@@ -1,0 +1,14 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh BEFORE any jax
+import, so sharding tests exercise real multi-device code paths without TPU
+hardware (mirrors the reference's ct_slave multi-node-on-one-host strategy,
+``vmq_cluster_test_utils.erl:109-175``)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

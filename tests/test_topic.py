@@ -1,0 +1,141 @@
+"""Topic algebra tests — corpus mirrors the reference eunit suite
+(``vmq_topic.erl:135-240``) plus hypothesis round-trip properties."""
+
+import pytest
+from hypothesis import given, strategies as st
+
+from vernemq_tpu.protocol import topic as T
+
+
+def ok(kind, s):
+    return T.validate_topic(kind, s)
+
+
+def err(kind, s):
+    with pytest.raises(T.TopicError) as e:
+        T.validate_topic(kind, s)
+    return e.value.reason
+
+
+class TestValidateNoWildcard:
+    def test_basic(self):
+        assert ok("subscribe", "a/b/c") == ["a", "b", "c"]
+        assert ok("subscribe", "/a/b") == ["", "a", "b"]
+        assert ok("subscribe", "test/topic/") == ["test", "topic", ""]
+        assert ok("subscribe", "test////a//topic") == ["test", "", "", "", "a", "", "topic"]
+        assert ok("subscribe", "/test////a//topic") == ["", "test", "", "", "", "a", "", "topic"]
+
+    def test_publish_empties(self):
+        assert ok("publish", "foo//bar///baz") == ["foo", "", "bar", "", "", "baz"]
+        assert ok("publish", "foo//baz//") == ["foo", "", "baz", "", ""]
+        assert ok("publish", "foo//baz") == ["foo", "", "baz"]
+        assert ok("publish", "foo//baz/bar") == ["foo", "", "baz", "bar"]
+        assert ok("publish", "////foo///bar") == ["", "", "", "", "foo", "", "", "bar"]
+
+
+class TestValidateWildcard:
+    def test_valid_subscribe(self):
+        assert ok("subscribe", "/+/x") == ["", "+", "x"]
+        assert ok("subscribe", "/a/b/c/#") == ["", "a", "b", "c", "#"]
+        assert ok("subscribe", "#") == ["#"]
+        assert ok("subscribe", "foo/#") == ["foo", "#"]
+        assert ok("subscribe", "foo/+/baz") == ["foo", "+", "baz"]
+        assert ok("subscribe", "foo/+/baz/#") == ["foo", "+", "baz", "#"]
+        assert ok("subscribe", "+/+/+/+/+/+/+/+/+/+/test") == ["+"] * 10 + ["test"]
+
+    def test_invalid_publish(self):
+        assert err("publish", "test/#-") == "no_#_allowed_in_word"
+        assert err("publish", "test/+-") == "no_+_allowed_in_word"
+        assert err("publish", "test/+/") == "no_+_allowed_in_publish"
+        assert err("publish", "test/#") == "no_#_allowed_in_publish"
+
+    def test_invalid_subscribe(self):
+        assert err("subscribe", "a/#/c") == "no_#_allowed_in_word"
+        assert err("subscribe", "#testtopic") == "no_#_allowed_in_word"
+        assert err("subscribe", "testtopic#") == "no_#_allowed_in_word"
+        assert err("subscribe", "+testtopic") == "no_+_allowed_in_word"
+        assert err("subscribe", "testtopic+") == "no_+_allowed_in_word"
+        assert err("subscribe", "#testtopic/test") == "no_#_allowed_in_word"
+        assert err("subscribe", "testtopic#/test") == "no_#_allowed_in_word"
+        assert err("subscribe", "+testtopic/test") == "no_+_allowed_in_word"
+        assert err("subscribe", "testtopic+/test") == "no_+_allowed_in_word"
+        assert err("subscribe", "/test/#testtopic") == "no_#_allowed_in_word"
+        assert err("subscribe", "/test/testtopic#") == "no_#_allowed_in_word"
+        assert err("subscribe", "/test/+testtopic") == "no_+_allowed_in_word"
+        assert err("subscribe", "/testtesttopic+") == "no_+_allowed_in_word"
+
+    def test_empty(self):
+        assert err("publish", "") == "no_empty_topic_allowed"
+        assert err("subscribe", "") == "no_empty_topic_allowed"
+
+
+class TestSharedSubscription:
+    def test_shared(self):
+        assert err("subscribe", "$share/mygroup") == "invalid_shared_subscription"
+        assert ok("subscribe", "$share/mygroup/a/b") == ["$share", "mygroup", "a", "b"]
+        assert T.unshare(["$share", "g", "a", "b"]) == ("g", ["a", "b"])
+        assert T.unshare(["a", "b"]) == (None, ["a", "b"])
+
+
+class TestMatch:
+    CASES = [
+        ("a/b/c", "a/b/c", True),
+        ("a/b/c", "a/b/d", False),
+        ("a/b/c", "+/b/c", True),
+        ("a/b/c", "a/+/c", True),
+        ("a/b/c", "a/b/+", True),
+        ("a/b/c", "#", True),
+        ("a/b/c", "a/#", True),
+        ("a/b/c", "a/b/#", True),
+        ("a/b/c", "a/b/c/#", True),  # '#' matches parent level
+        ("a/b", "a/b/#", True),
+        ("a", "a/#", True),
+        ("a", "a/+", False),
+        ("a/b/c", "a/+", False),
+        ("a/b/c", "+/+/+", True),
+        ("a/b/c", "+/+", False),
+        ("/a", "+/+", True),
+        ("/a", "/+", True),
+        ("/a", "+", False),
+        ("a//b", "a/+/b", True),
+        ("a//b", "a//b", True),
+        ("", "", True),
+    ]
+
+    @pytest.mark.parametrize("name,filt,want", CASES)
+    def test_match(self, name, filt, want):
+        assert T.match(name.split("/"), filt.split("/")) is want
+
+    def test_dollar_rule(self):
+        assert T.match_dollar_aware(["$SYS", "x"], ["#"]) is False
+        assert T.match_dollar_aware(["$SYS", "x"], ["+", "x"]) is False
+        assert T.match_dollar_aware(["$SYS", "x"], ["$SYS", "#"]) is True
+        assert T.match_dollar_aware(["$SYS", "x"], ["$SYS", "+"]) is True
+        assert T.match_dollar_aware(["a", "x"], ["#"]) is True
+
+
+class TestTriples:
+    def test_triples(self):
+        assert T.triples(["a"]) == [((), "a", ("a",))]
+        assert T.triples(["a", "b"]) == [((), "a", ("a",)), (("a",), "b", ("a", "b"))]
+
+
+words = st.text(alphabet="abcdefghijklmnopqrstuvwxyz0123456789", min_size=0, max_size=8)
+
+
+@given(st.lists(st.one_of(words, st.just("+")), min_size=1, max_size=20))
+def test_subscribe_roundtrip(topic_words):
+    s = "/".join(topic_words)
+    if s == "":
+        return
+    t = T.validate_topic("subscribe", s)
+    assert T.unword(t) == s
+
+
+@given(st.lists(words, min_size=1, max_size=20))
+def test_publish_roundtrip(topic_words):
+    s = "/".join(topic_words)
+    if s == "":
+        return
+    t = T.validate_topic("publish", s)
+    assert T.unword(t) == s

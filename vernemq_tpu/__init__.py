@@ -1,0 +1,3 @@
+"""vernemq_tpu: TPU-native distributed MQTT broker framework."""
+
+__version__ = "0.1.0"
