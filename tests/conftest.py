@@ -12,3 +12,42 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# ---------------------------------------------------------------------------
+# Minimal async-test support (pytest-asyncio is not in the image): async test
+# functions run on a per-test event loop; fixtures get the same loop via the
+# `event_loop` fixture.
+# ---------------------------------------------------------------------------
+import asyncio
+import inspect
+
+import pytest
+
+
+@pytest.fixture
+def event_loop():
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    yield loop
+    # let pending callbacks (cancellations) settle before closing
+    loop.run_until_complete(asyncio.sleep(0))
+    loop.close()
+    asyncio.set_event_loop(None)
+
+
+@pytest.hookimpl(tryfirst=True)
+def pytest_pyfunc_call(pyfuncitem):
+    testfn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(testfn):
+        loop = pyfuncitem._request.getfixturevalue("event_loop")
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        loop.run_until_complete(asyncio.wait_for(testfn(**kwargs), timeout=30))
+        return True
+    return None
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "asyncio: async test (built-in shim)")

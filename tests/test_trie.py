@@ -1,0 +1,105 @@
+"""Host trie tests: directed cases from the reference trie semantics
+(vmq_reg_trie.erl) plus a hypothesis cross-check against the pure
+``topic.match_dollar_aware`` function — trie walk and linear scan must agree
+on every (corpus, publish) pair."""
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from vernemq_tpu.models.trie import SubscriptionTrie
+from vernemq_tpu.protocol import topic as T
+
+
+def mk(*filters):
+    t = SubscriptionTrie()
+    for i, f in enumerate(filters):
+        t.add(f.split("/"), f"k{i}", f)
+    return t
+
+
+def matched_filters(t, pub):
+    return sorted(set("/".join(f) for f, _, _ in t.match(pub.split("/"))))
+
+
+class TestDirected:
+    def test_exact_and_wildcards(self):
+        t = mk("a/b/c", "a/+/c", "a/#", "#", "+/b/c", "x/y")
+        assert matched_filters(t, "a/b/c") == ["#", "+/b/c", "a/#", "a/+/c", "a/b/c"]
+        assert matched_filters(t, "a/b") == ["#", "a/#"]
+        assert matched_filters(t, "x/y") == ["#", "x/y"]
+
+    def test_hash_matches_parent(self):
+        t = mk("a/#")
+        assert matched_filters(t, "a") == ["a/#"]
+        assert matched_filters(t, "a/b/c/d") == ["a/#"]
+        assert matched_filters(t, "b") == []
+
+    def test_root_hash_matches_everything_but_dollar(self):
+        t = mk("#", "+/x")
+        assert matched_filters(t, "$SYS/x") == []
+        assert matched_filters(t, "sys/x") == ["#", "+/x"]
+
+    def test_dollar_explicit_subscription(self):
+        t = mk("$SYS/#", "$SYS/+/x")
+        assert matched_filters(t, "$SYS/a") == ["$SYS/#"]
+        assert matched_filters(t, "$SYS/a/x") == ["$SYS/#", "$SYS/+/x"]
+
+    def test_empty_words(self):
+        t = mk("/a", "+/a", "a//b", "a/+/b")
+        assert matched_filters(t, "/a") == ["+/a", "/a"]
+        assert matched_filters(t, "a//b") == ["a/+/b", "a//b"]
+
+    def test_multiple_entries_per_filter(self):
+        t = SubscriptionTrie()
+        t.add(["a", "b"], "k1", 1)
+        t.add(["a", "b"], "k2", 2)
+        assert len(t) == 2
+        rows = t.match(["a", "b"])
+        assert sorted(k for _, k, _ in rows) == ["k1", "k2"]
+
+    def test_remove_prunes(self):
+        t = SubscriptionTrie()
+        t.add(["a", "b", "c"], "k")
+        assert t.remove(["a", "b", "c"], "k")
+        assert not t.remove(["a", "b", "c"], "k")
+        assert len(t) == 0
+        assert t.stats()["nodes"] == 1  # only root left
+        assert t.match(["a", "b", "c"]) == []
+
+    def test_update_value(self):
+        t = SubscriptionTrie()
+        t.add(["a"], "k", 1)
+        t.add(["a"], "k", 2)
+        assert len(t) == 1
+        assert t.match(["a"])[0][2] == 2
+
+    def test_entries_roundtrip(self):
+        filters = ["a/b", "a/+", "#", "$SYS/x", "/"]
+        t = mk(*filters)
+        assert sorted("/".join(f) for f, _, _ in t.entries()) == sorted(filters)
+
+
+words = st.sampled_from(["a", "b", "c", "", "dev", "$SYS", "x1"])
+pub_topics = st.lists(words, min_size=1, max_size=5)
+sub_words = st.sampled_from(["a", "b", "c", "", "dev", "$SYS", "x1", "+"])
+
+
+@st.composite
+def sub_filter(draw):
+    base = draw(st.lists(sub_words, min_size=1, max_size=5))
+    if draw(st.booleans()):
+        base.append("#")
+    return base
+
+
+@given(st.lists(sub_filter(), min_size=0, max_size=30), pub_topics)
+@settings(max_examples=300)
+def test_trie_agrees_with_linear_match(filters, pub):
+    t = SubscriptionTrie()
+    for i, f in enumerate(filters):
+        t.add(f, i, None)
+    got = sorted((tuple(f), k) for f, k, _ in t.match(pub))
+    want = sorted(
+        (tuple(f), i) for i, f in enumerate(filters) if T.match_dollar_aware(pub, f)
+    )
+    assert got == want

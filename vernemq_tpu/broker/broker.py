@@ -1,0 +1,238 @@
+"""The Broker: assembly root tying config, metrics, hooks, registry, retain
+store, message store, sessions, and background services together.
+
+Plays the role of the reference's supervision root
+(``vmq_server_sup.erl:43-58`` boot order: config → msg store → queues →
+registry → cluster → metrics → listeners) — in asyncio there is no
+supervision tree, so this object owns construction order and shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..protocol import topic as T
+from ..protocol.types import Will
+from ..storage.msg_store import FileMsgStore, MemoryMsgStore, MsgStore
+from .config import Config
+from .message import Msg, SubscriberId
+from .metrics import Metrics
+from .plugins import HookError, HookRegistry
+from .queue import SubscriberQueue
+from .reg import Registry
+from .retain import RetainStore
+
+log = logging.getLogger("vernemq_tpu.broker")
+
+
+def _log_hook_task_error(task: "asyncio.Task") -> None:
+    if not task.cancelled() and task.exception() is not None:
+        log.error("async hook handler failed", exc_info=task.exception())
+
+
+class Broker:
+    def __init__(self, config: Optional[Config] = None, node_name: str = "node1"):
+        self.config = config or Config()
+        self.node_name = node_name
+        self.metrics = Metrics()
+        self.hooks = HookRegistry()
+        self.retain = RetainStore()
+        self.registry = Registry(self)
+        if self.config.message_store == "file":
+            self.msg_store: MsgStore = FileMsgStore(self.config.message_store_dir)
+        else:
+            self.msg_store = MemoryMsgStore()
+        # live sessions: sid -> Session (the reference reaches sessions via
+        # queue pids; a direct map is equivalent single-node)
+        self.sessions: Dict[SubscriberId, Any] = {}
+        self._delayed_wills: Dict[SubscriberId, asyncio.Task] = {}
+        self._servers: List[Any] = []
+        self._bg_tasks: List[asyncio.Task] = []
+        self._started = time.time()
+        self._cluster_ready = True  # single-node; cluster layer overrides
+        self.metrics.register_gauges(self._gauges, {
+            "router_subscriptions": "Subscriptions in the routing table.",
+            "router_memory": "Approximate routing table memory (bytes).",
+            "queue_processes": "Live subscriber queues.",
+            "retain_messages": "Retained messages.",
+            "active_sessions": "Currently connected sessions.",
+            "uptime_seconds": "Broker uptime.",
+        })
+
+    # ------------------------------------------------------------ plumbing
+
+    def _gauges(self) -> Dict[str, float]:
+        out = dict(self.registry.stats())
+        out["retain_messages"] = len(self.retain)
+        out["active_sessions"] = len(self.sessions)
+        out["uptime_seconds"] = time.time() - self._started
+        return out
+
+    def cluster_ready(self) -> bool:
+        """is_ready consistency gate (vmq_cluster.erl:67-92); the cluster
+        layer flips this on membership events."""
+        return self._cluster_ready
+
+    def hooks_fire_all(self, name: str, *args: Any) -> None:
+        """Fire-and-forget lifecycle hooks (on_register/on_publish/...).
+        Sync handlers run inline on the hot path; async handlers are
+        scheduled (the reference calls these synchronously in-process)."""
+        for fn in self.hooks.handlers(name):
+            try:
+                res = fn(*args)
+                if inspect.isawaitable(res):
+                    task = asyncio.ensure_future(res)
+                    task.add_done_callback(_log_hook_task_error)
+            except Exception:
+                log.exception("hook %s handler %r failed", name, fn)
+
+    async def auth_publish(
+        self,
+        sid: SubscriberId,
+        username: Optional[str],
+        topic: Tuple[str, ...],
+        payload: bytes,
+        qos: int,
+        retain: bool,
+        proto_ver: int,
+        properties: Optional[dict] = None,
+    ) -> Dict[str, Any]:
+        """auth_on_publish(_m5) chain; returns modifier dict (may rewrite
+        topic/payload), raises HookError on deny
+        (vmq_mqtt_fsm.erl:681-746)."""
+        hook = "auth_on_publish_m5" if proto_ver == 5 else "auth_on_publish"
+        try:
+            res = await self.hooks.all_till_ok(
+                hook, username, sid, qos, topic, payload, retain
+            )
+        except HookError as e:
+            if e.reason == "no_matching_hook_found":
+                return {}  # no auth plugin → allow (vmq_plugin default)
+            raise
+        if isinstance(res, tuple):
+            return res[1]
+        return {}
+
+    # ----------------------------------------------------- session support
+
+    async def takeover(self, sid: SubscriberId, new_session: Any) -> None:
+        """Duplicate ClientId: disconnect the live session
+        (vmq_connect_SUITE takeover semantics)."""
+        old = self.sessions.get(sid)
+        if old is not None and old is not new_session:
+            await old.takeover_close()
+
+    def schedule_will(self, sid: SubscriberId, will: Will, mountpoint: str,
+                      proto_ver: int, session_expiry: int) -> None:
+        """Publish the LWT, possibly after the v5 will-delay interval
+        (vmq_mqtt5_fsm set_delayed_will; vmq_queue.erl:932-942). The will is
+        cancelled if the client reconnects before the delay elapses."""
+        delay = will.properties.get("will_delay_interval", 0)
+        cap = self.config.max_last_will_delay
+        if cap:
+            delay = min(delay, cap)
+        if session_expiry:
+            delay = min(delay, session_expiry)
+
+        def _publish_will() -> None:
+            try:
+                words = tuple(T.validate_topic("publish", will.topic))
+            except T.TopicError:
+                return
+            props = {
+                k: v for k, v in will.properties.items()
+                if k in ("payload_format_indicator", "message_expiry_interval",
+                         "content_type", "response_topic", "correlation_data",
+                         "user_property")
+            }
+            msg = Msg(topic=words, payload=will.payload, qos=will.qos,
+                      retain=will.retain, mountpoint=mountpoint, properties=props)
+            expiry = props.get("message_expiry_interval")
+            if expiry:
+                msg.expires_at = time.monotonic() + expiry
+            try:
+                self.registry.publish(msg)
+            except RuntimeError:
+                pass
+
+        if delay <= 0:
+            _publish_will()
+            return
+
+        async def _delayed() -> None:
+            await asyncio.sleep(delay)
+            self._delayed_wills.pop(sid, None)
+            _publish_will()
+
+        self.cancel_delayed_will(sid)
+        self._delayed_wills[sid] = asyncio.get_event_loop().create_task(_delayed())
+
+    def cancel_delayed_will(self, sid: SubscriberId) -> None:
+        t = self._delayed_wills.pop(sid, None)
+        if t is not None:
+            t.cancel()
+
+    # ------------------------------------------------------ offline storage
+
+    def store_offline(self, sid: SubscriberId, msg: Msg) -> None:
+        self.msg_store.write(sid, msg)
+        self.metrics.incr("msg_store_ops_write")
+
+    def recover_offline(self, sid: SubscriberId, queue: SubscriberQueue) -> None:
+        """Rebuild the offline backlog from storage on queue re-creation
+        (vmq_queue offline(init_offline_queue), vmq_lvldb_store.erl:396-416)."""
+        msgs = self.msg_store.read_all(sid)
+        if msgs:
+            queue.offline.extend(msgs)
+            self.metrics.incr("queue_initialized_from_storage")
+
+    def delete_offline(self, sid: SubscriberId) -> None:
+        self.msg_store.delete_all(sid)
+        self.metrics.incr("msg_store_ops_delete")
+
+    def offline_delivered(self, sid: SubscriberId, msg: Msg) -> None:
+        self.msg_store.delete(sid, msg.msg_ref)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def on_trie_delta(self) -> None:
+        """Subscription change event — feeds the TPU table delta stream
+        (the analog of vmq_reg_trie consuming subscriber-db events)."""
+        view = self.registry.reg_views.get("tpu")
+        if view is not None:
+            view.mark_dirty()
+
+    async def start_systree(self) -> None:
+        """$SYS tree publisher (vmq_systree.erl): periodic internal publish
+        of all metrics to $SYS/<node>/... topics."""
+        interval = self.config.systree_interval
+        while True:
+            await asyncio.sleep(interval)
+            for name, value in self.metrics.all_metrics().items():
+                topic = ("$SYS", self.node_name, *name.split("_"))
+                msg = Msg(topic=topic, payload=str(value).encode(), qos=0)
+                try:
+                    self.registry.publish(msg)
+                except RuntimeError:
+                    pass
+
+    async def start(self) -> None:
+        if self.config.systree_enabled:
+            self._bg_tasks.append(asyncio.get_event_loop().create_task(
+                self.start_systree()))
+
+    async def stop(self) -> None:
+        for t in self._bg_tasks:
+            t.cancel()
+        for t in self._delayed_wills.values():
+            t.cancel()
+        self._delayed_wills.clear()
+        for s in list(self.sessions.values()):
+            await s.close("broker_shutdown", send_will=False)
+        for server in self._servers:
+            server.close()
+        self.msg_store.close()

@@ -1,0 +1,98 @@
+"""Layered broker configuration.
+
+Mirrors the reference's config system shape (``vmq_config.erl``: file <
+app-default < stored-global < stored-per-node, cached lookups;
+``priv/vmq_server.schema`` for the knob names) without cuttlefish — plain
+defaults dict + override layers. Knob names keep the reference's schema
+names so an operator coming from the reference finds the same switches.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+DEFAULTS: Dict[str, Any] = {
+    # connection / session (vmq_server.schema)
+    "allow_anonymous": True,  # reference default is off; on here until auth plugins land in the boot path
+    "max_client_id_size": 100,
+    "persistent_client_expiration": 0,  # seconds; 0 = never expire
+    "max_inflight_messages": 20,
+    "max_online_messages": 1000,
+    "max_offline_messages": 1000,
+    "queue_deliver_mode": "fanout",  # fanout | balance (vmq_queue.erl:826-835)
+    "queue_type": "fifo",  # fifo | lifo offline drop policy (vmq_queue.erl:845-865)
+    "upgrade_outgoing_qos": False,
+    "allow_multiple_sessions": False,
+    "retry_interval": 20,
+    "max_message_rate": 0,  # msgs/sec per session; 0 = unlimited
+    "max_message_size": 0,  # bytes; 0 = unlimited
+    "max_last_will_delay": 0,  # v5 will-delay cap, seconds
+    "receive_max_broker": 10,
+    "receive_max_client": 65535,
+    "suppress_lwt_on_session_takeover": False,
+    "coordinate_registrations": True,
+    # netsplit CAP flags (vmq_server.schema:13-35, vmq_reg.erl:65-70)
+    "allow_register_during_netsplit": False,
+    "allow_publish_during_netsplit": False,
+    "allow_subscribe_during_netsplit": False,
+    "allow_unsubscribe_during_netsplit": False,
+    # shared subscriptions (vmq_shared_subscriptions.erl:90-106)
+    "shared_subscription_policy": "prefer_local",  # prefer_local|local_only|random
+    # v5
+    "topic_alias_max_client": 0,
+    "topic_alias_max_broker": 0,
+    "max_session_expiry_interval": 0,  # 0 → no cap (v5 session_expiry_interval)
+    # matcher
+    "default_reg_view": "trie",  # trie | tpu — the reg-view seam (vmq_mqtt_fsm.erl:105)
+    "tpu_batch_window_us": 200,
+    "tpu_max_fanout": 1024,
+    # systree / metrics
+    "systree_enabled": True,
+    "systree_interval": 20,
+    "graphite_enabled": False,
+    # storage
+    "message_store": "memory",  # memory | file
+    "message_store_dir": "./data/msgstore",
+    "metadata_dir": "./data/meta",
+}
+
+
+class Config:
+    """Override layers: constructor kwargs > set() calls > DEFAULTS."""
+
+    def __init__(self, **overrides: Any):
+        self._values: Dict[str, Any] = dict(DEFAULTS)
+        for k, v in overrides.items():
+            if k not in DEFAULTS:
+                raise KeyError(f"unknown config key: {k}")
+            self._values[k] = v
+        self._listeners: List[Callable[[str, Any], None]] = []
+
+    def get(self, key: str, default: Any = None) -> Any:
+        if key in self._values:
+            return self._values[key]
+        if default is not None:
+            return default
+        raise KeyError(key)
+
+    def __getattr__(self, key: str) -> Any:
+        try:
+            return self._values[key]
+        except KeyError:
+            raise AttributeError(key) from None
+
+    def set(self, key: str, value: Any) -> None:
+        """Runtime config change with change-event fan-out
+        (vmq_config.erl:220-246 change_config)."""
+        if key not in DEFAULTS:
+            raise KeyError(f"unknown config key: {key}")
+        self._values[key] = value
+        for fn in self._listeners:
+            fn(key, value)
+
+    def on_change(self, fn: Callable[[str, Any], None]) -> None:
+        self._listeners.append(fn)
+
+    def snapshot(self) -> Dict[str, Any]:
+        return dict(self._values)

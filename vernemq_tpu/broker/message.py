@@ -1,0 +1,58 @@
+"""Broker-internal message record.
+
+Equivalent of the reference's ``#vmq_msg{}`` record (msg_ref, routing key,
+payload, QoS, retain/dup flags, mountpoint, v5 properties; see
+``vmq_cluster_com.erl:212-248`` for the field set) — the unit that flows
+registry → queue → session, independent of the wire frames.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+SubscriberId = Tuple[str, str]  # (mountpoint, client_id) — vmq_types.hrl
+
+_ref_counter = itertools.count()
+_node_seed = os.urandom(4).hex()
+
+
+def new_msg_ref() -> bytes:
+    """Unique message reference (the reference uses a 16-byte ref; ours is
+    node-seed + counter, unique per broker process)."""
+    return f"{_node_seed}:{next(_ref_counter)}".encode()
+
+
+@dataclass
+class Msg:
+    topic: Tuple[str, ...]  # routing key as word tuple
+    payload: bytes
+    qos: int = 0
+    retain: bool = False
+    dup: bool = False
+    mountpoint: str = ""
+    msg_ref: bytes = field(default_factory=new_msg_ref)
+    properties: Dict[str, Any] = field(default_factory=dict)
+    # expiry: absolute monotonic deadline derived from the v5
+    # message_expiry_interval property (vmq_mqtt5_fsm message expiry)
+    expires_at: Optional[float] = None
+    # $share sender info: set when delivered via a shared subscription
+    sg_policy: Optional[str] = None
+
+    def with_qos(self, qos: int) -> "Msg":
+        if qos == self.qos:
+            return self
+        return Msg(
+            topic=self.topic,
+            payload=self.payload,
+            qos=qos,
+            retain=self.retain,
+            dup=self.dup,
+            mountpoint=self.mountpoint,
+            msg_ref=self.msg_ref,
+            properties=self.properties,
+            expires_at=self.expires_at,
+        )

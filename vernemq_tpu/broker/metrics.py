@@ -1,0 +1,138 @@
+"""Broker metrics: counter/gauge registry with Prometheus text exposition.
+
+Mirrors the reference metric system (``vmq_metrics.erl``): named counters
+incremented on every protocol event, gauge providers sampled at scrape time,
+per-metric type/description metadata (``vmq_metrics.erl:627-1080``), and a
+``check_rate`` helper backing ``max_message_rate`` throttling
+(``vmq_metrics.erl:286``). The reference keeps counters in a wait-free C NIF
+(mzmetrics); here the asyncio broker is single-threaded on the hot path so
+plain int cells suffice — a C++ shard-per-thread counter block is the planned
+swap-in when the native runtime lands.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+COUNTERS: List[Tuple[str, str]] = [
+    # socket / session counters (vmq_metrics.hrl names)
+    ("socket_open", "The number of AF_INET opens."),
+    ("socket_close", "The number of AF_INET closes."),
+    ("socket_error", "The number of socket errors."),
+    ("bytes_received", "The total number of bytes received."),
+    ("bytes_sent", "The total number of bytes sent."),
+    ("mqtt_connect_received", "The number of CONNECT packets received."),
+    ("mqtt_connack_sent", "The number of CONNACK packets sent."),
+    ("mqtt_publish_received", "The number of PUBLISH packets received."),
+    ("mqtt_publish_sent", "The number of PUBLISH packets sent."),
+    ("mqtt_puback_received", "The number of PUBACK packets received."),
+    ("mqtt_puback_sent", "The number of PUBACK packets sent."),
+    ("mqtt_pubrec_received", "The number of PUBREC packets received."),
+    ("mqtt_pubrec_sent", "The number of PUBREC packets sent."),
+    ("mqtt_pubrel_received", "The number of PUBREL packets received."),
+    ("mqtt_pubrel_sent", "The number of PUBREL packets sent."),
+    ("mqtt_pubcomp_received", "The number of PUBCOMP packets received."),
+    ("mqtt_pubcomp_sent", "The number of PUBCOMP packets sent."),
+    ("mqtt_subscribe_received", "The number of SUBSCRIBE packets received."),
+    ("mqtt_suback_sent", "The number of SUBACK packets sent."),
+    ("mqtt_unsubscribe_received", "The number of UNSUBSCRIBE packets received."),
+    ("mqtt_unsuback_sent", "The number of UNSUBACK packets sent."),
+    ("mqtt_pingreq_received", "The number of PINGREQ packets received."),
+    ("mqtt_pingresp_sent", "The number of PINGRESP packets sent."),
+    ("mqtt_disconnect_received", "The number of DISCONNECT packets received."),
+    ("mqtt_disconnect_sent", "The number of DISCONNECT packets sent (MQTT5)."),
+    ("mqtt_auth_received", "The number of AUTH packets received (MQTT5)."),
+    ("mqtt_auth_sent", "The number of AUTH packets sent (MQTT5)."),
+    ("mqtt_connect_error", "Failed CONNECT attempts."),
+    ("mqtt_publish_error", "Failed PUBLISH attempts."),
+    ("mqtt_publish_auth_error", "Unauthorized PUBLISH attempts."),
+    ("mqtt_subscribe_error", "Failed SUBSCRIBE attempts."),
+    ("mqtt_subscribe_auth_error", "Unauthorized SUBSCRIBE attempts."),
+    ("mqtt_unsubscribe_error", "Failed UNSUBSCRIBE attempts."),
+    ("mqtt_invalid_msg_size_error", "Oversized messages dropped."),
+    ("queue_setup", "The number of queue processes created."),
+    ("queue_teardown", "The number of queue processes terminated."),
+    ("queue_message_in", "Messages enqueued."),
+    ("queue_message_out", "Messages delivered from queues."),
+    ("queue_message_drop", "Messages dropped (queue full / offline QoS0)."),
+    ("queue_message_expired", "Expired messages dropped from queues."),
+    ("queue_message_unhandled", "Messages not handled (offline session)."),
+    ("queue_initialized_from_storage", "Queues re-initialized from offline storage."),
+    ("client_expired", "Persistent sessions expired."),
+    ("cluster_bytes_received", "Bytes received over cluster channels."),
+    ("cluster_bytes_sent", "Bytes sent over cluster channels."),
+    ("cluster_bytes_dropped", "Bytes dropped on cluster channels."),
+    ("netsplit_detected", "Netsplits detected."),
+    ("netsplit_resolved", "Netsplits resolved."),
+    ("router_matches_local", "Subscriptions matched for local delivery."),
+    ("router_matches_remote", "Subscriptions matched for remote delivery."),
+    ("tpu_match_batches", "Batched TPU match kernel invocations."),
+    ("tpu_match_publishes", "Publishes matched on the TPU path."),
+    ("msg_store_ops_write", "Message store writes."),
+    ("msg_store_ops_delete", "Message store deletes."),
+    ("retain_messages_stored", "Retained messages persisted."),
+]
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._counters: Dict[str, int] = {name: 0 for name, _ in COUNTERS}
+        self._descriptions: Dict[str, str] = dict(COUNTERS)
+        self._gauge_providers: List[Callable[[], Dict[str, float]]] = []
+        self._gauge_desc: Dict[str, str] = {}
+        self._rate_state: Dict[object, Tuple[float, int]] = {}
+
+    def incr(self, name: str, n: int = 1) -> None:
+        self._counters[name] = self._counters.get(name, 0) + n
+
+    def value(self, name: str) -> int:
+        return self._counters.get(name, 0)
+
+    def register_gauges(
+        self, provider: Callable[[], Dict[str, float]], descriptions: Dict[str, str]
+    ) -> None:
+        """Pluggable gauge providers, like the reference's pluggable
+        ``metrics/0`` modules (vmq_metrics.erl metrics plugins)."""
+        self._gauge_providers.append(provider)
+        self._gauge_desc.update(descriptions)
+
+    def check_rate(self, key: object, max_per_sec: int) -> bool:
+        """Sliding-window rate check for max_message_rate
+        (vmq_metrics.erl:286). True = within budget."""
+        if max_per_sec <= 0:
+            return True
+        now = time.monotonic()
+        start, count = self._rate_state.get(key, (now, 0))
+        if now - start >= 1.0:
+            start, count = now, 0
+        count += 1
+        self._rate_state[key] = (start, count)
+        return count <= max_per_sec
+
+    def drop_rate_state(self, key: object) -> None:
+        self._rate_state.pop(key, None)
+
+    def all_metrics(self) -> Dict[str, float]:
+        out: Dict[str, float] = dict(self._counters)
+        for provider in self._gauge_providers:
+            out.update(provider())
+        return out
+
+    def prometheus_text(self, node: str = "local") -> str:
+        """Prometheus exposition format (vmq_metrics_http.erl:42-84)."""
+        lines: List[str] = []
+        gauges: Dict[str, float] = {}
+        for provider in self._gauge_providers:
+            gauges.update(provider())
+        for name, val in sorted(self._counters.items()):
+            desc = self._descriptions.get(name, name)
+            lines.append(f"# HELP {name} {desc}")
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f'{name}{{node="{node}"}} {val}')
+        for name, val in sorted(gauges.items()):
+            desc = self._gauge_desc.get(name, name)
+            lines.append(f"# HELP {name} {desc}")
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f'{name}{{node="{node}"}} {val}')
+        return "\n".join(lines) + "\n"

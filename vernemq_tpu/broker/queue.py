@@ -1,0 +1,225 @@
+"""Per-subscriber queue: the broker-side mailbox between the registry fanout
+and the client session(s).
+
+Mirrors the reference queue gen_fsm (``apps/vmq_server/src/vmq_queue.erl``):
+states ``online`` (≥1 attached session) / ``offline`` (persistent session,
+no attachment) / ``drain`` (migration, later rounds); per-session delivery
+with ``fanout``/``balance`` modes for multiple sessions per ClientId
+(``vmq_queue.erl:826-835``); an offline queue capped by
+``max_offline_messages`` with FIFO tail-drop or LIFO oldest-drop
+(``vmq_queue.erl:845-865``); QoS0 dropped when offline; session-expiry
+timer (``vmq_queue.erl:913-930``); lifecycle hooks ``on_client_wakeup`` /
+``on_client_offline`` / ``on_client_gone`` / ``on_offline_message`` /
+``on_message_drop`` (``vmq_queue.erl:614,658-700,1059-1070``).
+
+The reference's active/passive/notify backpressure protocol between queue
+and session process collapses here: sessions are asyncio tasks in the same
+loop, so delivery is a direct callback into the session, which applies its
+own inflight window; overflow beyond ``max_online_messages`` is dropped with
+accounting like the reference's online-queue cap.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+from typing import TYPE_CHECKING, Callable, Deque, Dict, List, Optional
+
+from .message import Msg, SubscriberId
+
+if TYPE_CHECKING:
+    from .broker import Broker
+
+ONLINE = "online"
+OFFLINE = "offline"
+DRAIN = "drain"
+TERMINATED = "terminated"
+
+
+class QueueOpts:
+    __slots__ = (
+        "clean_session",
+        "max_offline_messages",
+        "max_online_messages",
+        "deliver_mode",
+        "queue_type",
+        "session_expiry",
+        "is_plugin",
+    )
+
+    def __init__(
+        self,
+        clean_session: bool = True,
+        max_offline_messages: int = 1000,
+        max_online_messages: int = 1000,
+        deliver_mode: str = "fanout",
+        queue_type: str = "fifo",
+        session_expiry: int = 0,  # seconds; 0 = persistent_client_expiration config
+        is_plugin: bool = False,
+    ):
+        self.clean_session = clean_session
+        self.max_offline_messages = max_offline_messages
+        self.max_online_messages = max_online_messages
+        self.deliver_mode = deliver_mode
+        self.queue_type = queue_type
+        self.session_expiry = session_expiry
+        self.is_plugin = is_plugin
+
+
+class SubscriberQueue:
+    """One queue per SubscriberId (the reference partitions these across
+    phash2 supervisors, vmq_queue_sup_sup.erl:65-92; a Python dict gives the
+    same O(1) lookup without the supervision tree)."""
+
+    def __init__(self, broker: "Broker", subscriber_id: SubscriberId, opts: QueueOpts):
+        self.broker = broker
+        self.subscriber_id = subscriber_id
+        self.opts = opts
+        self.state = OFFLINE
+        # session_handle -> deliver callback; a handle is the Session object
+        self.sessions: Dict[object, Callable[[Msg], bool]] = {}
+        self._rr: int = 0  # round-robin cursor for balance mode
+        self.offline: Deque[Msg] = deque()
+        self._expiry_task: Optional[asyncio.Task] = None
+        self.created = time.time()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def add_session(self, session: object, deliver: Callable[[Msg], bool]) -> None:
+        """Attach a session; offline→online wakes the queue and flushes the
+        offline backlog through the new session (vmq_queue.erl:458-460 +
+        init_offline_queue)."""
+        was_offline = self.state == OFFLINE
+        self.sessions[session] = deliver
+        self.state = ONLINE
+        self._cancel_expiry()
+        if was_offline:
+            self.broker.hooks_fire_all("on_client_wakeup", self.subscriber_id)
+            backlog, self.offline = self.offline, deque()
+            if backlog:
+                # handed to the session's inflight tracking; clear storage
+                # (per-ref deletes on ack come with the native store)
+                self.broker.delete_offline(self.subscriber_id)
+            for msg in backlog:
+                if msg.expires_at is not None and msg.expires_at < time.monotonic():
+                    self.broker.metrics.incr("queue_message_expired")
+                    continue
+                self._deliver_online(msg)
+
+    def del_session(self, session: object) -> None:
+        """Detach; last session out moves the queue offline (persistent) or
+        tears it down (clean session), vmq_queue wait_for_offline."""
+        self.sessions.pop(session, None)
+        if self.sessions:
+            return
+        if self.opts.clean_session:
+            self.terminate("normal")
+        else:
+            self.state = OFFLINE
+            self.broker.hooks_fire_all("on_client_offline", self.subscriber_id)
+            self._arm_expiry()
+
+    def terminate(self, reason: str) -> None:
+        if self.state == TERMINATED:
+            return
+        self.state = TERMINATED
+        self._cancel_expiry()
+        for msg in self.offline:
+            self._drop(msg)
+        self.offline.clear()
+        self.broker.registry.queue_terminated(self.subscriber_id)
+        self.broker.hooks_fire_all("on_client_gone", self.subscriber_id)
+        self.broker.metrics.incr("queue_teardown")
+
+    def _arm_expiry(self) -> None:
+        """Persistent-session expiry (persistent_client_expiration config or
+        MQTT5 session_expiry_interval), vmq_queue.erl:913-930."""
+        expiry = self.opts.session_expiry or self.broker.config.persistent_client_expiration
+        if expiry <= 0:
+            return
+        loop = asyncio.get_event_loop()
+
+        async def _expire():
+            await asyncio.sleep(expiry)
+            if self.state == OFFLINE:
+                self.broker.metrics.incr("client_expired")
+                self.broker.registry.cleanup_subscriber(self.subscriber_id)
+
+        self._expiry_task = loop.create_task(_expire())
+
+    def _cancel_expiry(self) -> None:
+        if self._expiry_task is not None:
+            self._expiry_task.cancel()
+            self._expiry_task = None
+
+    # -- enqueue path ------------------------------------------------------
+
+    def enqueue(self, msg: Msg) -> None:
+        """Hot-path entry from the registry fanout (vmq_queue:enqueue/2)."""
+        self.broker.metrics.incr("queue_message_in")
+        if self.state == ONLINE:
+            self._deliver_online(msg)
+        elif self.state == OFFLINE:
+            self._enqueue_offline(msg)
+        else:  # drain/terminated: drop with accounting
+            self._drop(msg)
+
+    def _deliver_online(self, msg: Msg) -> None:
+        if not self.sessions:
+            self._enqueue_offline(msg)
+            return
+        if self.opts.deliver_mode == "balance" and len(self.sessions) > 1:
+            # balance: one session per message, round-robin (the reference
+            # picks randomly, vmq_queue.erl:826-835 — RR gives fairer tests)
+            handlers = list(self.sessions.values())
+            self._rr = (self._rr + 1) % len(handlers)
+            ok = handlers[self._rr](msg)
+            if ok:
+                self.broker.metrics.incr("queue_message_out")
+            else:
+                self._drop(msg)
+        else:  # fanout
+            delivered = False
+            for deliver in list(self.sessions.values()):
+                if deliver(msg):
+                    delivered = True
+                    self.broker.metrics.incr("queue_message_out")
+            if not delivered:
+                self._drop(msg)
+
+    def _enqueue_offline(self, msg: Msg) -> None:
+        if self.opts.clean_session:
+            self._drop(msg)
+            return
+        if msg.qos == 0:
+            # QoS0 is not stored for offline sessions (vmq_queue offline drop)
+            self._drop(msg)
+            return
+        cap = self.opts.max_offline_messages
+        if cap > 0 and len(self.offline) >= cap:
+            if self.opts.queue_type == "fifo":
+                self._drop(msg)  # tail-drop the new message
+                return
+            # lifo: drop the oldest to make room (vmq_queue.erl:845-865)
+            self._drop(self.offline.popleft())
+        self.offline.append(msg)
+        self.broker.hooks_fire_all("on_offline_message", self.subscriber_id, msg)
+        self.broker.store_offline(self.subscriber_id, msg)
+
+    def _drop(self, msg: Msg) -> None:
+        self.broker.metrics.incr("queue_message_drop")
+        self.broker.hooks_fire_all("on_message_drop", self.subscriber_id, msg, "queue_drop")
+
+    # -- introspection -----------------------------------------------------
+
+    def info(self) -> Dict[str, object]:
+        return {
+            "subscriber_id": self.subscriber_id,
+            "state": self.state,
+            "sessions": len(self.sessions),
+            "offline_messages": len(self.offline),
+            "clean_session": self.opts.clean_session,
+            "deliver_mode": self.opts.deliver_mode,
+            "started": self.created,
+        }

@@ -1,0 +1,356 @@
+"""Registry: subscribe/unsubscribe/register ops and the publish fanout.
+
+Mirrors ``apps/vmq_server/src/vmq_reg.erl``:
+
+- the **reg-view seam** (``vmq_reg_view.erl:20-27``): a RegView exposes
+  ``fold(topic) -> match rows``; ``TrieRegView`` (host trie) and the TPU
+  engine's view are interchangeable via config ``default_reg_view``;
+- ``publish``: retain set/delete first, then fold the view; per matched row
+  enqueue locally, collect shared-subscription group members for policy
+  selection, forward remote-node pointers to the cluster channel
+  (``vmq_reg.erl:265-353``);
+- RAP flag: live-routed deliveries clear the retain flag unless the v5
+  retain-as-published option is set (``vmq_reg.erl:355-360``);
+- ``no_local``: a subscriber never receives its own publishes on a no-local
+  subscription (``vmq_reg.erl:330-341``);
+- subscribe triggers retained replay per filter (``vmq_reg.erl:380-418``)
+  honoring v5 retain-handling;
+- shared-subscription member selection by policy with online members
+  preferred (``vmq_shared_subscriptions.erl:26-63,90-106``).
+
+Single-node in round 1: remote-node entries and the is_ready CAP gate are
+wired (cluster layer fills them in), with local behavior already faithful.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import TYPE_CHECKING, Any, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..models.trie import SubscriptionTrie
+from ..protocol.topic import is_shared, unshare
+from ..protocol.types import SubOpts
+from .message import Msg, SubscriberId
+from .queue import OFFLINE, ONLINE, QueueOpts, SubscriberQueue
+
+if TYPE_CHECKING:
+    from .broker import Broker
+
+
+class RetainedMsg:
+    """Stored retained message (#retain_msg{}, vmq_reg.erl:281-287)."""
+
+    __slots__ = ("payload", "properties", "expiry_ts", "qos")
+
+    def __init__(self, payload: bytes, properties: Dict[str, Any], qos: int,
+                 expiry_ts: Optional[float] = None):
+        self.payload = payload
+        self.properties = properties
+        self.qos = qos
+        self.expiry_ts = expiry_ts
+
+
+class TrieRegView:
+    """Default reg view: fold over the host subscription trie
+    (vmq_reg_trie:fold/4)."""
+
+    name = "trie"
+
+    def __init__(self, registry: "Registry"):
+        self._registry = registry
+
+    def fold(self, mountpoint: str, topic: Sequence[str]):
+        """Yield match rows: (filter, key, subopts). Keys are SubscriberId
+        for plain subs or ("$g", group, SubscriberId) for shared subs."""
+        return self._registry.trie(mountpoint).match(topic)
+
+
+class Registry:
+    def __init__(self, broker: "Broker"):
+        self.broker = broker
+        self._tries: Dict[str, SubscriptionTrie] = {}  # per-mountpoint
+        # subscriber DB: sid -> {filter_words_tuple: SubOpts}
+        # (vmq_subscriber_db over metadata; local dict in round 1)
+        self.subscriptions: Dict[SubscriberId, Dict[Tuple[str, ...], SubOpts]] = {}
+        self.queues: Dict[SubscriberId, SubscriberQueue] = {}
+        self.reg_views: Dict[str, Any] = {"trie": TrieRegView(self)}
+        # remote-node fanout hook, filled by the cluster layer:
+        # fn(node, msg) -> None (vmq_cluster:publish/2)
+        self.remote_publish = None
+
+    def trie(self, mountpoint: str = "") -> SubscriptionTrie:
+        t = self._tries.get(mountpoint)
+        if t is None:
+            t = self._tries[mountpoint] = SubscriptionTrie()
+        return t
+
+    def reg_view(self, name: Optional[str] = None):
+        return self.reg_views[name or self.broker.config.default_reg_view]
+
+    # -- session registration ---------------------------------------------
+
+    def register_subscriber(
+        self, sid: SubscriberId, clean_start: bool, queue_opts: QueueOpts
+    ) -> Tuple[SubscriberQueue, bool]:
+        """Create/reuse the subscriber queue; returns (queue,
+        session_present) (vmq_reg:register_subscriber, vmq_reg.erl:107-140).
+        Session takeover of live sessions is handled by the session layer
+        before calling this."""
+        existing = self.queues.get(sid)
+        if clean_start:
+            if existing is not None:
+                self.cleanup_subscriber(sid)
+            queue = self._start_queue(sid, queue_opts)
+            return queue, False
+        session_present = existing is not None or sid in self.subscriptions
+        if existing is not None:
+            existing.opts = queue_opts
+            return existing, session_present
+        queue = self._start_queue(sid, queue_opts)
+        if session_present:
+            self.broker.recover_offline(sid, queue)
+        return queue, session_present
+
+    def _start_queue(self, sid: SubscriberId, opts: QueueOpts) -> SubscriberQueue:
+        queue = SubscriberQueue(self.broker, sid, opts)
+        self.queues[sid] = queue
+        self.broker.metrics.incr("queue_setup")
+        return queue
+
+    def get_queue(self, sid: SubscriberId) -> Optional[SubscriberQueue]:
+        return self.queues.get(sid)
+
+    def queue_terminated(self, sid: SubscriberId) -> None:
+        """Callback from SubscriberQueue.terminate: drop registry state for
+        clean sessions."""
+        q = self.queues.pop(sid, None)
+        if q is not None and q.opts.clean_session:
+            self._remove_all_subscriptions(sid)
+
+    def cleanup_subscriber(self, sid: SubscriberId) -> None:
+        """Full cleanup: subscriptions + queue + offline storage
+        (vmq_reg cleanup via vmq_reg_sync, and client_expired path)."""
+        self._remove_all_subscriptions(sid)
+        q = self.queues.pop(sid, None)
+        if q is not None:
+            q.opts.clean_session = True  # prevent re-offline
+            q.terminate("cleanup")
+        self.broker.delete_offline(sid)
+
+    def _remove_all_subscriptions(self, sid: SubscriberId) -> None:
+        subs = self.subscriptions.pop(sid, None)
+        if not subs:
+            return
+        trie = self.trie(sid[0])
+        for filter_words in subs:
+            group, rest = unshare(list(filter_words))
+            if group is None:
+                trie.remove(filter_words, sid)
+            else:
+                trie.remove(rest, ("$g", group, sid))
+        self.broker.on_trie_delta()
+
+    # -- subscribe / unsubscribe ------------------------------------------
+
+    def subscribe(
+        self, sid: SubscriberId, topics: List[Tuple[List[str], SubOpts]]
+    ) -> List[int]:
+        """Add subscriptions; returns granted qos per topic
+        (vmq_reg:subscribe → subscribe_op, vmq_reg.erl:62-99,636-653)."""
+        mountpoint = sid[0]
+        trie = self.trie(mountpoint)
+        subs = self.subscriptions.setdefault(sid, {})
+        granted = []
+        for words, opts in topics:
+            key = tuple(words)
+            existed = key in subs
+            subs[key] = opts
+            group, rest = unshare(list(words))
+            if group is None:
+                trie.add(words, sid, opts)
+            else:
+                trie.add(rest, ("$g", group, sid), opts)
+            granted.append(opts.qos)
+            # retained replay (vmq_reg.erl:380-418); none for shared subs
+            # (MQTT5: retained messages are not sent to shared subscriptions)
+            if group is None and opts.retain_handling != 2:
+                if not (opts.retain_handling == 1 and existed):
+                    self._deliver_retained(sid, words, opts)
+        self.broker.on_trie_delta()
+        return granted
+
+    def unsubscribe(self, sid: SubscriberId, topics: List[List[str]]) -> List[bool]:
+        mountpoint = sid[0]
+        trie = self.trie(mountpoint)
+        subs = self.subscriptions.get(sid, {})
+        results = []
+        for words in topics:
+            key = tuple(words)
+            existed = subs.pop(key, None) is not None
+            group, rest = unshare(list(words))
+            if group is None:
+                trie.remove(words, sid)
+            else:
+                trie.remove(rest, ("$g", group, sid))
+            results.append(existed)
+        if not subs:
+            self.subscriptions.pop(sid, None)
+        self.broker.on_trie_delta()
+        return results
+
+    def _deliver_retained(self, sid: SubscriberId, filter_words: List[str], opts: SubOpts) -> None:
+        queue = self.queues.get(sid)
+        if queue is None:
+            return
+        now = time.time()
+        for topic, rmsg in self.broker.retain.match_filter(sid[0], filter_words):
+            if rmsg.expiry_ts is not None and rmsg.expiry_ts < now:
+                continue
+            msg = Msg(
+                topic=topic,
+                payload=rmsg.payload,
+                qos=min(opts.qos, rmsg.qos),
+                retain=True,
+                mountpoint=sid[0],
+                properties=dict(rmsg.properties),
+            )
+            queue.enqueue(msg)
+
+    # -- publish fanout (HOT PATH) ----------------------------------------
+
+    def publish(
+        self,
+        msg: Msg,
+        from_sid: Optional[SubscriberId] = None,
+        reg_view: Optional[str] = None,
+    ) -> int:
+        """Retain handling + fold + enqueue; returns number of local matches
+        (used for the v5 no-matching-subscribers reason code).
+        vmq_reg:publish/4 (vmq_reg.erl:265-319)."""
+        cfg = self.broker.config
+        if not self.broker.cluster_ready() and not cfg.allow_publish_during_netsplit:
+            raise RuntimeError("not_ready")
+        if msg.retain:
+            if not msg.payload:
+                self.broker.retain.delete(msg.mountpoint, msg.topic)
+                msg = msg_with_retain(msg, False)
+            else:
+                self.broker.retain.insert(
+                    msg.mountpoint,
+                    msg.topic,
+                    RetainedMsg(
+                        msg.payload,
+                        dict(msg.properties),
+                        msg.qos,
+                        expiry_ts=_retain_expiry(msg),
+                    ),
+                )
+                self.broker.metrics.incr("retain_messages_stored")
+        view = self.reg_view(reg_view)
+        rows = view.fold(msg.mountpoint, msg.topic)
+        return self.route_rows(msg, rows, from_sid)
+
+    def route_rows(
+        self,
+        msg: Msg,
+        rows: Iterable[Tuple[Tuple[str, ...], Any, SubOpts]],
+        from_sid: Optional[SubscriberId],
+    ) -> int:
+        """The fold body (vmq_reg:publish/3 fold fun, vmq_reg.erl:326-353):
+        local rows enqueue, shared rows collect into groups, node rows
+        forward. Shared groups then go through policy selection."""
+        matches = 0
+        groups: Dict[str, List[Tuple[SubscriberId, SubOpts]]] = {}
+        for _filter, key, opts in rows:
+            if isinstance(key, tuple) and len(key) == 3 and key[0] == "$g":
+                _, group, sid = key
+                if opts.no_local and sid == from_sid:
+                    continue
+                groups.setdefault(group, []).append((sid, opts))
+                continue
+            if isinstance(key, str):  # remote node pointer
+                if self.remote_publish is not None:
+                    self.remote_publish(key, msg)
+                    self.broker.metrics.incr("router_matches_remote")
+                continue
+            sid = key
+            if opts.no_local and sid == from_sid:
+                continue
+            if self._enqueue_to(sid, msg, opts):
+                matches += 1
+        for group, members in groups.items():
+            if self._publish_shared(msg, members):
+                matches += 1
+        if matches:
+            self.broker.metrics.incr("router_matches_local", matches)
+        return matches
+
+    def _enqueue_to(self, sid: SubscriberId, msg: Msg, opts: SubOpts) -> bool:
+        queue = self.queues.get(sid)
+        if queue is None:
+            return False
+        out = msg if opts.rap else msg_with_retain(msg, False)
+        qos = opts.qos if self.broker.config.upgrade_outgoing_qos else min(opts.qos, msg.qos)
+        out = out.with_qos(qos)
+        out = _maybe_add_sub_id(out, opts)
+        queue.enqueue(out)
+        return True
+
+    def _publish_shared(
+        self, msg: Msg, members: List[Tuple[SubscriberId, SubOpts]]
+    ) -> bool:
+        """Pick one group member: randomized, online-first
+        (vmq_shared_subscriptions.erl:26-63). Policies prefer_local /
+        local_only / random coincide on a single node; the cluster layer
+        extends member lists with remote entries."""
+        shuffled = members[:]
+        random.shuffle(shuffled)
+        online = [
+            (sid, opts)
+            for sid, opts in shuffled
+            if (q := self.queues.get(sid)) is not None and q.state == ONLINE
+        ]
+        for sid, opts in online + shuffled:
+            if self._enqueue_to(sid, msg, opts):
+                return True
+        return False
+
+    # -- introspection -----------------------------------------------------
+
+    def stats(self) -> Dict[str, float]:
+        total = sum(len(t) for t in self._tries.values())
+        mem = sum(t.stats()["memory"] for t in self._tries.values())
+        return {
+            "router_subscriptions": total,
+            "router_memory": mem,
+            "queue_processes": len(self.queues),
+        }
+
+    def fold_subscriptions(self, mountpoint: str = ""):
+        """Iterate every (filter, key, opts) — warm-load feed for the TPU
+        table (mirrors vmq_reg:fold_subscriptions, vmq_reg_trie warm load)."""
+        return self.trie(mountpoint).entries()
+
+
+def msg_with_retain(msg: Msg, retain: bool) -> Msg:
+    if msg.retain == retain:
+        return msg
+    return dataclasses.replace(msg, retain=retain)
+
+
+def _maybe_add_sub_id(msg: Msg, opts: SubOpts) -> Msg:
+    sub_id = getattr(opts, "subscription_id", None)
+    if not sub_id:
+        return msg
+    props = dict(msg.properties)
+    props.setdefault("subscription_identifier", []).append(sub_id)
+    return dataclasses.replace(msg, properties=props)
+
+
+def _retain_expiry(msg: Msg) -> Optional[float]:
+    interval = msg.properties.get("message_expiry_interval")
+    if interval:
+        return time.time() + interval
+    return None

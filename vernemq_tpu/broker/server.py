@@ -1,0 +1,211 @@
+"""asyncio TCP listeners + per-connection socket loop.
+
+Mirrors the reference socket layer: one lightweight task per connection
+(``vmq_ranch.erl:41-43`` — one Erlang process per socket), buffered reparse
+of incoming bytes driving the session FSM (``vmq_ranch.erl:167-251``),
+write coalescing per event-loop tick (the MSS flush-threshold batching of
+``vmq_ranch.erl:253-262``), and protocol detection on the first CONNECT
+frame choosing the v4 or v5 FSM (``vmq_mqtt_pre_init.erl:58-70``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional, Tuple
+
+from ..protocol import codec_v4, codec_v5, wire
+from ..protocol.types import PROTO_5, Connect, ParseError
+from .broker import Broker
+from .session import Session, Transport
+
+log = logging.getLogger("vernemq_tpu.server")
+
+CONNECT_TIMEOUT = 10.0
+MAX_FRAME_SIZE = 268435455
+
+
+class StreamTransport(Transport):
+    """Write-coalescing wrapper over an asyncio StreamWriter: session writes
+    within one loop tick are flushed as a single TCP write."""
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self._writer = writer
+        self._buf = bytearray()
+        self._flush_scheduled = False
+        self.closed = False
+
+    def write(self, data: bytes) -> None:
+        if self.closed:
+            return
+        self._buf += data
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_event_loop().call_soon(self._flush)
+
+    def _flush(self) -> None:
+        self._flush_scheduled = False
+        if self.closed or not self._buf:
+            return
+        try:
+            self._writer.write(bytes(self._buf))
+        except Exception:
+            self.closed = True
+        self._buf.clear()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self._flush()
+        self.closed = True
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+
+
+def sniff_proto_ver(body: bytes) -> int:
+    """Read the protocol level out of a CONNECT body without committing to a
+    codec (vmq_mqtt_pre_init.erl:44-70)."""
+    name, pos = wire.take_utf8(body, 0)
+    if pos >= len(body):
+        raise ParseError("malformed_connect")
+    return body[pos] & 0x7F
+
+
+class MQTTServer:
+    def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 1883,
+                 max_frame_size: int = 0):
+        self.broker = broker
+        self.host = host
+        self.port = port
+        self.max_frame_size = max_frame_size or MAX_FRAME_SIZE
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        if self.port == 0:
+            self.port = self._server.sockets[0].getsockname()[1]
+        self.broker._servers.append(self._server)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        metrics = self.broker.metrics
+        metrics.incr("socket_open")
+        peer = writer.get_extra_info("peername") or ("", 0)
+        transport = StreamTransport(writer)
+        session: Optional[Session] = None
+        buf = b""
+        try:
+            # ---- pre-init: wait for CONNECT, pick protocol ----------------
+            first = None
+            async with asyncio.timeout(CONNECT_TIMEOUT):
+                while first is None:
+                    chunk = await reader.read(65536)
+                    if not chunk:
+                        return
+                    metrics.incr("bytes_received", len(chunk))
+                    buf += chunk
+                    first = wire.split_frame(buf, self.max_frame_size)
+            ptype, flags, body, rest = first
+            if ptype != 1:  # must be CONNECT
+                return
+            proto_ver = sniff_proto_ver(body)
+            if proto_ver == PROTO_5:
+                codec = codec_v5
+            elif proto_ver in (3, 4):
+                codec = codec_v4
+            else:
+                # unknown protocol level: v4-style CONNACK rc=1
+                transport.write(b"\x20\x02\x00\x01")
+                return
+            connect_frame = codec._parse_body(ptype, flags, body)
+            session = Session(self.broker, transport, proto_ver, peer=peer)
+            ok = await session.handle_connect(connect_frame)
+            if not ok and not session._pending_connect:
+                return
+
+            # ---- steady-state frame loop ---------------------------------
+            buf = bytes(rest)
+            while not session.closed:
+                view = memoryview(buf)
+                while True:
+                    frame, view = codec.parse(view, self.max_frame_size)
+                    if frame is None:
+                        break
+                    await session.handle_frame(frame)
+                    if session.closed:
+                        break
+                buf = bytes(view)
+                if session.closed:
+                    break
+                if session.connected:
+                    chunk = await reader.read(65536)
+                else:
+                    # still inside the CONNECT/enhanced-AUTH exchange: keep
+                    # the pre-init deadline so parked half-auth connections
+                    # can't pin sockets forever
+                    chunk = await asyncio.wait_for(reader.read(65536), CONNECT_TIMEOUT)
+                if not chunk:
+                    break
+                metrics.incr("bytes_received", len(chunk))
+                buf += chunk
+        except (asyncio.TimeoutError, TimeoutError):
+            pass
+        except ParseError as e:
+            log.debug("parse error from %s: %s", peer, e.reason)
+            metrics.incr("socket_error")
+        except ConnectionError:
+            metrics.incr("socket_error")
+        except Exception:
+            log.exception("connection handler crashed")
+            metrics.incr("socket_error")
+        finally:
+            if session is not None and not session.closed:
+                await session.close("connection_lost")
+            transport.close()
+            try:
+                await writer.wait_closed()
+            except Exception:
+                pass
+            metrics.incr("socket_close")
+
+
+async def start_broker(
+    config=None, host: str = "127.0.0.1", port: int = 1883
+) -> Tuple[Broker, MQTTServer]:
+    """Boot a broker with one MQTT listener (vmq_test_utils:setup-style
+    convenience; port=0 picks a random free port)."""
+    broker = Broker(config)
+    await broker.start()
+    server = MQTTServer(broker, host, port)
+    await server.start()
+    return broker, server
+
+
+def main() -> None:  # pragma: no cover
+    import argparse
+
+    parser = argparse.ArgumentParser(description="vernemq_tpu broker")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=1883)
+    args = parser.parse_args()
+
+    async def _run():
+        broker, server = await start_broker(host=args.host, port=args.port)
+        print(f"vernemq_tpu broker listening on {args.host}:{server.port}")
+        await asyncio.Event().wait()
+
+    asyncio.run(_run())
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

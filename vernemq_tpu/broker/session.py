@@ -1,0 +1,830 @@
+"""MQTT session FSM — one implementation parameterized by protocol level.
+
+Mirrors the reference session FSMs (``vmq_mqtt_fsm.erl`` for 3.1/3.1.1,
+``vmq_mqtt5_fsm.erl`` for 5.0). Like the reference, the FSM has no process
+of its own — it runs inside the connection's socket loop (here: the asyncio
+connection task), with queue deliveries arriving as callbacks:
+
+- CONNECT pipeline ``check_connect → check_client_id → check_user →
+  check_will`` (vmq_mqtt_fsm.erl:487-604), auth via the
+  ``auth_on_register(_m5)`` all_till_ok chain with modifier support;
+- PUBLISH dispatch by QoS (vmq_mqtt_fsm.erl:748-866): QoS1 route+PUBACK,
+  QoS2 route-on-first-PUBLISH, PUBREC, dedup until PUBREL, PUBCOMP;
+- outgoing QoS1/2 tracked in ``waiting_acks`` with retry w/ DUP
+  (vmq_mqtt_fsm.erl:294-355,1077-1101) and a ``max_inflight_messages``
+  window (vmq_mqtt_fsm.erl:65);
+- keepalive enforcement at 1.5× (vmq_mqtt_fsm.erl:422-432);
+- session takeover (dup CONNECT) disconnects the old session, v5 with
+  reason 0x8E;
+- MQTT5: topic aliases both directions (vmq_mqtt5_fsm.erl:90-93), flow
+  control receive-maximum (:97-100), session/message expiry (:69),
+  enhanced AUTH via the on_auth_m5 hook (:78,330-353), will delay.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import TYPE_CHECKING, Any, Dict, List, Optional, Tuple
+
+from ..protocol import codec_v4, codec_v5
+from ..protocol import topic as T
+from ..protocol.types import (
+    PROTO_5,
+    RC_GRANTED_QOS0,
+    RC_NOT_AUTHORIZED,
+    RC_NO_MATCHING_SUBSCRIBERS,
+    RC_NO_SUBSCRIPTION_EXISTED,
+    RC_PACKET_ID_NOT_FOUND,
+    RC_SESSION_TAKEN_OVER,
+    RC_SUCCESS,
+    RC_TOPIC_ALIAS_INVALID,
+    RC_UNSPECIFIED_ERROR,
+    Auth,
+    Connack,
+    Connect,
+    Disconnect,
+    Frame,
+    ParseError,
+    Pingreq,
+    Pingresp,
+    Puback,
+    Pubcomp,
+    Publish,
+    Pubrec,
+    Pubrel,
+    SubOpts,
+    Suback,
+    Subscribe,
+    Unsuback,
+    Unsubscribe,
+    Will,
+)
+from .message import Msg, SubscriberId
+from .plugins import HookError
+from .queue import QueueOpts
+
+if TYPE_CHECKING:
+    from .broker import Broker
+
+CONNACK_V4_FROM_RC = {
+    # map v5-style internal reasons onto v4 return codes
+    RC_UNSPECIFIED_ERROR: 3,
+    RC_NOT_AUTHORIZED: 5,
+}
+
+
+class SessionError(Exception):
+    pass
+
+
+class Session:
+    """One per live client connection."""
+
+    def __init__(self, broker: "Broker", transport: "Transport", proto_ver: int,
+                 peer: Tuple[str, int] = ("", 0), mountpoint: str = ""):
+        self.broker = broker
+        self.transport = transport
+        self.proto_ver = proto_ver
+        self.codec = codec_v5 if proto_ver == PROTO_5 else codec_v4
+        self.peer = peer
+        self.mountpoint = mountpoint
+        self.client_id: str = ""
+        self.sid: Optional[SubscriberId] = None
+        self.username: Optional[str] = None
+        self.connected = False
+        self.clean_start = True
+        self.keepalive = 0
+        self.will: Optional[Will] = None
+        self.queue = None
+        # outgoing qos1/2: pid -> [kind, msg, ts, dup_sent]; kind: 'puback'|'pubrec'|'pubcomp'
+        self.waiting_acks: Dict[int, List[Any]] = {}
+        self.pending: List[Msg] = []  # deliveries waiting for an inflight slot
+        self._next_pid = 0
+        self.awaiting_rel: Dict[int, float] = {}  # incoming qos2 pids
+        self.last_activity = time.monotonic()
+        self._tasks: List[asyncio.Task] = []
+        self.closed = False
+        self.close_reason = "normal"
+        # v5 state
+        self.session_expiry = 0
+        self.topic_alias_in: Dict[int, Tuple[str, ...]] = {}
+        self.topic_alias_out: Dict[Tuple[str, ...], int] = {}
+        self.topic_alias_max_out = 0  # client's limit for broker→client aliases
+        self.receive_max_out = 65535  # client's receive maximum (broker→client inflight cap)
+        self.request_problem_info = True
+        self.auth_method: Optional[str] = None
+        self._in_enhanced_auth = False
+        self._pending_connect: Optional[Connect] = None
+
+    # ------------------------------------------------------------------ IO
+
+    def send(self, frame: Frame) -> None:
+        if self.closed:
+            return
+        data = self.codec.serialise(frame)
+        self.transport.write(data)
+        self.broker.metrics.incr("bytes_sent", len(data))
+
+    def _metric_in(self, frame: Frame) -> None:
+        m = _IN_METRIC.get(type(frame))
+        if m:
+            self.broker.metrics.incr(m)
+
+    # ---------------------------------------------------------- CONNECT
+
+    async def handle_connect(self, f: Connect) -> bool:
+        """CONNECT pipeline; returns True if session established."""
+        self.broker.metrics.incr("mqtt_connect_received")
+        cfg = self.broker.config
+        self.keepalive = f.keepalive
+        self.clean_start = f.clean_start
+        self.will = f.will
+        self.username = f.username
+
+        # check_client_id (vmq_mqtt_fsm.erl:514-560)
+        client_id = f.client_id
+        if not client_id:
+            if not f.clean_start and self.proto_ver != PROTO_5:
+                await self._connack_fail(2, RC_CLIENT_ID_NOT_VALID)
+                return False
+            client_id = f"auto-{id(self):x}-{int(time.time() * 1000) & 0xFFFFFF:x}"
+            self._assigned_client_id = client_id
+        else:
+            self._assigned_client_id = None
+        if len(client_id) > cfg.max_client_id_size:
+            await self._connack_fail(2, RC_CLIENT_ID_NOT_VALID)
+            return False
+        self.client_id = client_id
+        self.sid = (self.mountpoint, client_id)
+
+        if self.proto_ver == PROTO_5:
+            self.session_expiry = f.properties.get("session_expiry_interval", 0)
+            cap = cfg.max_session_expiry_interval
+            if cap and self.session_expiry > cap:
+                self.session_expiry = cap
+            self.topic_alias_max_out = f.properties.get("topic_alias_maximum", 0)
+            if cfg.topic_alias_max_broker:
+                self.topic_alias_max_out = min(self.topic_alias_max_out,
+                                               cfg.topic_alias_max_broker)
+            self.receive_max_out = f.properties.get("receive_maximum", 65535)
+            self.request_problem_info = bool(f.properties.get("request_problem_information", 1))
+            self.auth_method = f.properties.get("authentication_method")
+
+        # enhanced auth (MQTT5 AUTH exchange, vmq_mqtt5_fsm.erl:330-353)
+        if self.auth_method is not None and self.broker.hooks.has("on_auth_m5"):
+            self._pending_connect = f
+            res = await self._run_enhanced_auth(f.properties.get("authentication_data"))
+            if res == "continue":
+                return True  # wait for client AUTH frames
+            if res != "ok":
+                return False
+            # fallthrough: auth completed in one round
+
+        return await self._finish_connect(f)
+
+    async def _finish_connect(self, f: Connect) -> bool:
+        cfg = self.broker.config
+        # check_user → auth_on_register chain (vmq_mqtt_fsm.erl:606-650)
+        hook = "auth_on_register_m5" if self.proto_ver == PROTO_5 else "auth_on_register"
+        modifiers: Dict[str, Any] = {}
+        try:
+            res = await self.broker.hooks.all_till_ok(
+                hook, self.peer, self.sid, f.username, f.password, f.clean_start
+            )
+            if isinstance(res, tuple):
+                modifiers = res[1]
+        except HookError as e:
+            if e.reason == "no_matching_hook_found":
+                if not cfg.allow_anonymous:
+                    await self._connack_fail(5, RC_NOT_AUTHORIZED)
+                    return False
+            else:
+                self.broker.metrics.incr("mqtt_connect_error")
+                rc = 4 if e.reason == "invalid_credentials" else 5
+                await self._connack_fail(rc, RC_NOT_AUTHORIZED)
+                return False
+        # apply modifiers (per-session overrides, vmq_mqtt_fsm.erl:606-650)
+        if "mountpoint" in modifiers:
+            self.mountpoint = modifiers["mountpoint"]
+            self.sid = (self.mountpoint, self.client_id)
+        if "clean_session" in modifiers:
+            self.clean_start = modifiers["clean_session"]
+
+        # check_will (vmq_mqtt_fsm.erl:581-604)
+        if self.will is not None:
+            try:
+                wt = T.validate_topic("publish", self.will.topic)
+                self.will_topic_words = tuple(wt)
+            except T.TopicError:
+                await self._connack_fail(2, RC_TOPIC_NAME_INVALID)
+                return False
+            try:
+                await self.broker.auth_publish(
+                    self.sid, self.username, self.will_topic_words,
+                    self.will.payload, self.will.qos, self.will.retain,
+                    self.proto_ver,
+                )
+            except HookError:
+                await self._connack_fail(5, RC_NOT_AUTHORIZED)
+                return False
+
+        # session takeover (vmq_mqtt_fsm check_client_id dup connect)
+        await self.broker.takeover(self.sid, self)
+        self.broker.cancel_delayed_will(self.sid)
+
+        # register queue
+        persistent = (
+            (self.proto_ver == PROTO_5 and self.session_expiry > 0)
+            or (self.proto_ver != PROTO_5 and not self.clean_start)
+        )
+        qopts = QueueOpts(
+            clean_session=not persistent,
+            max_offline_messages=cfg.max_offline_messages,
+            max_online_messages=cfg.max_online_messages,
+            deliver_mode=cfg.queue_deliver_mode,
+            queue_type=cfg.queue_type,
+            session_expiry=self.session_expiry,
+        )
+        self.queue, session_present = self.broker.registry.register_subscriber(
+            self.sid, self.clean_start, qopts
+        )
+        self.connected = True
+        self.broker.sessions[self.sid] = self
+
+        # CONNACK
+        props: Dict[str, Any] = {}
+        if self.proto_ver == PROTO_5:
+            if self._assigned_client_id:
+                props["assigned_client_identifier"] = self._assigned_client_id
+            if cfg.receive_max_broker:
+                props["receive_maximum"] = cfg.receive_max_broker
+            if cfg.topic_alias_max_client:
+                props["topic_alias_maximum"] = cfg.topic_alias_max_client
+            if cfg.max_session_expiry_interval and self.session_expiry != \
+                    (self._pending_connect or f).properties.get("session_expiry_interval", 0):
+                props["session_expiry_interval"] = self.session_expiry
+        self.send(Connack(session_present=session_present, rc=0, properties=props))
+        self.broker.metrics.incr("mqtt_connack_sent")
+        # attach AFTER the CONNACK so offline-backlog flush serialises behind
+        # it on the wire (the reference's queue wakeup happens post-CONNACK)
+        self.queue.add_session(self, self._queue_deliver)
+        self.broker.hooks_fire_all(
+            "on_register", self.peer, self.sid, self.username
+        )
+        self._start_timers()
+        return True
+
+    async def _run_enhanced_auth(self, data: Optional[bytes]) -> str:
+        """on_auth_m5 hook round (vmq_mqtt5_fsm enhanced auth)."""
+        try:
+            res = await self.broker.hooks.all_till_ok(
+                "on_auth_m5", self.sid, self.auth_method, data
+            )
+        except HookError:
+            self.broker.metrics.incr("mqtt_connect_error")
+            if self.connected:
+                # re-auth on an established session: DISCONNECT, never a
+                # second CONNACK (MQTT5 4.12.1)
+                self.send(Disconnect(reason_code=0x8C))
+                self.broker.metrics.incr("mqtt_disconnect_sent")
+            else:
+                self.send(Connack(session_present=False, rc=0x8C))
+            await self.close("bad_authentication_method")
+            return "error"
+        if isinstance(res, tuple):
+            mods = res[1]
+            out_data = mods.get("authentication_data")
+            if mods.get("continue_auth"):
+                self._in_enhanced_auth = True
+                self.send(Auth(reason_code=0x18, properties={
+                    "authentication_method": self.auth_method,
+                    **({"authentication_data": out_data} if out_data else {}),
+                }))
+                self.broker.metrics.incr("mqtt_auth_sent")
+                return "continue"
+            self._auth_success_data = out_data
+        return "ok"
+
+    async def _connack_fail(self, v4_rc: int, v5_rc: int) -> None:
+        self.broker.metrics.incr("mqtt_connect_error")
+        rc = v5_rc if self.proto_ver == PROTO_5 else v4_rc
+        self.send(Connack(session_present=False, rc=rc))
+        self.broker.metrics.incr("mqtt_connack_sent")
+        await self.close("connack_fail", send_will=False)
+
+    # ------------------------------------------------------- frame dispatch
+
+    async def handle_frame(self, frame: Frame) -> None:
+        self.last_activity = time.monotonic()
+        self._metric_in(frame)
+        t = type(frame)
+        if t is Publish:
+            await self._handle_publish(frame)
+        elif t is Puback:
+            self._handle_puback(frame)
+        elif t is Pubrec:
+            self._handle_pubrec(frame)
+        elif t is Pubrel:
+            self._handle_pubrel(frame)
+        elif t is Pubcomp:
+            self._handle_pubcomp(frame)
+        elif t is Subscribe:
+            await self._handle_subscribe(frame)
+        elif t is Unsubscribe:
+            await self._handle_unsubscribe(frame)
+        elif t is Pingreq:
+            self.send(Pingresp())
+            self.broker.metrics.incr("mqtt_pingresp_sent")
+        elif t is Disconnect:
+            # v5 rc 0x04 = disconnect with will
+            send_will = self.proto_ver == PROTO_5 and frame.reason_code == 0x04
+            if self.proto_ver == PROTO_5:
+                sei = frame.properties.get("session_expiry_interval")
+                if sei is not None:
+                    cap = self.broker.config.max_session_expiry_interval
+                    if cap and sei > cap:
+                        sei = cap
+                    self.session_expiry = sei
+                    if self.queue is not None:
+                        self.queue.opts.session_expiry = sei
+                        # sei == 0 ends the session when the network
+                        # connection closes (MQTT5 3.14.2.2.2)
+                        self.queue.opts.clean_session = sei == 0
+            await self.close("client_disconnect", send_will=send_will)
+        elif t is Auth:
+            await self._handle_auth(frame)
+        elif t is Connect:
+            await self.close("protocol_violation_dup_connect")
+        else:
+            await self.close("unexpected_frame")
+
+    # ---------------------------------------------------------- PUBLISH in
+
+    async def _handle_publish(self, f: Publish) -> None:
+        cfg = self.broker.config
+        if cfg.max_message_size and len(f.payload) > cfg.max_message_size:
+            self.broker.metrics.incr("mqtt_invalid_msg_size_error")
+            await self.close("message_too_large")
+            return
+        if not self.broker.metrics.check_rate(self.sid, cfg.max_message_rate):
+            await self.close("message_rate_exceeded")
+            return
+        # v5 topic alias resolution (vmq_mqtt5_fsm.erl:90-93)
+        topic_str = f.topic
+        words: Optional[Tuple[str, ...]] = None
+        if self.proto_ver == PROTO_5:
+            alias = f.properties.get("topic_alias")
+            if alias is not None:
+                if alias == 0 or (cfg.topic_alias_max_client and
+                                  alias > cfg.topic_alias_max_client):
+                    await self._disconnect_v5(RC_TOPIC_ALIAS_INVALID)
+                    return
+                if topic_str:
+                    try:
+                        words = tuple(T.validate_topic("publish", topic_str))
+                    except T.TopicError:
+                        await self._pub_nack(f, RC_TOPIC_NAME_INVALID)
+                        return
+                    self.topic_alias_in[alias] = words
+                else:
+                    words = self.topic_alias_in.get(alias)
+                    if words is None:
+                        await self._disconnect_v5(RC_TOPIC_ALIAS_INVALID)
+                        return
+        if words is None:
+            try:
+                words = tuple(T.validate_topic("publish", topic_str))
+            except T.TopicError:
+                self.broker.metrics.incr("mqtt_publish_error")
+                if self.proto_ver == PROTO_5 and f.qos > 0:
+                    await self._pub_nack(f, RC_TOPIC_NAME_INVALID)
+                else:
+                    await self.close("invalid_topic")
+                return
+
+        # auth_on_publish chain; modifiers may rewrite topic/payload/qos
+        try:
+            mods = await self.broker.auth_publish(
+                self.sid, self.username, words, f.payload, f.qos, f.retain,
+                self.proto_ver, f.properties,
+            )
+        except HookError:
+            self.broker.metrics.incr("mqtt_publish_auth_error")
+            if self.proto_ver == PROTO_5 and f.qos > 0:
+                await self._pub_nack(f, RC_NOT_AUTHORIZED)
+            elif self.proto_ver == PROTO_5:
+                await self._disconnect_v5(RC_NOT_AUTHORIZED)
+            else:
+                # v4 has no nack: drop (QoS1 acked to avoid retry storms,
+                # mirroring the reference's behaviour of acking then dropping)
+                if f.qos == 1 and f.packet_id:
+                    self.send(Puback(packet_id=f.packet_id))
+                elif f.qos == 2 and f.packet_id:
+                    self.send(Pubrec(packet_id=f.packet_id))
+                    self.awaiting_rel[f.packet_id] = time.monotonic()
+            return
+        payload = f.payload
+        if mods:
+            if "topic" in mods:
+                words = tuple(mods["topic"])
+            if "payload" in mods:
+                payload = mods["payload"]
+            if "retain" in mods:
+                f.retain = mods["retain"]
+
+        props = {
+            k: v for k, v in f.properties.items()
+            if k in ("payload_format_indicator", "message_expiry_interval",
+                     "content_type", "response_topic", "correlation_data",
+                     "user_property")
+        }
+        msg = Msg(
+            topic=words, payload=payload, qos=f.qos, retain=f.retain,
+            mountpoint=self.mountpoint, properties=props,
+        )
+        expiry = props.get("message_expiry_interval")
+        if expiry:
+            msg.expires_at = time.monotonic() + expiry
+
+        if f.qos == 0:
+            self._route(msg)
+        elif f.qos == 1:
+            matches = self._route(msg)
+            rc = RC_SUCCESS if matches else RC_NO_MATCHING_SUBSCRIBERS
+            ack = Puback(packet_id=f.packet_id)
+            if self.proto_ver == PROTO_5 and rc:
+                ack.reason_code = rc
+            self.send(ack)
+            self.broker.metrics.incr("mqtt_puback_sent")
+        else:  # qos 2: route on first arrival, dedup until PUBREL
+            if f.packet_id not in self.awaiting_rel:
+                self._route(msg)
+                self.awaiting_rel[f.packet_id] = time.monotonic()
+            self.send(Pubrec(packet_id=f.packet_id))
+            self.broker.metrics.incr("mqtt_pubrec_sent")
+
+    def _route(self, msg: Msg) -> int:
+        try:
+            n = self.broker.registry.publish(msg, from_sid=self.sid)
+        except RuntimeError:
+            self.broker.metrics.incr("mqtt_publish_error")
+            return 0
+        self.broker.hooks_fire_all(
+            "on_publish", self.username, self.sid, msg.qos, msg.topic,
+            msg.payload, msg.retain,
+        )
+        return n
+
+    async def _pub_nack(self, f: Publish, rc: int) -> None:
+        if f.qos == 1:
+            self.send(Puback(packet_id=f.packet_id, reason_code=rc))
+        elif f.qos == 2:
+            self.send(Pubrec(packet_id=f.packet_id, reason_code=rc))
+
+    def _handle_pubrel(self, f: Pubrel) -> None:
+        existed = self.awaiting_rel.pop(f.packet_id, None)
+        comp = Pubcomp(packet_id=f.packet_id)
+        if existed is None and self.proto_ver == PROTO_5:
+            comp.reason_code = RC_PACKET_ID_NOT_FOUND
+        self.send(comp)
+        self.broker.metrics.incr("mqtt_pubcomp_sent")
+
+    # --------------------------------------------------------- PUBLISH out
+
+    def _queue_deliver(self, msg: Msg) -> bool:
+        """Called by the SubscriberQueue to hand a message to this session.
+        Returns False when the session can't take it (caller drops/offlines)."""
+        if self.closed:
+            return False
+        if msg.expires_at is not None and msg.expires_at < time.monotonic():
+            self.broker.metrics.incr("queue_message_expired")
+            return True  # consumed (expired), not a drop by us
+        if msg.qos == 0:
+            self._send_publish(msg, None)
+            return True
+        window = min(self.broker.config.max_inflight_messages, self.receive_max_out)
+        if len(self.waiting_acks) < window:
+            pid = self._next_packet_id()
+            self.waiting_acks[pid] = ["puback" if msg.qos == 1 else "pubrec",
+                                      msg, time.monotonic(), False]
+            self._send_publish(msg, pid)
+        else:
+            if len(self.pending) >= self.broker.config.max_online_messages:
+                return False
+            self.pending.append(msg)
+        return True
+
+    def _send_publish(self, msg: Msg, pid: Optional[int], dup: bool = False) -> None:
+        props = dict(msg.properties)
+        topic_str = T.unword(list(msg.topic))
+        if self.proto_ver == PROTO_5:
+            # remaining message expiry (MQTT5 3.3.2.3.3)
+            if msg.expires_at is not None:
+                remaining = max(0, int(msg.expires_at - time.monotonic()))
+                props["message_expiry_interval"] = remaining
+            # outbound topic alias (vmq_mqtt5_fsm.erl topic_aliases out)
+            if self.topic_alias_max_out:
+                alias = self.topic_alias_out.get(msg.topic)
+                if alias is not None:
+                    topic_str = ""
+                    props["topic_alias"] = alias
+                elif len(self.topic_alias_out) < self.topic_alias_max_out:
+                    alias = len(self.topic_alias_out) + 1
+                    self.topic_alias_out[msg.topic] = alias
+                    props["topic_alias"] = alias
+        else:
+            props = {}
+        frame = Publish(
+            topic=topic_str, payload=msg.payload, qos=msg.qos,
+            retain=msg.retain, dup=dup, packet_id=pid, properties=props,
+        )
+        self.broker.hooks_fire_all(
+            "on_deliver", self.username, self.sid, msg.topic, msg.payload
+        )
+        self.send(frame)
+        self.broker.metrics.incr("mqtt_publish_sent")
+
+    def _next_packet_id(self) -> int:
+        for _ in range(65535):
+            self._next_pid = (self._next_pid % 65535) + 1
+            if self._next_pid not in self.waiting_acks:
+                return self._next_pid
+        raise SessionError("no_free_packet_id")
+
+    def _pump_pending(self) -> None:
+        window = min(self.broker.config.max_inflight_messages, self.receive_max_out)
+        while self.pending and len(self.waiting_acks) < window:
+            msg = self.pending.pop(0)
+            if msg.expires_at is not None and msg.expires_at < time.monotonic():
+                self.broker.metrics.incr("queue_message_expired")
+                continue
+            pid = self._next_packet_id()
+            self.waiting_acks[pid] = ["puback" if msg.qos == 1 else "pubrec",
+                                      msg, time.monotonic(), False]
+            self._send_publish(msg, pid)
+
+    def _handle_puback(self, f: Puback) -> None:
+        entry = self.waiting_acks.get(f.packet_id)
+        if entry and entry[0] == "puback":
+            del self.waiting_acks[f.packet_id]
+            self._pump_pending()
+
+    def _handle_pubrec(self, f: Pubrec) -> None:
+        entry = self.waiting_acks.get(f.packet_id)
+        if entry and entry[0] == "pubrec":
+            if self.proto_ver == PROTO_5 and f.reason_code >= 0x80:
+                del self.waiting_acks[f.packet_id]
+                self._pump_pending()
+                return
+            entry[0] = "pubcomp"
+            entry[2] = time.monotonic()
+            self.send(Pubrel(packet_id=f.packet_id))
+            self.broker.metrics.incr("mqtt_pubrel_sent")
+
+    def _handle_pubcomp(self, f: Pubcomp) -> None:
+        entry = self.waiting_acks.get(f.packet_id)
+        if entry and entry[0] == "pubcomp":
+            del self.waiting_acks[f.packet_id]
+            self._pump_pending()
+
+    # ----------------------------------------------------------- SUBSCRIBE
+
+    async def _handle_subscribe(self, f: Subscribe) -> None:
+        cfg = self.broker.config
+        sub_id = None
+        if self.proto_ver == PROTO_5:
+            ids = f.properties.get("subscription_identifier")
+            if ids:
+                sub_id = ids[0]
+        topics: List[Tuple[List[str], SubOpts]] = []
+        codes: List[int] = []
+        for topic_str, opts in f.topics:
+            try:
+                words = T.validate_topic("subscribe", topic_str)
+            except T.TopicError:
+                codes.append(0x8F if self.proto_ver == PROTO_5 else 0x80)
+                topics.append(None)
+                continue
+            topics.append((words, opts))
+            codes.append(opts.qos)
+        # auth chain (may rewrite topics/qos)
+        hook = "auth_on_subscribe_m5" if self.proto_ver == PROTO_5 else "auth_on_subscribe"
+        try:
+            res = await self.broker.hooks.all_till_ok(
+                hook, self.username, self.sid,
+                [(t[0], t[1].qos) for t in topics if t],
+            )
+            if isinstance(res, tuple):
+                # modifiers: list of (topic_words, qos) or qos 128 to deny
+                mod_list = res[1]
+                new_topics, new_codes, i = [], [], 0
+                for t in topics:
+                    if t is None:
+                        new_topics.append(None)
+                        new_codes.append(0x8F if self.proto_ver == PROTO_5 else 0x80)
+                        continue
+                    words, qos = mod_list[i]
+                    i += 1
+                    if qos == 128 or qos == 0x80:
+                        new_topics.append(None)
+                        new_codes.append(0x80 if self.proto_ver != PROTO_5 else 0x87)
+                    else:
+                        opts = t[1]
+                        opts.qos = qos
+                        new_topics.append((list(words), opts))
+                        new_codes.append(qos)
+                topics, codes = new_topics, new_codes
+        except HookError as e:
+            if e.reason != "no_matching_hook_found":
+                self.broker.metrics.incr("mqtt_subscribe_auth_error")
+                fail = 0x80 if self.proto_ver != PROTO_5 else 0x87
+                self.send(Suback(packet_id=f.packet_id,
+                                 reason_codes=[fail] * len(f.topics)))
+                self.broker.metrics.incr("mqtt_suback_sent")
+                return
+        # SUBACK first so retained replay serialises behind it on the wire
+        self.send(Suback(packet_id=f.packet_id, reason_codes=codes))
+        self.broker.metrics.incr("mqtt_suback_sent")
+        good = [t for t in topics if t is not None]
+        if good:
+            for words, opts in good:
+                if sub_id:
+                    opts.subscription_id = sub_id
+            self.broker.registry.subscribe(self.sid, good)
+            self.broker.hooks_fire_all(
+                "on_subscribe", self.username, self.sid,
+                [(w, o.qos) for w, o in good],
+            )
+
+    async def _handle_unsubscribe(self, f: Unsubscribe) -> None:
+        topics = []
+        for topic_str in f.topics:
+            try:
+                topics.append(T.validate_topic("subscribe", topic_str))
+            except T.TopicError:
+                topics.append(None)
+        try:
+            res = await self.broker.hooks.all_till_ok(
+                "on_unsubscribe", self.username, self.sid,
+                [t for t in topics if t],
+            )
+            if isinstance(res, tuple):
+                topics = [list(t) for t in res[1]]
+        except HookError:
+            pass
+        valid = [t for t in topics if t is not None]
+        results = self.broker.registry.unsubscribe(self.sid, valid)
+        codes: List[int] = []
+        ri = iter(results)
+        for t in topics:
+            if t is None:
+                codes.append(0x8F)
+            else:
+                codes.append(RC_SUCCESS if next(ri) else RC_NO_SUBSCRIPTION_EXISTED)
+        self.send(Unsuback(packet_id=f.packet_id, reason_codes=codes))
+        self.broker.metrics.incr("mqtt_unsuback_sent")
+
+    # ---------------------------------------------------------------- AUTH
+
+    async def _handle_auth(self, f: Auth) -> None:
+        if self.proto_ver != PROTO_5:
+            await self.close("protocol_violation")
+            return
+        method = f.properties.get("authentication_method")
+        if method != self.auth_method:
+            await self._disconnect_v5(0x8C)
+            return
+        res = await self._run_enhanced_auth(f.properties.get("authentication_data"))
+        if res == "ok":
+            if self._pending_connect is not None:
+                pc, self._pending_connect = self._pending_connect, None
+                await self._finish_connect(pc)
+            else:
+                # re-auth complete
+                self.send(Auth(reason_code=0, properties={
+                    "authentication_method": self.auth_method}))
+                self.broker.metrics.incr("mqtt_auth_sent")
+
+    # -------------------------------------------------------------- timers
+
+    def _start_timers(self) -> None:
+        loop = asyncio.get_event_loop()
+        if self.keepalive:
+            self._tasks.append(loop.create_task(self._keepalive_loop()))
+        self._tasks.append(loop.create_task(self._retry_loop()))
+
+    async def _keepalive_loop(self) -> None:
+        # close if silent for 1.5× keepalive (vmq_mqtt_fsm.erl:422-432)
+        limit = self.keepalive * 1.5
+        while not self.closed:
+            await asyncio.sleep(max(0.05, limit / 4))
+            if time.monotonic() - self.last_activity > limit:
+                await self.close("keepalive_expired")
+                return
+
+    async def _retry_loop(self) -> None:
+        interval = self.broker.config.retry_interval
+        while not self.closed:
+            await asyncio.sleep(interval)
+            now = time.monotonic()
+            for pid, entry in list(self.waiting_acks.items()):
+                kind, msg, ts, _resent = entry
+                if now - ts < interval:
+                    continue
+                entry[2] = now
+                entry[3] = True
+                if kind in ("puback", "pubrec"):
+                    self._send_publish(msg, pid, dup=True)
+                else:  # pubcomp: retransmit PUBREL
+                    self.send(Pubrel(packet_id=pid))
+
+    # --------------------------------------------------------------- close
+
+    async def close(self, reason: str, send_will: Optional[bool] = None) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        self.close_reason = reason
+        for t in self._tasks:
+            t.cancel()
+        if send_will is None:
+            send_will = reason not in ("client_disconnect", "connack_fail")
+        if send_will and self.will is not None and self.connected:
+            self.broker.schedule_will(self.sid, self.will, self.mountpoint,
+                                      self.proto_ver, self.session_expiry)
+        if self.connected and self.sid is not None:
+            if self.broker.sessions.get(self.sid) is self:
+                del self.broker.sessions[self.sid]
+            if self.queue is not None:
+                # persistent session keeps undelivered inflight/pending msgs:
+                # move them back to the queue as offline backlog
+                if not self.queue.opts.clean_session:
+                    for pid, (kind, msg, _, _) in sorted(self.waiting_acks.items()):
+                        if kind in ("puback", "pubrec"):
+                            self.queue.offline.append(msg)
+                    for msg in self.pending:
+                        if msg.qos > 0:
+                            self.queue.offline.append(msg)
+                self.waiting_acks.clear()
+                self.pending.clear()
+                self.queue.del_session(self)
+        self.broker.metrics.drop_rate_state(self.sid)
+        self.transport.close()
+
+    async def takeover_close(self) -> None:
+        """Kicked by a newer session with the same client id."""
+        if self.proto_ver == PROTO_5:
+            self.send(Disconnect(reason_code=RC_SESSION_TAKEN_OVER))
+            self.broker.metrics.incr("mqtt_disconnect_sent")
+        suppress = self.broker.config.suppress_lwt_on_session_takeover
+        await self.close("session_taken_over", send_will=not suppress)
+
+    async def _disconnect_v5(self, rc: int) -> None:
+        if self.proto_ver == PROTO_5:
+            self.send(Disconnect(reason_code=rc))
+            self.broker.metrics.incr("mqtt_disconnect_sent")
+        await self.close(f"disconnect_rc_{rc:#x}")
+
+    def info(self) -> Dict[str, Any]:
+        return {
+            "client_id": self.client_id,
+            "mountpoint": self.mountpoint,
+            "user": self.username,
+            "peer_host": self.peer[0],
+            "peer_port": self.peer[1],
+            "protocol": self.proto_ver,
+            "waiting_acks": len(self.waiting_acks),
+            "pending": len(self.pending),
+            "clean_session": self.clean_start,
+            "keepalive": self.keepalive,
+        }
+
+
+RC_CLIENT_ID_NOT_VALID = 0x85
+RC_TOPIC_NAME_INVALID = 0x90
+
+_IN_METRIC = {
+    Publish: "mqtt_publish_received",
+    Puback: "mqtt_puback_received",
+    Pubrec: "mqtt_pubrec_received",
+    Pubrel: "mqtt_pubrel_received",
+    Pubcomp: "mqtt_pubcomp_received",
+    Subscribe: "mqtt_subscribe_received",
+    Unsubscribe: "mqtt_unsubscribe_received",
+    Pingreq: "mqtt_pingreq_received",
+    Disconnect: "mqtt_disconnect_received",
+    Auth: "mqtt_auth_received",
+}
+
+
+class Transport:
+    """Minimal transport interface the session writes to; implemented by the
+    asyncio server (write-batched like vmq_ranch.erl:253-262) and by test
+    fixtures."""
+
+    def write(self, data: bytes) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def close(self) -> None:  # pragma: no cover - interface
+        raise NotImplementedError

@@ -1,0 +1,228 @@
+"""Asyncio MQTT client (v4 + v5).
+
+Plays the role of the reference's ``gen_mqtt_client`` behaviour
+(``apps/vmq_commons/src/gen_mqtt_client.erl``): a programmatic client used
+by the bridge for broker-to-broker links and by the test suites as the
+"real protocol over TCP" driver (the reference suites build frames with the
+parser's gen_* helpers and speak raw TCP — ``packet.erl``; this client is
+that, structured).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional, Tuple
+
+from .protocol import codec_v4, codec_v5
+from .protocol.types import (
+    PROTO_5,
+    Auth,
+    Connack,
+    Connect,
+    Disconnect,
+    Frame,
+    Pingreq,
+    Pingresp,
+    Puback,
+    Pubcomp,
+    Publish,
+    Pubrec,
+    Pubrel,
+    SubOpts,
+    Suback,
+    Subscribe,
+    Unsuback,
+    Unsubscribe,
+    Will,
+)
+
+
+class MQTTClient:
+    def __init__(self, host: str, port: int, client_id: str = "",
+                 proto_ver: int = 4, clean_start: bool = True,
+                 username: Optional[str] = None, password: Optional[bytes] = None,
+                 keepalive: int = 60, will: Optional[Will] = None,
+                 properties: Optional[Dict[str, Any]] = None):
+        self.host, self.port = host, port
+        self.client_id = client_id
+        self.proto_ver = proto_ver
+        self.codec = codec_v5 if proto_ver == PROTO_5 else codec_v4
+        self.clean_start = clean_start
+        self.username, self.password = username, password
+        self.keepalive = keepalive
+        self.will = will
+        self.connect_properties = properties or {}
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._buf = b""
+        self._next_pid = 0
+        self.connack: Optional[Connack] = None
+        # inbound publishes land here; acks handled inline by recv loop
+        self.messages: asyncio.Queue = asyncio.Queue()
+        self.disconnect_frame: Optional[Disconnect] = None
+        self._acks: Dict[int, asyncio.Future] = {}
+        self._recv_task: Optional[asyncio.Task] = None
+        self._auto_ack = True
+        self.closed = False
+
+    # ------------------------------------------------------------ plumbing
+
+    def _pid(self) -> int:
+        self._next_pid = (self._next_pid % 65535) + 1
+        return self._next_pid
+
+    def _send(self, frame: Frame) -> None:
+        assert self._writer is not None
+        self._writer.write(self.codec.serialise(frame))
+
+    async def _read_frame(self) -> Optional[Frame]:
+        while True:
+            frame, rest = self.codec.parse(self._buf)
+            self._buf = bytes(rest)
+            if frame is not None:
+                return frame
+            chunk = await self._reader.read(65536)
+            if not chunk:
+                return None
+            self._buf += chunk
+
+    # ------------------------------------------------------------- connect
+
+    async def connect(self, timeout: float = 5.0) -> Connack:
+        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._send(Connect(
+            proto_ver=self.proto_ver, client_id=self.client_id,
+            username=self.username, password=self.password,
+            clean_start=self.clean_start, keepalive=self.keepalive,
+            will=self.will, properties=self.connect_properties,
+        ))
+        frame = await asyncio.wait_for(self._read_frame(), timeout)
+        if isinstance(frame, Auth):
+            # enhanced auth continuation is driven by the caller via auth()
+            self._pending_auth = frame
+            return frame
+        if not isinstance(frame, Connack):
+            raise ConnectionError(f"expected CONNACK, got {frame!r}")
+        self.connack = frame
+        self._recv_task = asyncio.get_event_loop().create_task(self._recv_loop())
+        return frame
+
+    async def auth(self, reason_code: int, properties: Dict[str, Any],
+                   timeout: float = 5.0) -> Frame:
+        """Send an AUTH frame during enhanced auth; returns the next
+        CONNACK/AUTH frame."""
+        self._send(Auth(reason_code=reason_code, properties=properties))
+        frame = await asyncio.wait_for(self._read_frame(), timeout)
+        if isinstance(frame, Connack):
+            self.connack = frame
+            self._recv_task = asyncio.get_event_loop().create_task(self._recv_loop())
+        return frame
+
+    async def _recv_loop(self) -> None:
+        try:
+            while True:
+                frame = await self._read_frame()
+                if frame is None:
+                    break
+                t = type(frame)
+                if t is Publish:
+                    if self._auto_ack and frame.qos == 1:
+                        self._send(Puback(packet_id=frame.packet_id))
+                    elif self._auto_ack and frame.qos == 2:
+                        self._send(Pubrec(packet_id=frame.packet_id))
+                    await self.messages.put(frame)
+                elif t is Pubrel:
+                    if self._auto_ack:
+                        self._send(Pubcomp(packet_id=frame.packet_id))
+                elif t in (Puback, Pubrec, Pubcomp, Suback, Unsuback):
+                    if t is Pubrec:
+                        self._send(Pubrel(packet_id=frame.packet_id))
+                        continue  # wait for PUBCOMP to resolve the future
+                    fut = self._acks.pop(frame.packet_id, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(frame)
+                elif t is Pingresp:
+                    pass
+                elif t is Disconnect:
+                    self.disconnect_frame = frame
+                    await self.messages.put(frame)
+                    break
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self.closed = True
+            await self.messages.put(None)  # EOF marker
+
+    # ------------------------------------------------------------- actions
+
+    async def subscribe(self, topics, qos: int = 0,
+                        properties: Optional[Dict[str, Any]] = None,
+                        opts: Optional[SubOpts] = None,
+                        timeout: float = 5.0) -> Suback:
+        if isinstance(topics, str):
+            topics = [topics]
+        pid = self._pid()
+        fut = asyncio.get_event_loop().create_future()
+        self._acks[pid] = fut
+        self._send(Subscribe(
+            packet_id=pid,
+            topics=[(t, opts or SubOpts(qos=qos)) for t in topics],
+            properties=properties or {},
+        ))
+        return await asyncio.wait_for(fut, timeout)
+
+    async def unsubscribe(self, topics, timeout: float = 5.0) -> Unsuback:
+        if isinstance(topics, str):
+            topics = [topics]
+        pid = self._pid()
+        fut = asyncio.get_event_loop().create_future()
+        self._acks[pid] = fut
+        self._send(Unsubscribe(packet_id=pid, topics=topics))
+        return await asyncio.wait_for(fut, timeout)
+
+    async def publish(self, topic: str, payload: bytes = b"", qos: int = 0,
+                      retain: bool = False,
+                      properties: Optional[Dict[str, Any]] = None,
+                      timeout: float = 5.0) -> Optional[Frame]:
+        pid = self._pid() if qos else None
+        frame = Publish(topic=topic, payload=payload, qos=qos, retain=retain,
+                        packet_id=pid, properties=properties or {})
+        if qos == 0:
+            self._send(frame)
+            return None
+        fut = asyncio.get_event_loop().create_future()
+        self._acks[pid] = fut
+        self._send(frame)
+        return await asyncio.wait_for(fut, timeout)  # Puback or Pubcomp
+
+    async def ping(self) -> None:
+        self._send(Pingreq())
+
+    async def recv(self, timeout: float = 5.0) -> Optional[Frame]:
+        """Next inbound PUBLISH (or server DISCONNECT/None-EOF)."""
+        return await asyncio.wait_for(self.messages.get(), timeout)
+
+    async def disconnect(self, reason_code: int = 0,
+                         properties: Optional[Dict[str, Any]] = None) -> None:
+        if self._writer is not None and not self.closed:
+            try:
+                if self.proto_ver == PROTO_5:
+                    self._send(Disconnect(reason_code=reason_code,
+                                          properties=properties or {}))
+                else:
+                    self._send(Disconnect())
+                await self._writer.drain()
+            except ConnectionError:
+                pass
+        await self.close()
+
+    async def close(self) -> None:
+        self.closed = True
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass

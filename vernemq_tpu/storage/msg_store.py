@@ -1,0 +1,150 @@
+"""Offline message store.
+
+Mirrors the reference message-store seam: the store is itself a plugin
+(``msg_store_write/read/delete/find`` hooks, used from the queue at
+``vmq_queue.erl:420,797,946,970``), with the LevelDB implementation
+(``vmq_lvldb_store.erl``) keeping three key families — message payload by
+ref, per-subscriber ref entries, and a per-subscriber index for recovery
+scans (``vmq_lvldb_store.erl:339-416``) — plus payload refcounting across
+subscribers.
+
+Round 1 ships the in-memory store and a durable append-log file store with
+the same refcounted layout; the C++/RocksDB engine lands behind this same
+interface in a later round.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..broker.message import Msg, SubscriberId
+
+
+class MsgStore:
+    """Interface (msg_store_* plugin hooks)."""
+
+    def write(self, sid: SubscriberId, msg: Msg) -> None:
+        raise NotImplementedError
+
+    def read_all(self, sid: SubscriberId) -> List[Msg]:
+        raise NotImplementedError
+
+    def delete(self, sid: SubscriberId, msg_ref: bytes) -> None:
+        raise NotImplementedError
+
+    def delete_all(self, sid: SubscriberId) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryMsgStore(MsgStore):
+    def __init__(self) -> None:
+        # payload table: ref -> (msg, refcount)  (dedup across subscribers,
+        # vmq_lvldb_store.erl:347,455-472)
+        self._msgs: Dict[bytes, Tuple[Msg, int]] = {}
+        # index: sid -> [ref] in arrival order (the sext-ordered idx family)
+        self._idx: Dict[SubscriberId, List[bytes]] = {}
+
+    def write(self, sid: SubscriberId, msg: Msg) -> None:
+        entry = self._msgs.get(msg.msg_ref)
+        if entry is None:
+            self._msgs[msg.msg_ref] = (msg, 1)
+        else:
+            self._msgs[msg.msg_ref] = (entry[0], entry[1] + 1)
+        self._idx.setdefault(sid, []).append(msg.msg_ref)
+
+    def read_all(self, sid: SubscriberId) -> List[Msg]:
+        return [self._msgs[r][0] for r in self._idx.get(sid, []) if r in self._msgs]
+
+    def delete(self, sid: SubscriberId, msg_ref: bytes) -> None:
+        idx = self._idx.get(sid)
+        if idx and msg_ref in idx:
+            idx.remove(msg_ref)
+            self._deref(msg_ref)
+
+    def delete_all(self, sid: SubscriberId) -> None:
+        for ref in self._idx.pop(sid, []):
+            self._deref(ref)
+
+    def _deref(self, ref: bytes) -> None:
+        entry = self._msgs.get(ref)
+        if entry is None:
+            return
+        if entry[1] <= 1:
+            del self._msgs[ref]
+        else:
+            self._msgs[ref] = (entry[0], entry[1] - 1)
+
+    def stats(self) -> Dict[str, int]:
+        return {"stored_messages": len(self._msgs),
+                "stored_refs": sum(len(v) for v in self._idx.values())}
+
+
+class FileMsgStore(MemoryMsgStore):
+    """Append-log-backed store: every op is journaled, state rebuilt on open
+    (the recovery scan role of vmq_lvldb_store.erl:396-453). Simple but
+    durable; swapped for the C++ engine later."""
+
+    def __init__(self, directory: str):
+        super().__init__()
+        os.makedirs(directory, exist_ok=True)
+        self._path = os.path.join(directory, "msgstore.log")
+        self._recover()
+        self._fh = open(self._path, "ab")
+
+    def _recover(self) -> None:
+        if not os.path.exists(self._path):
+            return
+        with open(self._path, "rb") as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    break  # torn tail write
+                op = rec["op"]
+                sid = (rec["mp"], rec["cid"])
+                if op == "w":
+                    msg = Msg(
+                        topic=tuple(rec["topic"]),
+                        payload=bytes.fromhex(rec["payload"]),
+                        qos=rec["qos"],
+                        retain=rec.get("retain", False),
+                        mountpoint=rec["mp"],
+                        msg_ref=rec["ref"].encode(),
+                        properties=rec.get("props", {}),
+                    )
+                    super().write(sid, msg)
+                elif op == "d":
+                    super().delete(sid, rec["ref"].encode())
+                elif op == "da":
+                    super().delete_all(sid)
+
+    def _log(self, rec: dict) -> None:
+        self._fh.write(json.dumps(rec).encode() + b"\n")
+        self._fh.flush()
+
+    def write(self, sid: SubscriberId, msg: Msg) -> None:
+        super().write(sid, msg)
+        self._log({
+            "op": "w", "mp": sid[0], "cid": sid[1], "ref": msg.msg_ref.decode(),
+            "topic": list(msg.topic), "payload": msg.payload.hex(),
+            "qos": msg.qos, "retain": msg.retain,
+            "props": {k: v for k, v in msg.properties.items()
+                      if isinstance(v, (int, str, float))},
+        })
+
+    def delete(self, sid: SubscriberId, msg_ref: bytes) -> None:
+        super().delete(sid, msg_ref)
+        self._log({"op": "d", "mp": sid[0], "cid": sid[1], "ref": msg_ref.decode()})
+
+    def delete_all(self, sid: SubscriberId) -> None:
+        super().delete_all(sid)
+        self._log({"op": "da", "mp": sid[0], "cid": sid[1]})
+
+    def close(self) -> None:
+        self._fh.close()
