@@ -6,10 +6,18 @@ hardware (mirrors the reference's ct_slave multi-node-on-one-host strategy,
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the image presets JAX_PLATFORMS=axon (the real TPU); tests always run on
+# the virtual CPU mesh, so override unconditionally. jax is already imported
+# by the time conftest runs (a pytest plugin pulls it in), so env vars alone
+# are too late — use jax.config before any backend initialises.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

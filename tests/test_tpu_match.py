@@ -1,0 +1,151 @@
+"""TPU match engine tests: parity against the host trie oracle on random
+corpora (SURVEY.md §7.1 step 4 / §4.4 — kernel vs reference matcher), delta
+updates, overflow/truncation fallbacks, and the broker wired to the tpu
+reg view end-to-end. Runs on the CPU backend (conftest forces 8 virtual
+devices)."""
+
+import random
+
+import pytest
+
+from vernemq_tpu.models.tpu_matcher import TpuMatcher
+from vernemq_tpu.models.trie import SubscriptionTrie
+from vernemq_tpu.protocol import topic as T
+
+WORDS = ["a", "b", "c", "d", "sensor", "dev", "x1", ""]
+
+
+def rand_filter(rng, max_len=6):
+    n = rng.randint(1, max_len)
+    words = []
+    for _ in range(n):
+        r = rng.random()
+        if r < 0.2:
+            words.append("+")
+        else:
+            words.append(rng.choice(WORDS))
+    if rng.random() < 0.25:
+        words.append("#")
+    return words
+
+
+def rand_topic(rng, max_len=6):
+    n = rng.randint(1, max_len)
+    words = [rng.choice(WORDS) for _ in range(n)]
+    if rng.random() < 0.1:
+        words[0] = "$SYS"
+    return tuple(words)
+
+
+def norm(rows):
+    return sorted((tuple(f), k) for f, k, _ in rows)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_parity_random_corpus(seed):
+    rng = random.Random(seed)
+    matcher = TpuMatcher(max_levels=8, initial_capacity=64, max_fanout=128)
+    trie = SubscriptionTrie()
+    for i in range(300):
+        f = rand_filter(rng)
+        matcher.table.add(f, i, None)
+        trie.add(f, i, None)
+    topics = [rand_topic(rng) for _ in range(100)]
+    got = matcher.match_batch(topics)
+    for topic, rows in zip(topics, got):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_delta_add_remove():
+    m = TpuMatcher(max_levels=4, initial_capacity=8)
+    m.table.add(["a", "+"], "k1", None)
+    m.table.add(["a", "b"], "k2", None)
+    assert norm(m.match_batch([("a", "b")])[0]) == [(("a", "+"), "k1"), (("a", "b"), "k2")]
+    # delta: remove one, add another — exercises apply_delta scatter
+    m.table.remove(["a", "b"], "k2")
+    m.table.add(["#"], "k3", None)
+    assert norm(m.match_batch([("a", "b")])[0]) == [(("#",), "k3"), (("a", "+"), "k1")]
+
+
+def test_capacity_growth():
+    m = TpuMatcher(max_levels=4, initial_capacity=4)
+    for i in range(100):
+        m.table.add(["t", str(i)], i, None)
+    rows = m.match_batch([("t", "42")])[0]
+    assert norm(rows) == [(("t", "42"), 42)]
+    assert m.table.cap >= 100
+
+
+def test_dollar_rule_on_device():
+    m = TpuMatcher(max_levels=4)
+    m.table.add(["#"], "root", None)
+    m.table.add(["$SYS", "#"], "sys", None)
+    m.table.add(["+", "x"], "plus", None)
+    assert norm(m.match_batch([("$SYS", "x")])[0]) == [(("$SYS", "#"), "sys")]
+    assert norm(m.match_batch([("normal", "x")])[0]) == [
+        (("#",), "root"), (("+", "x"), "plus")]
+
+
+def test_hash_matches_parent_level():
+    m = TpuMatcher(max_levels=4)
+    m.table.add(["a", "#"], "k", None)
+    assert norm(m.match_batch([("a",)])[0]) == [(("a", "#"), "k")]
+    assert norm(m.match_batch([("a", "b", "c")])[0]) == [(("a", "#"), "k")]
+    assert m.match_batch([("b",)])[0] == []
+
+
+def test_long_filter_overflow_to_host():
+    m = TpuMatcher(max_levels=4)
+    m.table.add(["a", "b", "c", "d", "e", "f"], "long", None)  # > L levels
+    m.table.add(["a", "#"], "short", None)
+    rows = m.match_batch([("a", "b", "c", "d", "e", "f")])[0]
+    assert norm(rows) == [(("a", "#"), "short"),
+                          (("a", "b", "c", "d", "e", "f"), "long")]
+
+
+def test_fanout_truncation_falls_back_exact():
+    m = TpuMatcher(max_levels=4, max_fanout=8)
+    for i in range(50):
+        m.table.add(["hot", "t"], f"k{i}", None)
+    rows = m.match_batch([("hot", "t")])[0]
+    assert len(rows) == 50  # truncated on device, exact on host
+
+
+def test_unknown_publish_words_only_match_wildcards():
+    m = TpuMatcher(max_levels=4)
+    m.table.add(["+"], "plus", None)
+    m.table.add(["known"], "exact", None)
+    assert norm(m.match_batch([("neverseen",)])[0]) == [(("+",), "plus")]
+
+
+@pytest.mark.asyncio
+async def test_broker_e2e_with_tpu_reg_view(event_loop):
+    """Full broker with default_reg_view=tpu: real MQTT over TCP routes
+    through the batched device matcher."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    b, server = await start_broker(
+        Config(systree_enabled=False, default_reg_view="tpu",
+               tpu_batch_window_us=500),
+        port=0,
+    )
+    try:
+        sub = MQTTClient(server.host, server.port, "tpu-sub")
+        await sub.connect()
+        await sub.subscribe("tpu/+/x", qos=1)
+        pub = MQTTClient(server.host, server.port, "tpu-pub")
+        await pub.connect()
+        for i in range(5):
+            await pub.publish(f"tpu/{i}/x", f"m{i}".encode(), qos=1)
+        got = sorted([(await sub.recv()).payload for _ in range(5)])
+        assert got == [f"m{i}".encode() for i in range(5)]
+        # matched via the device path
+        view = b.registry.reg_view("tpu")
+        assert view.matcher("").match_publishes >= 5
+        await sub.disconnect()
+        await pub.disconnect()
+    finally:
+        await b.stop()
+        await server.stop()

@@ -199,12 +199,17 @@ class Broker:
 
     # ------------------------------------------------------------ lifecycle
 
-    def on_trie_delta(self) -> None:
-        """Subscription change event — feeds the TPU table delta stream
-        (the analog of vmq_reg_trie consuming subscriber-db events)."""
-        view = self.registry.reg_views.get("tpu")
-        if view is not None:
-            view.mark_dirty()
+    def batch_collector(self):
+        """Lazy publish batch collector for the TPU reg view (µs-scale
+        coalescing, SURVEY.md §5.8 host↔TPU batching layer)."""
+        if getattr(self, "_collector", None) is None:
+            from ..models.tpu_matcher import BatchCollector
+
+            self._collector = BatchCollector(
+                self.registry.reg_view("tpu"),
+                window_us=self.config.tpu_batch_window_us,
+            )
+        return self._collector
 
     async def start_systree(self) -> None:
         """$SYS tree publisher (vmq_systree.erl): periodic internal publish

@@ -87,7 +87,17 @@ class Registry:
         return t
 
     def reg_view(self, name: Optional[str] = None):
-        return self.reg_views[name or self.broker.config.default_reg_view]
+        name = name or self.broker.config.default_reg_view
+        view = self.reg_views.get(name)
+        if view is None and name == "tpu":
+            from ..models.tpu_matcher import TpuRegView
+
+            view = self.reg_views["tpu"] = TpuRegView(
+                self, max_fanout=self.broker.config.tpu_max_fanout
+            )
+        if view is None:
+            raise KeyError(f"unknown reg view {name!r}")
+        return view
 
     # -- session registration ---------------------------------------------
 
@@ -148,9 +158,10 @@ class Registry:
             group, rest = unshare(list(filter_words))
             if group is None:
                 trie.remove(filter_words, sid)
+                self._emit_delta("remove", sid[0], filter_words, sid, None)
             else:
                 trie.remove(rest, ("$g", group, sid))
-        self.broker.on_trie_delta()
+                self._emit_delta("remove", sid[0], rest, ("$g", group, sid), None)
 
     # -- subscribe / unsubscribe ------------------------------------------
 
@@ -170,16 +181,25 @@ class Registry:
             group, rest = unshare(list(words))
             if group is None:
                 trie.add(words, sid, opts)
+                self._emit_delta("add", sid[0], words, sid, opts)
             else:
                 trie.add(rest, ("$g", group, sid), opts)
+                self._emit_delta("add", sid[0], rest, ("$g", group, sid), opts)
             granted.append(opts.qos)
             # retained replay (vmq_reg.erl:380-418); none for shared subs
             # (MQTT5: retained messages are not sent to shared subscriptions)
             if group is None and opts.retain_handling != 2:
                 if not (opts.retain_handling == 1 and existed):
                     self._deliver_retained(sid, words, opts)
-        self.broker.on_trie_delta()
         return granted
+
+    def _emit_delta(self, op: str, mountpoint: str, filter_words, key, opts) -> None:
+        """Subscription change event → TPU table delta stream (the analog of
+        vmq_reg_trie consuming subscriber-db change events; BASELINE
+        config 5 trie-delta streaming)."""
+        view = self.reg_views.get("tpu")
+        if view is not None:
+            view.on_delta(op, mountpoint, filter_words, key, opts)
 
     def unsubscribe(self, sid: SubscriberId, topics: List[List[str]]) -> List[bool]:
         mountpoint = sid[0]
@@ -192,12 +212,13 @@ class Registry:
             group, rest = unshare(list(words))
             if group is None:
                 trie.remove(words, sid)
+                self._emit_delta("remove", mountpoint, words, sid, None)
             else:
                 trie.remove(rest, ("$g", group, sid))
+                self._emit_delta("remove", mountpoint, rest, ("$g", group, sid), None)
             results.append(existed)
         if not subs:
             self.subscriptions.pop(sid, None)
-        self.broker.on_trie_delta()
         return results
 
     def _deliver_retained(self, sid: SubscriberId, filter_words: List[str], opts: SubOpts) -> None:
@@ -229,6 +250,30 @@ class Registry:
         """Retain handling + fold + enqueue; returns number of local matches
         (used for the v5 no-matching-subscribers reason code).
         vmq_reg:publish/4 (vmq_reg.erl:265-319)."""
+        msg = self._pre_publish(msg)
+        name = reg_view or self.broker.config.default_reg_view
+        if name == "tpu" and reg_view is None:
+            # synchronous callers (systree, wills, plugins) must never run
+            # the device matcher on the event loop — the host trie is
+            # maintained in parallel as the source of truth and gives
+            # identical results; sessions reach the tpu view via
+            # publish_async/BatchCollector
+            name = "trie"
+        rows = self.reg_view(name).fold(msg.mountpoint, msg.topic)
+        return self.route_rows(msg, rows, from_sid)
+
+    async def publish_async(
+        self, msg: Msg, from_sid: Optional[SubscriberId] = None
+    ) -> int:
+        """Batched publish path: retain handling is synchronous (local
+        read-your-writes ordering like the reference's synchronous trie
+        events), then the match rides the broker's BatchCollector — many
+        concurrent publishes share one device call."""
+        msg = self._pre_publish(msg)
+        rows = await self.broker.batch_collector().submit(msg.mountpoint, msg.topic)
+        return self.route_rows(msg, rows, from_sid)
+
+    def _pre_publish(self, msg: Msg) -> Msg:
         cfg = self.broker.config
         if not self.broker.cluster_ready() and not cfg.allow_publish_during_netsplit:
             raise RuntimeError("not_ready")
@@ -248,9 +293,7 @@ class Registry:
                     ),
                 )
                 self.broker.metrics.incr("retain_messages_stored")
-        view = self.reg_view(reg_view)
-        rows = view.fold(msg.mountpoint, msg.topic)
-        return self.route_rows(msg, rows, from_sid)
+        return msg
 
     def route_rows(
         self,

@@ -197,10 +197,24 @@ def main() -> None:  # pragma: no cover
     parser = argparse.ArgumentParser(description="vernemq_tpu broker")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=1883)
+    parser.add_argument("--reg-view", default="trie", choices=["trie", "tpu"],
+                        help="subscription matcher (the default_reg_view seam)")
+    parser.add_argument("--jax-platform", default=None,
+                        help="force the JAX backend (e.g. cpu); note this "
+                             "image's jax ignores the JAX_PLATFORMS env var — "
+                             "only jax.config takes effect")
     args = parser.parse_args()
+    if args.jax_platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.jax_platform)
 
     async def _run():
-        broker, server = await start_broker(host=args.host, port=args.port)
+        from .config import Config
+
+        broker, server = await start_broker(
+            Config(default_reg_view=args.reg_view), host=args.host, port=args.port
+        )
         print(f"vernemq_tpu broker listening on {args.host}:{server.port}")
         await asyncio.Event().wait()
 

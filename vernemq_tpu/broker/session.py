@@ -24,8 +24,11 @@ connection task), with queue deliveries arriving as callbacks:
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import TYPE_CHECKING, Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger("vernemq_tpu.session")
 
 from ..protocol import codec_v4, codec_v5
 from ..protocol import topic as T
@@ -448,9 +451,9 @@ class Session:
             msg.expires_at = time.monotonic() + expiry
 
         if f.qos == 0:
-            self._route(msg)
+            await self._route(msg)
         elif f.qos == 1:
-            matches = self._route(msg)
+            matches = await self._route(msg)
             rc = RC_SUCCESS if matches else RC_NO_MATCHING_SUBSCRIBERS
             ack = Puback(packet_id=f.packet_id)
             if self.proto_ver == PROTO_5 and rc:
@@ -459,17 +462,36 @@ class Session:
             self.broker.metrics.incr("mqtt_puback_sent")
         else:  # qos 2: route on first arrival, dedup until PUBREL
             if f.packet_id not in self.awaiting_rel:
-                self._route(msg)
                 self.awaiting_rel[f.packet_id] = time.monotonic()
+                n = await self._route(msg)
+                if n < 0:
+                    # internal routing failure: forget the packet id so the
+                    # client's DUP retry re-routes instead of being deduped
+                    self.awaiting_rel.pop(f.packet_id, None)
+                    return
             self.send(Pubrec(packet_id=f.packet_id))
             self.broker.metrics.incr("mqtt_pubrec_sent")
 
-    def _route(self, msg: Msg) -> int:
+    async def _route(self, msg: Msg) -> int:
+        """Route via the registry; returns match count, or -1 on an internal
+        matcher failure (distinct from the not_ready gate: internal errors
+        are logged and, for QoS2, leave the packet eligible for re-route on
+        the client's DUP retry)."""
         try:
-            n = self.broker.registry.publish(msg, from_sid=self.sid)
-        except RuntimeError:
+            if self.broker.config.default_reg_view == "tpu":
+                n = await self.broker.registry.publish_async(msg, from_sid=self.sid)
+            else:
+                n = self.broker.registry.publish(msg, from_sid=self.sid)
+        except RuntimeError as e:
             self.broker.metrics.incr("mqtt_publish_error")
+            if e.args != ("not_ready",):
+                log.exception("publish routing failed for %s", self.sid)
+                return -1
             return 0
+        except Exception:
+            self.broker.metrics.incr("mqtt_publish_error")
+            log.exception("publish routing failed for %s", self.sid)
+            return -1
         self.broker.hooks_fire_all(
             "on_publish", self.username, self.sid, msg.qos, msg.topic,
             msg.payload, msg.retain,

@@ -1,0 +1,271 @@
+"""The TPU match engine: device-resident subscription table + batched
+wildcard matching, wired as a RegView behind the registry's reg-view seam.
+
+This is the north star (BASELINE.json): the ``vmq_reg_trie`` equivalent
+lives in device HBM and ``fold_subscribers`` becomes one batched kernel
+call over thousands of concurrent PUBLISHes. The engine is correct on any
+JAX backend (tests run it on CPU with a virtual device mesh); on TPU the
+match is VPU/HBM work batched to amortise dispatch.
+
+Pieces:
+- :class:`TpuMatcher` — owns a :class:`SubscriptionTable`, mirrors it to
+  the device (full upload on growth, scatter delta otherwise), and serves
+  ``match_batch`` with power-of-two batch padding to bound recompiles;
+- :class:`TpuRegView` — the reg-view adapter (``vmq_reg_view.erl:20-27``
+  seam): synchronous ``fold`` for drop-in parity with the trie view plus
+  the batch interface the collector uses;
+- :class:`BatchCollector` — µs-scale publish coalescing (SURVEY.md §5.8
+  host↔TPU: accumulate ≤ window, one device call, scatter to queues).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ops import match_kernel as K
+from .tpu_table import SubscriptionTable
+
+Row = Tuple[Tuple[str, ...], Hashable, Any]
+
+
+class TpuMatcher:
+    def __init__(self, max_levels: int = 16, initial_capacity: int = 1024,
+                 max_fanout: int = 256, device=None):
+        import threading
+
+        import jax
+
+        self._jax = jax
+        self.table = SubscriptionTable(max_levels, initial_capacity)
+        self.max_fanout = max_fanout
+        self.device = device or jax.devices()[0]
+        self._dev_arrays: Optional[Tuple] = None
+        self._entries_snapshot: List[Optional[Row]] = []
+        self.match_batches = 0
+        self.match_publishes = 0
+        # guards table mutation (event loop) vs sync/match (executor thread)
+        self.lock = threading.Lock()
+
+    # ------------------------------------------------------------ delta sync
+
+    def sync(self) -> None:
+        """Ship pending table mutations to the device: full upload after a
+        capacity change, scatter of dirty slots otherwise. Also snapshots
+        the slot->entry map so results of an in-flight device call resolve
+        against the state that was actually matched (a slot freed+reused
+        mid-call must not misroute to the new subscriber). Callers hold
+        ``self.lock``."""
+        t = self.table
+        if self._dev_arrays is None or t.resized:
+            put = lambda a: self._jax.device_put(a, self.device)
+            self._dev_arrays = (
+                put(t.words), put(t.eff_len), put(t.has_hash),
+                put(t.first_wild), put(t.active),
+            )
+            t.resized = False
+            t.dirty.clear()
+            self._entries_snapshot = list(t.entries)
+            return
+        if not t.dirty:
+            return
+        slots = np.fromiter(t.dirty, dtype=np.int32)
+        t.dirty.clear()
+        for s in slots:
+            self._entries_snapshot[s] = t.entries[s]
+        sw, el, hh, fw, ac = self._dev_arrays
+        self._dev_arrays = K.apply_delta(
+            sw, el, hh, fw, ac,
+            self._jax.device_put(slots, self.device),
+            self._jax.device_put(t.words[slots], self.device),
+            self._jax.device_put(t.eff_len[slots], self.device),
+            self._jax.device_put(t.has_hash[slots], self.device),
+            self._jax.device_put(t.first_wild[slots], self.device),
+            self._jax.device_put(t.active[slots], self.device),
+        )
+
+    # ---------------------------------------------------------------- match
+
+    def _pad_batch(self, n: int) -> int:
+        b = 8
+        while b < n:
+            b *= 2
+        return b
+
+    def encode_batch(self, topics: Sequence[Sequence[str]]):
+        B = self._pad_batch(len(topics))
+        L = self.table.L
+        pw = np.full((B, L), K.PAD_ID, dtype=np.int32)
+        pl = np.zeros(B, dtype=np.int32)
+        pd = np.zeros(B, dtype=bool)
+        for i, t in enumerate(topics):
+            row, n, dollar = self.table.encode_topic(t)
+            pw[i], pl[i], pd[i] = row, n, dollar
+        return pw, pl, pd
+
+    def match_batch(self, topics: Sequence[Sequence[str]]) -> List[List[Row]]:
+        """Match a batch of publish topics; returns per-topic entry rows
+        (the per-publish fold results)."""
+        if not topics:
+            return []
+        with self.lock:
+            self.sync()
+            dev_arrays = self._dev_arrays
+            snapshot = self._entries_snapshot
+            pw, pl, pd = self.encode_batch(topics)
+        chunk = 256 if pw.shape[0] > 256 else 0
+        idx, valid, count = K.match_extract(
+            *dev_arrays, pw, pl, pd, k=self.max_fanout, chunk=chunk
+        )
+        idx = np.asarray(idx)
+        valid = np.asarray(valid)
+        count = np.asarray(count)
+        self.match_batches += 1
+        self.match_publishes += len(topics)
+        out: List[List[Row]] = []
+        for i, topic in enumerate(topics):
+            rows = [
+                e for e in (snapshot[s] for s in idx[i][valid[i]]) if e is not None
+            ]
+            if count[i] > self.max_fanout:
+                # truncated fanout: fall back to exact host matching for this
+                # topic so no subscriber is silently skipped
+                rows = self._host_match(topic, snapshot)
+            else:
+                with self.lock:
+                    if len(self.table.overflow):
+                        # >L-level filters live host-side; device rows stay
+                        # valid for any topic length (only concrete levels
+                        # <= L are compared)
+                        rows = rows + self.table.overflow.match(list(topic))
+            out.append(rows)
+        return out
+
+    def _host_match(self, topic: Sequence[str], snapshot=None) -> List[Row]:
+        from ..protocol.topic import match_dollar_aware
+
+        rows: List[Row] = []
+        t = list(topic)
+        with self.lock:
+            entries = list(snapshot if snapshot is not None else self.table.entries)
+            overflow_rows = self.table.overflow.match(t)
+        for e in entries:
+            if e is not None and match_dollar_aware(t, list(e[0])):
+                rows.append(e)
+        rows.extend(overflow_rows)
+        return rows
+
+
+class TpuRegView:
+    """Reg-view adapter over per-mountpoint TpuMatchers. Non-default
+    mountpoints share the same machinery (one table each)."""
+
+    name = "tpu"
+
+    def __init__(self, registry, max_levels: int = 16,
+                 initial_capacity: int = 1024, max_fanout: int = 256):
+        self.registry = registry
+        self._matchers: Dict[str, TpuMatcher] = {}
+        self._mk = lambda: TpuMatcher(max_levels, initial_capacity, max_fanout)
+
+    def matcher(self, mountpoint: str = "") -> TpuMatcher:
+        """Get/create the mountpoint's matcher. Warm-load MUST run on the
+        event-loop thread (trie iteration races loop-side subscribes
+        otherwise); the BatchCollector resolves matchers on-loop before
+        handing work to the executor."""
+        m = self._matchers.get(mountpoint)
+        if m is None:
+            m = self._mk()
+            with m.lock:
+                # warm-load from the registry's current state (the trie warm
+                # load at boot, vmq_reg_trie.erl:144-151); publish only after
+                # loading so on_delta can't interleave with the load
+                for fw, key, opts in self.registry.fold_subscriptions(mountpoint):
+                    m.table.add(list(fw), key, opts)
+            self._matchers[mountpoint] = m
+        return m
+
+    # delta feed from the registry
+    def on_delta(self, op: str, mountpoint: str, filter_words, key, opts) -> None:
+        m = self._matchers.get(mountpoint)
+        if m is None:
+            return  # lazily warm-loaded on first use
+        with m.lock:
+            if op == "add":
+                m.table.add(list(filter_words), key, opts)
+            else:
+                m.table.remove(list(filter_words), key)
+
+    def fold(self, mountpoint: str, topic: Sequence[str]) -> List[Row]:
+        """Synchronous single-topic fold — drop-in replacement for the trie
+        view (a batch of one; the BatchCollector path amortises)."""
+        return self.matcher(mountpoint).match_batch([tuple(topic)])[0]
+
+    def fold_batch(self, mountpoint: str, topics: Sequence[Sequence[str]]):
+        return self.matcher(mountpoint).match_batch(topics)
+
+
+class BatchCollector:
+    """Coalesce concurrent publishes into one device call.
+
+    Publishes arriving within ``window_us`` (or until ``max_batch``) are
+    matched together; each caller's future resolves to its own match rows.
+    Equivalent host-side role to the NIF batching layer in the north-star
+    design (BASELINE.json)."""
+
+    def __init__(self, view: TpuRegView, window_us: int = 200, max_batch: int = 4096):
+        self.view = view
+        self.window = window_us / 1e6
+        self.max_batch = max_batch
+        self._pending: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+
+    def submit(self, mountpoint: str, topic: Sequence[str]) -> asyncio.Future:
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        self._pending.append((mountpoint, tuple(topic), fut))
+        if len(self._pending) >= self.max_batch:
+            if self._flush_handle is not None:
+                self._flush_handle.cancel()
+                self._flush_handle = None
+            self._flush()
+        elif self._flush_handle is None:
+            self._flush_handle = loop.call_later(self.window, self._flush)
+        return fut
+
+    def _flush(self) -> None:
+        self._flush_handle = None
+        pending, self._pending = self._pending, []
+        if not pending:
+            return
+        asyncio.get_event_loop().create_task(self._flush_async(pending))
+
+    async def _flush_async(self, pending) -> None:
+        """Run the device call off-loop (executor thread): a jit compile for
+        a new padded batch size takes seconds, and blocking the event loop
+        would stall every session's IO (the socket loop is the analog of the
+        reference's per-connection process — it must never wait on the
+        matcher)."""
+        loop = asyncio.get_event_loop()
+        # group by mountpoint (typically one)
+        by_mp: Dict[str, List[Tuple[Tuple[str, ...], asyncio.Future]]] = {}
+        for mp, topic, fut in pending:
+            by_mp.setdefault(mp, []).append((topic, fut))
+        for mp, items in by_mp.items():
+            topics = [t for t, _ in items]
+            self.view.matcher(mp)  # warm-load on the loop thread (see matcher())
+            try:
+                results = await loop.run_in_executor(
+                    None, self.view.fold_batch, mp, topics
+                )
+            except Exception as e:  # resolve futures with the error
+                for _, fut in items:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            for (_, fut), rows in zip(items, results):
+                if not fut.done():
+                    fut.set_result(rows)

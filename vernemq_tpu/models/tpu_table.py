@@ -1,0 +1,167 @@
+"""Host-side management of the device-resident subscription table.
+
+This is the mutation half of the TPU match engine (SURVEY.md §7.2 "mutation
+vs. immutability"): ETS is mutable in place, device arrays are not, so
+subscribe/unsubscribe land in pinned numpy mirrors + a dirty-slot set, and
+``sync()`` ships them as one scatter (``apply_delta``) — bounded-staleness
+double buffering. Capacity grows by doubling (re-upload), word ids are
+interned (SURVEY.md §7.2 "id-interning"), and filters longer than ``L``
+levels overflow to a host trie so the device arrays stay rectangular.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..protocol.topic import HASH, PLUS
+from .trie import SubscriptionTrie
+
+PAD_ID = 0
+PLUS_ID = 1
+HASH_ID = 2
+FIRST_WORD_ID = 3
+UNKNOWN_ID = -2  # publish words never seen in any subscription
+
+
+class WordInterner:
+    def __init__(self) -> None:
+        self._ids: Dict[str, int] = {}
+        self._next = FIRST_WORD_ID
+
+    def intern(self, word: str) -> int:
+        """Id for a subscription word (allocates)."""
+        i = self._ids.get(word)
+        if i is None:
+            i = self._next
+            self._next = i + 1
+            self._ids[word] = i
+        return i
+
+    def lookup(self, word: str) -> int:
+        """Id for a publish word (never allocates: a word no subscription
+        uses can only match via ``+``/``#``)."""
+        return self._ids.get(word, UNKNOWN_ID)
+
+    def __len__(self) -> int:
+        return self._next - FIRST_WORD_ID
+
+
+class SubscriptionTable:
+    """Flat subscription store: numpy mirrors + slot bookkeeping.
+
+    Rows hold interned level ids; the per-slot payload (key, opts) stays
+    host-side — the kernel returns slot indices, the host maps them back,
+    mirroring the fold returning subscriber rows (vmq_reg_trie.erl:60-85).
+    """
+
+    def __init__(self, max_levels: int = 16, initial_capacity: int = 1024):
+        self.L = max_levels
+        self.cap = initial_capacity
+        self.interner = WordInterner()
+        self.words = np.zeros((self.cap, self.L), dtype=np.int32)
+        self.eff_len = np.zeros(self.cap, dtype=np.int32)
+        self.has_hash = np.zeros(self.cap, dtype=bool)
+        self.first_wild = np.zeros(self.cap, dtype=bool)
+        self.active = np.zeros(self.cap, dtype=bool)
+        self.entries: List[Optional[Tuple[Tuple[str, ...], Hashable, Any]]] = [None] * self.cap
+        self._free: List[int] = list(range(self.cap - 1, -1, -1))
+        self._slot_of: Dict[Tuple[Tuple[str, ...], Hashable], int] = {}
+        self.dirty: set = set()
+        self.resized = True  # force first full upload
+        # filters longer than L levels: host-trie overflow (kept tiny)
+        self.overflow = SubscriptionTrie()
+        self.count = 0
+
+    # ------------------------------------------------------------- mutation
+
+    def add(self, filter_words: Sequence[str], key: Hashable, value: Any = None) -> None:
+        fw = tuple(filter_words)
+        if len(fw) > self.L:
+            self.overflow.add(list(fw), key, value)
+            self.count += 1
+            return
+        existing = self._slot_of.get((fw, key))
+        if existing is not None:
+            self.entries[existing] = (fw, key, value)
+            return
+        if not self._free:
+            self._grow()
+        slot = self._free.pop()
+        hh = bool(fw) and fw[-1] == HASH
+        concrete = fw[:-1] if hh else fw
+        row = np.full(self.L, PAD_ID, dtype=np.int32)
+        for i, w in enumerate(concrete):
+            row[i] = PLUS_ID if w == PLUS else self.interner.intern(w)
+        self.words[slot] = row
+        self.eff_len[slot] = len(concrete)
+        self.has_hash[slot] = hh
+        self.first_wild[slot] = bool(fw) and fw[0] in (PLUS, HASH)
+        self.active[slot] = True
+        self.entries[slot] = (fw, key, value)
+        self._slot_of[(fw, key)] = slot
+        self.dirty.add(slot)
+        self.count += 1
+
+    def remove(self, filter_words: Sequence[str], key: Hashable) -> bool:
+        fw = tuple(filter_words)
+        if len(fw) > self.L:
+            ok = self.overflow.remove(list(fw), key)
+            if ok:
+                self.count -= 1
+            return ok
+        slot = self._slot_of.pop((fw, key), None)
+        if slot is None:
+            return False
+        self.active[slot] = False
+        self.entries[slot] = None
+        self._free.append(slot)
+        self.dirty.add(slot)
+        self.count -= 1
+        return True
+
+    def _grow(self) -> None:
+        new_cap = self.cap * 2
+        self.words = np.vstack([self.words,
+                                np.zeros((self.cap, self.L), dtype=np.int32)])
+        self.eff_len = np.concatenate([self.eff_len, np.zeros(self.cap, dtype=np.int32)])
+        self.has_hash = np.concatenate([self.has_hash, np.zeros(self.cap, dtype=bool)])
+        self.first_wild = np.concatenate([self.first_wild, np.zeros(self.cap, dtype=bool)])
+        self.active = np.concatenate([self.active, np.zeros(self.cap, dtype=bool)])
+        self.entries.extend([None] * self.cap)
+        self._free.extend(range(new_cap - 1, self.cap - 1, -1))
+        self.cap = new_cap
+        self.resized = True
+
+    # ---------------------------------------------------------- publish side
+
+    def encode_topic(self, topic: Sequence[str]) -> Tuple[np.ndarray, int, bool]:
+        """Publish topic → (row [L], length, is_dollar). Topics longer than L
+        are matched host-side only (overflow path)."""
+        row = np.full(self.L, UNKNOWN_ID, dtype=np.int32)
+        n = min(len(topic), self.L)
+        for i in range(n):
+            row[i] = self.interner.lookup(topic[i])
+        return row, len(topic), bool(topic) and topic[0].startswith("$")
+
+    def resolve(self, slots: Sequence[int]):
+        """Matched slot indices → (filter, key, value) rows."""
+        out = []
+        for s in slots:
+            e = self.entries[s]
+            if e is not None:
+                out.append(e)
+        return out
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "subscriptions": self.count,
+            "capacity": self.cap,
+            "interned_words": len(self.interner),
+            "overflow": len(self.overflow),
+            "table_bytes": int(
+                self.words.nbytes + self.eff_len.nbytes + self.has_hash.nbytes
+                + self.first_wild.nbytes + self.active.nbytes
+            ),
+        }

@@ -1,0 +1,278 @@
+"""Batched wildcard topic matching as dense JAX ops — the TPU replacement
+for the per-publish ETS trie walk (``vmq_reg_trie.erl:358-383``).
+
+Representation (SURVEY.md §7.1 step 4): subscriptions live in HBM as padded
+segment arrays over interned word ids —
+
+- ``sub_words`` int32 [S, L]: word ids, ``PLUS_ID`` for ``+``, ``HASH_ID``
+  for ``#``, ``PAD_ID`` beyond the filter length;
+- ``sub_eff_len`` int32 [S]: number of *concrete* levels (excludes a
+  trailing ``#``);
+- ``has_hash`` bool [S]: filter ends in ``#``;
+- ``first_wild`` bool [S]: level-0 word is a wildcard (for MQTT-4.7.2-1);
+- ``active`` bool [S]: slot liveness (unsubscribed slots stay allocated).
+
+A batch of publishes is matched in one device call: a filter matches iff
+every concrete level equals the publish word or is ``+``, and the length
+constraint holds (``== eff_len`` without ``#``, ``>= eff_len`` with — a
+trailing ``#`` also matches its parent level), and the ``$``-rule holds.
+This is exactly ``vmq_topic.erl:53-66`` + ``vmq_reg_trie.erl:283-288``
+vectorised over [B, S].
+
+The level loop runs as ``lax.fori_loop`` carrying a [B, S] accumulator so
+the [B, S, L] comparison tensor is never materialised; XLA fuses the
+per-level compare+and into one pass over the subscription table (HBM-bound:
+~S*L*4 bytes read per batch). Publish batches are chunked by the caller to
+bound the [B, S] working set.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PAD_ID = 0
+PLUS_ID = 1
+HASH_ID = 2
+FIRST_WORD_ID = 3  # real words intern from here
+
+
+def match_mask(
+    sub_words: jax.Array,  # int32 [S, L]
+    sub_eff_len: jax.Array,  # int32 [S]
+    has_hash: jax.Array,  # bool [S]
+    first_wild: jax.Array,  # bool [S]
+    active: jax.Array,  # bool [S]
+    pub_words: jax.Array,  # int32 [B, L]
+    pub_len: jax.Array,  # int32 [B]
+    pub_dollar: jax.Array,  # bool [B]
+) -> jax.Array:
+    """Boolean match matrix [B, S]."""
+    L = sub_words.shape[1]
+    B = pub_words.shape[0]
+    S = sub_words.shape[0]
+
+    len_ok = jnp.where(
+        has_hash[None, :],
+        pub_len[:, None] >= sub_eff_len[None, :],
+        pub_len[:, None] == sub_eff_len[None, :],
+    )
+    dollar_ok = ~(pub_dollar[:, None] & first_wild[None, :])
+    init = len_ok & dollar_ok & active[None, :]
+
+    def level_body(l, acc):
+        sw = lax.dynamic_index_in_dim(sub_words, l, axis=1, keepdims=False)  # [S]
+        pw = lax.dynamic_index_in_dim(pub_words, l, axis=1, keepdims=False)  # [B]
+        beyond = l >= sub_eff_len  # [S] padded/'#' region always ok
+        ok_l = (sw[None, :] == pw[:, None]) | (sw == PLUS_ID)[None, :] | beyond[None, :]
+        return acc & ok_l
+
+    return lax.fori_loop(0, L, level_body, init)
+
+
+def match_mask_unrolled(
+    sub_words, sub_eff_len, has_hash, first_wild, active,
+    pub_words, pub_len, pub_dollar,
+) -> jax.Array:
+    """match_mask with the level loop statically unrolled — one fused
+    elementwise pass over [B, S] instead of L fori_loop round-trips (XLA
+    cannot fuse across fori_loop iterations; measured ~20% faster and it
+    fuses into downstream reductions)."""
+    L = sub_words.shape[1]
+    len_ok = jnp.where(
+        has_hash[None, :],
+        pub_len[:, None] >= sub_eff_len[None, :],
+        pub_len[:, None] == sub_eff_len[None, :],
+    )
+    acc = len_ok & (~(pub_dollar[:, None] & first_wild[None, :])) & active[None, :]
+    for l in range(L):
+        ok_l = (
+            (sub_words[:, l][None, :] == pub_words[:, l][:, None])
+            | (sub_words[:, l] == PLUS_ID)[None, :]
+            | (l >= sub_eff_len)[None, :]
+        )
+        acc = acc & ok_l
+    return acc
+
+
+def extract_indices(
+    mask: jax.Array, k: int, block: int = 512
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Exact sort-free compaction of a [B, S] boolean mask into the first
+    ``k`` matched indices per row.
+
+    ``lax.top_k`` over [B, 1M] costs seconds on TPU; this is the
+    bandwidth-shaped replacement: per-block match counts → cumulative block
+    offsets → for each output position j, binary-search the block containing
+    the j-th match, gather just that 512-wide block, and locate the match
+    with an intra-block rank compare. O(B·S) streaming + O(B·k·block)
+    gather — no sort anywhere.
+
+    Returns (idx [B,k] int32, valid [B,k] bool, count [B] int32).
+    """
+    B, S = mask.shape
+    nblk = S // block
+    m = mask.reshape(B, nblk, block)
+    blk_cnt = jnp.sum(m, axis=2, dtype=jnp.int32)  # [B, nblk]
+    blk_cum = jnp.cumsum(blk_cnt, axis=1)  # inclusive
+    count = blk_cum[:, -1]
+    targets = jnp.broadcast_to(
+        jnp.arange(k, dtype=jnp.int32)[None, :], (B, k)
+    )  # j-th match per row
+    # block holding the j-th match: first blk with cum > j
+    blk = jax.vmap(lambda c, t: jnp.searchsorted(c, t, side="right"))(
+        blk_cum, targets
+    ).astype(jnp.int32)  # [B, k]
+    blk_c = jnp.minimum(blk, nblk - 1)
+    prev_cum = jnp.where(
+        blk_c > 0,
+        jnp.take_along_axis(blk_cum, jnp.maximum(blk_c - 1, 0), axis=1),
+        0,
+    )
+    offset = targets - prev_cum  # rank of the match within its block
+    gathered = jnp.take_along_axis(
+        m, blk_c[:, :, None], axis=1
+    )  # [B, k, block]
+    wcum = jnp.cumsum(gathered.astype(jnp.int32), axis=2)  # [B, k, block]
+    # position of the (offset+1)-th set bit: #entries with wcum <= offset
+    pos = jnp.sum((wcum <= offset[:, :, None]).astype(jnp.int32), axis=2)
+    idx = blk_c * block + jnp.minimum(pos, block - 1)
+    valid = targets < count[:, None]
+    return idx.astype(jnp.int32), valid, count
+
+
+def compact_topk(mask: jax.Array, k: int) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Compress a [B, S] boolean mask into per-row matched indices.
+
+    Returns ``(idx [B, k] int32, valid [B, k] bool, count [B] int32)``.
+    ``count`` may exceed ``k`` (truncated fanout — callers surface this like
+    the reference surfaces queue drops). Uses ``top_k`` over the 0/1 mask;
+    XLA's top_k is stable, so ties (all the 1s) come back in ascending slot
+    order — matching the deterministic fold order of the trie walk.
+    """
+    k = min(k, mask.shape[1])
+    vals, idx = lax.top_k(mask.astype(jnp.int32), k)
+    valid = vals > 0
+    count = jnp.sum(mask, axis=1, dtype=jnp.int32)
+    return idx.astype(jnp.int32), valid, count
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def match_extract(
+    sub_words: jax.Array,
+    sub_eff_len: jax.Array,
+    has_hash: jax.Array,
+    first_wild: jax.Array,
+    active: jax.Array,
+    pub_words: jax.Array,
+    pub_len: jax.Array,
+    pub_dollar: jax.Array,
+    k: int = 256,
+    chunk: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Production match path: unrolled fused mask + sort-free extraction.
+    Same contract as :func:`match_topk` but ~100x faster at S=1M on TPU."""
+    S = sub_words.shape[0]
+    block = 512 if S % 512 == 0 and S >= 512 else S
+    if chunk and pub_words.shape[0] > chunk:
+        B = pub_words.shape[0]
+        n = B // chunk
+
+        def one(args):
+            pw, pl, pd = args
+            m = match_mask_unrolled(sub_words, sub_eff_len, has_hash,
+                                    first_wild, active, pw, pl, pd)
+            return extract_indices(m, k, block)
+
+        idx, valid, count = lax.map(
+            one,
+            (
+                pub_words.reshape(n, chunk, -1),
+                pub_len.reshape(n, chunk),
+                pub_dollar.reshape(n, chunk),
+            ),
+        )
+        return idx.reshape(B, -1), valid.reshape(B, -1), count.reshape(B)
+    m = match_mask_unrolled(sub_words, sub_eff_len, has_hash, first_wild,
+                            active, pub_words, pub_len, pub_dollar)
+    return extract_indices(m, k, block)
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def match_topk(
+    sub_words: jax.Array,
+    sub_eff_len: jax.Array,
+    has_hash: jax.Array,
+    first_wild: jax.Array,
+    active: jax.Array,
+    pub_words: jax.Array,
+    pub_len: jax.Array,
+    pub_dollar: jax.Array,
+    k: int = 256,
+    chunk: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Full batched match: mask + top-k compaction.
+
+    ``chunk`` > 0 processes the publish batch in chunks of that size via
+    ``lax.map`` to bound the [B, S] working set (keeps HBM pressure constant
+    as B grows); B must then be a multiple of ``chunk``.
+    """
+    if chunk and pub_words.shape[0] > chunk:
+        B = pub_words.shape[0]
+        n = B // chunk
+
+        def one(args):
+            pw, pl, pd = args
+            m = match_mask(sub_words, sub_eff_len, has_hash, first_wild,
+                           active, pw, pl, pd)
+            return compact_topk(m, k)
+
+        idx, valid, count = lax.map(
+            one,
+            (
+                pub_words.reshape(n, chunk, -1),
+                pub_len.reshape(n, chunk),
+                pub_dollar.reshape(n, chunk),
+            ),
+        )
+        return (
+            idx.reshape(B, k),
+            valid.reshape(B, k),
+            count.reshape(B),
+        )
+    mask = match_mask(
+        sub_words, sub_eff_len, has_hash, first_wild, active,
+        pub_words, pub_len, pub_dollar,
+    )
+    return compact_topk(mask, k)
+
+
+@jax.jit
+def apply_delta(
+    sub_words: jax.Array,
+    sub_eff_len: jax.Array,
+    has_hash: jax.Array,
+    first_wild: jax.Array,
+    active: jax.Array,
+    slots: jax.Array,  # int32 [D] target slot per delta row
+    d_words: jax.Array,  # int32 [D, L]
+    d_eff_len: jax.Array,  # int32 [D]
+    d_has_hash: jax.Array,  # bool [D]
+    d_first_wild: jax.Array,  # bool [D]
+    d_active: jax.Array,  # bool [D]
+):
+    """Scatter a delta batch of subscription rows into the device-resident
+    table — the trie-delta stream (BASELINE config 5): subscribe/unsubscribe
+    events accumulate host-side and apply in one scatter instead of
+    re-uploading the table (the analog of vmq_reg_trie consuming
+    subscriber-db change events incrementally)."""
+    sub_words = sub_words.at[slots].set(d_words)
+    sub_eff_len = sub_eff_len.at[slots].set(d_eff_len)
+    has_hash = has_hash.at[slots].set(d_has_hash)
+    first_wild = first_wild.at[slots].set(d_first_wild)
+    active = active.at[slots].set(d_active)
+    return sub_words, sub_eff_len, has_hash, first_wild, active

@@ -1,0 +1,45 @@
+"""Device mesh construction for the sharded match engine.
+
+The scaling axes (SURVEY.md §2.7 #5/#6): ``batch`` is data-parallelism over
+concurrent publishes, ``sub`` is the subscription-table shard (the
+tensor-parallel analog — the reference's per-node trie replica becomes a
+segment-array sharded across chips). Cross-shard combine is XLA collectives
+over ICI; nothing here uses point-to-point messaging.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(devices: Optional[Sequence] = None,
+              batch: Optional[int] = None) -> Mesh:
+    """Build a ('batch', 'sub') mesh over the given devices. With no
+    ``batch`` hint the mesh is 1 x N (all devices shard the subscription
+    table — the right default, since S >> B dominates memory)."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if batch is None:
+        batch = 1
+    assert n % batch == 0, f"{n} devices not divisible by batch={batch}"
+    arr = np.array(devices).reshape(batch, n // batch)
+    return Mesh(arr, ("batch", "sub"))
+
+
+def table_sharding(mesh: Mesh) -> NamedSharding:
+    """Subscription arrays: sharded along S over the 'sub' axis, replicated
+    over 'batch'."""
+    return NamedSharding(mesh, P("sub"))
+
+
+def table_sharding_2d(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("sub", None))
+
+
+def pub_sharding(mesh: Mesh) -> NamedSharding:
+    """Publish batch: sharded along B over the 'batch' axis."""
+    return NamedSharding(mesh, P("batch", None))
