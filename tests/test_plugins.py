@@ -1,0 +1,289 @@
+"""Bundled-plugin tests: ACL file semantics (vmq_acl eunit/SUITE shape),
+passwd-file auth (vmq_passwd), webhooks against a local HTTP endpoint
+fixture (vmq_webhooks_SUITE runs a local cowboy handler the same way)."""
+
+import asyncio
+import base64
+import hashlib
+import json
+
+import pytest
+
+from vernemq_tpu.broker.config import Config
+from vernemq_tpu.broker.server import start_broker
+from vernemq_tpu.client import MQTTClient
+from vernemq_tpu.plugins.acl import AclPlugin
+from vernemq_tpu.plugins.passwd import PasswdPlugin, hash_password, make_entry
+from vernemq_tpu.plugins.webhooks import WebhooksPlugin
+
+# ---------------------------------------------------------------- ACL unit
+
+
+def test_acl_parse_and_check():
+    acl = AclPlugin()
+    acl.load_from_lines([
+        "# comment",
+        "topic read $SYS/#",
+        "topic both/topic",
+        "",
+        "user alice",
+        "topic write alice/out",
+        "topic read alice/in",
+        "",
+        "pattern read devices/%u/%c/+",
+    ])
+    sid = ("", "cl1")
+    # all-user rules apply to everyone, even anonymous
+    assert acl.check("read", ["$SYS", "broker", "uptime"], None, sid)
+    assert acl.check("read", ["both", "topic"], None, sid)
+    assert acl.check("write", ["both", "topic"], None, sid)
+    assert not acl.check("write", ["$SYS", "x"], None, sid)
+    # per-user
+    assert acl.check("write", ["alice", "out"], "alice", sid)
+    assert not acl.check("write", ["alice", "out"], "bob", sid)
+    assert acl.check("read", ["alice", "in"], "alice", sid)
+    assert not acl.check("write", ["alice", "in"], "alice", sid)
+    # pattern substitution %u/%c
+    assert acl.check("read", ["devices", "alice", "cl1", "temp"], "alice", sid)
+    assert not acl.check("read", ["devices", "bob", "cl1", "temp"], "alice", sid)
+    assert not acl.check("write", ["devices", "alice", "cl1", "temp"], "alice", sid)
+
+
+def test_acl_reload_replaces_rules():
+    acl = AclPlugin()
+    acl.load_from_lines(["topic old/topic"])
+    assert acl.check("read", ["old", "topic"], None, ("", "c"))
+    acl.load_from_lines(["topic new/topic"])
+    assert not acl.check("read", ["old", "topic"], None, ("", "c"))
+    assert acl.check("read", ["new", "topic"], None, ("", "c"))
+
+
+# ------------------------------------------------------------- passwd unit
+
+
+def test_passwd_entry_format_and_check():
+    entry = make_entry("alice", "secret", salt=b"0123456789ab")
+    user, rest = entry.split(":", 1)
+    assert user == "alice" and rest.startswith("$6$")
+    # hash must be base64(sha512(password || salt)) (vmq_passwd.erl:167-172)
+    _, six, salt_b64, hash_b64 = rest.split("$")
+    want = base64.b64encode(
+        hashlib.sha512(b"secret" + base64.b64decode(salt_b64)).digest()
+    ).decode()
+    assert hash_b64 == want
+
+    p = PasswdPlugin()
+    p.load_from_lines([entry, make_entry("bob", "hunter2")])
+    assert p.check("alice", "secret") == "ok"
+    assert p.check("alice", b"secret") == "ok"
+    assert p.check("alice", "wrong") == ("error", "invalid_credentials")
+    assert p.check("carol", "x") == "next"  # unknown user falls through
+    assert p.check(None, "x") == "next"
+
+
+# ------------------------------------------------- broker e2e with plugins
+
+
+@pytest.fixture
+def broker(event_loop):
+    b, server = event_loop.run_until_complete(
+        start_broker(
+            Config(systree_enabled=False, allow_anonymous=False), port=0))
+    yield b, server
+    event_loop.run_until_complete(b.stop())
+    event_loop.run_until_complete(server.stop())
+
+
+def addr(broker):
+    _, server = broker
+    return server.host, server.port
+
+
+@pytest.mark.asyncio
+async def test_passwd_auth_e2e(broker, tmp_path):
+    b, _ = broker
+    pw_file = tmp_path / "passwd"
+    pw_file.write_text(make_entry("alice", "secret") + "\n")
+    b.plugins.enable("vmq_passwd", passwd_file=str(pw_file))
+
+    # no credentials + allow_anonymous=off → CONNACK not-authorized
+    c = MQTTClient(*addr(broker), client_id="anon")
+    ack = await c.connect()
+    assert ack.rc == 5
+    # wrong password → bad-credentials rc
+    c = MQTTClient(*addr(broker), client_id="alice1",
+                   username="alice", password=b"wrong")
+    ack = await c.connect()
+    assert ack.rc == 4
+    # good credentials
+    c = MQTTClient(*addr(broker), client_id="alice2",
+                   username="alice", password=b"secret")
+    ack = await c.connect()
+    assert ack.rc == 0
+    await c.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_acl_gates_publish_subscribe(broker, tmp_path):
+    b, _ = broker
+    pw = tmp_path / "passwd"
+    pw.write_text(make_entry("alice", "pw") + "\n")
+    aclf = tmp_path / "acl"
+    aclf.write_text("user alice\ntopic read in/#\ntopic write out/alice\n")
+    b.plugins.enable("vmq_passwd", passwd_file=str(pw))
+    b.plugins.enable("vmq_acl", acl_file=str(aclf))
+
+    c = MQTTClient(*addr(broker), client_id="a", username="alice",
+                   password=b"pw")
+    ack = await c.connect()
+    assert ack.rc == 0
+    suback = await c.subscribe("in/temp", qos=1)
+    assert suback.reason_codes == [1]
+    denied = await c.subscribe("other/topic", qos=1)
+    assert denied.reason_codes == [0x80]
+    # allowed publish is routed back via the in/# subscription? no — publish
+    # to out/alice is allowed but nobody subscribed; just assert no kick.
+    await c.publish("out/alice", b"x", qos=1)
+    # denied publish: v4 silently drops (or disconnects); must NOT be routed
+    sub = MQTTClient(*addr(broker), client_id="s", username="alice",
+                     password=b"pw")
+    await sub.connect()
+    await sub.subscribe("in/#", qos=0)
+    await c.publish("in/evil", b"x", qos=0)  # alice has no write on in/#
+    with pytest.raises(asyncio.TimeoutError):
+        await sub.recv(timeout=0.3)
+    await c.disconnect()
+    await sub.disconnect()
+
+
+# --------------------------------------------------------------- webhooks
+
+
+class HookEndpoint:
+    """Local HTTP fixture standing in for the reference's webhooks_handler
+    cowboy endpoint."""
+
+    def __init__(self, responder):
+        self.responder = responder
+        self.requests = []
+        self.server = None
+        self.port = None
+        self._writers = []
+
+    async def start(self):
+        async def handle(reader, writer):
+            self._writers.append(writer)
+            try:
+                while True:
+                    line = await reader.readline()
+                    if not line:
+                        return
+                    headers = {}
+                    while True:
+                        h = await reader.readline()
+                        if h in (b"\r\n", b"", b"\n"):
+                            break
+                        k, _, v = h.decode().partition(":")
+                        headers[k.strip().lower()] = v.strip()
+                    body = await reader.readexactly(
+                        int(headers.get("content-length", "0")))
+                    self.requests.append(
+                        (headers.get("vernemq-hook"), json.loads(body)))
+                    status, resp_headers, resp = self.responder(
+                        headers.get("vernemq-hook"), json.loads(body))
+                    payload = json.dumps(resp).encode()
+                    head = (f"HTTP/1.1 {status} OK\r\n"
+                            f"Content-Length: {len(payload)}\r\n")
+                    for k, v in resp_headers.items():
+                        head += f"{k}: {v}\r\n"
+                    writer.write(head.encode() + b"\r\n" + payload)
+                    await writer.drain()
+            except (asyncio.IncompleteReadError, ConnectionError):
+                pass
+
+        self.server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        self.port = self.server.sockets[0].getsockname()[1]
+        return self
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}/hook"
+
+    async def stop(self):
+        self.server.close()
+        for w in self._writers:
+            w.close()
+        await self.server.wait_closed()
+
+
+@pytest.mark.asyncio
+async def test_webhooks_auth_and_modifiers(broker):
+    b, _ = broker
+
+    def responder(hook, body):
+        if hook in ("auth_on_register", "auth_on_register_m5"):
+            if body["username"] == "good":
+                return 200, {}, {"result": "ok"}
+            return 200, {}, {"result": {"error": "not_allowed"}}
+        if hook in ("auth_on_publish", "auth_on_publish_m5"):
+            # rewrite the payload (modifier support)
+            return 200, {}, {"result": "ok", "modifiers": {
+                "payload": base64.b64encode(b"rewritten").decode()}}
+        if hook in ("auth_on_subscribe", "auth_on_subscribe_m5"):
+            return 200, {}, {"result": "ok"}
+        return 200, {}, {"result": "next"}
+
+    ep = await HookEndpoint(responder).start()
+    wh: WebhooksPlugin = b.plugins.enable("vmq_webhooks")
+    for hook in ("auth_on_register", "auth_on_publish", "auth_on_subscribe"):
+        wh.register_endpoint(hook, ep.url)
+
+    bad = MQTTClient(*addr(broker), client_id="x", username="bad",
+                     password=b"pw")
+    ack = await bad.connect()
+    assert ack.rc == 5
+
+    good = MQTTClient(*addr(broker), client_id="g", username="good",
+                      password=b"pw")
+    ack = await good.connect()
+    assert ack.rc == 0
+    sub = MQTTClient(*addr(broker), client_id="g2", username="good",
+                     password=b"pw")
+    await sub.connect()
+    await sub.subscribe("t/#", qos=0)
+    await good.publish("t/1", b"original", qos=0)
+    msg = await sub.recv()
+    assert msg.payload == b"rewritten"  # modifier applied on the hot path
+    hooks_seen = [h for h, _ in ep.requests]
+    assert "auth_on_register" in hooks_seen
+    assert "auth_on_publish" in hooks_seen
+    await good.disconnect()
+    await sub.disconnect()
+    b.plugins.disable("vmq_webhooks")  # closes pooled endpoint connections
+    await ep.stop()
+
+
+@pytest.mark.asyncio
+async def test_webhooks_cache(broker):
+    b, _ = broker
+    calls = {"n": 0}
+
+    def responder(hook, body):
+        calls["n"] += 1
+        return 200, {"cache-control": "max-age=60"}, {"result": "ok"}
+
+    ep = await HookEndpoint(responder).start()
+    wh: WebhooksPlugin = b.plugins.enable("vmq_webhooks")
+    wh.register_endpoint("auth_on_register", ep.url)
+
+    for i in range(3):
+        c = MQTTClient(*addr(broker), client_id="same", username="u",
+                       password=b"pw")
+        ack = await c.connect()
+        assert ack.rc == 0
+        await c.disconnect()
+    # same client-id + username + clean_session → one endpoint call, 2 hits
+    assert calls["n"] == 1
+    assert wh.cache.hits == 2
+    b.plugins.disable("vmq_webhooks")
+    await ep.stop()

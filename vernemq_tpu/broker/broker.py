@@ -40,6 +40,9 @@ class Broker:
         self.node_name = node_name
         self.metrics = Metrics()
         self.hooks = HookRegistry()
+        from ..plugins import PluginManager
+
+        self.plugins = PluginManager(self)
         self.retain = RetainStore()
         self.registry = Registry(self)
         if self.config.message_store == "file":
@@ -111,7 +114,12 @@ class Broker:
             )
         except HookError as e:
             if e.reason == "no_matching_hook_found":
-                return {}  # no auth plugin → allow (vmq_plugin default)
+                # no plugin answered: allowed unless default-deny is active
+                # (vmq_auth.erl:3-8 registers deny hooks when
+                # allow_anonymous=off)
+                if self.config.allow_anonymous:
+                    return {}
+                raise HookError("not_authorized") from None
             raise
         if isinstance(res, tuple):
             return res[1]

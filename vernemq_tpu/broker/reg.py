@@ -24,6 +24,7 @@ wired (cluster layer fills them in), with local behavior already faithful.
 
 from __future__ import annotations
 
+import asyncio
 import dataclasses
 import random
 import time
@@ -272,6 +273,26 @@ class Registry:
         msg = self._pre_publish(msg)
         rows = await self.broker.batch_collector().submit(msg.mountpoint, msg.topic)
         return self.route_rows(msg, rows, from_sid)
+
+    def publish_nowait(self, msg: Msg, from_sid: Optional[SubscriberId] = None) -> int:
+        """QoS0 fast path for the batched view: submit to the collector and
+        route when the batch resolves, without blocking the session reader
+        on the batch window (a single publisher would otherwise get exactly
+        one message per window). Retain handling stays synchronous so local
+        read-your-writes ordering holds. Per-publisher delivery order is
+        preserved by collector submission order."""
+        msg = self._pre_publish(msg)
+        fut = self.broker.batch_collector().submit(msg.mountpoint, msg.topic)
+
+        def _done(f: "asyncio.Future") -> None:
+            exc = f.exception()
+            if exc is not None:
+                self.broker.metrics.incr("mqtt_publish_error")
+                return
+            self.route_rows(msg, f.result(), from_sid)
+
+        fut.add_done_callback(_done)
+        return 0
 
     def _pre_publish(self, msg: Msg) -> Msg:
         cfg = self.broker.config

@@ -451,9 +451,13 @@ class Session:
             msg.expires_at = time.monotonic() + expiry
 
         if f.qos == 0:
-            await self._route(msg)
+            await self._route(msg, nowait=True)
         elif f.qos == 1:
             matches = await self._route(msg)
+            if matches < 0:
+                # internal routing failure: withhold the PUBACK so the
+                # client's DUP retry re-routes (same contract as QoS2 below)
+                return
             rc = RC_SUCCESS if matches else RC_NO_MATCHING_SUBSCRIBERS
             ack = Puback(packet_id=f.packet_id)
             if self.proto_ver == PROTO_5 and rc:
@@ -472,14 +476,19 @@ class Session:
             self.send(Pubrec(packet_id=f.packet_id))
             self.broker.metrics.incr("mqtt_pubrec_sent")
 
-    async def _route(self, msg: Msg) -> int:
+    async def _route(self, msg: Msg, nowait: bool = False) -> int:
         """Route via the registry; returns match count, or -1 on an internal
         matcher failure (distinct from the not_ready gate: internal errors
         are logged and, for QoS2, leave the packet eligible for re-route on
-        the client's DUP retry)."""
+        the client's DUP retry). ``nowait`` (QoS0 under the batched view)
+        submits without awaiting the batch window so one publisher can fill
+        a batch instead of sending one message per window."""
         try:
             if self.broker.config.default_reg_view == "tpu":
-                n = await self.broker.registry.publish_async(msg, from_sid=self.sid)
+                if nowait:
+                    n = self.broker.registry.publish_nowait(msg, from_sid=self.sid)
+                else:
+                    n = await self.broker.registry.publish_async(msg, from_sid=self.sid)
             else:
                 n = self.broker.registry.publish(msg, from_sid=self.sid)
         except RuntimeError as e:
@@ -658,7 +667,10 @@ class Session:
                         new_codes.append(qos)
                 topics, codes = new_topics, new_codes
         except HookError as e:
-            if e.reason != "no_matching_hook_found":
+            # no plugin answered → allowed only without default-deny
+            # (vmq_auth.erl:3-8 registers deny hooks when allow_anonymous=off)
+            if (e.reason != "no_matching_hook_found"
+                    or not self.broker.config.allow_anonymous):
                 self.broker.metrics.incr("mqtt_subscribe_auth_error")
                 fail = 0x80 if self.proto_ver != PROTO_5 else 0x87
                 self.send(Suback(packet_id=f.packet_id,

@@ -74,8 +74,13 @@ class TpuMatcher:
             return
         slots = np.fromiter(t.dirty, dtype=np.int32)
         t.dirty.clear()
+        # copy-on-write: in-flight match_batch calls hold a reference to the
+        # previous snapshot list; mutating it in place would let a slot
+        # freed+reused mid-call misroute to the new subscriber
+        snap = list(self._entries_snapshot)
         for s in slots:
-            self._entries_snapshot[s] = t.entries[s]
+            snap[s] = t.entries[s]
+        self._entries_snapshot = snap
         sw, el, hh, fw, ac = self._dev_arrays
         self._dev_arrays = K.apply_delta(
             sw, el, hh, fw, ac,

@@ -79,12 +79,16 @@ class SubscriptionTable:
     def add(self, filter_words: Sequence[str], key: Hashable, value: Any = None) -> None:
         fw = tuple(filter_words)
         if len(fw) > self.L:
+            before = len(self.overflow)
             self.overflow.add(list(fw), key, value)
-            self.count += 1
+            self.count += len(self.overflow) - before  # re-subscribe: no drift
             return
         existing = self._slot_of.get((fw, key))
         if existing is not None:
+            # re-subscribe with changed opts: device row is unchanged, but
+            # consumers snapshotting entries by dirty slot must see the update
             self.entries[existing] = (fw, key, value)
+            self.dirty.add(existing)
             return
         if not self._free:
             self._grow()

@@ -221,6 +221,9 @@ def match_topk(
     ``lax.map`` to bound the [B, S] working set (keeps HBM pressure constant
     as B grows); B must then be a multiple of ``chunk``.
     """
+    # compact_topk clamps to the table size — do it here too so the chunked
+    # reshape below agrees with the per-chunk result width
+    k = min(k, sub_words.shape[0])
     if chunk and pub_words.shape[0] > chunk:
         B = pub_words.shape[0]
         n = B // chunk

@@ -1,0 +1,61 @@
+"""Bundled plugins + the plugin manager.
+
+The reference's plugin manager (``vmq_plugin_mgr.erl``) tracks enabled
+app-/module-plugins, persists that set, and rebuilds the dispatch module.
+Here the dispatch lives in ``HookRegistry``; the manager tracks enabled
+plugin instances by name and drives register/unregister — the surface
+behind ``vmq-admin plugin enable/disable/show``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class PluginManager:
+    BUNDLED = ("vmq_acl", "vmq_passwd", "vmq_webhooks", "vmq_bridge")
+
+    def __init__(self, broker):
+        self.broker = broker
+        self._enabled: Dict[str, Any] = {}
+
+    def enable(self, name: str, **opts) -> Any:
+        """Instantiate + register a bundled plugin
+        (vmq_plugin_mgr:enable_plugin)."""
+        if name in self._enabled:
+            raise ValueError(f"plugin {name} already enabled")
+        if name == "vmq_acl":
+            from .acl import AclPlugin
+
+            plugin = AclPlugin(acl_file=opts.get("acl_file"))
+        elif name == "vmq_passwd":
+            from .passwd import PasswdPlugin
+
+            plugin = PasswdPlugin(passwd_file=opts.get("passwd_file"))
+        elif name == "vmq_webhooks":
+            from .webhooks import WebhooksPlugin
+
+            plugin = WebhooksPlugin(self.broker)
+        elif name == "vmq_bridge":
+            try:
+                from .bridge import BridgePlugin
+            except ImportError as e:
+                raise ValueError(f"plugin {name} unavailable: {e}") from None
+            plugin = BridgePlugin(self.broker, **opts)
+        else:
+            raise ValueError(f"unknown plugin {name!r}")
+        plugin.register(self.broker.hooks)
+        self._enabled[name] = plugin
+        return plugin
+
+    def disable(self, name: str) -> None:
+        plugin = self._enabled.pop(name, None)
+        if plugin is None:
+            raise ValueError(f"plugin {name} not enabled")
+        plugin.unregister(self.broker.hooks)
+
+    def get(self, name: str) -> Optional[Any]:
+        return self._enabled.get(name)
+
+    def show(self) -> List[Tuple[str, str]]:
+        return [(name, type(p).__module__) for name, p in self._enabled.items()]
