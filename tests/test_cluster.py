@@ -1,0 +1,333 @@
+"""Multi-node cluster tests: several in-process brokers on localhost,
+joined over the real framed TCP channel — the shape of the reference's
+ct_slave multi-node suites (vmq_cluster_SUITE: cross-node pub/sub, remote
+enqueue, migration; vmq_cluster_netsplit_SUITE: CAP-flag behavior during
+partitions induced by severing the inter-node socket)."""
+
+import asyncio
+
+import pytest
+
+from vernemq_tpu.broker.config import Config
+from vernemq_tpu.broker.server import start_broker
+from vernemq_tpu.client import MQTTClient
+from vernemq_tpu.cluster import Cluster
+from vernemq_tpu.cluster.codec import decode, encode
+
+
+# ------------------------------------------------------------------- codec
+
+
+def test_codec_roundtrip():
+    cases = [
+        None, True, False, 0, -1, 1 << 62, -(1 << 62), 1 << 80, 3.14, "",
+        "täxt", b"\x00\xff", [], [1, "a", None], (1, 2), {"k": [1, (2, 3)]},
+        {("mp", "client"): {"qos": 1}},
+        {"nested": {"deep": [{"x": b"bytes"}, ("t", 0.5)]}},
+    ]
+    for obj in cases:
+        assert decode(encode(obj)) == obj
+    # tuple/list distinction survives
+    assert isinstance(decode(encode((1, 2))), tuple)
+    assert isinstance(decode(encode([1, 2])), list)
+
+
+def test_codec_rejects_garbage():
+    with pytest.raises(ValueError):
+        decode(b"\xfe\x01\x02")
+    with pytest.raises(ValueError):
+        decode(encode([1, 2]) + b"junk")
+    with pytest.raises(TypeError):
+        encode(object())
+
+
+# ---------------------------------------------------------------- fixtures
+
+
+async def wait_until(pred, timeout=5.0, interval=0.02):
+    """Poll helper (vmq_cluster_test_utils wait_until)."""
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        if pred():
+            return True
+        await asyncio.sleep(interval)
+    raise AssertionError(f"wait_until timed out: {pred}")
+
+
+class Node:
+    def __init__(self, broker, server, cluster):
+        self.broker = broker
+        self.server = server
+        self.cluster = cluster
+
+    @property
+    def addr(self):
+        return self.server.host, self.server.port
+
+
+async def start_node(name, **cfg):
+    config = Config(systree_enabled=False, **cfg)
+    broker, server = await start_broker(config, port=0)
+    broker.node_name = name
+    broker.metadata.node_name = name
+    broker.registry.node_name = name
+    broker.registry.db.node_name = name
+    cluster = Cluster(broker, "127.0.0.1", 0)
+    await cluster.start()
+    return Node(broker, server, cluster)
+
+
+async def make_cluster(n, **cfg):
+    nodes = [await start_node(f"node{i}", **cfg) for i in range(n)]
+    seed = nodes[0]
+    for node in nodes[1:]:
+        node.cluster.join(seed.cluster.listen_host, seed.cluster.listen_port)
+    for node in nodes:
+        await wait_until(lambda node=node: (
+            len(node.cluster.members()) == n and node.cluster.is_ready()))
+    return nodes
+
+
+async def stop_cluster(nodes):
+    for node in nodes:
+        await node.cluster.stop()
+        await node.broker.stop()
+        await node.server.stop()
+
+
+def partition(a: Node, b: Node):
+    """Sever both directions of the a<->b channel and hold it down
+    (the reference's cookie-change partition, vmq_cluster_test_utils.erl:
+    177-184)."""
+    for x, y in ((a, b), (b, a)):
+        w = x.cluster._writers.get(y.broker.node_name)
+        assert w is not None
+        w._real_addr = w.addr
+        w.addr = ("127.0.0.1", 9)  # discard port: connect refused
+        if w._writer is not None:
+            w._writer.close()
+
+
+def heal(a: Node, b: Node):
+    for x, y in ((a, b), (b, a)):
+        w = x.cluster._writers.get(y.broker.node_name)
+        w.addr = w._real_addr
+
+
+async def connected(node: Node, client_id, **kw):
+    c = MQTTClient(*node.addr, client_id=client_id, **kw)
+    ack = await c.connect()
+    assert ack.rc == 0, ack
+    return c
+
+
+# ------------------------------------------------------------------- tests
+
+
+@pytest.mark.asyncio
+async def test_join_forms_full_mesh():
+    nodes = await make_cluster(3)
+    try:
+        for node in nodes:
+            assert node.cluster.members() == ["node0", "node1", "node2"]
+            assert node.cluster.is_ready()
+            status = dict(node.cluster.status())
+            assert all(status.values())
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_cross_node_pubsub():
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        sub = await connected(b, "sub1")
+        await sub.subscribe("t/+", qos=1)
+        # subscription must replicate into node a's trie as a node pointer
+        await wait_until(
+            lambda: len(a.broker.registry.trie("").match(["t", "x"])) == 1)
+        pub = await connected(a, "pub1")
+        await pub.publish("t/x", b"cross", qos=1)
+        msg = await sub.recv()
+        assert msg.topic == "t/x" and msg.payload == b"cross" and msg.qos == 1
+        await sub.disconnect()
+        await pub.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_no_duplicate_across_nodes():
+    """A subscriber on the publisher's own node and one on a remote node
+    each get exactly one copy (one 'msg' frame per remote node,
+    vmq_reg.erl:346-353)."""
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        sub_local = await connected(a, "sl")
+        sub_remote = await connected(b, "sr")
+        await sub_local.subscribe("d/#", qos=0)
+        await sub_remote.subscribe("d/#", qos=0)
+        await wait_until(
+            lambda: len(a.broker.registry.trie("").match(["d", "x"])) == 2)
+        pub = await connected(a, "pb")
+        await pub.publish("d/x", b"one", qos=0)
+        m1 = await sub_local.recv()
+        m2 = await sub_remote.recv()
+        assert m1.payload == m2.payload == b"one"
+        with pytest.raises(asyncio.TimeoutError):
+            await sub_remote.recv(timeout=0.3)
+        with pytest.raises(asyncio.TimeoutError):
+            await sub_local.recv(timeout=0.3)
+        for c in (sub_local, sub_remote, pub):
+            await c.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_retain_replicates():
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        pub = await connected(a, "rp")
+        await pub.publish("state/x", b"kept", qos=1, retain=True)
+        await wait_until(lambda: len(b.broker.retain) == 1)
+        sub = await connected(b, "rs")
+        await sub.subscribe("state/#", qos=0)
+        msg = await sub.recv()
+        assert msg.payload == b"kept" and msg.retain is True
+        await pub.disconnect()
+        await sub.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_shared_subscription_cross_node():
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        local = await connected(a, "m-local")
+        remote = await connected(b, "m-remote")
+        await local.subscribe("$share/grp/work/#", qos=0)
+        await remote.subscribe("$share/grp/work/#", qos=0)
+        await wait_until(
+            lambda: len(a.broker.registry.trie("").match(["work", "1"])) == 2)
+        pub = await connected(a, "sp")
+        # prefer_local: the member on the publisher's node gets every message
+        for i in range(5):
+            await pub.publish("work/1", b"j%d" % i, qos=0)
+        for i in range(5):
+            msg = await local.recv()
+            assert msg.payload == b"j%d" % i
+        with pytest.raises(asyncio.TimeoutError):
+            await remote.recv(timeout=0.3)
+        # local member leaves -> remote member takes over via remote enqueue
+        await local.disconnect()
+        await wait_until(
+            lambda: len(a.broker.registry.trie("").match(["work", "1"])) == 1)
+        await pub.publish("work/2", b"failover", qos=0)
+        msg = await remote.recv()
+        assert msg.payload == b"failover"
+        await remote.disconnect()
+        await pub.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_netsplit_gates_publish_and_detection():
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        c = await connected(a, "np")
+        partition(a, b)
+        await wait_until(lambda: not a.cluster.is_ready())
+        # allow_publish_during_netsplit=False: QoS1 publish gets no PUBACK
+        # (client would retry; reference returns {error, not_ready})
+        with pytest.raises(asyncio.TimeoutError):
+            await c.publish("x/y", b"blocked", qos=1, timeout=0.5)
+        detected, resolved = a.cluster.netsplit_statistics()
+        assert detected >= 1
+        heal(a, b)
+        await wait_until(lambda: a.cluster.is_ready(), timeout=10)
+        _, resolved = a.cluster.netsplit_statistics()
+        assert resolved >= 1
+        ack = await c.publish("x/y", b"flows-again", qos=1)
+        assert ack is not None
+        await c.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_netsplit_allow_flags():
+    nodes = await make_cluster(
+        2, allow_publish_during_netsplit=True,
+        allow_subscribe_during_netsplit=True,
+        allow_register_during_netsplit=True)
+    try:
+        a, b = nodes
+        partition(a, b)
+        await wait_until(lambda: not a.cluster.is_ready())
+        c = await connected(a, "caps")  # register allowed during split
+        await c.subscribe("s/#", qos=1)  # subscribe allowed
+        ack = await c.publish("s/1", b"av", qos=1)  # publish allowed
+        assert ack is not None
+        msg = await c.recv()
+        assert msg.payload == b"av"
+        heal(a, b)
+        await c.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_queue_migration_on_reconnect():
+    """Persistent session moves nodes: offline messages drain to the new
+    owner over the acked enq channel (vmq_cluster_SUITE migration case +
+    vmq_reg remap, vmq_reg.erl:676-699)."""
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        c1 = await connected(a, "mig", clean_start=False)
+        await c1.subscribe("m/#", qos=1)
+        await c1.disconnect()
+        # queue now offline on node a; publish into it from node b
+        pub = await connected(b, "mig-pub")
+        for i in range(3):
+            await pub.publish("m/%d" % i, b"off%d" % i, qos=1)
+        await wait_until(
+            lambda: (q := a.broker.registry.queues.get(("", "mig"))) is not None
+            and len(q.offline) == 3)
+        # reconnect on node b: remap + drain
+        c2 = await connected(b, "mig", clean_start=False)
+        assert c2.connack.session_present is True
+        got = sorted([(await c2.recv()).payload for _ in range(3)])
+        assert got == [b"off0", b"off1", b"off2"]
+        # old owner dropped its queue; new owner has it
+        await wait_until(
+            lambda: ("", "mig") not in a.broker.registry.queues)
+        assert ("", "mig") in b.broker.registry.queues
+        rec = b.broker.registry.db.read(("", "mig"))
+        assert rec is not None and rec.node == "node1"
+        await c2.disconnect()
+        await pub.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_cluster_leave():
+    nodes = await make_cluster(3)
+    try:
+        a, b, c = nodes
+        a.cluster.leave("node2")
+        await wait_until(lambda: all(
+            n.cluster.members() == ["node0", "node1"] for n in (a, b)))
+        assert a.cluster.is_ready()
+    finally:
+        await stop_cluster(nodes)

@@ -43,7 +43,14 @@ class Broker:
         from ..plugins import PluginManager
 
         self.plugins = PluginManager(self)
-        self.retain = RetainStore()
+        # replicated metadata store (vmq_metadata facade); standalone it is a
+        # local LWW store, the cluster layer wires broadcast + anti-entropy
+        from ..cluster.metadata import MetadataStore
+
+        self.metadata = MetadataStore(node_name)
+        self.cluster: Optional[Any] = None  # set by cluster.Cluster
+        self.retain = RetainStore(on_dirty=self._retain_dirty)
+        self.metadata.subscribe("retain", self._on_retain_event)
         self.registry = Registry(self)
         if self.config.message_store == "file":
             self.msg_store: MsgStore = FileMsgStore(self.config.message_store_dir)
@@ -76,9 +83,90 @@ class Broker:
         return out
 
     def cluster_ready(self) -> bool:
-        """is_ready consistency gate (vmq_cluster.erl:67-92); the cluster
-        layer flips this on membership events."""
+        """is_ready consistency gate (vmq_cluster.erl:67-92)."""
+        if self.cluster is not None:
+            return self.cluster.is_ready()
         return self._cluster_ready
+
+    # ------------------------------------------------- retain replication
+
+    def _retain_dirty(self, mountpoint: str, topic, value) -> None:
+        """Write-behind from the retain cache into the replicated metadata
+        store (vmq_retain_srv.erl:186-191 persist + broadcast)."""
+        term = None
+        if value is not None:
+            term = {"payload": value.payload, "props": value.properties,
+                    "qos": value.qos, "exp": value.expiry_ts}
+        self.metadata.put("retain", (mountpoint,) + tuple(topic), term)
+
+    def _on_retain_event(self, key, old, new, origin) -> None:
+        from .reg import RetainedMsg
+
+        if origin == self.node_name:
+            return  # local writes already applied write-through
+        mountpoint, topic = key[0], tuple(key[1:])
+        value = None
+        if new is not None:
+            value = RetainedMsg(new["payload"], dict(new.get("props") or {}),
+                                new.get("qos", 0), new.get("exp"))
+        self.retain.apply_remote(mountpoint, topic, value)
+
+    # -------------------------------------------------- queue migration
+
+    def on_subscriber_moved(self, sid: SubscriberId, new_node: str) -> None:
+        """A persistent subscriber's record now points at another node:
+        hand off our queue — close any live session (cross-node takeover),
+        drain the offline backlog over the acked cluster channel, drop
+        local state (vmq_reg_mgr.erl:155-243 + vmq_queue migrate/drain)."""
+        queue = self.registry.queues.get(sid)
+        if queue is None:
+            return
+        task = asyncio.get_event_loop().create_task(
+            self._migrate_queue(sid, queue, new_node))
+        self._bg_tasks.append(task)
+
+    async def _migrate_queue(self, sid: SubscriberId, queue, new_node: str) -> None:
+        session = self.sessions.get(sid)
+        if session is not None:
+            await session.takeover_close()
+        backlog = queue.start_drain()
+        step = self.config.max_msgs_per_drain_step
+        while True:
+            sent = 0
+            ok = self.cluster is not None
+            if backlog and ok:
+                for i in range(0, len(backlog), step):
+                    try:
+                        ok = await self.cluster.remote_enqueue(
+                            new_node, sid, backlog[i:i + step])
+                    except (ConnectionError, asyncio.TimeoutError):
+                        ok = False
+                    if not ok:
+                        break
+                    sent = i + step
+            if ok:
+                self.delete_offline(sid)
+                self.metrics.incr("queue_migrated")
+                # clean_session stays False: queue_terminated must NOT delete
+                # the subscriber record — the new owner just rewrote it
+                queue.terminate("migrated")
+                return
+            # drain failed mid-way: keep the unsent tail (an unacked chunk
+            # may have landed — at-least-once, like any QoS1 redelivery) and
+            # retry while the record still points away (block_until_migrated
+            # retry loop, vmq_reg.erl:225-244)
+            backlog = backlog[sent:]
+            log.warning("queue drain %s -> %s failed, %d msgs pending retry",
+                        sid, new_node, len(backlog))
+            await asyncio.sleep(1.0)
+            rec = self.registry.db.read(sid)
+            if rec is None or rec.node == self.node_name:
+                # moved back / cleaned up: restore what's left locally
+                from .queue import OFFLINE
+
+                queue.offline.extend(backlog)
+                queue.state = OFFLINE
+                return
 
     def hooks_fire_all(self, name: str, *args: Any) -> None:
         """Fire-and-forget lifecycle hooks (on_register/on_publish/...).

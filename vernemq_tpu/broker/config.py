@@ -39,6 +39,9 @@ DEFAULTS: Dict[str, Any] = {
     "allow_unsubscribe_during_netsplit": False,
     # shared subscriptions (vmq_shared_subscriptions.erl:90-106)
     "shared_subscription_policy": "prefer_local",  # prefer_local|local_only|random
+    # cluster (vmq_cluster_node.erl buffering; vmq_queue drain batching)
+    "outgoing_clustering_buffer_size": 10_000_000,  # bytes
+    "max_msgs_per_drain_step": 100,
     # v5
     "topic_alias_max_client": 0,
     "topic_alias_max_broker": 0,

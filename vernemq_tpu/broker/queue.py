@@ -120,6 +120,17 @@ class SubscriberQueue:
             self.broker.hooks_fire_all("on_client_offline", self.subscriber_id)
             self._arm_expiry()
 
+    def start_drain(self) -> List[Msg]:
+        """Enter the drain state and hand the offline backlog to the
+        migration driver (vmq_queue drain state, vmq_queue.erl:338-400).
+        New enqueues during drain are dropped with accounting."""
+        self.state = DRAIN
+        self._cancel_expiry()
+        backlog = [m for m in self.offline
+                   if m.expires_at is None or m.expires_at >= time.monotonic()]
+        self.offline.clear()
+        return backlog
+
     def terminate(self, reason: str) -> None:
         if self.state == TERMINATED:
             return

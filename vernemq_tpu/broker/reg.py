@@ -35,6 +35,7 @@ from ..protocol.topic import is_shared, unshare
 from ..protocol.types import SubOpts
 from .message import Msg, SubscriberId
 from .queue import OFFLINE, ONLINE, QueueOpts, SubscriberQueue
+from .subscriber_db import SubscriberDB, SubscriberRecord, opts_to_dict
 
 if TYPE_CHECKING:
     from .broker import Broker
@@ -71,15 +72,29 @@ class TrieRegView:
 class Registry:
     def __init__(self, broker: "Broker"):
         self.broker = broker
+        self.node_name = broker.node_name
         self._tries: Dict[str, SubscriptionTrie] = {}  # per-mountpoint
-        # subscriber DB: sid -> {filter_words_tuple: SubOpts}
-        # (vmq_subscriber_db over metadata; local dict in round 1)
-        self.subscriptions: Dict[SubscriberId, Dict[Tuple[str, ...], SubOpts]] = {}
+        # subscriber DB over the replicated metadata store
+        # (vmq_subscriber_db.erl); the trie is maintained purely from its
+        # change events — local writes fire them synchronously
+        # (read-your-writes), remote writes arrive via metadata replication
+        # (vmq_reg_trie.erl:198-210 event consumption)
+        self.db = SubscriberDB(broker.metadata, broker.node_name)
+        self.db.subscribe_db_events(self._on_subs_event)
         self.queues: Dict[SubscriberId, SubscriberQueue] = {}
         self.reg_views: Dict[str, Any] = {"trie": TrieRegView(self)}
-        # remote-node fanout hook, filled by the cluster layer:
-        # fn(node, msg) -> None (vmq_cluster:publish/2)
-        self.remote_publish = None
+        # remote plain subscriptions collapse to one node-pointer trie row
+        # per (mountpoint, filter, node), refcounted
+        # (vmq_reg_trie.erl:503-520 remote-subs handling)
+        self._remote_refs: Dict[Tuple[str, Tuple[str, ...], str], int] = {}
+        # remote-node fanout hooks, filled by the cluster layer:
+        self.remote_publish = None  # fn(node, msg) (vmq_cluster:publish/2)
+        self.remote_enqueue_nowait = None  # fn(node, sid, [msg]) shared subs
+
+    @property
+    def subscriptions(self) -> Dict[SubscriberId, Dict[Tuple[str, ...], SubOpts]]:
+        """Local-view of the subscriber DB (introspection/back-compat)."""
+        return {sid: rec.subs for sid, rec in self.db.fold()}
 
     def trie(self, mountpoint: str = "") -> SubscriptionTrie:
         t = self._tries.get(mountpoint)
@@ -108,14 +123,27 @@ class Registry:
         """Create/reuse the subscriber queue; returns (queue,
         session_present) (vmq_reg:register_subscriber, vmq_reg.erl:107-140).
         Session takeover of live sessions is handled by the session layer
-        before calling this."""
+        before calling this. A persistent subscriber whose record points at
+        another node is remapped here (maybe_remap_subscriber,
+        vmq_reg.erl:676-699) — the node change event triggers queue
+        migration on the old owner."""
+        cfg = self.broker.config
+        if not self.broker.cluster_ready() and not cfg.allow_register_during_netsplit:
+            raise RuntimeError("not_ready")
         existing = self.queues.get(sid)
+        rec = self.db.read(sid)
         if clean_start:
-            if existing is not None:
+            if existing is not None or rec is not None:
                 self.cleanup_subscriber(sid)
             queue = self._start_queue(sid, queue_opts)
             return queue, False
-        session_present = existing is not None or sid in self.subscriptions
+        session_present = existing is not None or rec is not None
+        if rec is not None and rec.node != self.node_name:
+            # remap: rewrite the record to this node; every node's trie
+            # re-points, the old owner starts draining its queue to us
+            rec.node = self.node_name
+            rec.clean_session = queue_opts.clean_session
+            self.db.store(sid, rec)
         if existing is not None:
             existing.opts = queue_opts
             return existing, session_present
@@ -138,31 +166,102 @@ class Registry:
         clean sessions."""
         q = self.queues.pop(sid, None)
         if q is not None and q.opts.clean_session:
-            self._remove_all_subscriptions(sid)
+            rec = self.db.read(sid)
+            if rec is not None:
+                self.db.delete(sid)
 
     def cleanup_subscriber(self, sid: SubscriberId) -> None:
         """Full cleanup: subscriptions + queue + offline storage
         (vmq_reg cleanup via vmq_reg_sync, and client_expired path)."""
-        self._remove_all_subscriptions(sid)
+        if self.db.read(sid) is not None:
+            self.db.delete(sid)
         q = self.queues.pop(sid, None)
         if q is not None:
             q.opts.clean_session = True  # prevent re-offline
             q.terminate("cleanup")
         self.broker.delete_offline(sid)
 
-    def _remove_all_subscriptions(self, sid: SubscriberId) -> None:
-        subs = self.subscriptions.pop(sid, None)
-        if not subs:
-            return
-        trie = self.trie(sid[0])
-        for filter_words in subs:
-            group, rest = unshare(list(filter_words))
-            if group is None:
-                trie.remove(filter_words, sid)
-                self._emit_delta("remove", sid[0], filter_words, sid, None)
+    # -- subscriber-db change events → trie (vmq_reg_trie event consumer) --
+
+    def _on_subs_event(self, sid: SubscriberId, old, new) -> None:
+        """Apply a subscriber-record change to this node's routing state:
+        the diff of old vs new subscriptions (vmq_subscriber:get_changes,
+        vmq_subscriber.erl:54-58) becomes trie/TPU-table deltas. Local
+        subscribers become direct rows; remote plain subscriptions collapse
+        into per-node pointer rows; shared-subscription rows keep the full
+        (group, sid) identity with the owning node in the opts
+        (the reference trie's {Node, Group, SubscriberId, SubInfo} rows)."""
+        mountpoint = sid[0]
+        old_subs = old.subs if old is not None else {}
+        new_subs = new.subs if new is not None else {}
+        old_node = old.node if old is not None else None
+        new_node = new.node if new is not None else None
+        for fw, opts in old_subs.items():
+            if fw not in new_subs or new_node != old_node:
+                self._trie_remove(mountpoint, fw, sid, old_node)
+        for fw, opts in new_subs.items():
+            prev = old_subs.get(fw)
+            if prev is None or old_node != new_node:
+                self._trie_add(mountpoint, fw, sid, new_node, opts)
+            elif opts_to_dict(prev) != opts_to_dict(opts):
+                # opts-only change: local/group rows carry opts and must be
+                # replaced; remote pointer rows don't (and must not have
+                # their refcount bumped)
+                group, _ = unshare(list(fw))
+                if group is not None or new_node == self.node_name:
+                    self._trie_add(mountpoint, fw, sid, new_node, opts)
+        # a remote node took over a persistent subscriber we hold a queue
+        # for → queue migration trigger (vmq_reg_mgr.erl:155-243, task:
+        # drain handled by the migration protocol)
+        if (new is not None and new_node != self.node_name
+                and sid in self.queues and old_node == self.node_name):
+            self.broker.on_subscriber_moved(sid, new_node)
+
+    def _trie_add(self, mountpoint: str, fw: Tuple[str, ...],
+                  sid: SubscriberId, node: str, opts: SubOpts) -> None:
+        trie = self.trie(mountpoint)
+        opts.node = node  # locality for shared-sub policy + introspection
+        group, rest = unshare(list(fw))
+        if group is not None:
+            key = ("$g", group, sid)
+            trie.add(rest, key, opts)
+            self._emit_delta("add", mountpoint, rest, key, opts)
+        elif node == self.node_name:
+            trie.add(list(fw), sid, opts)
+            self._emit_delta("add", mountpoint, list(fw), sid, opts)
+        else:
+            ref = (mountpoint, fw, node)
+            n = self._remote_refs.get(ref, 0)
+            self._remote_refs[ref] = n + 1
+            if n == 0:
+                trie.add(list(fw), node, None)
+                self._emit_delta("add", mountpoint, list(fw), node, None)
+
+    def _trie_remove(self, mountpoint: str, fw: Tuple[str, ...],
+                     sid: SubscriberId, node: str) -> None:
+        trie = self.trie(mountpoint)
+        group, rest = unshare(list(fw))
+        if group is not None:
+            key = ("$g", group, sid)
+            trie.remove(rest, key)
+            self._emit_delta("remove", mountpoint, rest, key, None)
+        elif node == self.node_name:
+            trie.remove(list(fw), sid)
+            self._emit_delta("remove", mountpoint, list(fw), sid, None)
+        else:
+            ref = (mountpoint, fw, node)
+            n = self._remote_refs.get(ref, 0) - 1
+            if n <= 0:
+                self._remote_refs.pop(ref, None)
+                trie.remove(list(fw), node)
+                self._emit_delta("remove", mountpoint, list(fw), node, None)
             else:
-                trie.remove(rest, ("$g", group, sid))
-                self._emit_delta("remove", sid[0], rest, ("$g", group, sid), None)
+                self._remote_refs[ref] = n
+
+    def node_left(self, node: str) -> None:
+        """A member left: its subscriber records are rewritten by migration
+        (task of the leave path); nothing to do eagerly here — CAP flags
+        gate routing while the cluster is inconsistent."""
 
     # -- subscribe / unsubscribe ------------------------------------------
 
@@ -171,26 +270,27 @@ class Registry:
     ) -> List[int]:
         """Add subscriptions; returns granted qos per topic
         (vmq_reg:subscribe → subscribe_op, vmq_reg.erl:62-99,636-653)."""
-        mountpoint = sid[0]
-        trie = self.trie(mountpoint)
-        subs = self.subscriptions.setdefault(sid, {})
+        cfg = self.broker.config
+        if not self.broker.cluster_ready() and not cfg.allow_subscribe_during_netsplit:
+            raise RuntimeError("not_ready")
+        rec = self.db.read(sid)
+        if rec is None:
+            q = self.queues.get(sid)
+            clean = q.opts.clean_session if q is not None else True
+            rec = SubscriberRecord(self.node_name, clean)
+        rec.node = self.node_name
+        existed_before = {tuple(w) for w, _ in topics if tuple(w) in rec.subs}
         granted = []
         for words, opts in topics:
-            key = tuple(words)
-            existed = key in subs
-            subs[key] = opts
-            group, rest = unshare(list(words))
-            if group is None:
-                trie.add(words, sid, opts)
-                self._emit_delta("add", sid[0], words, sid, opts)
-            else:
-                trie.add(rest, ("$g", group, sid), opts)
-                self._emit_delta("add", sid[0], rest, ("$g", group, sid), opts)
+            rec.subs[tuple(words)] = opts
             granted.append(opts.qos)
+        self.db.store(sid, rec)  # events update the trie synchronously
+        for words, opts in topics:
+            group, _ = unshare(list(words))
             # retained replay (vmq_reg.erl:380-418); none for shared subs
             # (MQTT5: retained messages are not sent to shared subscriptions)
             if group is None and opts.retain_handling != 2:
-                if not (opts.retain_handling == 1 and existed):
+                if not (opts.retain_handling == 1 and tuple(words) in existed_before):
                     self._deliver_retained(sid, words, opts)
         return granted
 
@@ -203,23 +303,21 @@ class Registry:
             view.on_delta(op, mountpoint, filter_words, key, opts)
 
     def unsubscribe(self, sid: SubscriberId, topics: List[List[str]]) -> List[bool]:
-        mountpoint = sid[0]
-        trie = self.trie(mountpoint)
-        subs = self.subscriptions.get(sid, {})
+        cfg = self.broker.config
+        if not self.broker.cluster_ready() and not cfg.allow_unsubscribe_during_netsplit:
+            raise RuntimeError("not_ready")
+        rec = self.db.read(sid)
         results = []
+        if rec is None:
+            return [False] * len(topics)
         for words in topics:
-            key = tuple(words)
-            existed = subs.pop(key, None) is not None
-            group, rest = unshare(list(words))
-            if group is None:
-                trie.remove(words, sid)
-                self._emit_delta("remove", mountpoint, words, sid, None)
-            else:
-                trie.remove(rest, ("$g", group, sid))
-                self._emit_delta("remove", mountpoint, rest, ("$g", group, sid), None)
-            results.append(existed)
-        if not subs:
-            self.subscriptions.pop(sid, None)
+            results.append(rec.subs.pop(tuple(words), None) is not None)
+        if rec.subs:
+            self.db.store(sid, rec)
+        elif self.queues.get(sid) is None or rec.clean_session:
+            self.db.delete(sid)
+        else:
+            self.db.store(sid, rec)  # persistent session keeps its record
         return results
 
     def _deliver_retained(self, sid: SubscriberId, filter_words: List[str], opts: SubOpts) -> None:
@@ -321,21 +419,33 @@ class Registry:
         msg: Msg,
         rows: Iterable[Tuple[Tuple[str, ...], Any, SubOpts]],
         from_sid: Optional[SubscriberId],
+        origin_local: bool = True,
     ) -> int:
         """The fold body (vmq_reg:publish/3 fold fun, vmq_reg.erl:326-353):
         local rows enqueue, shared rows collect into groups, node rows
-        forward. Shared groups then go through policy selection."""
+        forward. Shared groups then go through policy selection.
+        ``origin_local=False`` (publish arriving over the cluster channel)
+        serves local plain rows only — node and group rows were already
+        covered by the origin node (vmq_cluster_com.erl:198-203)."""
         matches = 0
         groups: Dict[str, List[Tuple[SubscriberId, SubOpts]]] = {}
+        forwarded_nodes = set()  # one msg frame per remote node per publish
         for _filter, key, opts in rows:
             if isinstance(key, tuple) and len(key) == 3 and key[0] == "$g":
+                if not origin_local:
+                    continue
                 _, group, sid = key
                 if opts.no_local and sid == from_sid:
                     continue
                 groups.setdefault(group, []).append((sid, opts))
                 continue
             if isinstance(key, str):  # remote node pointer
-                if self.remote_publish is not None:
+                if (origin_local and self.remote_publish is not None
+                        and key not in forwarded_nodes):
+                    # overlapping filters yield multiple pointer rows to the
+                    # same node; the receiving node re-folds its own view, so
+                    # exactly one frame goes out (vmq_reg.erl:346-353)
+                    forwarded_nodes.add(key)
                     self.remote_publish(key, msg)
                     self.broker.metrics.incr("router_matches_remote")
                 continue
@@ -351,34 +461,78 @@ class Registry:
             self.broker.metrics.incr("router_matches_local", matches)
         return matches
 
+    def publish_from_remote(self, msg: Msg) -> int:
+        """Entry for ``msg`` frames from the cluster channel: fold the local
+        view, local subscribers only (vmq_cluster_com.erl:153-157)."""
+        rows = self.reg_view("trie").fold(msg.mountpoint, msg.topic)
+        return self.route_rows(msg, rows, None, origin_local=False)
+
+    def enqueue_remote(self, sid: SubscriberId, msgs: List[Msg]) -> bool:
+        """Entry for ``enq`` frames (remote shared-sub delivery and queue
+        migration drain): enqueue into the local queue
+        (vmq_cluster_com.erl:160-196)."""
+        queue = self.queues.get(sid)
+        if queue is None:
+            rec = self.db.read(sid)
+            if rec is None or rec.node != self.node_name:
+                return False
+            queue = self._start_queue(sid, QueueOpts(
+                clean_session=rec.clean_session))
+        for m in msgs:
+            queue.enqueue(m)
+        return True
+
+    def _prep_out(self, msg: Msg, opts: SubOpts) -> Msg:
+        """Per-subscription delivery transform: RAP flag, outgoing QoS
+        (upgrade_outgoing_qos), subscription identifier — applied the same
+        whether the member is local or remote."""
+        out = msg if opts.rap else msg_with_retain(msg, False)
+        qos = opts.qos if self.broker.config.upgrade_outgoing_qos else min(opts.qos, msg.qos)
+        out = out.with_qos(qos)
+        return _maybe_add_sub_id(out, opts)
+
     def _enqueue_to(self, sid: SubscriberId, msg: Msg, opts: SubOpts) -> bool:
         queue = self.queues.get(sid)
         if queue is None:
             return False
-        out = msg if opts.rap else msg_with_retain(msg, False)
-        qos = opts.qos if self.broker.config.upgrade_outgoing_qos else min(opts.qos, msg.qos)
-        out = out.with_qos(qos)
-        out = _maybe_add_sub_id(out, opts)
-        queue.enqueue(out)
+        queue.enqueue(self._prep_out(msg, opts))
         return True
 
     def _publish_shared(
         self, msg: Msg, members: List[Tuple[SubscriberId, SubOpts]]
     ) -> bool:
-        """Pick one group member: randomized, online-first
-        (vmq_shared_subscriptions.erl:26-63). Policies prefer_local /
-        local_only / random coincide on a single node; the cluster layer
-        extends member lists with remote entries."""
-        shuffled = members[:]
-        random.shuffle(shuffled)
-        online = [
-            (sid, opts)
-            for sid, opts in shuffled
-            if (q := self.queues.get(sid)) is not None and q.state == ONLINE
-        ]
-        for sid, opts in online + shuffled:
-            if self._enqueue_to(sid, msg, opts):
-                return True
+        """Pick one group member by policy, online members first
+        (vmq_shared_subscriptions.erl:26-63,90-106): ``prefer_local`` tries
+        local members before remote ones, ``local_only`` never leaves the
+        node, ``random`` mixes both. Remote member delivery rides the
+        cluster ``enq`` channel (vmq_shared_subscriptions.erl:86-88)."""
+        policy = self.broker.config.shared_subscription_policy
+        local, remote = [], []
+        for sid, opts in members:
+            node = getattr(opts, "node", self.node_name)
+            (local if node == self.node_name else remote).append((sid, opts, node))
+        random.shuffle(local)
+        random.shuffle(remote)
+        local_online = [m for m in local
+                        if (q := self.queues.get(m[0])) is not None
+                        and q.state == ONLINE]
+        if policy == "local_only":
+            candidates = local_online + [m for m in local if m not in local_online]
+        elif policy == "random":
+            mixed = local_online + remote
+            random.shuffle(mixed)
+            candidates = mixed + [m for m in local if m not in local_online]
+        else:  # prefer_local
+            candidates = (local_online + remote
+                          + [m for m in local if m not in local_online])
+        for sid, opts, node in candidates:
+            if node == self.node_name:
+                if self._enqueue_to(sid, msg, opts):
+                    return True
+            elif self.remote_enqueue_nowait is not None:
+                if self.remote_enqueue_nowait(node, sid, [self._prep_out(msg, opts)]):
+                    self.broker.metrics.incr("router_matches_remote")
+                    return True
         return False
 
     # -- introspection -----------------------------------------------------

@@ -26,11 +26,13 @@ class _RNode:
 
 
 class RetainStore:
-    def __init__(self, on_dirty: Optional[Callable[[Tuple[str, ...], Any], None]] = None):
+    def __init__(self, on_dirty: Optional[Callable[[str, Tuple[str, ...], Any], None]] = None):
         self._roots: Dict[str, _RNode] = {}  # per-mountpoint retain trees
         self._count = 0
-        # write-behind hook: called with (topic, value|None) on every mutation
-        # so a metadata store can persist deltas (vmq_retain_srv dirty table)
+        # write-behind hook: called with (mountpoint, topic, value|None) on
+        # every mutation so the metadata store persists + replicates deltas
+        # (vmq_retain_srv dirty table + metadata events,
+        # vmq_retain_srv.erl:180-191)
         self._on_dirty = on_dirty
 
     def __len__(self) -> int:
@@ -39,18 +41,35 @@ class RetainStore:
     def insert(self, mountpoint: str, topic: Sequence[str], value: Any) -> None:
         """Store/replace the retained message for a topic
         (vmq_retain_srv:insert/3)."""
+        self._insert(mountpoint, topic, value)
+        if self._on_dirty:
+            self._on_dirty(mountpoint, tuple(topic), value)
+
+    def _insert(self, mountpoint: str, topic: Sequence[str], value: Any) -> None:
         node = self._roots.setdefault(mountpoint, _RNode())
         for w in topic:
             node = node.children.setdefault(w, _RNode())
         if node.value is None:
             self._count += 1
         node.value = value
-        if self._on_dirty:
-            self._on_dirty(tuple(topic), value)
 
     def delete(self, mountpoint: str, topic: Sequence[str]) -> bool:
         """Remove retained message (empty retained payload deletes,
         vmq_reg.erl:274-283)."""
+        ok = self._delete(mountpoint, topic)
+        if ok and self._on_dirty:
+            self._on_dirty(mountpoint, tuple(topic), None)
+        return ok
+
+    def apply_remote(self, mountpoint: str, topic: Sequence[str], value: Any) -> None:
+        """Apply a replicated change without re-firing the dirty hook (the
+        metadata-event consumer path, vmq_retain_srv.erl:180-185)."""
+        if value is None:
+            self._delete(mountpoint, topic)
+        else:
+            self._insert(mountpoint, topic, value)
+
+    def _delete(self, mountpoint: str, topic: Sequence[str]) -> bool:
         root = self._roots.get(mountpoint)
         if root is None:
             return False
@@ -71,8 +90,6 @@ class RetainStore:
             if child.value is not None or child.children:
                 break
             del parent.children[w]
-        if self._on_dirty:
-            self._on_dirty(tuple(topic), None)
         return True
 
     def read(self, mountpoint: str, topic: Sequence[str]) -> Any:

@@ -180,14 +180,27 @@ class MQTTServer:
 
 
 async def start_broker(
-    config=None, host: str = "127.0.0.1", port: int = 1883
+    config=None, host: str = "127.0.0.1", port: int = 1883,
+    node_name: str = "node1",
+    cluster_listen: Optional[Tuple[str, int]] = None,
+    join: Optional[Tuple[str, int]] = None,
 ) -> Tuple[Broker, MQTTServer]:
     """Boot a broker with one MQTT listener (vmq_test_utils:setup-style
-    convenience; port=0 picks a random free port)."""
-    broker = Broker(config)
+    convenience; port=0 picks a random free port). ``cluster_listen``
+    additionally starts the inter-node channel listener (the reference's
+    ``vmq`` listener type, vmq_ranch_config.erl:224-227); ``join`` dials a
+    seed node."""
+    broker = Broker(config, node_name=node_name)
     await broker.start()
     server = MQTTServer(broker, host, port)
     await server.start()
+    if cluster_listen is not None:
+        from ..cluster import Cluster
+
+        cluster = Cluster(broker, cluster_listen[0], cluster_listen[1])
+        await cluster.start()
+        if join is not None:
+            cluster.join(*join)
     return broker, server
 
 
@@ -203,19 +216,35 @@ def main() -> None:  # pragma: no cover
                         help="force the JAX backend (e.g. cpu); note this "
                              "image's jax ignores the JAX_PLATFORMS env var — "
                              "only jax.config takes effect")
+    parser.add_argument("--node-name", default="node1")
+    parser.add_argument("--cluster-listen", default=None, metavar="HOST:PORT",
+                        help="start the inter-node cluster listener")
+    parser.add_argument("--join", default=None, metavar="HOST:PORT",
+                        help="join an existing cluster via this seed node")
     args = parser.parse_args()
     if args.jax_platform:
         import jax
 
         jax.config.update("jax_platforms", args.jax_platform)
 
+    def _addr(s):
+        h, _, p = s.rpartition(":")
+        return (h or "127.0.0.1", int(p))
+
     async def _run():
         from .config import Config
 
         broker, server = await start_broker(
-            Config(default_reg_view=args.reg_view), host=args.host, port=args.port
+            Config(default_reg_view=args.reg_view), host=args.host,
+            port=args.port, node_name=args.node_name,
+            cluster_listen=_addr(args.cluster_listen) if args.cluster_listen else None,
+            join=_addr(args.join) if args.join else None,
         )
-        print(f"vernemq_tpu broker listening on {args.host}:{server.port}")
+        print(f"vernemq_tpu broker {args.node_name} listening on "
+              f"{args.host}:{server.port}")
+        if broker.cluster is not None:
+            print(f"cluster listener on {broker.cluster.listen_host}:"
+                  f"{broker.cluster.listen_port}")
         await asyncio.Event().wait()
 
     asyncio.run(_run())

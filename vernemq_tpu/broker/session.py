@@ -36,6 +36,7 @@ from ..protocol.types import (
     PROTO_5,
     RC_GRANTED_QOS0,
     RC_NOT_AUTHORIZED,
+    RC_SERVER_UNAVAILABLE,
     RC_NO_MATCHING_SUBSCRIBERS,
     RC_NO_SUBSCRIPTION_EXISTED,
     RC_PACKET_ID_NOT_FOUND,
@@ -249,9 +250,15 @@ class Session:
             queue_type=cfg.queue_type,
             session_expiry=self.session_expiry,
         )
-        self.queue, session_present = self.broker.registry.register_subscriber(
-            self.sid, self.clean_start, qopts
-        )
+        try:
+            self.queue, session_present = self.broker.registry.register_subscriber(
+                self.sid, self.clean_start, qopts
+            )
+        except RuntimeError:
+            # netsplit CAP gate (vmq_reg.erl:65-70): CONNACK server
+            # unavailable instead of dropping the socket
+            await self._connack_fail(3, RC_SERVER_UNAVAILABLE)
+            return False
         self.connected = True
         self.broker.sessions[self.sid] = self
 
@@ -495,8 +502,9 @@ class Session:
             self.broker.metrics.incr("mqtt_publish_error")
             if e.args != ("not_ready",):
                 log.exception("publish routing failed for %s", self.sid)
-                return -1
-            return 0
+            # not_ready (netsplit CAP gate, vmq_reg.erl:293-318) behaves like
+            # the reference's {error, not_ready}: no ack — client retries
+            return -1
         except Exception:
             self.broker.metrics.incr("mqtt_publish_error")
             log.exception("publish routing failed for %s", self.sid)
@@ -678,14 +686,30 @@ class Session:
                 self.broker.metrics.incr("mqtt_suback_sent")
                 return
         # SUBACK first so retained replay serialises behind it on the wire
+        good = [t for t in topics if t is not None]
+        # netsplit CAP gate, checked before the SUBACK goes out
+        # (vmq_reg:subscribe if_ready, vmq_reg.erl:62-70)
+        if good and not self.broker.cluster_ready() \
+                and not self.broker.config.allow_subscribe_during_netsplit:
+            fail = 0x80 if self.proto_ver != PROTO_5 else 0x83
+            self.send(Suback(packet_id=f.packet_id,
+                             reason_codes=[fail] * len(f.topics)))
+            self.broker.metrics.incr("mqtt_suback_sent")
+            return
+        # SUBACK first so retained replay serialises behind it on the wire
         self.send(Suback(packet_id=f.packet_id, reason_codes=codes))
         self.broker.metrics.incr("mqtt_suback_sent")
-        good = [t for t in topics if t is not None]
         if good:
             for words, opts in good:
                 if sub_id:
                     opts.subscription_id = sub_id
-            self.broker.registry.subscribe(self.sid, good)
+            try:
+                self.broker.registry.subscribe(self.sid, good)
+            except RuntimeError:
+                # gate flipped between check and write: drop the session so
+                # the client re-subscribes on reconnect
+                await self.close("not_ready")
+                return
             self.broker.hooks_fire_all(
                 "on_subscribe", self.username, self.sid,
                 [(w, o.qos) for w, o in good],
@@ -708,7 +732,15 @@ class Session:
         except HookError:
             pass
         valid = [t for t in topics if t is not None]
-        results = self.broker.registry.unsubscribe(self.sid, valid)
+        try:
+            results = self.broker.registry.unsubscribe(self.sid, valid)
+        except RuntimeError:
+            # netsplit CAP gate (vmq_reg.erl:65-70)
+            fail = 0x80
+            self.send(Unsuback(packet_id=f.packet_id,
+                               reason_codes=[fail] * len(f.topics)))
+            self.broker.metrics.incr("mqtt_unsuback_sent")
+            return
         codes: List[int] = []
         ri = iter(results)
         for t in topics:

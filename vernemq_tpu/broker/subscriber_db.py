@@ -1,0 +1,104 @@
+"""Subscriber DB: subscription records in the replicated metadata store.
+
+Mirrors ``vmq_subscriber_db.erl``: store/read/fold/delete over the
+metadata facade under a dedicated prefix (``vmq_subscriber_db.erl:26-54``)
+plus change-event subscription (``:56-71``). The record keeps the
+reference's subscriber format — node + clean_session + per-filter
+subinfo (``vmq_subscriber.erl:35-48``) — so queue migration can remap the
+node field the same way (``change_node``, ``vmq_subscriber.erl:97-128``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+from ..protocol.types import SubOpts
+from .message import SubscriberId
+
+PREFIX = "subscriber"
+
+Filter = Tuple[str, ...]
+
+
+def opts_to_dict(opts: SubOpts) -> Dict[str, Any]:
+    d = {
+        "qos": opts.qos,
+        "nl": opts.no_local,
+        "rap": opts.rap,
+        "rh": opts.retain_handling,
+    }
+    sub_id = getattr(opts, "subscription_id", None)
+    if sub_id:
+        d["sid"] = sub_id
+    return d
+
+
+def opts_from_dict(d: Dict[str, Any]) -> SubOpts:
+    opts = SubOpts(qos=d.get("qos", 0), no_local=d.get("nl", False),
+                   rap=d.get("rap", False), retain_handling=d.get("rh", 0))
+    if "sid" in d:
+        opts.subscription_id = d["sid"]
+    return opts
+
+
+class SubscriberRecord:
+    """One subscriber's replicated state: which node owns its queue, its
+    clean-session flag, and its subscriptions."""
+
+    __slots__ = ("node", "clean_session", "subs")
+
+    def __init__(self, node: str, clean_session: bool,
+                 subs: Optional[Dict[Filter, SubOpts]] = None):
+        self.node = node
+        self.clean_session = clean_session
+        self.subs: Dict[Filter, SubOpts] = subs or {}
+
+    def to_term(self) -> Dict[str, Any]:
+        return {
+            "node": self.node,
+            "clean": self.clean_session,
+            "subs": {f: opts_to_dict(o) for f, o in self.subs.items()},
+        }
+
+    @classmethod
+    def from_term(cls, t: Optional[Dict[str, Any]]) -> Optional["SubscriberRecord"]:
+        if t is None:
+            return None
+        return cls(
+            t["node"], t["clean"],
+            {tuple(f): opts_from_dict(o) for f, o in t["subs"].items()},
+        )
+
+
+class SubscriberDB:
+    def __init__(self, metadata, node_name: str):
+        self.metadata = metadata
+        self.node_name = node_name
+
+    def store(self, sid: SubscriberId, record: SubscriberRecord) -> None:
+        self.metadata.put(PREFIX, tuple(sid), record.to_term())
+
+    def read(self, sid: SubscriberId) -> Optional[SubscriberRecord]:
+        return SubscriberRecord.from_term(
+            self.metadata.get(PREFIX, tuple(sid)))
+
+    def delete(self, sid: SubscriberId) -> None:
+        self.metadata.delete(PREFIX, tuple(sid))
+
+    def fold(self) -> Iterable[Tuple[SubscriberId, SubscriberRecord]]:
+        for key, term in self.metadata.fold(PREFIX):
+            yield (key[0], key[1]), SubscriberRecord.from_term(term)
+
+    def subscribe_db_events(
+        self, fn: Callable[[SubscriberId, Optional[SubscriberRecord],
+                            Optional[SubscriberRecord]], None]) -> None:
+        """fn(sid, old_record, new_record) on every change — local writes
+        fire synchronously (read-your-writes for the local trie, matching
+        the reference's synchronous trie events)."""
+
+        def _on_change(key, old, new, origin):
+            fn((key[0], key[1]),
+               SubscriberRecord.from_term(old),
+               SubscriberRecord.from_term(new))
+
+        self.metadata.subscribe(PREFIX, _on_change)

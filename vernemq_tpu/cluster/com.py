@@ -1,0 +1,118 @@
+"""Inbound cluster data-plane handler.
+
+Mirrors ``vmq_cluster_com.erl``: per inbound connection, parse the
+``vmq-connect`` handshake, then ``vmq-send`` batches of sub-frames.
+``msg`` frames fold the local reg view with remote/group rows ignored —
+they were already covered by the origin node (``vmq_cluster_com.erl:
+198-203``); ``enq`` frames enqueue into local queues off the channel's
+critical path and ack back to the origin (``:153-196``). Metadata frames
+(``mta``/``mtf``/``hlo``) merge into the replicated store.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Optional
+
+from . import codec
+from .node import term_to_msg
+
+log = logging.getLogger("vernemq_tpu.cluster")
+
+
+class ClusterCom:
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    async def handle_conn(self, reader: asyncio.StreamReader,
+                          writer: asyncio.StreamWriter) -> None:
+        origin: Optional[str] = None
+        try:
+            magic = await reader.readexactly(11)
+            if magic != b"vmq-connect":
+                return
+            (n,) = struct.unpack(">I", await reader.readexactly(4))
+            origin = (await reader.readexactly(n)).decode()
+            self.cluster.inbound_up(origin)
+            while True:
+                hdr = await reader.readexactly(12)
+                if hdr[:8] != b"vmq-send":
+                    log.warning("bad cluster frame header from %s", origin)
+                    return
+                (length,) = struct.unpack(">I", hdr[8:12])
+                blob = await reader.readexactly(length)
+                self.cluster.metrics.incr("cluster_bytes_received", length)
+                self._process(origin, blob)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            if origin is not None:
+                self.cluster.inbound_down(origin)
+            writer.close()
+
+    def _process(self, origin: str, blob: bytes) -> None:
+        pos = 0
+        while pos < len(blob):
+            try:
+                cmd = blob[pos:pos + 3]
+                (length,) = struct.unpack(">I", blob[pos + 3:pos + 7])
+                payload = blob[pos + 7:pos + 7 + length]
+                if len(payload) != length:
+                    raise ValueError("truncated sub-frame")
+            except (struct.error, ValueError):
+                # malformed header: no way to resync inside this batch —
+                # drop the remainder but keep the channel alive
+                log.warning("malformed cluster sub-frame from %s at +%d",
+                            origin, pos)
+                return
+            pos += 7 + length
+            try:
+                term = codec.decode(payload)
+                self._dispatch(origin, bytes(cmd), term)
+            except Exception:
+                log.exception("cluster frame %r from %s failed", cmd, origin)
+
+    def _dispatch(self, origin: str, cmd: bytes, term) -> None:
+        cluster = self.cluster
+        if cmd == b"msg":
+            # remote publish: local subscribers only (origin covered the rest)
+            msg = term_to_msg(term)
+            cluster.broker.registry.publish_from_remote(msg)
+        elif cmd == b"enq":
+            ref_id, sid, msgs, want_ack = term
+            sid = (sid[0], sid[1])
+            # enqueue off the channel path (the reference spawns,
+            # vmq_cluster_com.erl:160-166)
+            async def _enq():
+                ok = cluster.broker.registry.enqueue_remote(
+                    sid, [term_to_msg(m) for m in msgs])
+                if want_ack:
+                    cluster.send_ack(origin, ref_id, ok)
+
+            asyncio.get_event_loop().create_task(_enq())
+        elif cmd == b"akn":
+            ref_id, ok = term
+            cluster.resolve_ack(ref_id, ok)
+        elif cmd == b"mta":
+            prefix, key, entry = term
+            cluster.metadata.merge(prefix, _dekey(key), tuple(entry))
+        elif cmd == b"mtf":
+            applied = cluster.metadata.merge_full(
+                (p, k, tuple(e)) for p, k, e in term)
+            if applied:
+                log.debug("anti-entropy from %s applied %d entries",
+                          origin, applied)
+        elif cmd == b"hlo":
+            cluster.on_hello(origin, term)
+        elif cmd == b"png":
+            pass  # liveness ping
+        else:
+            log.warning("unknown cluster frame %r from %s", cmd, origin)
+
+
+def _dekey(key):
+    if isinstance(key, list):
+        return tuple(_dekey(k) for k in key)
+    return key

@@ -1,0 +1,114 @@
+"""Replicated metadata store: LWW registers with change events.
+
+Plays the role of the reference's ``vmq_metadata`` facade
+(``vmq_metadata.erl:47-60``: put/get/delete/fold/subscribe) with a
+plumtree-flavored implementation: every write is applied locally
+synchronously (read-your-writes on the local node, matching the
+synchronous trie events the reference relies on), broadcast to peers, and
+reconciled on (re)connect by a full-state exchange (the eager-push +
+anti-entropy shape of plumtree; the SWC store arrives as the second
+metadata backend the way ``vmq_swc`` does).
+
+Conflict resolution is last-writer-wins on a (lamport, origin-node) pair —
+the reference's plumtree backend resolves concurrent metadata writes LWW
+too (``vmq_plumtree.erl:91-104``).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+Key = Tuple[str, Any]  # (prefix, key)
+Entry = Tuple[int, str, Any]  # (lamport, origin_node, value | None tombstone)
+
+
+class MetadataStore:
+    def __init__(self, node_name: str):
+        self.node_name = node_name
+        self._data: Dict[Key, Entry] = {}
+        self._clock = 0
+        self._lock = threading.Lock()
+        # prefix -> [fn(key, old_value, new_value)]
+        self._subscribers: Dict[str, List[Callable[[Any, Any, Any], None]]] = {}
+        # wired by the cluster layer: fn(prefix, key, entry) -> None
+        self.broadcast: Optional[Callable[[str, Any, Entry], None]] = None
+
+    # ------------------------------------------------------------------ API
+
+    def put(self, prefix: str, key: Any, value: Any) -> None:
+        with self._lock:
+            self._clock += 1
+            entry = (self._clock, self.node_name, value)
+        self._apply(prefix, key, entry, local=True)
+
+    def delete(self, prefix: str, key: Any) -> None:
+        self.put(prefix, key, None)  # tombstone
+
+    def get(self, prefix: str, key: Any, default: Any = None) -> Any:
+        entry = self._data.get((prefix, key))
+        if entry is None or entry[2] is None:
+            return default
+        return entry[2]
+
+    def fold(self, prefix: str) -> Iterable[Tuple[Any, Any]]:
+        """Iterate live (key, value) under a prefix
+        (vmq_metadata:fold equivalent)."""
+        for (p, k), (_, _, v) in list(self._data.items()):
+            if p == prefix and v is not None:
+                yield k, v
+
+    def subscribe(self, prefix: str,
+                  fn: Callable[[Any, Any, Any, str], None]) -> None:
+        """Change events for a prefix: fn(key, old_value, new_value,
+        origin_node) — the subscriber-db event feed
+        (vmq_subscriber_db.erl:56-71). ``origin_node`` lets write-through
+        caches skip re-applying their own local writes."""
+        self._subscribers.setdefault(prefix, []).append(fn)
+
+    # ----------------------------------------------------------- replication
+
+    def _newer(self, a: Entry, b: Optional[Entry]) -> bool:
+        if b is None:
+            return True
+        return (a[0], a[1]) > (b[0], b[1])
+
+    def _apply(self, prefix: str, key: Any, entry: Entry, local: bool) -> bool:
+        with self._lock:
+            old = self._data.get((prefix, key))
+            if not local and not self._newer(entry, old):
+                return False
+            self._clock = max(self._clock, entry[0])
+            self._data[(prefix, key)] = entry
+        old_value = old[2] if old else None
+        for fn in self._subscribers.get(prefix, []):
+            fn(key, old_value, entry[2], entry[1])
+        if local and self.broadcast is not None:
+            self.broadcast(prefix, key, entry)
+        return True
+
+    def merge(self, prefix: str, key: Any, entry: Tuple) -> bool:
+        """Apply a replicated entry from a peer (broadcast or AE sync)."""
+        return self._apply(prefix, key, tuple(entry), local=False)
+
+    def full_state(self) -> List[Tuple[str, Any, Entry]]:
+        """Snapshot for the on-connect anti-entropy exchange."""
+        with self._lock:
+            return [(p, k, e) for (p, k), e in self._data.items()]
+
+    def merge_full(self, state: Iterable[Tuple[str, Any, Tuple]]) -> int:
+        applied = 0
+        for prefix, key, entry in state:
+            if self.merge(prefix, _dekey(key), entry):
+                applied += 1
+        return applied
+
+    def stats(self) -> Dict[str, int]:
+        return {"metadata_entries": len(self._data), "clock": self._clock}
+
+
+def _dekey(key: Any) -> Any:
+    # keys survive the codec as lists; restore tuple-ness for dict lookup
+    if isinstance(key, list):
+        return tuple(_dekey(k) for k in key)
+    return key
