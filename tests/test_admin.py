@@ -1,0 +1,249 @@
+"""Admin surface tests: command tree, HTTP endpoints, mgmt API auth, vmq_ql
+queries, CLI table formatting (vmq_http_SUITE / vmq_info_SUITE shapes)."""
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from vernemq_tpu.admin.cli import format_table, run_remote
+from vernemq_tpu.admin.commands import (
+    CommandError,
+    CommandRegistry,
+    register_core_commands,
+)
+from vernemq_tpu.admin.http import HttpServer
+from vernemq_tpu.admin import ql
+from vernemq_tpu.broker.config import Config
+from vernemq_tpu.broker.server import start_broker
+from vernemq_tpu.client import MQTTClient
+
+
+@pytest.fixture
+def broker(event_loop):
+    b, server = event_loop.run_until_complete(
+        start_broker(Config(systree_enabled=False), port=0))
+    http = HttpServer(b, port=0)
+    event_loop.run_until_complete(http.start())
+    yield b, server, http
+    event_loop.run_until_complete(b.stop())
+    event_loop.run_until_complete(server.stop())
+    event_loop.run_until_complete(http.stop())
+
+
+async def connected(broker, client_id, **kw):
+    _, server, _ = broker
+    c = MQTTClient(server.host, server.port, client_id=client_id, **kw)
+    ack = await c.connect()
+    assert ack.rc == 0
+    return c
+
+
+async def http_get(http, path):
+    """Raw GET via executor so the event loop keeps serving."""
+    url = f"http://{http.host}:{http.port}{path}"
+
+    def _get():
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    return await asyncio.get_event_loop().run_in_executor(None, _get)
+
+
+# ------------------------------------------------------------- command tree
+
+def test_registry_resolve_longest_prefix():
+    reg = register_core_commands(CommandRegistry())
+    path, flags = reg.resolve(["session", "show", "--limit=5", "client_id=x"])
+    assert path == ("session", "show")
+    assert flags == {"limit": 5, "client_id": "x"}
+
+
+def test_registry_unknown_command():
+    reg = register_core_commands(CommandRegistry())
+    with pytest.raises(CommandError):
+        reg.resolve(["bogus", "cmd"])
+
+
+def test_flag_coercion():
+    flags = CommandRegistry._parse_flags(["a=true", "b=3", "c=1.5", "d=x", "e"])
+    assert flags["a"] is True and flags["b"] == 3 and flags["c"] == 1.5
+    assert flags["d"] == "x"
+    from vernemq_tpu.admin.commands import BARE
+
+    assert flags["e"] is BARE and bool(flags["e"])
+
+
+@pytest.mark.asyncio
+async def test_node_status_and_metrics_commands(broker):
+    b, _, _ = broker
+    reg = register_core_commands(CommandRegistry())
+    res = reg.run(b, ["node", "status"])
+    assert res["table"][0]["node"] == b.node_name
+    res = reg.run(b, ["metrics", "show"])
+    names = {r["metric"] for r in res["table"]}
+    assert "mqtt_publish_received" in names
+
+
+@pytest.mark.asyncio
+async def test_config_show_set(broker):
+    b, _, _ = broker
+    reg = register_core_commands(CommandRegistry())
+    reg.run(b, ["config", "set", "max_inflight_messages=5"])
+    assert b.config.max_inflight_messages == 5
+    res = reg.run(b, ["config", "show", "key=max_inflight_messages"])
+    assert res["table"][0]["value"] == 5
+    with pytest.raises(CommandError):
+        reg.run(b, ["config", "set", "not_a_key=1"])
+
+
+# ------------------------------------------------------------ http endpoints
+
+@pytest.mark.asyncio
+async def test_prometheus_metrics_endpoint(broker):
+    b, _, http = broker
+    c = await connected(broker, "prom1")
+    await c.publish("a/b", b"x")
+    await c.disconnect()
+    status, text = await http_get(http, "/metrics")
+    assert status == 200
+    assert "# TYPE mqtt_publish_received counter" in text
+    assert 'mqtt_publish_received{node="node1"} 1' in text
+    assert "# TYPE active_sessions gauge" in text
+
+
+@pytest.mark.asyncio
+async def test_health_and_status(broker):
+    _, _, http = broker
+    status, text = await http_get(http, "/health")
+    assert status == 200 and json.loads(text)["status"] == "OK"
+    status, text = await http_get(http, "/status.json")
+    body = json.loads(text)
+    assert body["node"] == "node1" and body["ready"] is True
+
+
+@pytest.mark.asyncio
+async def test_mgmt_api_requires_key(broker):
+    b, _, http = broker
+    status, text = await http_get(http, "/api/v1/node/status")
+    assert status == 401
+    # create a key in-process (vmq-admin api-key create), then use it
+    reg = register_core_commands(CommandRegistry())
+    key = reg.run(b, ["api-key", "create"])["table"][0]["key"]
+    status, text = await http_get(http, f"/api/v1/node/status?api_key={key}")
+    assert status == 200
+    assert json.loads(text)["table"][0]["node"] == "node1"
+
+
+@pytest.mark.asyncio
+async def test_mgmt_api_session_show_and_cli(broker):
+    b, _, http = broker
+    b.config.set("http_mgmt_api_auth", False)
+    c = await connected(broker, "cli-sess", username="u1")
+    status, text = await http_get(
+        http, "/api/v1/session/show?client_id=cli-sess")
+    assert status == 200
+    rows = json.loads(text)["table"]
+    assert len(rows) == 1 and rows[0]["client_id"] == "cli-sess"
+    # the CLI end-to-end path (urllib in executor)
+    result = await asyncio.get_event_loop().run_in_executor(
+        None, run_remote, f"http://{http.host}:{http.port}", "",
+        ["session", "show", "client_id=cli-sess"])
+    assert result["type"] == "table"
+    out = format_table(result["table"])
+    assert "cli-sess" in out
+    await c.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_mgmt_api_bad_command(broker):
+    b, _, http = broker
+    b.config.set("http_mgmt_api_auth", False)
+    status, text = await http_get(http, "/api/v1/bogus")
+    assert status == 400
+    assert "unknown command" in json.loads(text)["error"]
+
+
+# ------------------------------------------------------------------ vmq_ql
+
+@pytest.mark.asyncio
+async def test_ql_sessions_query(broker):
+    b, _, _ = broker
+    c1 = await connected(broker, "q1", username="alice")
+    c2 = await connected(broker, "q2", username="bob")
+    await c1.subscribe("t/#", qos=1)
+    rows = ql.query(b, "SELECT client_id, user FROM sessions "
+                       "WHERE user='alice'")
+    assert rows == [{"client_id": "q1", "user": "alice"}]
+    rows = ql.query(b, "SELECT * FROM sessions WHERE is_online=true")
+    assert {r["client_id"] for r in rows} == {"q1", "q2"}
+    rows = ql.query(b, "SELECT topic, qos FROM subscriptions")
+    assert rows == [{"topic": "t/#", "qos": 1}]
+    await c1.disconnect()
+    await c2.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_ql_operators_and_limit(broker):
+    b, _, _ = broker
+    clients = []
+    for i in range(4):
+        clients.append(await connected(broker, f"ql{i}"))
+    rows = ql.query(b, "SELECT client_id FROM sessions LIMIT 2")
+    assert len(rows) == 2
+    rows = ql.query(
+        b, "SELECT client_id FROM sessions "
+           "WHERE (client_id='ql0' OR client_id='ql1') AND is_online=true")
+    assert {r["client_id"] for r in rows} == {"ql0", "ql1"}
+    rows = ql.query(b, "SELECT client_id FROM sessions WHERE waiting_acks>0")
+    assert rows == []
+    with pytest.raises(ql.QLError):
+        ql.query(b, "SELECT x FROM nope")
+    for c in clients:
+        await c.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_session_show_filters(broker):
+    b, _, _ = broker
+    reg = register_core_commands(CommandRegistry())
+    c1 = await connected(broker, "123")       # numeric-looking client id
+    c2 = await connected(broker, "alpha")
+    # int-coerced flag value must still match the string client_id
+    rows = reg.run(b, ["session", "show", "client_id=123"])["table"]
+    assert len(rows) == 1 and rows[0]["client_id"] == "123"
+    # boolean filter works (is_online=false matches nothing: both online)
+    rows = reg.run(b, ["session", "show", "is_online=false"])["table"]
+    assert rows == []
+    # bare --field narrows columns
+    rows = reg.run(b, ["session", "show", "--client_id", "client_id=alpha"])
+    assert rows["table"] == [{"client_id": "alpha"}]
+    await c1.disconnect()
+    await c2.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_ql_limit_zero(broker):
+    b, _, _ = broker
+    c = await connected(broker, "lz")
+    assert ql.query(b, "SELECT client_id FROM sessions LIMIT 0") == []
+    await c.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_metrics_with_descriptions(broker):
+    b, _, _ = broker
+    reg = register_core_commands(CommandRegistry())
+    rows = reg.run(b, ["metrics", "show", "--with-descriptions"])["table"]
+    by_name = {r["metric"]: r for r in rows}
+    assert "CONNECT" in by_name["mqtt_connect_received"]["description"]
+
+
+def test_format_table_empty():
+    assert format_table([]) == "(no rows)"
+    out = format_table([{"a": 1, "b": None}, {"a": 22, "c": True}])
+    assert "22" in out and "true" in out

@@ -1,0 +1,409 @@
+"""The ``vmq-admin`` command tree.
+
+Plays the role of clique in the reference: subsystems register commands
+into one tree (``vmq_server_cli.erl:52-73`` registers node/cluster/session/
+plugin/listener/metrics/api-key commands), the CLI and the HTTP management
+API both dispatch into it (``vmq_http_mgmt_api.erl:100-140`` maps
+``/api/v1/<path>?flags`` onto the same registry).
+
+A command is ``(path_words, fn(broker, flags) -> result, usage, help)``.
+Results are plain JSON-able values; tabular results are
+``{"table": [row-dicts]}`` so the CLI can pretty-print and the HTTP API can
+return JSON unchanged (the clique writer split, ``vmq_cli_json_writer``).
+"""
+
+from __future__ import annotations
+
+import secrets
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+
+class CommandError(Exception):
+    def __init__(self, message: str, usage: Optional[str] = None):
+        super().__init__(message)
+        self.message = message
+        self.usage = usage
+
+
+CommandFn = Callable[[Any, Dict[str, Any]], Any]
+
+
+class _Bare:
+    """Sentinel for a bare ``--flag`` (no ``=value``): truthy, but
+    distinguishable from an explicit ``flag=true`` so commands like
+    ``session show`` can tell column selectors from filters."""
+
+    def __bool__(self) -> bool:
+        return True
+
+    def __repr__(self) -> str:
+        return "true"
+
+
+BARE = _Bare()
+
+
+class CommandRegistry:
+    def __init__(self) -> None:
+        # path tuple -> (fn, usage, help)
+        self._commands: Dict[Tuple[str, ...], Tuple[CommandFn, str, str]] = {}
+
+    def register(self, path: Sequence[str], fn: CommandFn, usage: str,
+                 help_text: str = "") -> None:
+        self._commands[tuple(path)] = (fn, usage, help_text)
+
+    def commands(self) -> List[Tuple[Tuple[str, ...], str, str]]:
+        return [(p, u, h) for p, (_, u, h) in sorted(self._commands.items())]
+
+    def resolve(self, words: Sequence[str]) -> Tuple[Tuple[str, ...], Dict[str, Any]]:
+        """Split ``words`` into the longest registered command path plus
+        ``key=value`` / ``--flag`` arguments (clique parsing shape)."""
+        path: List[str] = []
+        args: List[str] = []
+        for w in words:
+            if args or "=" in w or w.startswith("--"):
+                args.append(w)
+            else:
+                path.append(w)
+        # longest-prefix match so `session show` wins over `session`
+        for cut in range(len(path), 0, -1):
+            if tuple(path[:cut]) in self._commands:
+                args = path[cut:] + args
+                return tuple(path[:cut]), self._parse_flags(args)
+        raise CommandError(f"unknown command: {' '.join(words) or '(empty)'}",
+                           usage=self.usage_overview())
+    @staticmethod
+    def _parse_flags(args: Sequence[str]) -> Dict[str, Any]:
+        flags: Dict[str, Any] = {}
+        for a in args:
+            if a.startswith("--"):
+                a = a[2:]
+            if "=" in a:
+                k, _, v = a.partition("=")
+                flags[k.replace("-", "_")] = _coerce(v)
+            else:
+                k = a.replace("-", "_")
+                flags.setdefault(k, BARE)
+                flags.setdefault("_bare", []).append(k)
+        return flags
+
+    def run(self, broker: Any, words: Sequence[str]) -> Any:
+        path, flags = self.resolve(words)
+        fn, usage, _ = self._commands[path]
+        try:
+            return fn(broker, flags)
+        except CommandError as e:
+            if e.usage is None:
+                e.usage = usage
+            raise
+
+    def usage_overview(self) -> str:
+        lines = ["Usage: vmq-admin <command>", "", "Commands:"]
+        seen = set()
+        for p, u, h in self.commands():
+            head = p[0]
+            if head not in seen:
+                seen.add(head)
+                lines.append(f"  {head}")
+        lines.append("")
+        lines.append("Run a full command for detailed output; "
+                     "flags are key=value pairs.")
+        return "\n".join(lines)
+
+
+def _coerce(v: str) -> Any:
+    if v.lower() in ("true", "on", "yes"):
+        return True
+    if v.lower() in ("false", "off", "no"):
+        return False
+    try:
+        return int(v)
+    except ValueError:
+        pass
+    try:
+        return float(v)
+    except ValueError:
+        pass
+    return v
+
+
+# --------------------------------------------------------------------------
+# core command set (vmq_server_cli.erl usage tree :521-584)
+# --------------------------------------------------------------------------
+
+def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
+    reg.register(["node", "status"], _node_status, "vmq-admin node status")
+    reg.register(["cluster", "show"], _cluster_show, "vmq-admin cluster show")
+    reg.register(["cluster", "join"], _cluster_join,
+                 "vmq-admin cluster join discovery-node=HOST:PORT")
+    reg.register(["cluster", "leave"], _cluster_leave,
+                 "vmq-admin cluster leave node=NodeName")
+    reg.register(["session", "show"], _session_show,
+                 "vmq-admin session show [--limit=N] [client_id=X] "
+                 "[--<field>...]")
+    reg.register(["queue", "show"], _queue_show,
+                 "vmq-admin queue show [--limit=N]")
+    reg.register(["subscription", "show"], _subscription_show,
+                 "vmq-admin subscription show [--limit=N]")
+    reg.register(["retain", "show"], _retain_show,
+                 "vmq-admin retain show [--limit=N]")
+    reg.register(["metrics", "show"], _metrics_show,
+                 "vmq-admin metrics show [--with-descriptions]")
+    reg.register(["plugin", "show"], _plugin_show, "vmq-admin plugin show")
+    reg.register(["plugin", "enable"], _plugin_enable,
+                 "vmq-admin plugin enable name=PluginName [opt=val...]")
+    reg.register(["plugin", "disable"], _plugin_disable,
+                 "vmq-admin plugin disable name=PluginName")
+    reg.register(["config", "show"], _config_show,
+                 "vmq-admin config show [key=K]")
+    reg.register(["config", "set"], _config_set,
+                 "vmq-admin config set key=value [key=value ...]")
+    reg.register(["listener", "show"], _listener_show,
+                 "vmq-admin listener show")
+    reg.register(["listener", "start"], _listener_start,
+                 "vmq-admin listener start address=A port=P "
+                 "[--mqtt|--mqtts|--ws|--wss|--http]")
+    reg.register(["listener", "stop"], _listener_stop,
+                 "vmq-admin listener stop address=A port=P")
+    reg.register(["api-key", "create"], _api_key_create,
+                 "vmq-admin api-key create")
+    reg.register(["api-key", "show"], _api_key_show, "vmq-admin api-key show")
+    reg.register(["api-key", "delete"], _api_key_delete,
+                 "vmq-admin api-key delete key=KEY")
+    reg.register(["api-key", "add"], _api_key_add,
+                 "vmq-admin api-key add key=KEY")
+    return reg
+
+
+def _node_status(broker, flags):
+    return {"table": [{
+        "node": broker.node_name,
+        "running": True,
+        "uptime_s": round(time.time() - broker._started, 1),
+        "sessions": len(broker.sessions),
+        "queues": len(broker.registry.queues),
+        "subscriptions": int(broker.registry.stats()["router_subscriptions"]),
+    }]}
+
+
+def _cluster_show(broker, flags):
+    rows = [{"node": broker.node_name, "running": True, "self": True}]
+    if broker.cluster is not None:
+        for node, up in broker.cluster.status():
+            if node != broker.node_name:
+                rows.append({"node": node, "running": up, "self": False})
+    return {"table": rows}
+
+
+def _cluster_join(broker, flags):
+    if broker.cluster is None:
+        raise CommandError("clustering is not enabled on this node")
+    target = flags.get("discovery_node")
+    if not isinstance(target, str) or ":" not in target:
+        raise CommandError("discovery-node=HOST:PORT required")
+    host, _, port = target.rpartition(":")
+    broker.cluster.join(host, int(port))
+    return f"join request sent to {target}"
+
+
+def _cluster_leave(broker, flags):
+    if broker.cluster is None:
+        raise CommandError("clustering is not enabled on this node")
+    node = flags.get("node")
+    if not isinstance(node, str):
+        raise CommandError("node=NodeName required")
+    broker.cluster.leave(node)
+    return f"node {node} left the cluster"
+
+
+_SESSION_FIELDS = ("client_id", "mountpoint", "user", "peer_host", "peer_port",
+                   "protocol", "is_online", "queue_size", "clean_session")
+
+
+def _loose_eq(row_value: Any, want: Any) -> bool:
+    """Filter equality tolerant of flag coercion: a client_id of "123"
+    must match the int-coerced flag value 123."""
+    if row_value == want:
+        return True
+    if isinstance(want, bool) or isinstance(row_value, bool):
+        return str(row_value).lower() == str(want).lower()
+    return str(row_value) == str(want)
+
+
+def _session_show(broker, flags):
+    # vmq_ql-backed in the reference (vmq_info.erl); lazily built rows here
+    from .ql import session_rows
+
+    limit = int(flags.pop("limit", 100))
+    # bare --field flags select columns; key=value pairs filter rows
+    bare = flags.pop("_bare", [])
+    fields = [k for k in bare if k in _SESSION_FIELDS] or list(_SESSION_FIELDS)
+    where = {k: v for k, v in flags.items() if v is not BARE}
+    rows = []
+    for row in session_rows(broker):
+        if any(not _loose_eq(row.get(k), v) for k, v in where.items()):
+            continue
+        rows.append({k: row.get(k) for k in fields})
+        if len(rows) >= limit:
+            break
+    return {"table": rows}
+
+
+def _queue_show(broker, flags):
+    limit = int(flags.get("limit", 100))
+    rows = []
+    for sid, q in list(broker.registry.queues.items())[:limit]:
+        info = q.info()
+        info["mountpoint"], info["client_id"] = sid
+        rows.append(info)
+    return {"table": rows}
+
+
+def _subscription_show(broker, flags):
+    from .ql import subscription_rows
+
+    limit = int(flags.get("limit", 100))
+    rows = []
+    for row in subscription_rows(broker):
+        rows.append(row)
+        if len(rows) >= limit:
+            break
+    return {"table": rows}
+
+
+def _retain_show(broker, flags):
+    from .ql import retain_rows
+
+    limit = int(flags.get("limit", 100))
+    rows = []
+    for row in retain_rows(broker):
+        row.pop("payload", None)  # CLI listing shows sizes, not bodies
+        rows.append(row)
+        if len(rows) >= limit:
+            break
+    return {"table": rows}
+
+
+def _metrics_show(broker, flags):
+    with_desc = bool(flags.get("with_descriptions"))
+    rows = []
+    for k, v in sorted(broker.metrics.all_metrics().items()):
+        row = {"metric": k, "value": v}
+        if with_desc:
+            row["description"] = broker.metrics.describe(k)
+        rows.append(row)
+    return {"table": rows}
+
+
+def _plugin_show(broker, flags):
+    return {"table": [{"plugin": name, "info": info}
+                      for name, info in broker.plugins.show()]}
+
+
+def _plugin_enable(broker, flags):
+    flags.pop("_bare", None)
+    name = flags.pop("name", None)
+    if not isinstance(name, str):
+        raise CommandError("name=PluginName required")
+    broker.plugins.enable(name, **flags)
+    return f"plugin {name} enabled"
+
+
+def _plugin_disable(broker, flags):
+    name = flags.get("name")
+    if not isinstance(name, str):
+        raise CommandError("name=PluginName required")
+    broker.plugins.disable(name)
+    return f"plugin {name} disabled"
+
+
+def _config_show(broker, flags):
+    snap = broker.config.snapshot()
+    if "key" in flags:
+        key = flags["key"]
+        if key not in snap:
+            raise CommandError(f"unknown config key: {key}")
+        return {"table": [{"key": key, "value": snap[key]}]}
+    return {"table": [{"key": k, "value": v} for k, v in sorted(snap.items())]}
+
+
+def _config_set(broker, flags):
+    if not flags:
+        raise CommandError("config set needs key=value pairs")
+    for k, v in flags.items():
+        try:
+            broker.config.set(k, v)
+        except KeyError:
+            raise CommandError(f"unknown config key: {k}") from None
+    return f"{len(flags)} config value(s) updated"
+
+
+def _listener_manager(broker):
+    lm = getattr(broker, "listeners", None)
+    if lm is None:
+        raise CommandError("listener manager not running")
+    return lm
+
+
+def _listener_show(broker, flags):
+    return {"table": _listener_manager(broker).show()}
+
+
+def _listener_start(broker, flags):
+    lm = _listener_manager(broker)
+    addr = str(flags.get("address", "127.0.0.1"))
+    port = int(flags.get("port", 0))
+    kind = "mqtt"
+    for k in ("mqtt", "mqtts", "ws", "wss", "http", "https", "vmq", "vmqs"):
+        if flags.get(k):
+            kind = k
+    import asyncio
+
+    listener = asyncio.get_event_loop().create_task(
+        lm.start_listener(kind, addr, port, flags))
+    lm.track_start_task(listener)
+    return f"starting {kind} listener on {addr}:{port}"
+
+
+def _listener_stop(broker, flags):
+    lm = _listener_manager(broker)
+    addr = str(flags.get("address", "127.0.0.1"))
+    port = int(flags.get("port", 0))
+    lm.stop_listener(addr, port)
+    return f"listener {addr}:{port} stopping"
+
+
+# --- api keys: stored in replicated metadata (mgmt API auth) ---------------
+
+API_KEY_PREFIX = "api_key"
+
+
+def _api_key_create(broker, flags):
+    key = secrets.token_urlsafe(24)
+    broker.metadata.put(API_KEY_PREFIX, key, {"created": time.time()})
+    return {"table": [{"key": key}]}
+
+
+def _api_key_add(broker, flags):
+    key = flags.get("key")
+    if not isinstance(key, str):
+        raise CommandError("key=KEY required")
+    broker.metadata.put(API_KEY_PREFIX, key, {"created": time.time()})
+    return f"api key added"
+
+
+def _api_key_show(broker, flags):
+    return {"table": [{"key": k} for k, _ in broker.metadata.fold(API_KEY_PREFIX)]}
+
+
+def _api_key_delete(broker, flags):
+    key = flags.get("key")
+    if not isinstance(key, str):
+        raise CommandError("key=KEY required")
+    broker.metadata.delete(API_KEY_PREFIX, key)
+    return "api key deleted"
+
+
+def valid_api_key(broker, key: str) -> bool:
+    return broker.metadata.get(API_KEY_PREFIX, key) is not None

@@ -1,0 +1,54 @@
+"""Graphite reporter: periodic plaintext-protocol push of all metrics
+(``vmq_graphite.erl:118-130`` — one ``<prefix>vmq.<node>.<metric> <value>
+<ts>\\n`` line per metric over TCP, reconnect on failure)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+log = logging.getLogger("vernemq_tpu.graphite")
+
+
+class GraphiteReporter:
+    def __init__(self, broker):
+        self.broker = broker
+        self._task: Optional[asyncio.Task] = None
+
+    def start(self) -> None:
+        self._task = asyncio.get_event_loop().create_task(self._run())
+        self.broker._bg_tasks.append(self._task)
+
+    async def _run(self) -> None:
+        cfg = self.broker.config
+        writer: Optional[asyncio.StreamWriter] = None
+        while True:
+            await asyncio.sleep(cfg.graphite_interval)
+            if writer is None:
+                try:
+                    _, writer = await asyncio.wait_for(
+                        asyncio.open_connection(cfg.graphite_host,
+                                                cfg.graphite_port), 5.0)
+                except (OSError, asyncio.TimeoutError) as e:
+                    log.debug("graphite connect failed: %s", e)
+                    continue
+            prefix = cfg.graphite_prefix
+            if prefix and not prefix.endswith("."):
+                prefix += "."
+            node = self.broker.node_name
+            now = int(time.time())
+            lines = [
+                f"{prefix}vmq.{node}.{name} {value} {now}\n"
+                for name, value in self.broker.metrics.all_metrics().items()
+            ]
+            try:
+                writer.write("".join(lines).encode())
+                await writer.drain()
+            except (OSError, ConnectionError):
+                try:
+                    writer.close()
+                except Exception:
+                    pass
+                writer = None

@@ -1,0 +1,258 @@
+"""vmq_ql — SQL-ish SELECT over live broker rows.
+
+The reference ships a small query language (``apps/vmq_ql``,
+``vmq_ql_query.erl:146-178``) used by ``vmq-admin session show``: rows are
+built lazily from live sessions/queues/subscriptions via row initializers
+(``vmq_info.erl:24-66``) and filtered by a WHERE expression. This module
+reproduces that: ``session_rows`` is the row initializer; ``query`` parses
+``SELECT f1,f2 FROM sessions WHERE x=1 AND (y>2 OR z!=3) LIMIT n``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional
+
+
+def session_rows(broker) -> Iterator[Dict[str, Any]]:
+    """One row per (queue, session) pair — offline queues included, like the
+    reference's session listing which walks queues (vmq_info.erl:24-66)."""
+    for sid, queue in list(broker.registry.queues.items()):
+        mountpoint, client_id = sid
+        base = {
+            "client_id": client_id,
+            "mountpoint": mountpoint,
+            "node": broker.node_name,
+            "queue_state": queue.state,
+            "offline_messages": len(queue.offline),
+            "queue_size": len(queue.offline),
+            "deliver_mode": queue.opts.deliver_mode,
+            "queue_started_at": queue.created,
+            "is_offline": queue.state == "offline",
+            "num_sessions": len(queue.sessions),
+        }
+        session = broker.sessions.get(sid)
+        if session is None:
+            yield {**base, "is_online": False, "user": None,
+                   "peer_host": None, "peer_port": None, "protocol": None,
+                   "clean_session": queue.opts.clean_session,
+                   "waiting_acks": 0}
+        else:
+            info = session.info()
+            yield {**base, "is_online": True, **info}
+
+
+def subscription_rows(broker) -> Iterator[Dict[str, Any]]:
+    for sid, subs in list(broker.registry.subscriptions.items()):
+        rec = broker.registry.db.read(sid)
+        node = rec.node if rec is not None else broker.node_name
+        for words, opts in subs.items():
+            yield {
+                "client_id": sid[1], "mountpoint": sid[0],
+                "topic": "/".join(words), "qos": opts.qos, "node": node,
+                "no_local": getattr(opts, "no_local", False),
+                "rap": getattr(opts, "retain_as_published", False),
+            }
+
+
+def retain_rows(broker) -> Iterator[Dict[str, Any]]:
+    for words, rm in broker.retain.items():
+        yield {"topic": "/".join(words), "payload": rm.payload.decode("latin1"),
+               "payload_size": len(rm.payload), "qos": rm.qos}
+
+
+TABLES: Dict[str, Callable[[Any], Iterator[Dict[str, Any]]]] = {
+    "sessions": session_rows,
+    "subscriptions": subscription_rows,
+    "retain": retain_rows,
+}
+
+
+# --------------------------------------------------------------- QL parser
+
+_TOKEN = re.compile(r"""
+    \s*(?:
+      (?P<kw>SELECT|FROM|WHERE|LIMIT|AND|OR|NOT)\b
+    | (?P<op><=|>=|!=|=|<|>)
+    | (?P<num>-?\d+(?:\.\d+)?)
+    | (?P<str>"[^"]*"|'[^']*')
+    | (?P<word>[\w\$\#\+\/\.\*-]+)
+    | (?P<punc>[(),])
+    )""", re.VERBOSE | re.IGNORECASE)
+
+
+class QLError(Exception):
+    pass
+
+
+def _tokenize(text: str) -> List[tuple]:
+    out, pos = [], 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            if text[pos:].strip():
+                raise QLError(f"bad token at: {text[pos:pos+20]!r}")
+            break
+        pos = m.end()
+        for kind in ("kw", "op", "num", "str", "word", "punc"):
+            v = m.group(kind)
+            if v is not None:
+                if kind == "kw":
+                    v = v.upper()
+                if kind == "str":
+                    v = v[1:-1]
+                if kind == "num":
+                    v = float(v) if "." in v else int(v)
+                out.append((kind, v))
+                break
+    return out
+
+
+class _Parser:
+    """Recursive-descent over: expr := term (OR term)*; term := factor
+    (AND factor)*; factor := NOT factor | '(' expr ')' | field op value."""
+
+    def __init__(self, tokens: List[tuple]):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else (None, None)
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def expr(self) -> Callable[[Dict], bool]:
+        left = self.term()
+        while self.peek() == ("kw", "OR"):
+            self.next()
+            right = self.term()
+            l = left
+            left = lambda row, l=l, r=right: l(row) or r(row)
+        return left
+
+    def term(self) -> Callable[[Dict], bool]:
+        left = self.factor()
+        while self.peek() == ("kw", "AND"):
+            self.next()
+            right = self.factor()
+            l = left
+            left = lambda row, l=l, r=right: l(row) and r(row)
+        return left
+
+    def factor(self) -> Callable[[Dict], bool]:
+        kind, val = self.peek()
+        if (kind, val) == ("kw", "NOT"):
+            self.next()
+            inner = self.factor()
+            return lambda row: not inner(row)
+        if (kind, val) == ("punc", "("):
+            self.next()
+            inner = self.expr()
+            if self.next() != ("punc", ")"):
+                raise QLError("expected )")
+            return inner
+        return self.comparison()
+
+    def comparison(self) -> Callable[[Dict], bool]:
+        kind, field = self.next()
+        if kind not in ("word", "str"):
+            raise QLError(f"expected field name, got {field!r}")
+        opk, op = self.next()
+        if opk != "op":
+            raise QLError(f"expected operator after {field}, got {op!r}")
+        vk, value = self.next()
+        if vk not in ("num", "str", "word"):
+            raise QLError(f"expected value, got {value!r}")
+        if vk == "word" and isinstance(value, str):
+            low = value.lower()
+            if low in ("true", "false"):
+                value = low == "true"
+            elif low in ("null", "undefined"):
+                value = None
+
+        def cmp(row: Dict, f=field, o=op, v=value) -> bool:
+            rv = row.get(f)
+            try:
+                if o == "=":
+                    return rv == v
+                if o == "!=":
+                    return rv != v
+                if rv is None or v is None:
+                    return False
+                if o == "<":
+                    return rv < v
+                if o == ">":
+                    return rv > v
+                if o == "<=":
+                    return rv <= v
+                if o == ">=":
+                    return rv >= v
+            except TypeError:
+                return False
+            return False
+
+        return cmp
+
+
+def parse(text: str) -> Dict[str, Any]:
+    toks = _tokenize(text)
+    p = _Parser(toks)
+    if p.next() != ("kw", "SELECT"):
+        raise QLError("query must start with SELECT")
+    fields: List[str] = []
+    while True:
+        kind, v = p.next()
+        if kind == "word" and v == "*":
+            fields = []
+        elif kind in ("word", "str"):
+            fields.append(str(v))
+        else:
+            raise QLError(f"bad select field: {v!r}")
+        if p.peek() == ("punc", ","):
+            p.next()
+            continue
+        break
+    if p.next() != ("kw", "FROM"):
+        raise QLError("expected FROM")
+    kind, table = p.next()
+    if kind != "word":
+        raise QLError("expected table name")
+    where: Optional[Callable[[Dict], bool]] = None
+    limit = None
+    if p.peek() == ("kw", "WHERE"):
+        p.next()
+        where = p.expr()
+    if p.peek() == ("kw", "LIMIT"):
+        p.next()
+        kind, limit = p.next()
+        if kind != "num":
+            raise QLError("LIMIT needs a number")
+    if p.peek() != (None, None):
+        raise QLError(f"trailing tokens: {p.peek()[1]!r}")
+    return {"fields": fields, "table": str(table).lower(), "where": where,
+            "limit": int(limit) if limit is not None else None}
+
+
+def query(broker, text: str) -> List[Dict[str, Any]]:
+    """Run a QL query against live broker state (fold_query equivalent,
+    vmq_ql_query_mgr)."""
+    q = parse(text)
+    init = TABLES.get(q["table"])
+    if init is None:
+        raise QLError(f"unknown table {q['table']!r}; "
+                      f"tables: {', '.join(sorted(TABLES))}")
+    out: List[Dict[str, Any]] = []
+    limit = q["limit"]
+    for row in init(broker):
+        if limit is not None and len(out) >= limit:
+            break
+        if q["where"] is not None and not q["where"](row):
+            continue
+        if q["fields"]:
+            out.append({f: row.get(f) for f in q["fields"]})
+        else:
+            out.append(dict(row))
+    return out

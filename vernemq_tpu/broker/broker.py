@@ -60,6 +60,9 @@ class Broker:
         # queue pids; a direct map is equivalent single-node)
         self.sessions: Dict[SubscriberId, Any] = {}
         self._delayed_wills: Dict[SubscriberId, asyncio.Task] = {}
+        self.http: Optional[Any] = None
+        self.graphite: Optional[Any] = None
+        self.listeners: Optional[Any] = None  # ListenerManager (transports)
         self._servers: List[Any] = []
         self._bg_tasks: List[asyncio.Task] = []
         self._started = time.time()
@@ -325,6 +328,18 @@ class Broker:
         if self.config.systree_enabled:
             self._bg_tasks.append(asyncio.get_event_loop().create_task(
                 self.start_systree()))
+        if self.config.http_enabled:
+            from ..admin.http import HttpServer
+
+            self.http = HttpServer(self, self.config.http_host,
+                                   self.config.http_port,
+                                   tuple(self.config.http_modules))
+            await self.http.start()
+        if self.config.graphite_enabled:
+            from ..admin.graphite import GraphiteReporter
+
+            self.graphite = GraphiteReporter(self)
+            self.graphite.start()
 
     async def stop(self) -> None:
         for t in self._bg_tasks:

@@ -54,6 +54,16 @@ DEFAULTS: Dict[str, Any] = {
     "systree_enabled": True,
     "systree_interval": 20,
     "graphite_enabled": False,
+    "graphite_host": "localhost",
+    "graphite_port": 2003,
+    "graphite_interval": 20,
+    "graphite_prefix": "",
+    # http endpoints (vmq_http_config.erl http_modules)
+    "http_enabled": False,
+    "http_host": "127.0.0.1",
+    "http_port": 8888,
+    "http_modules": ["metrics", "health", "status", "mgmt"],
+    "http_mgmt_api_auth": True,
     # storage
     "message_store": "memory",  # memory | file
     "message_store_dir": "./data/msgstore",
@@ -65,7 +75,11 @@ class Config:
     """Override layers: constructor kwargs > set() calls > DEFAULTS."""
 
     def __init__(self, **overrides: Any):
-        self._values: Dict[str, Any] = dict(DEFAULTS)
+        import copy
+
+        # deep copy: DEFAULTS holds mutable values (http_modules list) that
+        # must not be shared across Config instances
+        self._values: Dict[str, Any] = copy.deepcopy(DEFAULTS)
         for k, v in overrides.items():
             if k not in DEFAULTS:
                 raise KeyError(f"unknown config key: {k}")

@@ -89,6 +89,9 @@ class Metrics:
     def value(self, name: str) -> int:
         return self._counters.get(name, 0)
 
+    def describe(self, name: str) -> str:
+        return self._descriptions.get(name) or self._gauge_desc.get(name, "")
+
     def register_gauges(
         self, provider: Callable[[], Dict[str, float]], descriptions: Dict[str, str]
     ) -> None:

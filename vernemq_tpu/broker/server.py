@@ -217,6 +217,11 @@ def main() -> None:  # pragma: no cover
                              "image's jax ignores the JAX_PLATFORMS env var — "
                              "only jax.config takes effect")
     parser.add_argument("--node-name", default="node1")
+    parser.add_argument("--http-port", type=int, default=None,
+                        help="start the HTTP endpoint (metrics/health/"
+                             "status/mgmt API) on this port")
+    parser.add_argument("--no-mgmt-auth", action="store_true",
+                        help="disable api-key auth on the management API")
     parser.add_argument("--cluster-listen", default=None, metavar="HOST:PORT",
                         help="start the inter-node cluster listener")
     parser.add_argument("--join", default=None, metavar="HOST:PORT",
@@ -234,17 +239,27 @@ def main() -> None:  # pragma: no cover
     async def _run():
         from .config import Config
 
+        cfg = Config(default_reg_view=args.reg_view)
+        if args.http_port is not None:
+            cfg.set("http_enabled", True)
+            cfg.set("http_port", args.http_port)
+            cfg.set("http_host", args.host)
+        if args.no_mgmt_auth:
+            cfg.set("http_mgmt_api_auth", False)
         broker, server = await start_broker(
-            Config(default_reg_view=args.reg_view), host=args.host,
+            cfg, host=args.host,
             port=args.port, node_name=args.node_name,
             cluster_listen=_addr(args.cluster_listen) if args.cluster_listen else None,
             join=_addr(args.join) if args.join else None,
         )
         print(f"vernemq_tpu broker {args.node_name} listening on "
-              f"{args.host}:{server.port}")
+              f"{args.host}:{server.port}", flush=True)
+        if broker.http is not None:
+            print(f"http endpoint on {broker.http.host}:{broker.http.port}",
+                  flush=True)
         if broker.cluster is not None:
             print(f"cluster listener on {broker.cluster.listen_host}:"
-                  f"{broker.cluster.listen_port}")
+                  f"{broker.cluster.listen_port}", flush=True)
         await asyncio.Event().wait()
 
     asyncio.run(_run())
