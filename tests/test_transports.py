@@ -1,0 +1,350 @@
+"""Transport tests: WebSocket, TLS, PROXY protocol, listener manager
+(vmq_websocket / vmq_ssl_SUITE / vmq_proxy_protocol_SUITE shapes)."""
+
+import asyncio
+import base64
+import hashlib
+import os
+import ssl
+
+import pytest
+
+from vernemq_tpu.broker import proxy_proto
+from vernemq_tpu.broker.config import Config
+from vernemq_tpu.broker.listeners import ListenerManager
+from vernemq_tpu.broker.server import start_broker
+from vernemq_tpu.broker.websocket import (
+    OP_BINARY,
+    OP_CLOSE,
+    OP_PING,
+    OP_PONG,
+    accept_key,
+    encode_frame,
+)
+from vernemq_tpu.client import MQTTClient
+from vernemq_tpu.protocol import codec_v4
+from vernemq_tpu.protocol.types import Connack, Connect, Pingreq, Pingresp, Publish, Suback, Subscribe, SubOpts
+
+SSL_DIR = os.path.join(os.path.dirname(__file__), "ssl")
+
+
+@pytest.fixture
+def broker(event_loop):
+    b, server = event_loop.run_until_complete(
+        start_broker(Config(systree_enabled=False), port=0))
+    yield b, server
+    event_loop.run_until_complete(b.stop())
+    event_loop.run_until_complete(server.stop())
+
+
+# ------------------------------------------------------------------ helpers
+
+class WsTestClient:
+    """Minimal RFC6455 client: handshake + masked binary frames carrying
+    MQTT bytes (the browser side of vmq_websocket)."""
+
+    def __init__(self, host, port, subprotocol="mqtt"):
+        self.host, self.port = host, port
+        self.subprotocol = subprotocol
+        self.buf = b""
+
+    async def connect(self):
+        self.reader, self.writer = await asyncio.open_connection(
+            self.host, self.port)
+        key = base64.b64encode(os.urandom(16)).decode()
+        req = (f"GET /mqtt HTTP/1.1\r\nHost: {self.host}\r\n"
+               "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+               f"Sec-WebSocket-Key: {key}\r\n"
+               "Sec-WebSocket-Version: 13\r\n"
+               f"Sec-WebSocket-Protocol: {self.subprotocol}\r\n\r\n")
+        self.writer.write(req.encode())
+        head = await self.reader.readuntil(b"\r\n\r\n")
+        text = head.decode()
+        assert "101" in text.split("\r\n")[0], text
+        assert accept_key(key) in text
+        return text
+
+    def send_mqtt(self, frame, codec=codec_v4):
+        self.writer.write(
+            encode_frame(OP_BINARY, codec.serialise(frame), mask=True))
+
+    def send_raw(self, opcode, payload, mask=True):
+        self.writer.write(encode_frame(opcode, payload, mask=mask))
+
+    async def recv_frame(self):
+        import struct
+
+        hdr = await self.reader.readexactly(2)
+        opcode = hdr[0] & 0x0F
+        n = hdr[1] & 0x7F
+        if n == 126:
+            n = struct.unpack(">H", await self.reader.readexactly(2))[0]
+        elif n == 127:
+            n = struct.unpack(">Q", await self.reader.readexactly(8))[0]
+        payload = await self.reader.readexactly(n)
+        return opcode, payload
+
+    async def recv_mqtt(self, codec=codec_v4):
+        while True:
+            frame, rest = codec.parse(memoryview(self.buf), 1 << 20)
+            if frame is not None:
+                self.buf = bytes(rest)
+                return frame
+            opcode, payload = await self.recv_frame()
+            if opcode == OP_CLOSE:
+                return None
+            if opcode == OP_BINARY:
+                self.buf += payload
+
+
+# ---------------------------------------------------------------- WebSocket
+
+@pytest.mark.asyncio
+async def test_ws_connect_publish_subscribe(broker):
+    b, _ = broker
+    lm = b.listeners
+    ws_server = await lm.start_listener("ws", "127.0.0.1", 0)
+    c = WsTestClient("127.0.0.1", ws_server.port)
+    await c.connect()
+    c.send_mqtt(Connect(client_id="wsc1"))
+    ack = await asyncio.wait_for(c.recv_mqtt(), 5)
+    assert isinstance(ack, Connack) and ack.rc == 0
+    c.send_mqtt(Subscribe(packet_id=1, topics=[("ws/t", SubOpts(qos=0))]))
+    suback = await asyncio.wait_for(c.recv_mqtt(), 5)
+    assert isinstance(suback, Suback)
+    # a TCP client publishes; the WS client must receive it
+    tcp = MQTTClient("127.0.0.1", broker[1].port, client_id="tcp1")
+    await tcp.connect()
+    await tcp.publish("ws/t", b"cross-transport")
+    pub = await asyncio.wait_for(c.recv_mqtt(), 5)
+    assert isinstance(pub, Publish) and pub.payload == b"cross-transport"
+    await tcp.disconnect()
+    c.writer.close()
+
+
+@pytest.mark.asyncio
+async def test_ws_ping_pong_and_fragmentation(broker):
+    b, _ = broker
+    ws_server = await b.listeners.start_listener("ws", "127.0.0.1", 0)
+    c = WsTestClient("127.0.0.1", ws_server.port)
+    await c.connect()
+    # ws-level ping answered with pong
+    c.send_raw(OP_PING, b"hi")
+    opcode, payload = await asyncio.wait_for(c.recv_frame(), 5)
+    assert opcode == OP_PONG and payload == b"hi"
+    # CONNECT split across two ws fragments (FIN=0 + continuation)
+    data = codec_v4.serialise(Connect(client_id="frag"))
+    import struct
+
+    k1, k2 = os.urandom(4), os.urandom(4)
+    part1 = bytes(x ^ k1[i % 4] for i, x in enumerate(data[:3]))
+    part2 = bytes(x ^ k2[i % 4] for i, x in enumerate(data[3:]))
+    c.writer.write(bytes([0x02, 0x80 | len(part1)]) + k1 + part1)
+    c.writer.write(bytes([0x80, 0x80 | len(part2)]) + k2 + part2)
+    ack = await asyncio.wait_for(c.recv_mqtt(), 5)
+    assert isinstance(ack, Connack) and ack.rc == 0
+    # MQTT-level ping inside ws frames
+    c.send_mqtt(Pingreq())
+    frame = await asyncio.wait_for(c.recv_mqtt(), 5)
+    assert isinstance(frame, Pingresp)
+    c.writer.close()
+
+
+@pytest.mark.asyncio
+async def test_ws_rejects_bad_handshake(broker):
+    b, _ = broker
+    ws_server = await b.listeners.start_listener("ws", "127.0.0.1", 0)
+    reader, writer = await asyncio.open_connection("127.0.0.1", ws_server.port)
+    writer.write(b"GET /mqtt HTTP/1.1\r\nHost: x\r\n\r\n")  # no upgrade headers
+    line = await asyncio.wait_for(reader.readline(), 5)
+    assert b"400" in line
+    writer.close()
+
+
+@pytest.mark.asyncio
+async def test_ws_rejects_unknown_subprotocol(broker):
+    b, _ = broker
+    ws_server = await b.listeners.start_listener("ws", "127.0.0.1", 0)
+    c = WsTestClient("127.0.0.1", ws_server.port, subprotocol="nope")
+    reader, writer = await asyncio.open_connection("127.0.0.1", ws_server.port)
+    key = base64.b64encode(os.urandom(16)).decode()
+    writer.write((f"GET /mqtt HTTP/1.1\r\nHost: x\r\n"
+                  "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+                  f"Sec-WebSocket-Key: {key}\r\n"
+                  "Sec-WebSocket-Protocol: bogus\r\n\r\n").encode())
+    line = await asyncio.wait_for(reader.readline(), 5)
+    assert b"400" in line
+    writer.close()
+
+
+# --------------------------------------------------------------------- TLS
+
+def _client_ctx(**kw):
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(os.path.join(SSL_DIR, "ca.crt"))
+    if kw.get("cert"):
+        ctx.load_cert_chain(os.path.join(SSL_DIR, "client.crt"),
+                            os.path.join(SSL_DIR, "client.key"))
+    ctx.check_hostname = False
+    return ctx
+
+
+@pytest.mark.asyncio
+async def test_mqtts_basic(broker):
+    b, _ = broker
+    srv = await b.listeners.start_listener("mqtts", "127.0.0.1", 0, {
+        "certfile": os.path.join(SSL_DIR, "server.crt"),
+        "keyfile": os.path.join(SSL_DIR, "server.key"),
+    })
+    c = MQTTClient("127.0.0.1", srv.port, client_id="tls1",
+                   ssl_context=_client_ctx())
+    ack = await c.connect()
+    assert ack.rc == 0
+    await c.publish("tls/t", b"secure", qos=1)
+    await c.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_mqtts_client_cert_as_username(broker):
+    b, _ = broker
+    seen = {}
+
+    async def auth_on_register(peer, sid, username, password, clean):
+        seen["username"] = username
+        return "ok"
+
+    b.hooks.register("auth_on_register", auth_on_register)
+    srv = await b.listeners.start_listener("mqtts", "127.0.0.1", 0, {
+        "certfile": os.path.join(SSL_DIR, "server.crt"),
+        "keyfile": os.path.join(SSL_DIR, "server.key"),
+        "cafile": os.path.join(SSL_DIR, "ca.crt"),
+        "require_certificate": True,
+        "use_identity_as_username": True,
+    })
+    c = MQTTClient("127.0.0.1", srv.port, client_id="tls2",
+                   username="ignored-by-listener",
+                   ssl_context=_client_ctx(cert=True))
+    ack = await c.connect()
+    assert ack.rc == 0
+    assert seen["username"] == "client-identity"
+    await c.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_mqtts_requires_certificate(broker):
+    b, _ = broker
+    srv = await b.listeners.start_listener("mqtts", "127.0.0.1", 0, {
+        "certfile": os.path.join(SSL_DIR, "server.crt"),
+        "keyfile": os.path.join(SSL_DIR, "server.key"),
+        "cafile": os.path.join(SSL_DIR, "ca.crt"),
+        "require_certificate": True,
+    })
+    c = MQTTClient("127.0.0.1", srv.port, client_id="tls3",
+                   ssl_context=_client_ctx())  # no client cert
+    with pytest.raises((ssl.SSLError, ConnectionError, asyncio.TimeoutError)):
+        await c.connect(timeout=3)
+
+
+# ------------------------------------------------------------ PROXY protocol
+
+def test_proxy_v1_roundtrip():
+    hdr = proxy_proto.build_v1(("10.1.2.3", 1234), ("10.9.9.9", 1883))
+    assert hdr == b"PROXY TCP4 10.1.2.3 10.9.9.9 1234 1883\r\n"
+
+
+def test_proxy_v2_cn_tlv():
+    blob = proxy_proto.build_v2(("10.1.2.3", 55), ("10.0.0.1", 1883),
+                                cn="proxy-client")
+    assert blob.startswith(proxy_proto.V2_SIG)
+    assert proxy_proto._find_cn(blob[16 + 12:]) == "proxy-client"
+
+
+@pytest.mark.asyncio
+async def test_proxy_v1_listener(broker):
+    b, _ = broker
+    srv = await b.listeners.start_listener("mqtt", "127.0.0.1", 0,
+                                           {"proxy_protocol": True})
+    reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+    writer.write(proxy_proto.build_v1(("192.0.2.7", 4321), ("10.0.0.1", 1883)))
+    writer.write(codec_v4.serialise(Connect(client_id="pp1")))
+    buf = await asyncio.wait_for(reader.read(64), 5)
+    ack, _ = codec_v4.parse(memoryview(buf), 1 << 20)
+    assert isinstance(ack, Connack) and ack.rc == 0
+    # the session must see the proxied source address
+    sess = b.sessions[("", "pp1")]
+    assert sess.peer == ("192.0.2.7", 4321)
+    writer.close()
+
+
+@pytest.mark.asyncio
+async def test_proxy_v2_listener_with_cn_username(broker):
+    b, _ = broker
+    seen = {}
+
+    async def auth_on_register(peer, sid, username, password, clean):
+        seen["username"] = username
+        seen["peer"] = peer
+        return "ok"
+
+    b.hooks.register("auth_on_register", auth_on_register)
+    srv = await b.listeners.start_listener("mqtt", "127.0.0.1", 0, {
+        "proxy_protocol": True, "use_identity_as_username": True})
+    reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+    writer.write(proxy_proto.build_v2(("198.51.100.2", 999), ("10.0.0.1", 1883),
+                                      cn="lb-client"))
+    writer.write(codec_v4.serialise(Connect(client_id="pp2")))
+    buf = await asyncio.wait_for(reader.read(64), 5)
+    ack, _ = codec_v4.parse(memoryview(buf), 1 << 20)
+    assert isinstance(ack, Connack) and ack.rc == 0
+    assert seen["username"] == "lb-client"
+    assert seen["peer"] == ("198.51.100.2", 999)
+    writer.close()
+
+
+@pytest.mark.asyncio
+async def test_proxy_rejects_garbage(broker):
+    b, _ = broker
+    srv = await b.listeners.start_listener("mqtt", "127.0.0.1", 0,
+                                           {"proxy_protocol": True})
+    reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+    writer.write(b"\x10\x20not-a-proxy-header")
+    data = await asyncio.wait_for(reader.read(64), 5)
+    assert data == b""  # dropped without CONNACK
+    writer.close()
+
+
+# ---------------------------------------------------------- listener manager
+
+@pytest.mark.asyncio
+async def test_listener_show_and_stop(broker):
+    b, _ = broker
+    lm = b.listeners
+    ws_server = await lm.start_listener("ws", "127.0.0.1", 0)
+    rows = lm.show()
+    kinds = {r["type"] for r in rows}
+    assert "mqtt" in kinds and "ws" in kinds
+    lm.stop_listener("127.0.0.1", ws_server.port)
+    assert all(r["port"] != ws_server.port for r in lm.show())
+
+
+@pytest.mark.asyncio
+async def test_listener_mountpoint(broker):
+    """Per-listener mountpoint isolates topic spaces (multitenancy)."""
+    b, _ = broker
+    srv = await b.listeners.start_listener("mqtt", "127.0.0.1", 0,
+                                           {"mountpoint": "tenant-a"})
+    ca = MQTTClient("127.0.0.1", srv.port, client_id="mp-a")
+    await ca.connect()
+    await ca.subscribe("iso/t", qos=0)
+    # default-mountpoint publisher must NOT reach the tenant subscriber
+    c0 = MQTTClient("127.0.0.1", broker[1].port, client_id="mp-0")
+    await c0.connect()
+    await c0.publish("iso/t", b"default-mp")
+    # tenant publisher does
+    cb = MQTTClient("127.0.0.1", srv.port, client_id="mp-b")
+    await cb.connect()
+    await cb.publish("iso/t", b"tenant-mp")
+    msg = await asyncio.wait_for(ca.messages.get(), 5)
+    assert msg.payload == b"tenant-mp"
+    assert ca.messages.empty()
+    await ca.disconnect(); await cb.disconnect(); await c0.disconnect()

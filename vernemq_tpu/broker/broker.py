@@ -342,6 +342,8 @@ class Broker:
             self.graphite.start()
 
     async def stop(self) -> None:
+        if self.listeners is not None:
+            await self.listeners.stop_all()
         for t in self._bg_tasks:
             t.cancel()
         for t in self._delayed_wills.values():

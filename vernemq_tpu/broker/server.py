@@ -18,6 +18,7 @@ from ..protocol import codec_v4, codec_v5, wire
 from ..protocol.types import PROTO_5, Connect, ParseError
 from .broker import Broker
 from .session import Session, Transport
+from .websocket import WsError
 
 log = logging.getLogger("vernemq_tpu.server")
 
@@ -73,18 +74,124 @@ def sniff_proto_ver(body: bytes) -> int:
     return body[pos] & 0x7F
 
 
+async def mqtt_connection(
+    broker: Broker,
+    read_chunk,
+    transport: Transport,
+    peer: Tuple[str, int],
+    max_frame_size: int = MAX_FRAME_SIZE,
+    initial: bytes = b"",
+    preauth_user: Optional[str] = None,
+    mountpoint: str = "",
+) -> None:
+    """The per-connection MQTT byte loop, transport-agnostic: ``read_chunk``
+    is an awaitable returning the next bytes (b"" on EOF), ``transport``
+    writes outbound frames. TCP, TLS, WebSocket and PROXY-wrapped listeners
+    all drive their sockets through this one loop (the reference funnels all
+    transports into the same FSM contract, vmq_ranch.erl:167-251).
+    ``preauth_user`` overrides the CONNECT username (TLS client-cert CN or
+    PROXY identity, vmq_ranch.erl:59-72); ``mountpoint`` is the listener's
+    multitenancy prefix (per-listener mountpoint config)."""
+    metrics = broker.metrics
+    metrics.incr("socket_open")
+    session: Optional[Session] = None
+    buf = initial
+    try:
+        # ---- pre-init: wait for CONNECT, pick protocol ----------------
+        first = wire.split_frame(buf, max_frame_size) if buf else None
+        async with asyncio.timeout(CONNECT_TIMEOUT):
+            while first is None:
+                chunk = await read_chunk()
+                if not chunk:
+                    return
+                metrics.incr("bytes_received", len(chunk))
+                buf += chunk
+                first = wire.split_frame(buf, max_frame_size)
+        ptype, flags, body, rest = first
+        if ptype != 1:  # must be CONNECT
+            return
+        proto_ver = sniff_proto_ver(body)
+        if proto_ver == PROTO_5:
+            codec = codec_v5
+        elif proto_ver in (3, 4):
+            codec = codec_v4
+        else:
+            # unknown protocol level: v4-style CONNACK rc=1
+            transport.write(b"\x20\x02\x00\x01")
+            return
+        connect_frame = codec._parse_body(ptype, flags, body)
+        if preauth_user is not None:
+            connect_frame.username = preauth_user
+        session = Session(broker, transport, proto_ver, peer=peer,
+                          mountpoint=mountpoint)
+        ok = await session.handle_connect(connect_frame)
+        if not ok and not session._pending_connect:
+            return
+
+        # ---- steady-state frame loop ---------------------------------
+        buf = bytes(rest)
+        while not session.closed:
+            view = memoryview(buf)
+            while True:
+                frame, view = codec.parse(view, max_frame_size)
+                if frame is None:
+                    break
+                await session.handle_frame(frame)
+                if session.closed:
+                    break
+            buf = bytes(view)
+            if session.closed:
+                break
+            if session.connected:
+                chunk = await read_chunk()
+            else:
+                # still inside the CONNECT/enhanced-AUTH exchange: keep
+                # the pre-init deadline so parked half-auth connections
+                # can't pin sockets forever
+                chunk = await asyncio.wait_for(read_chunk(), CONNECT_TIMEOUT)
+            if not chunk:
+                break
+            metrics.incr("bytes_received", len(chunk))
+            buf += chunk
+    except (asyncio.TimeoutError, TimeoutError):
+        pass
+    except ParseError as e:
+        log.debug("parse error from %s: %s", peer, e.reason)
+        metrics.incr("socket_error")
+    except WsError as e:
+        log.debug("websocket error from %s: %s", peer, e)
+        metrics.incr("socket_error")
+    except ConnectionError:
+        metrics.incr("socket_error")
+    except Exception:
+        log.exception("connection handler crashed")
+        metrics.incr("socket_error")
+    finally:
+        if session is not None and not session.closed:
+            await session.close("connection_lost")
+        transport.close()
+        metrics.incr("socket_close")
+
+
 class MQTTServer:
     def __init__(self, broker: Broker, host: str = "127.0.0.1", port: int = 1883,
-                 max_frame_size: int = 0):
+                 max_frame_size: int = 0, ssl_context=None,
+                 proxy_protocol: bool = False,
+                 use_identity_as_username: bool = False,
+                 mountpoint: str = ""):
         self.broker = broker
         self.host = host
         self.port = port
         self.max_frame_size = max_frame_size or MAX_FRAME_SIZE
+        self.ssl_context = ssl_context
+        self.proxy_protocol = proxy_protocol
+        self.use_identity_as_username = use_identity_as_username
+        self.mountpoint = mountpoint
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port
+            self._handle_conn, self.host, self.port, ssl=self.ssl_context
         )
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
@@ -98,85 +205,47 @@ class MQTTServer:
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        metrics = self.broker.metrics
-        metrics.incr("socket_open")
         peer = writer.get_extra_info("peername") or ("", 0)
-        transport = StreamTransport(writer)
-        session: Optional[Session] = None
-        buf = b""
-        try:
-            # ---- pre-init: wait for CONNECT, pick protocol ----------------
-            first = None
-            async with asyncio.timeout(CONNECT_TIMEOUT):
-                while first is None:
-                    chunk = await reader.read(65536)
-                    if not chunk:
-                        return
-                    metrics.incr("bytes_received", len(chunk))
-                    buf += chunk
-                    first = wire.split_frame(buf, self.max_frame_size)
-            ptype, flags, body, rest = first
-            if ptype != 1:  # must be CONNECT
-                return
-            proto_ver = sniff_proto_ver(body)
-            if proto_ver == PROTO_5:
-                codec = codec_v5
-            elif proto_ver in (3, 4):
-                codec = codec_v4
-            else:
-                # unknown protocol level: v4-style CONNACK rc=1
-                transport.write(b"\x20\x02\x00\x01")
-                return
-            connect_frame = codec._parse_body(ptype, flags, body)
-            session = Session(self.broker, transport, proto_ver, peer=peer)
-            ok = await session.handle_connect(connect_frame)
-            if not ok and not session._pending_connect:
-                return
+        initial = b""
+        preauth: Optional[str] = None
+        if self.proxy_protocol:
+            from .proxy_proto import ProxyProtoError, read_proxy_header
 
-            # ---- steady-state frame loop ---------------------------------
-            buf = bytes(rest)
-            while not session.closed:
-                view = memoryview(buf)
-                while True:
-                    frame, view = codec.parse(view, self.max_frame_size)
-                    if frame is None:
-                        break
-                    await session.handle_frame(frame)
-                    if session.closed:
-                        break
-                buf = bytes(view)
-                if session.closed:
-                    break
-                if session.connected:
-                    chunk = await reader.read(65536)
-                else:
-                    # still inside the CONNECT/enhanced-AUTH exchange: keep
-                    # the pre-init deadline so parked half-auth connections
-                    # can't pin sockets forever
-                    chunk = await asyncio.wait_for(reader.read(65536), CONNECT_TIMEOUT)
-                if not chunk:
-                    break
-                metrics.incr("bytes_received", len(chunk))
-                buf += chunk
-        except (asyncio.TimeoutError, TimeoutError):
-            pass
-        except ParseError as e:
-            log.debug("parse error from %s: %s", peer, e.reason)
-            metrics.incr("socket_error")
-        except ConnectionError:
-            metrics.incr("socket_error")
-        except Exception:
-            log.exception("connection handler crashed")
-            metrics.incr("socket_error")
+            try:
+                info = await asyncio.wait_for(read_proxy_header(reader),
+                                              CONNECT_TIMEOUT)
+            except (ProxyProtoError, asyncio.TimeoutError, ConnectionError,
+                    asyncio.IncompleteReadError, asyncio.LimitOverrunError):
+                writer.close()
+                return
+            if info.src is not None:
+                peer = info.src
+            if self.use_identity_as_username:
+                if not info.cn:
+                    # identity mapping requires the PP2 SSL CN TLV — same
+                    # policy as the TLS path (no silent fall-through)
+                    writer.close()
+                    return
+                preauth = info.cn
+        else:
+            from .ssl_util import preauth_from_cert
+
+            ok, preauth = preauth_from_cert(
+                writer, self.use_identity_as_username, self.ssl_context)
+            if not ok:
+                writer.close()  # cert required for identity mapping
+                return
+        transport = StreamTransport(writer)
+        try:
+            await mqtt_connection(
+                self.broker, lambda: reader.read(65536), transport, peer,
+                self.max_frame_size, initial=initial, preauth_user=preauth,
+                mountpoint=self.mountpoint)
         finally:
-            if session is not None and not session.closed:
-                await session.close("connection_lost")
-            transport.close()
             try:
                 await writer.wait_closed()
             except Exception:
                 pass
-            metrics.incr("socket_close")
 
 
 async def start_broker(
@@ -192,8 +261,10 @@ async def start_broker(
     seed node."""
     broker = Broker(config, node_name=node_name)
     await broker.start()
-    server = MQTTServer(broker, host, port)
-    await server.start()
+    from .listeners import ListenerManager
+
+    manager = ListenerManager(broker)
+    server = await manager.start_listener("mqtt", host, port)
     if cluster_listen is not None:
         from ..cluster import Cluster
 

@@ -42,7 +42,8 @@ class MQTTClient:
                  proto_ver: int = 4, clean_start: bool = True,
                  username: Optional[str] = None, password: Optional[bytes] = None,
                  keepalive: int = 60, will: Optional[Will] = None,
-                 properties: Optional[Dict[str, Any]] = None):
+                 properties: Optional[Dict[str, Any]] = None,
+                 ssl_context=None):
         self.host, self.port = host, port
         self.client_id = client_id
         self.proto_ver = proto_ver
@@ -52,6 +53,7 @@ class MQTTClient:
         self.keepalive = keepalive
         self.will = will
         self.connect_properties = properties or {}
+        self.ssl_context = ssl_context
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._buf = b""
@@ -89,7 +91,8 @@ class MQTTClient:
     # ------------------------------------------------------------- connect
 
     async def connect(self, timeout: float = 5.0) -> Connack:
-        self._reader, self._writer = await asyncio.open_connection(self.host, self.port)
+        self._reader, self._writer = await asyncio.open_connection(
+            self.host, self.port, ssl=self.ssl_context)
         self._send(Connect(
             proto_ver=self.proto_ver, client_id=self.client_id,
             username=self.username, password=self.password,
