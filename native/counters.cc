@@ -1,0 +1,77 @@
+// libvmq_counters — wait-free sharded metric counters.
+//
+// The reference keeps hot-path counters in mzmetrics, a C NIF with
+// per-scheduler lock-free counter blocks (vmq_metrics.erl:267-301). This
+// is the same design: each logical counter owns NSHARDS cache-line-padded
+// atomic cells; writers fetch_add(relaxed) their shard, readers sum.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <new>
+
+namespace {
+
+constexpr int NSHARDS = 16;
+
+struct alignas(64) Cell {
+  std::atomic<int64_t> v{0};
+  char pad[64 - sizeof(std::atomic<int64_t>)];
+};
+
+struct Block {
+  uint32_t n;
+  Cell* cells;  // n * NSHARDS
+};
+
+}  // namespace
+
+extern "C" {
+
+Block* ctr_create(uint32_t n) {
+  Block* b = new (std::nothrow) Block();
+  if (!b) return nullptr;
+  b->n = n;
+  b->cells = new (std::nothrow) Cell[(size_t)n * NSHARDS];
+  if (!b->cells) {
+    delete b;
+    return nullptr;
+  }
+  return b;
+}
+
+void ctr_destroy(Block* b) {
+  if (!b) return;
+  delete[] b->cells;
+  delete b;
+}
+
+int ctr_shards(void) { return NSHARDS; }
+
+void ctr_incr(Block* b, uint32_t idx, int64_t delta, uint32_t shard) {
+  if (idx >= b->n) return;
+  b->cells[(size_t)idx * NSHARDS + (shard % NSHARDS)].v.fetch_add(
+      delta, std::memory_order_relaxed);
+}
+
+int64_t ctr_read(Block* b, uint32_t idx) {
+  if (idx >= b->n) return 0;
+  int64_t sum = 0;
+  for (int s = 0; s < NSHARDS; s++)
+    sum += b->cells[(size_t)idx * NSHARDS + s].v.load(
+        std::memory_order_relaxed);
+  return sum;
+}
+
+void ctr_snapshot(Block* b, int64_t* out) {
+  for (uint32_t i = 0; i < b->n; i++) out[i] = ctr_read(b, i);
+}
+
+void ctr_reset(Block* b, uint32_t idx) {
+  if (idx >= b->n) return;
+  for (int s = 0; s < NSHARDS; s++)
+    b->cells[(size_t)idx * NSHARDS + s].v.store(0,
+                                                std::memory_order_relaxed);
+}
+
+}  // extern "C"

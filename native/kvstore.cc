@@ -1,0 +1,333 @@
+// libvmq_kvstore — append-log key-value storage engine with ordered
+// in-memory index and prefix scans.
+//
+// Plays the role the eleveldb C++ NIF plays in the reference (offline
+// message store backend, vmq_lvldb_store.erl:316-358; metadata
+// persistence): ordered keys, prefix iteration, crash recovery. The
+// design is a write-ahead log + std::map index + compaction rather than a
+// full LSM tree — the broker's working set is the index (refs, not
+// payloads), and recovery scans are sequential either way.
+//
+// C ABI (ctypes-friendly); all buffers returned via kv_* getters are
+// malloc'd and must be released with kv_free.
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+namespace {
+
+// CRC32 (IEEE, reflected) — table generated at first use.
+uint32_t crc_table[256];
+std::once_flag crc_once;
+
+void init_crc() {
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++)
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    crc_table[i] = c;
+  }
+}
+
+uint32_t crc32(const uint8_t* data, size_t n, uint32_t crc = 0) {
+  std::call_once(crc_once, init_crc);
+  crc = ~crc;
+  for (size_t i = 0; i < n; i++)
+    crc = crc_table[(crc ^ data[i]) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+constexpr uint8_t OP_PUT = 1;
+constexpr uint8_t OP_DEL = 2;
+constexpr size_t HDR = 4 + 1 + 4 + 4;  // crc op klen vlen
+
+struct Entry {
+  uint64_t value_off;  // offset of value bytes in log
+  uint32_t vlen;
+};
+
+struct Store {
+  std::string path;
+  int fd = -1;
+  std::map<std::string, Entry> index;
+  uint64_t tail = 0;           // append offset
+  uint64_t garbage = 0;        // dead bytes (overwritten / deleted records)
+  uint64_t live = 0;           // live value+key bytes
+  std::mutex mu;
+  std::string err;
+
+  bool append_record(uint8_t op, const std::string& key, const uint8_t* val,
+                     uint32_t vlen, uint64_t* value_off) {
+    uint32_t klen = (uint32_t)key.size();
+    std::vector<uint8_t> rec(HDR + klen + vlen);
+    rec[4] = op;
+    memcpy(&rec[5], &klen, 4);
+    memcpy(&rec[9], &vlen, 4);
+    memcpy(&rec[HDR], key.data(), klen);
+    if (vlen) memcpy(&rec[HDR + klen], val, vlen);
+    uint32_t crc = crc32(&rec[4], rec.size() - 4);
+    memcpy(&rec[0], &crc, 4);
+    ssize_t n = pwrite(fd, rec.data(), rec.size(), (off_t)tail);
+    if (n != (ssize_t)rec.size()) {
+      err = strerror(errno);
+      return false;
+    }
+    if (value_off) *value_off = tail + HDR + klen;
+    tail += rec.size();
+    return true;
+  }
+
+  // Replay the log; truncate at the first torn/corrupt record.
+  bool recover() {
+    struct stat st;
+    if (fstat(fd, &st) != 0) { err = strerror(errno); return false; }
+    uint64_t size = (uint64_t)st.st_size, off = 0;
+    std::vector<uint8_t> hdr(HDR);
+    std::string key;
+    while (off + HDR <= size) {
+      if (pread(fd, hdr.data(), HDR, (off_t)off) != (ssize_t)HDR) break;
+      uint32_t crc, klen, vlen;
+      memcpy(&crc, &hdr[0], 4);
+      memcpy(&klen, &hdr[5], 4);
+      memcpy(&vlen, &hdr[9], 4);
+      uint8_t op = hdr[4];
+      if (klen > (1u << 28) || vlen > (1u << 30)) break;
+      uint64_t rec_end = off + HDR + klen + vlen;
+      if (rec_end > size) break;
+      std::vector<uint8_t> body(1 + 8 + klen + vlen);
+      body[0] = op;
+      memcpy(&body[1], &klen, 4);
+      memcpy(&body[5], &vlen, 4);
+      if (pread(fd, &body[9], klen + vlen, (off_t)(off + HDR)) !=
+          (ssize_t)(klen + vlen))
+        break;
+      if (crc32(body.data(), body.size()) != crc) break;
+      key.assign((char*)&body[9], klen);
+      auto it = index.find(key);
+      if (it != index.end()) {
+        garbage += HDR + key.size() + it->second.vlen;
+        live -= key.size() + it->second.vlen;
+      }
+      if (op == OP_PUT) {
+        index[key] = Entry{off + HDR + klen, vlen};
+        live += key.size() + vlen;
+      } else {
+        if (it != index.end()) index.erase(it);
+        garbage += HDR + klen;
+      }
+      off = rec_end;
+    }
+    tail = off;
+    if (off < size) {
+      if (ftruncate(fd, (off_t)off) != 0) { err = strerror(errno); return false; }
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Store* kv_open(const char* path) {
+  Store* s = new Store();
+  s->path = path;
+  s->fd = open(path, O_RDWR | O_CREAT, 0644);
+  if (s->fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  if (!s->recover()) {
+    close(s->fd);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void kv_close(Store* s) {
+  if (!s) return;
+  if (s->fd >= 0) {
+    fdatasync(s->fd);
+    close(s->fd);
+  }
+  delete s;
+}
+
+int kv_put(Store* s, const uint8_t* key, uint32_t klen, const uint8_t* val,
+           uint32_t vlen) {
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string k((const char*)key, klen);
+  uint64_t voff;
+  auto it = s->index.find(k);
+  if (it != s->index.end()) {
+    s->garbage += HDR + k.size() + it->second.vlen;
+    s->live -= k.size() + it->second.vlen;
+  }
+  if (!s->append_record(OP_PUT, k, val, vlen, &voff)) return -1;
+  s->index[k] = Entry{voff, vlen};
+  s->live += k.size() + vlen;
+  return 0;
+}
+
+// Returns 1 if found (out/out_len set, caller frees), 0 if missing, -1 error.
+int kv_get(Store* s, const uint8_t* key, uint32_t klen, uint8_t** out,
+           uint32_t* out_len) {
+  std::lock_guard<std::mutex> g(s->mu);
+  auto it = s->index.find(std::string((const char*)key, klen));
+  if (it == s->index.end()) return 0;
+  uint8_t* buf = (uint8_t*)malloc(it->second.vlen ? it->second.vlen : 1);
+  if (!buf) return -1;
+  if (pread(s->fd, buf, it->second.vlen, (off_t)it->second.value_off) !=
+      (ssize_t)it->second.vlen) {
+    free(buf);
+    return -1;
+  }
+  *out = buf;
+  *out_len = it->second.vlen;
+  return 1;
+}
+
+int kv_delete(Store* s, const uint8_t* key, uint32_t klen) {
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string k((const char*)key, klen);
+  auto it = s->index.find(k);
+  if (it == s->index.end()) return 0;
+  s->garbage += 2 * HDR + 2 * k.size() + it->second.vlen;
+  s->live -= k.size() + it->second.vlen;
+  s->index.erase(it);
+  if (!s->append_record(OP_DEL, k, nullptr, 0, nullptr)) return -1;
+  return 1;
+}
+
+// Prefix scan in key order. Output blob: repeated
+// [u32 klen][key][u32 vlen][value]; returns count, or -1 on error.
+long kv_scan(Store* s, const uint8_t* prefix, uint32_t plen, uint8_t** out,
+             uint64_t* out_len) {
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string p((const char*)prefix, plen);
+  std::vector<uint8_t> blob;
+  long count = 0;
+  auto it = p.empty() ? s->index.begin() : s->index.lower_bound(p);
+  for (; it != s->index.end(); ++it) {
+    if (!p.empty() && it->first.compare(0, p.size(), p) != 0) break;
+    uint32_t klen = (uint32_t)it->first.size(), vlen = it->second.vlen;
+    size_t base = blob.size();
+    blob.resize(base + 4 + klen + 4 + vlen);
+    memcpy(&blob[base], &klen, 4);
+    memcpy(&blob[base + 4], it->first.data(), klen);
+    memcpy(&blob[base + 4 + klen], &vlen, 4);
+    if (vlen && pread(s->fd, &blob[base + 8 + klen], vlen,
+                      (off_t)it->second.value_off) != (ssize_t)vlen)
+      return -1;
+    count++;
+  }
+  uint8_t* buf = (uint8_t*)malloc(blob.size() ? blob.size() : 1);
+  if (!buf) return -1;
+  memcpy(buf, blob.data(), blob.size());
+  *out = buf;
+  *out_len = blob.size();
+  return count;
+}
+
+// Keys-only prefix scan (no value reads — boot GC scans only need
+// membership). Blob: repeated [u32 klen][key]; returns count or -1.
+long kv_scan_keys(Store* s, const uint8_t* prefix, uint32_t plen,
+                  uint8_t** out, uint64_t* out_len) {
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string p((const char*)prefix, plen);
+  std::vector<uint8_t> blob;
+  long count = 0;
+  auto it = p.empty() ? s->index.begin() : s->index.lower_bound(p);
+  for (; it != s->index.end(); ++it) {
+    if (!p.empty() && it->first.compare(0, p.size(), p) != 0) break;
+    uint32_t klen = (uint32_t)it->first.size();
+    size_t base = blob.size();
+    blob.resize(base + 4 + klen);
+    memcpy(&blob[base], &klen, 4);
+    memcpy(&blob[base + 4], it->first.data(), klen);
+    count++;
+  }
+  uint8_t* buf = (uint8_t*)malloc(blob.size() ? blob.size() : 1);
+  if (!buf) return -1;
+  memcpy(buf, blob.data(), blob.size());
+  *out = buf;
+  *out_len = blob.size();
+  return count;
+}
+
+uint64_t kv_count(Store* s) {
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->index.size();
+}
+
+uint64_t kv_garbage_bytes(Store* s) {
+  std::lock_guard<std::mutex> g(s->mu);
+  return s->garbage;
+}
+
+int kv_sync(Store* s) {
+  std::lock_guard<std::mutex> g(s->mu);
+  return fdatasync(s->fd) == 0 ? 0 : -1;
+}
+
+// Rewrite live records into a fresh log (drops garbage); atomic rename.
+int kv_compact(Store* s) {
+  std::lock_guard<std::mutex> g(s->mu);
+  std::string tmp = s->path + ".compact";
+  int nfd = open(tmp.c_str(), O_RDWR | O_CREAT | O_TRUNC, 0644);
+  if (nfd < 0) return -1;
+  Store fresh;
+  fresh.fd = nfd;
+  fresh.path = tmp;
+  std::vector<uint8_t> val;
+  for (auto& kv : s->index) {
+    val.resize(kv.second.vlen);
+    if (kv.second.vlen &&
+        pread(s->fd, val.data(), kv.second.vlen,
+              (off_t)kv.second.value_off) != (ssize_t)kv.second.vlen) {
+      close(nfd);
+      unlink(tmp.c_str());
+      return -1;
+    }
+    uint64_t voff;
+    if (!fresh.append_record(OP_PUT, kv.first, val.data(), kv.second.vlen,
+                             &voff)) {
+      close(nfd);
+      unlink(tmp.c_str());
+      return -1;
+    }
+    fresh.index[kv.first] = Entry{voff, kv.second.vlen};
+  }
+  if (fdatasync(nfd) != 0 || rename(tmp.c_str(), s->path.c_str()) != 0) {
+    close(nfd);
+    unlink(tmp.c_str());
+    return -1;
+  }
+  close(s->fd);
+  s->fd = nfd;
+  s->index.swap(fresh.index);
+  fresh.fd = -1;
+  s->tail = fresh.tail;
+  s->garbage = 0;
+  return 0;
+}
+
+void kv_free(void* p) { free(p); }
+
+const char* kv_error(Store* s) { return s ? s->err.c_str() : "null store"; }
+
+}  // extern "C"

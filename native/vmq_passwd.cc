@@ -1,0 +1,164 @@
+// vmq-passwd — password-file management tool.
+//
+// Reproduces the reference's C tool (apps/vmq_passwd/c_src/vmq_passwd.c):
+// entries `user:$6$<salt-b64>$<base64(sha512(password ++ salt))>`
+// (format written at vmq_passwd.c:166; checked by vmq_passwd.erl:164-172
+// and by vernemq_tpu/plugins/passwd.py). Usage:
+//
+//   vmq-passwd [-c] <passwordfile> <username>   add/update (prompts twice)
+//   vmq-passwd -D <passwordfile> <username>     delete user
+//
+// -c creates the file (refuses to clobber an existing one). For scripting
+// and tests the password can be supplied via VMQ_PASSWORD instead of the
+// interactive prompt.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include <termios.h>
+#include <unistd.h>
+
+#include "sha512.h"
+
+namespace {
+
+constexpr size_t SALT_LEN = 12;
+
+std::string prompt_password(const char* prompt) {
+  const char* env = getenv("VMQ_PASSWORD");
+  if (env != nullptr) return env;
+  std::fprintf(stderr, "%s", prompt);
+  termios oldt{};
+  bool tty = tcgetattr(STDIN_FILENO, &oldt) == 0;
+  if (tty) {
+    termios noecho = oldt;
+    noecho.c_lflag &= ~ECHO;
+    tcsetattr(STDIN_FILENO, TCSANOW, &noecho);
+  }
+  std::string pw;
+  std::getline(std::cin, pw);
+  if (tty) {
+    tcsetattr(STDIN_FILENO, TCSANOW, &oldt);
+    std::fprintf(stderr, "\n");
+  }
+  return pw;
+}
+
+std::string make_hash(const std::string& password) {
+  uint8_t salt[SALT_LEN];
+  std::ifstream ur("/dev/urandom", std::ios::binary);
+  if (!ur.read((char*)salt, SALT_LEN)) {
+    std::fprintf(stderr, "cannot read /dev/urandom\n");
+    exit(1);
+  }
+  std::vector<uint8_t> buf(password.begin(), password.end());
+  buf.insert(buf.end(), salt, salt + SALT_LEN);
+  uint8_t digest[64];
+  vmq::sha512(buf.data(), buf.size(), digest);
+  return "$6$" + vmq::base64(salt, SALT_LEN) + "$" +
+         vmq::base64(digest, 64);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  bool create = false, del = false;
+  int arg = 1;
+  while (arg < argc && argv[arg][0] == '-') {
+    if (strcmp(argv[arg], "-c") == 0) create = true;
+    else if (strcmp(argv[arg], "-D") == 0) del = true;
+    else {
+      std::fprintf(stderr, "unknown option %s\n", argv[arg]);
+      return 2;
+    }
+    arg++;
+  }
+  if (argc - arg != 2) {
+    std::fprintf(stderr,
+                 "usage: vmq-passwd [-c | -D] passwordfile username\n");
+    return 2;
+  }
+  std::string path = argv[arg], user = argv[arg + 1];
+  if (user.find(':') != std::string::npos) {
+    std::fprintf(stderr, "username may not contain ':'\n");
+    return 1;
+  }
+
+  std::vector<std::string> lines;
+  {
+    std::ifstream in(path);
+    if (in.good()) {
+      if (create) {
+        std::fprintf(stderr, "%s already exists (drop -c to update)\n",
+                     path.c_str());
+        return 1;
+      }
+      std::string line;
+      while (std::getline(in, line)) lines.push_back(line);
+    } else if (!create && !del) {
+      // plain update on a missing file behaves like -c (reference tool
+      // creates the file on demand)
+    } else if (del) {
+      std::fprintf(stderr, "%s: no such file\n", path.c_str());
+      return 1;
+    }
+  }
+
+  bool found = false;
+  std::vector<std::string> out;
+  for (auto& line : lines) {
+    size_t colon = line.find(':');
+    if (colon != std::string::npos && line.compare(0, colon, user) == 0) {
+      found = true;
+      if (!del) {
+        std::string pw = prompt_password("Password: ");
+        std::string again = getenv("VMQ_PASSWORD")
+                                ? pw
+                                : prompt_password("Reenter password: ");
+        if (pw != again) {
+          std::fprintf(stderr, "passwords do not match\n");
+          return 1;
+        }
+        out.push_back(user + ":" + make_hash(pw));
+      }
+      continue;  // del: drop the line
+    }
+    out.push_back(line);
+  }
+  if (!found) {
+    if (del) {
+      std::fprintf(stderr, "user %s not found\n", user.c_str());
+      return 1;
+    }
+    std::string pw = prompt_password("Password: ");
+    std::string again = getenv("VMQ_PASSWORD")
+                            ? pw
+                            : prompt_password("Reenter password: ");
+    if (pw != again) {
+      std::fprintf(stderr, "passwords do not match\n");
+      return 1;
+    }
+    out.push_back(user + ":" + make_hash(pw));
+  }
+
+  std::string tmp = path + ".tmp";
+  {
+    std::ofstream o(tmp, std::ios::trunc);
+    if (!o) {
+      std::fprintf(stderr, "cannot write %s\n", tmp.c_str());
+      return 1;
+    }
+    for (auto& line : out) o << line << "\n";
+  }
+  if (rename(tmp.c_str(), path.c_str()) != 0) {
+    std::perror("rename");
+    return 1;
+  }
+  return 0;
+}

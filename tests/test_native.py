@@ -1,0 +1,314 @@
+"""Native component tests: C++ kvstore (crash recovery, prefix scans,
+compaction), wait-free counters, vmq-passwd tool, native message store
+(vmq_lvldb_store_SUITE shape)."""
+
+import base64
+import hashlib
+import os
+import subprocess
+import threading
+
+import pytest
+
+from vernemq_tpu.native import counters as nat_counters
+from vernemq_tpu.native import kvstore as nat_kvstore
+from vernemq_tpu.native import passwd_tool_path
+
+pytestmark = pytest.mark.skipif(
+    not nat_kvstore.available(), reason="native toolchain unavailable")
+
+
+# ----------------------------------------------------------------- kvstore
+
+def test_kv_put_get_delete(tmp_path):
+    with nat_kvstore.KVStore(str(tmp_path / "a.kv")) as kv:
+        kv.put(b"k1", b"v1")
+        kv.put(b"k2", b"")
+        assert kv.get(b"k1") == b"v1"
+        assert kv.get(b"k2") == b""
+        assert kv.get(b"nope") is None
+        assert kv.delete(b"k1") is True
+        assert kv.delete(b"k1") is False
+        assert kv.get(b"k1") is None
+        assert kv.count() == 1
+
+
+def test_kv_overwrite_and_reopen(tmp_path):
+    path = str(tmp_path / "b.kv")
+    with nat_kvstore.KVStore(path) as kv:
+        for i in range(100):
+            kv.put(f"key{i:03d}".encode(), f"val{i}".encode())
+        kv.put(b"key050", b"overwritten")
+        kv.delete(b"key051")
+    with nat_kvstore.KVStore(path) as kv:
+        assert kv.count() == 99
+        assert kv.get(b"key050") == b"overwritten"
+        assert kv.get(b"key051") is None
+        assert kv.get(b"key099") == b"val99"
+
+
+def test_kv_prefix_scan_ordered(tmp_path):
+    with nat_kvstore.KVStore(str(tmp_path / "c.kv")) as kv:
+        kv.put(b"b:2", b"x2")
+        kv.put(b"a:1", b"y")
+        kv.put(b"b:1", b"x1")
+        kv.put(b"b:10", b"x10")
+        kv.put(b"c:1", b"z")
+        items = kv.scan(b"b:")
+        assert [k for k, _ in items] == [b"b:1", b"b:10", b"b:2"]
+        assert dict(items)[b"b:10"] == b"x10"
+        assert len(kv.scan(b"")) == 5
+
+
+def test_kv_torn_tail_recovery(tmp_path):
+    path = str(tmp_path / "d.kv")
+    with nat_kvstore.KVStore(path) as kv:
+        kv.put(b"good1", b"v1")
+        kv.put(b"good2", b"v2")
+    # simulate a torn write: append garbage
+    with open(path, "ab") as f:
+        f.write(b"\x99\x88\x77partial-record-without-valid-crc")
+    with nat_kvstore.KVStore(path) as kv:
+        assert kv.count() == 2
+        assert kv.get(b"good1") == b"v1"
+        # the store must stay writable after truncating the torn tail
+        kv.put(b"good3", b"v3")
+    with nat_kvstore.KVStore(path) as kv:
+        assert kv.get(b"good3") == b"v3"
+
+
+def test_kv_compaction(tmp_path):
+    path = str(tmp_path / "e.kv")
+    with nat_kvstore.KVStore(path) as kv:
+        for i in range(50):
+            kv.put(b"churn", b"x" * 1000)  # 49 dead versions
+        kv.put(b"keep", b"stay")
+        before = os.path.getsize(path)
+        assert kv.garbage_bytes() > 40_000
+        kv.compact()
+        after = os.path.getsize(path)
+        assert after < before
+        assert kv.garbage_bytes() == 0
+        assert kv.get(b"churn") == b"x" * 1000
+        assert kv.get(b"keep") == b"stay"
+    with nat_kvstore.KVStore(path) as kv:
+        assert kv.count() == 2
+
+
+def test_kv_binary_keys(tmp_path):
+    with nat_kvstore.KVStore(str(tmp_path / "f.kv")) as kv:
+        k = bytes(range(256))
+        kv.put(k, b"bin")
+        assert kv.get(k) == b"bin"
+
+
+# ---------------------------------------------------------------- counters
+
+def test_counters_basic():
+    blk = nat_counters.CounterBlock(["a", "b", "c"])
+    blk.incr(0)
+    blk.incr(0, 5)
+    blk.incr(2, 7)
+    assert blk.read(0) == 6
+    assert blk.read(1) == 0
+    snap = blk.snapshot()
+    assert snap == {"a": 6, "b": 0, "c": 7}
+    blk.close()
+
+
+def test_counters_threaded():
+    blk = nat_counters.CounterBlock(["hot"])
+    N, T = 10_000, 8
+
+    def worker():
+        for _ in range(N):
+            blk.incr(0)
+
+    threads = [threading.Thread(target=worker) for _ in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert blk.read(0) == N * T
+    blk.close()
+
+
+def test_metrics_native_backend():
+    from vernemq_tpu.broker.metrics import Metrics
+
+    m = Metrics(native=True)
+    assert m._native is not None
+    m.incr("mqtt_publish_received")
+    m.incr("mqtt_publish_received", 4)
+    assert m.value("mqtt_publish_received") == 5
+    assert m.all_metrics()["mqtt_publish_received"] == 5
+    assert 'mqtt_publish_received{node="n"} 5' in m.prometheus_text("n")
+    # dynamic (unregistered) names still work via the dict path
+    m.incr("custom_metric", 3)
+    assert m.value("custom_metric") == 3
+
+
+# ------------------------------------------------------------- passwd tool
+
+def test_passwd_tool_roundtrip(tmp_path):
+    tool = passwd_tool_path()
+    pw_file = str(tmp_path / "users.passwd")
+    env = {**os.environ, "VMQ_PASSWORD": "hunter2"}
+    subprocess.run([tool, "-c", pw_file, "alice"], check=True, env=env)
+    subprocess.run([tool, pw_file, "bob"], check=True,
+                   env={**os.environ, "VMQ_PASSWORD": "b0b"})
+    lines = open(pw_file).read().splitlines()
+    assert len(lines) == 2
+    # format + hash must match the Python auth plugin exactly
+    for line, pw in zip(lines, ["hunter2", "b0b"]):
+        user, rest = line.split(":", 1)
+        _, _, salt_b64, hash_b64 = rest.split("$")
+        salt = base64.b64decode(salt_b64)
+        want = base64.b64encode(
+            hashlib.sha512(pw.encode() + salt).digest()).decode()
+        assert hash_b64 == want
+    from vernemq_tpu.plugins.passwd import PasswdPlugin
+
+    plug = PasswdPlugin()
+    plug.load_from_lines(lines)
+    from vernemq_tpu.broker.plugins import OK
+
+    assert plug.check("alice", "hunter2") == OK
+    assert plug.check("alice", "wrong") == ("error", "invalid_credentials")
+    # update + delete
+    subprocess.run([tool, pw_file, "alice"], check=True,
+                   env={**os.environ, "VMQ_PASSWORD": "new-pass"})
+    plug.load_from_file(pw_file)
+    assert plug.check("alice", "new-pass") == OK
+    subprocess.run([tool, "-D", pw_file, "alice"], check=True)
+    lines = open(pw_file).read().splitlines()
+    assert len(lines) == 1 and lines[0].startswith("bob:")
+
+
+def test_kv_scan_keys(tmp_path):
+    with nat_kvstore.KVStore(str(tmp_path / "g.kv")) as kv:
+        kv.put(b"p:1", b"huge" * 1000)
+        kv.put(b"p:2", b"x")
+        kv.put(b"q:1", b"y")
+        assert kv.scan_keys(b"p:") == [b"p:1", b"p:2"]
+        assert len(kv.scan_keys(b"")) == 3
+
+
+def test_retained_survive_restart(tmp_path, event_loop):
+    import asyncio
+
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    async def run():
+        cfg = Config(systree_enabled=False, metadata_persistence=True,
+                     metadata_dir=str(tmp_path))
+        b, server = await start_broker(cfg, port=0)
+        pub = MQTTClient(server.host, server.port, client_id="rp")
+        await pub.connect()
+        await pub.publish("keep/t", b"retained-value", qos=0, retain=True)
+        await pub.disconnect()
+        await asyncio.sleep(0.05)
+        await b.stop()
+        await server.stop()
+        b2, server2 = await start_broker(cfg, port=0)
+        sub = MQTTClient(server2.host, server2.port, client_id="rs")
+        await sub.connect()
+        await sub.subscribe("keep/#", qos=0)
+        msg = await asyncio.wait_for(sub.messages.get(), 5)
+        assert msg.payload == b"retained-value" and msg.retain
+        await sub.disconnect()
+        await b2.stop()
+        await server2.stop()
+
+    event_loop.run_until_complete(run())
+
+
+# --------------------------------------------------------- native msg store
+
+def test_native_msg_store_roundtrip(tmp_path):
+    from vernemq_tpu.broker.message import Msg
+    from vernemq_tpu.storage.msg_store import NativeMsgStore
+
+    store = NativeMsgStore(str(tmp_path))
+    sid_a, sid_b = ("", "client-a"), ("", "client-b")
+    m1 = Msg(topic=("t", "1"), payload=b"p1", qos=1)
+    m2 = Msg(topic=("t", "2"), payload=b"p2", qos=2,
+             properties={"message_expiry_interval": 30})
+    store.write(sid_a, m1)
+    store.write(sid_a, m2)
+    store.write(sid_b, m1)  # shared payload: refcount 2
+    assert store.stats()["stored_messages"] == 2
+    got = store.read_all(sid_a)
+    assert [m.payload for m in got] == [b"p1", b"p2"]
+    assert got[1].properties["message_expiry_interval"] == 30
+    store.delete(sid_a, m1.msg_ref)
+    assert [m.payload for m in store.read_all(sid_a)] == [b"p2"]
+    # payload still alive for sid_b
+    assert [m.payload for m in store.read_all(sid_b)] == [b"p1"]
+    store.delete_all(sid_b)
+    assert store.read_all(sid_b) == []
+    assert store.stats()["stored_messages"] == 1  # only m2 remains
+    store.close()
+
+
+def test_native_msg_store_recovery_and_gc(tmp_path):
+    from vernemq_tpu.broker.message import Msg
+    from vernemq_tpu.storage.msg_store import NativeMsgStore
+
+    store = NativeMsgStore(str(tmp_path))
+    sid = ("", "rec")
+    msgs = [Msg(topic=("a", str(i)), payload=f"x{i}".encode(), qos=1)
+            for i in range(5)]
+    for m in msgs:
+        store.write(sid, m)
+    store.delete(sid, msgs[0].msg_ref)
+    store.close()
+    # reopen: ordered recovery scan (vmq_lvldb_store.erl:396-416)
+    store2 = NativeMsgStore(str(tmp_path))
+    got = store2.read_all(sid)
+    assert [m.payload for m in got] == [b"x1", b"x2", b"x3", b"x4"]
+    assert store2.stats()["stored_messages"] == 4
+    store2.close()
+
+
+def test_broker_native_store_offline_queue(tmp_path, event_loop):
+    """End-to-end: offline QoS1 messages survive a broker restart via the
+    native store (the offline-storage recovery flow)."""
+    import asyncio
+
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    async def run():
+        cfg = Config(systree_enabled=False, message_store="native",
+                     message_store_dir=str(tmp_path / "msgs"),
+                     metadata_persistence=True,
+                     metadata_dir=str(tmp_path / "meta"))
+        b, server = await start_broker(cfg, port=0)
+        sub = MQTTClient(server.host, server.port, client_id="dur",
+                         clean_start=False)
+        await sub.connect()
+        await sub.subscribe("d/t", qos=1)
+        await sub.disconnect()
+        pub = MQTTClient(server.host, server.port, client_id="p")
+        await pub.connect()
+        await pub.publish("d/t", b"while-offline", qos=1)
+        await pub.disconnect()
+        await b.stop()
+        await server.stop()
+        # "restart": fresh broker over the same store dir
+        b2, server2 = await start_broker(cfg, port=0)
+        sub2 = MQTTClient(server2.host, server2.port, client_id="dur",
+                          clean_start=False)
+        ack = await sub2.connect()
+        assert ack.session_present
+        msg = await asyncio.wait_for(sub2.messages.get(), 5)
+        assert msg.payload == b"while-offline"
+        await sub2.disconnect()
+        await b2.stop()
+        await server2.stop()
+
+    event_loop.run_until_complete(run())

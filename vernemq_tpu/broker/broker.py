@@ -47,13 +47,25 @@ class Broker:
         # local LWW store, the cluster layer wires broadcast + anti-entropy
         from ..cluster.metadata import MetadataStore
 
-        self.metadata = MetadataStore(node_name)
+        persist_dir = (self.config.metadata_dir
+                       if self.config.get("metadata_persistence", False)
+                       else None)
+        self.metadata = MetadataStore(node_name, persist_dir=persist_dir)
         self.cluster: Optional[Any] = None  # set by cluster.Cluster
         self.retain = RetainStore(on_dirty=self._retain_dirty)
         self.metadata.subscribe("retain", self._on_retain_event)
         self.registry = Registry(self)
         if self.config.message_store == "file":
             self.msg_store: MsgStore = FileMsgStore(self.config.message_store_dir)
+        elif self.config.message_store == "native":
+            from ..storage.msg_store import NativeMsgStore
+
+            try:
+                self.msg_store = NativeMsgStore(self.config.message_store_dir)
+            except Exception as e:  # no toolchain → durable Python fallback
+                log.warning("native msg store unavailable (%s); "
+                            "falling back to file store", e)
+                self.msg_store = FileMsgStore(self.config.message_store_dir)
         else:
             self.msg_store = MemoryMsgStore()
         # live sessions: sid -> Session (the reference reaches sessions via
@@ -102,17 +114,21 @@ class Broker:
                     "qos": value.qos, "exp": value.expiry_ts}
         self.metadata.put("retain", (mountpoint,) + tuple(topic), term)
 
-    def _on_retain_event(self, key, old, new, origin) -> None:
+    @staticmethod
+    def _retain_term(value):
+        """Replicated retain term → RetainedMsg (None passes through)."""
+        if value is None:
+            return None
         from .reg import RetainedMsg
 
+        return RetainedMsg(value["payload"], dict(value.get("props") or {}),
+                           value.get("qos", 0), value.get("exp"))
+
+    def _on_retain_event(self, key, old, new, origin) -> None:
         if origin == self.node_name:
             return  # local writes already applied write-through
         mountpoint, topic = key[0], tuple(key[1:])
-        value = None
-        if new is not None:
-            value = RetainedMsg(new["payload"], dict(new.get("props") or {}),
-                                new.get("qos", 0), new.get("exp"))
-        self.retain.apply_remote(mountpoint, topic, value)
+        self.retain.apply_remote(mountpoint, topic, self._retain_term(new))
 
     # -------------------------------------------------- queue migration
 
@@ -325,6 +341,13 @@ class Broker:
                     pass
 
     async def start(self) -> None:
+        # warm-load from persisted metadata: routing state, offline queues,
+        # retain cache (boot order of vmq_server_sup + vmq_reg_trie /
+        # vmq_retain_srv warm-loads)
+        self.registry.bootstrap()
+        for key, value in self.metadata.fold("retain"):
+            self.retain.apply_remote(key[0], tuple(key[1:]),
+                                     self._retain_term(value))
         if self.config.systree_enabled:
             self._bg_tasks.append(asyncio.get_event_loop().create_task(
                 self.start_systree()))
@@ -354,3 +377,4 @@ class Broker:
         for server in self._servers:
             server.close()
         self.msg_store.close()
+        self.metadata.close()

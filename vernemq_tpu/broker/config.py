@@ -65,9 +65,10 @@ DEFAULTS: Dict[str, Any] = {
     "http_modules": ["metrics", "health", "status", "mgmt"],
     "http_mgmt_api_auth": True,
     # storage
-    "message_store": "memory",  # memory | file
+    "message_store": "memory",  # memory | file | native (C++ engine)
     "message_store_dir": "./data/msgstore",
     "metadata_dir": "./data/meta",
+    "metadata_persistence": False,  # durable subscriber-db/retain via kvstore
 }
 
 

@@ -76,17 +76,38 @@ COUNTERS: List[Tuple[str, str]] = [
 
 
 class Metrics:
-    def __init__(self) -> None:
+    def __init__(self, native: bool = True) -> None:
         self._counters: Dict[str, int] = {name: 0 for name, _ in COUNTERS}
         self._descriptions: Dict[str, str] = dict(COUNTERS)
         self._gauge_providers: List[Callable[[], Dict[str, float]]] = []
         self._gauge_desc: Dict[str, str] = {}
         self._rate_state: Dict[object, Tuple[float, int]] = {}
+        # wait-free native counter block for the registered counters (the
+        # mzmetrics seat); unknown/dynamic names stay in the dict
+        self._native = None
+        self._native_idx: Dict[str, int] = {}
+        if native:
+            try:
+                from ..native import counters as nc
+
+                if nc.available():
+                    self._native = nc.CounterBlock([n for n, _ in COUNTERS])
+                    self._native_idx = {
+                        n: i for i, n in enumerate(n for n, _ in COUNTERS)}
+            except Exception:  # toolchain missing etc. — pure-Python path
+                self._native = None
 
     def incr(self, name: str, n: int = 1) -> None:
-        self._counters[name] = self._counters.get(name, 0) + n
+        idx = self._native_idx.get(name)
+        if idx is not None:
+            self._native.incr(idx, n)
+        else:
+            self._counters[name] = self._counters.get(name, 0) + n
 
     def value(self, name: str) -> int:
+        idx = self._native_idx.get(name)
+        if idx is not None:
+            return self._native.read(idx)
         return self._counters.get(name, 0)
 
     def describe(self, name: str) -> str:
@@ -118,6 +139,8 @@ class Metrics:
 
     def all_metrics(self) -> Dict[str, float]:
         out: Dict[str, float] = dict(self._counters)
+        if self._native is not None:
+            out.update(self._native.snapshot())
         for provider in self._gauge_providers:
             out.update(provider())
         return out
@@ -128,7 +151,10 @@ class Metrics:
         gauges: Dict[str, float] = {}
         for provider in self._gauge_providers:
             gauges.update(provider())
-        for name, val in sorted(self._counters.items()):
+        counters = dict(self._counters)
+        if self._native is not None:
+            counters.update(self._native.snapshot())
+        for name, val in sorted(counters.items()):
             desc = self._descriptions.get(name, name)
             lines.append(f"# HELP {name} {desc}")
             lines.append(f"# TYPE {name} counter")

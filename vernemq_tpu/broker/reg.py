@@ -91,6 +91,20 @@ class Registry:
         self.remote_publish = None  # fn(node, msg) (vmq_cluster:publish/2)
         self.remote_enqueue_nowait = None  # fn(node, sid, [msg]) shared subs
 
+    def bootstrap(self) -> None:
+        """Warm-load routing state from a persisted subscriber DB: replay
+        every stored record as a change event (the async trie warm-load of
+        ``vmq_reg_trie.erl:144-149``) and re-create offline queues for
+        persistent sessions homed here (``vmq_reg_mgr.erl:64-72``)."""
+        for sid, rec in self.db.fold():
+            self._on_subs_event(sid, None, rec)
+            if (rec.node == self.node_name and not rec.clean_session
+                    and sid not in self.queues):
+                queue = self._start_queue(
+                    sid, _qopts_from_dict(rec.queue_opts, self.broker.config))
+                self.broker.recover_offline(sid, queue)
+                queue._arm_expiry()  # session/persistent expiry clock
+
     @property
     def subscriptions(self) -> Dict[SubscriberId, Dict[Tuple[str, ...], SubOpts]]:
         """Local-view of the subscriber DB (introspection/back-compat)."""
@@ -143,6 +157,7 @@ class Registry:
             # re-points, the old owner starts draining its queue to us
             rec.node = self.node_name
             rec.clean_session = queue_opts.clean_session
+            rec.queue_opts = _qopts_to_dict(queue_opts)
             self.db.store(sid, rec)
         if existing is not None:
             existing.opts = queue_opts
@@ -279,6 +294,9 @@ class Registry:
             clean = q.opts.clean_session if q is not None else True
             rec = SubscriberRecord(self.node_name, clean)
         rec.node = self.node_name
+        q = self.queues.get(sid)
+        if q is not None:
+            rec.queue_opts = _qopts_to_dict(q.opts)
         existed_before = {tuple(w) for w, _ in topics if tuple(w) in rec.subs}
         granted = []
         for words, opts in topics:
@@ -550,6 +568,33 @@ class Registry:
         """Iterate every (filter, key, opts) — warm-load feed for the TPU
         table (mirrors vmq_reg:fold_subscriptions, vmq_reg_trie warm load)."""
         return self.trie(mountpoint).entries()
+
+
+def _qopts_to_dict(opts: "QueueOpts") -> Dict[str, Any]:
+    """Durable queue parameters carried in the subscriber record so boot
+    re-creation keeps them (session expiry above all — MQTT5 semantics)."""
+    return {
+        "session_expiry": opts.session_expiry,
+        "max_offline_messages": opts.max_offline_messages,
+        "max_online_messages": opts.max_online_messages,
+        "queue_type": opts.queue_type,
+        "deliver_mode": opts.deliver_mode,
+    }
+
+
+def _qopts_from_dict(d: Dict[str, Any], config) -> "QueueOpts":
+    from .queue import QueueOpts
+
+    return QueueOpts(
+        clean_session=False,
+        session_expiry=d.get("session_expiry", 0),
+        max_offline_messages=d.get("max_offline_messages",
+                                   config.max_offline_messages),
+        max_online_messages=d.get("max_online_messages",
+                                  config.max_online_messages),
+        queue_type=d.get("queue_type", config.queue_type),
+        deliver_mode=d.get("deliver_mode", config.queue_deliver_mode),
+    )
 
 
 def msg_with_retain(msg: Msg, retain: bool) -> Msg:

@@ -45,19 +45,24 @@ class SubscriberRecord:
     """One subscriber's replicated state: which node owns its queue, its
     clean-session flag, and its subscriptions."""
 
-    __slots__ = ("node", "clean_session", "subs")
+    __slots__ = ("node", "clean_session", "subs", "queue_opts")
 
     def __init__(self, node: str, clean_session: bool,
-                 subs: Optional[Dict[Filter, SubOpts]] = None):
+                 subs: Optional[Dict[Filter, SubOpts]] = None,
+                 queue_opts: Optional[Dict[str, Any]] = None):
         self.node = node
         self.clean_session = clean_session
         self.subs: Dict[Filter, SubOpts] = subs or {}
+        # durable queue parameters (session_expiry etc.) so offline queues
+        # re-created at boot keep their semantics (vmq_reg_mgr boot path)
+        self.queue_opts: Dict[str, Any] = queue_opts or {}
 
     def to_term(self) -> Dict[str, Any]:
         return {
             "node": self.node,
             "clean": self.clean_session,
             "subs": {f: opts_to_dict(o) for f, o in self.subs.items()},
+            "qopts": self.queue_opts,
         }
 
     @classmethod
@@ -67,6 +72,7 @@ class SubscriberRecord:
         return cls(
             t["node"], t["clean"],
             {tuple(f): opts_from_dict(o) for f, o in t["subs"].items()},
+            dict(t.get("qopts") or {}),
         )
 
 

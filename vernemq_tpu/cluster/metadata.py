@@ -24,7 +24,7 @@ Entry = Tuple[int, str, Any]  # (lamport, origin_node, value | None tombstone)
 
 
 class MetadataStore:
-    def __init__(self, node_name: str):
+    def __init__(self, node_name: str, persist_dir: Optional[str] = None):
         self.node_name = node_name
         self._data: Dict[Key, Entry] = {}
         self._clock = 0
@@ -33,6 +33,64 @@ class MetadataStore:
         self._subscribers: Dict[str, List[Callable[[Any, Any, Any], None]]] = {}
         # wired by the cluster layer: fn(prefix, key, entry) -> None
         self.broadcast: Optional[Callable[[str, Any, Entry], None]] = None
+        # optional durability through the native storage engine (the
+        # reference's metadata store persists via eleveldb)
+        self._kv = None
+        if persist_dir is not None:
+            import os
+
+            from ..native.kvstore import KVError, KVStore
+
+            try:
+                os.makedirs(persist_dir, exist_ok=True)
+                self._kv = KVStore(os.path.join(persist_dir, "metadata.kv"))
+                self._load_persisted()
+            except (KVError, OSError) as e:
+                import logging
+
+                logging.getLogger("vernemq_tpu.metadata").warning(
+                    "metadata persistence unavailable: %s", e)
+                self._kv = None
+
+    # tombstones older than this are dropped at load time — long enough for
+    # anti-entropy to have spread the delete cluster-wide, short enough that
+    # clean-session churn cannot grow the store unboundedly
+    TOMBSTONE_RETENTION_S = 86400.0
+
+    def _load_persisted(self) -> None:
+        import time
+
+        from .codec import decode, encode
+
+        now = time.time()
+        for kb, vb in self._kv.scan(b""):
+            prefix, key = decode(kb)
+            stored = decode(vb)
+            entry = tuple(stored[:3])
+            if entry[2] is None:  # tombstone: [clock, origin, None, wall_ts]
+                ts = stored[3] if len(stored) > 3 else 0.0
+                if now - ts > self.TOMBSTONE_RETENTION_S:
+                    self._kv.delete(kb)
+                    continue
+            self._data[(prefix, _dekey(key))] = entry
+            self._clock = max(self._clock, entry[0])
+
+    def _persist(self, prefix: str, key: Any, entry: Entry) -> None:
+        if self._kv is None:
+            return
+        import time
+
+        from .codec import encode
+
+        stored = list(entry)
+        if entry[2] is None:
+            stored.append(time.time())  # tombstone GC clock
+        self._kv.put(encode([prefix, key]), encode(stored))
+
+    def close(self) -> None:
+        if self._kv is not None:
+            self._kv.close()
+            self._kv = None
 
     # ------------------------------------------------------------------ API
 
@@ -80,6 +138,7 @@ class MetadataStore:
                 return False
             self._clock = max(self._clock, entry[0])
             self._data[(prefix, key)] = entry
+            self._persist(prefix, key, entry)
         old_value = old[2] if old else None
         for fn in self._subscribers.get(prefix, []):
             fn(key, old_value, entry[2], entry[1])

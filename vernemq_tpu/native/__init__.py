@@ -1,0 +1,71 @@
+"""Native runtime components (SURVEY.md §2.6 equivalents).
+
+- ``kvstore``  — C++ append-log storage engine (the eleveldb seat:
+  offline message store backend + metadata persistence)
+- ``counters`` — C++ wait-free sharded counters (the mzmetrics seat)
+- ``vmq-passwd`` — C++ passwd tool (the vmq_passwd c_src seat)
+
+Libraries are built from ``native/`` via make on first use when a
+toolchain is present; every consumer gates on availability and falls back
+to the pure-Python implementation, so the package works without a
+compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("vernemq_tpu.native")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+NATIVE_DIR = os.path.join(_REPO_ROOT, "native")
+BUILD_DIR = os.path.join(NATIVE_DIR, "build")
+
+_build_lock = threading.Lock()
+_build_attempted = False
+
+
+def _ensure_built() -> bool:
+    global _build_attempted
+    if os.path.exists(os.path.join(BUILD_DIR, "libvmq_kvstore.so")):
+        return True
+    with _build_lock:
+        if _build_attempted:
+            return os.path.exists(os.path.join(BUILD_DIR, "libvmq_kvstore.so"))
+        _build_attempted = True
+        if not os.path.exists(os.path.join(NATIVE_DIR, "Makefile")):
+            return False
+        try:
+            subprocess.run(["make", "-C", NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError) as e:
+            log.warning("native build failed, using Python fallbacks: %s", e)
+            return False
+    return os.path.exists(os.path.join(BUILD_DIR, "libvmq_kvstore.so"))
+
+
+def load_library(name: str):
+    """ctypes.CDLL for a built native library, or None."""
+    if os.environ.get("VMQ_NO_NATIVE"):
+        return None
+    if not _ensure_built():
+        return None
+    path = os.path.join(BUILD_DIR, name)
+    if not os.path.exists(path):
+        return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError as e:
+        log.warning("cannot load %s: %s", path, e)
+        return None
+
+
+def passwd_tool_path() -> str:
+    """Path to the vmq-passwd binary (built on demand)."""
+    _ensure_built()
+    return os.path.join(BUILD_DIR, "vmq-passwd")

@@ -85,6 +85,146 @@ class MemoryMsgStore(MsgStore):
                 "stored_refs": sum(len(v) for v in self._idx.values())}
 
 
+class NativeMsgStore(MsgStore):
+    """C++ storage-engine-backed store with the reference's 3-key-family
+    layout (``vmq_lvldb_store.erl:339-416``):
+
+    - ``m\\x00<ref>``                       → encoded message (payload family)
+    - ``r\\x00<sid><ref>``                  → b"" (per-subscriber ref entry)
+    - ``i\\x00<sid><seq:8>``                → ref (ordered recovery index)
+
+    Payloads are deduplicated across subscribers via an in-memory refcount
+    rebuilt from the ``r`` family on open; unreferenced payloads are
+    garbage-collected by a startup scan (``vmq_lvldb_store.erl:418-453``).
+    """
+
+    def __init__(self, directory: str):
+        import time as _time
+
+        from ..cluster.codec import decode, encode
+        from ..cluster.node import msg_to_term, term_to_msg
+        from ..native.kvstore import KVStore
+
+        # wrap the wire term with the wall-clock store time: the codec's
+        # "remaining seconds" expiry is rebased at decode, so time spent in
+        # the store counts against MQTT5 message_expiry_interval
+        def _enc(m):
+            return encode([msg_to_term(m), _time.time()])
+
+        def _dec(b):
+            term, stored_at = decode(b)
+            if term.get("exp") is not None:
+                elapsed = max(0.0, _time.time() - stored_at)
+                term["exp"] = max(0.0, term["exp"] - elapsed)
+            return term_to_msg(term)
+
+        self._enc = _enc
+        self._dec = _dec
+        os.makedirs(directory, exist_ok=True)
+        self._kv = KVStore(os.path.join(directory, "msgstore.kv"))
+        # refcount + sid→ref→[seq] maps, rebuilt from the r/i families
+        self._refcount: Dict[bytes, int] = {}
+        self._seqs: Dict[SubscriberId, Dict[bytes, List[int]]] = {}
+        self._next_seq = 1
+        self._recover()
+
+    @staticmethod
+    def _sid_key(sid: SubscriberId) -> bytes:
+        mp = sid[0].encode()
+        cid = sid[1].encode()
+        return (len(mp).to_bytes(2, "big") + mp
+                + len(cid).to_bytes(2, "big") + cid)
+
+    @staticmethod
+    def _parse_sid(b: bytes) -> Tuple[SubscriberId, bytes]:
+        n = int.from_bytes(b[:2], "big")
+        mp = b[2:2 + n].decode()
+        rest = b[2 + n:]
+        n2 = int.from_bytes(rest[:2], "big")
+        cid = rest[2:2 + n2].decode()
+        return (mp, cid), rest[2 + n2:]
+
+    def _recover(self) -> None:
+        live_refs: Dict[bytes, int] = {}
+        for key in self._kv.scan_keys(b"r\x00"):
+            sid, ref = self._parse_sid(key[2:])
+            live_refs[ref] = live_refs.get(ref, 0) + 1
+        self._refcount = live_refs
+        for key, ref in self._kv.scan(b"i\x00"):
+            sid, seq_b = self._parse_sid(key[2:])
+            seq = int.from_bytes(seq_b, "big")
+            self._seqs.setdefault(sid, {}).setdefault(ref, []).append(seq)
+            self._next_seq = max(self._next_seq, seq + 1)
+        # startup GC: drop payloads nobody references (keys-only scan — no
+        # payload bytes cross the C boundary)
+        for key in self._kv.scan_keys(b"m\x00"):
+            if key[2:] not in live_refs:
+                self._kv.delete(key)
+
+    def write(self, sid: SubscriberId, msg: Msg) -> None:
+        ref = msg.msg_ref
+        if ref not in self._refcount:
+            self._kv.put(b"m\x00" + ref, self._enc(msg))
+            self._refcount[ref] = 0
+        self._refcount[ref] += 1
+        sk = self._sid_key(sid)
+        seq = self._next_seq
+        self._next_seq += 1
+        self._kv.put(b"r\x00" + sk + ref, b"")
+        self._kv.put(b"i\x00" + sk + seq.to_bytes(8, "big"), ref)
+        self._seqs.setdefault(sid, {}).setdefault(ref, []).append(seq)
+
+    def read_all(self, sid: SubscriberId) -> List[Msg]:
+        out: List[Msg] = []
+        for _, ref in self._kv.scan(b"i\x00" + self._sid_key(sid)):
+            data = self._kv.get(b"m\x00" + ref)
+            if data is not None:
+                out.append(self._dec(data))
+        return out
+
+    def delete(self, sid: SubscriberId, msg_ref: bytes) -> None:
+        seqs = self._seqs.get(sid, {}).get(msg_ref)
+        if not seqs:
+            return
+        seq = seqs.pop(0)
+        if not seqs:
+            self._seqs[sid].pop(msg_ref, None)
+        sk = self._sid_key(sid)
+        self._kv.delete(b"i\x00" + sk + seq.to_bytes(8, "big"))
+        if not self._seqs.get(sid, {}).get(msg_ref):
+            self._kv.delete(b"r\x00" + sk + msg_ref)
+        self._deref(msg_ref)
+
+    def delete_all(self, sid: SubscriberId) -> None:
+        sk = self._sid_key(sid)
+        for key, ref in self._kv.scan(b"i\x00" + sk):
+            self._kv.delete(key)
+            self._deref(ref)
+        for key, _ in self._kv.scan(b"r\x00" + sk):
+            self._kv.delete(key)
+        self._seqs.pop(sid, None)
+
+    def _deref(self, ref: bytes) -> None:
+        n = self._refcount.get(ref, 0) - 1
+        if n <= 0:
+            self._refcount.pop(ref, None)
+            self._kv.delete(b"m\x00" + ref)
+        else:
+            self._refcount[ref] = n
+
+    def stats(self) -> Dict[str, int]:
+        return {"stored_messages": len(self._refcount),
+                "stored_refs": sum(len(m) for m in self._seqs.values()),
+                "kv_keys": self._kv.count(),
+                "kv_garbage_bytes": self._kv.garbage_bytes()}
+
+    def sync(self) -> None:
+        self._kv.sync()
+
+    def close(self) -> None:
+        self._kv.close()
+
+
 class FileMsgStore(MemoryMsgStore):
     """Append-log-backed store: every op is journaled, state rebuilt on open
     (the recovery scan role of vmq_lvldb_store.erl:396-453). Simple but
