@@ -1,0 +1,334 @@
+"""SWC metadata store tests: logical-clock kernel properties, store-level
+convergence through a loopback transport, and the full-stack cluster path
+(metadata_plugin=swc) — the role of vmq_swc_store_SUITE (AE convergence on
+real peer nodes) plus the swc dep's unit tests."""
+
+import asyncio
+
+import pytest
+
+from vernemq_tpu.cluster import swc_kernel as K
+from vernemq_tpu.cluster.swc_store import SWCMetadata
+
+from test_cluster import (Node, connected, make_cluster, partition, heal,
+                          stop_cluster, wait_until)
+
+
+# ------------------------------------------------------------------ kernel
+
+
+def test_entry_norm_and_contains():
+    assert K.entry_norm((0, 0b111)) == (3, 0)
+    assert K.entry_norm((2, 0b101)) == (3, 0b10)
+    e = (3, 0b10)  # seen: 1,2,3,5
+    assert K.entry_contains(e, 3)
+    assert not K.entry_contains(e, 4)
+    assert K.entry_contains(e, 5)
+    assert not K.entry_contains(e, 6)
+
+
+def test_entry_add_join_missing():
+    e = (0, 0)
+    for c in (1, 2, 5):
+        e = K.entry_add(e, c)
+    assert e == (2, 0b100)  # 1,2 contiguous; 5 as bit
+    assert K.entry_missing((2, 0b100), (0, 0)) == [1, 2, 5]
+    assert K.entry_missing((2, 0b100), (2, 0)) == [5]
+    assert K.entry_join((2, 0b100), (4, 0)) == (5, 0)  # 4 covers gap 3,4
+    # join is commutative
+    assert K.entry_join((4, 0), (2, 0b100)) == (5, 0)
+
+
+def test_bvv_event_and_missing_dots():
+    clock = K.bvv_new()
+    c1, clock = K.bvv_event(clock, "a")
+    c2, clock = K.bvv_event(clock, "a")
+    assert (c1, c2) == (1, 2)
+    clock = K.bvv_add(clock, ("b", 2))  # b:2 without b:1 → gap
+    assert clock["b"] == (0, 0b10)
+    missing = K.bvv_missing_dots(clock, {"a": (1, 0)})
+    assert set(missing) == {("a", 2), ("b", 2)}
+    assert K.bvv_missing_dots(clock, clock) == []
+
+
+def test_dcc_write_read_cycle():
+    # a local write: fill-discard-event-add like the store's write path
+    clock = {"n1": (3, 0), "n2": (1, 0)}
+    obj = K.dcc_new()
+    filled = K.dcc_fill(obj, clock)
+    assert K.dcc_context(filled) == {"n1": 3, "n2": 1}
+    obj = K.dcc_add(filled, ("n1", 4), "v1")
+    assert K.dcc_values(obj) == ["v1"]
+    # a concurrent write on n2 not covered by our context survives sync
+    other = K.dcc_add(K.dcc_new(), ("n2", 2), "v2")
+    merged = K.dcc_sync(obj, other)
+    assert sorted(K.dcc_values(merged)) == ["v1", "v2"]
+    # but one covered by the context is discarded
+    stale = K.dcc_add(K.dcc_new(), ("n2", 1), "old")
+    merged2 = K.dcc_sync(obj, stale)
+    assert K.dcc_values(merged2) == ["v1"]
+
+
+def test_dcc_strip_fill_inverse():
+    clock = {"n1": (5, 0)}
+    obj = ({("n1", 5): "v"}, {"n1": 5, "n2": 7})
+    stripped = K.dcc_strip(obj, clock)
+    assert stripped[1] == {"n2": 7}  # n1 covered by base, n2 retained
+    refilled = K.dcc_fill(stripped, clock)
+    assert refilled[1] == {"n1": 5, "n2": 7}
+
+
+def test_watermark_min_and_fix():
+    wm = K.wm_new()
+    wm = K.wm_update_peer(wm, "a", {"a": (5, 0), "b": (3, 0)})
+    wm = K.wm_update_peer(wm, "b", {"a": (2, 0), "b": (3, 0)})
+    assert K.wm_min(wm, "a", ["a", "b"]) == 2
+    assert K.wm_min(wm, "a", ["a", "b", "c"]) == 0  # c knows nothing
+    fixed = K.wm_fix(wm, ["a", "b"])
+    assert fixed["a"]["b"] == 3 and fixed["b"]["a"] == 2
+
+
+def test_dkm_prune():
+    dkm = K.DotKeyMap()
+    dkm.insert("a", 1, "k1")
+    dkm.insert("a", 2, "k1")
+    dkm.insert("b", 1, "k2")
+    dkm.mark_for_gc("k1")
+    wm = {"a": {"a": 2, "b": 1}, "b": {"a": 2, "b": 1}}
+    deletable = dkm.prune(wm, ["a", "b"])
+    assert deletable == ["k1"]
+    assert dkm.lookup(("a", 1)) is None
+    assert dkm.object_count() == 0
+
+
+# ------------------------------------------------- loopback store clusters
+
+
+class Hub:
+    """In-memory transport hub standing in for the framed TCP channel."""
+
+    def __init__(self):
+        self.stores = {}
+        self.cut = set()  # severed (from, to) pairs
+
+    def add(self, store: SWCMetadata):
+        self.stores[store.node_name] = store
+        store.attach_cluster(_Port(self, store.node_name))
+        for s in self.stores.values():
+            s.set_peers(list(self.stores.keys()))
+
+    def up(self, a, b):
+        return (a, b) not in self.cut
+
+
+class _Port:
+    def __init__(self, hub, me):
+        self.hub = hub
+        self.me = me
+
+    def swc_send_all(self, term):
+        for name, store in self.hub.stores.items():
+            if name != self.me and self.hub.up(self.me, name):
+                store.handle_swc_cast(self.me, term)
+
+    async def swc_call(self, node, term, timeout=10.0):
+        if not self.hub.up(self.me, node) or not self.hub.up(node, self.me):
+            raise ConnectionError(f"{self.me} cut from {node}")
+        return self.hub.stores[node].handle_swc_call(self.me, term)
+
+    def status(self):
+        return [(n, True) for n in self.hub.stores if n != self.me]
+
+
+def two_stores():
+    hub = Hub()
+    s1, s2 = SWCMetadata("n1", sync_interval=999), SWCMetadata("n2", sync_interval=999)
+    hub.add(s1)
+    hub.add(s2)
+    return hub, s1, s2
+
+
+def test_standalone_put_get_delete():
+    s = SWCMetadata("solo")
+    s.set_peers([])
+    events = []
+    s.subscribe("p", lambda k, old, new, origin: events.append((k, old, new)))
+    s.put("p", "k", {"v": 1})
+    assert s.get("p", "k") == {"v": 1}
+    assert events == [("k", None, {"v": 1})]
+    s.put("p", "k", {"v": 2})
+    assert s.get("p", "k") == {"v": 2}
+    assert dict(s.fold("p")) == {"k": {"v": 2}}
+    s.delete("p", "k")
+    assert s.get("p", "k") is None
+    # standalone deletes leave no tombstone (case 1: no peers)
+    assert s.stats()["metadata_entries"] == 0
+
+
+def test_broadcast_replication():
+    hub, s1, s2 = two_stores()
+    s1.put("subs", ("mp", "client"), [1, 2, 3])
+    assert s2.get("subs", ("mp", "client")) == [1, 2, 3]
+    s2.put("subs", ("mp", "client"), [4])
+    assert s1.get("subs", ("mp", "client")) == [4]
+    s1.delete("subs", ("mp", "client"))
+    assert s2.get("subs", ("mp", "client")) is None
+
+
+async def test_exchange_repairs_partition():
+    hub, s1, s2 = two_stores()
+    hub.cut = {("n1", "n2"), ("n2", "n1")}
+    s1.put("p", "a", 1)
+    s1.put("p", "b", 2)
+    s2.put("p", "c", 3)
+    assert s2.get("p", "a") is None
+    hub.cut = set()
+    await s1.exchange_with("n2")  # pulls s2's writes into s1
+    await s2.exchange_with("n1")
+    assert s1.get("p", "c") == 3
+    assert s2.get("p", "a") == 1 and s2.get("p", "b") == 2
+
+
+async def test_concurrent_writes_resolve_deterministically():
+    hub, s1, s2 = two_stores()
+    hub.cut = {("n1", "n2"), ("n2", "n1")}
+    s1.put("p", "k", "from-n1")
+    await asyncio.sleep(0.01)  # strictly later wall clock → LWW winner
+    s2.put("p", "k", "from-n2")
+    hub.cut = set()
+    await s1.exchange_with("n2")
+    await s2.exchange_with("n1")
+    assert s1.get("p", "k") == s2.get("p", "k") == "from-n2"
+
+
+async def test_delete_converges_and_tombstones_collect():
+    hub, s1, s2 = two_stores()
+    s1.put("p", "k", 1)
+    assert s2.get("p", "k") == 1
+    hub.cut = {("n1", "n2"), ("n2", "n1")}
+    s1.delete("p", "k")
+    assert s2.get("p", "k") == 1  # partitioned: s2 still sees it
+    hub.cut = set()
+    await s2.exchange_with("n1")
+    assert s2.get("p", "k") is None
+    # a few mutual AE rounds spread the watermarks; tombstones then GC
+    for _ in range(3):
+        await s1.exchange_with("n2")
+        await s2.exchange_with("n1")
+        for g in s1.groups + s2.groups:
+            g.gc()
+    assert s1.stats()["metadata_entries"] == 0
+    assert s2.stats()["metadata_entries"] == 0
+    assert s1.stats()["swc_tombstone_count"] == 0
+
+
+async def test_remote_delete_does_not_resurrect():
+    """A delete of a value written by ANOTHER node must dominate that
+    node's dot through anti-entropy: stored tombstones are stripped
+    relative to the sender's clock, so sync_repair must fill remote
+    objects with the remote clock or the foreign dot survives."""
+    hub, s1, s2 = two_stores()
+    s2.put("p", "k", "v-from-n2")          # dot minted by n2
+    assert s1.get("p", "k") == "v-from-n2"
+    hub.cut = {("n1", "n2"), ("n2", "n1")}
+    s1.delete("p", "k")                    # n1 deletes n2's value
+    hub.cut = set()
+    await s2.exchange_with("n1")           # n2 pulls the tombstone
+    assert s2.get("p", "k") is None
+    assert s1.get("p", "k") is None
+
+
+def test_persisted_tombstones_reload_and_collect(tmp_path):
+    """Tombstones reloaded from disk keep their dot-key-map entries, so
+    watermark GC can still collect them after a restart."""
+    s1 = SWCMetadata("n1", persist_dir=str(tmp_path))
+    s1.set_peers(["n2"])  # a peer → deletes leave tombstones
+    s1.put("p", "k", 1)
+    s1.delete("p", "k")
+    assert s1.stats()["swc_tombstone_count"] >= 1
+    s1.close()
+    s2 = SWCMetadata("n1", persist_dir=str(tmp_path))
+    s2.set_peers(["n2"])
+    assert s2.get("p", "k") is None
+    # the reloaded dot-key-map still answers sync_missing with delete
+    # markers for the dead key (what a lagging peer needs to converge)
+    served = 0
+    for g in s2.groups:
+        for nid, row in g.dkm.log.items():
+            dots = [(nid, c) for c in row]
+            served += len(g.sync_missing(dots))
+    assert served >= 1
+    # peer gone → solo GC horizon covers everything; the log collects
+    s2.set_peers([])
+    for g in s2.groups:
+        g.gc()
+    assert s2.stats()["metadata_entries"] == 0
+    assert s2.stats()["swc_object_count"] == 0
+    s2.close()
+    # and the collection survives another reload
+    s3 = SWCMetadata("n1", persist_dir=str(tmp_path))
+    assert s3.stats()["metadata_entries"] == 0
+    s3.close()
+
+
+async def test_exchange_is_idempotent():
+    hub, s1, s2 = two_stores()
+    for i in range(20):
+        s1.put("p", f"k{i}", i)
+    before = dict(s2.fold("p"))
+    applied = await s2.exchange_with("n1")
+    assert applied == 0  # broadcast already delivered everything
+    assert dict(s2.fold("p")) == before
+
+
+# ------------------------------------------------------------- full stack
+
+
+@pytest.mark.asyncio
+async def test_swc_cluster_pubsub():
+    """Cross-node routing with the SWC backend replacing LWW end to end."""
+    nodes = await make_cluster(3, metadata_plugin="swc")
+    try:
+        sub = await connected(nodes[2], "swc-sub")
+        await sub.subscribe("swc/#", qos=1)
+        pub = await connected(nodes[0], "swc-pub")
+        await pub.publish("swc/t", b"via-swc", qos=1)
+        msg = await sub.recv(5.0)
+        assert msg.payload == b"via-swc"
+        await pub.close()
+        await sub.close()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_swc_partition_heals_via_exchange():
+    """Writes during a partition converge through AE after healing —
+    the vmq_swc_store_SUITE partitioned-sync scenario."""
+    nodes = await make_cluster(2, metadata_plugin="swc",
+                               allow_subscribe_during_netsplit=True,
+                               allow_register_during_netsplit=True,
+                               swc_sync_interval=0.3)
+    a, b = nodes
+    try:
+        partition(a, b)
+        # subscribe on b while a can't hear about it
+        sub = await connected(b, "part-sub")
+        await sub.subscribe("part/t", qos=1)
+        await wait_until(
+            lambda: b.broker.metadata.get(
+                "subscriber", ("", "part-sub")) is not None)
+        assert a.broker.metadata.get("subscriber", ("", "part-sub")) is None
+        heal(a, b)
+        await wait_until(
+            lambda: a.broker.metadata.get(
+                "subscriber", ("", "part-sub")) is not None, timeout=10.0)
+        # and routing works from a after convergence
+        pub = await connected(a, "part-pub")
+        await pub.publish("part/t", b"healed", qos=1)
+        msg = await sub.recv(5.0)
+        assert msg.payload == b"healed"
+        await pub.close()
+        await sub.close()
+    finally:
+        await stop_cluster(nodes)

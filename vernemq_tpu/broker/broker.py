@@ -43,14 +43,24 @@ class Broker:
         from ..plugins import PluginManager
 
         self.plugins = PluginManager(self)
-        # replicated metadata store (vmq_metadata facade); standalone it is a
-        # local LWW store, the cluster layer wires broadcast + anti-entropy
-        from ..cluster.metadata import MetadataStore
-
+        # replicated metadata store (vmq_metadata facade,
+        # vmq_metadata.erl:24-28): ``metadata_plugin`` picks the backend the
+        # way metadata_impl selects vmq_plumtree or vmq_swc — "lww" is the
+        # plumtree-flavored LWW store, "swc" the server-wide-clock store
         persist_dir = (self.config.metadata_dir
                        if self.config.get("metadata_persistence", False)
                        else None)
-        self.metadata = MetadataStore(node_name, persist_dir=persist_dir)
+        if self.config.get("metadata_plugin", "lww") == "swc":
+            from ..cluster.swc_store import SWCMetadata
+
+            self.metadata = SWCMetadata(
+                node_name, persist_dir=persist_dir,
+                n_groups=self.config.get("swc_replication_groups", 8),
+                sync_interval=self.config.get("swc_sync_interval", 2.0))
+        else:
+            from ..cluster.metadata import MetadataStore
+
+            self.metadata = MetadataStore(node_name, persist_dir=persist_dir)
         self.cluster: Optional[Any] = None  # set by cluster.Cluster
         self.retain = RetainStore(on_dirty=self._retain_dirty)
         self.metadata.subscribe("retain", self._on_retain_event)

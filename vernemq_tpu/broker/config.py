@@ -69,6 +69,11 @@ DEFAULTS: Dict[str, Any] = {
     "message_store_dir": "./data/msgstore",
     "metadata_dir": "./data/meta",
     "metadata_persistence": False,  # durable subscriber-db/retain via kvstore
+    # metadata backend: "lww" (plumtree-flavored) | "swc" (server-wide
+    # clocks, vmq_swc) — the metadata_impl knob (vmq_metadata.erl:24-28)
+    "metadata_plugin": "lww",
+    "swc_replication_groups": 8,  # reference runs 10 (vmq_swc_plugin.erl:36-44)
+    "swc_sync_interval": 2.0,  # seconds between AE rounds (sync_interval)
 }
 
 

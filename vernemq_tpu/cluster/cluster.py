@@ -50,9 +50,13 @@ class Cluster:
         self._ack_ids = itertools.count(1)
         self.netsplit_detected = 0
         self.netsplit_resolved = 0
+        self._pending_swc: Dict[int, asyncio.Future] = {}
         self._com = ClusterCom(self)
         self.metadata.subscribe(MEMBERS, self._on_member_change)
-        self.metadata.broadcast = self._broadcast_meta
+        if hasattr(self.metadata, "attach_cluster"):  # SWC backend
+            self.metadata.attach_cluster(self)
+        else:  # LWW backend: delta broadcast + full-state AE
+            self.metadata.broadcast = self._broadcast_meta
         broker.cluster = self
         broker.registry.remote_publish = self.publish
         broker.registry.remote_enqueue_nowait = self.enqueue_nowait
@@ -69,8 +73,13 @@ class Cluster:
             "state": "joined",
             "joined_at": time.time(),
         })
+        if hasattr(self.metadata, "start_ae"):
+            self._sync_metadata_peers()
+            self.metadata.start_ae()
 
     async def stop(self) -> None:
+        if hasattr(self.metadata, "stop_ae"):
+            self.metadata.stop_ae()
         for w in list(self._writers.values()) + self._bootstrap:
             w.stop()
         self._writers.clear()
@@ -117,8 +126,15 @@ class Cluster:
             self.metadata.put(MEMBERS, node, {
                 "addr": addr, "state": "joined", "joined_at": time.time()})
 
+    def _sync_metadata_peers(self) -> None:
+        """Keep the SWC replica groups' peer set in lock-step with cluster
+        membership (set_group_members → vmq_swc_store:set_peers)."""
+        if hasattr(self.metadata, "set_peers"):
+            self.metadata.set_peers(self.members())
+
     def _on_member_change(self, node: str, old: Any, new: Any,
                           origin: str) -> None:
+        self._sync_metadata_peers()
         if node == self.node_name:
             return
         if new is not None and new.get("state") == "joined":
@@ -245,6 +261,53 @@ class Cluster:
             fut.set_result(ok)
 
     # --------------------------------------------------------- metadata wire
+
+    def on_peer_connected(self, w: NodeWriter) -> None:
+        """Channel (re)established: exchange member info, then reconcile
+        metadata — full-state push for the LWW backend, a scheduled SWC
+        exchange for the SWC backend."""
+        w.send_frame(frame(b"hlo", self.member_info()))
+        ms = self.metadata
+        if hasattr(ms, "full_state"):
+            w.send_frame(frame(b"mtf", ms.full_state()))
+        if hasattr(ms, "schedule_exchange") and \
+                not w.node_name.startswith("bootstrap:"):
+            ms.schedule_exchange(w.node_name)
+
+    def swc_send_all(self, term: Any) -> None:
+        """Fire-and-forget SWC frame (object broadcast) to every peer."""
+        data = frame(b"swb", term)
+        for w in self._writers.values():
+            w.send_frame(data)
+
+    async def swc_call(self, node: str, term: Any, timeout: float = 10.0) -> Any:
+        """Request/response over the data plane — the SWC exchange's rpc
+        transport (replaces vmq_swc_edist_srv's erlang-dist rpc)."""
+        w = self._writers.get(node)
+        if w is None:
+            raise ConnectionError(f"no channel to {node}")
+        ref_id = next(self._ack_ids)
+        fut = asyncio.get_event_loop().create_future()
+        self._pending_swc[ref_id] = fut
+        try:
+            if not w.send_frame(frame(b"swc", (ref_id, term))):
+                raise ConnectionError(f"channel buffer to {node} full")
+            return await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending_swc.pop(ref_id, None)
+
+    def swc_respond(self, origin: str, ref_id: int, ok: bool, result: Any) -> None:
+        w = self._writers.get(origin)
+        if w is not None:
+            w.send_frame(frame(b"swr", (ref_id, ok, result)))
+
+    def resolve_swc(self, ref_id: int, ok: bool, result: Any) -> None:
+        fut = self._pending_swc.get(ref_id)
+        if fut is not None and not fut.done():
+            if ok:
+                fut.set_result(result)
+            else:
+                fut.set_exception(ConnectionError(str(result)))
 
     def _broadcast_meta(self, prefix: str, key: Any, entry) -> None:
         # the codec preserves tuple/list distinction, so keys travel as-is

@@ -132,3 +132,18 @@ def decode(data: bytes) -> Any:
     if pos != len(data):
         raise DecodeError("trailing bytes")
     return value
+
+
+def enkey(key: Any) -> Any:
+    """Tuple→list for wire shapes that must not rely on tuple keys."""
+    if isinstance(key, tuple):
+        return [enkey(k) for k in key]
+    return key
+
+
+def dekey(key: Any) -> Any:
+    """Restore tuple-ness of keys that traveled as lists (dict lookups in
+    the metadata stores are tuple-keyed)."""
+    if isinstance(key, list):
+        return tuple(dekey(k) for k in key)
+    return key

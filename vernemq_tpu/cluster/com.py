@@ -96,14 +96,29 @@ class ClusterCom:
             ref_id, ok = term
             cluster.resolve_ack(ref_id, ok)
         elif cmd == b"mta":
-            prefix, key, entry = term
-            cluster.metadata.merge(prefix, _dekey(key), tuple(entry))
+            if hasattr(cluster.metadata, "merge"):
+                prefix, key, entry = term
+                cluster.metadata.merge(prefix, codec.dekey(key), tuple(entry))
         elif cmd == b"mtf":
-            applied = cluster.metadata.merge_full(
-                (p, k, tuple(e)) for p, k, e in term)
-            if applied:
-                log.debug("anti-entropy from %s applied %d entries",
-                          origin, applied)
+            if hasattr(cluster.metadata, "merge_full"):
+                applied = cluster.metadata.merge_full(
+                    (p, k, tuple(e)) for p, k, e in term)
+                if applied:
+                    log.debug("anti-entropy from %s applied %d entries",
+                              origin, applied)
+        elif cmd == b"swb":
+            if hasattr(cluster.metadata, "handle_swc_cast"):
+                cluster.metadata.handle_swc_cast(origin, term)
+        elif cmd == b"swc":
+            ref_id, body = term
+            try:
+                result, ok = cluster.metadata.handle_swc_call(origin, body), True
+            except Exception as e:
+                result, ok = str(e), False
+            cluster.swc_respond(origin, ref_id, ok, result)
+        elif cmd == b"swr":
+            ref_id, ok, result = term
+            cluster.resolve_swc(ref_id, ok, result)
         elif cmd == b"hlo":
             cluster.on_hello(origin, term)
         elif cmd == b"png":
@@ -111,8 +126,3 @@ class ClusterCom:
         else:
             log.warning("unknown cluster frame %r from %s", cmd, origin)
 
-
-def _dekey(key):
-    if isinstance(key, list):
-        return tuple(_dekey(k) for k in key)
-    return key

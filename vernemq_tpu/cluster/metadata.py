@@ -19,6 +19,8 @@ from __future__ import annotations
 import threading
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+from . import codec
+
 Key = Tuple[str, Any]  # (prefix, key)
 Entry = Tuple[int, str, Any]  # (lamport, origin_node, value | None tombstone)
 
@@ -72,7 +74,7 @@ class MetadataStore:
                 if now - ts > self.TOMBSTONE_RETENTION_S:
                     self._kv.delete(kb)
                     continue
-            self._data[(prefix, _dekey(key))] = entry
+            self._data[(prefix, codec.dekey(key))] = entry
             self._clock = max(self._clock, entry[0])
 
     def _persist(self, prefix: str, key: Any, entry: Entry) -> None:
@@ -158,16 +160,10 @@ class MetadataStore:
     def merge_full(self, state: Iterable[Tuple[str, Any, Tuple]]) -> int:
         applied = 0
         for prefix, key, entry in state:
-            if self.merge(prefix, _dekey(key), entry):
+            if self.merge(prefix, codec.dekey(key), entry):
                 applied += 1
         return applied
 
     def stats(self) -> Dict[str, int]:
         return {"metadata_entries": len(self._data), "clock": self._clock}
 
-
-def _dekey(key: Any) -> Any:
-    # keys survive the codec as lists; restore tuple-ness for dict lookup
-    if isinstance(key, list):
-        return tuple(_dekey(k) for k in key)
-    return key

@@ -138,9 +138,9 @@ class NodeWriter:
             self._conn_lost = False
             name = self.cluster.node_name.encode()
             writer.write(HANDSHAKE + struct.pack(">I", len(name)) + name)
-            # on (re)connect push our metadata state: the plumtree/AE exchange
-            self.send_frame(frame(b"hlo", self.cluster.member_info()))
-            self.send_frame(frame(b"mtf", self.cluster.metadata.full_state()))
+            # on (re)connect run the backend's reconciliation: full-state
+            # push (LWW/plumtree-style) or an SWC exchange
+            self.cluster.on_peer_connected(self)
             self.status = "up"
             self.cluster.on_channel_status(self.node_name, "up")
             # the channel is write-only; EOF on the read side is the peer

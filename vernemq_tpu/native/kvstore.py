@@ -192,8 +192,11 @@ class KVStore:
         self._compactor.start()
 
     def close(self) -> None:
+        # the native handle is freed below, so a still-running compactor
+        # would use-after-free: join without a timeout (compaction is
+        # bounded by file size; shutdown correctness beats promptness)
         if self._compactor is not None and self._compactor.is_alive():
-            self._compactor.join(timeout=30)
+            self._compactor.join()
         if self._h:
             self._lib.kv_close(self._h)
             self._h = None

@@ -145,16 +145,22 @@ class NativeMsgStore(MsgStore):
         return (mp, cid), rest[2 + n2:]
 
     def _recover(self) -> None:
+        # refcounts must be rebuilt one-per-enqueue (per i-entry), matching
+        # the runtime write path — counting r-keys (one per sid+ref) would
+        # undercount a message enqueued twice to the same subscriber and a
+        # later delete would free the payload while a copy is still owed
         live_refs: Dict[bytes, int] = {}
-        for key in self._kv.scan_keys(b"r\x00"):
-            sid, ref = self._parse_sid(key[2:])
-            live_refs[ref] = live_refs.get(ref, 0) + 1
-        self._refcount = live_refs
         for key, ref in self._kv.scan(b"i\x00"):
             sid, seq_b = self._parse_sid(key[2:])
             seq = int.from_bytes(seq_b, "big")
             self._seqs.setdefault(sid, {}).setdefault(ref, []).append(seq)
             self._next_seq = max(self._next_seq, seq + 1)
+            live_refs[ref] = live_refs.get(ref, 0) + 1
+        self._refcount = live_refs
+        for key in self._kv.scan_keys(b"r\x00"):
+            _, ref = self._parse_sid(key[2:])
+            if ref not in live_refs:
+                self._kv.delete(key)  # stale ref marker with no idx entries
         # startup GC: drop payloads nobody references (keys-only scan — no
         # payload bytes cross the C boundary)
         for key in self._kv.scan_keys(b"m\x00"):
