@@ -95,8 +95,9 @@ def test_dkm_prune():
     dkm.insert("b", 1, "k2")
     dkm.mark_for_gc("k1")
     wm = {"a": {"a": 2, "b": 1}, "b": {"a": 2, "b": 1}}
-    deletable = dkm.prune(wm, ["a", "b"])
+    deletable, pruned = dkm.prune(wm, ["a", "b"])
     assert deletable == ["k1"]
+    assert set(pruned) == {("a", 1), ("a", 2), ("b", 1)}
     assert dkm.lookup(("a", 1)) is None
     assert dkm.object_count() == 0
 

@@ -151,6 +151,7 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
     reg.register(["metrics", "show"], _metrics_show,
                  "vmq-admin metrics show [--with-descriptions]")
     reg.register(["plugin", "show"], _plugin_show, "vmq-admin plugin show")
+    reg.register(["bridge", "show"], _bridge_show, "vmq-admin bridge show")
     reg.register(["plugin", "enable"], _plugin_enable,
                  "vmq-admin plugin enable name=PluginName [opt=val...]")
     reg.register(["plugin", "disable"], _plugin_disable,
@@ -293,6 +294,13 @@ def _metrics_show(broker, flags):
         if with_desc:
             row["description"] = broker.metrics.describe(k)
         rows.append(row)
+    return {"table": rows}
+
+
+def _bridge_show(broker, flags):
+    """vmq-admin bridge show (the vmq_bridge_cli info table)."""
+    plugin = broker.plugins.get("vmq_bridge")
+    rows = plugin.show() if plugin is not None else []
     return {"table": rows}
 
 

@@ -373,17 +373,24 @@ class Broker:
 
             self.graphite = GraphiteReporter(self)
             self.graphite.start()
+        if self.config.get("bridges"):
+            self.plugins.enable("vmq_bridge")
 
     async def stop(self) -> None:
-        if self.listeners is not None:
-            await self.listeners.stop_all()
         for t in self._bg_tasks:
             t.cancel()
         for t in self._delayed_wills.values():
             t.cancel()
         self._delayed_wills.clear()
+        # sessions first so lifecycle hooks (on_client_offline/gone) still
+        # reach enabled plugins; then plugins (a bridge keeps an outbound
+        # client reconnecting); listeners last — Server.wait_closed blocks
+        # until every connection handler (incl. bridge links) has returned
         for s in list(self.sessions.values()):
             await s.close("broker_shutdown", send_will=False)
+        await self.plugins.stop_all()
+        if self.listeners is not None:
+            await self.listeners.stop_all()
         for server in self._servers:
             server.close()
         self.msg_store.close()

@@ -72,6 +72,10 @@ DEFAULTS: Dict[str, Any] = {
     # metadata backend: "lww" (plumtree-flavored) | "swc" (server-wide
     # clocks, vmq_swc) — the metadata_impl knob (vmq_metadata.erl:24-28)
     "metadata_plugin": "lww",
+    # MQTT bridges (vmq_bridge): list of {host, port, topics:[{pattern,
+    # direction, qos, local_prefix, remote_prefix}], ...} dicts — the
+    # vmq_bridge.tcp.* config tree flattened
+    "bridges": [],
     "swc_replication_groups": 8,  # reference runs 10 (vmq_swc_plugin.erl:36-44)
     "swc_sync_interval": 2.0,  # seconds between AE rounds (sync_interval)
 }

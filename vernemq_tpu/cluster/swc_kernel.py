@@ -324,16 +324,20 @@ class DotKeyMap:
     def unmark(self, key: Any) -> None:
         self._gc_marked.discard(key)
 
-    def prune(self, wm: Watermark, peers: List[NodeId]) -> List[Any]:
-        """Drop log entries every peer has seen; return keys whose
-        tombstones may now be deleted outright."""
+    def prune(self, wm: Watermark,
+              peers: List[NodeId]) -> Tuple[List[Any], List[Dot]]:
+        """Drop log entries every peer has seen; return (keys whose
+        tombstones may now be deleted outright, the pruned dots) — the
+        dots let the caller drop their durable log records too."""
         deletable: List[Any] = []
+        pruned: List[Dot] = []
         for nid, row in list(self.log.items()):
             horizon = wm_min(wm, nid, peers)
             if horizon <= 0:
                 continue
             for c in [c for c in row if c <= horizon]:
                 key = row.pop(c)
+                pruned.append((nid, c))
                 dots = self._key_dots.get(key)
                 if dots is not None:
                     dots.discard((nid, c))
@@ -344,7 +348,7 @@ class DotKeyMap:
                             deletable.append(key)
             if not row:
                 del self.log[nid]
-        return deletable
+        return deletable, pruned
 
     def prune_for_peer(self, nid: NodeId) -> None:
         row = self.log.pop(nid, None)

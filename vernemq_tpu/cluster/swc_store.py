@@ -85,6 +85,7 @@ class SWCGroupStore:
         live / tombstone / hard-delete, fire the change event."""
         for dot in obj[0]:
             self.dkm.insert(dot[0], dot[1], skey)
+            self.owner._persist_dot(self.group, dot, skey)
         dots, ctx = K.dcc_strip(obj, self.nodeclock)
         live = {d: v for d, v in dots.items() if v != DELETED}
         old_values = K.dcc_values(old)
@@ -171,6 +172,7 @@ class SWCGroupStore:
             self.nodeclock.setdefault(nid, (0, 0))
         for left in old - set(me_and_peers):
             self.dkm.prune_for_peer(left)
+            self.owner._purge_peer_dots(self.group, left)
         self.watermark = K.wm_fix(self.watermark, me_and_peers)
         self.peers = [p for p in me_and_peers if p != self.id]
 
@@ -180,7 +182,9 @@ class SWCGroupStore:
         members = sorted(set(self.peers) | {self.id})
         wm = K.wm_update_peer(self.watermark, self.id, self.nodeclock)
         self.watermark = wm
-        for skey in self.dkm.prune(wm, members):
+        deletable, pruned = self.dkm.prune(wm, members)
+        self.owner._delete_dot_records(self.group, pruned)
+        for skey in deletable:
             self.objects.pop(skey, None)
             self.owner._persist_obj(self.group, skey, None)
 
@@ -216,6 +220,7 @@ class SWCMetadata:
         self._subscribers: Dict[str, List[Callable[[Any, Any, Any, str], None]]] = {}
         self.cluster: Optional[Any] = None
         self._ae_task: Optional[asyncio.Task] = None
+        self._exchange_tasks: set = set()
         self._exchange_lock: Optional[asyncio.Lock] = None
         self.exchanges_done = 0
         self._kv = None
@@ -250,7 +255,18 @@ class SWCMetadata:
             loop = asyncio.get_event_loop()
         except RuntimeError:
             return
-        loop.create_task(self.exchange_with(peer))
+        # hold a strong reference: the loop keeps only weak refs to tasks,
+        # and a GC'd exchange would neither finish nor report its failure
+        task = loop.create_task(self.exchange_with(peer))
+        self._exchange_tasks.add(task)
+
+        def _done(t: "asyncio.Task") -> None:
+            self._exchange_tasks.discard(t)
+            if not t.cancelled() and t.exception() is not None:
+                log.error("scheduled exchange with %s failed", peer,
+                          exc_info=t.exception())
+
+        task.add_done_callback(_done)
 
     # ------------------------------------------------------------------ API
 
@@ -441,13 +457,14 @@ class SWCMetadata:
                 group.objects[skey] = obj
                 if not K.dcc_values(obj):
                     group.dkm.mark_for_gc(skey)
-            elif tag == b"d":
-                # dot-key-map log: tombstone dots live only here, so the
-                # log must be durable or reloaded tombstones never GC
-                for nid, row in codec.decode(vb).items():
-                    for counter, skey_w in row.items():
-                        group.dkm.insert(
-                            nid, counter, (skey_w[0], codec.dekey(skey_w[1])))
+            elif tag == b"e":
+                # dot-key-map log entry (one per dot): tombstone dots live
+                # only here, so the log must be durable or reloaded
+                # tombstones never GC
+                nid, counter = codec.decode(kb[2:])
+                skey_w = codec.decode(vb)
+                group.dkm.insert(nid, counter,
+                                 (skey_w[0], codec.dekey(skey_w[1])))
             elif tag == b"c":
                 group.nodeclock = _wire_clock(codec.decode(vb))
             elif tag == b"w":
@@ -471,9 +488,29 @@ class SWCMetadata:
                      codec.encode({n: list(e) for n, e in g.nodeclock.items()}))
         self._kv.put(b"w" + bytes([gidx]),
                      codec.encode({a: dict(r) for a, r in g.watermark.items()}))
-        self._kv.put(b"d" + bytes([gidx]), codec.encode(
-            {nid: {c: [sk[0], codec.enkey(sk[1])] for c, sk in row.items()}
-             for nid, row in g.dkm.log.items()}))
+
+    def _persist_dot(self, gidx: int, dot: Dot, skey: Key) -> None:
+        """One durable record per log dot — per-write cost stays O(1)
+        instead of re-encoding the whole group log each operation."""
+        if self._kv is None:
+            return
+        self._kv.put(b"e" + bytes([gidx]) + codec.encode([dot[0], dot[1]]),
+                     codec.encode([skey[0], codec.enkey(skey[1])]))
+
+    def _delete_dot_records(self, gidx: int, dots: List[Dot]) -> None:
+        if self._kv is None or not dots:
+            return
+        for nid, c in dots:
+            self._kv.delete(b"e" + bytes([gidx]) + codec.encode([nid, c]))
+
+    def _purge_peer_dots(self, gidx: int, nid: str) -> None:
+        """A peer left the group: drop its durable log records (rare)."""
+        if self._kv is None:
+            return
+        prefix = b"e" + bytes([gidx])
+        for kb in list(self._kv.scan_keys(prefix)):
+            if codec.decode(kb[2:])[0] == nid:
+                self._kv.delete(kb)
 
 
 def _resolve(values: List[Any]) -> Any:

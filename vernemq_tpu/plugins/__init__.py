@@ -54,6 +54,24 @@ class PluginManager:
             raise ValueError(f"plugin {name} not enabled")
         plugin.unregister(self.broker.hooks)
 
+    async def stop_all(self) -> None:
+        """Broker-shutdown hook: bring down every enabled plugin, awaiting
+        plugins that hold network links (bridges) so their connections are
+        gone before the listeners are reaped."""
+        import logging
+
+        for name, plugin in list(self._enabled.items()):
+            try:
+                stop = getattr(plugin, "stop_all", None)
+                if stop is not None:
+                    await stop()
+                else:
+                    plugin.unregister(self.broker.hooks)
+            except Exception:
+                logging.getLogger("vernemq_tpu.plugins").exception(
+                    "plugin %s failed to stop cleanly", name)
+            self._enabled.pop(name, None)
+
     def get(self, name: str) -> Optional[Any]:
         return self._enabled.get(name)
 
