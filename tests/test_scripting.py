@@ -1,0 +1,188 @@
+"""Scripting-plugin tests (vmq_diversity role): script-provided auth hooks,
+the ACL cache front-end, script reload, per-script kv — plus the MQTT5 demo
+plugin's enhanced-auth exchange (vmq_mqtt5_demo_plugin role), all driven
+over real MQTT connections."""
+
+import asyncio
+import textwrap
+
+import pytest
+
+from vernemq_tpu.broker.config import Config
+from vernemq_tpu.broker.server import start_broker
+from vernemq_tpu.client import MQTTClient
+
+AUTH_SCRIPT = textwrap.dedent('''
+    # operator auth script: the shape of the reference's bundled Lua auth
+    # scripts (vmq_diversity priv/auth/*.lua) with the datastore replaced
+    # by an in-script table
+    USERS = {"alice": b"wonder", "bob": b"builder"}
+
+    def auth_on_register(peer, sid, username, password, clean_start):
+        kv["registers"] = kv.get("registers", 0) + 1
+        if username not in USERS:
+            return "next"          # not ours — let other plugins decide
+        if USERS[username] != password:
+            return ("error", "invalid_credentials")
+        cache.insert(sid[0], sid[1], username,
+                     publish=["data/%u/#"],
+                     subscribe=["data/#", {"pattern": "ctrl/%c"}])
+        return "ok"
+
+    def on_client_gone(sid):
+        kv.setdefault("gone", []).append(sid[1])
+''')
+
+
+async def boot_with_script(tmp_path, script_src=AUTH_SCRIPT, **cfg):
+    path = tmp_path / "auth_script.py"
+    path.write_text(script_src)
+    broker, server = await start_broker(
+        Config(systree_enabled=False, allow_anonymous=False, **cfg),
+        port=0, node_name="scripted")
+    plugin = broker.plugins.enable("vmq_diversity", scripts=[str(path)])
+    return broker, server, plugin, path
+
+
+@pytest.mark.asyncio
+async def test_script_auth_and_acl_cache(tmp_path):
+    b, s, plugin, _ = await boot_with_script(tmp_path)
+    try:
+        # wrong password rejected
+        bad = MQTTClient(s.host, s.port, client_id="c1",
+                         username="alice", password=b"nope")
+        ack = await bad.connect()
+        assert ack.rc == 4  # bad user or password
+        await bad.close()
+        # unknown user falls through to default-deny (allow_anonymous=False)
+        unknown = MQTTClient(s.host, s.port, client_id="cx",
+                             username="eve", password=b"x")
+        ack = await unknown.connect()
+        assert ack.rc == 5
+        await unknown.close()
+        # good credentials: ACLs cached at register time
+        c = MQTTClient(s.host, s.port, client_id="c1",
+                       username="alice", password=b"wonder")
+        ack = await c.connect()
+        assert ack.rc == 0
+        assert plugin.stats()["cached_acls"] == 1
+        # subscribe through the cached ACL: allowed pattern + denied one
+        sub = await c.subscribe(["data/#", "secret/#"], qos=1)
+        assert sub.reason_codes[0] in (0, 1)
+        assert sub.reason_codes[1] == 0x80  # cached ACL says no
+        # publish %u-expanded pattern: data/alice/... allowed
+        await c.publish("data/alice/t", b"mine", qos=1)
+        msg = await c.recv(5.0)
+        assert msg.payload == b"mine"
+        # publish outside the ACL is dropped (v4: connection stays, no msg)
+        await c.publish("data/bob/t", b"not-mine", qos=1)
+        with pytest.raises(asyncio.TimeoutError):
+            await c.recv(0.4)
+        # %c expansion
+        sub2 = await c.subscribe("ctrl/c1", qos=0)
+        assert sub2.reason_codes[0] == 0
+        await c.close()
+        # lifecycle hook ran + kv persisted across hook invocations
+        await asyncio.sleep(0.1)
+        script = next(iter(plugin.scripts.values()))
+        assert script.kv["registers"] >= 3
+        assert "c1" in script.kv.get("gone", [])
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_script_reload_changes_behavior(tmp_path):
+    b, s, plugin, path = await boot_with_script(tmp_path)
+    try:
+        denied = MQTTClient(s.host, s.port, client_id="c9",
+                            username="carol", password=b"pw")
+        ack = await denied.connect()
+        assert ack.rc == 5
+        await denied.close()
+        path.write_text(AUTH_SCRIPT.replace(
+            '{"alice": b"wonder", "bob": b"builder"}',
+            '{"carol": b"pw"}'))
+        plugin.reload_script(str(path))
+        ok = MQTTClient(s.host, s.port, client_id="c9",
+                        username="carol", password=b"pw")
+        ack = await ok.connect()
+        assert ack.rc == 0
+        await ok.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_script_error_is_auth_error_not_crash(tmp_path):
+    b, s, plugin, _ = await boot_with_script(tmp_path, textwrap.dedent('''
+        def auth_on_register(peer, sid, username, password, clean_start):
+            raise ValueError("boom")
+    '''))
+    try:
+        c = MQTTClient(s.host, s.port, client_id="cc",
+                       username="anyone", password=b"x")
+        ack = await c.connect()
+        assert ack.rc == 5  # deny, broker alive
+        await c.close()
+        c2 = MQTTClient(s.host, s.port, client_id="cd",
+                        username="x", password=b"y")
+        ack2 = await c2.connect()
+        assert ack2.rc == 5
+        await c2.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+# ------------------------------------------------ MQTT5 demo plugin (v5)
+
+
+@pytest.mark.asyncio
+async def test_mqtt5_demo_enhanced_auth_two_rounds():
+    b, s = await start_broker(Config(systree_enabled=False), port=0,
+                              node_name="demo5")
+    b.plugins.enable("vmqtt5demo" if False else "vmq_mqtt5_demo_plugin")
+    try:
+        c = MQTTClient(s.host, s.port, client_id="eauth", proto_ver=5,
+                       properties={"authentication_method": "method1",
+                                   "authentication_data": b"client1"})
+        first = await c.connect()
+        # round 1: broker continues with server1
+        assert type(first).__name__ == "Auth"
+        assert first.properties.get("authentication_data") == b"server1"
+        # round 2: client answers client2 → CONNACK success + server2
+        ack = await c.auth(0x18, {"authentication_method": "method1",
+                                  "authentication_data": b"client2"})
+        assert type(ack).__name__ == "Connack"
+        assert ack.rc == 0
+        assert ack.properties.get("authentication_data") == b"server2"
+        # the authenticated session is fully usable
+        await c.subscribe("e/#", qos=1)
+        await c.publish("e/t", b"hello", qos=1)
+        msg = await c.recv(5.0)
+        assert msg.payload == b"hello"
+        await c.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_mqtt5_demo_enhanced_auth_bad_data_rejected():
+    b, s = await start_broker(Config(systree_enabled=False), port=0,
+                              node_name="demo5b")
+    b.plugins.enable("vmq_mqtt5_demo_plugin")
+    try:
+        c = MQTTClient(s.host, s.port, client_id="eauth-bad", proto_ver=5,
+                       properties={"authentication_method": "method1",
+                                   "authentication_data": b"baddata"})
+        frame = await c.connect()
+        assert type(frame).__name__ == "Connack"
+        assert frame.rc == 0x8C  # bad authentication method
+        await c.close()
+    finally:
+        await b.stop()
+        await s.stop()

@@ -1,0 +1,95 @@
+"""Session tracer tests (vmq_tracer role): frame-level trace of one
+client's sessions with rate limiting and payload truncation, driven over
+real MQTT connections like the reference's tracer is."""
+
+import asyncio
+
+import pytest
+
+from vernemq_tpu.admin.commands import CommandError, CommandRegistry, register_core_commands
+from vernemq_tpu.broker.config import Config
+from vernemq_tpu.broker.server import start_broker
+from vernemq_tpu.client import MQTTClient
+
+
+async def boot():
+    broker, server = await start_broker(
+        Config(systree_enabled=False), port=0, node_name="tracer-node")
+    return broker, server
+
+
+@pytest.mark.asyncio
+async def test_trace_captures_frames_of_matching_client_only():
+    b, s = await boot()
+    try:
+        tracer = b.start_trace("traced", payload_limit=8)
+        c1 = MQTTClient(s.host, s.port, client_id="traced")
+        await c1.connect()
+        c2 = MQTTClient(s.host, s.port, client_id="other")
+        await c2.connect()
+        await c1.subscribe("t/#", qos=1)
+        await c2.publish("t/x", b"from-other", qos=1)
+        await c1.recv(5.0)
+        await c1.publish("t/self", b"a" * 100, qos=0)
+        await asyncio.sleep(0.1)
+        lines = "\n".join(tracer.drain())
+        assert 'New session for client "traced"' in lines
+        assert "CONNECT c: 'traced'" in lines
+        assert "CONNACK rc: 0" in lines
+        assert "SUBSCRIBE" in lines and "SUBACK" in lines
+        # delivery of the other client's publish traced on the way OUT
+        assert "MQTT SEND: PUBLISH" in lines and "'t/x'" in lines
+        # but the other client's own session is not traced
+        assert "'other'" not in lines
+        # payload truncation
+        assert "(100 bytes)" in lines
+        await c1.close()
+        await c2.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_trace_rate_limit_trips_once():
+    b, s = await boot()
+    try:
+        tracer = b.start_trace("flood", max_rate=(5, 60.0))
+        c = MQTTClient(s.host, s.port, client_id="flood")
+        await c.connect()
+        for i in range(20):
+            await c.publish("f/t", b"x", qos=0)
+        await asyncio.sleep(0.1)
+        lines = tracer.drain()
+        assert sum("rate limit" in l for l in lines) == 1
+        assert len([l for l in lines if "MQTT" in l]) <= 5
+        await c.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_trace_cli_lifecycle_and_single_tracer():
+    b, s = await boot()
+    try:
+        reg = register_core_commands(CommandRegistry())
+        out = reg.run(b, ["trace", "client", "client-id=cli-c"])
+        assert "Tracing" in out["text"]
+        with pytest.raises(CommandError):
+            reg.run(b, ["trace", "client", "client-id=someone-else"])
+        c = MQTTClient(s.host, s.port, client_id="cli-c")
+        await c.connect()
+        await c.ping()
+        await asyncio.sleep(0.1)
+        shown = reg.run(b, ["trace", "show"])["text"]
+        assert "CONNECT" in shown and "PINGREQ" in shown
+        stopped = reg.run(b, ["trace", "stop"])["text"]
+        assert "stopped" in stopped
+        assert b.tracer is None
+        with pytest.raises(CommandError):
+            reg.run(b, ["trace", "show"])
+        await c.close()
+    finally:
+        await b.stop()
+        await s.stop()

@@ -152,6 +152,11 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin metrics show [--with-descriptions]")
     reg.register(["plugin", "show"], _plugin_show, "vmq-admin plugin show")
     reg.register(["bridge", "show"], _bridge_show, "vmq-admin bridge show")
+    reg.register(["trace", "client"], _trace_client,
+                 "vmq-admin trace client client-id=X [mountpoint=MP] "
+                 "[payload-limit=N] [rate-max=N] [rate-interval=Secs]")
+    reg.register(["trace", "show"], _trace_show, "vmq-admin trace show")
+    reg.register(["trace", "stop"], _trace_stop, "vmq-admin trace stop")
     reg.register(["plugin", "enable"], _plugin_enable,
                  "vmq-admin plugin enable name=PluginName [opt=val...]")
     reg.register(["plugin", "disable"], _plugin_disable,
@@ -295,6 +300,39 @@ def _metrics_show(broker, flags):
             row["description"] = broker.metrics.describe(k)
         rows.append(row)
     return {"table": rows}
+
+
+def _trace_client(broker, flags):
+    """Start tracing a client's sessions (vmq_tracer_cli trace_client_cmd)."""
+    client_id = flags.get("client_id")
+    if not client_id:
+        raise CommandError("client-id=X is required")
+    try:
+        broker.start_trace(
+            client_id,
+            mountpoint=flags.get("mountpoint", ""),
+            payload_limit=int(flags.get("payload_limit", 1000)),
+            max_rate=(int(flags.get("rate_max", 10)),
+                      float(flags.get("rate_interval", 0.1))))
+    except RuntimeError as e:
+        raise CommandError(str(e))
+    return {"text": f'Tracing client "{client_id}". '
+                    "Use `trace show` to drain output, `trace stop` to end."}
+
+
+def _trace_show(broker, flags):
+    if broker.tracer is None:
+        raise CommandError("no trace running")
+    return {"text": "\n".join(broker.tracer.drain())}
+
+
+def _trace_stop(broker, flags):
+    if broker.tracer is None:
+        raise CommandError("no trace running")
+    info = broker.tracer.info()
+    broker.stop_trace()
+    return {"text": f"Trace for \"{info['client_id']}\" stopped "
+                    f"after {info['traced_frames']} frames."}
 
 
 def _bridge_show(broker, flags):

@@ -167,6 +167,7 @@ class HttpServer:
             "retain_messages": m.get("retain_messages", 0),
             "publish_received": m.get("mqtt_publish_received", 0),
             "publish_sent": m.get("mqtt_publish_sent", 0),
+            **({"sysmon": b.sysmon.status()} if b.sysmon is not None else {}),
         }
 
     # ----------------------------------------------------------- mgmt API

@@ -82,6 +82,9 @@ class Broker:
         # queue pids; a direct map is equivalent single-node)
         self.sessions: Dict[SubscriberId, Any] = {}
         self._delayed_wills: Dict[SubscriberId, asyncio.Task] = {}
+        self.tracer: Optional[Any] = None  # single active session tracer
+        self.sysmon: Optional[Any] = None
+        self.crl_refresher: Optional[Any] = None
         self.http: Optional[Any] = None
         self.graphite: Optional[Any] = None
         self.listeners: Optional[Any] = None  # ListenerManager (transports)
@@ -350,6 +353,38 @@ class Broker:
                 except RuntimeError:
                     pass
 
+    # ---------------------------------------------------- session tracing
+
+    def trace_frame(self, direction: str, mountpoint: str,
+                    client_id: Optional[str], frame: Any,
+                    session_start: bool = False) -> None:
+        """Frame tap from the session layer; no-op unless a tracer is
+        active and the client matches (vmq_tracer role)."""
+        t = self.tracer
+        if t is None or not t.matches(mountpoint, client_id):
+            return
+        if session_start:
+            t.session_event(f'New session for client "{client_id}"')
+        t.trace(direction, client_id, frame)
+
+    def start_trace(self, client_id: str, mountpoint: str = "",
+                    **opts) -> Any:
+        """vmq-admin trace client client-id=X; single tracer at a time
+        (vmq_tracer_cli: "another trace is already running")."""
+        if self.tracer is not None:
+            raise RuntimeError("another trace is already running")
+        from ..admin.tracer import Tracer
+
+        self.tracer = Tracer(client_id, mountpoint, **opts)
+        n = sum(1 for sid in self.sessions
+                if sid == (mountpoint, client_id))
+        self.tracer.session_event(
+            f'Starting trace for {n} existing sessions for client "{client_id}"')
+        return self.tracer
+
+    def stop_trace(self) -> None:
+        self.tracer = None
+
     async def start(self) -> None:
         # warm-load from persisted metadata: routing state, offline queues,
         # retain cache (boot order of vmq_server_sup + vmq_reg_trie /
@@ -375,6 +410,20 @@ class Broker:
             self.graphite.start()
         if self.config.get("bridges"):
             self.plugins.enable("vmq_bridge")
+        if self.config.get("sysmon_enabled", True):
+            from .sysmon import Sysmon
+
+            self.sysmon = Sysmon(
+                self,
+                lag_threshold=self.config.get("sysmon_lag_threshold", 0.25),
+                memory_high_watermark=self.config.get(
+                    "sysmon_memory_high_watermark", 0))
+            self.sysmon.start()
+        from .sysmon import CrlRefresher
+
+        self.crl_refresher = CrlRefresher(
+            self, interval=self.config.get("crl_refresh_interval", 60.0))
+        self.crl_refresher.start()
 
     async def stop(self) -> None:
         for t in self._bg_tasks:
@@ -386,6 +435,10 @@ class Broker:
         # reach enabled plugins; then plugins (a bridge keeps an outbound
         # client reconnecting); listeners last — Server.wait_closed blocks
         # until every connection handler (incl. bridge links) has returned
+        if self.sysmon is not None:
+            self.sysmon.stop()
+        if self.crl_refresher is not None:
+            self.crl_refresher.stop()
         for s in list(self.sessions.values()):
             await s.close("broker_shutdown", send_will=False)
         await self.plugins.stop_all()

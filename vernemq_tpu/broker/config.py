@@ -76,6 +76,14 @@ DEFAULTS: Dict[str, Any] = {
     # direction, qos, local_prefix, remote_prefix}], ...} dicts — the
     # vmq_bridge.tcp.* config tree flattened
     "bridges": [],
+    # scripting plugin (vmq_diversity): operator script files exposing the
+    # hook surface; Python here where the reference embeds Lua
+    "diversity_scripts": [],
+    # sysmon / overload protection (vmq_sysmon; riak_sysmon knobs)
+    "sysmon_enabled": True,
+    "sysmon_lag_threshold": 0.25,  # seconds of event-loop lag = long_schedule
+    "sysmon_memory_high_watermark": 0,  # bytes RSS; 0 = off (large_heap)
+    "crl_refresh_interval": 60.0,  # seconds (vmq_crl_srv schema knob)
     "swc_replication_groups": 8,  # reference runs 10 (vmq_swc_plugin.erl:36-44)
     "swc_sync_interval": 2.0,  # seconds between AE rounds (sync_interval)
 }

@@ -90,9 +90,15 @@ class ListenerManager:
                 raise ValueError("cluster listener already running")
         self._listeners[(addr, port)] = {
             "kind": kind, "server": server, "opts": opts,
+            "ssl_context": ssl_context,
         }
         log.info("started %s listener on %s:%d", kind, addr, port)
         return server
+
+    def listener_records(self) -> List[Dict[str, Any]]:
+        """Raw listener records (kind/server/opts/ssl_context) — consumed
+        by the CRL refresher and introspection."""
+        return list(self._listeners.values())
 
     def stop_listener(self, addr: str, port: int) -> None:
         entry = self._listeners.pop((addr, port), None)

@@ -126,6 +126,8 @@ class Session:
     def send(self, frame: Frame) -> None:
         if self.closed:
             return
+        if self.broker.tracer is not None:  # session tracer tap (vmq_tracer)
+            self.broker.trace_frame("out", self.mountpoint, self.client_id, frame)
         data = self.codec.serialise(frame)
         self.transport.write(data)
         self.broker.metrics.incr("bytes_sent", len(data))
@@ -161,6 +163,11 @@ class Session:
             return False
         self.client_id = client_id
         self.sid = (self.mountpoint, client_id)
+        if self.broker.tracer is not None:
+            # trace the CONNECT of a newly-arriving traced client (the
+            # trace_fun injected into FSM init, vmq_mqtt_fsm.erl:116-118)
+            self.broker.trace_frame("in", self.mountpoint, client_id, f,
+                                    session_start=True)
 
         if self.proto_ver == PROTO_5:
             self.session_expiry = f.properties.get("session_expiry_interval", 0)
@@ -274,6 +281,12 @@ class Session:
             if cfg.max_session_expiry_interval and self.session_expiry != \
                     (self._pending_connect or f).properties.get("session_expiry_interval", 0):
                 props["session_expiry_interval"] = self.session_expiry
+            if self.auth_method is not None:
+                # enhanced auth: CONNACK echoes the method and the final
+                # server auth data (MQTT5 3.2.2.3.17; vmq_mqtt5_fsm AUTH)
+                props["authentication_method"] = self.auth_method
+                if getattr(self, "_auth_success_data", None):
+                    props["authentication_data"] = self._auth_success_data
         self.send(Connack(session_present=session_present, rc=0, properties=props))
         self.broker.metrics.incr("mqtt_connack_sent")
         # attach AFTER the CONNACK so offline-backlog flush serialises behind
@@ -328,6 +341,8 @@ class Session:
     async def handle_frame(self, frame: Frame) -> None:
         self.last_activity = time.monotonic()
         self._metric_in(frame)
+        if self.broker.tracer is not None:
+            self.broker.trace_frame("in", self.mountpoint, self.client_id, frame)
         t = type(frame)
         if t is Publish:
             await self._handle_publish(frame)
@@ -378,8 +393,16 @@ class Session:
             await self.close("message_too_large")
             return
         if not self.broker.metrics.check_rate(self.sid, cfg.max_message_rate):
-            await self.close("message_rate_exceeded")
-            return
+            # the reference THROTTLES rather than kills the session: the
+            # socket loop pauses reads for ~1s (vmq_mqtt_fsm.erl:243-262 →
+            # vmq_ranch.erl:198-203); awaiting here backpressures the
+            # reader loop the same way, then the publish proceeds
+            self.broker.metrics.incr("mqtt_publish_throttled")
+            await asyncio.sleep(1.0)
+        if self.broker.sysmon is not None and self.broker.sysmon.overloaded:
+            # sysmon load shedding: slow every producer while overloaded
+            self.broker.metrics.incr("mqtt_publish_throttled")
+            await asyncio.sleep(0.1)
         # v5 topic alias resolution (vmq_mqtt5_fsm.erl:90-93)
         topic_str = f.topic
         words: Optional[Tuple[str, ...]] = None

@@ -23,6 +23,13 @@ def make_server_context(opts: Dict[str, Any]) -> ssl.SSLContext:
         ctx.verify_mode = ssl.CERT_REQUIRED
     elif cafile:
         ctx.verify_mode = ssl.CERT_OPTIONAL
+    crl_file = opts.get("crl_file")
+    if crl_file:
+        # load at startup, not only at the first periodic refresh — a
+        # revoked cert must not be accepted during the first
+        # crl_refresh_interval window (vmq_crl_srv checks on listener start)
+        ctx.load_verify_locations(cafile=crl_file)
+        ctx.verify_flags |= ssl.VERIFY_CRL_CHECK_LEAF
     ciphers = opts.get("ciphers")
     if ciphers:
         ctx.set_ciphers(ciphers)

@@ -457,6 +457,15 @@ class SWCMetadata:
                 group.objects[skey] = obj
                 if not K.dcc_values(obj):
                     group.dkm.mark_for_gc(skey)
+            elif tag == b"d":
+                # legacy whole-log blob (pre per-dot records): import and
+                # rewrite as b"e" records, then drop the blob
+                for nid, row in codec.decode(vb).items():
+                    for counter, skey_w in row.items():
+                        skey = (skey_w[0], codec.dekey(skey_w[1]))
+                        group.dkm.insert(nid, counter, skey)
+                        self._persist_dot(gidx, (nid, counter), skey)
+                self._kv.delete(kb)
             elif tag == b"e":
                 # dot-key-map log entry (one per dot): tombstone dots live
                 # only here, so the log must be durable or reloaded

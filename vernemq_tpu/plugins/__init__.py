@@ -13,7 +13,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 
 class PluginManager:
-    BUNDLED = ("vmq_acl", "vmq_passwd", "vmq_webhooks", "vmq_bridge")
+    BUNDLED = ("vmq_acl", "vmq_passwd", "vmq_webhooks", "vmq_bridge",
+               "vmq_diversity", "vmq_mqtt5_demo_plugin")
 
     def __init__(self, broker):
         self.broker = broker
@@ -42,6 +43,14 @@ class PluginManager:
             except ImportError as e:
                 raise ValueError(f"plugin {name} unavailable: {e}") from None
             plugin = BridgePlugin(self.broker, **opts)
+        elif name == "vmq_diversity":
+            from .scripting import ScriptingPlugin
+
+            plugin = ScriptingPlugin(self.broker, **opts)
+        elif name == "vmq_mqtt5_demo_plugin":
+            from .mqtt5_demo import Mqtt5DemoPlugin
+
+            plugin = Mqtt5DemoPlugin(self.broker)
         else:
             raise ValueError(f"unknown plugin {name!r}")
         plugin.register(self.broker.hooks)

@@ -275,6 +275,7 @@ class BridgePlugin:
     def __init__(self, broker, bridges: Optional[List[Dict[str, Any]]] = None):
         self.broker = broker
         self.bridges: Dict[str, Bridge] = {}
+        self._stop_tasks: set = set()
         for i, cfg in enumerate(bridges or broker.config.get("bridges", [])):
             self.add_bridge(cfg.get("name", f"br{i}"), cfg)
 
@@ -310,7 +311,18 @@ class BridgePlugin:
     def unregister(self, hooks) -> None:
         loop = asyncio.get_event_loop()
         for b in self.bridges.values():
-            loop.create_task(b.stop())
+            # hold strong refs: the loop keeps only weak task refs, and a
+            # GC'd stop task would leave the reconnect loop running
+            task = loop.create_task(b.stop())
+            self._stop_tasks.add(task)
+
+            def _done(t: "asyncio.Task", name=b.name) -> None:
+                self._stop_tasks.discard(t)
+                if not t.cancelled() and t.exception() is not None:
+                    log.error("bridge %s failed to stop", name,
+                              exc_info=t.exception())
+
+            task.add_done_callback(_done)
         self.bridges.clear()
 
     async def stop_all(self) -> None:

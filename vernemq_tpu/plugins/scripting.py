@@ -1,0 +1,250 @@
+"""Script-driven plugins: operator-provided scripts exposing the full
+auth/lifecycle hook surface, with an ACL cache so per-publish authorization
+does not re-enter the script.
+
+Plays the role of ``vmq_diversity`` (4.6k LoC): the reference embeds a Lua
+interpreter (luerl) and hands Lua scripts the hook surface plus datastore
+connectors (``vmq_diversity_plugin.erl:18-50``), a per-script KV store
+(``vmq_diversity_ets.erl``), and an auth/ACL cache
+(``vmq_diversity_cache.erl``) so ``auth_on_publish``/``auth_on_subscribe``
+hit cached ACLs instead of the datastore. The TPU-era broker is Python all
+the way down, so the natural scripting language *is* Python: scripts are
+plain ``.py`` files exec'd with a helper namespace — same trust model as
+the reference's operator-provided Lua (scripts run in-process with broker
+privileges).
+
+Script surface (any subset):
+
+- ``auth_on_register(peer, sid, username, password, clean_start)``
+- ``auth_on_publish(username, sid, qos, topic, payload, retain)``
+- ``auth_on_subscribe(username, sid, topics)``
+- the ``_m5`` variants, ``on_auth_m5(sid, method, data)``
+- lifecycle: ``on_register``, ``on_publish``, ``on_subscribe``,
+  ``on_unsubscribe``, ``on_deliver``, ``on_offline_message``,
+  ``on_client_wakeup``, ``on_client_offline``, ``on_client_gone``,
+  ``on_message_drop``
+
+Injected helpers:
+
+- ``kv``: per-script dict-backed store (vmq_diversity_ets role)
+- ``cache``: the ACL cache — ``cache.insert(mountpoint, client_id,
+  username, publish=[...], subscribe=[...])`` from ``auth_on_register``;
+  ``%u``/``%c`` in patterns substitute username/client-id at insert
+  (vmq_diversity_cache.erl)
+- ``log``: a logger
+- ``topic``: the topic algebra module (match/validate)
+
+Datastore connectors: the reference bundles postgres/mysql/mongodb/redis/
+memcached drivers. This image ships none of those client libraries, so
+scripts import drivers themselves when deployed where they exist; the
+ready-made auth-script pattern is documented in the test-suite example.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..broker.plugins import HookError
+from ..protocol import topic as T
+
+log = logging.getLogger("vernemq_tpu.scripting")
+
+#: every hook a script may implement (the vernemq_dev hook behaviours)
+SCRIPT_HOOKS = (
+    "auth_on_register", "auth_on_publish", "auth_on_subscribe",
+    "auth_on_register_m5", "auth_on_publish_m5", "auth_on_subscribe_m5",
+    "on_auth_m5",
+    "on_register", "on_publish", "on_subscribe", "on_unsubscribe",
+    "on_deliver", "on_offline_message", "on_client_wakeup",
+    "on_client_offline", "on_client_gone", "on_message_drop",
+)
+
+
+class AclCache:
+    """Per-subscriber cached ACLs (vmq_diversity_cache.erl): populated by
+    a successful ``auth_on_register``, consulted by the publish/subscribe
+    auth hooks without re-entering the script."""
+
+    def __init__(self) -> None:
+        self._entries: Dict[Tuple[str, str], Dict[str, List[Any]]] = {}
+
+    @staticmethod
+    def _expand(pattern: str, username: Optional[str], client_id: str) -> List[str]:
+        """%u/%c substitution at insert time (mosquitto-style, as the
+        reference's Lua cache does)."""
+        out = pattern
+        if username is not None:
+            out = out.replace("%u", username)
+        out = out.replace("%c", client_id)
+        return out.split("/")
+
+    def insert(self, mountpoint: str, client_id: str,
+               username: Optional[str],
+               publish: Sequence[Any] = (),
+               subscribe: Sequence[Any] = ()) -> None:
+        def norm(acls):
+            normed = []
+            for a in acls:
+                if isinstance(a, str):
+                    normed.append((self._expand(a, username, client_id), {}))
+                else:  # {"pattern": ..., **modifiers}
+                    a = dict(a)
+                    normed.append((self._expand(a.pop("pattern"), username,
+                                                client_id), a))
+            return normed
+
+        self._entries[(mountpoint, client_id)] = {
+            "publish": norm(publish), "subscribe": norm(subscribe)}
+
+    def remove(self, mountpoint: str, client_id: str) -> None:
+        self._entries.pop((mountpoint, client_id), None)
+
+    def lookup(self, sid, kind: str, topic: Sequence[str]) -> Optional[Tuple[bool, Dict]]:
+        """None = no entry for this client (fall through to scripts);
+        (True, modifiers) = allowed; (False, {}) = cached ACL says no."""
+        entry = self._entries.get((sid[0], sid[1]))
+        if entry is None:
+            return None
+        for pattern, modifiers in entry[kind]:
+            if T.match(list(topic), pattern):
+                return True, modifiers
+        return False, {}
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+
+class Script:
+    """One loaded script file (one vmq_diversity script state)."""
+
+    def __init__(self, path: str, plugin: "ScriptingPlugin"):
+        self.path = path
+        self.plugin = plugin
+        self.kv: Dict[Any, Any] = {}
+        self.hooks: Dict[str, Any] = {}
+        self.load()
+
+    def load(self) -> None:
+        with open(self.path) as f:
+            src = f.read()
+        ns: Dict[str, Any] = {
+            "kv": self.kv,
+            "cache": self.plugin.cache,
+            "log": logging.getLogger(f"vernemq_tpu.script.{self.path}"),
+            "topic": T,
+        }
+        exec(compile(src, self.path, "exec"), ns)
+        self.hooks = {h: ns[h] for h in SCRIPT_HOOKS if callable(ns.get(h))}
+
+
+class ScriptingPlugin:
+    """The vmq_diversity equivalent: loads scripts, registers their hooks,
+    fronts publish/subscribe auth with the ACL cache."""
+
+    def __init__(self, broker, scripts: Optional[Sequence[str]] = None):
+        self.broker = broker
+        self.cache = AclCache()
+        self.scripts: Dict[str, Script] = {}
+        for path in (scripts or broker.config.get("diversity_scripts", [])):
+            self.load_script(path)
+        self._registered: List[Tuple[str, Any]] = []
+
+    # ------------------------------------------------------------- scripts
+
+    def load_script(self, path: str) -> Script:
+        s = Script(path, self)
+        self.scripts[path] = s
+        return s
+
+    def reload_script(self, path: str) -> None:
+        """vmq-admin script reload path=... (vmq_diversity_cli)."""
+        self.scripts[path].load()
+
+    # ----------------------------------------------------------- hook glue
+
+    def register(self, hooks) -> None:
+        # the cache front-ends the script chain: a cached entry answers
+        # authoritatively, no entry falls through ("next") to the scripts
+        for hook_name, kind in (("auth_on_publish", "publish"),
+                                ("auth_on_publish_m5", "publish"),
+                                ("auth_on_subscribe", "subscribe"),
+                                ("auth_on_subscribe_m5", "subscribe")):
+            fn = self._make_cache_hook(kind, subscribe="subscribe" in hook_name)
+            hooks.register(hook_name, fn, priority=-10)  # before the scripts
+            self._registered.append((hook_name, fn))
+        # cache invalidation: the entry dies with the session's queue so
+        # the cache cannot grow past live subscribers (the reference's
+        # vmq_diversity_cache clears on client-gone)
+        hooks.register("on_client_gone", self._on_client_gone)
+        self._registered.append(("on_client_gone", self._on_client_gone))
+        for script in self.scripts.values():
+            for name in script.hooks:
+                wrapped = self._wrap(script, name)
+                hooks.register(name, wrapped)
+                self._registered.append((name, wrapped))
+
+    def unregister(self, hooks) -> None:
+        for name, fn in self._registered:
+            hooks.unregister(name, fn)
+        self._registered.clear()
+
+    def _make_cache_hook(self, kind: str, subscribe: bool):
+        if not subscribe:
+            def cache_pub(username, sid, qos, topic, payload, retain):
+                res = self.cache.lookup(sid, kind, topic)
+                if res is None:
+                    return "next"
+                allowed, modifiers = res
+                if not allowed:
+                    return ("error", "not_authorized")
+                return ("ok", modifiers) if modifiers else "ok"
+
+            return cache_pub
+
+        def cache_sub(username, sid, topics):
+            if not topics:
+                return "next"
+            res_all = []
+            for words, qos in topics:
+                res = self.cache.lookup(sid, kind, words)
+                if res is None:
+                    return "next"  # no cached ACLs for this client at all
+                allowed, _ = res
+                res_all.append((list(words), qos if allowed else 128))
+            return ("ok", res_all)
+
+        return cache_sub
+
+    def _on_client_gone(self, sid) -> None:
+        self.cache.remove(sid[0], sid[1])
+
+    def _wrap(self, script: Script, name: str):
+        # resolve through script.hooks at call time so reload_script takes
+        # effect without re-registering (hook bodies swap; the set of hooks
+        # a script exports is fixed at enable time)
+        def wrapped(*args):
+            fn = script.hooks.get(name)
+            if fn is None:
+                return "next"
+            try:
+                return fn(*args)
+            except HookError:
+                raise
+            except Exception as e:
+                log.exception("script %s hook %s failed", script.path, name)
+                if name.startswith("auth_") or name == "on_auth_m5":
+                    return ("error", f"script_error: {e}")
+                return None
+
+        wrapped.__name__ = f"{name}@{script.path}"
+        return wrapped
+
+    # -------------------------------------------------------------- ops
+
+    def show(self) -> List[Dict[str, Any]]:
+        return [{"script": p, "hooks": sorted(s.hooks)}
+                for p, s in self.scripts.items()]
+
+    def stats(self) -> Dict[str, int]:
+        return {"scripts": len(self.scripts), "cached_acls": len(self.cache)}
