@@ -183,7 +183,14 @@ class Session:
             self.auth_method = f.properties.get("authentication_method")
 
         # enhanced auth (MQTT5 AUTH exchange, vmq_mqtt5_fsm.erl:330-353)
-        if self.auth_method is not None and self.broker.hooks.has("on_auth_m5"):
+        if self.auth_method is not None:
+            if not self.broker.hooks.has("on_auth_m5"):
+                # a method the broker does not support must be rejected
+                # with 0x8C, not silently ignored (MQTT5 4.12)
+                self.broker.metrics.incr("mqtt_connect_error")
+                self.send(Connack(session_present=False, rc=0x8C))
+                await self.close("bad_authentication_method")
+                return False
             self._pending_connect = f
             res = await self._run_enhanced_auth(f.properties.get("authentication_data"))
             if res == "continue":
@@ -281,9 +288,10 @@ class Session:
             if cfg.max_session_expiry_interval and self.session_expiry != \
                     (self._pending_connect or f).properties.get("session_expiry_interval", 0):
                 props["session_expiry_interval"] = self.session_expiry
-            if self.auth_method is not None:
-                # enhanced auth: CONNACK echoes the method and the final
-                # server auth data (MQTT5 3.2.2.3.17; vmq_mqtt5_fsm AUTH)
+            if self.auth_method is not None and \
+                    getattr(self, "_enhanced_done", False):
+                # enhanced auth RAN: CONNACK echoes the method and the
+                # final server data (MQTT5 3.2.2.3.17; vmq_mqtt5_fsm AUTH)
                 props["authentication_method"] = self.auth_method
                 if getattr(self, "_auth_success_data", None):
                     props["authentication_data"] = self._auth_success_data
@@ -327,6 +335,7 @@ class Session:
                 self.broker.metrics.incr("mqtt_auth_sent")
                 return "continue"
             self._auth_success_data = out_data
+        self._enhanced_done = True
         return "ok"
 
     async def _connack_fail(self, v4_rc: int, v5_rc: int) -> None:

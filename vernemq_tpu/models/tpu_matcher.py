@@ -121,8 +121,14 @@ class TpuMatcher:
             dev_arrays = self._dev_arrays
             snapshot = self._entries_snapshot
             pw, pl, pd = self.encode_batch(topics)
-        chunk = 256 if pw.shape[0] > 256 else 0
-        idx, valid, count = K.match_extract(
+        chunk = 1024 if pw.shape[0] > 1024 else 0  # lax.map serialises; see bench
+        # MXU matmul path needs byte-splittable ids (< 2^24 — never in
+        # practice) and a block-aligned table; else the VPU scan
+        S = dev_arrays[0].shape[0]
+        fast = (len(self.table.interner) < (1 << 24) - K.FIRST_WORD_ID
+                and S % 2048 == 0 and S >= 2048)
+        matcher = K.match_extract_mxu if fast else K.match_extract
+        idx, valid, count = matcher(
             *dev_arrays, pw, pl, pd, k=self.max_fanout, chunk=chunk
         )
         idx = np.asarray(idx)

@@ -58,6 +58,10 @@ class SubscriptionTable:
 
     def __init__(self, max_levels: int = 16, initial_capacity: int = 1024):
         self.L = max_levels
+        if initial_capacity >= 2048:
+            # block-align so the matcher's packed/MXU fast path applies
+            # (it needs S % 2048 == 0)
+            initial_capacity = -(-initial_capacity // 2048) * 2048
         self.cap = initial_capacity
         self.interner = WordInterner()
         self.words = np.zeros((self.cap, self.L), dtype=np.int32)

@@ -123,10 +123,14 @@ def extract_indices(
     targets = jnp.broadcast_to(
         jnp.arange(k, dtype=jnp.int32)[None, :], (B, k)
     )  # j-th match per row
-    # block holding the j-th match: first blk with cum > j
-    blk = jax.vmap(lambda c, t: jnp.searchsorted(c, t, side="right"))(
-        blk_cum, targets
-    ).astype(jnp.int32)  # [B, k]
+    # block holding the j-th match: first blk with cum > j, computed as a
+    # compare-reduce (#blocks with cum <= j) — vmap'd searchsorted costs
+    # B·k dependent binary-search gathers, ~50ms at this shape on TPU;
+    # the dense reduction fuses into one VPU pass
+    blk = jnp.sum(
+        (blk_cum[:, None, :] <= targets[:, :, None]).astype(jnp.int32),
+        axis=2,
+    )  # [B, k]
     blk_c = jnp.minimum(blk, nblk - 1)
     prev_cum = jnp.where(
         blk_c > 0,
@@ -161,6 +165,26 @@ def compact_topk(mask: jax.Array, k: int) -> Tuple[jax.Array, jax.Array, jax.Arr
     return idx.astype(jnp.int32), valid, count
 
 
+def _run_chunked(one, pub_words, pub_len, pub_dollar, chunk: int):
+    """Apply ``one((pw, plen, pd)) -> (idx, valid, count)`` over the publish
+    batch, optionally in ``chunk``-sized pieces via ``lax.map`` to bound the
+    [B, S] working set (B must divide by ``chunk``). lax.map serialises the
+    chunks — only worth it when [B, S] would not fit."""
+    if chunk and pub_words.shape[0] > chunk:
+        B = pub_words.shape[0]
+        n = B // chunk
+        idx, valid, count = lax.map(
+            one,
+            (
+                pub_words.reshape(n, chunk, -1),
+                pub_len.reshape(n, chunk),
+                pub_dollar.reshape(n, chunk),
+            ),
+        )
+        return idx.reshape(B, -1), valid.reshape(B, -1), count.reshape(B)
+    return one((pub_words, pub_len, pub_dollar))
+
+
 @functools.partial(jax.jit, static_argnames=("k", "chunk"))
 def match_extract(
     sub_words: jax.Array,
@@ -178,29 +202,202 @@ def match_extract(
     Same contract as :func:`match_topk` but ~100x faster at S=1M on TPU."""
     S = sub_words.shape[0]
     block = 512 if S % 512 == 0 and S >= 512 else S
-    if chunk and pub_words.shape[0] > chunk:
-        B = pub_words.shape[0]
-        n = B // chunk
 
-        def one(args):
-            pw, pl, pd = args
-            m = match_mask_unrolled(sub_words, sub_eff_len, has_hash,
-                                    first_wild, active, pw, pl, pd)
-            return extract_indices(m, k, block)
+    def one(args):
+        pw, plen, pd = args
+        m = match_mask_unrolled(sub_words, sub_eff_len, has_hash,
+                                first_wild, active, pw, plen, pd)
+        return extract_indices(m, k, block)
 
-        idx, valid, count = lax.map(
-            one,
-            (
-                pub_words.reshape(n, chunk, -1),
-                pub_len.reshape(n, chunk),
-                pub_dollar.reshape(n, chunk),
-            ),
-        )
-        return idx.reshape(B, -1), valid.reshape(B, -1), count.reshape(B)
-    m = match_mask_unrolled(sub_words, sub_eff_len, has_hash, first_wild,
-                            active, pub_words, pub_len, pub_dollar)
-    return extract_indices(m, k, block)
+    return _run_chunked(one, pub_words, pub_len, pub_dollar, chunk)
 
+def _pack_mask(mask: jax.Array) -> jax.Array:
+    """[B, S] bool → [B, S/32] uint32 bit-pack. XLA fuses this into the
+    mask computation, so the bool matrix never reaches HBM — 32x less
+    write traffic than materialising [B, S] bytes."""
+    B, S = mask.shape
+    bits = mask.reshape(B, S // 32, 32).astype(jnp.uint32)
+    weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))
+    return jnp.sum(bits * weights[None, None, :], axis=2, dtype=jnp.uint32)
+
+
+def extract_indices_packed(
+    packed: jax.Array, k: int, block: int = 512,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Sort-free compaction over a bit-packed mask ([B, S/32] uint32).
+
+    Same contract as :func:`extract_indices` but all bookkeeping runs on
+    popcounts of the packed words: per-block counts → cumulative block
+    offsets → locate the block of the j-th match by compare-reduce → rank
+    the bit inside the block's words. The heavy [B, k, block]-bool gather
+    of the unpacked path shrinks to [B, k, block/32] words, and both
+    prefix sums run on the MXU (see inline notes — minor-axis reductions
+    have hostile lane layouts on TPU).
+    """
+    B, W = packed.shape
+    wpb = block // 32  # words per block
+    nblk = W // wpb
+    pc = lax.population_count(packed).astype(jnp.int32)  # [B, W]
+    # cumulative block counts as ONE bf16 matmul against a prefix-indicator
+    # matrix: cum[b, n] = Σ_w pc[b, w]·(w//wpb ≤ n). A reshape+sum over the
+    # small trailing axis costs ~14ms at this shape (bad lane layout); the
+    # MXU does it in ~1ms. Exact: pc ≤ 32 (bf16-exact), sums < 2^24 (fp32
+    # accumulate).
+    word_blk = jnp.arange(W, dtype=jnp.int32) // wpb
+    prefix = (word_blk[:, None] <= jnp.arange(nblk, dtype=jnp.int32)[None, :])
+    blk_cum = lax.dot_general(
+        pc.astype(jnp.bfloat16), prefix.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)  # [B, nblk] inclusive cumulative counts
+    count = blk_cum[:, -1]
+    targets = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :], (B, k))
+    # compare-reduce instead of vmap'd searchsorted (see extract_indices)
+    blk = jnp.sum(
+        (blk_cum[:, None, :] <= targets[:, :, None]).astype(jnp.int32),
+        axis=2,
+    )
+    blk_c = jnp.minimum(blk, nblk - 1)
+    prev_cum = jnp.where(
+        blk_c > 0,
+        jnp.take_along_axis(blk_cum, jnp.maximum(blk_c - 1, 0), axis=1),
+        0,
+    )
+    offset = targets - prev_cum  # rank of the target match in its block
+    words = jnp.take_along_axis(
+        packed.reshape(B, nblk, wpb), blk_c[:, :, None], axis=1
+    )  # [B, k, wpb]
+    wpc = lax.population_count(words).astype(jnp.int32)
+    # inclusive per-word popcount prefix via triangular matmul (same layout
+    # argument as blk_cum; wpc ≤ 32, prefix sums ≤ block — exact)
+    tri = (jnp.arange(wpb, dtype=jnp.int32)[:, None]
+           <= jnp.arange(wpb, dtype=jnp.int32)[None, :])
+    wcum = lax.dot_general(
+        wpc.reshape(B * k, wpb).astype(jnp.bfloat16), tri.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32).reshape(B, k, wpb)
+    widx = jnp.sum((wcum <= offset[:, :, None]).astype(jnp.int32), axis=2)
+    widx_c = jnp.minimum(widx, wpb - 1)
+    prior = jnp.where(
+        widx_c > 0,
+        jnp.squeeze(jnp.take_along_axis(
+            wcum, jnp.maximum(widx_c - 1, 0)[:, :, None], axis=2), 2),
+        0,
+    )
+    bit_rank = offset - prior  # rank of the bit inside its 32-bit word
+    word = jnp.squeeze(
+        jnp.take_along_axis(words, widx_c[:, :, None], axis=2), 2
+    )  # [B, k] uint32
+    # position p of the (bit_rank+1)-th set bit: the unique p with bit p set
+    # and popcount(word & (2^p - 1)) == bit_rank
+    p_range = jnp.arange(32, dtype=jnp.uint32)
+    below = (jnp.uint32(1) << p_range) - jnp.uint32(1)  # [32]
+    cnt_below = lax.population_count(
+        word[:, :, None] & below[None, None, :]
+    ).astype(jnp.int32)  # [B, k, 32]
+    bit_set = ((word[:, :, None] >> p_range[None, None, :]) & 1).astype(jnp.int32)
+    ind = (cnt_below == bit_rank[:, :, None]) & (bit_set == 1)
+    pos_bit = jnp.sum(
+        jnp.arange(32, dtype=jnp.int32)[None, None, :] * ind.astype(jnp.int32),
+        axis=2,
+    )
+    idx = blk_c * block + widx_c * 32 + pos_bit
+    valid = targets < count[:, None]
+    return idx.astype(jnp.int32), valid, count
+
+
+def _mxu_mask(
+    sub_words: jax.Array,   # int32 [S, L]
+    sub_eff_len: jax.Array,
+    has_hash: jax.Array,
+    first_wild: jax.Array,
+    active: jax.Array,
+    pub_words: jax.Array,   # int32 [B, L]
+    pub_len: jax.Array,
+    pub_dollar: jax.Array,
+) -> jax.Array:
+    """Match mask computed on the MXU instead of the VPU.
+
+    A filter matches iff every *concrete* level equals the publish word —
+    i.e. ``Σ_l w_l·(s_l − p_l)² == 0`` with weight ``w_l = 0`` on ``+``
+    levels and beyond ``eff_len``. The squared distance expands into three
+    matmul-shaped terms:
+
+        Σ w·s²  (per-sub scalar)  −2·(w·s)@p  +  w@(p²)
+
+    so the whole [B, S] mismatch matrix is ONE ``[B, 6L]·[6L, S]`` matmul —
+    the systolic array does in a few ms what the elementwise level scan
+    spreads over ~10x the time in VPU traffic. Word ids are split into
+    bytes (three sub-features per level) so every product stays < 2^16 and
+    the fp32 accumulation (precision=HIGHEST — the default truncates
+    operands to bfloat16, which cannot hold p²) is exact: equality of all
+    byte planes ⇔ equality of ids (ids < 2^24). Length/$/active rules are
+    the same cheap elementwise epilogue as the VPU path, fused by XLA into
+    the matmul output."""
+    S, L = sub_words.shape
+    B = pub_words.shape[0]
+    s, p = sub_words, pub_words
+    sb = jnp.stack([s & 255, (s >> 8) & 255, (s >> 16) & 255], axis=2)
+    pb = jnp.stack([p & 255, (p >> 8) & 255, (p >> 16) & 255], axis=2)
+    sbf = sb.reshape(S, 3 * L).astype(jnp.float32)
+    pbf = pb.reshape(B, 3 * L).astype(jnp.float32)
+    lvl = jnp.arange(L, dtype=jnp.int32)
+    w = ((s != PLUS_ID) & (lvl[None, :] < sub_eff_len[:, None]))
+    w3 = jnp.repeat(w, 3, axis=1).astype(jnp.float32)  # [S, 3L] byte layout
+    # every matmul operand is an integer ≤ 256 → EXACT in bfloat16 (8-bit
+    # mantissa), products < 2^17 accumulate exactly in the MXU's fp32 —
+    # so a cheap single-pass bf16 matmul is bit-exact. That needs the
+    # oversized features split: −2·s·p duplicates the (w·s, −p) pair, and
+    # p² (16-bit) splits into (256·w, p²>>8) + (w, p²&255).
+    ws = w3 * sbf                       # ≤ 255
+    p2 = pbf * pbf                      # ≤ 65025 (split below)
+    F = jnp.concatenate([ws, ws, 256.0 * w3, w3], axis=1)      # [S, 12L]
+    G = jnp.concatenate(
+        [-pbf, -pbf, jnp.floor(p2 / 256.0), p2 % 256.0], axis=1)  # [B, 12L]
+    t1 = jnp.sum(ws * sbf, axis=1)      # Σ w·s²  [S]
+    mm = lax.dot_general(
+        G.astype(jnp.bfloat16), F.astype(jnp.bfloat16),
+        (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [B, S]
+    mismatch = mm + t1[None, :]
+    len_ok = jnp.where(
+        has_hash[None, :],
+        pub_len[:, None] >= sub_eff_len[None, :],
+        pub_len[:, None] == sub_eff_len[None, :],
+    )
+    dollar_ok = ~(pub_dollar[:, None] & first_wild[None, :])
+    return (mismatch == 0.0) & len_ok & dollar_ok & active[None, :]
+
+
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def match_extract_mxu(
+    sub_words: jax.Array,
+    sub_eff_len: jax.Array,
+    has_hash: jax.Array,
+    first_wild: jax.Array,
+    active: jax.Array,
+    pub_words: jax.Array,
+    pub_len: jax.Array,
+    pub_dollar: jax.Array,
+    k: int = 256,
+    chunk: int = 0,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """MXU-matmul match + bit-packed extraction — the fast production path
+    (same contract as :func:`match_extract`)."""
+    S = sub_words.shape[0]
+    block = 2048
+    packed_ok = S % block == 0 and S >= block
+
+    def one(args):
+        pw, plen, pd = args
+        m = _mxu_mask(sub_words, sub_eff_len, has_hash, first_wild,
+                      active, pw, plen, pd)
+        if packed_ok:
+            return extract_indices_packed(_pack_mask(m), k, block)
+        return extract_indices(m, k, S if S < 512 else 512)
+    return _run_chunked(one, pub_words, pub_len, pub_dollar, chunk)
 
 @functools.partial(jax.jit, static_argnames=("k", "chunk"))
 def match_topk(
@@ -224,34 +421,14 @@ def match_topk(
     # compact_topk clamps to the table size — do it here too so the chunked
     # reshape below agrees with the per-chunk result width
     k = min(k, sub_words.shape[0])
-    if chunk and pub_words.shape[0] > chunk:
-        B = pub_words.shape[0]
-        n = B // chunk
 
-        def one(args):
-            pw, pl, pd = args
-            m = match_mask(sub_words, sub_eff_len, has_hash, first_wild,
-                           active, pw, pl, pd)
-            return compact_topk(m, k)
+    def one(args):
+        pw, plen, pd = args
+        m = match_mask(sub_words, sub_eff_len, has_hash, first_wild,
+                       active, pw, plen, pd)
+        return compact_topk(m, k)
 
-        idx, valid, count = lax.map(
-            one,
-            (
-                pub_words.reshape(n, chunk, -1),
-                pub_len.reshape(n, chunk),
-                pub_dollar.reshape(n, chunk),
-            ),
-        )
-        return (
-            idx.reshape(B, k),
-            valid.reshape(B, k),
-            count.reshape(B),
-        )
-    mask = match_mask(
-        sub_words, sub_eff_len, has_hash, first_wild, active,
-        pub_words, pub_len, pub_dollar,
-    )
-    return compact_topk(mask, k)
+    return _run_chunked(one, pub_words, pub_len, pub_dollar, chunk)
 
 
 @jax.jit

@@ -171,7 +171,12 @@ class ScriptingPlugin:
                                 ("auth_on_subscribe", "subscribe"),
                                 ("auth_on_subscribe_m5", "subscribe")):
             fn = self._make_cache_hook(kind, subscribe="subscribe" in hook_name)
-            hooks.register(hook_name, fn, priority=-10)  # before the scripts
+            # priority 0 + registration-before-the-scripts: the cache
+            # answers ahead of THIS plugin's script hooks (same-priority
+            # order is insertion order) but does NOT preempt other plugins
+            # enabled earlier — plugin enable order stays the operator's
+            # chain order, as in the reference
+            hooks.register(hook_name, fn)
             self._registered.append((hook_name, fn))
         # cache invalidation: the entry dies with the session's queue so
         # the cache cannot grow past live subscribers (the reference's
