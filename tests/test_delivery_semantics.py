@@ -1,0 +1,293 @@
+"""Delivery-semantics tests closing coverage gaps vs the reference suites:
+in-order delivery across reconnect (vmq_in_order_delivery_SUITE), QoS1
+retry with DUP (vmq_publish_SUITE retry cases), v5 will delay, retain
+handling options (rh/rap, vmq_retain_SUITE), offline queue FIFO/LIFO caps
+(vmq_queue_SUITE), max_message_size, v5 message expiry in the offline
+queue, multiple sessions per ClientId (vmq_multiple_sessions_SUITE), and
+the churney self-test."""
+
+import asyncio
+
+import pytest
+
+from vernemq_tpu.broker.config import Config
+from vernemq_tpu.broker.server import start_broker
+from vernemq_tpu.client import MQTTClient
+from vernemq_tpu.protocol.types import SubOpts, Will
+
+
+async def boot(**cfg):
+    return await start_broker(Config(systree_enabled=False, **cfg),
+                              port=0, node_name="sem-node")
+
+
+async def connected(s, client_id, **kw):
+    c = MQTTClient(s.host, s.port, client_id=client_id, **kw)
+    ack = await c.connect()
+    assert ack.rc == 0
+    return c, ack
+
+
+@pytest.mark.asyncio
+async def test_in_order_delivery_across_reconnect():
+    """Offline backlog must replay in publish order after reconnect
+    (vmq_in_order_delivery_SUITE)."""
+    b, s = await boot()
+    try:
+        sub, _ = await connected(s, "order-sub", clean_start=False,
+                                 proto_ver=5,
+                                 properties={"session_expiry_interval": 300})
+        await sub.subscribe("ord/#", qos=1)
+        await sub.close()  # go offline, session persists
+        pub, _ = await connected(s, "order-pub")
+        for i in range(20):
+            await pub.publish("ord/t", f"m{i:02d}".encode(), qos=1)
+        await pub.close()
+        sub2, ack = await connected(s, "order-sub", clean_start=False,
+                                    proto_ver=5,
+                                    properties={"session_expiry_interval": 300})
+        assert ack.session_present
+        got = []
+        for _ in range(20):
+            m = await sub2.recv(5.0)
+            got.append(m.payload.decode())
+        assert got == [f"m{i:02d}" for i in range(20)]
+        await sub2.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_qos1_retry_sets_dup():
+    """An unacked QoS1 delivery is retransmitted with DUP=1 after
+    retry_interval (vmq_mqtt_fsm retry queue, vmq_mqtt_fsm.erl:1077-1101)."""
+    b, s = await boot(retry_interval=1)
+    try:
+        sub, _ = await connected(s, "retry-sub")
+        sub._auto_ack = False  # swallow the first delivery
+        await sub.subscribe("rt/#", qos=1)
+        pub, _ = await connected(s, "retry-pub")
+        await pub.publish("rt/t", b"again", qos=1)
+        first = await sub.recv(5.0)
+        assert first.dup is False
+        second = await sub.recv(5.0)  # retry after ~1s
+        assert second.payload == b"again"
+        assert second.dup is True
+        assert second.packet_id == first.packet_id
+        await pub.close()
+        await sub.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_v5_will_delay_cancelled_by_reconnect():
+    """A will with will_delay_interval only fires if the client stays gone
+    (vmq_mqtt5_fsm will delay via set_delayed_will)."""
+    b, s = await boot()
+    try:
+        watcher, _ = await connected(s, "will-watch")
+        await watcher.subscribe("wills/#", qos=0)
+        wc = MQTTClient(s.host, s.port, client_id="will-client", proto_ver=5,
+                        clean_start=False,
+                        properties={"session_expiry_interval": 60},
+                        will=Will(topic="wills/w", payload=b"gone",
+                                  properties={"will_delay_interval": 2}))
+        await wc.connect()
+        wc._writer.close()  # abnormal disconnect → delayed will armed
+        # reconnect within the delay window cancels the will
+        await asyncio.sleep(0.3)
+        wc2 = MQTTClient(s.host, s.port, client_id="will-client", proto_ver=5,
+                         clean_start=False,
+                         properties={"session_expiry_interval": 60},
+                         will=Will(topic="wills/w", payload=b"gone",
+                                   properties={"will_delay_interval": 2}))
+        await wc2.connect()
+        with pytest.raises(asyncio.TimeoutError):
+            await watcher.recv(2.5)  # will never fires
+        # now die without reconnecting: will fires after the delay
+        wc2._writer.close()
+        m = await watcher.recv(5.0)
+        assert m.topic == "wills/w" and m.payload == b"gone"
+        await watcher.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_v5_retain_handling_options():
+    """rh=1 sends retained only for NEW subscriptions; rh=2 never; rap
+    preserves the retain flag on routed messages (MQTT5 3.8.3.1)."""
+    b, s = await boot()
+    try:
+        pub, _ = await connected(s, "rh-pub")
+        await pub.publish("rh/t", b"kept", qos=0, retain=True)
+        await asyncio.sleep(0.05)
+        c, _ = await connected(s, "rh-sub", proto_ver=5)
+        # rh=2: no retained delivery at all
+        await c.subscribe("rh/t", opts=SubOpts(qos=0, retain_handling=2))
+        with pytest.raises(asyncio.TimeoutError):
+            await c.recv(0.4)
+        # rh=1 on an EXISTING subscription: still nothing
+        await c.subscribe("rh/t", opts=SubOpts(qos=0, retain_handling=1))
+        with pytest.raises(asyncio.TimeoutError):
+            await c.recv(0.4)
+        # rh=0 delivers the retained message (flagged retained)
+        await c.subscribe("rh/t", opts=SubOpts(qos=0, retain_handling=0))
+        m = await c.recv(5.0)
+        assert m.payload == b"kept" and m.retain
+        # rap: live-routed messages keep their retain flag
+        await c.subscribe("rap/t", opts=SubOpts(qos=0, rap=True))
+        await pub.publish("rap/t", b"live", qos=0, retain=True)
+        m = await c.recv(5.0)
+        assert m.payload == b"live" and m.retain is True
+        # without rap the flag is stripped on live routing
+        await c.subscribe("norap/t", opts=SubOpts(qos=0, rap=False))
+        await pub.publish("norap/t", b"live2", qos=0, retain=True)
+        m = await c.recv(5.0)
+        assert m.payload == b"live2" and m.retain is False
+        await c.close()
+        await pub.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_offline_queue_caps_fifo_and_lifo():
+    """max_offline_messages with FIFO tail-drop vs LIFO oldest-drop
+    (vmq_queue.erl:845-865)."""
+    for qtype, expect in (("fifo", ["m0", "m1", "m2"]),
+                          ("lifo", ["m3", "m4", "m5"])):
+        b, s = await boot(max_offline_messages=3, queue_type=qtype)
+        try:
+            sub, _ = await connected(s, "cap-sub", clean_start=False,
+                                     proto_ver=5,
+                                     properties={"session_expiry_interval": 300})
+            await sub.subscribe("cap/#", qos=1)
+            await sub.close()
+            pub, _ = await connected(s, "cap-pub")
+            for i in range(6):
+                await pub.publish("cap/t", f"m{i}".encode(), qos=1)
+            await pub.close()
+            sub2, _ = await connected(s, "cap-sub", clean_start=False,
+                                      proto_ver=5,
+                                      properties={"session_expiry_interval": 300})
+            got = []
+            for _ in range(3):
+                m = await sub2.recv(5.0)
+                got.append(m.payload.decode())
+            assert got == expect, (qtype, got)
+            with pytest.raises(asyncio.TimeoutError):
+                await sub2.recv(0.3)
+            await sub2.close()
+        finally:
+            await b.stop()
+            await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_max_message_size_closes_connection():
+    b, s = await boot(max_message_size=64)
+    try:
+        c, _ = await connected(s, "big-pub")
+        await c.publish("big/t", b"x" * 200, qos=0)
+        # the reference drops the connection on oversized publishes
+        m = await c.recv(5.0)
+        assert m is None  # EOF
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_v5_message_expiry_in_offline_queue():
+    """A message whose expiry elapses while queued offline is dropped and
+    never delivered (vmq_mqtt5_fsm message expiry + queue expiry checks)."""
+    b, s = await boot()
+    try:
+        sub, _ = await connected(s, "exp-sub", clean_start=False,
+                                 proto_ver=5,
+                                 properties={"session_expiry_interval": 300})
+        await sub.subscribe("exp/#", qos=1)
+        await sub.close()
+        pub, _ = await connected(s, "exp-pub", proto_ver=5)
+        await pub.publish("exp/t", b"short", qos=1,
+                          properties={"message_expiry_interval": 1})
+        await pub.publish("exp/t", b"long", qos=1,
+                          properties={"message_expiry_interval": 300})
+        await pub.close()
+        await asyncio.sleep(1.2)  # the short one expires in the queue
+        sub2, _ = await connected(s, "exp-sub", clean_start=False,
+                                  proto_ver=5,
+                                  properties={"session_expiry_interval": 300})
+        m = await sub2.recv(5.0)
+        assert m.payload == b"long"
+        # remaining expiry interval must have been decremented en route
+        assert m.properties.get("message_expiry_interval", 300) < 300
+        with pytest.raises(asyncio.TimeoutError):
+            await sub2.recv(0.4)
+        await sub2.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_multiple_sessions_balance():
+    """allow_multiple_sessions + balance deliver mode: each message goes
+    to exactly one of the ClientId's sessions
+    (vmq_multiple_sessions_SUITE; vmq_queue.erl:826-835)."""
+    b, s = await boot(allow_multiple_sessions=True,
+                      queue_deliver_mode="balance")
+    try:
+        c1, _ = await connected(s, "multi")
+        await c1.subscribe("bal/#", qos=1)
+        c2, _ = await connected(s, "multi")  # second session, same ClientId
+        await asyncio.sleep(0.1)
+        assert not c1.closed  # no takeover with multiple sessions allowed
+        pub, _ = await connected(s, "bal-pub")
+        for i in range(6):
+            await pub.publish("bal/t", f"m{i}".encode(), qos=1)
+        await asyncio.sleep(0.5)
+        got1, got2 = [], []
+        for q, out in ((c1, got1), (c2, got2)):
+            while True:
+                try:
+                    m = await q.recv(0.3)
+                except asyncio.TimeoutError:
+                    break
+                if m is not None and m.__class__.__name__ == "Publish":
+                    out.append(m.payload.decode())
+        assert sorted(got1 + got2) == [f"m{i}" for i in range(6)]
+        assert got1 and got2  # balanced: both sessions participated
+        for c in (c1, c2, pub):
+            await c.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_churney_self_test():
+    from vernemq_tpu.admin.commands import CommandRegistry, register_core_commands
+
+    b, s = await boot()
+    try:
+        reg = register_core_commands(CommandRegistry())
+        out = reg.run(b, ["churney", "start", f"host={s.host}",
+                          f"port={s.port}"])
+        assert "churney started" in out["text"]
+        await asyncio.sleep(1.0)
+        import json
+
+        report = json.loads(reg.run(b, ["churney", "stop"])["text"])
+        assert report["sessions"] >= 3
+        assert report["outcomes"].get("ok", 0) >= 3
+        assert sum(report["latency_histogram_ms"].values()) == report["sessions"]
+    finally:
+        await b.stop()
+        await s.stop()

@@ -157,6 +157,11 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "[payload-limit=N] [rate-max=N] [rate-interval=Secs]")
     reg.register(["trace", "show"], _trace_show, "vmq-admin trace show")
     reg.register(["trace", "stop"], _trace_stop, "vmq-admin trace stop")
+    reg.register(["churney", "start"], _churney_start,
+                 "vmq-admin churney start [host=H] [port=P] [concurrency=N]")
+    reg.register(["churney", "report"], _churney_report,
+                 "vmq-admin churney report")
+    reg.register(["churney", "stop"], _churney_stop, "vmq-admin churney stop")
     reg.register(["plugin", "enable"], _plugin_enable,
                  "vmq-admin plugin enable name=PluginName [opt=val...]")
     reg.register(["plugin", "disable"], _plugin_disable,
@@ -300,6 +305,43 @@ def _metrics_show(broker, flags):
             row["description"] = broker.metrics.describe(k)
         rows.append(row)
     return {"table": rows}
+
+
+def _churney_start(broker, flags):
+    """Session-churn self-test (vmq_churney.erl)."""
+    if getattr(broker, "churney", None) is not None:
+        raise CommandError("churney already running")
+    from .churney import Churney
+
+    listeners = broker.listeners.show() if broker.listeners else []
+    mqtt = [l for l in listeners if l.get("type") == "mqtt"]
+    host = flags.get("host") or (mqtt[0]["address"] if mqtt else "127.0.0.1")
+    port = int(flags.get("port") or (mqtt[0]["port"] if mqtt else 1883))
+    broker.churney = Churney(broker, host, port,
+                             concurrency=int(flags.get("concurrency", 1)))
+    broker.churney.start()
+    return {"text": f"churney started against {host}:{port}"}
+
+
+def _churney_report(broker, flags):
+    import json
+
+    ch = getattr(broker, "churney", None)
+    if ch is None:
+        raise CommandError("churney not running")
+    return {"text": json.dumps(ch.report(), indent=2)}
+
+
+def _churney_stop(broker, flags):
+    import json
+
+    ch = getattr(broker, "churney", None)
+    if ch is None:
+        raise CommandError("churney not running")
+    report = ch.report()
+    ch.stop()
+    broker.churney = None
+    return {"text": json.dumps(report, indent=2)}
 
 
 def _trace_client(broker, flags):

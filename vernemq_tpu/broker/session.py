@@ -247,8 +247,14 @@ class Session:
                 await self._connack_fail(5, RC_NOT_AUTHORIZED)
                 return False
 
-        # session takeover (vmq_mqtt_fsm check_client_id dup connect)
-        await self.broker.takeover(self.sid, self)
+        # session takeover (vmq_mqtt_fsm check_client_id dup connect) —
+        # unless multiple sessions per ClientId are allowed, in which case
+        # the new session joins the existing queue (vmq_queue multi-session
+        # fanout/balance, vmq_queue.erl:826-835)
+        multi = (cfg.allow_multiple_sessions
+                 and self.broker.registry.get_queue(self.sid) is not None)
+        if not multi:
+            await self.broker.takeover(self.sid, self)
         self.broker.cancel_delayed_will(self.sid)
 
         # register queue
@@ -264,9 +270,20 @@ class Session:
             queue_type=cfg.queue_type,
             session_expiry=self.session_expiry,
         )
+        if multi:
+            # a joining extra session must not clean-start the shared queue
+            # NOR flip it volatile: the queue stays persistent while ANY of
+            # its sessions is persistent (register_subscriber overwrites
+            # existing.opts with what we pass)
+            shared = self.broker.registry.get_queue(self.sid)
+            if shared is not None:
+                qopts.clean_session = (qopts.clean_session
+                                       and shared.opts.clean_session)
+                qopts.session_expiry = max(qopts.session_expiry,
+                                           shared.opts.session_expiry)
         try:
             self.queue, session_present = self.broker.registry.register_subscriber(
-                self.sid, self.clean_start, qopts
+                self.sid, self.clean_start and not multi, qopts
             )
         except RuntimeError:
             # netsplit CAP gate (vmq_reg.erl:65-70): CONNACK server

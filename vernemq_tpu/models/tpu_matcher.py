@@ -125,7 +125,9 @@ class TpuMatcher:
         # MXU matmul path needs byte-splittable ids (< 2^24 — never in
         # practice) and a block-aligned table; else the VPU scan
         S = dev_arrays[0].shape[0]
-        fast = (len(self.table.interner) < (1 << 24) - K.FIRST_WORD_ID
+        # the -1 keeps the top id clear of UNKNOWN_ID's byte planes: -2
+        # splits to (254,255,255), identical to id 2^24-2
+        fast = (len(self.table.interner) < (1 << 24) - K.FIRST_WORD_ID - 1
                 and S % 2048 == 0 and S >= 2048)
         matcher = K.match_extract_mxu if fast else K.match_extract
         idx, valid, count = matcher(

@@ -131,13 +131,16 @@ class SubscriptionTable:
 
     def _grow(self) -> None:
         new_cap = self.cap * 2
+        if new_cap >= 2048:  # keep the matcher's fast-path block alignment
+            new_cap = -(-new_cap // 2048) * 2048
+        grow_by = new_cap - self.cap
         self.words = np.vstack([self.words,
-                                np.zeros((self.cap, self.L), dtype=np.int32)])
-        self.eff_len = np.concatenate([self.eff_len, np.zeros(self.cap, dtype=np.int32)])
-        self.has_hash = np.concatenate([self.has_hash, np.zeros(self.cap, dtype=bool)])
-        self.first_wild = np.concatenate([self.first_wild, np.zeros(self.cap, dtype=bool)])
-        self.active = np.concatenate([self.active, np.zeros(self.cap, dtype=bool)])
-        self.entries.extend([None] * self.cap)
+                                np.zeros((grow_by, self.L), dtype=np.int32)])
+        self.eff_len = np.concatenate([self.eff_len, np.zeros(grow_by, dtype=np.int32)])
+        self.has_hash = np.concatenate([self.has_hash, np.zeros(grow_by, dtype=bool)])
+        self.first_wild = np.concatenate([self.first_wild, np.zeros(grow_by, dtype=bool)])
+        self.active = np.concatenate([self.active, np.zeros(grow_by, dtype=bool)])
+        self.entries.extend([None] * grow_by)
         self._free.extend(range(new_cap - 1, self.cap - 1, -1))
         self.cap = new_cap
         self.resized = True
