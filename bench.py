@@ -25,6 +25,54 @@ import numpy as np
 TARGET_MATCHES_PER_SEC = 10_000_000
 
 
+def note(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def init_backend(retries: int = 2, probe_timeout: float = 120.0,
+                 delay: float = 15.0):
+    """Initialise the JAX backend safely, falling back to CPU.
+
+    Round-1 postmortem (VERDICT.md): bench.py died in jax.devices() with
+    'Unable to initialize backend axon: UNAVAILABLE' — and the failure mode
+    can also be a HANG (a wedged accelerator tunnel blocks backend init
+    indefinitely, and it holds a process-wide lock, so an in-process
+    attempt can never be abandoned). So: probe the accelerator in a
+    SUBPROCESS with a hard timeout; only if the probe succeeds does this
+    process touch the default backend. Otherwise force the CPU platform
+    via jax.config (the env var is ignored by this jax build — see
+    .claude/skills/verify/SKILL.md) and still emit a number.
+    Returns (jax, devices, fallback: bool).
+    """
+    import subprocess
+
+    last = "unknown"
+    for attempt in range(retries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=probe_timeout,
+            )
+            if r.returncode == 0 and r.stdout.strip():
+                note(f"[bench] accelerator probe ok: {r.stdout.strip()}")
+                import jax
+                return jax, jax.devices(), False
+            last = (r.stderr or "").strip().splitlines()[-1:] or ["rc!=0"]
+            last = last[0]
+        except subprocess.TimeoutExpired:
+            last = f"probe hung >{probe_timeout:.0f}s (wedged tunnel?)"
+        note(f"[bench] accelerator probe {attempt + 1}/{retries} failed: "
+             f"{last}")
+        if attempt + 1 < retries:
+            time.sleep(delay)
+    note(f"[bench] giving up on accelerator ({last}); falling back to CPU")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return jax, jax.devices(), True
+
+
 def build_corpus(rng: random.Random, n_subs: int, table):
     """Mixed subscription corpus over a 3-level topic tree (BASELINE
     config 2/3 shape): words chosen so wildcard fanout is realistic."""
@@ -64,11 +112,19 @@ def main() -> int:
     ap.add_argument("--max-fanout", type=int, default=256)
     ap.add_argument("--levels", type=int, default=8)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu) — the JAX_PLATFORMS "
+                         "env var is ignored by this jax build")
     args = ap.parse_args()
 
-    import jax
+    if args.platform:
+        import jax
 
-    platform = jax.devices()[0].platform
+        jax.config.update("jax_platforms", args.platform)
+        devices, fallback = jax.devices(), False
+    else:
+        jax, devices, fallback = init_backend()
+    platform = devices[0].platform
     if platform == "cpu":
         # smoke-scale on CPU so the bench stays runnable anywhere
         args.subs = min(args.subs, 100_000)
@@ -76,9 +132,6 @@ def main() -> int:
 
     from vernemq_tpu.models.tpu_table import SubscriptionTable
     from vernemq_tpu.ops import match_kernel as K
-
-    def note(msg):
-        print(msg, file=sys.stderr, flush=True)
 
     rng = random.Random(args.seed)
     note(f"[bench] platform={platform} subs={args.subs} batch={args.batch}")
@@ -122,32 +175,38 @@ def main() -> int:
     S = arrays[0].shape[0]
     matcher = (K.match_extract_mxu
                if S % 2048 == 0 and S >= 2048 else K.match_extract)
+    import jax.numpy as jnp
+
     for i in range(args.warmup):
         out = matcher(*arrays, *batches[i % len(batches)],
                       k=args.max_fanout, chunk=chunk)
-        np.asarray(out[2])
+        # pre-compile the checksum sum/add used in the timed loop
+        np.asarray(jnp.zeros((), jnp.int32) + out[2].sum())
         note(f"[bench] warmup {i} done")
 
-    # Phase 1 — throughput: submit every batch back-to-back and pull the
-    # count vectors only after the last submit. A per-batch host pull would
-    # measure the dev tunnel's ~65ms RTT, not the device (on a real v5e
-    # host the pull is µs); the end-of-run pull still forces execution of
-    # every batch, so the wall clock below is honest device throughput.
+    # Phase 1 — throughput: submit every batch back-to-back; each batch's
+    # count vector is folded into a device-side scalar checksum, and THAT
+    # scalar is pulled before the clock stops. Syncing a value derived
+    # from every batch is an unconditional barrier — it stays honest even
+    # if a future chunked/sharded matcher splits work across streams
+    # (a last-batch-only sync would not). A per-batch host pull would
+    # measure the dev tunnel's ~65ms RTT, not the device; on a real v5e
+    # host the single end-of-run pull is µs.
     total_pubs = args.batch * args.iters
-    import jax.numpy as jnp
 
-    outs = []
+    counts = []
+    acc = jnp.zeros((), jnp.int32)  # may wrap: it is only a barrier value
     t_start = time.perf_counter()
     for i in range(args.iters):
         b = batches[i % len(batches)]
-        outs.append(matcher(*arrays, *b, k=args.max_fanout, chunk=chunk))
-    # barrier: the device queue executes in submission order, so syncing
-    # the LAST batch proves all 50 ran; per-batch pulls would pay the
-    # tunnel RTT ~65ms each and the stack pull compiles — both untimed
-    np.asarray(outs[-1][2])
+        out = matcher(*arrays, *b, k=args.max_fanout, chunk=chunk)
+        counts.append(out[2])
+        acc = acc + out[2].sum()
+    np.asarray(acc)  # barrier: a value derived from every batch
     elapsed = time.perf_counter() - t_start
-    counts = np.asarray(jnp.stack([o[2] for o in outs]))
-    total_matches = int(counts.sum())
+    # true total pulled after the clock stops, summed in int64 host-side
+    # (the int32 device checksum above may overflow on long runs)
+    total_matches = int(sum(np.asarray(c).sum(dtype=np.int64) for c in counts))
 
     # Phase 2 — latency: synced round-trips (includes tunnel RTT here;
     # reported as-is so regressions in per-batch compute stay visible)
@@ -165,6 +224,7 @@ def main() -> int:
         "unit": "matches/s",
         "vs_baseline": round(matches_per_sec / TARGET_MATCHES_PER_SEC, 4),
         "platform": platform,
+        "platform_fallback": fallback,
         "subs": args.subs,
         "batch": args.batch,
         "publishes_per_sec": round(total_pubs / elapsed),
@@ -180,4 +240,15 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except Exception as e:  # never a stack trace on stdout: one JSON line
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "topic-matches/sec @1M subs (config 3)",
+            "value": 0, "unit": "matches/s", "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}",
+        }))
+        sys.exit(1)
