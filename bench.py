@@ -115,6 +115,11 @@ def main() -> int:
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu) — the JAX_PLATFORMS "
                          "env var is ignored by this jax build")
+    ap.add_argument("--matcher", default="auto",
+                    choices=("auto", "bucketed", "mxu", "vpu"),
+                    help="device match path: bucketed (level-0 bucket "
+                         "narrowing, production default), mxu (full-scan "
+                         "matmul), vpu (full-scan elementwise)")
     args = ap.parse_args()
 
     if args.platform:
@@ -150,15 +155,39 @@ def main() -> int:
     jax.block_until_ready(arrays)
     upload_s = time.perf_counter() - t0
 
+    # pick the device path the way TpuMatcher.match_batch does
+    S = arrays[0].shape[0]
+    bits = table.id_bits
+    mode = args.matcher
+    if mode == "auto":
+        mode = ("bucketed" if table.bucketed and bits else
+                "mxu" if bits and S % 2048 == 0 and S >= 2048 else "vpu")
+    elif mode == "bucketed" and not (table.bucketed and bits):
+        note("[bench] table too small/wide for the bucketed layout; "
+             "downgrading to vpu")
+        mode = "vpu"
+    note(f"[bench] matcher={mode} S={S} NB={table.NB} id_bits={bits}")
+
+    operands = None
+    if mode == "bucketed":
+        t0 = time.perf_counter()
+        operands = K.build_operands(arrays[0], arrays[1], bits)
+        jax.block_until_ready(operands)
+        note(f"[bench] operands built in {time.perf_counter() - t0:.1f}s")
+        reg_start = table.reg_start.copy()
+        reg_end = (table.reg_start + table.reg_cap).copy()
+        glob_pad = int(table.reg_cap[0])
+
     def encode(topics):
         B, L = len(topics), table.L
         pw = np.full((B, L), K.PAD_ID, dtype=np.int32)
         pl = np.zeros(B, dtype=np.int32)
         pd = np.zeros(B, dtype=bool)
+        pb = np.zeros(B, dtype=np.int32)
         for i, t in enumerate(topics):
-            row, n, dollar = table.encode_topic(t)
-            pw[i], pl[i], pd[i] = row, n, dollar
-        return put(pw), put(pl), put(pd)
+            row, n, dollar, bucket = table.encode_topic_ex(t)
+            pw[i], pl[i], pd[i], pb[i] = row, n, dollar, bucket
+        return pw, pl, pd, pb
 
     # chunking bounds the [B,S] working set but serialises via lax.map
     # (measured ~4x slower at B=1024) — only chunk past 1024
@@ -167,41 +196,58 @@ def main() -> int:
                for _ in range(min(args.iters, 8))]
     note(f"[bench] upload {upload_s:.1f}s; batches encoded; compiling...")
 
+    from vernemq_tpu.models.tpu_matcher import prepare_tiles
+
+    def submit(batch):
+        """One production step: host prep (sort/cut/pad — real per-batch
+        work, stays inside the wall clock, via the SAME prepare_tiles the
+        broker's matcher uses) + ONE device dispatch. Returns device
+        count arrays."""
+        pw, pl, pd, pb = batch
+        if mode != "bucketed":
+            matcher = K.match_extract_mxu if mode == "mxu" else K.match_extract
+            out = matcher(*arrays, put(pw), put(pl), put(pd),
+                          k=args.max_fanout, chunk=chunk)
+            return out[2]
+        n = pw.shape[0]
+        (t_pw, t_pl, t_pd, t_start, t_lo, t_len, _tile_of, _pos_of,
+         seg_max) = prepare_tiles(pw, pl, pd, pb, n, reg_start, reg_end,
+                                  glob_pad, S)
+        _g1, _g2, gcount, _t1, _t2, tcount = K.match_extract_bucketed(
+            *operands, arrays[1], arrays[2], arrays[3], arrays[4],
+            put(pw), put(pl), put(pd), put(t_pw), put(t_pl), put(t_pd),
+            put(t_start), put(t_lo), put(t_len),
+            id_bits=bits, k=args.max_fanout, glob_pad=glob_pad,
+            seg_max=seg_max)
+        return gcount.sum() + tcount.sum()
+
     # warmup / compile; np.asarray forces a REAL device sync (on the axon
     # tunnel block_until_ready returns early — only a host transfer is an
     # honest barrier)
-    # production path selection mirrors TpuMatcher.match_batch: the MXU
-    # matmul matcher when the table shape allows it
-    S = arrays[0].shape[0]
-    matcher = (K.match_extract_mxu
-               if S % 2048 == 0 and S >= 2048 else K.match_extract)
     import jax.numpy as jnp
 
     for i in range(args.warmup):
-        out = matcher(*arrays, *batches[i % len(batches)],
-                      k=args.max_fanout, chunk=chunk)
+        out = submit(batches[i % len(batches)])
         # pre-compile the checksum sum/add used in the timed loop
-        np.asarray(jnp.zeros((), jnp.int32) + out[2].sum())
+        np.asarray(jnp.zeros((), jnp.int32) + out.sum())
         note(f"[bench] warmup {i} done")
 
     # Phase 1 — throughput: submit every batch back-to-back; each batch's
-    # count vector is folded into a device-side scalar checksum, and THAT
-    # scalar is pulled before the clock stops. Syncing a value derived
-    # from every batch is an unconditional barrier — it stays honest even
-    # if a future chunked/sharded matcher splits work across streams
-    # (a last-batch-only sync would not). A per-batch host pull would
-    # measure the dev tunnel's ~65ms RTT, not the device; on a real v5e
-    # host the single end-of-run pull is µs.
+    # count is folded into a device-side scalar checksum, and THAT scalar
+    # is pulled before the clock stops. Syncing a value derived from every
+    # batch is an unconditional barrier — it stays honest even if a future
+    # path splits work across streams (a last-batch-only sync would not).
+    # A per-batch host pull would measure the dev tunnel's ~65ms RTT, not
+    # the device; on a real v5e host the single end-of-run pull is µs.
     total_pubs = args.batch * args.iters
 
     counts = []
     acc = jnp.zeros((), jnp.int32)  # may wrap: it is only a barrier value
     t_start = time.perf_counter()
     for i in range(args.iters):
-        b = batches[i % len(batches)]
-        out = matcher(*arrays, *b, k=args.max_fanout, chunk=chunk)
-        counts.append(out[2])
-        acc = acc + out[2].sum()
+        out = submit(batches[i % len(batches)])
+        counts.append(out)
+        acc = acc + out.sum()
     np.asarray(acc)  # barrier: a value derived from every batch
     elapsed = time.perf_counter() - t_start
     # true total pulled after the clock stops, summed in int64 host-side
@@ -212,9 +258,8 @@ def main() -> int:
     # reported as-is so regressions in per-batch compute stay visible)
     lat = []
     for i in range(min(8, args.iters)):
-        b = batches[i % len(batches)]
         t1 = time.perf_counter()
-        np.asarray(matcher(*arrays, *b, k=args.max_fanout, chunk=chunk)[2])
+        np.asarray(submit(batches[i % len(batches)]).sum())
         lat.append(time.perf_counter() - t1)
 
     matches_per_sec = total_matches / elapsed
@@ -225,6 +270,7 @@ def main() -> int:
         "vs_baseline": round(matches_per_sec / TARGET_MATCHES_PER_SEC, 4),
         "platform": platform,
         "platform_fallback": fallback,
+        "matcher": mode,
         "subs": args.subs,
         "batch": args.batch,
         "publishes_per_sec": round(total_pubs / elapsed),

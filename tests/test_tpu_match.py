@@ -149,3 +149,142 @@ async def test_broker_e2e_with_tpu_reg_view(event_loop):
     finally:
         await b.stop()
         await server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Bucketed path (level-0 bucket narrowing — models/tpu_table.py regions +
+# ops/match_kernel.match_extract_bucketed). A big initial capacity forces
+# NB > 1 so these run the tiled device path, not the full scan.
+# ---------------------------------------------------------------------------
+
+def _bucketed_matcher(**kw):
+    m = TpuMatcher(max_levels=8, initial_capacity=16384, **kw)
+    assert m.table.bucketed and m.table.NB > 1
+    return m
+
+
+def corpus_filter(rng):
+    """Bucket-realistic corpus: concrete level-0 words dominate, with
+    wildcard-first and $-rooted filters mixed in."""
+    w = [f"r{rng.randrange(16)}", f"d{rng.randrange(40)}", f"m{rng.randrange(16)}"]
+    r = rng.random()
+    if r < 0.5:
+        return w
+    if r < 0.65:
+        return [w[0], "+", w[2]]
+    if r < 0.75:
+        return ["+", w[1], w[2]]
+    if r < 0.85:
+        return [w[0], w[1], "#"]
+    if r < 0.90:
+        return [w[0], "+", "#"]
+    if r < 0.95:
+        return ["$SYS", w[1], w[2]]
+    return ["#"]
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_bucketed_parity_with_churn(seed):
+    """Random corpus through add/remove churn + growth rebuilds: the tiled
+    bucketed matcher agrees with the trie oracle on every topic (incl.
+    $-topics, unknown words, >L topics and truncation fallbacks)."""
+    rng = random.Random(seed)
+    m = _bucketed_matcher(max_fanout=256)
+    trie = SubscriptionTrie()
+    subs = []
+    for i in range(12000):
+        f = corpus_filter(rng)
+        m.table.add(f, i, None)
+        trie.add(list(f), i, None)
+        subs.append(f)
+    for i in rng.sample(range(12000), 3000):
+        m.table.remove(subs[i], i)
+        trie.remove(list(subs[i]), i)
+    topics = [(f"r{rng.randrange(16)}", f"d{rng.randrange(40)}",
+               f"m{rng.randrange(16)}") for _ in range(200)]
+    topics += [("$SYS", "d1", "m2"), ("unseen", "d0"), ("r1",),
+               ("r1", "d1", "m1", "deep", "deeper")]
+    for topic, rows in zip(topics, m.match_batch(topics)):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+    # delta-scatter path (no rebuild): mutate after the first sync
+    for i in range(12000, 12400):
+        f = corpus_filter(rng)
+        m.table.add(f, i, None)
+        trie.add(list(f), i, None)
+    assert not m.table.resized  # stays on the scatter path
+    for topic, rows in zip(topics[:50], m.match_batch(topics[:50])):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_bucketed_rebuild_preserves_entries():
+    """Region overflow triggers a repartition; every entry survives with a
+    (possibly) new slot and matching still agrees with the oracle."""
+    rng = random.Random(3)
+    m = _bucketed_matcher()
+    trie = SubscriptionTrie()
+    cap_before = m.table.cap
+    n = 0
+    while m.table.cap == cap_before:  # insert until a rebuild fires
+        f = corpus_filter(rng)
+        m.table.add(f, n, None)
+        trie.add(list(f), n, None)
+        n += 1
+        assert n < 10_000_000
+    assert m.table.count == n
+    topics = [(f"r{i % 16}", f"d{i % 40}", f"m{i % 16}") for i in range(64)]
+    for topic, rows in zip(topics, m.match_batch(topics)):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_cut_tiles_invariants():
+    """Greedy tile cutting: every sorted pub lands in exactly one tile, the
+    tile's row window covers its pubs' buckets, and spans obey seg_max."""
+    import numpy as np
+
+    from vernemq_tpu.models.tpu_matcher import _cut_tiles
+
+    rng = random.Random(5)
+    NB = 16
+    reg_cap = np.array([2048] + [256 * rng.randint(1, 8) for _ in range(NB)],
+                       dtype=np.int64)
+    reg_start = np.concatenate([[0], np.cumsum(reg_cap)[:-1]])
+    reg_end = reg_start + reg_cap
+    S = int(reg_cap.sum())
+    seg_max = 4096
+    assert int(reg_cap[1:].max()) <= seg_max
+    pb = np.sort(np.array([rng.randint(1, NB) for _ in range(500)]))
+    tiles = _cut_tiles(pb, reg_start, reg_end, seg_max, S, tile_pubs=128)
+    covered = 0
+    for (plo, phi, start, lo, ln) in tiles:
+        assert phi - plo <= 128
+        assert ln <= seg_max and lo + ln <= seg_max
+        assert 0 <= start <= S - seg_max
+        for p in range(plo, phi):
+            b = pb[p]
+            assert start + lo <= reg_start[b]
+            assert reg_end[b] <= start + lo + ln
+        covered += phi - plo
+    assert covered == len(pb)
+
+
+def test_bucketed_id_bits_crossover():
+    """Interner growth past the 16-bit plane limit rebuilds operands on the
+    24-bit path and matching stays exact."""
+    from vernemq_tpu.models import tpu_table as TT
+
+    old16 = TT.MAX_IDS_16
+    TT.MAX_IDS_16 = 500  # force the crossover without 65k interns
+    try:
+        rng = random.Random(9)
+        m = _bucketed_matcher()
+        trie = SubscriptionTrie()
+        for i in range(2000):  # ~interns 2000 distinct level-2 words
+            f = [f"r{i % 8}", "x", f"unique{i}"]
+            m.table.add(f, i, None)
+            trie.add(list(f), i, None)
+        assert m.table.id_bits == 24
+        topics = [(f"r{i % 8}", "x", f"unique{i}") for i in range(0, 2000, 37)]
+        for topic, rows in zip(topics, m.match_batch(topics)):
+            assert norm(rows) == norm(trie.match(list(topic))), topic
+    finally:
+        TT.MAX_IDS_16 = old16

@@ -31,6 +31,85 @@ from .tpu_table import SubscriptionTable
 
 Row = Tuple[Tuple[str, ...], Hashable, Any]
 
+_TILE_PUBS = 128  # pubs per bucket tile (MXU sublane-friendly)
+
+
+def _pow2ceil(n: int) -> int:
+    return 1 << max(n - 1, 1).bit_length()
+
+
+def _cut_tiles(sb: np.ndarray, reg_start: np.ndarray, reg_end: np.ndarray,
+               seg_max: int, S: int, tile_pubs: int = _TILE_PUBS):
+    """Greedy cut of bucket-sorted publishes into tiles whose spanned
+    bucket regions fit one contiguous ``seg_max`` row window.
+
+    ``sb`` is the bucket id per sorted publish. Returns a list of
+    ``(pub_lo, pub_hi, start, lo, ln)``: pubs [pub_lo, pub_hi) match table
+    rows [start+lo, start+lo+ln); ``start`` is the (clamped) slice start
+    actually sent to the device. Requires seg_max ≥ every bucket's region
+    size (the caller sizes seg_max so) — each tile then holds ≥ 1 pub.
+    """
+    tiles = []
+    n = len(sb)
+    i = 0
+    while i < n:
+        b0 = int(sb[i])
+        seg_lo = int(reg_start[b0])
+        hi = int(reg_end[b0])
+        j = i + 1
+        while j < n and j - i < tile_pubs:
+            b = int(sb[j])
+            new_hi = int(reg_end[b])  # sb sorted ⇒ monotone
+            if new_hi - seg_lo > seg_max:
+                break
+            hi = new_hi
+            j += 1
+        start = min(seg_lo, S - seg_max)
+        tiles.append((i, j, start, seg_lo - start, hi - seg_lo))
+        i = j
+    return tiles
+
+
+def prepare_tiles(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
+                  pb: np.ndarray, n: int, reg_start: np.ndarray,
+                  reg_end: np.ndarray, glob_pad: int, S: int):
+    """Host prep for the bucketed device call, shared by TpuMatcher and
+    bench.py (so the bench measures the production path by construction).
+
+    Sizes the segment window (≥ every bucket region — cut_tiles' invariant
+    — and ~2x the per-tile fair share, pow2-quantised to bound recompiles),
+    sorts the n real publishes by bucket, cuts tiles, and packs the padded
+    tile arrays. Returns ``(t_pw, t_pl, t_pd, t_start, t_lo, t_len,
+    tile_of, pos_of, seg_max)`` where tile_of/pos_of map each original pub
+    index to its tile slot.
+    """
+    L = pw.shape[1]
+    bucket_max = (int((reg_end[1:] - reg_start[1:]).max())
+                  if len(reg_start) > 1 else 0)
+    fair = (S - glob_pad) * _TILE_PUBS * 2 // max(n, _TILE_PUBS)
+    seg_max = min(_pow2ceil(max(4096, bucket_max, fair)), S)
+    order = np.argsort(pb[:n], kind="stable")
+    tiles = _cut_tiles(pb[:n][order], reg_start, reg_end, seg_max, S)
+    Tpad = -(-max(len(tiles), 1) // 4) * 4
+    t_pw = np.full((Tpad, _TILE_PUBS, L), np.int32(K.PAD_ID), dtype=np.int32)
+    t_pl = np.zeros((Tpad, _TILE_PUBS), dtype=np.int32)
+    t_pd = np.zeros((Tpad, _TILE_PUBS), dtype=bool)
+    t_start = np.zeros(Tpad, dtype=np.int32)
+    t_lo = np.zeros(Tpad, dtype=np.int32)
+    t_len = np.zeros(Tpad, dtype=np.int32)
+    tile_of = np.zeros(n, dtype=np.int32)
+    pos_of = np.zeros(n, dtype=np.int32)
+    for ti, (plo, phi, start, lo, ln) in enumerate(tiles):
+        sel = order[plo:phi]
+        m = len(sel)
+        t_pw[ti, :m] = pw[sel]
+        t_pl[ti, :m] = pl[sel]
+        t_pd[ti, :m] = pd[sel]
+        t_start[ti], t_lo[ti], t_len[ti] = start, lo, ln
+        tile_of[sel] = ti
+        pos_of[sel] = np.arange(m)
+    return t_pw, t_pl, t_pd, t_start, t_lo, t_len, tile_of, pos_of, seg_max
+
 
 class TpuMatcher:
     def __init__(self, max_levels: int = 16, initial_capacity: int = 1024,
@@ -44,7 +123,12 @@ class TpuMatcher:
         self.max_fanout = max_fanout
         self.device = device or jax.devices()[0]
         self._dev_arrays: Optional[Tuple] = None
-        self._entries_snapshot: List[Optional[Row]] = []
+        self._operands: Optional[Tuple] = None  # (F_t, t1) coded MXU operands
+        self._ops_bits = 0
+        self._reg_start: Optional[np.ndarray] = None
+        self._reg_end: Optional[np.ndarray] = None
+        self._glob_pad = 0
+        self._bucketed = False
         self.match_batches = 0
         self.match_publishes = 0
         # guards table mutation (event loop) vs sync/match (executor thread)
@@ -60,12 +144,26 @@ class TpuMatcher:
         mid-call must not misroute to the new subscriber). Callers hold
         ``self.lock``."""
         t = self.table
-        if self._dev_arrays is None or t.resized:
+        bits = t.id_bits
+        if self._dev_arrays is None or t.resized or bits != self._ops_bits:
             put = lambda a: self._jax.device_put(a, self.device)
             self._dev_arrays = (
                 put(t.words), put(t.eff_len), put(t.has_hash),
                 put(t.first_wild), put(t.active),
             )
+            # derived coded operands (F/t1) live device-side next to the
+            # base arrays; id_bits growth (interner crossing a byte plane)
+            # forces this full rebuild path too
+            self._operands = (
+                K.build_operands(self._dev_arrays[0], self._dev_arrays[1],
+                                 bits)
+                if bits else None
+            )
+            self._ops_bits = bits
+            self._reg_start = t.reg_start.copy()
+            self._reg_end = (t.reg_start + t.reg_cap).copy()
+            self._glob_pad = int(t.reg_cap[0])
+            self._bucketed = t.bucketed
             t.resized = False
             t.dirty.clear()
             self._entries_snapshot = list(t.entries)
@@ -82,15 +180,18 @@ class TpuMatcher:
             snap[s] = t.entries[s]
         self._entries_snapshot = snap
         sw, el, hh, fw, ac = self._dev_arrays
+        slots_dev = self._jax.device_put(slots, self.device)
+        w_dev = self._jax.device_put(t.words[slots], self.device)
+        e_dev = self._jax.device_put(t.eff_len[slots], self.device)
         self._dev_arrays = K.apply_delta(
-            sw, el, hh, fw, ac,
-            self._jax.device_put(slots, self.device),
-            self._jax.device_put(t.words[slots], self.device),
-            self._jax.device_put(t.eff_len[slots], self.device),
+            sw, el, hh, fw, ac, slots_dev, w_dev, e_dev,
             self._jax.device_put(t.has_hash[slots], self.device),
             self._jax.device_put(t.first_wild[slots], self.device),
             self._jax.device_put(t.active[slots], self.device),
         )
+        if self._operands is not None:
+            self._operands = K.apply_delta_operands(
+                *self._operands, slots_dev, w_dev, e_dev, self._ops_bits)
 
     # ---------------------------------------------------------------- match
 
@@ -111,6 +212,19 @@ class TpuMatcher:
             pw[i], pl[i], pd[i] = row, n, dollar
         return pw, pl, pd
 
+    def _encode_batch_ex(self, topics: Sequence[Sequence[str]]):
+        """encode_batch + per-real-topic bucket ids (for the tiled path)."""
+        B = self._pad_batch(len(topics))
+        L = self.table.L
+        pw = np.full((B, L), K.PAD_ID, dtype=np.int32)
+        pl = np.zeros(B, dtype=np.int32)
+        pd = np.zeros(B, dtype=bool)
+        pb = np.zeros(len(topics), dtype=np.int32)
+        for i, t in enumerate(topics):
+            row, n, dollar, bucket = self.table.encode_topic_ex(t)
+            pw[i], pl[i], pd[i], pb[i] = row, n, dollar, bucket
+        return pw, pl, pd, pb
+
     def match_batch(self, topics: Sequence[Sequence[str]]) -> List[List[Row]]:
         """Match a batch of publish topics; returns per-topic entry rows
         (the per-publish fold results)."""
@@ -119,31 +233,43 @@ class TpuMatcher:
         with self.lock:
             self.sync()
             dev_arrays = self._dev_arrays
+            operands = self._operands
             snapshot = self._entries_snapshot
-            pw, pl, pd = self.encode_batch(topics)
-        chunk = 1024 if pw.shape[0] > 1024 else 0  # lax.map serialises; see bench
-        # MXU matmul path needs byte-splittable ids (< 2^24 — never in
-        # practice) and a block-aligned table; else the VPU scan
-        S = dev_arrays[0].shape[0]
-        # the -1 keeps the top id clear of UNKNOWN_ID's byte planes: -2
-        # splits to (254,255,255), identical to id 2^24-2
-        fast = (len(self.table.interner) < (1 << 24) - K.FIRST_WORD_ID - 1
-                and S % 2048 == 0 and S >= 2048)
-        matcher = K.match_extract_mxu if fast else K.match_extract
-        idx, valid, count = matcher(
-            *dev_arrays, pw, pl, pd, k=self.max_fanout, chunk=chunk
-        )
-        idx = np.asarray(idx)
-        valid = np.asarray(valid)
-        count = np.asarray(count)
+            bucketed = self._bucketed and operands is not None
+            if bucketed:
+                reg_start, reg_end = self._reg_start, self._reg_end
+                glob_pad, bits = self._glob_pad, self._ops_bits
+                pw, pl, pd, pb = self._encode_batch_ex(topics)
+            else:
+                pw, pl, pd = self.encode_batch(topics)
         self.match_batches += 1
         self.match_publishes += len(topics)
+        if bucketed:
+            idx_rows, counts = self._match_bucketed(
+                dev_arrays, operands, reg_start, reg_end, glob_pad, bits,
+                pw, pl, pd, pb, len(topics))
+        else:
+            chunk = 1024 if pw.shape[0] > 1024 else 0  # lax.map serialises
+            # full-scan fallback: MXU matmul path needs byte-splittable ids
+            # and a block-aligned table; else the VPU scan. The -1 keeps the
+            # top id clear of UNKNOWN_ID's byte planes (-2 → 254,255,255)
+            S = dev_arrays[0].shape[0]
+            fast = (len(self.table.interner) < (1 << 24) - K.FIRST_WORD_ID - 1
+                    and S % 2048 == 0 and S >= 2048)
+            matcher = K.match_extract_mxu if fast else K.match_extract
+            idx, valid, count = matcher(
+                *dev_arrays, pw, pl, pd, k=self.max_fanout, chunk=chunk
+            )
+            idx = np.asarray(idx)
+            valid = np.asarray(valid)
+            counts = np.asarray(count)
+            idx_rows = [idx[i][valid[i]] for i in range(len(topics))]
         out: List[List[Row]] = []
         for i, topic in enumerate(topics):
             rows = [
-                e for e in (snapshot[s] for s in idx[i][valid[i]]) if e is not None
+                e for e in (snapshot[s] for s in idx_rows[i]) if e is not None
             ]
-            if count[i] > self.max_fanout:
+            if counts[i] > self.max_fanout:
                 # truncated fanout: fall back to exact host matching for this
                 # topic so no subscriber is silently skipped
                 rows = self._host_match(topic, snapshot)
@@ -156,6 +282,38 @@ class TpuMatcher:
                         rows = rows + self.table.overflow.match(list(topic))
             out.append(rows)
         return out
+
+    def _match_bucketed(self, dev_arrays, operands, reg_start, reg_end,
+                        glob_pad, bits, pw, pl, pd, pb, n):
+        """Run the bucketed device path; returns (per-pub slot index lists,
+        per-pub total counts) in original batch order."""
+        S = int(dev_arrays[0].shape[0])
+        k = self.max_fanout
+        (t_pw, t_pl, t_pd, t_start, t_lo, t_len, tile_of, pos_of,
+         seg_max) = prepare_tiles(pw, pl, pd, pb, n, reg_start, reg_end,
+                                  glob_pad, S)
+        F_t, t1 = operands
+        gidx, gvalid, gcount, tidx, tvalid, tcount = K.match_extract_bucketed(
+            F_t, t1, dev_arrays[1], dev_arrays[2], dev_arrays[3],
+            dev_arrays[4], pw, pl, pd, t_pw, t_pl, t_pd, t_start, t_lo,
+            t_len, id_bits=bits, k=k, glob_pad=glob_pad, seg_max=seg_max)
+        gidx = np.asarray(gidx)
+        gvalid = np.asarray(gvalid)
+        gcount = np.asarray(gcount)
+        tidx = np.asarray(tidx)
+        tvalid = np.asarray(tvalid)
+        tcount = np.asarray(tcount)
+        idx_rows, counts = [], np.zeros(n, dtype=np.int64)
+        for i in range(n):
+            ti, j = tile_of[i], pos_of[i]
+            idx_rows.append(np.concatenate(
+                [gidx[i][gvalid[i]], tidx[ti, j][tvalid[ti, j]]]))
+            # per-part truncation: if either part clipped at k, report a
+            # count > max_fanout so the caller takes the exact host path
+            counts[i] = (int(gcount[i]) + int(tcount[ti, j])
+                         if gcount[i] <= k and tcount[ti, j] <= k
+                         else self.max_fanout + 1)
+        return idx_rows, counts
 
     def _host_match(self, topic: Sequence[str], snapshot=None) -> List[Row]:
         from ..protocol.topic import match_dollar_aware

@@ -24,6 +24,39 @@ HASH_ID = 2
 FIRST_WORD_ID = 3
 UNKNOWN_ID = -2  # publish words never seen in any subscription
 
+# id width for the coded MXU operands (ops/match_kernel.build_operands):
+# 16-bit while every interned id's byte planes stay clear of UNKNOWN_ID's
+# (-2 → planes 254,255); beyond that, 24-bit; beyond THAT, the VPU scan.
+MAX_IDS_16 = (1 << 16) - FIRST_WORD_ID - 2
+MAX_IDS_24 = (1 << 24) - FIRST_WORD_ID - 2
+
+REGION_ALIGN = 256    # bucket regions start/size-align to this (lane tiles)
+GLOBAL_ALIGN = 2048   # global region + total capacity align (packed extract)
+
+
+_M64 = (1 << 64) - 1
+
+
+def _splitmix32(x: int) -> int:
+    """Deterministic 32-bit mix (splitmix64's finalizer, truncated) — maps
+    interned word ids to buckets without correlating with intern order."""
+    z = ((x & 0xFFFFFFFF) + 0x9E3779B97F4A7C15) & _M64
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & _M64
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & _M64
+    return (z ^ (z >> 31)) & 0xFFFFFFFF
+
+
+def _nb_for(total_hint: int) -> int:
+    """Bucket count for a table sized ``total_hint`` (1 = flat layout)."""
+    if total_hint < 8192:
+        return 1
+    return min(256, max(1, total_hint // 2048))
+
+
+def _bucket_for(word0_id: int, nb: int) -> int:
+    """Region (1-based) for a level-0 word id under ``nb`` buckets."""
+    return _splitmix32(word0_id & 0xFFFFFFFF) % nb + 1
+
 
 class WordInterner:
     def __init__(self) -> None:
@@ -49,36 +82,166 @@ class WordInterner:
 
 
 class SubscriptionTable:
-    """Flat subscription store: numpy mirrors + slot bookkeeping.
+    """Bucket-partitioned subscription store: numpy mirrors + slot keeping.
 
     Rows hold interned level ids; the per-slot payload (key, opts) stays
     host-side — the kernel returns slot indices, the host maps them back,
     mirroring the fold returning subscriber rows (vmq_reg_trie.erl:60-85).
+
+    Slots are allocated inside per-bucket REGIONS so the device arrays are
+    bucket-sorted at all times: region 0 holds wildcard-first filters
+    (``+``/``#`` at level 0 — the only ones a publish can match regardless
+    of its first word), regions 1..NB hold filters hashed by their level-0
+    word. This is the trie's first-edge narrowing
+    (``vmq_reg_trie.erl:358-371``) recast as a dense layout: the bucketed
+    matcher reads each region ~once per batch instead of B times. A region
+    filling up triggers a full repartition (amortized doubling, like the
+    old flat growth) and a full device re-upload (``resized``).
     """
 
     def __init__(self, max_levels: int = 16, initial_capacity: int = 1024):
         self.L = max_levels
-        if initial_capacity >= 2048:
-            # block-align so the matcher's packed/MXU fast path applies
-            # (it needs S % 2048 == 0)
-            initial_capacity = -(-initial_capacity // 2048) * 2048
-        self.cap = initial_capacity
         self.interner = WordInterner()
-        self.words = np.zeros((self.cap, self.L), dtype=np.int32)
-        self.eff_len = np.zeros(self.cap, dtype=np.int32)
-        self.has_hash = np.zeros(self.cap, dtype=bool)
-        self.first_wild = np.zeros(self.cap, dtype=bool)
-        self.active = np.zeros(self.cap, dtype=bool)
-        self.entries: List[Optional[Tuple[Tuple[str, ...], Hashable, Any]]] = [None] * self.cap
-        self._free: List[int] = list(range(self.cap - 1, -1, -1))
         self._slot_of: Dict[Tuple[Tuple[str, ...], Hashable], int] = {}
         self.dirty: set = set()
         self.resized = True  # force first full upload
         # filters longer than L levels: host-trie overflow (kept tiny)
         self.overflow = SubscriptionTrie()
         self.count = 0
+        self.entries: List[Optional[Tuple[Tuple[str, ...], Hashable, Any]]] = []
+        self._alloc_regions(max(initial_capacity, 16))
+
+    # ----------------------------------------------------------- region mgmt
+
+    @property
+    def bucketed(self) -> bool:
+        """Whether the layout satisfies the bucketed matcher's alignment
+        contract (glob region % 2048, bucket regions % 256)."""
+        return self.NB > 1
+
+    @property
+    def id_bits(self) -> int:
+        """Byte-plane width for the coded MXU operands (0 = too many ids,
+        callers must use the VPU scan path)."""
+        n = len(self.interner)
+        if n <= MAX_IDS_16:
+            return 16
+        if n <= MAX_IDS_24:
+            return 24
+        return 0
+
+    def _alloc_regions(self, total_hint: int,
+                       need: Optional[List[int]] = None) -> None:
+        """(Re)build the region layout sized for ``total_hint`` rows with
+        per-region needs ``need`` (entry counts to re-home). Sets up empty
+        arrays + free lists; the caller re-inserts entries."""
+        big = total_hint >= 8192
+        self.NB = _nb_for(total_hint)
+        self._bucket_cache: Dict[int, int] = {}
+        align = REGION_ALIGN if big else 8
+        nreg = self.NB + 1
+        if need is None:
+            need = [0] * nreg
+        # headroom: double each region's need, floor-split any spare hint
+        spare = max(total_hint - 2 * sum(need), 0) // nreg
+        caps = [max(2 * n + spare, align) for n in need]
+        caps = [-(-c // align) * align for c in caps]
+        if big:
+            g = max(caps[0], GLOBAL_ALIGN)
+            caps[0] = 1 << (g - 1).bit_length()  # pow2: bounds recompiles
+            total = sum(caps)
+            pad = -total % GLOBAL_ALIGN
+            caps[-1] += pad
+        elif sum(caps) >= 2048:
+            caps[-1] += -sum(caps) % 2048
+        self.reg_cap = np.asarray(caps, dtype=np.int64)
+        self.reg_start = np.concatenate(
+            [[0], np.cumsum(self.reg_cap)[:-1]]).astype(np.int64)
+        self.cap = int(self.reg_cap.sum())
+        self.words = np.zeros((self.cap, self.L), dtype=np.int32)
+        self.eff_len = np.zeros(self.cap, dtype=np.int32)
+        self.has_hash = np.zeros(self.cap, dtype=bool)
+        self.first_wild = np.zeros(self.cap, dtype=bool)
+        self.active = np.zeros(self.cap, dtype=bool)
+        self.entries = [None] * self.cap
+        self._free = [
+            list(range(int(s + c) - 1, int(s) - 1, -1))
+            for s, c in zip(self.reg_start, self.reg_cap)
+        ]
+        self.resized = True
+        self.dirty.clear()
+
+    def _bucket_of_id(self, word0_id: int) -> int:
+        b = self._bucket_cache.get(word0_id)
+        if b is None:
+            b = _bucket_for(word0_id, self.NB)
+            self._bucket_cache[word0_id] = b
+        return b
+
+    def _region_of_filter(self, fw: Tuple[str, ...]) -> int:
+        if not fw or fw[0] in (PLUS, HASH):
+            return 0
+        if self.NB == 1:
+            return 1
+        return self._bucket_of_id(self.interner.intern(fw[0]))
+
+    def pub_bucket(self, word0_id: int) -> int:
+        """Bucket region a publish topic's level-0 word falls in (mirrors
+        the subscription-side mapping, including UNKNOWN_ID)."""
+        if self.NB == 1:
+            return 1
+        return self._bucket_of_id(word0_id)
+
+    def _rebuild(self) -> None:
+        """Repartition all regions (doubling total), re-homing every entry.
+        Slot numbers change wholesale; ``resized`` forces the full upload
+        and consumers re-snapshot under the matcher lock."""
+        old_entries = [e for e in self.entries if e is not None]
+        # recompute per-region need under the NEW bucket count: NB depends
+        # on total, so pick NB first from the doubled hint, then count
+        total_hint = max(2 * max(self.count, 1), self.cap)
+        nb = _nb_for(total_hint)
+        cache: Dict[int, int] = {}
+        need = [0] * (nb + 1)
+        for fw, _k, _v in old_entries:
+            if not fw or fw[0] in (PLUS, HASH):
+                need[0] += 1
+            elif nb == 1:
+                need[1] += 1
+            else:
+                wid = self.interner.intern(fw[0])
+                b = cache.get(wid)
+                if b is None:
+                    b = _bucket_for(wid, nb)
+                    cache[wid] = b
+                need[b] += 1
+        self._alloc_regions(total_hint, need)
+        assert self.NB == nb
+        self._slot_of.clear()
+        for fw, key, value in old_entries:
+            self._insert(fw, key, value)
 
     # ------------------------------------------------------------- mutation
+
+    def _insert(self, fw: Tuple[str, ...], key: Hashable, value: Any) -> None:
+        region = self._region_of_filter(fw)
+        if not self._free[region]:
+            self._rebuild()
+            region = self._region_of_filter(fw)  # NB may have changed
+        slot = self._free[region].pop()
+        hh = bool(fw) and fw[-1] == HASH
+        concrete = fw[:-1] if hh else fw
+        row = np.full(self.L, PAD_ID, dtype=np.int32)
+        for i, w in enumerate(concrete):
+            row[i] = PLUS_ID if w == PLUS else self.interner.intern(w)
+        self.words[slot] = row
+        self.eff_len[slot] = len(concrete)
+        self.has_hash[slot] = hh
+        self.first_wild[slot] = bool(fw) and fw[0] in (PLUS, HASH)
+        self.active[slot] = True
+        self.entries[slot] = (fw, key, value)
+        self._slot_of[(fw, key)] = slot
+        self.dirty.add(slot)
 
     def add(self, filter_words: Sequence[str], key: Hashable, value: Any = None) -> None:
         fw = tuple(filter_words)
@@ -94,22 +257,7 @@ class SubscriptionTable:
             self.entries[existing] = (fw, key, value)
             self.dirty.add(existing)
             return
-        if not self._free:
-            self._grow()
-        slot = self._free.pop()
-        hh = bool(fw) and fw[-1] == HASH
-        concrete = fw[:-1] if hh else fw
-        row = np.full(self.L, PAD_ID, dtype=np.int32)
-        for i, w in enumerate(concrete):
-            row[i] = PLUS_ID if w == PLUS else self.interner.intern(w)
-        self.words[slot] = row
-        self.eff_len[slot] = len(concrete)
-        self.has_hash[slot] = hh
-        self.first_wild[slot] = bool(fw) and fw[0] in (PLUS, HASH)
-        self.active[slot] = True
-        self.entries[slot] = (fw, key, value)
-        self._slot_of[(fw, key)] = slot
-        self.dirty.add(slot)
+        self._insert(fw, key, value)
         self.count += 1
 
     def remove(self, filter_words: Sequence[str], key: Hashable) -> bool:
@@ -124,26 +272,11 @@ class SubscriptionTable:
             return False
         self.active[slot] = False
         self.entries[slot] = None
-        self._free.append(slot)
+        region = int(np.searchsorted(self.reg_start, slot, side="right")) - 1
+        self._free[region].append(slot)
         self.dirty.add(slot)
         self.count -= 1
         return True
-
-    def _grow(self) -> None:
-        new_cap = self.cap * 2
-        if new_cap >= 2048:  # keep the matcher's fast-path block alignment
-            new_cap = -(-new_cap // 2048) * 2048
-        grow_by = new_cap - self.cap
-        self.words = np.vstack([self.words,
-                                np.zeros((grow_by, self.L), dtype=np.int32)])
-        self.eff_len = np.concatenate([self.eff_len, np.zeros(grow_by, dtype=np.int32)])
-        self.has_hash = np.concatenate([self.has_hash, np.zeros(grow_by, dtype=bool)])
-        self.first_wild = np.concatenate([self.first_wild, np.zeros(grow_by, dtype=bool)])
-        self.active = np.concatenate([self.active, np.zeros(grow_by, dtype=bool)])
-        self.entries.extend([None] * grow_by)
-        self._free.extend(range(new_cap - 1, self.cap - 1, -1))
-        self.cap = new_cap
-        self.resized = True
 
     # ---------------------------------------------------------- publish side
 
@@ -155,6 +288,12 @@ class SubscriptionTable:
         for i in range(n):
             row[i] = self.interner.lookup(topic[i])
         return row, len(topic), bool(topic) and topic[0].startswith("$")
+
+    def encode_topic_ex(self, topic: Sequence[str]):
+        """encode_topic + the bucket region this topic's matches live in
+        (wildcard-first matches live in region 0, checked for every pub)."""
+        row, n, dollar = self.encode_topic(topic)
+        return row, n, dollar, self.pub_bucket(int(row[0]) if n else UNKNOWN_ID)
 
     def resolve(self, slots: Sequence[int]):
         """Matched slot indices → (filter, key, value) rows."""

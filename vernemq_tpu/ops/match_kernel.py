@@ -307,6 +307,83 @@ def extract_indices_packed(
     return idx.astype(jnp.int32), valid, count
 
 
+@functools.partial(jax.jit, static_argnames=("id_bits",))
+def build_operands(
+    sub_words: jax.Array,  # int32 [S, L]
+    sub_eff_len: jax.Array,  # int32 [S]
+    id_bits: int = 16,
+) -> Tuple[jax.Array, jax.Array]:
+    """Precompute the MXU match operands for a subscription table.
+
+    A filter matches a publish iff every concrete level's word id equals
+    the publish word id. With ids split into ``id_bits/8`` byte planes,
+    ``mismatch = Σ_l w_l Σ_d (s_{l,d} − p_{l,d})² == 0`` is that equality
+    (w_l = 0 on ``+`` levels and beyond eff_len). The quadratic expands so
+    the whole [B, S] mismatch matrix is ONE matmul plus a per-sub scalar:
+
+        mismatch = G(pub) @ F(sub)ᵀ + t1(sub)
+
+    with F/G chosen so every bf16 operand is exact (representable as
+    n·2^e, n < 256) and every product < 2^17 (fp32 accumulation exact):
+
+      16-bit ids (K = 5L):  F = [2wc₀, 2wc₁, 65536w, 256w, w]
+      24-bit ids (K = 6L):  F = [2wc₀, 2wc₁, 2wc₂, 65536w, 256w, w]
+      both:                 G = [−p₀, (−p₁, −p₂,) q»16, (q»8)&255, q&255]
+    where q = Σ_d p_d² < 2^18, so its base-256 planes are ≤ 2, ≤ 255,
+    ≤ 255 — every one bf16-exact (a single »8 split would leave odd
+    values > 256 in the top plane, which bf16 cannot represent).
+
+    This replaces the 12L byte-split layout of the original matcher: the
+    MXU pads the contraction dim to 128 either way, but F is the term the
+    matmul streams from HBM every batch — 4L halves that traffic vs 6L
+    and is 3x less than 12L. F is returned TRANSPOSED [K, S]: the minor
+    dimension must be the long one or TPU lane padding would inflate
+    [S, K<128] storage ~4x.
+
+    Returns ``(F_t bf16 [K, S], t1 f32 [S])``.
+    """
+    S, L = sub_words.shape
+    lvl = jnp.arange(L, dtype=jnp.int32)
+    w = ((sub_words != PLUS_ID) & (lvl[None, :] < sub_eff_len[:, None]))
+    wf = w.astype(jnp.float32)
+    s = sub_words
+    if id_bits == 16:
+        planes = [(s & 255), ((s >> 8) & 255)]
+    else:
+        planes = [(s & 255), ((s >> 8) & 255), ((s >> 16) & 255)]
+    splits = [65536.0, 256.0, 1.0]
+    pf = [c.astype(jnp.float32) for c in planes]
+    parts = [2.0 * wf * c for c in pf] + [m * wf for m in splits]
+    F = jnp.concatenate(parts, axis=1)  # [S, K]
+    t1 = sum(jnp.sum(wf * c * c, axis=1) for c in pf)  # Σ w·s² [S]
+    return F.T.astype(jnp.bfloat16), t1
+
+
+def build_pub_operand(pub_words: jax.Array, id_bits: int = 16) -> jax.Array:
+    """G [B, K] bf16 for a publish batch (see :func:`build_operands`)."""
+    p = pub_words
+    if id_bits == 16:
+        planes = [(p & 255), ((p >> 8) & 255)]
+    else:
+        planes = [(p & 255), ((p >> 8) & 255), ((p >> 16) & 255)]
+    pf = [c.astype(jnp.float32) for c in planes]
+    q = sum(c * c for c in planes)  # int32: < 2^18
+    qparts = [(q >> 16).astype(jnp.float32),
+              ((q >> 8) & 255).astype(jnp.float32),
+              (q & 255).astype(jnp.float32)]
+    G = jnp.concatenate([-c for c in pf] + qparts, axis=1)
+    return G.astype(jnp.bfloat16)
+
+
+def coded_mismatch(F_t: jax.Array, t1: jax.Array, G: jax.Array) -> jax.Array:
+    """[B, S] f32 mismatch: 0 exactly where all concrete levels match."""
+    mm = lax.dot_general(
+        G, F_t, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return mm + t1[None, :]
+
+
 def _mxu_mask(
     sub_words: jax.Array,   # int32 [S, L]
     sub_eff_len: jax.Array,
@@ -398,6 +475,109 @@ def match_extract_mxu(
             return extract_indices_packed(_pack_mask(m), k, block)
         return extract_indices(m, k, S if S < 512 else 512)
     return _run_chunked(one, pub_words, pub_len, pub_dollar, chunk)
+
+def _epilogue(pub_len, pub_dollar, eff, hh, fw, act) -> jax.Array:
+    """Length / $-rule / liveness mask [B, Sseg] (vmq_topic.erl:53-66 +
+    vmq_reg_trie.erl:283-288), applied on top of the mismatch==0 test."""
+    len_ok = jnp.where(
+        hh[None, :],
+        pub_len[:, None] >= eff[None, :],
+        pub_len[:, None] == eff[None, :],
+    )
+    return len_ok & ~(pub_dollar[:, None] & fw[None, :]) & act[None, :]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("id_bits", "k", "glob_pad", "seg_max"))
+def match_extract_bucketed(
+    F_t: jax.Array,          # bf16 [K, S] coded operands (build_operands)
+    t1: jax.Array,           # f32 [S]
+    sub_eff_len: jax.Array,  # int32 [S]
+    has_hash: jax.Array,     # bool [S]
+    first_wild: jax.Array,   # bool [S]
+    active: jax.Array,       # bool [S]
+    pub_words: jax.Array,    # int32 [B, L]  original batch order
+    pub_len: jax.Array,      # int32 [B]
+    pub_dollar: jax.Array,   # bool [B]
+    t_pw: jax.Array,         # int32 [T, TP, L]  bucket-sorted pub tiles
+    t_pl: jax.Array,         # int32 [T, TP]
+    t_pd: jax.Array,         # bool [T, TP]
+    t_start: jax.Array,      # int32 [T] clamped slice start into S
+    t_lo: jax.Array,         # int32 [T] local offset of the tile's rows
+    t_len: jax.Array,        # int32 [T] live row count from t_lo
+    *,
+    id_bits: int,
+    k: int,
+    glob_pad: int,           # global (wildcard-first) region width, %2048
+    seg_max: int,            # padded bucket-segment width, %2048
+) -> Tuple[jax.Array, ...]:
+    """The bucketed production match path (single device call).
+
+    Two phases against a bucket-partitioned table (models/tpu_table.py):
+
+    1. GLOBAL: every publish × region 0 (wildcard-first filters — the only
+       rows whose match doesn't pin the publish's level-0 word).
+    2. BUCKETS: publishes sorted by their level-0 bucket and cut into
+       tiles of TP whose spanned bucket regions form one contiguous row
+       range ≤ seg_max; each tile matmuls only against its own segment
+       slice. Every table row is thus read ~once per batch instead of
+       B/TP times — the dense-layout equivalent of the trie's first-edge
+       narrowing (vmq_reg_trie.erl:358-371), worth ~#buckets in FLOPs.
+
+    Returns ``(gidx, gvalid, gcount, tidx, tvalid, tcount)``; tile
+    indices are global slot ids (segment offset already added). Exact —
+    no false positives: the coded matmul is bit-exact (build_operands).
+    """
+    Kdim = F_t.shape[0]
+
+    G = build_pub_operand(pub_words, id_bits)
+    mmg = lax.dot_general(
+        G, F_t[:, :glob_pad], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) + t1[None, :glob_pad]
+    maskg = (mmg == 0.0) & _epilogue(
+        pub_len, pub_dollar, sub_eff_len[:glob_pad], has_hash[:glob_pad],
+        first_wild[:glob_pad], active[:glob_pad])
+    gidx, gvalid, gcount = extract_indices_packed(_pack_mask(maskg), k, 2048)
+
+    def one(args):
+        tpw, tpl, tpd, start, lo, ln = args
+        Gt = build_pub_operand(tpw, id_bits)
+        Fseg = lax.dynamic_slice(F_t, (0, start), (Kdim, seg_max))
+        t1s = lax.dynamic_slice(t1, (start,), (seg_max,))
+        effs = lax.dynamic_slice(sub_eff_len, (start,), (seg_max,))
+        hhs = lax.dynamic_slice(has_hash, (start,), (seg_max,))
+        fws = lax.dynamic_slice(first_wild, (start,), (seg_max,))
+        acts = lax.dynamic_slice(active, (start,), (seg_max,))
+        mm = lax.dot_general(
+            Gt, Fseg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + t1s[None, :]
+        j = jnp.arange(seg_max, dtype=jnp.int32)
+        rowok = (j >= lo) & (j < lo + ln)
+        mask = (mm == 0.0) & _epilogue(tpl, tpd, effs, hhs, fws, acts) \
+            & rowok[None, :]
+        idx, valid, cnt = extract_indices_packed(_pack_mask(mask), k, 2048)
+        return idx + start, valid, cnt
+
+    tidx, tvalid, tcount = lax.map(
+        one, (t_pw, t_pl, t_pd, t_start, t_lo, t_len))
+    return gidx, gvalid, gcount, tidx, tvalid, tcount
+
+
+@functools.partial(jax.jit, static_argnames=("id_bits",))
+def apply_delta_operands(
+    F_t: jax.Array, t1: jax.Array,
+    slots: jax.Array,     # int32 [D]
+    d_words: jax.Array,   # int32 [D, L]
+    d_eff_len: jax.Array,  # int32 [D]
+    id_bits: int = 16,
+):
+    """Scatter-update the coded operand columns for dirty table slots
+    (companion to :func:`apply_delta` for the derived F/t1 arrays)."""
+    F_d, t1_d = build_operands(d_words, d_eff_len, id_bits)
+    return F_t.at[:, slots].set(F_d), t1.at[slots].set(t1_d)
+
 
 @functools.partial(jax.jit, static_argnames=("k", "chunk"))
 def match_topk(
