@@ -331,3 +331,144 @@ async def test_cluster_leave():
         assert a.cluster.is_ready()
     finally:
         await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_graceful_leave_migrates_offline_queues():
+    """`vmq-admin cluster leave` on the leaving node: offline queues are
+    rewritten to live peers and their backlogs drain over acked enq
+    batches (vmq_reg:migrate_offline_queues, vmq_reg.erl:433-477)."""
+    nodes = await make_cluster(3)
+    try:
+        a, b, c = nodes
+        # two persistent subscribers homed on node0, then taken offline
+        sids = []
+        for name in ("ml1", "ml2"):
+            cl = await connected(a, name, clean_start=False)
+            await cl.subscribe(f"leave/{name}/#", qos=1)
+            await cl.disconnect()
+            sids.append(("", name))
+        pub = await connected(b, "leave-pub")
+        for name in ("ml1", "ml2"):
+            for i in range(4):
+                await pub.publish(f"leave/{name}/{i}", b"m%d" % i, qos=1)
+        await wait_until(lambda: all(
+            (q := a.broker.registry.queues.get(sid)) is not None
+            and len(q.offline) == 4 for sid in sids))
+
+        moved = await a.cluster.leave_gracefully()
+        assert moved == 2
+        # node0 out of the membership everywhere
+        await wait_until(lambda: all(
+            n.cluster.members() == ["node1", "node2"] for n in (b, c)))
+        # queues live on the targets with the full backlog, node0 is empty
+        def drained():
+            for sid in sids:
+                rec = b.broker.registry.db.read(sid)
+                if rec is None or rec.node == "node0":
+                    return False
+                owner = b if rec.node == "node1" else c
+                q = owner.broker.registry.queues.get(sid)
+                if q is None or len(q.offline) != 4:
+                    return False
+            return not a.broker.registry.queues and not a.broker.migrations
+        await wait_until(drained)
+        # both targets used (round-robin)
+        owners = {b.broker.registry.db.read(sid).node for sid in sids}
+        assert owners == {"node1", "node2"}
+        # clients reconnect at the new owner and receive the backlog
+        rec = b.broker.registry.db.read(("", "ml1"))
+        owner = b if rec.node == "node1" else c
+        cl = await connected(owner, "ml1", clean_start=False)
+        assert cl.connack.session_present is True
+        got = sorted([(await cl.recv()).payload for _ in range(4)])
+        assert got == [b"m0", b"m1", b"m2", b"m3"]
+        await cl.disconnect()
+        await pub.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_fix_dead_queues_repairs_routing():
+    """A node dies without leaving: fix-dead-queues rewrites its persistent
+    subscribers to live nodes (fresh queues there; routing repaired) and
+    drops its clean-session records (vmq_reg:fix_dead_queues,
+    vmq_reg.erl:479-520)."""
+    nodes = await make_cluster(3)
+    try:
+        a, b, c = nodes
+        # persistent subscriber + clean-session subscriber homed on node2
+        cp = await connected(c, "dead-p", clean_start=False)
+        await cp.subscribe("dead/#", qos=1)
+        ccs = await connected(c, "dead-cs", clean_start=True)
+        await ccs.subscribe("dead/cs", qos=1)
+        # replicate records, then kill node2 without leave
+        await wait_until(lambda: all(
+            n.broker.registry.db.read(("", "dead-p")) is not None
+            for n in (a, b)))
+        await c.cluster.stop()
+        await c.broker.stop()
+        await c.server.stop()
+        await wait_until(lambda: not a.cluster.is_ready())
+
+        fixed = a.cluster.fix_dead_queues()
+        assert fixed == 2
+        # operator also removes the dead member so the cluster is ready
+        # again (registration stays CAP-gated while a member is down)
+        a.cluster.leave("node2")
+        await wait_until(lambda: a.cluster.is_ready() and b.cluster.is_ready())
+        rec = a.broker.registry.db.read(("", "dead-p"))
+        assert rec is not None and rec.node in ("node0", "node1")
+        assert a.broker.registry.db.read(("", "dead-cs")) is None
+        # the new owner built an offline queue; publishes land in it
+        owner = a if rec.node == "node0" else b
+        await wait_until(
+            lambda: ("", "dead-p") in owner.broker.registry.queues)
+        pub = await connected(a, "dead-pub")
+        await pub.publish("dead/x", b"repaired", qos=1)
+        await wait_until(lambda: len(
+            owner.broker.registry.queues[("", "dead-p")].offline) == 1)
+        # subscriber reconnects at the new owner and gets the message
+        cl = await connected(owner, "dead-p", clean_start=False)
+        assert cl.connack.session_present is True
+        assert (await cl.recv()).payload == b"repaired"
+        await cl.disconnect()
+        await pub.disconnect()
+    finally:
+        await stop_cluster(nodes[:2])
+
+
+@pytest.mark.asyncio
+async def test_drain_retry_is_bounded_and_surfaced():
+    """A migration whose target never acks retries a bounded number of
+    times, surfaces state via broker.migrations, and restores the backlog
+    locally (VERDICT: no unbounded fire-and-forget drain loops)."""
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        a.broker.config.set("migrate_drain_retries", 2)
+        cl = await connected(a, "stuck", clean_start=False)
+        await cl.subscribe("stuck/#", qos=1)
+        await cl.disconnect()
+        pub = await connected(a, "stuck-pub")
+        await pub.publish("stuck/1", b"x", qos=1)
+        await pub.disconnect()
+        sid = ("", "stuck")
+        await wait_until(lambda: (
+            (q := a.broker.registry.queues.get(sid)) is not None
+            and len(q.offline) == 1))
+        # sever the channel a->b so enq acks never arrive, then remap the
+        # record to node1 (as a reconnect there would)
+        partition(a, b)
+        rec = a.broker.registry.db.read(sid)
+        rec.node = "node1"
+        a.broker.registry.db.store(sid, rec)
+        await wait_until(
+            lambda: a.broker.migrations.get(sid, {}).get("state") == "failed",
+            timeout=30.0)
+        q = a.broker.registry.queues.get(sid)
+        assert q is not None and len(q.offline) == 1  # backlog restored
+        assert a.broker.metrics.value("queue_drain_failed") >= 1
+    finally:
+        await stop_cluster(nodes)

@@ -139,6 +139,10 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin cluster join discovery-node=HOST:PORT")
     reg.register(["cluster", "leave"], _cluster_leave,
                  "vmq-admin cluster leave node=NodeName")
+    reg.register(["cluster", "fix-dead-queues"], _cluster_fix_dead_queues,
+                 "vmq-admin cluster fix-dead-queues [targets=n1,n2]")
+    reg.register(["cluster", "migrations"], _cluster_migrations,
+                 "vmq-admin cluster migrations")
     reg.register(["session", "show"], _session_show,
                  "vmq-admin session show [--limit=N] [client_id=X] "
                  "[--<field>...]")
@@ -219,13 +223,58 @@ def _cluster_join(broker, flags):
 
 
 def _cluster_leave(broker, flags):
+    import asyncio
+
     if broker.cluster is None:
         raise CommandError("clustering is not enabled on this node")
     node = flags.get("node")
     if not isinstance(node, str):
         raise CommandError("node=NodeName required")
+    if node == broker.node_name:
+        # graceful leave: migrate every locally-homed offline queue to the
+        # live peers, then flip membership (vmq_reg:migrate_offline_queues
+        # behind `vmq-admin cluster leave`, vmq_reg.erl:433-477). Strong
+        # reference via _bg_tasks (the loop holds tasks weakly) + an
+        # error-surfacing callback: the command returns before the
+        # migration finishes.
+        task = asyncio.get_event_loop().create_task(
+            broker.cluster.leave_gracefully())
+        broker._bg_tasks.append(task)
+
+        def _done(t):
+            if not t.cancelled() and t.exception() is not None:
+                import logging
+
+                logging.getLogger("vernemq_tpu.cluster").error(
+                    "graceful leave failed: %s", t.exception())
+
+        task.add_done_callback(_done)
+        return (f"node {node} leaving: offline queues migrating to live "
+                f"peers — progress via `vmq-admin cluster migrations`")
     broker.cluster.leave(node)
-    return f"node {node} left the cluster"
+    return (f"node {node} removed from the cluster (if it died without "
+            f"leaving, run `vmq-admin cluster fix-dead-queues`)")
+
+
+def _cluster_fix_dead_queues(broker, flags):
+    if broker.cluster is None:
+        raise CommandError("clustering is not enabled on this node")
+    targets = flags.get("targets")
+    if isinstance(targets, str):
+        targets = [t for t in targets.split(",") if t]
+    try:
+        fixed = broker.cluster.fix_dead_queues(targets)
+    except RuntimeError as e:
+        raise CommandError(str(e)) from None
+    return f"fixed {fixed} dead subscriber records"
+
+
+def _cluster_migrations(broker, flags):
+    rows = [{"subscriber": f"{sid[0]}/{sid[1]}", "target": m["target"],
+             "pending": m["pending"], "retries": m["retries"],
+             "state": m["state"]}
+            for sid, m in sorted(broker.migrations.items())]
+    return {"table": rows}
 
 
 _SESSION_FIELDS = ("client_id", "mountpoint", "user", "peer_host", "peer_port",

@@ -81,6 +81,10 @@ class Broker:
         # live sessions: sid -> Session (the reference reaches sessions via
         # queue pids; a direct map is equivalent single-node)
         self.sessions: Dict[SubscriberId, Any] = {}
+        # live queue-migration state, surfaced via `vmq-admin cluster
+        # migrations` (the reference surfaces drain progress via queue
+        # status / cluster show): sid -> {target, pending, retries, state}
+        self.migrations: Dict[SubscriberId, Dict[str, Any]] = {}
         self._delayed_wills: Dict[SubscriberId, asyncio.Task] = {}
         self.tracer: Optional[Any] = None  # single active session tracer
         self.sysmon: Optional[Any] = None
@@ -153,6 +157,12 @@ class Broker:
         queue = self.registry.queues.get(sid)
         if queue is None:
             return
+        # register the migration BEFORE the task first runs: callers (the
+        # graceful-leave wait loop) poll this map right after the record
+        # rewrite, and a not-yet-scheduled task must already count
+        self.migrations[sid] = {"target": new_node,
+                                "pending": len(queue.offline),
+                                "retries": 0, "state": "draining"}
         task = asyncio.get_event_loop().create_task(
             self._migrate_queue(sid, queue, new_node))
         self._bg_tasks.append(task)
@@ -163,6 +173,10 @@ class Broker:
             await session.takeover_close()
         backlog = queue.start_drain()
         step = self.config.max_msgs_per_drain_step
+        max_retries = self.config.get("migrate_drain_retries", 60)
+        state = self.migrations.setdefault(
+            sid, {"target": new_node, "retries": 0, "state": "draining"})
+        state["pending"] = len(backlog)
         while True:
             sent = 0
             ok = self.cluster is not None
@@ -176,20 +190,39 @@ class Broker:
                     if not ok:
                         break
                     sent = i + step
+                    state["pending"] = len(backlog) - sent
             if ok:
                 self.delete_offline(sid)
                 self.metrics.incr("queue_migrated")
                 # clean_session stays False: queue_terminated must NOT delete
                 # the subscriber record — the new owner just rewrote it
                 queue.terminate("migrated")
+                self.migrations.pop(sid, None)
                 return
             # drain failed mid-way: keep the unsent tail (an unacked chunk
             # may have landed — at-least-once, like any QoS1 redelivery) and
             # retry while the record still points away (block_until_migrated
-            # retry loop, vmq_reg.erl:225-244)
+            # retry loop, vmq_reg.erl:225-244) — bounded: a peer that never
+            # acks must not pin a drain task forever
             backlog = backlog[sent:]
-            log.warning("queue drain %s -> %s failed, %d msgs pending retry",
-                        sid, new_node, len(backlog))
+            state["pending"] = len(backlog)
+            state["retries"] += 1
+            self.metrics.incr("queue_drain_retry")
+            log.warning("queue drain %s -> %s failed, %d msgs pending "
+                        "(retry %d/%d)", sid, new_node, len(backlog),
+                        state["retries"], max_retries)
+            if state["retries"] >= max_retries:
+                from .queue import OFFLINE
+
+                queue.offline.extend(backlog)
+                queue.state = OFFLINE
+                queue._arm_expiry()  # start_drain cancelled the clock
+                state["state"] = "failed"
+                self.metrics.incr("queue_drain_failed")
+                log.error("queue drain %s -> %s abandoned after %d retries; "
+                          "%d msgs restored to the local offline queue",
+                          sid, new_node, max_retries, len(backlog))
+                return
             await asyncio.sleep(1.0)
             rec = self.registry.db.read(sid)
             if rec is None or rec.node == self.node_name:
@@ -198,6 +231,8 @@ class Broker:
 
                 queue.offline.extend(backlog)
                 queue.state = OFFLINE
+                queue._arm_expiry()  # start_drain cancelled the clock
+                self.migrations.pop(sid, None)
                 return
 
     def hooks_fire_all(self, name: str, *args: Any) -> None:

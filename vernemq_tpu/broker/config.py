@@ -42,6 +42,9 @@ DEFAULTS: Dict[str, Any] = {
     # cluster (vmq_cluster_node.erl buffering; vmq_queue drain batching)
     "outgoing_clustering_buffer_size": 10_000_000,  # bytes
     "max_msgs_per_drain_step": 100,
+    # bounded migration-drain retry (1s apart) before the backlog is
+    # restored locally and the migration is marked failed
+    "migrate_drain_retries": 60,
     # v5
     "topic_alias_max_client": 0,
     "topic_alias_max_broker": 0,

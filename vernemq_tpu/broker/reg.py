@@ -97,7 +97,7 @@ class Registry:
         ``vmq_reg_trie.erl:144-149``) and re-create offline queues for
         persistent sessions homed here (``vmq_reg_mgr.erl:64-72``)."""
         for sid, rec in self.db.fold():
-            self._on_subs_event(sid, None, rec)
+            self._on_subs_event(sid, None, rec, self.node_name)
             if (rec.node == self.node_name and not rec.clean_session
                     and sid not in self.queues):
                 queue = self._start_queue(
@@ -198,7 +198,8 @@ class Registry:
 
     # -- subscriber-db change events → trie (vmq_reg_trie event consumer) --
 
-    def _on_subs_event(self, sid: SubscriberId, old, new) -> None:
+    def _on_subs_event(self, sid: SubscriberId, old, new,
+                       origin: str = "") -> None:
         """Apply a subscriber-record change to this node's routing state:
         the diff of old vs new subscriptions (vmq_subscriber:get_changes,
         vmq_subscriber.erl:54-58) becomes trie/TPU-table deltas. Local
@@ -231,6 +232,27 @@ class Registry:
         if (new is not None and new_node != self.node_name
                 and sid in self.queues and old_node == self.node_name):
             self.broker.on_subscriber_moved(sid, new_node)
+        # a persistent subscriber was remapped TO this node by someone else
+        # (queue migration / fix-dead-queues): create the offline queue
+        # eagerly so publishes and drain frames land in it
+        # (vmq_reg_mgr:handle_new_sub_event → setup_queue). A local-origin
+        # remap is the register path, which creates its own queue.
+        if (new is not None and new_node == self.node_name
+                and origin != self.node_name
+                and old_node != self.node_name):
+            self.ensure_offline_queue(sid, new)
+
+    def ensure_offline_queue(self, sid: SubscriberId, rec) -> None:
+        """Create + recover the offline queue for a persistent subscriber
+        homed here, if missing (vmq_reg_mgr setup_queue — used by the
+        remote-remap event path and fix-dead-queues)."""
+        if (rec is None or rec.clean_session or rec.node != self.node_name
+                or sid in self.queues or sid in self.broker.sessions):
+            return
+        queue = self._start_queue(
+            sid, _qopts_from_dict(rec.queue_opts, self.broker.config))
+        self.broker.recover_offline(sid, queue)
+        queue._arm_expiry()
 
     def _trie_add(self, mountpoint: str, fw: Tuple[str, ...],
                   sid: SubscriberId, node: str, opts: SubOpts) -> None:

@@ -97,14 +97,17 @@ class SubscriberDB:
 
     def subscribe_db_events(
         self, fn: Callable[[SubscriberId, Optional[SubscriberRecord],
-                            Optional[SubscriberRecord]], None]) -> None:
-        """fn(sid, old_record, new_record) on every change — local writes
-        fire synchronously (read-your-writes for the local trie, matching
-        the reference's synchronous trie events)."""
+                            Optional[SubscriberRecord], str], None]) -> None:
+        """fn(sid, old_record, new_record, origin) on every change — local
+        writes fire synchronously (read-your-writes for the local trie,
+        matching the reference's synchronous trie events); replicated
+        writes carry the originating node so consumers can tell a remote
+        remap (→ create the offline queue here, vmq_reg_mgr.erl:155-243)
+        from their own."""
 
         def _on_change(key, old, new, origin):
             fn((key[0], key[1]),
                SubscriberRecord.from_term(old),
-               SubscriberRecord.from_term(new))
+               SubscriberRecord.from_term(new), origin)
 
         self.metadata.subscribe(PREFIX, _on_change)

@@ -85,6 +85,7 @@ class Cluster:
         self._writers.clear()
         if self._server is not None:
             self._server.close()
+        self._com.close_all()  # peers must see the channels drop
 
     def join(self, seed_host: str, seed_port: int) -> None:
         """Join via a seed node (vmq_peer_service:join): a bootstrap
@@ -97,12 +98,105 @@ class Cluster:
         w.start()
 
     def leave(self, node_name: str) -> None:
-        """vmq-admin cluster leave node=X (graceful membership removal)."""
+        """Membership removal (the bare state flip). For the full operator
+        workflow — migrate offline queues, then leave — use
+        :meth:`leave_gracefully` on the leaving node; for a node that died
+        without leaving, :meth:`fix_dead_queues`."""
         rec = self.metadata.get(MEMBERS, node_name)
         if rec:
             rec = dict(rec)
             rec["state"] = "left"
             self.metadata.put(MEMBERS, node_name, rec)
+
+    async def leave_gracefully(self, timeout: float = 60.0) -> int:
+        """`vmq-admin cluster leave` on the leaving node
+        (vmq_reg:migrate_offline_queues behind the leave command,
+        vmq_reg.erl:433-477): rewrite every locally-homed persistent
+        subscriber to a live peer, wait for the drains, then flip
+        membership. Raises (and does NOT leave) if any drain failed or is
+        still pending at the timeout — the reference blocks on
+        block_until_migrated before leaving. Returns queues migrated."""
+        moved = await self.migrate_offline_queues(timeout=timeout)
+        stuck = {sid: m for sid, m in self.broker.migrations.items()
+                 if m["state"] in ("draining", "failed")}
+        if stuck:
+            raise RuntimeError(
+                f"leave aborted: {len(stuck)} queue migration(s) incomplete "
+                f"({', '.join(f'{s[0]}/{s[1]}:{m['state']}' for s, m in stuck.items())})")
+        self.leave(self.node_name)
+        return moved
+
+    async def migrate_offline_queues(self, targets: Optional[List[str]] = None,
+                                     timeout: float = 60.0) -> int:
+        """Rewrite each local offline persistent queue's subscriber record
+        to a target node (round-robin) and wait for the resulting drains.
+
+        The record rewrite replicates; the target creates the offline
+        queue (reg_mgr event path) and this node's migration task drains
+        the backlog over acked ``enq`` batches (broker._migrate_queue).
+        """
+        reg = self.broker.registry
+        if targets is None:
+            targets = [n for n in self.members(include_self=False)
+                       if self._status.get(n) == "up"]
+        if not targets:
+            raise RuntimeError("no live migration targets")
+        rr = itertools.cycle(targets)
+        moved = 0
+        for sid, queue in list(reg.queues.items()):
+            if sid in self.broker.sessions:
+                continue  # live session: not an offline queue
+            rec = reg.db.read(sid)
+            if rec is None or rec.node != self.node_name or rec.clean_session:
+                continue
+            rec.node = next(rr)
+            reg.db.store(sid, rec)  # event triggers the drain task
+            moved += 1
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + timeout
+        while loop.time() < deadline:
+            live = [m for m in self.broker.migrations.values()
+                    if m["state"] == "draining"]
+            if not live:
+                break
+            await asyncio.sleep(0.05)
+        return moved
+
+    def fix_dead_queues(self, targets: Optional[List[str]] = None) -> int:
+        """`vmq-admin cluster fix-dead-queues` (vmq_reg:fix_dead_queues,
+        vmq_reg.erl:479-520): repair routing after a node died without
+        leaving. Every subscriber record pointing at a node that is neither
+        a live member nor this node is rewritten to a live target
+        (round-robin; persistent sessions keep their subscriptions and get
+        fresh offline queues there) or dropped (clean sessions died with
+        their node). Messages already stored on the dead node stay there —
+        same data-loss contract as the reference. Returns records fixed."""
+        reg = self.broker.registry
+        alive = {self.node_name}
+        for n in self.members(include_self=False):
+            if self._status.get(n) == "up":
+                alive.add(n)
+        if targets is None:
+            targets = sorted(alive)
+        else:
+            bad = [t for t in targets if t not in alive]
+            if bad:
+                raise RuntimeError(f"targets not alive: {bad}")
+        rr = itertools.cycle(targets)
+        fixed = 0
+        for sid, rec in list(reg.db.fold()):
+            if rec is None or rec.node in alive:
+                continue
+            if rec.clean_session:
+                reg.db.delete(sid)
+            else:
+                rec.node = next(rr)
+                reg.db.store(sid, rec)
+                # a record assigned to THIS node is a local-origin write, so
+                # the event path won't build the queue — do it directly
+                reg.ensure_offline_queue(sid, rec)
+            fixed += 1
+        return fixed
 
     # ----------------------------------------------------------- membership
 
@@ -238,6 +332,11 @@ class Cluster:
         w = self._writers.get(node)
         if w is None:
             raise ConnectionError(f"no channel to {node}")
+        if w.status == "down":
+            # fail fast instead of buffering into a dead channel and
+            # waiting out the ack timeout (the reference's enqueue errors
+            # when the peer is unreachable, vmq_cluster_node.erl:124-147)
+            raise ConnectionError(f"channel to {node} is down")
         ref_id = next(self._ack_ids)
         fut = asyncio.get_event_loop().create_future()
         self._pending_acks[ref_id] = fut

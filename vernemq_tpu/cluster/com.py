@@ -25,10 +25,18 @@ log = logging.getLogger("vernemq_tpu.cluster")
 class ClusterCom:
     def __init__(self, cluster):
         self.cluster = cluster
+        self._conns: set = set()  # live inbound writers, closed on stop
+
+    def close_all(self) -> None:
+        """Tear down established inbound channels (node shutdown: peers
+        must observe the drop, not keep writing into a stopped broker)."""
+        for w in list(self._conns):
+            w.close()
 
     async def handle_conn(self, reader: asyncio.StreamReader,
                           writer: asyncio.StreamWriter) -> None:
         origin: Optional[str] = None
+        self._conns.add(writer)
         try:
             magic = await reader.readexactly(11)
             if magic != b"vmq-connect":
@@ -48,6 +56,7 @@ class ClusterCom:
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
+            self._conns.discard(writer)
             if origin is not None:
                 self.cluster.inbound_down(origin)
             writer.close()
