@@ -51,6 +51,9 @@ class Cluster:
         self.netsplit_detected = 0
         self.netsplit_resolved = 0
         self._pending_swc: Dict[int, asyncio.Future] = {}
+        from .reg_sync import RegSync
+
+        self.reg_sync = RegSync(self)
         self._com = ClusterCom(self)
         self.metadata.subscribe(MEMBERS, self._on_member_change)
         if hasattr(self.metadata, "attach_cluster"):  # SWC backend
@@ -269,6 +272,8 @@ class Cluster:
         if old == "up" and status == "down":
             self.netsplit_detected += 1
             self.metrics.incr("netsplit_detected")
+            # a dead peer's reg_sync locks release, its queued requests drop
+            self.reg_sync.on_node_down(node)
         elif old == "down" and status == "up":
             self.netsplit_resolved += 1
             self.metrics.incr("netsplit_resolved")
@@ -407,6 +412,26 @@ class Cluster:
                 fut.set_result(result)
             else:
                 fut.set_exception(ConnectionError(str(result)))
+
+    # ---------------------------------------------------- reg_sync transport
+
+    def sync_acquire(self, node: str, ref_id: int, key: Any,
+                     lease: float) -> bool:
+        w = self._writers.get(node)
+        if w is None or w.status == "down":
+            return False
+        return w.send_frame(frame(b"syq", (ref_id, key, lease)))
+
+    def sync_grant(self, node: str, ref_id: int) -> bool:
+        w = self._writers.get(node)
+        if w is None or w.status == "down":
+            return False
+        return w.send_frame(frame(b"syg", ref_id))
+
+    def sync_release(self, node: str, key: Any) -> None:
+        w = self._writers.get(node)
+        if w is not None:
+            w.send_frame(frame(b"syr", key))
 
     def _broadcast_meta(self, prefix: str, key: Any, entry) -> None:
         # the codec preserves tuple/list distinction, so keys travel as-is
