@@ -23,7 +23,7 @@ from vernemq_tpu.client import MQTTClient
 @pytest.fixture
 def broker(event_loop):
     b, server = event_loop.run_until_complete(
-        start_broker(Config(systree_enabled=False), port=0))
+        start_broker(Config(systree_enabled=False, allow_anonymous=True), port=0))
     http = HttpServer(b, port=0)
     event_loop.run_until_complete(http.start())
     yield b, server, http

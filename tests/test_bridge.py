@@ -14,7 +14,7 @@ from vernemq_tpu.client import MQTTClient
 
 
 async def boot(name, **cfg):
-    config = Config(systree_enabled=False, **cfg)
+    config = Config(systree_enabled=False, allow_anonymous=True, **cfg)
     broker, server = await start_broker(config, port=0, node_name=name)
     return broker, server
 

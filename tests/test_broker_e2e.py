@@ -24,7 +24,7 @@ from vernemq_tpu.protocol.types import (
 @pytest.fixture
 def broker(event_loop):
     b, server = event_loop.run_until_complete(
-        start_broker(Config(systree_enabled=False, retry_interval=1), port=0)
+        start_broker(Config(systree_enabled=False, allow_anonymous=True, retry_interval=1), port=0)
     )
     yield b, server
     event_loop.run_until_complete(b.stop())

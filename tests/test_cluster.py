@@ -67,7 +67,7 @@ class Node:
 
 
 async def start_node(name, **cfg):
-    config = Config(systree_enabled=False, **cfg)
+    config = Config(systree_enabled=False, allow_anonymous=True, **cfg)
     broker, server = await start_broker(config, port=0, node_name=name)
     broker.node_name = name
     broker.metadata.node_name = name

@@ -17,7 +17,7 @@ from vernemq_tpu.protocol.types import SubOpts, Will
 
 
 async def boot(**cfg):
-    return await start_broker(Config(systree_enabled=False, **cfg),
+    return await start_broker(Config(systree_enabled=False, allow_anonymous=True, **cfg),
                               port=0, node_name="sem-node")
 
 

@@ -142,7 +142,7 @@ async def test_script_error_is_auth_error_not_crash(tmp_path):
 
 @pytest.mark.asyncio
 async def test_mqtt5_demo_enhanced_auth_two_rounds():
-    b, s = await start_broker(Config(systree_enabled=False), port=0,
+    b, s = await start_broker(Config(systree_enabled=False, allow_anonymous=True), port=0,
                               node_name="demo5")
     b.plugins.enable("vmqtt5demo" if False else "vmq_mqtt5_demo_plugin")
     try:
@@ -172,7 +172,7 @@ async def test_mqtt5_demo_enhanced_auth_two_rounds():
 
 @pytest.mark.asyncio
 async def test_mqtt5_demo_enhanced_auth_bad_data_rejected():
-    b, s = await start_broker(Config(systree_enabled=False), port=0,
+    b, s = await start_broker(Config(systree_enabled=False, allow_anonymous=True), port=0,
                               node_name="demo5b")
     b.plugins.enable("vmq_mqtt5_demo_plugin")
     try:

@@ -15,7 +15,7 @@ from vernemq_tpu.client import MQTTClient
 
 @pytest.mark.asyncio
 async def test_sysmon_detects_loop_lag_and_sheds():
-    b, s = await start_broker(Config(systree_enabled=False,
+    b, s = await start_broker(Config(systree_enabled=False, allow_anonymous=True,
                                      sysmon_lag_threshold=0.05),
                               port=0, node_name="sysmon-node")
     try:
@@ -73,7 +73,7 @@ def test_sysmon_memory_watermark_forces_gc():
 
 @pytest.mark.asyncio
 async def test_rate_limit_throttles_instead_of_closing():
-    b, s = await start_broker(Config(systree_enabled=False,
+    b, s = await start_broker(Config(systree_enabled=False, allow_anonymous=True,
                                      max_message_rate=2),
                               port=0, node_name="rl-node")
     try:

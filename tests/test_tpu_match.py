@@ -127,7 +127,7 @@ async def test_broker_e2e_with_tpu_reg_view(event_loop):
     from vernemq_tpu.client import MQTTClient
 
     b, server = await start_broker(
-        Config(systree_enabled=False, default_reg_view="tpu",
+        Config(systree_enabled=False, allow_anonymous=True, default_reg_view="tpu",
                tpu_batch_window_us=500),
         port=0,
     )

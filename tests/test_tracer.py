@@ -14,7 +14,7 @@ from vernemq_tpu.client import MQTTClient
 
 async def boot():
     broker, server = await start_broker(
-        Config(systree_enabled=False), port=0, node_name="tracer-node")
+        Config(systree_enabled=False, allow_anonymous=True), port=0, node_name="tracer-node")
     return broker, server
 
 

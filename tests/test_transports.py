@@ -31,7 +31,7 @@ SSL_DIR = os.path.join(os.path.dirname(__file__), "ssl")
 @pytest.fixture
 def broker(event_loop):
     b, server = event_loop.run_until_complete(
-        start_broker(Config(systree_enabled=False), port=0))
+        start_broker(Config(systree_enabled=False, allow_anonymous=True), port=0))
     yield b, server
     event_loop.run_until_complete(b.stop())
     event_loop.run_until_complete(server.stop())

@@ -1,0 +1,199 @@
+"""Validate the K4 production-kernel shape at 1M subs on the real chip.
+
+K4 = one jitted call per batch:
+  - global phase: unrolled pub-chunks of <=1024 x region-0 matmul
+    + pack + extract (bounds the [Bc, glob] f32 intermediate)
+  - tile phase: static T tiles of TP bucket-sorted pubs, each matching a
+    traced-start dynamic_slice window of seg_max rows (unrolled, no
+    lax.map, no gathers of F)
+Also re-times the EXISTING match_extract_bucketed steady-state for a fair
+baseline (10 warm iters, single shape).
+"""
+import functools
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def note(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bench import build_corpus, zipf_topics
+    from vernemq_tpu.models.tpu_matcher import prepare_tiles
+    from vernemq_tpu.models.tpu_table import SubscriptionTable
+    from vernemq_tpu.ops import match_kernel as K
+
+    subs = 1_000_000
+    rng = random.Random(42)
+    import pickle, os
+    cache = f"/tmp/corpus_{subs}.pkl"
+    t0 = time.perf_counter()
+    if os.path.exists(cache):
+        with open(cache, "rb") as fh:
+            table, pools = pickle.load(fh)
+    else:
+        table = SubscriptionTable(max_levels=8,
+                                  initial_capacity=1 << (subs - 1).bit_length())
+        pools = build_corpus(rng, subs, table)
+        with open(cache, "wb") as fh:
+            pickle.dump((table, pools), fh)
+    note(f"corpus {time.perf_counter()-t0:.1f}s")
+    dev = jax.devices()[0]
+    put = lambda a: jax.device_put(a, dev)
+    arrays = (put(table.words), put(table.eff_len), put(table.has_hash),
+              put(table.first_wild), put(table.active))
+    bits = table.id_bits
+    F_t, t1 = K.build_operands(arrays[0], arrays[1], bits)
+    F_t = jax.block_until_ready(F_t)
+    S = int(arrays[0].shape[0])
+    glob = int(table.reg_cap[0])
+    eff, hh, fw, act = arrays[1], arrays[2], arrays[3], arrays[4]
+    note(f"platform={dev.platform} S={S} glob={glob} bits={bits}")
+    reg_start = table.reg_start.copy()
+    reg_end = (table.reg_start + table.reg_cap).copy()
+    Kd = int(F_t.shape[0])
+
+    def enc(B):
+        topics = zipf_topics(rng, pools, B)
+        pw = np.full((B, table.L), K.PAD_ID, dtype=np.int32)
+        pl = np.zeros(B, dtype=np.int32)
+        pd = np.zeros(B, dtype=bool)
+        pb = np.zeros(B, dtype=np.int32)
+        for i, t in enumerate(topics):
+            row, n, dollar, b = table.encode_topic_ex(t)
+            pw[i], pl[i], pd[i], pb[i] = row, n, dollar, b
+        return pw, pl, pd, pb
+
+    # ---------------- K4 host prep: static T tiles ----------------------
+    def k4_tiles(pw, pl, pd, pb, T, seg_max):
+        B = pw.shape[0]
+        TP = B // T
+        order = np.argsort(pb, kind="stable")
+        t_pw = np.full((T, TP, table.L), np.int32(K.PAD_ID), np.int32)
+        t_pl = np.zeros((T, TP), np.int32)
+        t_pd = np.zeros((T, TP), bool)
+        t_start = np.zeros(T, np.int32)
+        leftovers = []
+        for ti in range(T):
+            sel = order[ti * TP:(ti + 1) * TP]
+            lo = int(reg_start[pb[sel[0]]])
+            start = min(lo, S - seg_max)
+            keep = []
+            for s in sel:
+                if int(reg_end[pb[s]]) - start <= seg_max:
+                    keep.append(s)
+                else:
+                    leftovers.append(s)
+            m = len(keep)
+            t_pw[ti, :m] = pw[keep]
+            t_pl[ti, :m] = pl[keep]
+            t_pd[ti, :m] = pd[keep]
+            t_start[ti] = start
+        return t_pw, t_pl, t_pd, t_start, leftovers
+
+    def mk_k4(B, T, seg_max, GC, k=256, count_only=False):
+        TP = B // T
+
+        @jax.jit
+        def k4(pw, pl, pd, t_pw, t_pl, t_pd, t_start):
+            outs = []
+            # global phase in GC-sized pub chunks (unrolled)
+            for c in range(B // GC):
+                sl = slice(c * GC, (c + 1) * GC)
+                G = K.build_pub_operand(pw[sl], bits)
+                mm = lax.dot_general(G, F_t[:, :glob], (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+                m = (mm + t1[None, :glob] == 0.0) & K._epilogue(
+                    pl[sl], pd[sl], eff[:glob], hh[:glob], fw[:glob],
+                    act[:glob])
+                pk = K._pack_mask(m)
+                if count_only:
+                    outs.append(lax.population_count(pk).sum(dtype=jnp.int32))
+                else:
+                    outs.append(K.extract_indices_packed(pk, k, 2048))
+            # tile phase (unrolled static T)
+            touts = []
+            for ti in range(T):
+                Fseg = lax.dynamic_slice(F_t, (0, t_start[ti]), (Kd, seg_max))
+                t1s = lax.dynamic_slice(t1, (t_start[ti],), (seg_max,))
+                effs = lax.dynamic_slice(eff, (t_start[ti],), (seg_max,))
+                hhs = lax.dynamic_slice(hh, (t_start[ti],), (seg_max,))
+                fws = lax.dynamic_slice(fw, (t_start[ti],), (seg_max,))
+                acts = lax.dynamic_slice(act, (t_start[ti],), (seg_max,))
+                Gt = K.build_pub_operand(t_pw[ti], bits)
+                mm = lax.dot_general(Gt, Fseg, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+                j = jnp.arange(seg_max, dtype=jnp.int32)
+                rowok = j[None, :] >= glob - t_start[ti]  # never match region0 twice
+                m = (mm + t1s[None, :] == 0.0) & K._epilogue(
+                    t_pl[ti], t_pd[ti], effs, hhs, fws, acts) & rowok
+                pk = K._pack_mask(m)
+                if count_only:
+                    touts.append(lax.population_count(pk).sum(dtype=jnp.int32))
+                else:
+                    i2, v2, c2 = K.extract_indices_packed(pk, k, 2048)
+                    touts.append((i2 + t_start[ti], v2, c2))
+            if count_only:
+                return sum(outs) + sum(touts)
+            return outs, touts
+        return k4
+
+    def bench(fn, args, iters=20, warm=8, label=""):
+        for _ in range(warm):
+            out = fn(*args)
+        np.asarray(jax.tree_util.tree_leaves(out)[0])
+        t0 = time.perf_counter()
+        accs = []
+        for _ in range(iters):
+            out = fn(*args)
+            accs.append(jax.tree_util.tree_leaves(out)[0])
+        acc = accs[0].sum()
+        for a in accs[1:]:
+            acc = acc + a.sum()
+        np.asarray(acc)
+        per = (time.perf_counter() - t0) / iters
+        note(f"{label}: {per*1e3:.2f} ms/batch")
+        return per
+
+    import sys as _sys
+    cfgs = {"small": ((1024, 4, 262144, 1024),),
+            "big": ((8192, 16, 262144, 1024),),
+            "mid": ((4096, 8, 262144, 1024),)}[_sys.argv[1] if len(_sys.argv) > 1 else "big"]
+    for B, T, seg_max, GC in cfgs:
+        e = enc(B)
+        t_pw, t_pl, t_pd, t_start, left = k4_tiles(*e, T, seg_max)
+        note(f"B={B} T={T} seg={seg_max}: leftovers={len(left)}")
+        args = (put(e[0]), put(e[1]), put(e[2]),
+                put(t_pw), put(t_pl), put(t_pd), put(t_start))
+        try:
+            bench(mk_k4(B, T, seg_max, GC, count_only=True), args,
+                  label=f"K4 count B={B} T={T} seg={seg_max}")
+            bench(mk_k4(B, T, seg_max, GC, count_only=False), args,
+                  label=f"K4 extr  B={B} T={T} seg={seg_max}")
+        except Exception as ex:
+            note(f"K4 B={B} failed: {type(ex).__name__} {str(ex)[:150]}")
+
+    # existing production kernel, steady-state, one shape
+    B = 1024
+    pw, pl, pd, pb = enc(B)
+    (t_pw, t_pl, t_pd, t_s, t_lo, t_len, tile_of, pos_of,
+     seg2) = prepare_tiles(pw, pl, pd, pb, B, reg_start, reg_end, glob, S)
+    args2 = (F_t, t1, eff, hh, fw, act, put(pw), put(pl), put(pd),
+             put(t_pw), put(t_pl), put(t_pd), put(t_s), put(t_lo), put(t_len))
+    fn2 = functools.partial(K.match_extract_bucketed, id_bits=bits, k=256,
+                            glob_pad=glob, seg_max=seg2)
+    bench(lambda *a: fn2(*a)[2], args2, label=f"EXISTING bucketed B={B}")
+
+
+if __name__ == "__main__":
+    main()

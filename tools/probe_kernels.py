@@ -1,0 +1,174 @@
+"""Candidate kernel formulations for the v3 match path, timed on the real
+chip at a realistic 1M-sub table (bench corpus shape).
+
+Variants:
+  V1: current full-scan coded matmul + extract_indices_packed(block=2048)
+  V2: full-scan coded matmul + CHEAP extraction (matvec block counts +
+      small triangular cumsum) at several block sizes
+  V3: count-only full-scan (lower bound: matmul + pack + popcount-sum)
+  V4: chunked-table batched einsum (single-bucket tiles) count-only
+All at B in {2048, 8192}.
+"""
+import functools
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def note(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bench import build_corpus, zipf_topics
+    from vernemq_tpu.models.tpu_table import SubscriptionTable
+    from vernemq_tpu.ops import match_kernel as K
+
+    subs = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    rng = random.Random(42)
+    table = SubscriptionTable(max_levels=8,
+                              initial_capacity=1 << (subs - 1).bit_length())
+    t0 = time.perf_counter()
+    pools = build_corpus(rng, subs, table)
+    note(f"corpus {time.perf_counter()-t0:.1f}s")
+    dev = jax.devices()[0]
+    put = lambda a: jax.device_put(a, dev)
+    note(f"platform={dev.platform}")
+    arrays = (put(table.words), put(table.eff_len), put(table.has_hash),
+              put(table.first_wild), put(table.active))
+    bits = table.id_bits
+    F_t, t1 = K.build_operands(arrays[0], arrays[1], bits)
+    S = int(arrays[0].shape[0])
+    note(f"S={S} NB={table.NB} bits={bits}")
+    eff, hh, fw, act = arrays[1], arrays[2], arrays[3], arrays[4]
+
+    def enc(B):
+        topics = zipf_topics(rng, pools, B)
+        pw = np.full((B, table.L), K.PAD_ID, dtype=np.int32)
+        pl = np.zeros(B, dtype=np.int32)
+        pd = np.zeros(B, dtype=bool)
+        pb = np.zeros(B, dtype=np.int32)
+        for i, t in enumerate(topics):
+            row, n, dollar, b = table.encode_topic_ex(t)
+            pw[i], pl[i], pd[i], pb[i] = row, n, dollar, b
+        return pw, pl, pd, pb
+
+    def mask_full(pw, pl, pd):
+        G = K.build_pub_operand(pw, bits)
+        mm = lax.dot_general(G, F_t, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        return (mm + t1[None, :] == 0.0) & K._epilogue(pl, pd, eff, hh, fw, act)
+
+    # -- V1: current extraction
+    @functools.partial(jax.jit, static_argnames=("k",))
+    def v1(pw, pl, pd, k=256):
+        m = mask_full(pw, pl, pd)
+        i, v, c = K.extract_indices_packed(K._pack_mask(m), k, 2048)
+        return i.sum() + c.sum()
+
+    # -- V2: cheap extraction
+    def extract_cheap(packed, k, block):
+        B, W = packed.shape
+        wpb = block // 32
+        nblk = W // wpb
+        pc = lax.population_count(packed).astype(jnp.float32)
+        # per-block counts: [B*nblk, wpb] @ ones  (matvec, 2BW flops)
+        blk_cnt = lax.dot_general(
+            pc.reshape(B * nblk, wpb).astype(jnp.bfloat16),
+            jnp.ones((wpb, 1), jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).reshape(B, nblk)
+        # inclusive cumsum over nblk via small triangular matmul — but counts
+        # can exceed bf16 exactness (<=block<=8192 ok: ints to 256 only are
+        # exact in bf16! counts up to block=2048 NOT bf16-exact) → f32 matmul
+        tri = (jnp.arange(nblk)[:, None] <= jnp.arange(nblk)[None, :])
+        blk_cum = lax.dot_general(
+            blk_cnt, tri.astype(jnp.float32),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.int32)
+        count = blk_cum[:, -1]
+        targets = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32)[None, :],
+                                   (B, k))
+        blk = jnp.sum((blk_cum[:, None, :] <= targets[:, :, None])
+                      .astype(jnp.int32), axis=2)
+        blk_c = jnp.minimum(blk, nblk - 1)
+        prev_cum = jnp.where(
+            blk_c > 0,
+            jnp.take_along_axis(blk_cum, jnp.maximum(blk_c - 1, 0), axis=1), 0)
+        offset = targets - prev_cum
+        words = jnp.take_along_axis(
+            packed.reshape(B, nblk, wpb), blk_c[:, :, None], axis=1)
+        wpc = lax.population_count(words).astype(jnp.int32)
+        tri2 = (jnp.arange(wpb)[:, None] <= jnp.arange(wpb)[None, :])
+        wcum = lax.dot_general(
+            wpc.reshape(B * k, wpb).astype(jnp.bfloat16),
+            tri2.astype(jnp.bfloat16),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(jnp.int32).reshape(B, k, wpb)
+        widx = jnp.sum((wcum <= offset[:, :, None]).astype(jnp.int32), axis=2)
+        widx_c = jnp.minimum(widx, wpb - 1)
+        prior = jnp.where(
+            widx_c > 0,
+            jnp.squeeze(jnp.take_along_axis(
+                wcum, jnp.maximum(widx_c - 1, 0)[:, :, None], axis=2), 2), 0)
+        bit_rank = offset - prior
+        word = jnp.squeeze(
+            jnp.take_along_axis(words, widx_c[:, :, None], axis=2), 2)
+        p_range = jnp.arange(32, dtype=jnp.uint32)
+        below = (jnp.uint32(1) << p_range) - jnp.uint32(1)
+        cnt_below = lax.population_count(
+            word[:, :, None] & below[None, None, :]).astype(jnp.int32)
+        bit_set = ((word[:, :, None] >> p_range[None, None, :]) & 1).astype(jnp.int32)
+        ind = (cnt_below == bit_rank[:, :, None]) & (bit_set == 1)
+        pos_bit = jnp.sum(jnp.arange(32, dtype=jnp.int32)[None, None, :]
+                          * ind.astype(jnp.int32), axis=2)
+        idx = blk_c * block + widx_c * 32 + pos_bit
+        valid = targets < count[:, None]
+        return idx.astype(jnp.int32), valid, count
+
+    def mk_v2(block):
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def v2(pw, pl, pd, k=256):
+            m = mask_full(pw, pl, pd)
+            i, v, c = extract_cheap(K._pack_mask(m), k, block)
+            return i.sum() + c.sum()
+        return v2
+
+    # -- V3: count-only lower bound
+    @jax.jit
+    def v3(pw, pl, pd):
+        m = mask_full(pw, pl, pd)
+        pk = K._pack_mask(m)
+        return lax.population_count(pk).sum(dtype=jnp.int32)
+
+    def bench(fn, args, iters=20, label=""):
+        np.asarray(fn(*args))
+        t0 = time.perf_counter()
+        acc = jnp.zeros((), jnp.int32)
+        for _ in range(iters):
+            acc = acc + fn(*args)
+        np.asarray(acc)
+        per = (time.perf_counter() - t0) / iters
+        B = args[0].shape[0]
+        note(f"{label}: {per*1e3:.2f} ms/batch -> {B/per/1e3:.0f}k pubs/s")
+        return per
+
+    for B in (2048, 8192):
+        pw, pl, pd, pb = enc(B)
+        a = (put(pw), put(pl), put(pd))
+        bench(v3, a, label=f"V3 count-only      B={B}")
+        bench(v1, a, label=f"V1 cur extract     B={B}")
+        for blk in (2048, 8192):
+            bench(mk_v2(blk), a, label=f"V2 cheap blk={blk:5d} B={B}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,215 @@
+"""Probe 2: memory-safe kernel candidates at 1M subs.
+
+  W1: chunked full-scan — unrolled static S-chunks, matmul+pack per chunk,
+      cheap extraction on the assembled packed mask.
+  W2: batched-tile einsum — pubs grouped by bucket into [T, TP] tiles,
+      each tile matmuls its bucket's R-row window: [T,TP,K]x[T,K,R],
+      count-only and with per-tile extraction.
+"""
+import functools
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def note(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bench import build_corpus, zipf_topics
+    from vernemq_tpu.models.tpu_table import SubscriptionTable
+    from vernemq_tpu.ops import match_kernel as K
+
+    subs = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    rng = random.Random(42)
+    table = SubscriptionTable(max_levels=8,
+                              initial_capacity=1 << (subs - 1).bit_length())
+    t0 = time.perf_counter()
+    pools = build_corpus(rng, subs, table)
+    note(f"corpus {time.perf_counter()-t0:.1f}s")
+    dev = jax.devices()[0]
+    put = lambda a: jax.device_put(a, dev)
+    note(f"platform={dev.platform}")
+    arrays = (put(table.words), put(table.eff_len), put(table.has_hash),
+              put(table.first_wild), put(table.active))
+    bits = table.id_bits
+    F_t, t1 = K.build_operands(arrays[0], arrays[1], bits)
+    F_t = jax.block_until_ready(F_t)
+    S = int(arrays[0].shape[0])
+    caps = table.reg_cap
+    note(f"S={S} NB={table.NB} bits={bits} glob={caps[0]} "
+         f"bucket caps: min={caps[1:].min()} p50={int(np.percentile(caps[1:],50))} "
+         f"max={caps[1:].max()} nonzero={(caps[1:]>256).sum()}")
+    eff, hh, fw, act = arrays[1], arrays[2], arrays[3], arrays[4]
+
+    def enc(B):
+        topics = zipf_topics(rng, pools, B)
+        pw = np.full((B, table.L), K.PAD_ID, dtype=np.int32)
+        pl = np.zeros(B, dtype=np.int32)
+        pd = np.zeros(B, dtype=bool)
+        pb = np.zeros(B, dtype=np.int32)
+        for i, t in enumerate(topics):
+            row, n, dollar, b = table.encode_topic_ex(t)
+            pw[i], pl[i], pd[i], pb[i] = row, n, dollar, b
+        return pw, pl, pd, pb
+
+    def bench(fn, args, iters=20, label=""):
+        np.asarray(jax.tree_util.tree_leaves(fn(*args))[0])
+        t0 = time.perf_counter()
+        acc = jnp.zeros((), jnp.int32)
+        for _ in range(iters):
+            out = fn(*args)
+            acc = acc + jax.tree_util.tree_leaves(out)[0].sum()
+        np.asarray(acc)
+        per = (time.perf_counter() - t0) / iters
+        B = args[0].shape[0] if args[0].ndim <= 2 else args[0].shape[0] * args[0].shape[1]
+        note(f"{label}: {per*1e3:.2f} ms/batch")
+        return per
+
+    # ---------------- W1: chunked full-scan, pack per chunk -------------
+    def mk_w1(CH, count_only):
+        nch = S // CH
+        assert S % CH == 0
+
+        @jax.jit
+        def w1(pw, pl, pd):
+            G = K.build_pub_operand(pw, bits)
+            packs = []
+            for c in range(nch):
+                sl = slice(c * CH, (c + 1) * CH)
+                mm = lax.dot_general(G, F_t[:, sl], (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+                m = (mm + t1[None, sl] == 0.0) & K._epilogue(
+                    pl, pd, eff[sl], hh[sl], fw[sl], act[sl])
+                packs.append(K._pack_mask(m))
+            packed = jnp.concatenate(packs, axis=1)
+            if count_only:
+                return lax.population_count(packed).sum(dtype=jnp.int32)
+            return K.extract_indices_packed(packed, 256, 2048)[2].sum()
+        return w1
+
+    # ---------------- W2: batched-tile einsum ---------------------------
+    # tiles: host groups pubs by bucket, cuts into TP-sized tiles, each
+    # covering chunk c of its bucket's region (R-wide windows).
+    def tiles_for(pb, n, R, TP):
+        order = np.argsort(pb[:n], kind="stable")
+        tiles = []  # (pub_sel, col_start, row_lo, row_ln)
+        i = 0
+        while i < n:
+            b = pb[order[i]]
+            j = i
+            while j < n and pb[order[j]] == b:
+                j += 1
+            start = int(table.reg_start[b])
+            cap = int(table.reg_cap[b])
+            for plo in range(i, j, TP):
+                sel = order[plo:plo + TP]
+                for c0 in range(0, cap, R):
+                    cs = min(start + c0, S - R)
+                    lo = start + c0 - cs
+                    ln = min(R - lo, cap - c0)
+                    tiles.append((sel, cs, lo, ln))
+            i = j
+        return tiles
+
+    def pack_tiles(enc_out, R, TP, Tpad):
+        pw, pl, pd, pb = enc_out
+        n = pw.shape[0]
+        tl = tiles_for(pb, n, R, TP)
+        T = len(tl)
+        if T > Tpad:
+            raise RuntimeError(f"T={T} > Tpad={Tpad}")
+        t_pw = np.full((Tpad, TP, table.L), np.int32(K.PAD_ID), np.int32)
+        t_pl = np.zeros((Tpad, TP), np.int32)
+        t_pd = np.zeros((Tpad, TP), bool)
+        t_cs = np.zeros(Tpad, np.int32)
+        t_lo = np.zeros(Tpad, np.int32)
+        t_ln = np.zeros(Tpad, np.int32)
+        for ti, (sel, cs, lo, ln) in enumerate(tl):
+            m = len(sel)
+            t_pw[ti, :m] = pw[sel]
+            t_pl[ti, :m] = pl[sel]
+            t_pd[ti, :m] = pd[sel]
+            t_cs[ti], t_lo[ti], t_ln[ti] = cs, lo, ln
+        return T, t_pw, t_pl, t_pd, t_cs, t_lo, t_ln
+
+    Kdim = int(F_t.shape[0])
+
+    def mk_w2(R, TP, count_only, extract=False):
+        @jax.jit
+        def w2(t_pw, t_pl, t_pd, t_cs, t_lo, t_ln, gpw, gpl, gpd):
+            # global phase (region 0)
+            glob = int(caps[0])
+            G = K.build_pub_operand(gpw, bits)
+            mmg = lax.dot_general(G, F_t[:, :glob], (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+            mg = (mmg + t1[None, :glob] == 0.0) & K._epilogue(
+                gpl, gpd, eff[:glob], hh[:glob], fw[:glob], act[:glob])
+            gout = (lax.population_count(K._pack_mask(mg)).sum(dtype=jnp.int32)
+                    if count_only else
+                    K.extract_indices_packed(K._pack_mask(mg), 256, 2048)[2].sum())
+            # tile phase: gather F windows [T, K, R]
+            cols = t_cs[:, None] + jnp.arange(R)[None, :]      # [T, R]
+            Fw = F_t[:, cols]                                   # [K, T, R]
+            Fw = jnp.swapaxes(Fw, 0, 1)                         # [T, K, R]
+            t1w = t1[cols]                                      # [T, R]
+            effw, hhw, fww, actw = eff[cols], hh[cols], fw[cols], act[cols]
+            Gt = K.build_pub_operand(
+                t_pw.reshape(-1, t_pw.shape[-1]), bits).reshape(
+                t_pw.shape[0], TP, -1)                          # [T, TP, Kd]
+            mm = lax.dot_general(
+                Gt, Fw, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)             # [T, TP, R]
+            r = jnp.arange(R, dtype=jnp.int32)
+            rowok = (r[None, :] >= t_lo[:, None]) & (r[None, :] < (t_lo + t_ln)[:, None])
+            m = (mm + t1w[:, None, :] == 0.0)
+            m = m & rowok[:, None, :]
+            # epilogue per tile-window
+            len_ok = jnp.where(hhw[:, None, :],
+                               t_pl[:, :, None] >= effw[:, None, :],
+                               t_pl[:, :, None] == effw[:, None, :])
+            m = m & len_ok & ~(t_pd[:, :, None] & fww[:, None, :]) & actw[:, None, :]
+            if count_only:
+                return gout + m.sum(dtype=jnp.int32)
+            # per-tile extraction: flatten [T*TP, R]
+            Tn = m.shape[0]
+            mf = m.reshape(Tn * TP, R)
+            pk = K._pack_mask(mf)
+            i2, v2, c2 = K.extract_indices_packed(pk, 256, 2048)
+            return gout + c2.sum() + i2.sum()
+        return w2
+
+    for B in (2048, 8192):
+        e = enc(B)
+        a = (put(e[0]), put(e[1]), put(e[2]))
+        for CH in (131072,):
+            try:
+                bench(mk_w1(CH, True), a, label=f"W1 count CH={CH} B={B}")
+                bench(mk_w1(CH, False), a, label=f"W1 extr  CH={CH} B={B}")
+            except Exception as ex:
+                note(f"W1 CH={CH} B={B} failed: {type(ex).__name__} {str(ex)[:120]}")
+        for R, TP in ((8192, 128), (8192, 256), (32768, 256)):
+            try:
+                Tpad = 512 if B == 8192 else 256
+                T, *tarrs = pack_tiles(e, R, TP, Tpad)
+                targs = tuple(put(x) for x in tarrs) + a
+                note(f"  tiles T={T} (pad {Tpad}) R={R} TP={TP}")
+                bench(mk_w2(R, TP, True), targs,
+                      label=f"W2 count R={R} TP={TP} B={B}")
+                bench(mk_w2(R, TP, False), targs,
+                      label=f"W2 extr  R={R} TP={TP} B={B}")
+            except Exception as ex:
+                note(f"W2 R={R} TP={TP} B={B} failed: {type(ex).__name__} {str(ex)[:120]}")
+
+
+if __name__ == "__main__":
+    main()

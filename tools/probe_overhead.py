@@ -1,0 +1,102 @@
+"""Measure per-launch overhead + pipelining on the axon tunnel.
+
+1. trivial jitted kernel, N chained (dependent) launches
+2. trivial jitted kernel, N independent launches, one checksum pull
+3. medium matmul (MXU work ~100 GFLOP) same two ways
+4. device_put cost for small arrays
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def note(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    note(f"platform={dev.platform}")
+
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    x = jax.device_put(jnp.zeros((8, 128), jnp.float32), dev)
+    np.asarray(tiny(x))
+
+    N = 100
+    # chained
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(N):
+        y = tiny(y)
+    np.asarray(y)
+    note(f"tiny chained: {(time.perf_counter()-t0)/N*1e3:.3f} ms/launch")
+
+    # independent
+    t0 = time.perf_counter()
+    outs = [tiny(x) for _ in range(N)]
+    acc = outs[0]
+    for o in outs[1:]:
+        acc = acc + o
+    np.asarray(acc)
+    note(f"tiny indep: {(time.perf_counter()-t0)/(N+N)*1e3:.3f} ms/launch "
+         f"(incl the {N} adds)")
+
+    # medium matmul: [4096, 64] @ [64, 393216] bf16 -> ~206 GFLOP? no:
+    # 4096*64*393216*2 = 206 GFLOP... make it [1024, 40] @ [40, 1.8M]
+    K, S, B = 64, 1_572_864, 1024
+    F = jax.device_put(jnp.ones((K, S), jnp.bfloat16), dev)
+
+    @jax.jit
+    def mm(g):
+        out = jax.lax.dot_general(g, F, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        return (out == 0.0).sum(dtype=jnp.int32)
+
+    g = jax.device_put(jnp.ones((B, K), jnp.bfloat16), dev)
+    np.asarray(mm(g))
+    M = 20
+    t0 = time.perf_counter()
+    acc = jnp.zeros((), jnp.int32)
+    for _ in range(M):
+        acc = acc + mm(g)
+    np.asarray(acc)
+    per = (time.perf_counter() - t0) / M
+    gf = 2 * B * K * S / per / 1e12
+    bw = (K * S * 2) / per / 1e9
+    note(f"matmul+reduce [B={B},K={K}]x[K,S={S}]: {per*1e3:.2f} ms/launch "
+         f"({gf:.1f} TFLOP/s, F-read {bw:.0f} GB/s)")
+
+    # B=8192 same
+    g8 = jax.device_put(jnp.ones((8192, K), jnp.bfloat16), dev)
+    np.asarray(mm(g8))
+    t0 = time.perf_counter()
+    acc = jnp.zeros((), jnp.int32)
+    for _ in range(M):
+        acc = acc + mm(g8)
+    np.asarray(acc)
+    per8 = (time.perf_counter() - t0) / M
+    gf8 = 2 * 8192 * K * S / per8 / 1e12
+    note(f"matmul+reduce [B=8192]: {per8*1e3:.2f} ms/launch ({gf8:.1f} TFLOP/s)")
+
+    # device_put cost
+    a = np.zeros((1024, 8), np.int32)
+    t0 = time.perf_counter()
+    for _ in range(50):
+        jax.device_put(a, dev)
+    note(f"device_put 32KB: {(time.perf_counter()-t0)/50*1e3:.3f} ms")
+    t0 = time.perf_counter()
+    ds = [jax.device_put(a, dev) for _ in range(50)]
+    note(f"device_put 32KB nosync: {(time.perf_counter()-t0)/50*1e3:.3f} ms")
+
+
+if __name__ == "__main__":
+    main()

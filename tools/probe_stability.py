@@ -1,0 +1,74 @@
+"""Interleaved repeats of the same kernels to expose tunnel/device noise,
+plus per-op overhead inside one executable."""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def note(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    dev = jax.devices()[0]
+    note(f"platform={dev.platform}")
+    K, S = 64, 1_572_864
+    F = jax.device_put(jnp.ones((K, S), jnp.bfloat16), dev)
+
+    @jax.jit
+    def mm(g):
+        out = lax.dot_general(g, F, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        return (out == 0.0).sum(dtype=jnp.int32)
+
+    gs = {B: jax.device_put(jnp.ones((B, K), jnp.bfloat16), dev)
+          for B in (1024, 8192)}
+    for B, g in gs.items():
+        np.asarray(mm(g))
+
+    def run(B, iters=10):
+        g = gs[B]
+        t0 = time.perf_counter()
+        acc = jnp.zeros((), jnp.int32)
+        for _ in range(iters):
+            acc = acc + mm(g)
+        np.asarray(acc)
+        return (time.perf_counter() - t0) / iters * 1e3
+
+    for r in range(4):
+        a = run(1024)
+        b = run(8192)
+        note(f"round {r}: B=1024 {a:.1f} ms  B=8192 {b:.1f} ms")
+
+    # per-op overhead inside one executable: 256 chained scalar-ish ops
+    x0 = jax.device_put(jnp.ones((8, 128), jnp.float32), dev)
+
+    def chain(n):
+        @jax.jit
+        def f(x):
+            for i in range(n):
+                x = x * 1.0000001 + 0.0000001
+            return x.sum()
+        return f
+
+    for n in (16, 256, 1024):
+        f = chain(n)
+        np.asarray(f(x0))
+        t0 = time.perf_counter()
+        acc = jnp.zeros((), jnp.float32)
+        for _ in range(20):
+            acc = acc + f(x0)
+        np.asarray(acc)
+        per = (time.perf_counter() - t0) / 20 * 1e3
+        note(f"chain n={n}: {per:.2f} ms/exec ({per/n*1e3:.1f} us/op)")
+
+
+if __name__ == "__main__":
+    main()

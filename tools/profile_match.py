@@ -1,0 +1,189 @@
+"""Phase-by-phase profile of the production match path on the real chip.
+
+Answers VERDICT r2 weak-1: where do the ~39ms/batch go at B=1024, 1M subs?
+Phases measured independently (each amortized over many iters, one
+checksum pull at the end — the axon tunnel's ~65ms RTT stays out of the
+steady-state numbers):
+
+  A. pure device: bucketed kernel on device-resident inputs
+  B. device + per-batch transfers (the 9 device_puts submit() does today)
+  C. host encode (encode_topic_ex loop)
+  D. host tile prep (prepare_tiles)
+  E. full-scan MXU kernel on device-resident inputs (for comparison)
+  F. resolve: host mapping of idx/valid arrays back to entries
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def note(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from bench import build_corpus, zipf_topics
+    from vernemq_tpu.models.tpu_matcher import prepare_tiles
+    from vernemq_tpu.models.tpu_table import SubscriptionTable
+    from vernemq_tpu.ops import match_kernel as K
+
+    subs = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    B = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+    iters = 30
+
+    rng = random.Random(42)
+    table = SubscriptionTable(max_levels=8,
+                              initial_capacity=1 << (subs - 1).bit_length())
+    t0 = time.perf_counter()
+    pools = build_corpus(rng, subs, table)
+    note(f"corpus {time.perf_counter()-t0:.1f}s")
+
+    dev = jax.devices()[0]
+    note(f"platform={dev.platform}")
+    put = lambda a: jax.device_put(a, dev)
+    arrays = (put(table.words), put(table.eff_len), put(table.has_hash),
+              put(table.first_wild), put(table.active))
+    bits = table.id_bits
+    operands = K.build_operands(arrays[0], arrays[1], bits)
+    S = arrays[0].shape[0]
+    reg_start = table.reg_start.copy()
+    reg_end = (table.reg_start + table.reg_cap).copy()
+    glob_pad = int(table.reg_cap[0])
+    note(f"S={S} NB={table.NB} glob_pad={glob_pad} bits={bits}")
+
+    def encode(topics):
+        n, L = len(topics), table.L
+        pw = np.full((n, L), K.PAD_ID, dtype=np.int32)
+        pl = np.zeros(n, dtype=np.int32)
+        pd = np.zeros(n, dtype=bool)
+        pb = np.zeros(n, dtype=np.int32)
+        for i, t in enumerate(topics):
+            row, ln, dollar, bucket = table.encode_topic_ex(t)
+            pw[i], pl[i], pd[i], pb[i] = row, ln, dollar, bucket
+        return pw, pl, pd, pb
+
+    topic_batches = [zipf_topics(rng, pools, B) for _ in range(8)]
+
+    # C. host encode
+    t0 = time.perf_counter()
+    enc = [encode(tb) for tb in topic_batches]
+    enc_ms = (time.perf_counter() - t0) / len(topic_batches) * 1e3
+    note(f"C host encode: {enc_ms:.2f} ms/batch")
+
+    # D. host tile prep
+    t0 = time.perf_counter()
+    reps = 4
+    for _ in range(reps):
+        tiles = [prepare_tiles(pw, pl, pd, pb, pw.shape[0], reg_start,
+                               reg_end, glob_pad, S)
+                 for (pw, pl, pd, pb) in enc]
+    prep_ms = (time.perf_counter() - t0) / (len(enc) * reps) * 1e3
+    tcounts = [t[0].shape[0] for t in tiles]
+    segs = sorted({t[8] for t in tiles})
+    note(f"D host prepare_tiles: {prep_ms:.2f} ms/batch; tile counts "
+         f"{sorted(set(tcounts))}; seg_max {segs}")
+
+    # device-resident input sets (A)
+    dev_in = []
+    for (pw, pl, pd, pb), t in zip(enc, tiles):
+        t_pw, t_pl, t_pd, t_start, t_lo, t_len, _, _, seg_max = t
+        dev_in.append((put(pw), put(pl), put(pd), put(t_pw), put(t_pl),
+                       put(t_pd), put(t_start), put(t_lo), put(t_len),
+                       seg_max))
+    F_t, t1 = operands
+
+    def run_dev(di):
+        (pw, pl, pd, t_pw, t_pl, t_pd, t_start, t_lo, t_len, seg_max) = di
+        g1, g2, gc, x1, x2, tc = K.match_extract_bucketed(
+            F_t, t1, arrays[1], arrays[2], arrays[3], arrays[4],
+            pw, pl, pd, t_pw, t_pl, t_pd, t_start, t_lo, t_len,
+            id_bits=bits, k=256, glob_pad=glob_pad, seg_max=seg_max)
+        return gc.sum() + tc.sum()
+
+    # warmup/compile all shapes
+    for di in dev_in:
+        np.asarray(run_dev(di))
+    note("compiled A")
+
+    t0 = time.perf_counter()
+    acc = jnp.zeros((), jnp.int32)
+    for i in range(iters):
+        acc = acc + run_dev(dev_in[i % len(dev_in)])
+    np.asarray(acc)
+    a_ms = (time.perf_counter() - t0) / iters * 1e3
+    note(f"A pure device bucketed: {a_ms:.2f} ms/batch")
+
+    # B. with per-batch transfers (prepared host arrays, as submit() does)
+    host_in = [(pw, pl, pd) + t[:6] + (t[8],)
+               for (pw, pl, pd, pb), t in zip(enc, tiles)]
+
+    def run_put(hi):
+        (pw, pl, pd, t_pw, t_pl, t_pd, t_start, t_lo, t_len, seg_max) = hi
+        return run_dev((put(pw), put(pl), put(pd), put(t_pw), put(t_pl),
+                        put(t_pd), put(t_start), put(t_lo), put(t_len),
+                        seg_max))
+
+    np.asarray(run_put(host_in[0]))
+    t0 = time.perf_counter()
+    acc = jnp.zeros((), jnp.int32)
+    for i in range(iters):
+        acc = acc + run_put(host_in[i % len(host_in)])
+    np.asarray(acc)
+    b_ms = (time.perf_counter() - t0) / iters * 1e3
+    note(f"B device + per-batch puts: {b_ms:.2f} ms/batch "
+         f"(transfer+dispatch overhead {b_ms - a_ms:.2f})")
+
+    # E. full-scan MXU path
+    pw0, pl0, pd0 = (put(enc[0][0]), put(enc[0][1]), put(enc[0][2]))
+    def run_mxu(i):
+        e = dev_in[i % len(dev_in)]
+        out = K.match_extract_mxu(*arrays, e[0], e[1], e[2], k=256, chunk=0)
+        return out[2].sum()
+    np.asarray(run_mxu(0))
+    t0 = time.perf_counter()
+    acc = jnp.zeros((), jnp.int32)
+    for i in range(iters):
+        acc = acc + run_mxu(i)
+    np.asarray(acc)
+    e_ms = (time.perf_counter() - t0) / iters * 1e3
+    note(f"E pure device full-scan MXU: {e_ms:.2f} ms/batch")
+
+    # F. resolve cost: pull idx/valid and map to entries host-side
+    di = dev_in[0]
+    (pw, pl, pd, t_pw, t_pl, t_pd, t_start, t_lo, t_len, seg_max) = di
+    out = K.match_extract_bucketed(
+        F_t, t1, arrays[1], arrays[2], arrays[3], arrays[4],
+        pw, pl, pd, t_pw, t_pl, t_pd, t_start, t_lo, t_len,
+        id_bits=bits, k=256, glob_pad=glob_pad, seg_max=seg_max)
+    host_out = [np.asarray(o) for o in out]
+    gidx, gvalid, gcount, tidx, tvalid, tcount = host_out
+    _, _, _, _, _, _, tile_of, pos_of, _ = tiles[0]
+    entries = table.entries
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        res = []
+        for i in range(B):
+            ti, j = tile_of[i], pos_of[i]
+            rows = [entries[s] for s in gidx[i][gvalid[i]]]
+            rows += [entries[s] for s in tidx[ti, j][tvalid[ti, j]]]
+            res.append(rows)
+    f_ms = (time.perf_counter() - t0) / reps * 1e3
+    nrows = sum(len(r) for r in res)
+    note(f"F host resolve: {f_ms:.2f} ms/batch ({nrows} rows)")
+
+    note(f"SUMMARY enc={enc_ms:.2f} prep={prep_ms:.2f} devA={a_ms:.2f} "
+         f"dev+put={b_ms:.2f} mxu={e_ms:.2f} resolve={f_ms:.2f} ms")
+
+
+if __name__ == "__main__":
+    main()

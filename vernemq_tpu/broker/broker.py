@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import inspect
 import logging
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -68,10 +69,22 @@ class Broker:
         if self.config.message_store == "file":
             self.msg_store: MsgStore = FileMsgStore(self.config.message_store_dir)
         elif self.config.message_store == "native":
-            from ..storage.msg_store import NativeMsgStore
+            from ..storage.msg_store import BucketedMsgStore, NativeMsgStore
 
             try:
-                self.msg_store = NativeMsgStore(self.config.message_store_dir)
+                n = int(self.config.get("msg_store_instances", 1))
+                store_dir = self.config.message_store_dir
+                if n > 1 and os.path.exists(
+                        os.path.join(store_dir, "msgstore.kv")):
+                    # a flat single-instance store already lives here —
+                    # honour it rather than silently orphaning its data
+                    log.warning("legacy single-instance msg store found in "
+                                "%s; ignoring msg_store_instances=%d",
+                                store_dir, n)
+                    n = 1
+                # N engines hashed by msg-ref (vmq_lvldb_store_sup.erl:47-54)
+                self.msg_store = (BucketedMsgStore(store_dir, n) if n > 1
+                                  else NativeMsgStore(store_dir))
             except Exception as e:  # no toolchain → durable Python fallback
                 log.warning("native msg store unavailable (%s); "
                             "falling back to file store", e)
@@ -445,6 +458,21 @@ class Broker:
             self.graphite.start()
         if self.config.get("bridges"):
             self.plugins.enable("vmq_bridge")
+        # conf-file plugins (plugins.<name> = on) and listeners
+        # (listener.<kind>.<name> = ip:port) — the boot-time half of the
+        # vernemq.conf layer (broker/conf.py)
+        for p in self.config.get("plugins", []):
+            self.plugins.enable(p["name"], **p.get("opts", {}))
+        conf_listeners = self.config.get("listeners", [])
+        if conf_listeners:
+            if self.listeners is None:
+                from .listeners import ListenerManager
+
+                ListenerManager(self)
+            for ln in conf_listeners:
+                await self.listeners.start_listener(
+                    ln["kind"], ln.get("addr", "127.0.0.1"),
+                    ln.get("port", 0), ln.get("opts"))
         if self.config.get("sysmon_enabled", True):
             from .sysmon import Sysmon
 

@@ -1,0 +1,206 @@
+"""``vernemq.conf``-style configuration file loader.
+
+The reference translates a flat ``key = value`` file through cuttlefish
+schemas (``apps/vmq_server/priv/vmq_server.schema``, 217 mappings) into app
+envs. This loader keeps the same operator surface — the same knob names,
+``on``/``off`` flags, ``listener.<kind>.<name>`` tree, ``plugins.<name>``
+switches — mapped onto :class:`~vernemq_tpu.broker.config.Config` without
+the schema-compiler machinery: values are coerced to the type of the
+matching ``DEFAULTS`` entry.
+
+Grammar (one setting per line)::
+
+    # comment                     (also '%%' like the reference's erlang-isms)
+    allow_anonymous = off
+    listener.tcp.default = 127.0.0.1:1883
+    listener.tcp.default.proxy_protocol = on
+    listener.ssl.default = 0.0.0.0:8883
+    listener.ssl.default.certfile = /etc/ssl/cert.pem
+    plugins.vmq_passwd = on
+    vmq_passwd.password_file = /etc/vmq.passwd
+
+Listener kinds follow ``vmq_ranch_config.erl:224-227``: ``tcp``/``ssl``
+(MQTT), ``ws``/``wss`` (WebSocket), ``http``/``https`` (admin), ``vmq``/
+``vmqs`` (cluster data plane).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from .config import DEFAULTS, Config
+
+# conf-file listener kind -> ListenerManager kind
+LISTENER_KINDS = {
+    "tcp": "mqtt", "ssl": "mqtts", "ws": "ws", "wss": "wss",
+    "http": "http", "https": "https", "vmq": "vmq", "vmqs": "vmqs",
+}
+
+# plugin-opt spellings from the reference schemas -> our enable() kwargs
+_PLUGIN_OPT_ALIASES = {
+    ("vmq_passwd", "password_file"): "passwd_file",
+    ("vmq_acl", "acl_file"): "acl_file",
+    ("vmq_diversity", "script_dir"): "script_dir",
+}
+
+# reference metadata_plugin values -> our backend names
+_METADATA_IMPLS = {"vmq_plumtree": "lww", "vmq_swc": "swc",
+                   "lww": "lww", "swc": "swc"}
+
+
+class ConfError(ValueError):
+    def __init__(self, lineno: int, line: str, why: str):
+        super().__init__(f"conf line {lineno}: {why}: {line!r}")
+        self.lineno = lineno
+
+
+def _coerce(key: str, raw: str, lineno: int, line: str) -> Any:
+    """Coerce ``raw`` to the type of ``DEFAULTS[key]`` (cuttlefish's
+    datatype step)."""
+    proto = DEFAULTS[key]
+    if isinstance(proto, bool):
+        low = raw.lower()
+        if low in ("on", "true", "1", "yes"):
+            return True
+        if low in ("off", "false", "0", "no"):
+            return False
+        raise ConfError(lineno, line, f"expected on/off for {key}")
+    if isinstance(proto, int) and not isinstance(proto, bool):
+        try:
+            return int(raw)
+        except ValueError:
+            raise ConfError(lineno, line, f"expected integer for {key}") from None
+    if isinstance(proto, float):
+        try:
+            return float(raw)
+        except ValueError:
+            raise ConfError(lineno, line, f"expected number for {key}") from None
+    if isinstance(proto, list):
+        return [p.strip() for p in raw.split(",") if p.strip()]
+    return raw
+
+
+def _host_port(raw: str, lineno: int, line: str) -> Tuple[str, int]:
+    host, sep, port = raw.rpartition(":")
+    if not sep:
+        raise ConfError(lineno, line, "expected host:port")
+    try:
+        return host, int(port)
+    except ValueError:
+        raise ConfError(lineno, line, "bad port") from None
+
+
+def parse_conf(text: str) -> Dict[str, Any]:
+    """Parse conf text into Config kwargs (including the ``listeners`` and
+    ``plugins`` structured keys)."""
+    settings: Dict[str, Any] = {}
+    listeners: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    plugins: Dict[str, Dict[str, Any]] = {}
+    plugin_opts: Dict[str, Dict[str, Any]] = {}
+
+    # first pass: collect declared plugin names so a typo'd option tree
+    # (vmq_paswd.password_file) fails loudly instead of being stashed for a
+    # plugin that will never exist
+    declared_plugins = set()
+    for rawline in text.splitlines():
+        line = rawline.strip()
+        if line.startswith("plugins.") and "=" in line:
+            declared_plugins.add(line.split("=")[0].strip().split(".", 1)[1])
+
+    for lineno, rawline in enumerate(text.splitlines(), 1):
+        line = rawline.strip()
+        if not line or line.startswith("#") or line.startswith("%%"):
+            continue
+        key, sep, value = line.partition("=")
+        if not sep:
+            raise ConfError(lineno, line, "expected key = value")
+        key = key.strip()
+        value = value.strip()
+        # strip a trailing comment ('cert.pem  # prod cert')
+        if " #" in value:
+            value = value.split(" #", 1)[0].strip()
+
+        if key.startswith("listener."):
+            parts = key.split(".")
+            if len(parts) < 3 or parts[1] not in LISTENER_KINDS:
+                raise ConfError(lineno, line,
+                                f"unknown listener kind {parts[1] if len(parts) > 1 else '?'}")
+            kind, name = parts[1], parts[2]
+            ent = listeners.setdefault((kind, name), {"opts": {}})
+            if len(parts) == 3:
+                ent["addr"], ent["port"] = _host_port(value, lineno, line)
+            else:
+                opt = ".".join(parts[3:])
+                ov: Any = value
+                if value.lower() in ("on", "true"):
+                    ov = True
+                elif value.lower() in ("off", "false"):
+                    ov = False
+                else:
+                    try:
+                        ov = int(value)
+                    except ValueError:
+                        pass
+                ent["opts"][opt] = ov
+            continue
+
+        if key.startswith("plugins."):
+            name = key.split(".", 1)[1]
+            low = value.lower()
+            if low in ("on", "true"):
+                plugins[name] = plugin_opts.setdefault(name, {})
+            elif low in ("off", "false"):
+                plugins.pop(name, None)
+            else:
+                raise ConfError(lineno, line, "expected on/off")
+            continue
+
+        head = key.split(".", 1)[0]
+        if head.startswith("vmq_") and head not in DEFAULTS:
+            # plugin option tree (vmq_passwd.password_file = ...)
+            if head not in declared_plugins:
+                raise ConfError(lineno, line,
+                                f"options for undeclared plugin {head} "
+                                f"(missing plugins.{head} = on?)")
+            opt = key.split(".", 1)[1]
+            opt = _PLUGIN_OPT_ALIASES.get((head, opt), opt)
+            plugin_opts.setdefault(head, {})[opt] = value
+            if head in plugins:
+                plugins[head] = plugin_opts[head]
+            continue
+
+        if key == "metadata_plugin":
+            impl = _METADATA_IMPLS.get(value)
+            if impl is None:
+                raise ConfError(lineno, line, "unknown metadata_plugin")
+            settings[key] = impl
+            continue
+
+        if key not in DEFAULTS:
+            raise ConfError(lineno, line, f"unknown config key {key}")
+        settings[key] = _coerce(key, value, lineno, line)
+
+    if listeners:
+        for (kind, name), ent in listeners.items():
+            if "port" not in ent:
+                # opts-only listener = typo'd name or missing address line;
+                # refuse rather than bind an unconfigured ephemeral socket
+                raise ConfError(
+                    0, f"listener.{kind}.{name}",
+                    "listener has options but no address line")
+        settings["listeners"] = [
+            {"kind": LISTENER_KINDS[kind], "name": name,
+             "addr": ent.get("addr", "127.0.0.1"),
+             "port": ent["port"], "opts": ent["opts"]}
+            for (kind, name), ent in listeners.items()
+        ]
+    if plugins:
+        settings["plugins"] = [
+            {"name": n, "opts": o} for n, o in plugins.items()
+        ]
+    return settings
+
+
+def load_conf_file(path: str) -> Config:
+    with open(path, "r", encoding="utf-8") as fh:
+        return Config(**parse_conf(fh.read()))

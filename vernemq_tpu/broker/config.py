@@ -14,7 +14,10 @@ from typing import Any, Callable, Dict, List, Optional
 
 DEFAULTS: Dict[str, Any] = {
     # connection / session (vmq_server.schema)
-    "allow_anonymous": True,  # reference default is off; on here until auth plugins land in the boot path
+    # off by default like the reference: with no auth plugin answering the
+    # auth_on_register chain, connects are denied (vmq_auth.erl:3-8
+    # registers deny-all fallback hooks when allow_anonymous=off)
+    "allow_anonymous": False,
     "max_client_id_size": 100,
     "persistent_client_expiration": 0,  # seconds; 0 = never expire
     "max_inflight_messages": 20,
@@ -70,6 +73,8 @@ DEFAULTS: Dict[str, Any] = {
     # storage
     "message_store": "memory",  # memory | file | native (C++ engine)
     "message_store_dir": "./data/msgstore",
+    # engines hashed by msg-ref; reference runs 12 (vmq_lvldb_store_sup.erl)
+    "msg_store_instances": 12,
     "metadata_dir": "./data/meta",
     "metadata_persistence": False,  # durable subscriber-db/retain via kvstore
     # metadata backend: "lww" (plumtree-flavored) | "swc" (server-wide
@@ -89,6 +94,11 @@ DEFAULTS: Dict[str, Any] = {
     "crl_refresh_interval": 60.0,  # seconds (vmq_crl_srv schema knob)
     "swc_replication_groups": 8,  # reference runs 10 (vmq_swc_plugin.erl:36-44)
     "swc_sync_interval": 2.0,  # seconds between AE rounds (sync_interval)
+    # structured keys filled by the conf-file loader (broker/conf.py):
+    # listeners started at boot (vmq_ranch_config listener tree) and
+    # plugins enabled at boot (plugins.<name> = on)
+    "listeners": [],  # [{kind, name, addr, port, opts}]
+    "plugins": [],    # [{name, opts}]
 }
 
 
@@ -128,6 +138,13 @@ class Config:
         self._values[key] = value
         for fn in self._listeners:
             fn(key, value)
+
+    @classmethod
+    def from_file(cls, path: str) -> "Config":
+        """Boot-from-conf-file entry point (the vernemq.conf layer)."""
+        from .conf import load_conf_file
+
+        return load_conf_file(path)
 
     def on_change(self, fn: Callable[[str, Any], None]) -> None:
         self._listeners.append(fn)

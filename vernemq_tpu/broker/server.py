@@ -279,10 +279,16 @@ def main() -> None:  # pragma: no cover
     import argparse
 
     parser = argparse.ArgumentParser(description="vernemq_tpu broker")
+    parser.add_argument("--conf", default=None, metavar="PATH",
+                        help="vernemq.conf-style config file (broker/conf.py)")
+    parser.add_argument("--allow-anonymous", action="store_true",
+                        help="accept connects without an auth plugin "
+                             "(allow_anonymous=on)")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=1883)
-    parser.add_argument("--reg-view", default="trie", choices=["trie", "tpu"],
-                        help="subscription matcher (the default_reg_view seam)")
+    parser.add_argument("--reg-view", default=None, choices=["trie", "tpu"],
+                        help="subscription matcher (the default_reg_view "
+                             "seam); overrides --conf when given")
     parser.add_argument("--jax-platform", default=None,
                         help="force the JAX backend (e.g. cpu); note this "
                              "image's jax ignores the JAX_PLATFORMS env var — "
@@ -310,7 +316,11 @@ def main() -> None:  # pragma: no cover
     async def _run():
         from .config import Config
 
-        cfg = Config(default_reg_view=args.reg_view)
+        cfg = Config.from_file(args.conf) if args.conf else Config()
+        if args.reg_view:
+            cfg.set("default_reg_view", args.reg_view)
+        if args.allow_anonymous:
+            cfg.set("allow_anonymous", True)
         if args.http_port is not None:
             cfg.set("http_enabled", True)
             cfg.set("http_port", args.http_port)

@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 from ..broker.message import Msg, SubscriberId
@@ -85,6 +86,29 @@ class MemoryMsgStore(MsgStore):
                 "stored_refs": sum(len(v) for v in self._idx.values())}
 
 
+class SeqCounter:
+    """Monotonic enqueue-order counter, shareable across store instances so
+    a bucketed store's per-subscriber recovery merge preserves global
+    arrival order."""
+
+    __slots__ = ("_next", "_lock")
+
+    def __init__(self) -> None:
+        self._next = 1
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            n = self._next
+            self._next += 1
+            return n
+
+    def bump(self, seen: int) -> None:
+        with self._lock:
+            if seen >= self._next:
+                self._next = seen + 1
+
+
 class NativeMsgStore(MsgStore):
     """C++ storage-engine-backed store with the reference's 3-key-family
     layout (``vmq_lvldb_store.erl:339-416``):
@@ -96,9 +120,13 @@ class NativeMsgStore(MsgStore):
     Payloads are deduplicated across subscribers via an in-memory refcount
     rebuilt from the ``r`` family on open; unreferenced payloads are
     garbage-collected by a startup scan (``vmq_lvldb_store.erl:418-453``).
+
+    Thread-safety: one lock per store instance around the host-side maps
+    (the C++ engine has its own per-instance mutex) — the analog of the
+    reference's one gen_server per bucket serializing that bucket's ops.
     """
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, seq: Optional[SeqCounter] = None):
         import time as _time
 
         from ..cluster.codec import decode, encode
@@ -125,7 +153,8 @@ class NativeMsgStore(MsgStore):
         # refcount + sid→ref→[seq] maps, rebuilt from the r/i families
         self._refcount: Dict[bytes, int] = {}
         self._seqs: Dict[SubscriberId, Dict[bytes, List[int]]] = {}
-        self._next_seq = 1
+        self._seq = seq or SeqCounter()
+        self._lock = threading.Lock()
         self._recover()
 
     @staticmethod
@@ -154,7 +183,7 @@ class NativeMsgStore(MsgStore):
             sid, seq_b = self._parse_sid(key[2:])
             seq = int.from_bytes(seq_b, "big")
             self._seqs.setdefault(sid, {}).setdefault(ref, []).append(seq)
-            self._next_seq = max(self._next_seq, seq + 1)
+            self._seq.bump(seq)
             live_refs[ref] = live_refs.get(ref, 0) + 1
         self._refcount = live_refs
         for key in self._kv.scan_keys(b"r\x00"):
@@ -168,47 +197,57 @@ class NativeMsgStore(MsgStore):
                 self._kv.delete(key)
 
     def write(self, sid: SubscriberId, msg: Msg) -> None:
-        ref = msg.msg_ref
-        if ref not in self._refcount:
-            self._kv.put(b"m\x00" + ref, self._enc(msg))
-            self._refcount[ref] = 0
-        self._refcount[ref] += 1
-        sk = self._sid_key(sid)
-        seq = self._next_seq
-        self._next_seq += 1
-        self._kv.put(b"r\x00" + sk + ref, b"")
-        self._kv.put(b"i\x00" + sk + seq.to_bytes(8, "big"), ref)
-        self._seqs.setdefault(sid, {}).setdefault(ref, []).append(seq)
+        with self._lock:
+            ref = msg.msg_ref
+            if ref not in self._refcount:
+                self._kv.put(b"m\x00" + ref, self._enc(msg))
+                self._refcount[ref] = 0
+            self._refcount[ref] += 1
+            sk = self._sid_key(sid)
+            seq = self._seq.next()
+            self._kv.put(b"r\x00" + sk + ref, b"")
+            self._kv.put(b"i\x00" + sk + seq.to_bytes(8, "big"), ref)
+            self._seqs.setdefault(sid, {}).setdefault(ref, []).append(seq)
 
     def read_all(self, sid: SubscriberId) -> List[Msg]:
-        out: List[Msg] = []
-        for _, ref in self._kv.scan(b"i\x00" + self._sid_key(sid)):
-            data = self._kv.get(b"m\x00" + ref)
-            if data is not None:
-                out.append(self._dec(data))
+        return [m for _, m in self.read_all_seq(sid)]
+
+    def read_all_seq(self, sid: SubscriberId) -> List[Tuple[int, Msg]]:
+        """(enqueue-seq, msg) pairs in seq order — the merge key for a
+        bucketed store's cross-instance recovery (the reference's ordset
+        union in msg_store_collect, vmq_lvldb_store.erl:104-107)."""
+        out: List[Tuple[int, Msg]] = []
+        with self._lock:
+            for key, ref in self._kv.scan(b"i\x00" + self._sid_key(sid)):
+                data = self._kv.get(b"m\x00" + ref)
+                if data is not None:
+                    out.append((int.from_bytes(key[-8:], "big"),
+                                self._dec(data)))
         return out
 
     def delete(self, sid: SubscriberId, msg_ref: bytes) -> None:
-        seqs = self._seqs.get(sid, {}).get(msg_ref)
-        if not seqs:
-            return
-        seq = seqs.pop(0)
-        if not seqs:
-            self._seqs[sid].pop(msg_ref, None)
-        sk = self._sid_key(sid)
-        self._kv.delete(b"i\x00" + sk + seq.to_bytes(8, "big"))
-        if not self._seqs.get(sid, {}).get(msg_ref):
-            self._kv.delete(b"r\x00" + sk + msg_ref)
-        self._deref(msg_ref)
+        with self._lock:
+            seqs = self._seqs.get(sid, {}).get(msg_ref)
+            if not seqs:
+                return
+            seq = seqs.pop(0)
+            if not seqs:
+                self._seqs[sid].pop(msg_ref, None)
+            sk = self._sid_key(sid)
+            self._kv.delete(b"i\x00" + sk + seq.to_bytes(8, "big"))
+            if not self._seqs.get(sid, {}).get(msg_ref):
+                self._kv.delete(b"r\x00" + sk + msg_ref)
+            self._deref(msg_ref)
 
     def delete_all(self, sid: SubscriberId) -> None:
-        sk = self._sid_key(sid)
-        for key, ref in self._kv.scan(b"i\x00" + sk):
-            self._kv.delete(key)
-            self._deref(ref)
-        for key, _ in self._kv.scan(b"r\x00" + sk):
-            self._kv.delete(key)
-        self._seqs.pop(sid, None)
+        with self._lock:
+            sk = self._sid_key(sid)
+            for key, ref in self._kv.scan(b"i\x00" + sk):
+                self._kv.delete(key)
+                self._deref(ref)
+            for key, _ in self._kv.scan(b"r\x00" + sk):
+                self._kv.delete(key)
+            self._seqs.pop(sid, None)
 
     def _deref(self, ref: bytes) -> None:
         n = self._refcount.get(ref, 0) - 1
@@ -294,3 +333,64 @@ class FileMsgStore(MemoryMsgStore):
 
     def close(self) -> None:
         self._fh.close()
+
+
+class BucketedMsgStore(MsgStore):
+    """N independent store instances hashed by MsgRef — the reference's
+    bucket supervision (``vmq_lvldb_store_sup.erl:47-54``: ``phash2(Key)
+    rem NR_OF_BUCKETS``, default 12 instances) so concurrent writers hit
+    different engines/locks instead of serializing on one WAL mutex.
+
+    Per-subscriber reads fan out to every instance and merge on the shared
+    enqueue-seq (the reference's cross-bucket ordset union in
+    ``msg_store_find``, ``vmq_lvldb_store.erl:84-107``).
+    """
+
+    def __init__(self, directory: str, instances: int = 12):
+        os.makedirs(directory, exist_ok=True)
+        self._seqc = SeqCounter()
+        self.instances: List[NativeMsgStore] = []
+        try:
+            for i in range(max(1, instances)):
+                self.instances.append(NativeMsgStore(
+                    os.path.join(directory, f"bucket{i}"), seq=self._seqc))
+        except Exception:
+            for inst in self.instances:  # no half-open engines left locked
+                inst.close()
+            raise
+
+    def _bucket(self, ref: bytes) -> NativeMsgStore:
+        return self.instances[zlib.crc32(ref) % len(self.instances)]
+
+    def write(self, sid: SubscriberId, msg: Msg) -> None:
+        self._bucket(msg.msg_ref).write(sid, msg)
+
+    def read_all(self, sid: SubscriberId) -> List[Msg]:
+        merged: List[Tuple[int, Msg]] = []
+        for inst in self.instances:
+            merged.extend(inst.read_all_seq(sid))
+        merged.sort(key=lambda p: p[0])
+        return [m for _, m in merged]
+
+    def delete(self, sid: SubscriberId, msg_ref: bytes) -> None:
+        self._bucket(msg_ref).delete(sid, msg_ref)
+
+    def delete_all(self, sid: SubscriberId) -> None:
+        for inst in self.instances:
+            inst.delete_all(sid)
+
+    def stats(self) -> Dict[str, int]:
+        agg: Dict[str, int] = {}
+        for inst in self.instances:
+            for k, v in inst.stats().items():
+                agg[k] = agg.get(k, 0) + v
+        agg["instances"] = len(self.instances)
+        return agg
+
+    def sync(self) -> None:
+        for inst in self.instances:
+            inst.sync()
+
+    def close(self) -> None:
+        for inst in self.instances:
+            inst.close()
