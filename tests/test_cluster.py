@@ -472,3 +472,111 @@ async def test_drain_retry_is_bounded_and_surfaced():
         assert a.broker.metrics.value("queue_drain_failed") >= 1
     finally:
         await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_migration_zero_loss_mid_drain():
+    """A QoS1 message racing into the queue DURING the drain follows the
+    migration instead of being dropped (drain({enqueue,..}) inserts and
+    re-fires drain_start, vmq_queue.erl:383-390)."""
+    from vernemq_tpu.broker.message import Msg
+
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        c1 = await connected(a, "zmig", clean_start=False)
+        await c1.subscribe("z/#", qos=1)
+        await c1.disconnect()
+        pub = await connected(b, "zmig-pub")
+        for i in range(3):
+            await pub.publish("z/%d" % i, b"pre%d" % i, qos=1)
+        await wait_until(
+            lambda: (q := a.broker.registry.queues.get(("", "zmig")))
+            is not None and len(q.offline) == 3)
+        q = a.broker.registry.queues[("", "zmig")]
+
+        # wrap node a's remote_enqueue: the FIRST drain chunk triggers an
+        # in-flight publish racing into the draining queue
+        orig = a.broker.cluster.remote_enqueue
+        raced = []
+
+        async def racing_enqueue(node, sid, msgs):
+            if not raced:
+                raced.append(True)
+                assert q.state == "drain"
+                q.enqueue(Msg(topic=("z", "race"), payload=b"mid-drain",
+                              qos=1, mountpoint=""))
+            return await orig(node, sid, msgs)
+
+        a.broker.cluster.remote_enqueue = racing_enqueue
+        c2 = await connected(b, "zmig", clean_start=False)
+        assert c2.connack.session_present is True
+        got = sorted([(await c2.recv()).payload for _ in range(4)])
+        assert got == [b"mid-drain", b"pre0", b"pre1", b"pre2"]
+        assert a.broker.metrics.value("queue_message_drop") == 0
+        await c2.disconnect()
+        await pub.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_concurrent_same_clientid_register_serialized():
+    """Two nodes registering the same ClientId at once: RegSync serializes
+    them cluster-wide (vmq_reg.erl:115-126 via vmq_reg_sync) — exactly one
+    node ends up owning the record, the loser's queue is gone/migrated,
+    and the losing live session is taken over."""
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        ca = MQTTClient(*a.addr, client_id="dup", clean_start=False)
+        cb = MQTTClient(*b.addr, client_id="dup", clean_start=False)
+        acks = await asyncio.gather(ca.connect(), cb.connect())
+        assert [k.rc for k in acks] == [0, 0]
+        # records converge on ONE owner on both nodes
+        await wait_until(lambda: (
+            (ra := a.broker.registry.db.read(("", "dup"))) is not None
+            and (rb := b.broker.registry.db.read(("", "dup"))) is not None
+            and ra.node == rb.node))
+        owner = a.broker.registry.db.read(("", "dup")).node
+        loser = b if owner == "node0" else a
+        winner = a if owner == "node0" else b
+        # loser's queue drained away + its session taken over
+        await wait_until(lambda: ("", "dup") not in loser.broker.registry.queues)
+        assert ("", "dup") in winner.broker.registry.queues
+        await wait_until(lambda: ("", "dup") not in loser.broker.sessions)
+        assert ("", "dup") in winner.broker.sessions
+        for c in (ca, cb):
+            try:
+                await c.close()
+            except Exception:
+                pass
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_reg_sync_lock_serializes_actions():
+    """Direct RegSync property check: two nodes' actions on one key run
+    strictly one-at-a-time, FIFO, across the framed channel."""
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        running, order = [], []
+
+        def action(tag):
+            def _do():
+                assert not running, "lock violated: overlapping actions"
+                running.append(tag)
+                order.append(tag)
+                running.clear()
+            return _do
+
+        await asyncio.gather(
+            a.cluster.reg_sync.sync(("", "k1"), action("a1")),
+            b.cluster.reg_sync.sync(("", "k1"), action("b1")),
+            a.cluster.reg_sync.sync(("", "k1"), action("a2")),
+        )
+        assert sorted(order) == ["a1", "a2", "b1"]
+    finally:
+        await stop_cluster(nodes)

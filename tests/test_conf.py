@@ -79,6 +79,10 @@ def test_parse_errors():
         parse_conf("listener.quic.default = 1.2.3.4:1")
     with pytest.raises(ConfError):
         parse_conf("allow_anonymous")
+    with pytest.raises(ConfError):
+        parse_conf("plugins = vmq_passwd")
+    with pytest.raises(ConfError):
+        parse_conf("listeners = foo")
 
 
 def test_metadata_plugin_alias():

@@ -291,3 +291,77 @@ async def test_churney_self_test():
     finally:
         await b.stop()
         await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_slow_consumer_backpressure_no_drops():
+    """Queue→session flow control (vmq_queue.erl:752-774 active/notify):
+    a consumer past its inflight window gets messages PARKED — session
+    pending first, then queue backlog — not dropped; acks drain both."""
+    from vernemq_tpu.protocol.types import Puback
+
+    b, s = await boot(max_inflight_messages=2, max_online_messages=5)
+    try:
+        sub, _ = await connected(s, "slow", clean_start=False)
+        sub._auto_ack = False
+        await sub.subscribe("bp/t", qos=1)
+        pub, _ = await connected(s, "fast")
+        N = 12  # 2 inflight + 5 session-pending + 5 queue-backlog
+        for i in range(N):
+            await pub.publish("bp/t", b"m%d" % i, qos=1)
+        await asyncio.sleep(0.2)
+        q = b.registry.queues[("", "slow")]
+        sess = b.sessions[("", "slow")]
+        assert len(sess.waiting_acks) == 2
+        assert len(sess.pending) == 5
+        assert len(q.backlog) == 5
+        assert b.metrics.value("queue_message_drop") == 0
+
+        # one more goes past every window: dropped with accounting
+        await pub.publish("bp/t", b"overflow", qos=1)
+        await asyncio.sleep(0.1)
+        assert b.metrics.value("queue_message_drop") == 1
+
+        # ack everything as it arrives: the whole parked backlog drains
+        got = []
+        for _ in range(N):
+            m = await sub.recv()
+            got.append(m.payload)
+            sub._send(Puback(packet_id=m.packet_id))
+        assert got == [b"m%d" % i for i in range(N)]  # in order, no loss
+        assert len(q.backlog) == 0 and len(sess.pending) == 0
+        await sub.disconnect()
+        await pub.disconnect()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_backlog_survives_session_detach():
+    """Backpressure backlog moves to the offline queue when the session
+    detaches (insert_from_session, vmq_queue.erl:867-881)."""
+    b, s = await boot(max_inflight_messages=1, max_online_messages=50)
+    try:
+        sub, _ = await connected(s, "bs", clean_start=False)
+        sub._auto_ack = False
+        await sub.subscribe("bs/t", qos=1)
+        pub, _ = await connected(s, "bp")
+        for i in range(5):
+            await pub.publish("bs/t", b"x%d" % i, qos=1)
+        await asyncio.sleep(0.2)
+        await sub.close()  # drop the connection, session detaches
+        await asyncio.sleep(0.2)
+        q = b.registry.queues[("", "bs")]
+        # 1 inflight (redelivered later) + pending + backlog all parked
+        assert q.state == "offline"
+        assert len(q.offline) == 5
+        sub2, ack = await connected(s, "bs", clean_start=False)
+        assert ack.session_present is True
+        got = sorted([(await sub2.recv()).payload for _ in range(5)])
+        assert got == [b"x%d" % i for i in range(5)]
+        await sub2.disconnect()
+        await pub.disconnect()
+    finally:
+        await b.stop()
+        await s.stop()

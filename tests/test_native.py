@@ -381,3 +381,27 @@ def test_bucketed_msg_store_concurrent_stress(tmp_path):
         assert [m.payload for m in got] == \
             [f"{w}:{i}".encode() for i in range(NMSG)]
     store.close()
+
+
+def test_bucketed_store_instance_count_persisted(tmp_path):
+    """The bucket count is on-disk layout: reopening with a different
+    configured count must honour what wrote the data (else deletes route
+    to the wrong bucket and acked messages redeliver forever)."""
+    from vernemq_tpu.broker.message import Msg
+    from vernemq_tpu.storage.msg_store import BucketedMsgStore
+
+    sid = ("", "c")
+    st = BucketedMsgStore(str(tmp_path), instances=4)
+    msgs = [Msg(topic=("t", str(i)), payload=b"p%d" % i, qos=1)
+            for i in range(10)]
+    for m in msgs:
+        st.write(sid, m)
+    st.close()
+
+    st2 = BucketedMsgStore(str(tmp_path), instances=2)  # config changed
+    assert len(st2.instances) == 4  # persisted layout wins
+    for m in msgs:
+        st2.delete(sid, m.msg_ref)  # routes to the RIGHT buckets
+    assert st2.read_all(sid) == []
+    assert st2.stats()["stored_messages"] == 0
+    st2.close()

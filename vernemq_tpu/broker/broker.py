@@ -205,6 +205,14 @@ class Broker:
                     sent = i + step
                     state["pending"] = len(backlog) - sent
             if ok:
+                # messages that raced in mid-drain follow the migration
+                # (drain({enqueue,..}) re-fires drain_start,
+                # vmq_queue.erl:383-390): keep pulling until dry
+                more = queue.drain_pending()
+                if more:
+                    backlog = more
+                    state["pending"] = len(backlog)
+                    continue
                 self.delete_offline(sid)
                 self.metrics.incr("queue_migrated")
                 # clean_session stays False: queue_terminated must NOT delete

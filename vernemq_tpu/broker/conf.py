@@ -176,6 +176,12 @@ def parse_conf(text: str) -> Dict[str, Any]:
             settings[key] = impl
             continue
 
+        if key in ("plugins", "listeners"):
+            # loader-internal structured keys — only the dotted forms
+            # (plugins.<name>, listener.<kind>.<name>) are valid conf lines
+            raise ConfError(lineno, line,
+                            f"'{key}' is not settable directly; use "
+                            f"{'plugins.<name> = on' if key == 'plugins' else 'listener.<kind>.<name> = ip:port'}")
         if key not in DEFAULTS:
             raise ConfError(lineno, line, f"unknown config key {key}")
         settings[key] = _coerce(key, value, lineno, line)

@@ -13,10 +13,14 @@ timer (``vmq_queue.erl:913-930``); lifecycle hooks ``on_client_wakeup`` /
 ``on_message_drop`` (``vmq_queue.erl:614,658-700,1059-1070``).
 
 The reference's active/passive/notify backpressure protocol between queue
-and session process collapses here: sessions are asyncio tasks in the same
-loop, so delivery is a direct callback into the session, which applies its
-own inflight window; overflow beyond ``max_online_messages`` is dropped with
-accounting like the reference's online-queue cap.
+and session process (``vmq_queue.erl:752-774``, ``vmq_mqtt_fsm.erl:264-293``)
+collapses here to a two-level window: the session holds an inflight window
+plus a ``pending`` list; when every attached session refuses a message the
+queue keeps it in its own ``backlog`` (the passive-state queue) and the
+session pulls it back via :meth:`SubscriberQueue.notify_ready` once acks
+free its window (the notify→active transition). Only past
+``max_online_messages`` of queue-level backlog do messages drop, with
+accounting — matching the reference's online-queue cap.
 """
 
 from __future__ import annotations
@@ -81,6 +85,10 @@ class SubscriberQueue:
         self.sessions: Dict[object, Callable[[Msg], bool]] = {}
         self._rr: int = 0  # round-robin cursor for balance mode
         self.offline: Deque[Msg] = deque()
+        # online backpressure backlog: messages every session refused
+        # (windows full) parked until notify_ready — the passive-state
+        # per-session queue of the reference (vmq_queue.erl:752-774)
+        self.backlog: Deque[Msg] = deque()
         self._expiry_task: Optional[asyncio.Task] = None
         self.created = time.time()
 
@@ -117,19 +125,37 @@ class SubscriberQueue:
             self.terminate("normal")
         else:
             self.state = OFFLINE
+            # park the backpressure backlog offline (insert_from_session,
+            # vmq_queue.erl:867-881: undelivered messages survive the session)
+            backlog, self.backlog = self.backlog, deque()
+            for msg in backlog:
+                self._enqueue_offline(msg)
             self.broker.hooks_fire_all("on_client_offline", self.subscriber_id)
             self._arm_expiry()
 
     def start_drain(self) -> List[Msg]:
         """Enter the drain state and hand the offline backlog to the
         migration driver (vmq_queue drain state, vmq_queue.erl:338-400).
-        New enqueues during drain are dropped with accounting."""
+        Enqueues arriving mid-drain are queued (drain({enqueue,..})
+        inserts, vmq_queue.erl:383-390) and picked up by
+        :meth:`drain_pending` — never dropped."""
         self.state = DRAIN
         self._cancel_expiry()
-        backlog = [m for m in self.offline
-                   if m.expires_at is None or m.expires_at >= time.monotonic()]
+        backlog = list(self.backlog)
+        self.backlog.clear()
+        backlog += list(self.offline)
         self.offline.clear()
-        return backlog
+        return [m for m in backlog
+                if m.expires_at is None or m.expires_at >= time.monotonic()]
+
+    def drain_pending(self) -> List[Msg]:
+        """Messages that raced into the queue after start_drain — the
+        migration driver keeps draining until this runs dry (the reference
+        re-fires drain_start on every mid-drain enqueue)."""
+        more = [m for m in self.offline
+                if m.expires_at is None or m.expires_at >= time.monotonic()]
+        self.offline.clear()
+        return more
 
     def terminate(self, reason: str) -> None:
         if self.state == TERMINATED:
@@ -139,6 +165,9 @@ class SubscriberQueue:
         for msg in self.offline:
             self._drop(msg)
         self.offline.clear()
+        for msg in self.backlog:
+            self._drop(msg)
+        self.backlog.clear()
         self.broker.registry.queue_terminated(self.subscriber_id)
         self.broker.hooks_fire_all("on_client_gone", self.subscriber_id)
         self.broker.metrics.incr("queue_teardown")
@@ -153,9 +182,18 @@ class SubscriberQueue:
 
         async def _expire():
             await asyncio.sleep(expiry)
-            if self.state == OFFLINE:
-                self.broker.metrics.incr("client_expired")
-                self.broker.registry.cleanup_subscriber(self.subscriber_id)
+            while self.state == OFFLINE:
+                try:
+                    # serialized: expiry racing a re-register on another
+                    # node must not delete the record it just claimed
+                    await self.broker.registry.cleanup_subscriber_synced(
+                        self.subscriber_id)
+                    self.broker.metrics.incr("client_expired")
+                    return
+                except RuntimeError:
+                    # coordinator unreachable (netsplit): retry — an
+                    # expired client must eventually be cleaned, not leak
+                    await asyncio.sleep(5.0)
 
         self._expiry_task = loop.create_task(_expire())
 
@@ -173,13 +211,25 @@ class SubscriberQueue:
             self._deliver_online(msg)
         elif self.state == OFFLINE:
             self._enqueue_offline(msg)
-        else:  # drain/terminated: drop with accounting
+        elif self.state == DRAIN:
+            # mid-drain arrival: queue it so the drain forwards it to the
+            # new node (vmq_queue.erl:383-390) — dropping here was the
+            # migration message-loss window. Goes through the normal
+            # offline path: caps apply and the message is persisted in
+            # case the broker dies mid-migration.
+            self._enqueue_offline(msg)
+        else:  # terminated: drop with accounting
             self._drop(msg)
 
     def _deliver_online(self, msg: Msg) -> None:
         if not self.sessions:
             self._enqueue_offline(msg)
             return
+        if not self._try_sessions(msg):
+            self._backpressure(msg)
+
+    def _try_sessions(self, msg: Msg) -> bool:
+        """Offer to the attached session(s); True iff someone took it."""
         if self.opts.deliver_mode == "balance" and len(self.sessions) > 1:
             # balance: one session per message, round-robin (the reference
             # picks randomly, vmq_queue.erl:826-835 — RR gives fairer tests)
@@ -188,16 +238,36 @@ class SubscriberQueue:
             ok = handlers[self._rr](msg)
             if ok:
                 self.broker.metrics.incr("queue_message_out")
-            else:
-                self._drop(msg)
-        else:  # fanout
-            delivered = False
-            for deliver in list(self.sessions.values()):
-                if deliver(msg):
-                    delivered = True
-                    self.broker.metrics.incr("queue_message_out")
-            if not delivered:
-                self._drop(msg)
+            return ok
+        delivered = False
+        for deliver in list(self.sessions.values()):
+            if deliver(msg):
+                delivered = True
+                self.broker.metrics.incr("queue_message_out")
+        return delivered
+
+    def _backpressure(self, msg: Msg) -> None:
+        """Every session refused (inflight + pending windows full): park in
+        the queue-level backlog instead of dropping; cap + drop policy as
+        the reference's online-queue cap (vmq_queue.erl:845-865)."""
+        cap = self.opts.max_online_messages
+        if cap > 0 and len(self.backlog) >= cap:
+            if self.opts.queue_type == "fifo":
+                self._drop(msg)  # tail-drop the new message
+                return
+            self._drop(self.backlog.popleft())  # lifo: oldest makes room
+        self.backlog.append(msg)
+
+    def notify_ready(self, session: object) -> None:
+        """A session's window freed up (the notify→active transition,
+        vmq_mqtt_fsm.erl:264-293): replay the parked backlog in arrival
+        order until it refuses again. Peek-then-pop: a refused head must
+        stay at the FRONT or same-subscriber delivery reorders
+        (MQTT-4.6.0)."""
+        while self.backlog and self.state == ONLINE and self.sessions:
+            if not self._try_sessions(self.backlog[0]):
+                break
+            self.backlog.popleft()
 
     def _enqueue_offline(self, msg: Msg) -> None:
         if self.opts.clean_session:
@@ -230,6 +300,7 @@ class SubscriberQueue:
             "state": self.state,
             "sessions": len(self.sessions),
             "offline_messages": len(self.offline),
+            "backlog_messages": len(self.backlog),
             "clean_session": self.opts.clean_session,
             "deliver_mode": self.opts.deliver_mode,
             "started": self.created,

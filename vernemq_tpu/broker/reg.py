@@ -159,6 +159,17 @@ class Registry:
             rec.clean_session = queue_opts.clean_session
             rec.queue_opts = _qopts_to_dict(queue_opts)
             self.db.store(sid, rec)
+        elif rec is None:
+            # persist an empty record immediately: every node must learn who
+            # owns this ClientId's queue even before the first SUBSCRIBE
+            # (maybe_remap_subscriber stores {Node, CleanSession, []},
+            # vmq_reg.erl:676-699) — this is what a concurrent register on
+            # another node races against
+            from .subscriber_db import SubscriberRecord
+
+            self.db.store(sid, SubscriberRecord(
+                self.node_name, queue_opts.clean_session,
+                queue_opts=_qopts_to_dict(queue_opts)))
         if existing is not None:
             existing.opts = queue_opts
             return existing, session_present
@@ -166,6 +177,39 @@ class Registry:
         if session_present:
             self.broker.recover_offline(sid, queue)
         return queue, session_present
+
+    async def register_subscriber_synced(
+        self, sid: SubscriberId, clean_start: bool, queue_opts: QueueOpts
+    ) -> Tuple[SubscriberQueue, bool]:
+        """Cluster-serialized registration: the whole register (incl. the
+        record remap that triggers the old owner's drain) runs holding the
+        cluster-wide per-SubscriberId lock (vmq_reg.erl:115-126 running
+        register_subscriber_ via vmq_reg_sync:sync). Without it, two nodes
+        registering the same ClientId concurrently race on the subscriber
+        record. Raises RuntimeError('not_ready') like the direct path."""
+        cluster = self.broker.cluster
+        if cluster is None or not self.broker.config.coordinate_registrations:
+            return self.register_subscriber(sid, clean_start, queue_opts)
+        return await cluster.reg_sync.sync(
+            sid,
+            lambda: self.register_subscriber(sid, clean_start, queue_opts))
+
+    async def cleanup_subscriber_synced(self, sid: SubscriberId) -> None:
+        """Serialized cleanup (the vmq_reg_sync 'cleanup' action): session
+        expiry racing a concurrent re-register on another node must not
+        delete the record the other node just claimed."""
+        cluster = self.broker.cluster
+        if cluster is None or not self.broker.config.coordinate_registrations:
+            self.cleanup_subscriber(sid)
+            return
+
+        def _do() -> None:
+            rec = self.db.read(sid)
+            if rec is not None and rec.node != self.node_name:
+                return  # another node owns it now; nothing to clean here
+            self.cleanup_subscriber(sid)
+
+        await cluster.reg_sync.sync(sid, _do)
 
     def _start_queue(self, sid: SubscriberId, opts: QueueOpts) -> SubscriberQueue:
         queue = SubscriberQueue(self.broker, sid, opts)

@@ -282,9 +282,12 @@ class Session:
                 qopts.session_expiry = max(qopts.session_expiry,
                                            shared.opts.session_expiry)
         try:
-            self.queue, session_present = self.broker.registry.register_subscriber(
-                self.sid, self.clean_start and not multi, qopts
-            )
+            # cluster-serialized per-SubscriberId (vmq_reg.erl:115-126 via
+            # vmq_reg_sync); degrades to the direct call single-node
+            self.queue, session_present = \
+                await self.broker.registry.register_subscriber_synced(
+                    self.sid, self.clean_start and not multi, qopts
+                )
         except RuntimeError:
             # netsplit CAP gate (vmq_reg.erl:65-70): CONNACK server
             # unavailable instead of dropping the socket
@@ -651,6 +654,11 @@ class Session:
             self.waiting_acks[pid] = ["puback" if msg.qos == 1 else "pubrec",
                                       msg, time.monotonic(), False]
             self._send_publish(msg, pid)
+        # session window freed and nothing pending here: pull messages the
+        # queue parked under backpressure (notify→active transition)
+        if (not self.pending and self.queue is not None
+                and len(self.waiting_acks) < window):
+            self.queue.notify_ready(self)
 
     def _handle_puback(self, f: Puback) -> None:
         entry = self.waiting_acks.get(f.packet_id)

@@ -128,6 +128,15 @@ class ClusterCom:
         elif cmd == b"swr":
             ref_id, ok, result = term
             cluster.resolve_swc(ref_id, ok, result)
+        elif cmd == b"syq":
+            # reg_sync acquire request: this node coordinates `key`
+            ref_id, key, lease = term
+            cluster.reg_sync.handle_acquire(origin, ref_id,
+                                            codec.dekey(key), lease)
+        elif cmd == b"syg":
+            cluster.reg_sync.on_grant(term)  # term = ref_id
+        elif cmd == b"syr":
+            cluster.reg_sync.handle_release(origin, codec.dekey(term))
         elif cmd == b"hlo":
             cluster.on_hello(origin, term)
         elif cmd == b"png":

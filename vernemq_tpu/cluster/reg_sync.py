@@ -72,7 +72,7 @@ class RegSync:
             return res
         finally:
             if node == me:
-                self.release(key, me)
+                self.handle_release(me, key)
             else:
                 self.cluster.sync_release(node, key)
 

@@ -348,6 +348,23 @@ class BucketedMsgStore(MsgStore):
 
     def __init__(self, directory: str, instances: int = 12):
         os.makedirs(directory, exist_ok=True)
+        # the bucket count is part of the on-disk layout: ref→bucket hashing
+        # must match what wrote the data, or deletes silently miss. Persist
+        # it on first open and honour the persisted value thereafter.
+        marker = os.path.join(directory, "INSTANCES")
+        if os.path.exists(marker):
+            with open(marker, "r", encoding="ascii") as fh:
+                persisted = int(fh.read().strip())
+            if persisted != instances:
+                import logging
+
+                logging.getLogger("vernemq_tpu.storage").warning(
+                    "msg store in %s was created with %d instances; "
+                    "ignoring configured %d", directory, persisted, instances)
+            instances = persisted
+        else:
+            with open(marker, "w", encoding="ascii") as fh:
+                fh.write(str(max(1, instances)))
         self._seqc = SeqCounter()
         self.instances: List[NativeMsgStore] = []
         try:
