@@ -1,0 +1,240 @@
+// bcrypt password hashing — the vmq_diversity bcrypt seat
+// (vmq_diversity_bcrypt.erl / erlang-bcrypt C port in the reference).
+//
+// OpenBSD-style $2b$ (and $2a$-compatible) crypt: EksBlowfish with the
+// password+NUL as key (72-byte cap), cost = log2 rounds, 16-byte salt,
+// "OrpheanBeholderScryDoubt" encrypted 64 times, custom base64 output.
+// Blowfish initial state comes from blowfish_tables.h (generated from pi
+// by tools/gen_blowfish_tables.py — no pasted magic tables).
+//
+// C ABI (ctypes): vmq_bcrypt_hash / vmq_bcrypt_gensalt return 0 on ok.
+
+#include <cstdint>
+#include <cstring>
+
+#include "blowfish_tables.h"
+
+namespace {
+
+struct BlowfishState {
+    uint32_t P[18];
+    uint32_t S[4][256];
+};
+
+inline uint32_t bf_f(const BlowfishState& st, uint32_t x) {
+    return ((st.S[0][(x >> 24) & 0xFF] + st.S[1][(x >> 16) & 0xFF]) ^
+            st.S[2][(x >> 8) & 0xFF]) +
+           st.S[3][x & 0xFF];
+}
+
+void bf_encrypt(const BlowfishState& st, uint32_t& l, uint32_t& r) {
+    for (int i = 0; i < 16; i += 2) {
+        l ^= st.P[i];
+        r ^= bf_f(st, l);
+        r ^= st.P[i + 1];
+        l ^= bf_f(st, r);
+    }
+    l ^= st.P[16];
+    r ^= st.P[17];
+    uint32_t t = l;
+    l = r;
+    r = t;
+}
+
+// cyclic big-endian 32-bit word reader over a byte buffer
+struct Cyclic {
+    const uint8_t* buf;
+    size_t len;
+    size_t pos = 0;
+    uint32_t next32() {
+        uint32_t w = 0;
+        for (int i = 0; i < 4; i++) {
+            w = (w << 8) | buf[pos];
+            pos = (pos + 1) % len;
+        }
+        return w;
+    }
+};
+
+// ExpandKey(state, salt, key) — bcrypt's extended Blowfish key schedule.
+// With a zero salt this is the classic Blowfish schedule.
+void expand_key(BlowfishState& st, const uint8_t* salt16, const uint8_t* key,
+                size_t keylen) {
+    Cyclic kc{key, keylen};
+    for (int i = 0; i < 18; i++) st.P[i] ^= kc.next32();
+    uint32_t l = 0, r = 0;
+    Cyclic sc{salt16, 16};
+    auto mix = [&](uint32_t& a, uint32_t& b) {
+        if (salt16 != nullptr) {
+            a ^= sc.next32();
+            b ^= sc.next32();
+        }
+        bf_encrypt(st, a, b);
+    };
+    for (int i = 0; i < 18; i += 2) {
+        mix(l, r);
+        st.P[i] = l;
+        st.P[i + 1] = r;
+    }
+    for (auto& box : st.S) {
+        for (int i = 0; i < 256; i += 2) {
+            mix(l, r);
+            box[i] = l;
+            box[i + 1] = r;
+        }
+    }
+}
+
+void eks_setup(BlowfishState& st, int cost, const uint8_t* salt16,
+               const uint8_t* key, size_t keylen) {
+    memcpy(st.P, BF_P_INIT, sizeof(st.P));
+    memcpy(st.S, BF_S_INIT, sizeof(st.S));
+    expand_key(st, salt16, key, keylen);
+    uint64_t rounds = 1ull << cost;
+    for (uint64_t i = 0; i < rounds; i++) {
+        expand_key(st, nullptr, key, keylen);
+        expand_key(st, nullptr, salt16, 16);
+    }
+}
+
+const char B64[] =
+    "./ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789";
+
+void b64_encode(const uint8_t* data, size_t len, char* out) {
+    // bcrypt's base64 (no padding chars; trailing bits in the last symbol)
+    size_t o = 0;
+    size_t i = 0;
+    while (i < len) {
+        uint32_t c1 = data[i++];
+        out[o++] = B64[c1 >> 2];
+        c1 = (c1 & 0x03) << 4;
+        if (i >= len) {
+            out[o++] = B64[c1];
+            break;
+        }
+        uint32_t c2 = data[i++];
+        c1 |= c2 >> 4;
+        out[o++] = B64[c1];
+        c1 = (c2 & 0x0F) << 2;
+        if (i >= len) {
+            out[o++] = B64[c1];
+            break;
+        }
+        uint32_t c3 = data[i++];
+        c1 |= c3 >> 6;
+        out[o++] = B64[c1];
+        out[o++] = B64[c3 & 0x3F];
+    }
+    out[o] = '\0';
+}
+
+int b64_decode(const char* in, size_t nsyms, uint8_t* out, size_t outlen) {
+    auto val = [](char c) -> int {
+        const char* p = strchr(B64, c);
+        return p && c ? int(p - B64) : -1;
+    };
+    size_t o = 0;
+    size_t i = 0;
+    while (i < nsyms && o < outlen) {
+        int c1 = val(in[i]);
+        int c2 = i + 1 < nsyms ? val(in[i + 1]) : -1;
+        if (c1 < 0 || c2 < 0) return -1;
+        out[o++] = uint8_t((c1 << 2) | (c2 >> 4));
+        if (o >= outlen) break;
+        int c3 = i + 2 < nsyms ? val(in[i + 2]) : -1;
+        if (c3 < 0) return -1;
+        out[o++] = uint8_t(((c2 & 0x0F) << 4) | (c3 >> 2));
+        if (o >= outlen) break;
+        int c4 = i + 3 < nsyms ? val(in[i + 3]) : -1;
+        if (c4 < 0) return -1;
+        out[o++] = uint8_t(((c3 & 0x03) << 6) | c4);
+        i += 4;
+    }
+    return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// salt_or_hash: "$2b$NN$<22 chars>[...]"; out: >= 64 bytes.
+int vmq_bcrypt_hash(const char* password, const char* salt_or_hash,
+                    char* out) {
+    const char* s = salt_or_hash;
+    if (!password || !s || !out) return -1;
+    if (s[0] != '$' || s[1] != '2' ||
+        (s[2] != 'b' && s[2] != 'a' && s[2] != 'y') || s[3] != '$')
+        return -1;
+    char minor = s[2];
+    if (s[4] < '0' || s[4] > '9' || s[5] < '0' || s[5] > '9' || s[6] != '$')
+        return -1;
+    int cost = (s[4] - '0') * 10 + (s[5] - '0');
+    if (cost < 4 || cost > 31) return -1;
+    if (strlen(s + 7) < 22) return -1;
+    uint8_t salt[16];
+    if (b64_decode(s + 7, 22, salt, 16) != 0) return -1;
+
+    // key = password + NUL, capped at 72 bytes TOTAL; at >=72 password
+    // bytes the NUL is dropped, not the last password byte (OpenBSD /
+    // crypt_blowfish convention — required for hash interop)
+    size_t plen = strlen(password);
+    uint8_t key[72];
+    size_t keylen;
+    if (plen >= 72) {
+        memcpy(key, password, 72);
+        keylen = 72;
+    } else {
+        memcpy(key, password, plen);
+        key[plen] = 0;
+        keylen = plen + 1;
+    }
+
+    BlowfishState st;
+    eks_setup(st, cost, salt, key, keylen);
+
+    static const char magic[25] = "OrpheanBeholderScryDoubt";
+    uint32_t block[6];
+    for (int i = 0; i < 6; i++) {
+        block[i] = (uint32_t(uint8_t(magic[i * 4])) << 24) |
+                   (uint32_t(uint8_t(magic[i * 4 + 1])) << 16) |
+                   (uint32_t(uint8_t(magic[i * 4 + 2])) << 8) |
+                   uint32_t(uint8_t(magic[i * 4 + 3]));
+    }
+    for (int rep = 0; rep < 64; rep++)
+        for (int i = 0; i < 6; i += 2) bf_encrypt(st, block[i], block[i + 1]);
+
+    uint8_t ct[24];
+    for (int i = 0; i < 6; i++) {
+        ct[i * 4] = uint8_t(block[i] >> 24);
+        ct[i * 4 + 1] = uint8_t(block[i] >> 16);
+        ct[i * 4 + 2] = uint8_t(block[i] >> 8);
+        ct[i * 4 + 3] = uint8_t(block[i]);
+    }
+
+    out[0] = '$';
+    out[1] = '2';
+    out[2] = minor;
+    out[3] = '$';
+    out[4] = char('0' + cost / 10);
+    out[5] = char('0' + cost % 10);
+    out[6] = '$';
+    b64_encode(salt, 16, out + 7);   // 22 chars
+    b64_encode(ct, 23, out + 29);    // 31 chars (last ciphertext byte off)
+    return 0;
+}
+
+// rand16: caller-provided 16 random bytes; out: >= 30 bytes.
+int vmq_bcrypt_gensalt(int cost, const unsigned char* rand16, char* out) {
+    if (cost < 4 || cost > 31 || !rand16 || !out) return -1;
+    out[0] = '$';
+    out[1] = '2';
+    out[2] = 'b';
+    out[3] = '$';
+    out[4] = char('0' + cost / 10);
+    out[5] = char('0' + cost % 10);
+    out[6] = '$';
+    b64_encode(rand16, 16, out + 7);
+    return 0;
+}
+
+}  // extern "C"

@@ -580,3 +580,51 @@ async def test_reg_sync_lock_serializes_actions():
         assert sorted(order) == ["a1", "a2", "b1"]
     finally:
         await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_partial_ae_transfers_delta_not_state():
+    """Reconnect reconciliation is O(delta): after a partition with a few
+    writes, the digest exchange moves only mismatching buckets' entries,
+    not the full 5k-key store (VERDICT r2 item 7; the
+    vmq_swc_exchange_fsm.erl:34-116 shape)."""
+    from vernemq_tpu.cluster import codec as ccodec
+
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        # seed a large store and let it replicate
+        for i in range(5000):
+            a.broker.metadata.put("seed", ("k", i), {"v": i})
+        await wait_until(
+            lambda: sum(1 for _ in b.broker.metadata.fold("seed")) == 5000,
+            timeout=15)
+
+        partition(a, b)
+        for i in range(10):
+            a.broker.metadata.put("seed", ("k", i), {"v": i + 100000})
+        b.broker.metadata.put("seed", ("post", 1), {"v": "from-b"})
+
+        # count AE entry transfers during heal by wrapping the frames
+        moved = {"entries": 0, "full": 0}
+        for n in (a, b):
+            orig = n.cluster.send_meta_frame
+
+            def counting(node, cmd, term, _o=orig):
+                if cmd == b"dgr":
+                    moved["entries"] += len(term[1])
+                elif cmd == b"dgp":
+                    moved["entries"] += len(term)
+                return _o(node, cmd, term)
+
+            n.cluster.send_meta_frame = counting
+        heal(a, b)
+        await wait_until(
+            lambda: (b.broker.metadata.get("seed", ("k", 3)) or {}).get("v")
+            == 100003 and a.broker.metadata.get("seed", ("post", 1))
+            is not None, timeout=15)
+        # the 11 changed keys live in <= 11 buckets of 512 over 5k keys
+        # (~10 keys/bucket): far fewer entries than the full state move
+        assert 0 < moved["entries"] < 500, moved
+    finally:
+        await stop_cluster(nodes)

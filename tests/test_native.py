@@ -405,3 +405,112 @@ def test_bucketed_store_instance_count_persisted(tmp_path):
     assert st2.read_all(sid) == []
     assert st2.stats()["stored_messages"] == 0
     st2.close()
+
+
+def test_bcrypt_known_vectors():
+    """C++ bcrypt against the canonical crypt_blowfish test vectors —
+    interop with hashes produced by any other bcrypt implementation."""
+    from vernemq_tpu.native import bcrypt
+
+    if not bcrypt.available():
+        pytest.skip("no native toolchain")
+    vectors = [
+        ("U*U", "$2a$05$CCCCCCCCCCCCCCCCCCCCC.",
+         "$2a$05$CCCCCCCCCCCCCCCCCCCCC.E5YPO9kmyuRGyh0XouQYb4YMJKvyOeW"),
+        ("U*U*", "$2a$05$CCCCCCCCCCCCCCCCCCCCC.",
+         "$2a$05$CCCCCCCCCCCCCCCCCCCCC.VGOzA784oUp/Z0DY336zx7pLYAy0lwK"),
+        ("U*U*U", "$2a$05$XXXXXXXXXXXXXXXXXXXXXO",
+         "$2a$05$XXXXXXXXXXXXXXXXXXXXXOAcXxm9kjPGEMsLznoKqmqw7tc8WCx4a"),
+    ]
+    for pw, salt, want in vectors:
+        assert bcrypt.hashpw(pw, salt) == want
+        assert bcrypt.checkpw(pw, want)
+        assert not bcrypt.checkpw(pw + "x", want)
+
+
+def test_bcrypt_roundtrip_and_salt():
+    from vernemq_tpu.native import bcrypt
+
+    if not bcrypt.available():
+        pytest.skip("no native toolchain")
+    h = bcrypt.hashpw("s3cret", cost=4)
+    assert h.startswith("$2b$04$") and len(h) == 60
+    assert bcrypt.checkpw("s3cret", h)
+    assert not bcrypt.checkpw("other", h)
+    # two hashes of the same password differ (random salt)
+    assert bcrypt.hashpw("s3cret", cost=4) != h
+    with pytest.raises(ValueError):
+        bcrypt.gensalt(cost=99)
+
+
+def test_passwd_plugin_accepts_bcrypt_entries(tmp_path):
+    from vernemq_tpu.broker.plugins import OK
+    from vernemq_tpu.native import bcrypt
+    from vernemq_tpu.plugins.passwd import PasswdPlugin
+
+    if not bcrypt.available():
+        pytest.skip("no native toolchain")
+    pw_file = tmp_path / "passwd"
+    pw_file.write_text("bob:%s\n" % bcrypt.hashpw("hunter2", cost=4))
+    p = PasswdPlugin(passwd_file=str(pw_file))
+    assert p.check("bob", "hunter2") == OK
+    assert p.check("bob", "wrong") == ("error", "invalid_credentials")
+
+
+def test_scripting_bcrypt_auth(tmp_path, event_loop):
+    """Auth script verifying a bcrypt hash — the vmq_diversity pattern of
+    priv/auth/*.lua scripts checking datastore bcrypt hashes."""
+    import asyncio
+
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+    from vernemq_tpu.native import bcrypt
+
+    if not bcrypt.available():
+        pytest.skip("no native toolchain")
+    h = bcrypt.hashpw("pa55", cost=4)
+    script = tmp_path / "auth.py"
+    script.write_text(
+        "USERS = {'carol': %r}\n"
+        "def auth_on_register(peer, sid, username, password, clean_start):\n"
+        "    want = USERS.get(username)\n"
+        "    pw = password.decode() if isinstance(password, bytes) else password\n"
+        "    if want and pw and bcrypt.checkpw(pw, want):\n"
+        "        return 'ok'\n"
+        "    return ('error', 'invalid_credentials')\n" % h)
+
+    async def run():
+        b, s = await start_broker(Config(systree_enabled=False), port=0)
+        try:
+            b.plugins.enable("vmq_diversity", scripts=[str(script)])
+            good = MQTTClient(s.host, s.port, client_id="c1",
+                              username="carol", password=b"pa55")
+            assert (await good.connect()).rc == 0
+            await good.disconnect()
+            bad = MQTTClient(s.host, s.port, client_id="c2",
+                             username="carol", password=b"nope")
+            assert (await bad.connect()).rc != 0
+        finally:
+            await b.stop()
+            await s.stop()
+
+    event_loop.run_until_complete(run())
+
+
+def test_bcrypt_72_byte_key_interop():
+    """>=72-byte passwords key as the first 72 bytes with NO trailing NUL
+    (OpenBSD/crypt_blowfish convention) — canonical long-password vector."""
+    from vernemq_tpu.native import bcrypt
+
+    if not bcrypt.available():
+        pytest.skip("no native toolchain")
+    pw = ("0123456789abcdefghijklmnopqrstuvwxyz"
+          "ABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789"
+          "chars after 72 are ignored")
+    want = ("$2a$05$abcdefghijklmnopqrstuu"
+            "5s2v8.iXieOjg/.AySBTTZIIVFJeBui")
+    assert bcrypt.hashpw(pw, "$2a$05$abcdefghijklmnopqrstuu") == want
+    # chars past 72 truly ignored
+    assert bcrypt.hashpw(pw[:72] + "DIFFERENT-TAIL",
+                         "$2a$05$abcdefghijklmnopqrstuu") == want

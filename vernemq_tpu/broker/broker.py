@@ -101,6 +101,7 @@ class Broker:
         self._delayed_wills: Dict[SubscriberId, asyncio.Task] = {}
         self.tracer: Optional[Any] = None  # single active session tracer
         self.sysmon: Optional[Any] = None
+        self.supervisor: Optional[Any] = None  # crash-restart supervision
         self.crl_refresher: Optional[Any] = None
         self.http: Optional[Any] = None
         self.graphite: Optional[Any] = None
@@ -449,9 +450,13 @@ class Broker:
         for key, value in self.metadata.fold("retain"):
             self.retain.apply_remote(key[0], tuple(key[1:]),
                                      self._retain_term(value))
+        # crash-restart supervision (vmq_server_sup one_for_one analog)
+        from .supervisor import Supervisor
+
+        self.supervisor = Supervisor(self)
+        self.supervisor.watch_listeners()
         if self.config.systree_enabled:
-            self._bg_tasks.append(asyncio.get_event_loop().create_task(
-                self.start_systree()))
+            self.supervisor.spawn("systree", self.start_systree)
         if self.config.http_enabled:
             from ..admin.http import HttpServer
 
@@ -506,6 +511,8 @@ class Broker:
         # reach enabled plugins; then plugins (a bridge keeps an outbound
         # client reconnecting); listeners last — Server.wait_closed blocks
         # until every connection handler (incl. bridge links) has returned
+        if getattr(self, "supervisor", None) is not None:
+            self.supervisor.stop()
         if self.sysmon is not None:
             self.sysmon.stop()
         if self.crl_refresher is not None:

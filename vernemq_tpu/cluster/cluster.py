@@ -372,11 +372,22 @@ class Cluster:
         exchange for the SWC backend."""
         w.send_frame(frame(b"hlo", self.member_info()))
         ms = self.metadata
-        if hasattr(ms, "full_state"):
+        if hasattr(ms, "digests"):
+            # digest-based partial AE: ship the (bucket, digest) vector;
+            # the peer answers with entries of mismatching buckets only —
+            # O(delta) per reconnect, not O(state)
+            w.send_frame(frame(b"dgq", ms.digests()))
+        elif hasattr(ms, "full_state"):
             w.send_frame(frame(b"mtf", ms.full_state()))
         if hasattr(ms, "schedule_exchange") and \
                 not w.node_name.startswith("bootstrap:"):
             ms.schedule_exchange(w.node_name)
+
+    def send_meta_frame(self, node: str, cmd: bytes, term: Any) -> None:
+        """Metadata AE frame to one peer (dgr/dgp replies)."""
+        w = self._writers.get(node)
+        if w is not None:
+            w.send_frame(frame(cmd, term))
 
     def swc_send_all(self, term: Any) -> None:
         """Fire-and-forget SWC frame (object broadcast) to every peer."""

@@ -115,6 +115,32 @@ class ClusterCom:
                 if applied:
                     log.debug("anti-entropy from %s applied %d entries",
                               origin, applied)
+        elif cmd == b"dgq":
+            # partial-AE digest vector: answer with entries of buckets
+            # whose digest differs (both sides run this symmetric flow)
+            ms = cluster.metadata
+            if hasattr(ms, "diff_buckets"):
+                diff = ms.diff_buckets((b, d) for b, d in term)
+                if diff:
+                    cluster.send_meta_frame(
+                        origin, b"dgr", (diff, ms.bucket_entries(diff)))
+        elif cmd == b"dgr":
+            ms = cluster.metadata
+            if hasattr(ms, "merge_full"):
+                buckets, entries = term
+                # snapshot OUR side BEFORE merging: reciprocation must
+                # carry only entries the peer doesn't have, not echo the
+                # ones it just sent us back at it
+                ours = ms.bucket_entries(buckets)
+                applied = ms.merge_full(
+                    (p, k, tuple(e)) for p, k, e in entries)
+                log.debug("partial AE from %s: %d buckets, %d applied",
+                          origin, len(buckets), applied)
+                cluster.send_meta_frame(origin, b"dgp", ours)
+        elif cmd == b"dgp":
+            ms = cluster.metadata
+            if hasattr(ms, "merge_full"):
+                ms.merge_full((p, k, tuple(e)) for p, k, e in term)
         elif cmd == b"swb":
             if hasattr(cluster.metadata, "handle_swc_cast"):
                 cluster.metadata.handle_swc_cast(origin, term)

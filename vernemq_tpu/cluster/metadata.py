@@ -12,10 +12,18 @@ metadata backend the way ``vmq_swc`` does).
 Conflict resolution is last-writer-wins on a (lamport, origin-node) pair —
 the reference's plumtree backend resolves concurrent metadata writes LWW
 too (``vmq_plumtree.erl:91-104``).
+
+Reconnect reconciliation is DIGEST-BASED partial anti-entropy (the role of
+plumtree's AE exchange / ``vmq_swc_exchange_fsm.erl:34-116``'s
+clock-then-missing-dots shape): keys hash into ``AE_BUCKETS`` buckets whose
+XOR-of-entry-hash digests are maintained incrementally (O(1) per write);
+peers exchange the non-zero digests (~KBs) and transfer only the entries
+of mismatching buckets — O(delta) per reconnect instead of O(state).
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -24,12 +32,56 @@ from . import codec
 Key = Tuple[str, Any]  # (prefix, key)
 Entry = Tuple[int, str, Any]  # (lamport, origin_node, value | None tombstone)
 
+AE_BUCKETS = 512
+
+
+def term_hash(obj: Any) -> int:
+    """Deterministic 64-bit structural hash — identical on every node for
+    equal terms (dict iteration order canonicalised; Python hash() is
+    per-process randomised so unusable here)."""
+    h = hashlib.blake2b(digest_size=8)
+
+    def feed(o: Any) -> None:
+        if o is None:
+            h.update(b"\x00N")
+        elif isinstance(o, bool):
+            h.update(b"\x00B1" if o else b"\x00B0")
+        elif isinstance(o, int):
+            h.update(b"\x00I" + str(o).encode())
+        elif isinstance(o, float):
+            h.update(b"\x00F" + repr(o).encode())
+        elif isinstance(o, str):
+            h.update(b"\x00S" + o.encode("utf-8", "surrogatepass"))
+        elif isinstance(o, bytes):
+            h.update(b"\x00Y" + o)
+        elif isinstance(o, (list, tuple)):
+            h.update(b"\x00L")
+            for x in o:
+                feed(x)
+            h.update(b"\x00/")
+        elif isinstance(o, dict):
+            h.update(b"\x00D")
+            for k in sorted(o, key=lambda k: (str(type(k)), str(k))):
+                feed(k)
+                feed(o[k])
+            h.update(b"\x00/")
+        else:
+            h.update(b"\x00O" + repr(o).encode())
+
+    feed(obj)
+    return int.from_bytes(h.digest(), "big")
+
 
 class MetadataStore:
     def __init__(self, node_name: str, persist_dir: Optional[str] = None):
         self.node_name = node_name
         self._data: Dict[Key, Entry] = {}
         self._clock = 0
+        # per-bucket XOR of entry hashes, maintained incrementally — the
+        # AE digest vector (zero = empty bucket) — plus a bucket→keys
+        # index so bucket_entries is O(requested), not an O(state) rescan
+        self._digests = [0] * AE_BUCKETS
+        self._bucket_keys: List[set] = [set() for _ in range(AE_BUCKETS)]
         self._lock = threading.Lock()
         # prefix -> [fn(key, old_value, new_value)]
         self._subscribers: Dict[str, List[Callable[[Any, Any, Any], None]]] = {}
@@ -74,7 +126,11 @@ class MetadataStore:
                 if now - ts > self.TOMBSTONE_RETENTION_S:
                     self._kv.delete(kb)
                     continue
-            self._data[(prefix, codec.dekey(key))] = entry
+            k = (prefix, codec.dekey(key))
+            self._data[k] = entry
+            b = self._bucket(k)
+            self._digests[b] ^= term_hash((k, entry))
+            self._bucket_keys[b].add(k)
             self._clock = max(self._clock, entry[0])
 
     def _persist(self, prefix: str, key: Any, entry: Entry) -> None:
@@ -133,13 +189,23 @@ class MetadataStore:
             return True
         return (a[0], a[1]) > (b[0], b[1])
 
+    @staticmethod
+    def _bucket(k: Key) -> int:
+        return term_hash(k) % AE_BUCKETS
+
     def _apply(self, prefix: str, key: Any, entry: Entry, local: bool) -> bool:
         with self._lock:
-            old = self._data.get((prefix, key))
+            k = (prefix, key)
+            old = self._data.get(k)
             if not local and not self._newer(entry, old):
                 return False
             self._clock = max(self._clock, entry[0])
-            self._data[(prefix, key)] = entry
+            self._data[k] = entry
+            b = self._bucket(k)
+            if old is not None:
+                self._digests[b] ^= term_hash((k, old))
+            self._digests[b] ^= term_hash((k, entry))
+            self._bucket_keys[b].add(k)
             self._persist(prefix, key, entry)
         old_value = old[2] if old else None
         for fn in self._subscribers.get(prefix, []):
@@ -153,9 +219,35 @@ class MetadataStore:
         return self._apply(prefix, key, tuple(entry), local=False)
 
     def full_state(self) -> List[Tuple[str, Any, Entry]]:
-        """Snapshot for the on-connect anti-entropy exchange."""
+        """Snapshot for a full anti-entropy exchange (bootstrap / fallback
+        for peers without the digest protocol)."""
         with self._lock:
             return [(p, k, e) for (p, k), e in self._data.items()]
+
+    # --------------------------------------------- digest-based partial AE
+
+    def digests(self) -> List[Tuple[int, int]]:
+        """Non-zero (bucket, digest) pairs — the exchange request payload.
+        ~16 bytes per OCCUPIED bucket regardless of key count."""
+        with self._lock:
+            return [(i, d) for i, d in enumerate(self._digests) if d]
+
+    def diff_buckets(self, remote: Iterable[Tuple[int, int]]) -> List[int]:
+        """Buckets whose digest differs from the remote's (missing = 0)."""
+        rd = dict(remote)
+        with self._lock:
+            return [i for i in range(AE_BUCKETS)
+                    if self._digests[i] != rd.get(i, 0)]
+
+    def bucket_entries(self, buckets: Iterable[int]) -> List[Tuple[str, Any, Entry]]:
+        out: List[Tuple[str, Any, Entry]] = []
+        with self._lock:
+            for b in buckets:
+                for k in self._bucket_keys[b]:
+                    e = self._data.get(k)
+                    if e is not None:
+                        out.append((k[0], k[1], e))
+        return out
 
     def merge_full(self, state: Iterable[Tuple[str, Any, Tuple]]) -> int:
         applied = 0

@@ -30,13 +30,23 @@ _build_lock = threading.Lock()
 _build_attempted = False
 
 
+_TARGETS = ("libvmq_kvstore.so", "libvmq_counters.so", "libvmq_bcrypt.so",
+            "vmq-passwd")
+
+
+def _all_built() -> bool:
+    return all(os.path.exists(os.path.join(BUILD_DIR, t)) for t in _TARGETS)
+
+
 def _ensure_built() -> bool:
     global _build_attempted
-    if os.path.exists(os.path.join(BUILD_DIR, "libvmq_kvstore.so")):
+    # check the FULL target set: a build dir from an older checkout may
+    # hold some libraries but miss newly-added ones
+    if _all_built():
         return True
     with _build_lock:
         if _build_attempted:
-            return os.path.exists(os.path.join(BUILD_DIR, "libvmq_kvstore.so"))
+            return _all_built()
         _build_attempted = True
         if not os.path.exists(os.path.join(NATIVE_DIR, "Makefile")):
             return False
@@ -46,7 +56,7 @@ def _ensure_built() -> bool:
         except (OSError, subprocess.SubprocessError) as e:
             log.warning("native build failed, using Python fallbacks: %s", e)
             return False
-    return os.path.exists(os.path.join(BUILD_DIR, "libvmq_kvstore.so"))
+    return _all_built()
 
 
 def load_library(name: str):

@@ -60,6 +60,20 @@ class PasswdPlugin:
                 continue
             try:
                 user, rest = line.split(":", 1)
+                if rest.startswith("$2"):
+                    # bcrypt entry ($2a/$2b, native/bcrypt.cc) — the
+                    # reference accepts these via vmq_diversity's bcrypt
+                    from ..native import bcrypt as _bcrypt
+
+                    if not _bcrypt.available():
+                        # loud at load time: silently failing every
+                        # check() later is an undiagnosable auth outage
+                        log.error("passwd entry for %r uses bcrypt but "
+                                  "the native bcrypt library is "
+                                  "unavailable — this user CANNOT log in",
+                                  user)
+                    entries[user] = ("bcrypt", rest)
+                    continue
                 _, six, salt_b64, hash_b64 = rest.split("$")
                 if six != "6":
                     raise ValueError(f"unknown hash id {six!r}")
@@ -77,6 +91,13 @@ class PasswdPlugin:
             return NEXT
         salt_b64, hash_b64 = entry
         pw = password.encode() if isinstance(password, str) else password
+        if salt_b64 == "bcrypt":
+            from ..native import bcrypt as _bcrypt
+
+            if _bcrypt.checkpw(pw.decode("utf-8", "surrogateescape"),
+                               hash_b64):
+                return OK
+            return ("error", "invalid_credentials")
         want = hash_password(pw, base64.b64decode(salt_b64))
         if hmac.compare_digest(want.decode(), hash_b64):
             return OK

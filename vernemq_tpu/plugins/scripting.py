@@ -128,11 +128,17 @@ class Script:
     def load(self) -> None:
         with open(self.path) as f:
             src = f.read()
+        from ..native import bcrypt as _bcrypt
+
         ns: Dict[str, Any] = {
             "kv": self.kv,
             "cache": self.plugin.cache,
             "log": logging.getLogger(f"vernemq_tpu.script.{self.path}"),
             "topic": T,
+            # bcrypt helpers (vmq_diversity's bcrypt dep,
+            # vmq_diversity_bcrypt.erl): auth scripts verify datastore
+            # password hashes with bcrypt.checkpw / create with hashpw
+            "bcrypt": _bcrypt,
         }
         exec(compile(src, self.path, "exec"), ns)
         self.hooks = {h: ns[h] for h in SCRIPT_HOOKS if callable(ns.get(h))}
