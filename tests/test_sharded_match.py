@@ -54,3 +54,115 @@ def test_sharded_delta_resync():
     got = m.match_batch(topics[:8])
     for topic, rows in zip(topics[:8], got):
         assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+# ---------------------------------------------------------------------------
+# v3 windowed production path under shard_map (VERDICT r2 item 2)
+# ---------------------------------------------------------------------------
+
+from vernemq_tpu.parallel.sharded_match import ShardedWindowedMatcher
+
+
+def build_bucketed(seed, n_filters, cap, l0n=32, l1n=64, l2n=16, skew=False):
+    """Corpus over a 3-level tree so the table's bucketed layout engages
+    (cap >= 8192); skew concentrates filters on one hot level-0 word to
+    make shards uneven."""
+    rng = random.Random(seed)
+    table = SubscriptionTable(max_levels=8, initial_capacity=cap)
+    trie = SubscriptionTrie()
+    l0 = [f"r{i}" for i in range(l0n)]
+    l1 = [f"d{i}" for i in range(l1n)]
+    l2 = [f"m{i}" for i in range(l2n)]
+    for i in range(n_filters):
+        r = rng.random()
+        w0 = l0[0] if skew and rng.random() < 0.5 else rng.choice(l0)
+        w = [w0, rng.choice(l1), rng.choice(l2)]
+        if r < 0.6:
+            f = w
+        elif r < 0.8:
+            f = [w[0], "+", w[2]]
+        elif r < 0.9:
+            f = ["+", w[1], w[2]]
+        else:
+            f = [w[0], w[1], "#"]
+        table.add(f, i, None)
+        trie.add(list(f), i, None)
+    assert table.bucketed
+    pools = (l0, l1, l2)
+    return table, trie, pools, rng
+
+
+def topics_for(rng, pools, n, skew=False):
+    l0, l1, l2 = pools
+    return [((l0[0] if skew and rng.random() < 0.5 else rng.choice(l0)),
+             rng.choice(l1), rng.choice(l2)) for _ in range(n)]
+
+
+@pytest.mark.parametrize("batch_axis", [1, 2])
+def test_windowed_sharded_parity_100k(batch_axis):
+    """>=100k filters, bucketed table sharded over 'sub', full parity with
+    the host trie (the VERDICT item-2 'done' bar)."""
+    table, trie, pools, rng = build_bucketed(7, 100_000, 1 << 17)
+    mesh = make_mesh(batch=batch_axis)
+    m = ShardedWindowedMatcher(table, mesh, max_fanout=128)
+    topics = topics_for(rng, pools, 200)
+    got = m.match_batch(topics)
+    for topic, rows in zip(topics, got):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_windowed_sharded_churn():
+    """Subscribe/unsubscribe churn between batches: re-sync keeps parity
+    (the trie-delta stream of BASELINE config 5 under sharding)."""
+    table, trie, pools, rng = build_bucketed(13, 20_000, 1 << 15)
+    mesh = make_mesh(batch=2)
+    m = ShardedWindowedMatcher(table, mesh, max_fanout=128)
+    l0, l1, l2 = pools
+    for round_i in range(3):
+        # churn: add 200 new filters, remove 100 existing
+        base = 1_000_000 + round_i * 1000
+        for j in range(200):
+            f = [rng.choice(l0), rng.choice(l1), rng.choice(l2)]
+            table.add(f, base + j, None)
+            trie.add(list(f), base + j, None)
+        removed = 0
+        for e in list(table.entries):
+            if removed >= 100 or e is None:
+                if removed >= 100:
+                    break
+                continue
+            if rng.random() < 0.01:
+                table.remove(list(e[0]), e[1])
+                trie.remove(list(e[0]), e[1])
+                removed += 1
+        topics = topics_for(rng, pools, 64)
+        got = m.match_batch(topics)
+        for topic, rows in zip(topics, got):
+            assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_windowed_sharded_uneven_shards():
+    """Zipf-skewed corpus + publish stream: hot buckets overload one
+    shard's tile slots; overflow pubs must still match exactly (host
+    fallback), never silently drop."""
+    table, trie, pools, rng = build_bucketed(17, 30_000, 1 << 15, skew=True)
+    mesh = make_mesh(batch=1)  # all 8 devices on 'sub'
+    m = ShardedWindowedMatcher(table, mesh, max_fanout=128)
+    topics = topics_for(rng, pools, 300, skew=True)
+    got = m.match_batch(topics)
+    for topic, rows in zip(topics, got):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_windowed_sharded_dollar_and_unknown():
+    """$-topics and never-subscribed words under sharding."""
+    table, trie, pools, rng = build_bucketed(23, 10_000, 1 << 14)
+    table.add(["$SYS", "stats", "#"], "sys", None)
+    trie.add(["$SYS", "stats", "#"], "sys", None)
+    mesh = make_mesh(batch=2)
+    m = ShardedWindowedMatcher(table, mesh, max_fanout=128)
+    topics = [("$SYS", "stats", "x"), ("neverseen", "word", "here"),
+              ("$SYS", "other", "y")] + topics_for(rng, pools, 13)
+    got = m.match_batch(topics)
+    for topic, rows in zip(topics, got):
+        assert norm(rows) == norm(trie.match(list(topic))), topic

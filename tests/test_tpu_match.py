@@ -236,12 +236,13 @@ def test_bucketed_rebuild_preserves_entries():
         assert norm(rows) == norm(trie.match(list(topic))), topic
 
 
-def test_cut_tiles_invariants():
-    """Greedy tile cutting: every sorted pub lands in exactly one tile, the
-    tile's row window covers its pubs' buckets, and spans obey seg_max."""
+def test_prepare_windows_invariants():
+    """Fixed-T windowing: every pub either lands in exactly one tile whose
+    window fully covers its bucket, or is reported as a leftover; window
+    starts stay inside [row_lo, row_hi - seg_max]."""
     import numpy as np
 
-    from vernemq_tpu.models.tpu_matcher import _cut_tiles
+    from vernemq_tpu.models.tpu_matcher import prepare_windows
 
     rng = random.Random(5)
     NB = 16
@@ -251,20 +252,42 @@ def test_cut_tiles_invariants():
     reg_end = reg_start + reg_cap
     S = int(reg_cap.sum())
     seg_max = 4096
-    assert int(reg_cap[1:].max()) <= seg_max
-    pb = np.sort(np.array([rng.randint(1, NB) for _ in range(500)]))
-    tiles = _cut_tiles(pb, reg_start, reg_end, seg_max, S, tile_pubs=128)
-    covered = 0
-    for (plo, phi, start, lo, ln) in tiles:
-        assert phi - plo <= 128
-        assert ln <= seg_max and lo + ln <= seg_max
+    n, Bpad, T = 500, 512, 4
+    pb = np.array([rng.randint(1, NB) for _ in range(n)], dtype=np.int32)
+    L = 4
+    pw = np.zeros((Bpad, L), dtype=np.int32)
+    pl = np.zeros(Bpad, dtype=np.int32)
+    pd = np.zeros(Bpad, dtype=bool)
+    (t_pw, t_pl, t_pd, t_start, tile_of, pos_of,
+     leftovers) = prepare_windows(pw, pl, pd, pb, n, reg_start, reg_end,
+                                  S, T, seg_max)
+    assert t_pw.shape == (T, Bpad // T, L)
+    left = set(leftovers)
+    for i in range(n):
+        b = int(pb[i])
+        if i in left:
+            assert tile_of[i] == -1
+            continue
+        ti = int(tile_of[i])
+        start = int(t_start[ti])
         assert 0 <= start <= S - seg_max
-        for p in range(plo, phi):
-            b = pb[p]
-            assert start + lo <= reg_start[b]
-            assert reg_end[b] <= start + lo + ln
-        covered += phi - plo
-    assert covered == len(pb)
+        assert start <= reg_start[b] and reg_end[b] <= start + seg_max
+    assert len(left) + int((tile_of >= 0).sum()) == n
+
+    # sharded slice: only buckets fully inside [row_lo, row_hi) are tiled
+    row_lo, row_hi = int(reg_start[8]), S
+    (t_pw2, _, _, t_start2, tile_of2, _, left2) = prepare_windows(
+        pw, pl, pd, pb, n, reg_start, reg_end, S, T, seg_max,
+        row_lo=row_lo, row_hi=row_hi)
+    for i in range(n):
+        b = int(pb[i])
+        if int(tile_of2[i]) >= 0:
+            start = int(t_start2[int(tile_of2[i])]) + row_lo
+            assert start >= row_lo
+            assert start <= reg_start[b] and reg_end[b] <= start + seg_max
+            assert reg_end[b] <= row_hi
+        else:
+            assert i in set(left2)
 
 
 def test_bucketed_id_bits_crossover():
@@ -288,3 +311,58 @@ def test_bucketed_id_bits_crossover():
             assert norm(rows) == norm(trie.match(list(topic))), topic
     finally:
         TT.MAX_IDS_16 = old16
+
+
+def test_region_relocation_no_rebuild():
+    """An overflowing bucket region relocates into the spare tail — S and
+    slot capacity unchanged (no device re-upload, no recompile) and
+    matching stays exact (VERDICT r2 weak-1 cold-rebuild stalls)."""
+    import numpy as np
+
+    from vernemq_tpu.models.tpu_table import SubscriptionTable
+
+    table = SubscriptionTable(max_levels=8, initial_capacity=16384)
+    trie = SubscriptionTrie()
+    m = TpuMatcher(max_levels=8, initial_capacity=16384)
+    m.table = table
+    # fill one level-0 word's bucket until its region overflows
+    cap_before = None
+    n = 0
+    relocated = False
+    for i in range(6000):
+        f = ["hot", f"d{i}", f"m{i % 7}"]
+        table.add(f, i, None)
+        trie.add(list(f), i, None)
+        n += 1
+        if cap_before is None:
+            cap_before = table.cap
+        if not table.resized and table.cap == cap_before and \
+                table.spare_start != cap_before - table.spare_cap:
+            relocated = True
+    # also some background filters in other buckets
+    for i in range(500):
+        f = [f"r{i % 20}", "x", "+"]
+        table.add(f, 10_000 + i, None)
+        trie.add(list(f), 10_000 + i, None)
+    table.resized = True  # force first upload on the fresh matcher
+    topics = [("hot", f"d{i}", f"m{i % 7}") for i in range(0, 6000, 101)]
+    topics += [(f"r{i % 20}", "x", "q") for i in range(8)]
+    for topic, rows in zip(topics, m.match_batch(topics)):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+
+    # now trigger relocation AFTER the matcher is warm: deltas only
+    assert not table.resized
+    start_cap = table.cap
+    for i in range(6000, 9000):
+        f = ["hot", f"d{i}", f"m{i % 7}"]
+        table.add(f, i, None)
+        trie.add(list(f), i, None)
+        if table.resized:
+            break
+    # matching stays exact whether it relocated or rebuilt; if capacity
+    # never changed, the growth was relocation-only (the cheap path)
+    grew_in_place = not table.resized and table.cap == start_cap
+    topics = [("hot", f"d{i}", f"m{i % 7}") for i in range(5900, 9000, 37)]
+    for topic, rows in zip(topics, m.match_batch(topics)):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+    assert grew_in_place, "expected spare-tail relocation, got full rebuild"

@@ -565,6 +565,100 @@ def match_extract_bucketed(
     return gidx, gvalid, gcount, tidx, tvalid, tcount
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("id_bits", "k", "glob_pad", "seg_max",
+                                    "gc"))
+def match_extract_windowed(
+    F_t: jax.Array,          # bf16 [K, S] coded operands (build_operands)
+    t1: jax.Array,           # f32 [S]
+    sub_eff_len: jax.Array,  # int32 [S]
+    has_hash: jax.Array,     # bool [S]
+    first_wild: jax.Array,   # bool [S]
+    active: jax.Array,       # bool [S]
+    pub_words: jax.Array,    # int32 [B, L]  original batch order
+    pub_len: jax.Array,      # int32 [B]
+    pub_dollar: jax.Array,   # bool [B]
+    t_pw: jax.Array,         # int32 [T, TP, L]  bucket-sorted pub tiles
+    t_pl: jax.Array,         # int32 [T, TP]
+    t_pd: jax.Array,         # bool [T, TP]
+    t_start: jax.Array,      # int32 [T] clamped window start per tile
+    *,
+    id_bits: int,
+    k: int,
+    glob_pad: int,           # region-0 width (wildcard-first rows), %2048
+    seg_max: int,            # window width, %2048
+    gc: int,                 # pub-chunk size for the global phase
+) -> Tuple[jax.Array, ...]:
+    """The v3 production match path — ONE fused executable per batch.
+
+    Replaces :func:`match_extract_bucketed`'s greedy variable tiling +
+    ``lax.map``: per-execution overhead on the TPU runtime is ~5ms
+    regardless of op count (measured), ``lax.map`` serialises tile
+    launches, and variable tile counts recompile — so this kernel uses a
+    STATIC tile count with the loop unrolled at trace time.
+
+    Two phases against the bucket-partitioned table (models/tpu_table.py):
+
+    1. GLOBAL: every publish × region 0 (wildcard-first filters), chunked
+       to ``gc`` pubs so the [gc, glob_pad] f32 mismatch intermediate
+       stays bounded (XLA materialises it when the pack epilogue blocks
+       matmul fusion — [B, S]-sized f32 at B=2048 OOMs the compile).
+    2. WINDOWS: publishes sorted by level-0 bucket, cut into T = B/TP
+       fixed tiles; tile i matmuls a traced-start ``dynamic_slice`` window
+       of ``seg_max`` contiguous rows (contiguous: no gathers — a
+       [T,K,R]-window gather measured 10-60x slower than the matmul it
+       feeds). Pubs whose bucket region exceeds their tile's window are
+       handled host-side (prepare_windows returns them as leftovers).
+
+    Returns ``(gidx, gvalid, gcount, tidx, tvalid, tcount)``; tile
+    indices are global slot ids. Exact — the coded matmul is bit-exact
+    (build_operands) and a row-guard keeps region-0 rows out of windows.
+    """
+    Kd = F_t.shape[0]
+    B = pub_words.shape[0]
+    gouts = []
+    for c in range(0, B, gc):
+        sl = slice(c, c + gc)
+        G = build_pub_operand(pub_words[sl], id_bits)
+        mm = lax.dot_general(
+            G, F_t[:, :glob_pad], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + t1[None, :glob_pad]
+        m = (mm == 0.0) & _epilogue(
+            pub_len[sl], pub_dollar[sl], sub_eff_len[:glob_pad],
+            has_hash[:glob_pad], first_wild[:glob_pad], active[:glob_pad])
+        gouts.append(extract_indices_packed(_pack_mask(m), k, 2048))
+    gidx = jnp.concatenate([o[0] for o in gouts], axis=0)
+    gvalid = jnp.concatenate([o[1] for o in gouts], axis=0)
+    gcount = jnp.concatenate([o[2] for o in gouts], axis=0)
+
+    T = t_pw.shape[0]
+    j = jnp.arange(seg_max, dtype=jnp.int32)
+    touts = []
+    for ti in range(T):
+        start = t_start[ti]
+        Fseg = lax.dynamic_slice(F_t, (0, start), (Kd, seg_max))
+        t1s = lax.dynamic_slice(t1, (start,), (seg_max,))
+        effs = lax.dynamic_slice(sub_eff_len, (start,), (seg_max,))
+        hhs = lax.dynamic_slice(has_hash, (start,), (seg_max,))
+        fws = lax.dynamic_slice(first_wild, (start,), (seg_max,))
+        acts = lax.dynamic_slice(active, (start,), (seg_max,))
+        Gt = build_pub_operand(t_pw[ti], id_bits)
+        mm = lax.dot_general(
+            Gt, Fseg, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + t1s[None, :]
+        rowok = j[None, :] >= (glob_pad - start)  # region 0 never re-matched
+        m = (mm == 0.0) & _epilogue(
+            t_pl[ti], t_pd[ti], effs, hhs, fws, acts) & rowok
+        i2, v2, c2 = extract_indices_packed(_pack_mask(m), k, 2048)
+        touts.append((i2 + start, v2, c2))
+    tidx = jnp.stack([o[0] for o in touts])
+    tvalid = jnp.stack([o[1] for o in touts])
+    tcount = jnp.stack([o[2] for o in touts])
+    return gidx, gvalid, gcount, tidx, tvalid, tcount
+
+
 @functools.partial(jax.jit, static_argnames=("id_bits",))
 def apply_delta_operands(
     F_t: jax.Array, t1: jax.Array,

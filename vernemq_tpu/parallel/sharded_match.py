@@ -141,12 +141,358 @@ class ShardedMatcher:
         return out
 
     def _host_match(self, topic):
-        from ..protocol.topic import match_dollar_aware
+        return host_match(self.table, topic)
 
-        t = list(topic)
-        rows = [
-            e for e in self.table.entries
-            if e is not None and match_dollar_aware(t, list(e[0]))
-        ]
-        rows.extend(self.table.overflow.match(t))
-        return rows
+
+def host_match(table, topic):
+    """Exact host-side fallback over a snapshot of the entry list (slow
+    path for truncated/leftover publishes; snapshot so concurrent
+    mutation from the event loop can't skip entries mid-scan)."""
+    from ..protocol.topic import match_dollar_aware
+
+    t = list(topic)
+    entries = list(table.entries)
+    rows = [
+        e for e in entries
+        if e is not None and match_dollar_aware(t, list(e[0]))
+    ]
+    rows.extend(table.overflow.match(t))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# v3: the windowed production kernel under shard_map
+# ---------------------------------------------------------------------------
+
+from ..models.tpu_matcher import TILE_PUBS, _pow2ceil, prepare_windows
+from ..ops.match_kernel import (
+    _epilogue,
+    _pack_mask,
+    build_operands,
+    build_pub_operand,
+    extract_indices_packed,
+)
+
+
+def build_sharded_windowed(mesh: Mesh, *, id_bits: int, k: int,
+                           glob_pad: int, seg_max: int, gc: int, T: int,
+                           Sl: int, with_total: bool = False):
+    """The windowed production matcher under shard_map on a
+    ('batch', 'sub') mesh — the multi-chip form of
+    :func:`ops.match_kernel.match_extract_windowed`.
+
+    Sharding (SURVEY.md §5.7/§5.8): the coded operand matrix F_t is
+    column-sharded over 'sub' (each device owns Sl contiguous table rows —
+    the per-node trie replica seam vmq_reg_trie.erl:503-520 recast as row
+    slices); the publish batch is sharded over 'batch'. Region 0
+    (wildcard-first rows) travels replicated and each 'sub' shard matches
+    its glob_pad/n_sub column chunk, so no work is duplicated. Tile
+    windows are shard-local dynamic slices; tile inputs are prepped per
+    shard by the host (prepare_windows with row_lo/row_hi). The scalar
+    total-match count is psum-reduced over both mesh axes (ICI
+    collectives) and returned replicated.
+    """
+    nsub = mesh.shape["sub"]
+    GW = glob_pad // nsub
+    # packed-extraction block: <=2048 and dividing the region width (GW is
+    # pow2/nsub-pow2, so itself pow2 — any pow2 <= GW divides it)
+    gblock = min(2048, GW)
+    assert glob_pad % nsub == 0 and seg_max <= Sl
+
+    def local(F_sh, t1_sh, eff_sh, hh_sh, fw_sh, act_sh,
+              Fg, t1g, effg, hhg, fwg, actg,
+              pw, pl, pd,
+              t_pw, t_pl, t_pd, t_start):
+        Kd = F_sh.shape[0]
+        t_pw, t_pl, t_pd, t_start = (t_pw[0], t_pl[0], t_pd[0], t_start[0])
+        sidx = lax.axis_index("sub")
+        j = jnp.arange(seg_max, dtype=jnp.int32)
+
+        # global phase: this shard's column chunk of region 0, all pubs of
+        # this batch shard, in gc-sized pub chunks
+        goff = sidx * GW
+        Fg_c = lax.dynamic_slice(Fg, (0, goff), (Kd, GW))
+        t1g_c = lax.dynamic_slice(t1g, (goff,), (GW,))
+        effg_c = lax.dynamic_slice(effg, (goff,), (GW,))
+        hhg_c = lax.dynamic_slice(hhg, (goff,), (GW,))
+        fwg_c = lax.dynamic_slice(fwg, (goff,), (GW,))
+        actg_c = lax.dynamic_slice(actg, (goff,), (GW,))
+        Bl = pw.shape[0]
+        gouts = []
+        for c in range(0, Bl, min(gc, Bl)):
+            sl = slice(c, c + min(gc, Bl))
+            G = build_pub_operand(pw[sl], id_bits)
+            mm = lax.dot_general(G, Fg_c, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            m = (mm + t1g_c[None, :] == 0.0) & _epilogue(
+                pl[sl], pd[sl], effg_c, hhg_c, fwg_c, actg_c)
+            i1, v1, c1 = extract_indices_packed(_pack_mask(m), k, gblock)
+            gouts.append((i1 + goff, v1, c1))
+        gidx = jnp.concatenate([o[0] for o in gouts], axis=0)
+        gvalid = jnp.concatenate([o[1] for o in gouts], axis=0)
+        gcount = jnp.concatenate([o[2] for o in gouts], axis=0)
+
+        # tile phase against this shard's row slice
+        touts = []
+        for ti in range(T):
+            start = t_start[ti]
+            Kd_ = F_sh.shape[0]
+            Fseg = lax.dynamic_slice(F_sh, (0, start), (Kd_, seg_max))
+            t1s = lax.dynamic_slice(t1_sh, (start,), (seg_max,))
+            effs = lax.dynamic_slice(eff_sh, (start,), (seg_max,))
+            hhs = lax.dynamic_slice(hh_sh, (start,), (seg_max,))
+            fws = lax.dynamic_slice(fw_sh, (start,), (seg_max,))
+            acts = lax.dynamic_slice(act_sh, (start,), (seg_max,))
+            Gt = build_pub_operand(t_pw[ti], id_bits)
+            mm = lax.dot_general(Gt, Fseg, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+            abs_start = sidx * Sl + start
+            rowok = (j[None, :] + abs_start) >= glob_pad
+            m = (mm + t1s[None, :] == 0.0) & _epilogue(
+                t_pl[ti], t_pd[ti], effs, hhs, fws, acts) & rowok
+            i2, v2, c2 = extract_indices_packed(_pack_mask(m), k, 2048)
+            touts.append((i2 + abs_start, v2, c2))
+        tidx = jnp.stack([o[0] for o in touts])
+        tvalid = jnp.stack([o[1] for o in touts])
+        tcount = jnp.stack([o[2] for o in touts])
+
+        outs = (gidx[:, None], gvalid[:, None], gcount[:, None],
+                tidx[None], tvalid[None], tcount[None])
+        if with_total:
+            # ICI collective: cluster-wide match total (dryrun exercises
+            # it; production skips the per-batch collective latency)
+            total = lax.psum(lax.psum(
+                gcount.sum() + tcount.sum(), "sub"), "batch")
+            outs = outs + (total,)
+        return outs
+
+    fn = shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(None, "sub"), P("sub"), P("sub"), P("sub"), P("sub"), P("sub"),
+            P(None, None), P(None), P(None), P(None), P(None), P(None),
+            P("batch", None), P("batch"), P("batch"),
+            P("sub", None, None, None), P("sub", None, None),
+            P("sub", None, None), P("sub", None),
+        ),
+        out_specs=(
+            P("batch", "sub", None), P("batch", "sub", None),
+            P("batch", "sub"),
+            P("sub", None, None, None), P("sub", None, None, None),
+            P("sub", None, None),
+        ) + ((P(),) if with_total else ()),
+        check_vma=False,
+    )
+    return jax.jit(fn)
+
+
+class ShardedWindowedMatcher:
+    """Multi-device windowed matcher over a SubscriptionTable: the
+    production (bucketed/windowed) path sharded on a ('batch', 'sub')
+    mesh. Host prep assigns each publish to the 'sub' shard owning its
+    bucket's rows; pubs in buckets straddling a shard cut (or overflowing
+    their shard's tile slots) fall back to exact host matching."""
+
+    def __init__(self, table, mesh: Mesh, max_fanout: int = 128,
+                 with_total: bool = False):
+        self.table = table
+        self.mesh = mesh
+        self.nsub = mesh.shape["sub"]
+        self.nb = mesh.shape["batch"]
+        self.max_fanout = max_fanout
+        self.with_total = with_total
+        self._dev = None
+        self._fns = {}
+        self._geom = None
+
+    def sync(self) -> None:
+        import numpy as np
+
+        t = self.table
+        self._reg_start = t.reg_start.copy()
+        self._reg_end = (t.reg_start + t.reg_cap).copy()
+        if self._dev is not None and not t.resized and not t.dirty:
+            return
+        if self._dev is not None and not t.resized:
+            self._sync_delta()
+            return
+        assert t.bucketed and t.id_bits, "windowed sharding needs a bucketed table"
+        S = t.cap
+        assert S % self.nsub == 0
+        if S // self.nsub < 4096:
+            raise ValueError(
+                f"table of {S} rows is too small for a {self.nsub}-way "
+                f"'sub' axis (each shard needs >= 4096 rows)")
+        # device-resident coded operands, column-sharded over 'sub'
+        F_t, t1 = jax.jit(build_operands, static_argnames=("id_bits",))(
+            t.words, t.eff_len, id_bits=t.id_bits)
+        F_t = np.asarray(F_t)
+        t1 = np.asarray(t1)
+        glob = int(t.reg_cap[0])
+        sF = NamedSharding(self.mesh, P(None, "sub"))
+        s1 = NamedSharding(self.mesh, P("sub"))
+        rep2 = NamedSharding(self.mesh, P(None, None))
+        rep1 = NamedSharding(self.mesh, P(None))
+        self._dev = (
+            jax.device_put(F_t, sF), jax.device_put(t1, s1),
+            jax.device_put(t.eff_len, s1), jax.device_put(t.has_hash, s1),
+            jax.device_put(t.first_wild, s1), jax.device_put(t.active, s1),
+            jax.device_put(F_t[:, :glob], rep2),
+            jax.device_put(t1[:glob], rep1),
+            jax.device_put(t.eff_len[:glob], rep1),
+            jax.device_put(t.has_hash[:glob], rep1),
+            jax.device_put(t.first_wild[:glob], rep1),
+            jax.device_put(t.active[:glob], rep1),
+        )
+        self._glob = glob
+        self._S = S
+        self._bits = t.id_bits
+        t.resized = False
+        t.dirty.clear()
+
+    def _sync_delta(self) -> None:
+        """Scatter dirty slots into the sharded device arrays (GSPMD
+        handles the sharded .at[].set under jit) — the delta path that
+        keeps churn from re-uploading the whole table."""
+        import numpy as np
+
+        from ..ops.match_kernel import apply_delta_operands
+
+        t = self.table
+        slots = np.fromiter(t.dirty, dtype=np.int32)
+        t.dirty.clear()
+        (F_t, t1, eff, hh, fw, act,
+         Fg, t1g, effg, hhg, fwg, actg) = self._dev
+        d_words = t.words[slots]
+        d_eff = t.eff_len[slots]
+        eff = eff.at[slots].set(d_eff)
+        hh = hh.at[slots].set(t.has_hash[slots])
+        fw = fw.at[slots].set(t.first_wild[slots])
+        act = act.at[slots].set(t.active[slots])
+        F_t, t1 = apply_delta_operands(F_t, t1, slots, d_words, d_eff,
+                                       self._bits)
+        gsel = slots < self._glob
+        if gsel.any():
+            gs = slots[gsel]
+            Fg, t1g = apply_delta_operands(Fg, t1g, gs, t.words[gs],
+                                           t.eff_len[gs], self._bits)
+            effg = effg.at[gs].set(t.eff_len[gs])
+            hhg = hhg.at[gs].set(t.has_hash[gs])
+            fwg = fwg.at[gs].set(t.first_wild[gs])
+            actg = actg.at[gs].set(t.active[gs])
+        self._dev = (F_t, t1, eff, hh, fw, act,
+                     Fg, t1g, effg, hhg, fwg, actg)
+
+    def _fn_for(self, Bpad: int, T: int, seg_max: int, gc: int):
+        key = (Bpad, T, seg_max, gc)
+        fn = self._fns.get(key)
+        if fn is None:
+            fn = build_sharded_windowed(
+                self.mesh, id_bits=self._bits, k=self.max_fanout,
+                glob_pad=self._glob, seg_max=seg_max, gc=gc, T=T,
+                Sl=self._S // self.nsub, with_total=self.with_total)
+            self._fns[key] = fn
+        return fn
+
+    def match_batch(self, topics):
+        import numpy as np
+
+        if not topics:
+            return []
+        self.sync()
+        n = len(topics)
+        S, glob, nsub = self._S, self._glob, self.nsub
+        Sl = S // nsub
+        # batch padding: divisible by the batch axis and pow2-laddered
+        Bpad = self.nb
+        while Bpad < n:
+            Bpad *= 2
+        Bpad = max(Bpad, 8 * self.nb)
+        L = self.table.L
+        pw = np.full((Bpad, L), np.int32(-2), dtype=np.int32)
+        pl = np.zeros(Bpad, dtype=np.int32)
+        pd = np.zeros(Bpad, dtype=bool)
+        pb = np.zeros(n, dtype=np.int32)
+        for i, topic in enumerate(topics):
+            row, ln, dollar, bucket = self.table.encode_topic_ex(topic)
+            pw[i], pl[i], pd[i], pb[i] = row, ln, dollar, bucket
+        # per-shard pub assignment by bucket-row ownership
+        shard_of = np.minimum(self._reg_start[pb] // Sl, nsub - 1).astype(int)
+        Bsh = max(8, min(Bpad, _pow2ceil(2 * Bpad // nsub)))
+        T = max(1, Bsh // TILE_PUBS)
+        bucket_max = (int((self._reg_end[1:] - self._reg_start[1:]).max())
+                      if len(self._reg_start) > 1 else 0)
+        # window must divide into 2048 blocks (packed extraction) and fit
+        # the shard slice; Sl itself may not be 2048-aligned
+        sl_cap = Sl - Sl % 2048
+        seg_max = min(_pow2ceil(max(4096, bucket_max, 2 * Sl // T)), sl_cap)
+        gc = min(Bpad // self.nb, 1024)
+        t_pw = np.full((nsub, T, Bsh // T, L), np.int32(0), dtype=np.int32)
+        t_pl = np.zeros((nsub, T, Bsh // T), dtype=np.int32)
+        t_pd = np.zeros((nsub, T, Bsh // T), dtype=bool)
+        t_start = np.zeros((nsub, T), dtype=np.int32)
+        tile_of = np.full(n, -1, dtype=np.int64)  # packed shard*T*TP + ...
+        leftovers = set()
+        TP = Bsh // T
+        for s in range(nsub):
+            mine = np.nonzero(shard_of == s)[0]
+            if len(mine) == 0:
+                continue
+            if len(mine) > Bsh:
+                leftovers.update(int(i) for i in mine[Bsh:])
+                mine = mine[:Bsh]
+            pw_s = np.full((Bsh, L), np.int32(-2), dtype=np.int32)
+            pl_s = np.zeros(Bsh, dtype=np.int32)
+            pd_s = np.zeros(Bsh, dtype=bool)
+            pb_s = np.zeros(len(mine), dtype=np.int32)
+            pw_s[:len(mine)] = pw[mine]
+            pl_s[:len(mine)] = pl[mine]
+            pd_s[:len(mine)] = pd[mine]
+            pb_s[:] = pb[mine]
+            (tps, tls, tds, tss, tof, pof, left) = prepare_windows(
+                pw_s, pl_s, pd_s, pb_s, len(mine), self._reg_start,
+                self._reg_end, S, T, seg_max,
+                row_lo=s * Sl, row_hi=(s + 1) * Sl)
+            t_pw[s], t_pl[s], t_pd[s], t_start[s] = tps, tls, tds, tss
+            for li in left:
+                leftovers.add(int(mine[li]))
+            for local_i, orig in enumerate(mine):
+                if tof[local_i] >= 0:
+                    tile_of[orig] = ((s * T + tof[local_i]) * TP
+                                     + pof[local_i])
+        fn = self._fn_for(Bpad, T, seg_max, gc)
+        res = fn(*self._dev, pw, pl, pd, t_pw, t_pl, t_pd, t_start)
+        (gidx, gvalid, gcount, tidx, tvalid, tcount) = res[:6]
+        gidx = np.asarray(gidx)      # [Bpad, nsub, k]
+        gvalid = np.asarray(gvalid)
+        gcount = np.asarray(gcount)  # [Bpad, nsub]
+        tidx = np.asarray(tidx)      # [nsub, T, TP, k]
+        tvalid = np.asarray(tvalid)
+        tcount = np.asarray(tcount)
+        k = self.max_fanout
+        out = []
+        for i, topic in enumerate(topics):
+            if i in leftovers:
+                out.append(self._host_match(topic))
+                continue
+            clipped = bool((gcount[i] > k).any())
+            parts = [gidx[i, s][gvalid[i, s]] for s in range(nsub)]
+            packed = tile_of[i]
+            if packed >= 0:
+                st = int(packed // TP)
+                s, ti, pos = st // T, st % T, int(packed % TP)
+                if tcount[s, ti, pos] > k:
+                    clipped = True
+                parts.append(tidx[s, ti, pos][tvalid[s, ti, pos]])
+            if clipped:
+                out.append(self._host_match(topic))
+                continue
+            rows = self.table.resolve(np.concatenate(parts))
+            if len(self.table.overflow):
+                rows = rows + self.table.overflow.match(list(topic))
+            out.append(rows)
+        return out
+
+    def _host_match(self, topic):
+        return host_match(self.table, topic)
