@@ -1,0 +1,24 @@
+"""Auth against a local user table with bcrypt hashes — the
+vmq_diversity postgres.lua shape with the datastore swapped for a file
+(each line: user:$2b$... as produced by vernemq_tpu.native.bcrypt).
+"""
+
+import os
+
+USERS = {}
+_path = os.environ.get("VMQ_BCRYPT_USERS", "users.bcrypt")
+if os.path.exists(_path):
+    with open(_path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line and not line.startswith("#") and ":" in line:
+                u, h = line.split(":", 1)
+                USERS[u] = h
+
+
+def auth_on_register(peer, sid, username, password, clean_start):
+    want = USERS.get(username or "")
+    pw = password.decode() if isinstance(password, bytes) else (password or "")
+    if want and bcrypt.available() and bcrypt.checkpw(pw, want):  # noqa: F821
+        return "ok"
+    return ("error", "invalid_credentials")

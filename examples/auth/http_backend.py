@@ -1,0 +1,29 @@
+"""Auth against a REST backend — the vmq_diversity priv/auth/*.lua
+pattern with the HTTP connector instead of a SQL pool.
+
+Configure the endpoint via kv (set once from another script or edit
+here); the backend answers POST /auth {"user":..,"pass":..} with
+{"ok": true, "publish_acl": [...], "subscribe_acl": [...]}.
+Enable with: plugins.vmq_diversity = on + diversity_scripts config, or
+broker.plugins.enable("vmq_diversity", scripts=[this file]).
+"""
+
+AUTH_URL = kv.get("auth_url", "http://127.0.0.1:8080/auth")  # noqa: F821
+
+
+def auth_on_register(peer, sid, username, password, clean_start):
+    if not username:
+        return ("error", "invalid_credentials")
+    pw = password.decode() if isinstance(password, bytes) else password
+    resp = http.post_json(AUTH_URL, {"user": username, "pass": pw})  # noqa: F821
+    if resp["status"] != 200 or not resp["json"]:
+        return ("error", "invalid_credentials")
+    body = resp["json"]
+    if not body.get("ok"):
+        return ("error", "invalid_credentials")
+    # populate the ACL cache so publish/subscribe auth is local
+    # (vmq_diversity_cache.erl role)
+    mp, client_id = sid
+    cache.insert(mp, client_id, username,  # noqa: F821
+                 body.get("publish_acl", []), body.get("subscribe_acl", []))
+    return "ok"

@@ -186,3 +186,29 @@ def test_legacy_flat_store_not_orphaned(tmp_path):
     assert [m.payload for m in b.msg_store.read_all(("", "c1"))] == [b"keep"]
     b.msg_store.close()
     b.metadata.close()
+
+
+@pytest.mark.asyncio
+async def test_log_file_sink(tmp_path):
+    """log_file/log_level knobs attach a file sink (the lager file sink
+    seat); syslog is the same handler path via log_syslog."""
+    import logging
+
+    from vernemq_tpu.broker.server import start_broker
+
+    logf = tmp_path / "broker.log"
+    cfg = Config(systree_enabled=False, allow_anonymous=True,
+                 log_file=str(logf), log_level="info")
+    b, s = await start_broker(cfg, port=0)
+    try:
+        logging.getLogger("vernemq_tpu.test").info("sink-check-%d", 42)
+        for h in b._log_handlers:
+            h.flush()
+        assert "sink-check-42" in logf.read_text()
+    finally:
+        await b.stop()
+        await s.stop()
+    # handler detached at stop: further logs don't append
+    size = logf.stat().st_size
+    logging.getLogger("vernemq_tpu.test").info("after-stop")
+    assert logf.stat().st_size == size

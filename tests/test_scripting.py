@@ -4,9 +4,12 @@ plugin's enhanced-auth exchange (vmq_mqtt5_demo_plugin role), all driven
 over real MQTT connections."""
 
 import asyncio
+import pathlib
 import textwrap
 
 import pytest
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 from vernemq_tpu.broker.config import Config
 from vernemq_tpu.broker.server import start_broker
@@ -184,5 +187,71 @@ async def test_mqtt5_demo_enhanced_auth_bad_data_rejected():
         assert frame.rc == 0x8C  # bad authentication method
         await c.close()
     finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_http_backend_auth_script(tmp_path):
+    """The examples/auth/http_backend.py pattern end-to-end: a script
+    authenticating against a REST endpoint through the http connector,
+    populating the ACL cache (the vmq_diversity priv/auth/* shape). The
+    endpoint runs in a thread: the connector blocks an executor worker,
+    never the broker loop."""
+    import http.server
+    import json as _json
+    import threading
+
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    hits = []
+
+    class AuthHandler(http.server.BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = _json.loads(self.rfile.read(
+                int(self.headers.get("Content-Length", 0))))
+            hits.append(body)
+            ok = body == {"user": "erin", "pass": "s3cret"}
+            resp = _json.dumps({
+                "ok": ok,
+                "publish_acl": ["data/%u/#"],
+                "subscribe_acl": ["data/#"],
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(resp)))
+            self.end_headers()
+            self.wfile.write(resp)
+
+        def log_message(self, *a):
+            pass
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), AuthHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_port}/auth"
+
+    script = tmp_path / "http_auth.py"
+    src = (REPO_ROOT / "examples" / "auth" / "http_backend.py").read_text()
+    script.write_text(src.replace(
+        'kv.get("auth_url", "http://127.0.0.1:8080/auth")', repr(url)))
+
+    b, s = await start_broker(Config(systree_enabled=False), port=0)
+    try:
+        b.plugins.enable("vmq_diversity", scripts=[str(script)])
+        good = MQTTClient(s.host, s.port, client_id="e1",
+                          username="erin", password=b"s3cret")
+        assert (await good.connect()).rc == 0
+        # ACL cache populated: publish inside the granted tree works,
+        # outside is rejected (session closed on v4 puback-less deny or
+        # CONNACK-level... here: publish auth denial drops QoS0 silently)
+        await good.publish("data/erin/t1", b"x", qos=1)
+        bad = MQTTClient(s.host, s.port, client_id="e2",
+                         username="erin", password=b"wrong")
+        assert (await bad.connect()).rc != 0
+        assert len(hits) == 2
+        await good.disconnect()
+    finally:
+        httpd.shutdown()
         await b.stop()
         await s.stop()

@@ -153,8 +153,8 @@ async def test_broker_e2e_with_tpu_reg_view(event_loop):
 
 # ---------------------------------------------------------------------------
 # Bucketed path (level-0 bucket narrowing — models/tpu_table.py regions +
-# ops/match_kernel.match_extract_bucketed). A big initial capacity forces
-# NB > 1 so these run the tiled device path, not the full scan.
+# ops/match_kernel.match_extract_windowed). A big initial capacity forces
+# NB > 1 so these run the windowed device path, not the full scan.
 # ---------------------------------------------------------------------------
 
 def _bucketed_matcher(**kw):

@@ -442,7 +442,38 @@ class Broker:
     def stop_trace(self) -> None:
         self.tracer = None
 
+    def _setup_logging(self) -> None:
+        """Attach the configured log sinks (console is the host app's
+        concern; file + syslog mirror the reference's lager sinks)."""
+        import logging as _logging
+
+        if not self.config.log_file and not self.config.log_syslog:
+            return  # no sink knobs set: leave the host app's config alone
+        root = _logging.getLogger("vernemq_tpu")
+        level = getattr(_logging, str(self.config.log_level).upper(),
+                        _logging.INFO)
+        root.setLevel(level)
+        fmt = _logging.Formatter(
+            "%(asctime)s [%(levelname)s] %(name)s: %(message)s")
+        if self.config.log_file:
+            fh = _logging.FileHandler(self.config.log_file)
+            fh.setFormatter(fmt)
+            root.addHandler(fh)
+            self._log_handlers.append(fh)
+        if self.config.log_syslog:
+            import logging.handlers as _lh
+
+            try:
+                sh = _lh.SysLogHandler(address=self.config.log_syslog_address)
+                sh.setFormatter(fmt)
+                root.addHandler(sh)
+                self._log_handlers.append(sh)
+            except OSError as e:
+                log.warning("syslog sink unavailable: %s", e)
+
     async def start(self) -> None:
+        self._log_handlers: List[Any] = []
+        self._setup_logging()
         # warm-load from persisted metadata: routing state, offline queues,
         # retain cache (boot order of vmq_server_sup + vmq_reg_trie /
         # vmq_retain_srv warm-loads)
@@ -513,6 +544,11 @@ class Broker:
         # until every connection handler (incl. bridge links) has returned
         if getattr(self, "supervisor", None) is not None:
             self.supervisor.stop()
+        import logging as _logging
+
+        for h in getattr(self, "_log_handlers", []):
+            _logging.getLogger("vernemq_tpu").removeHandler(h)
+            h.close()
         if self.sysmon is not None:
             self.sysmon.stop()
         if self.crl_refresher is not None:

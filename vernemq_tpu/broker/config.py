@@ -94,6 +94,13 @@ DEFAULTS: Dict[str, Any] = {
     "crl_refresh_interval": 60.0,  # seconds (vmq_crl_srv schema knob)
     "swc_replication_groups": 8,  # reference runs 10 (vmq_swc_plugin.erl:36-44)
     "swc_sync_interval": 2.0,  # seconds between AE rounds (sync_interval)
+    # logging sinks (the lager console/file/syslog triple of the
+    # reference's release config; syslog uses the OS socket via the
+    # stdlib handler — the reference's C port driver seat)
+    "log_level": "info",
+    "log_file": "",          # path; empty = no file sink
+    "log_syslog": False,
+    "log_syslog_address": "/dev/log",
     # structured keys filled by the conf-file loader (broker/conf.py):
     # listeners started at boot (vmq_ranch_config listener tree) and
     # plugins enabled at boot (plugins.<name> = on)

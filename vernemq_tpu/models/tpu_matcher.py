@@ -73,7 +73,11 @@ def prepare_windows(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
     hi_cap = S if row_hi is None else row_hi
     span = hi_cap - row_lo
     assert seg_max <= span, "window wider than the row slice"
-    order = np.argsort(pb[:n], kind="stable")
+    # sort by region ADDRESS: relocation (spare tail) makes reg_start
+    # non-monotone in bucket id, and windows span contiguous addresses —
+    # a bucket-id sort would strand every relocated bucket's pubs in the
+    # host-fallback leftovers
+    order = np.argsort(reg_start[pb[:n]], kind="stable")
     t_pw = np.full((T, TP, L), np.int32(K.PAD_ID), dtype=np.int32)
     t_pl = np.zeros((T, TP), dtype=np.int32)
     t_pd = np.zeros((T, TP), dtype=bool)

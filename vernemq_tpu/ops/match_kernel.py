@@ -488,84 +488,6 @@ def _epilogue(pub_len, pub_dollar, eff, hh, fw, act) -> jax.Array:
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("id_bits", "k", "glob_pad", "seg_max"))
-def match_extract_bucketed(
-    F_t: jax.Array,          # bf16 [K, S] coded operands (build_operands)
-    t1: jax.Array,           # f32 [S]
-    sub_eff_len: jax.Array,  # int32 [S]
-    has_hash: jax.Array,     # bool [S]
-    first_wild: jax.Array,   # bool [S]
-    active: jax.Array,       # bool [S]
-    pub_words: jax.Array,    # int32 [B, L]  original batch order
-    pub_len: jax.Array,      # int32 [B]
-    pub_dollar: jax.Array,   # bool [B]
-    t_pw: jax.Array,         # int32 [T, TP, L]  bucket-sorted pub tiles
-    t_pl: jax.Array,         # int32 [T, TP]
-    t_pd: jax.Array,         # bool [T, TP]
-    t_start: jax.Array,      # int32 [T] clamped slice start into S
-    t_lo: jax.Array,         # int32 [T] local offset of the tile's rows
-    t_len: jax.Array,        # int32 [T] live row count from t_lo
-    *,
-    id_bits: int,
-    k: int,
-    glob_pad: int,           # global (wildcard-first) region width, %2048
-    seg_max: int,            # padded bucket-segment width, %2048
-) -> Tuple[jax.Array, ...]:
-    """The bucketed production match path (single device call).
-
-    Two phases against a bucket-partitioned table (models/tpu_table.py):
-
-    1. GLOBAL: every publish × region 0 (wildcard-first filters — the only
-       rows whose match doesn't pin the publish's level-0 word).
-    2. BUCKETS: publishes sorted by their level-0 bucket and cut into
-       tiles of TP whose spanned bucket regions form one contiguous row
-       range ≤ seg_max; each tile matmuls only against its own segment
-       slice. Every table row is thus read ~once per batch instead of
-       B/TP times — the dense-layout equivalent of the trie's first-edge
-       narrowing (vmq_reg_trie.erl:358-371), worth ~#buckets in FLOPs.
-
-    Returns ``(gidx, gvalid, gcount, tidx, tvalid, tcount)``; tile
-    indices are global slot ids (segment offset already added). Exact —
-    no false positives: the coded matmul is bit-exact (build_operands).
-    """
-    Kdim = F_t.shape[0]
-
-    G = build_pub_operand(pub_words, id_bits)
-    mmg = lax.dot_general(
-        G, F_t[:, :glob_pad], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    ) + t1[None, :glob_pad]
-    maskg = (mmg == 0.0) & _epilogue(
-        pub_len, pub_dollar, sub_eff_len[:glob_pad], has_hash[:glob_pad],
-        first_wild[:glob_pad], active[:glob_pad])
-    gidx, gvalid, gcount = extract_indices_packed(_pack_mask(maskg), k, 2048)
-
-    def one(args):
-        tpw, tpl, tpd, start, lo, ln = args
-        Gt = build_pub_operand(tpw, id_bits)
-        Fseg = lax.dynamic_slice(F_t, (0, start), (Kdim, seg_max))
-        t1s = lax.dynamic_slice(t1, (start,), (seg_max,))
-        effs = lax.dynamic_slice(sub_eff_len, (start,), (seg_max,))
-        hhs = lax.dynamic_slice(has_hash, (start,), (seg_max,))
-        fws = lax.dynamic_slice(first_wild, (start,), (seg_max,))
-        acts = lax.dynamic_slice(active, (start,), (seg_max,))
-        mm = lax.dot_general(
-            Gt, Fseg, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) + t1s[None, :]
-        j = jnp.arange(seg_max, dtype=jnp.int32)
-        rowok = (j >= lo) & (j < lo + ln)
-        mask = (mm == 0.0) & _epilogue(tpl, tpd, effs, hhs, fws, acts) \
-            & rowok[None, :]
-        idx, valid, cnt = extract_indices_packed(_pack_mask(mask), k, 2048)
-        return idx + start, valid, cnt
-
-    tidx, tvalid, tcount = lax.map(
-        one, (t_pw, t_pl, t_pd, t_start, t_lo, t_len))
-    return gidx, gvalid, gcount, tidx, tvalid, tcount
-
-
-@functools.partial(jax.jit,
                    static_argnames=("id_bits", "k", "glob_pad", "seg_max",
                                     "gc"))
 def match_extract_windowed(

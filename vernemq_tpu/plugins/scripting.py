@@ -115,6 +115,52 @@ class AclCache:
         return len(self._entries)
 
 
+class HttpConnector:
+    """Minimal HTTP client for auth scripts (vmq_diversity's hackney
+    pool seat): get/post_json with a hard timeout, JSON decoding, no
+    redirects. Kept deliberately tiny — scripts needing more roll their
+    own with the stdlib."""
+
+    def __init__(self, timeout: float = 2.0):
+        self.timeout = timeout
+
+    def _req(self, method, url, body=None, headers=None):
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(url, data=body, method=method,
+                                     headers=dict(headers or {}))
+
+        def package(status, data):
+            try:
+                j = _json.loads(data) if data[:1] in (b"{", b"[") else None
+            except ValueError:
+                j = None
+            return {"status": status, "body": data, "json": j}
+
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return package(resp.status, resp.read())
+        except urllib.error.HTTPError as e:
+            # non-2xx is a REAL response (401 from an auth backend is a
+            # credential verdict, not an outage) — keep status + body
+            return package(e.code, e.read())
+        except Exception as e:  # network failure: status 0
+            return {"status": 0, "body": b"", "json": None,
+                    "error": str(e)}
+
+    def get(self, url, headers=None):
+        return self._req("GET", url, None, headers)
+
+    def post_json(self, url, obj, headers=None):
+        import json as _json
+
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
+        return self._req("POST", url, _json.dumps(obj).encode(), h)
+
+
 class Script:
     """One loaded script file (one vmq_diversity script state)."""
 
@@ -139,6 +185,13 @@ class Script:
             # vmq_diversity_bcrypt.erl): auth scripts verify datastore
             # password hashes with bcrypt.checkpw / create with hashpw
             "bcrypt": _bcrypt,
+            # http connector (the hackney seat of vmq_diversity): auth
+            # scripts talk to REST auth backends; blocking with a short
+            # timeout — the reference's Lua pools block a worker the same
+            # way. Datastore-specific drivers (postgres/mysql/mongo/redis)
+            # need client libraries this image doesn't ship; the HTTP
+            # connector + examples/auth/ scripts cover the same seat.
+            "http": HttpConnector(),
         }
         exec(compile(src, self.path, "exec"), ns)
         self.hooks = {h: ns[h] for h in SCRIPT_HOOKS if callable(ns.get(h))}
@@ -234,7 +287,9 @@ class ScriptingPlugin:
         # resolve through script.hooks at call time so reload_script takes
         # effect without re-registering (hook bodies swap; the set of hooks
         # a script exports is fixed at enable time)
-        def wrapped(*args):
+        auth = name.startswith("auth_") or name == "on_auth_m5"
+
+        def call(*args):
             fn = script.hooks.get(name)
             if fn is None:
                 return "next"
@@ -244,9 +299,25 @@ class ScriptingPlugin:
                 raise
             except Exception as e:
                 log.exception("script %s hook %s failed", script.path, name)
-                if name.startswith("auth_") or name == "on_auth_m5":
+                if auth:
                     return ("error", f"script_error: {e}")
                 return None
+
+        if auth:
+            # auth hooks may block on a datastore (the http connector):
+            # run them in the executor so a slow backend stalls one
+            # worker, not the whole event loop (the reference's Lua pool
+            # blocks a poolboy worker the same way). The auth chain
+            # already awaits handlers, so an async wrapper slots in.
+            import asyncio
+            import functools
+
+            async def wrapped(*args):
+                loop = asyncio.get_event_loop()
+                return await loop.run_in_executor(
+                    None, functools.partial(call, *args))
+        else:
+            wrapped = call
 
         wrapped.__name__ = f"{name}@{script.path}"
         return wrapped
