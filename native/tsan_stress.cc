@@ -1,0 +1,99 @@
+// ThreadSanitizer stress harness for the native components (SURVEY.md
+// §5.2: the reference's race defenses are architectural; for our C++ the
+// defense is TSAN). Build + run with `make -C native tsan` — any data
+// race aborts with a TSAN report (exit != 0).
+//
+// Covers the two concurrently-used components:
+//  - counters: 8 writer threads hammering shard-local cells while a
+//    reader snapshots (the wait-free mzmetrics contract)
+//  - kvstore: 4 threads doing put/get/delete on one Store (the
+//    per-instance mutex contract the bucketed msg store relies on)
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+struct Block;
+extern "C" {
+Block* ctr_create(uint32_t n);
+void ctr_destroy(Block* b);
+int ctr_shards(void);
+void ctr_incr(Block* b, uint32_t idx, int64_t delta, uint32_t shard);
+int64_t ctr_read(Block* b, uint32_t idx);
+void ctr_snapshot(Block* b, int64_t* out);
+}
+
+struct Store;
+extern "C" {
+Store* kv_open(const char* path);
+void kv_close(Store* s);
+int kv_put(Store* s, const uint8_t* key, uint32_t klen, const uint8_t* val,
+           uint32_t vlen);
+int kv_get(Store* s, const uint8_t* key, uint32_t klen, uint8_t** out,
+           uint32_t* outlen);
+int kv_delete(Store* s, const uint8_t* key, uint32_t klen);
+void kv_free(void* p);
+}
+
+int main() {
+  // ---- counters
+  Block* b = ctr_create(16);
+  const int nshards = ctr_shards();
+  std::vector<std::thread> ts;
+  std::atomic<bool> stop{false};
+  for (int t = 0; t < 8; t++) {
+    ts.emplace_back([&, t] {
+      for (int i = 0; i < 200000; i++)
+        ctr_incr(b, uint32_t(i % 16), 1, uint32_t(t % nshards));
+    });
+  }
+  std::thread reader([&] {
+    int64_t snap[16];
+    while (!stop.load(std::memory_order_acquire)) ctr_snapshot(b, snap);
+  });
+  for (auto& t : ts) t.join();
+  stop.store(true, std::memory_order_release);
+  reader.join();
+  int64_t total = 0;
+  for (uint32_t i = 0; i < 16; i++) total += ctr_read(b, i);
+  if (total != 8 * 200000) {
+    std::fprintf(stderr, "counter total %lld != %d\n",
+                 (long long)total, 8 * 200000);
+    return 1;
+  }
+  ctr_destroy(b);
+
+  // ---- kvstore
+  std::string path = "/tmp/vmq_tsan_kv_XXXXXX";
+  (void)mkstemp(path.data());
+  Store* s = kv_open(path.c_str());
+  if (!s) {
+    std::fprintf(stderr, "kv_open failed\n");
+    return 1;
+  }
+  ts.clear();
+  for (int t = 0; t < 4; t++) {
+    ts.emplace_back([&, t] {
+      char key[32], val[32];
+      for (int i = 0; i < 5000; i++) {
+        int klen = std::snprintf(key, sizeof key, "k%d-%d", t, i % 100);
+        int vlen = std::snprintf(val, sizeof val, "v%d", i);
+        kv_put(s, (const uint8_t*)key, klen, (const uint8_t*)val, vlen);
+        uint8_t* out = nullptr;
+        uint32_t outlen = 0;
+        if (kv_get(s, (const uint8_t*)key, klen, &out, &outlen) == 0 && out)
+          kv_free(out);
+        if (i % 7 == 0) kv_delete(s, (const uint8_t*)key, klen);
+      }
+    });
+  }
+  for (auto& t : ts) t.join();
+  kv_close(s);
+  std::remove(path.c_str());
+  std::puts("tsan stress OK");
+  return 0;
+}
