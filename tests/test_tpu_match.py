@@ -366,3 +366,44 @@ def test_region_relocation_no_rebuild():
     for topic, rows in zip(topics, m.match_batch(topics)):
         assert norm(rows) == norm(trie.match(list(topic))), topic
     assert grew_in_place, "expected spare-tail relocation, got full rebuild"
+
+
+def test_windowed_matcher_property_parity():
+    """Hypothesis: random filter corpora (incl. $-prefixes, deep levels,
+    unicode words, churn) stay in exact parity with the trie oracle on the
+    windowed path."""
+    from hypothesis import given, settings, strategies as st
+
+    word = st.sampled_from(
+        ["a", "b", "c", "dev", "Ω", "x-y", "0", "$SYS", "metric"])
+    filt = st.lists(
+        st.one_of(word, st.sampled_from(["+", "#"])),
+        min_size=1, max_size=6,
+    ).filter(lambda f: "#" not in f[:-1])
+    topic = st.lists(word.filter(lambda w: w not in ("+", "#")),
+                     min_size=1, max_size=6)
+
+    @settings(max_examples=25, deadline=None)
+    @given(st.lists(filt, min_size=1, max_size=40),
+           st.lists(topic, min_size=1, max_size=12),
+           st.data())
+    def run(filters, topics, data):
+        m = _bucketed_matcher()
+        trie = SubscriptionTrie()
+        # pad with bulk filler so the bucketed layout engages
+        for i in range(3000):
+            f = [f"fill{i % 31}", f"x{i % 11}", "+"]
+            m.table.add(f, 100000 + i, None)
+            trie.add(list(f), 100000 + i, None)
+        for i, f in enumerate(filters):
+            m.table.add(list(f), i, None)
+            trie.add(list(f), i, None)
+        # churn: remove a random subset
+        for i, f in enumerate(filters):
+            if data.draw(st.booleans()):
+                m.table.remove(list(f), i)
+                trie.remove(list(f), i)
+        for t, rows in zip(topics, m.match_batch([tuple(t) for t in topics])):
+            assert norm(rows) == norm(trie.match(list(t))), t
+
+    run()

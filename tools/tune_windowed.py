@@ -1,0 +1,66 @@
+"""Parameter sweep for the windowed kernel on the real chip: batch size x
+tile width. Prints one line per config; run after any kernel change.
+
+Usage: python tools/tune_windowed.py [subs]
+"""
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def note(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def main():
+    if "--cpu" in sys.argv:
+        sys.argv.remove("--cpu")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    from bench import WindowedBench, build_corpus
+    from vernemq_tpu.models import tpu_matcher as TM
+    from vernemq_tpu.models.tpu_table import SubscriptionTable
+
+    subs = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    rng = random.Random(42)
+    table = SubscriptionTable(max_levels=8,
+                              initial_capacity=1 << (subs - 1).bit_length())
+    t0 = time.perf_counter()
+    pools = build_corpus(rng, subs, table)
+    note(f"corpus {time.perf_counter()-t0:.1f}s platform="
+         f"{jax.devices()[0].platform}")
+
+    best = None
+    for tile_pubs in (128, 256, 512):
+        TM.TILE_PUBS = tile_pubs
+        for B in (2048, 4096, 8192):
+            try:
+                wb = WindowedBench(jax, table, pools, rng, B, 256)
+                r = wb.run(20, warmup=8, measure_resolve=False)
+                line = (f"TP={tile_pubs} B={B}: "
+                        f"{r['matches_per_sec']/1e6:.2f}M matches/s "
+                        f"{r['publishes_per_sec']/1e3:.0f}k pubs/s "
+                        f"batch={r['batch_ms']:.2f}ms "
+                        f"enc={r['encode_ms']:.2f} prep={r['prep_ms']:.2f} "
+                        f"sync_p50={r['synced_batch_ms_p50']:.1f} "
+                        f"left={r['leftover_pubs']}")
+                note(line)
+                if best is None or r["matches_per_sec"] > best[0]:
+                    best = (r["matches_per_sec"], tile_pubs, B)
+            except Exception as e:
+                note(f"TP={tile_pubs} B={B} FAILED: {type(e).__name__}: "
+                     f"{str(e)[:120]}")
+    if best:
+        note(f"BEST: TILE_PUBS={best[1]} B={best[2]} "
+             f"{best[0]/1e6:.2f}M matches/s")
+
+
+if __name__ == "__main__":
+    main()
