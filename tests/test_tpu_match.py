@@ -261,7 +261,8 @@ def test_prepare_windows_invariants():
     (t_pw, t_pl, t_pd, t_start, tile_of, pos_of,
      leftovers) = prepare_windows(pw, pl, pd, pb, n, reg_start, reg_end,
                                   S, T, seg_max)
-    assert t_pw.shape == (T, Bpad // T, L)
+    from vernemq_tpu.models.tpu_matcher import TILE_PUBS
+    assert t_pw.shape == (T, TILE_PUBS, L)
     left = set(leftovers)
     for i in range(n):
         b = int(pb[i])

@@ -420,21 +420,24 @@ class ShardedWindowedMatcher:
         # per-shard pub assignment by bucket-row ownership
         shard_of = np.minimum(self._reg_start[pb] // Sl, nsub - 1).astype(int)
         Bsh = max(8, min(Bpad, _pow2ceil(2 * Bpad // nsub)))
-        T = max(1, Bsh // TILE_PUBS)
+        slot_tiles = max(1, Bsh // TILE_PUBS)
         bucket_max = (int((self._reg_end[1:] - self._reg_start[1:]).max())
                       if len(self._reg_start) > 1 else 0)
         # window must divide into 2048 blocks (packed extraction) and fit
         # the shard slice; Sl itself may not be 2048-aligned
         sl_cap = Sl - Sl % 2048
-        seg_max = min(_pow2ceil(max(4096, bucket_max, 2 * Sl // T)), sl_cap)
+        seg_max = min(_pow2ceil(max(4096, bucket_max, 2 * Sl // slot_tiles)),
+                      sl_cap)
+        # span budget: tiles close on window overflow even with free slots
+        T = slot_tiles + -(-Sl // seg_max) + 2
         gc = min(Bpad // self.nb, 1024)
-        t_pw = np.full((nsub, T, Bsh // T, L), np.int32(0), dtype=np.int32)
-        t_pl = np.zeros((nsub, T, Bsh // T), dtype=np.int32)
-        t_pd = np.zeros((nsub, T, Bsh // T), dtype=bool)
+        TP = TILE_PUBS
+        t_pw = np.full((nsub, T, TP, L), np.int32(0), dtype=np.int32)
+        t_pl = np.zeros((nsub, T, TP), dtype=np.int32)
+        t_pd = np.zeros((nsub, T, TP), dtype=bool)
         t_start = np.zeros((nsub, T), dtype=np.int32)
         tile_of = np.full(n, -1, dtype=np.int64)  # packed shard*T*TP + ...
         leftovers = set()
-        TP = Bsh // T
         for s in range(nsub):
             mine = np.nonzero(shard_of == s)[0]
             if len(mine) == 0:
