@@ -166,3 +166,30 @@ def test_windowed_sharded_dollar_and_unknown():
     got = m.match_batch(topics)
     for topic, rows in zip(topics, got):
         assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_windowed_sharded_relocation_churn():
+    """A bucket overflowing AFTER the sharded matcher is warm relocates
+    into the spare tail (owned by the last 'sub' shard): delta re-sync +
+    geometry refresh keep parity without a resize."""
+    table, trie, pools, rng = build_bucketed(29, 20_000, 1 << 15)
+    mesh = make_mesh(batch=2)
+    m = ShardedWindowedMatcher(table, mesh, max_fanout=128)
+    l0, l1, l2 = pools
+    topics = topics_for(rng, pools, 32) + [("hotword", "a", "b")]
+    got = m.match_batch(topics)  # warm
+    cap0 = table.cap
+    relocated = False
+    for i in range(8000):
+        f = ["hotword", f"d{i}", f"m{i % 5}"]
+        table.add(f, 500_000 + i, None)
+        trie.add(list(f), 500_000 + i, None)
+        if not table.resized and table.cap == cap0 and i > 100:
+            relocated = True
+        if table.resized:
+            break
+    probe = [("hotword", f"d{i}", f"m{i % 5}") for i in range(0, 8000, 257)]
+    probe += topics_for(rng, pools, 16)
+    got = m.match_batch(probe)
+    for topic, rows in zip(probe, got):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
