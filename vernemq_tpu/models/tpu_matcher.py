@@ -46,15 +46,21 @@ def window_params(S: int, glob_pad: int, bucket_max: int, Bpad: int):
     slot_tiles = max(1, Bpad // TILE_PUBS)
     fair = 2 * (S - glob_pad) // slot_tiles
     # pow2 ≥ 4096 (so %2048 holds for the packed extraction), clamped to S
-    # (dynamic_slice bound; S is 2048-aligned for any bucketed table)
-    seg_max = min(_pow2ceil(max(4096, bucket_max, fair)), S)
+    # (dynamic_slice bound; S is 2048-aligned for any bucketed table) AND
+    # to a memory cap: the [TP, seg] f32 mismatch intermediate must stay
+    # ~256MB or multi-million-row tables (5M+ subs) blow the compile —
+    # span tiles absorb the difference (same FLOPs, bounded memory)
+    SEG_CAP = 262_144
+    seg_max = min(_pow2ceil(max(4096, bucket_max, fair)),
+                  max(SEG_CAP, _pow2ceil(bucket_max)), S)
     # greedy packing closes a tile when its window span fills even if pub
     # slots remain, so tiles-needed ≈ slot tiles + span tiles; budget both
     # or overflow pubs fall to the host path (VERDICT r2: those scans are
     # the perf killer)
     span_tiles = -(-(S - glob_pad) // seg_max)
     T = slot_tiles + span_tiles + 2
-    gc = min(Bpad, 1024)
+    # global-phase pub chunk: [gc, glob_pad] f32 capped at ~1GB
+    gc = min(Bpad, max(256, (1 << 28) // max(glob_pad, 1)))
     return T, seg_max, gc
 
 
