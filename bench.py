@@ -149,21 +149,31 @@ class WindowedBench:
             "bench requires the bucketed windowed path"
 
     def _prep(self, topics):
-        from vernemq_tpu.models.tpu_matcher import (prepare_windows,
-                                                    window_params)
+        from vernemq_tpu.models.tpu_matcher import prepare_windows
 
         m = self.m
         t0 = time.perf_counter()
-        pw, pl, pd, pb = m._encode_batch_ex(topics)
+        pw, pl, pd, pb, gb = m._encode_batch_ex(topics)
         t1 = time.perf_counter()
         S = int(m._dev_arrays[0].shape[0])
-        bucket_max = int((m._reg_end[1:] - m._reg_start[1:]).max())
-        T, seg_max, gc = window_params(S, m._glob_pad, bucket_max,
-                                       pw.shape[0])
+        T, seg_max, gc, T2, seg2, gb_end = m._geometry(
+            S, m._glob_pad, m._reg_start, m._reg_end, pw.shape[0])
         tiles = prepare_windows(pw, pl, pd, pb, len(topics), m._reg_start,
-                                m._reg_end, S, T, seg_max)
+                                m._reg_end, S, T, seg_max, row_lo=gb_end)
+        tiles = (tiles[0], tiles[1], tiles[2], tiles[3] + gb_end) + tiles[4:]
+        if seg2:
+            tiles2 = prepare_windows(pw, pl, pd, gb, len(topics),
+                                     m._reg_start, m._reg_end, S, T2, seg2,
+                                     row_lo=m._glob_pad, row_hi=gb_end)
+            tiles2 = ((tiles2[0], tiles2[1], tiles2[2],
+                       tiles2[3] + m._glob_pad) + tiles2[4:])
+        else:
+            from vernemq_tpu.ops.match_kernel import empty_probe_tiles
+
+            tiles2 = empty_probe_tiles(tiles[0].shape[1], pw.shape[1]) + (
+                None, None, [])
         t2 = time.perf_counter()
-        return (pw, pl, pd, tiles, T, seg_max, gc,
+        return (pw, pl, pd, tiles, tiles2, seg_max, seg2, gc,
                 t1 - t0, t2 - t1)
 
     def submit(self, prep):
@@ -171,15 +181,17 @@ class WindowedBench:
         from vernemq_tpu.ops import match_kernel as K
 
         m = self.m
-        pw, pl, pd, tiles, T, seg_max, gc, _, _ = prep
-        t_pw, t_pl, t_pd, t_start, tile_of, pos_of, leftovers = tiles
+        pw, pl, pd, tiles, tiles2, seg_max, seg2, gc, _, _ = prep
+        t_pw, t_pl, t_pd, t_start = tiles[:4]
+        t2_pw, t2_pl, t2_pd, t2_start = tiles2[:4]
         F_t, t1 = m._operands
         out = K.match_extract_windowed(
             F_t, t1, m._dev_arrays[1], m._dev_arrays[2], m._dev_arrays[3],
             m._dev_arrays[4], pw, pl, pd, t_pw, t_pl, t_pd, t_start,
+            t2_pw, t2_pl, t2_pd, t2_start,
             id_bits=m._ops_bits, k=m.max_fanout, glob_pad=m._glob_pad,
-            seg_max=seg_max, gc=gc)
-        return out, len(leftovers)
+            seg_max=seg_max, seg2_max=seg2, gc=gc)
+        return out, len(tiles[6]) + len(tiles2[6])
 
     def run(self, iters, warmup=6, measure_resolve=True):
         import jax.numpy as jnp
@@ -199,17 +211,18 @@ class WindowedBench:
         counts = []
         for i in range(iters):
             p = self._prep(topics_batches[i % len(topics_batches)])
-            enc_ms += p[7]
-            prep_ms += p[8]
+            enc_ms += p[8]
+            prep_ms += p[9]
             out, nleft = self.submit(p)
             leftover_total += nleft
-            counts.append((out[2], out[5]))
-            acc = acc + out[2].sum() + out[5].sum()
+            counts.append((out[2], out[5], out[8]))
+            acc = acc + out[2].sum() + out[5].sum() + out[8].sum()
         np.asarray(acc)  # barrier derived from every batch
         elapsed = time.perf_counter() - t_start
         total_matches = int(sum(
             np.asarray(g).sum(dtype=np.int64)
-            + np.asarray(t).sum(dtype=np.int64) for g, t in counts))
+            + np.asarray(t).sum(dtype=np.int64)
+            + np.asarray(t2).sum(dtype=np.int64) for g, t, t2 in counts))
         # NOTE: tile counts include only window rows; global counts region
         # 0 — together they are exact per-pub match totals (padded tile
         # slots hold PAD pubs which match nothing concrete, but length 0

@@ -408,3 +408,65 @@ def test_windowed_matcher_property_parity():
             assert norm(rows) == norm(trie.match(list(t))), t
 
     run()
+
+
+def test_two_level_probe_parity():
+    """NG-active table (cap >= 32768 → level-1 g-buckets live): dense
+    region 0 shrinks to both-levels-wild filters; probes A+B together
+    stay in exact parity with the trie, including "+"/w1 filters, churn
+    on them, and 1-level topics."""
+    rng = random.Random(77)
+    m = TpuMatcher(max_levels=8, initial_capacity=1 << 16)
+    assert m.table.NG > 0
+    trie = SubscriptionTrie()
+
+    def add(f, k):
+        m.table.add(list(f), k, None)
+        trie.add(list(f), k, None)
+
+    # realistic fanout corpus: mostly exact / single-wildcard filters (a
+    # corpus_filter-style 5% bare-'#' rate puts EVERY pub's true fanout
+    # past max_fanout, which legitimately routes all pubs to the exact
+    # host path and makes the device-path assertion below meaningless)
+    for i in range(20000):
+        r = rng.random()
+        w = [f"r{rng.randrange(16)}", f"d{rng.randrange(40)}",
+             f"m{rng.randrange(16)}"]
+        if r < 0.6:
+            f = w
+        elif r < 0.8:
+            f = [w[0], "+", w[2]]
+        elif r < 0.9:
+            f = ["+", w[1], w[2]]
+        else:
+            f = [w[0], w[1], "#"]
+        add(f, i)
+    # heavy "+"-first population (the g-bucket zone)
+    for i in range(3000):
+        add(["+", f"d{rng.randrange(40)}", f"m{rng.randrange(16)}"],
+            100000 + i)
+    for i in range(200):
+        add(["+", "+", f"m{i % 16}"], 200000 + i)  # stays dense (region 0)
+        add(["#"], 300000 + i) if i == 0 else None
+    topics = [(f"r{i % 16}", f"d{i % 40}", f"m{i % 16}") for i in range(64)]
+    topics += [("nosub", f"d{i % 40}", "x") for i in range(8)]  # g-probe only
+    topics += [("r1",), ("r1", "d2")]  # short topics
+    for topic, rows in zip(topics, m.match_batch(topics)):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+    # churn in the g-zone: remove a slice of the "+"-first filters
+    removed = 0
+    for e in list(m.table.entries):
+        if e is not None and isinstance(e[1], int) and \
+                100000 <= e[1] < 103000 and removed % 7 == 0:
+            m.table.remove(list(e[0]), e[1])
+            trie.remove(list(e[0]), e[1])
+        if e is not None and isinstance(e[1], int) and \
+                100000 <= e[1] < 103000:
+            removed += 1
+    for topic, rows in zip(topics, m.match_batch(topics)):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+    # the DEVICE path must have served the bulk of these pubs: a kernel
+    # bug that blows per-pub counts silently degrades every pub to the
+    # exact host fallback and parity alone cannot see it
+    assert m.host_fallbacks < m.match_publishes // 4, (
+        m.host_fallbacks, m.match_publishes)

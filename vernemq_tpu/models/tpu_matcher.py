@@ -38,28 +38,34 @@ def _pow2ceil(n: int) -> int:
     return 1 << max(n - 1, 1).bit_length()
 
 
-def window_params(S: int, glob_pad: int, bucket_max: int, Bpad: int):
+def window_params(S: int, glob_pad: int, bucket_max: int, Bpad: int,
+                  zone: Optional[int] = None):
     """Static kernel geometry for a padded batch: tile count T (fixed per
     Bpad — shape-stable), window width seg_max (pow2, ≥ every bucket
-    region and ≥ 2x the per-tile fair share of the table), and the global
-    chunk gc. Together these bound recompiles to the Bpad ladder."""
+    region and ≥ 2x the per-tile fair share of the zone), and the dense
+    chunk gc. ``zone`` is the row span the tiles must cover (probe A: the
+    level-0 buckets; probe B: the g-bucket zone) — defaults to
+    S - glob_pad. Together these bound recompiles to the Bpad ladder."""
     slot_tiles = max(1, Bpad // TILE_PUBS)
-    fair = 2 * (S - glob_pad) // slot_tiles
-    # pow2 ≥ 4096 (so %2048 holds for the packed extraction), clamped to S
-    # (dynamic_slice bound; S is 2048-aligned for any bucketed table) AND
+    zone = (S - glob_pad) if zone is None else zone
+    zone = max(zone, 4096)  # bucketed zones are >=4096 and 2048-aligned
+    fair = 2 * zone // slot_tiles
+    # pow2 ≥ 4096 (so %2048 holds for the packed extraction), clamped to
+    # the zone (prepare_windows row bounds) and S (dynamic_slice bound) AND
     # to a memory cap: the [TP, seg] f32 mismatch intermediate must stay
     # ~256MB or multi-million-row tables (5M+ subs) blow the compile —
     # span tiles absorb the difference (same FLOPs, bounded memory)
     SEG_CAP = 262_144
     seg_max = min(_pow2ceil(max(4096, bucket_max, fair)),
-                  max(SEG_CAP, _pow2ceil(bucket_max)), S)
+                  max(SEG_CAP, _pow2ceil(bucket_max)),
+                  zone - zone % 2048, S)
     # greedy packing closes a tile when its window span fills even if pub
     # slots remain, so tiles-needed ≈ slot tiles + span tiles; budget both
     # or overflow pubs fall to the host path (VERDICT r2: those scans are
     # the perf killer)
-    span_tiles = -(-(S - glob_pad) // seg_max)
+    span_tiles = -(-zone // seg_max)
     T = slot_tiles + span_tiles + 2
-    # global-phase pub chunk: [gc, glob_pad] f32 capped at ~1GB
+    # dense-phase pub chunk: [gc, glob_pad] f32 capped at ~1GB
     gc = min(Bpad, max(256, (1 << 28) // max(glob_pad, 1)))
     return T, seg_max, gc
 
@@ -169,11 +175,12 @@ class TpuMatcher:
         self._bucketed = False
         self.match_batches = 0
         self.match_publishes = 0
+        self.host_fallbacks = 0  # pubs served by exact host match
         # encode cache: hot topics (zipf streams) skip per-word interner
         # lookups; invalidated when the interner or bucket layout changes
         # (a cached UNKNOWN word may since have been interned)
         self._enc_cache: Dict[Tuple[str, ...], int] = {}
-        self._enc_rows = np.zeros((1024, self.table.L + 3), dtype=np.int32)
+        self._enc_rows = np.zeros((1024, self.table.L + 4), dtype=np.int32)
         self._enc_gen: Tuple[int, int] = (-1, -1)
         # guards table mutation (event loop) vs sync/match (executor thread)
         self.lock = threading.Lock()
@@ -207,6 +214,8 @@ class TpuMatcher:
             self._reg_start = t.reg_start.copy()
             self._reg_end = (t.reg_start + t.reg_cap).copy()
             self._glob_pad = int(t.reg_cap[0])
+            self._gb_end = t.gb_end if t.bucketed else int(t.reg_cap[0])
+            self._ng = t.NG
             self._bucketed = t.bucketed
             t.resized = False
             t.dirty.clear()
@@ -278,12 +287,12 @@ class TpuMatcher:
             tp = tuple(tp)
             j = cache.get(tp)
             if j is None:
-                row, n, dollar, bucket = t.encode_topic_ex(tp)
+                row, n, dollar, bucket, gbucket = t.encode_topic_ex(tp)
                 j = len(cache)
                 if j >= rows.shape[0]:
                     if j >= 1 << 20:  # bound memory on adversarial streams
                         cache.clear()
-                        rows = np.zeros((1024, L + 3), dtype=np.int32)
+                        rows = np.zeros((1024, L + 4), dtype=np.int32)
                         self._enc_rows = rows  # release the grown buffer too
                         self._enc_gen = (-1, -1)
                         return self._encode_batch_ex(topics)
@@ -293,6 +302,7 @@ class TpuMatcher:
                 rows[j, L] = n
                 rows[j, L + 1] = dollar
                 rows[j, L + 2] = bucket
+                rows[j, L + 3] = gbucket
                 cache[tp] = j
             idxs[i] = j
         B = self._pad_batch(len(topics))
@@ -304,7 +314,8 @@ class TpuMatcher:
         pl[:len(topics)] = sel[:, L]
         pd[:len(topics)] = sel[:, L + 1].astype(bool)
         pb = sel[:, L + 2].copy()
-        return pw, pl, pd, pb
+        gb = sel[:, L + 3].copy()
+        return pw, pl, pd, pb, gb
 
     def match_batch(self, topics: Sequence[Sequence[str]]) -> List[List[Row]]:
         """Match a batch of publish topics; returns per-topic entry rows
@@ -320,7 +331,7 @@ class TpuMatcher:
             if bucketed:
                 reg_start, reg_end = self._reg_start, self._reg_end
                 glob_pad, bits = self._glob_pad, self._ops_bits
-                pw, pl, pd, pb = self._encode_batch_ex(topics)
+                pw, pl, pd, pb, gb = self._encode_batch_ex(topics)
             else:
                 pw, pl, pd = self.encode_batch(topics)
         self.match_batches += 1
@@ -328,7 +339,7 @@ class TpuMatcher:
         if bucketed:
             idx_rows, counts = self._match_windowed(
                 dev_arrays, operands, reg_start, reg_end, glob_pad, bits,
-                pw, pl, pd, pb, len(topics))
+                pw, pl, pd, pb, gb, len(topics))
         else:
             chunk = 1024 if pw.shape[0] > 1024 else 0  # lax.map serialises
             # full-scan fallback: MXU matmul path needs byte-splittable ids
@@ -353,6 +364,7 @@ class TpuMatcher:
             if counts[i] > self.max_fanout:
                 # truncated fanout: fall back to exact host matching for this
                 # topic so no subscriber is silently skipped
+                self.host_fallbacks += 1
                 rows = self._host_match(topic, snapshot)
             else:
                 with self.lock:
@@ -364,33 +376,71 @@ class TpuMatcher:
             out.append(rows)
         return out
 
+    def _geometry(self, S, glob_pad, reg_start, reg_end, Bpad):
+        """Static kernel geometry for both probes at this batch size."""
+        ng = self._ng
+        gb_end = self._gb_end
+        amax = (int((reg_end[1 + ng:] - reg_start[1 + ng:]).max())
+                if len(reg_start) > 1 + ng else 0)
+        T, seg_max, gc = window_params(S, glob_pad, amax, Bpad,
+                                       zone=S - gb_end)
+        if ng:
+            gmax = int((reg_end[1:1 + ng] - reg_start[1:1 + ng]).max())
+            T2, seg2, _ = window_params(S, glob_pad, gmax, Bpad,
+                                        zone=gb_end - glob_pad)
+        else:
+            T2, seg2 = 1, 0
+        return T, seg_max, gc, T2, seg2, gb_end
+
     def _match_windowed(self, dev_arrays, operands, reg_start, reg_end,
-                        glob_pad, bits, pw, pl, pd, pb, n):
-        """Run the windowed device path (the v3 production kernel);
-        returns (per-pub slot index lists, per-pub total counts) in
-        original batch order. Window-overflow pubs ("leftovers") are
-        matched exactly on the host — their count entry is forced past
-        max_fanout so the caller takes the host path for them."""
+                        glob_pad, bits, pw, pl, pd, pb, gb, n):
+        """Run the windowed device path (the production kernel): a dense
+        pass over region 0 plus probe-A (level-0 bucket) and probe-B
+        (level-1 g-bucket) window tiles; returns (per-pub slot index
+        lists, per-pub total counts) in original batch order.
+        Window-overflow pubs ("leftovers") are matched exactly on the
+        host — their count entry is forced past max_fanout so the caller
+        takes the host path for them."""
         S = int(dev_arrays[0].shape[0])
         k = self.max_fanout
-        bucket_max = (int((reg_end[1:] - reg_start[1:]).max())
-                      if len(reg_start) > 1 else 0)
-        T, seg_max, gc = window_params(S, glob_pad, bucket_max, pw.shape[0])
+        Bpad = pw.shape[0]
+        T, seg_max, gc, T2, seg2, gb_end = self._geometry(
+            S, glob_pad, reg_start, reg_end, Bpad)
         (t_pw, t_pl, t_pd, t_start, tile_of, pos_of,
          leftovers) = prepare_windows(pw, pl, pd, pb, n, reg_start,
-                                      reg_end, S, T, seg_max)
+                                      reg_end, S, T, seg_max,
+                                      row_lo=gb_end)
+        t_start = t_start + gb_end  # starts are row_lo-relative
+        if seg2:
+            (t2_pw, t2_pl, t2_pd, t2_start, tile2_of, pos2_of,
+             left2) = prepare_windows(pw, pl, pd, gb, n, reg_start,
+                                      reg_end, S, T2, seg2,
+                                      row_lo=glob_pad, row_hi=gb_end)
+            t2_start = t2_start + glob_pad
+        else:
+            t2_pw, t2_pl, t2_pd, t2_start = K.empty_probe_tiles(
+                t_pw.shape[1], pw.shape[1])
+            tile2_of = np.full(n, -1, np.int32)
+            pos2_of = np.zeros(n, np.int32)
+            left2 = []
         F_t, t1 = operands
-        gidx, gvalid, gcount, tidx, tvalid, tcount = K.match_extract_windowed(
+        (gidx, gvalid, gcount, tidx, tvalid, tcount,
+         t2idx, t2valid, t2count) = K.match_extract_windowed(
             F_t, t1, dev_arrays[1], dev_arrays[2], dev_arrays[3],
             dev_arrays[4], pw, pl, pd, t_pw, t_pl, t_pd, t_start,
-            id_bits=bits, k=k, glob_pad=glob_pad, seg_max=seg_max, gc=gc)
+            t2_pw, t2_pl, t2_pd, t2_start,
+            id_bits=bits, k=k, glob_pad=glob_pad, seg_max=seg_max,
+            seg2_max=seg2, gc=gc)
         gidx = np.asarray(gidx)
         gvalid = np.asarray(gvalid)
         gcount = np.asarray(gcount)
         tidx = np.asarray(tidx)
         tvalid = np.asarray(tvalid)
         tcount = np.asarray(tcount)
-        left = set(leftovers)
+        t2idx = np.asarray(t2idx)
+        t2valid = np.asarray(t2valid)
+        t2count = np.asarray(t2count)
+        left = set(leftovers) | set(left2)
         idx_rows, counts = [], np.zeros(n, dtype=np.int64)
         empty = np.zeros(0, dtype=np.int32)
         for i in range(n):
@@ -399,13 +449,18 @@ class TpuMatcher:
                 counts[i] = self.max_fanout + 1  # force exact host match
                 continue
             ti, j = tile_of[i], pos_of[i]
-            idx_rows.append(np.concatenate(
-                [gidx[i][gvalid[i]], tidx[ti, j][tvalid[ti, j]]]))
-            # per-part truncation: if either part clipped at k, report a
+            parts = [gidx[i][gvalid[i]], tidx[ti, j][tvalid[ti, j]]]
+            total = int(gcount[i]) + int(tcount[ti, j])
+            clipped = gcount[i] > k or tcount[ti, j] > k
+            if seg2:
+                t2i, j2 = tile2_of[i], pos2_of[i]
+                parts.append(t2idx[t2i, j2][t2valid[t2i, j2]])
+                total += int(t2count[t2i, j2])
+                clipped = clipped or t2count[t2i, j2] > k
+            idx_rows.append(np.concatenate(parts))
+            # per-part truncation: if any part clipped at k, report a
             # count > max_fanout so the caller takes the exact host path
-            counts[i] = (int(gcount[i]) + int(tcount[ti, j])
-                         if gcount[i] <= k and tcount[ti, j] <= k
-                         else self.max_fanout + 1)
+            counts[i] = total if not clipped else self.max_fanout + 1
         return idx_rows, counts
 
     def _host_match(self, topic: Sequence[str], snapshot=None) -> List[Row]:

@@ -137,11 +137,20 @@ class SubscriptionTable:
         arrays + free lists; the caller re-inserts entries."""
         big = total_hint >= 8192
         self.NB = _nb_for(total_hint)
+        # level-1 sub-buckets for wildcard-first filters ("+"/w1/...):
+        # the dense global phase shrinks to region 0 (both levels wild)
+        # while g-buckets get window probes like ordinary buckets
+        # NG >= 16 keeps the g-zone >= 4096 rows (window-geometry floor);
+        # smaller bucketed tables keep wildcard-first filters dense
+        self.NG = min(64, self.NB) if self.NB >= 16 else 0
         self._bucket_cache: Dict[int, int] = {}
+        self._gbucket_cache: Dict[int, int] = {}
         align = REGION_ALIGN if big else 8
-        nreg = self.NB + 1
+        nreg = 1 + self.NG + self.NB
         if need is None:
             need = [0] * nreg
+        if len(need) != nreg:
+            need = (need + [0] * nreg)[:nreg]
         # headroom: double each region's need, floor-split any spare hint
         spare = max(total_hint - 2 * sum(need), 0) // nreg
         caps = [max(2 * n + spare, align) for n in need]
@@ -149,6 +158,10 @@ class SubscriptionTable:
         if big:
             g = max(caps[0], GLOBAL_ALIGN)
             caps[0] = 1 << (g - 1).bit_length()  # pow2: bounds recompiles
+            # the g-zone boundary (end of the g-buckets) is the sharded
+            # dense-phase width — keep it GLOBAL_ALIGN-aligned
+            gz = sum(caps[:1 + self.NG])
+            caps[self.NG] += -gz % GLOBAL_ALIGN
             total = sum(caps)
             pad = -total % GLOBAL_ALIGN
             caps[-1] += pad
@@ -185,15 +198,34 @@ class SubscriptionTable:
         self.resized = True
         self.dirty.clear()
 
+    @property
+    def gb_end(self) -> int:
+        """End row of the g-zone (region 0 + level-1 g-buckets) — the
+        dense-phase width for consumers that match the whole wildcard-first
+        zone densely (the sharded matcher)."""
+        i = self.NG
+        return int(self.reg_start[i] + self.reg_cap[i])
+
     def _bucket_of_id(self, word0_id: int) -> int:
         b = self._bucket_cache.get(word0_id)
         if b is None:
-            b = _bucket_for(word0_id, self.NB)
+            b = self.NG + _bucket_for(word0_id, self.NB)
             self._bucket_cache[word0_id] = b
+        return b
+
+    def _gbucket_of_id(self, word1_id: int) -> int:
+        b = self._gbucket_cache.get(word1_id)
+        if b is None:
+            b = _bucket_for(word1_id, self.NG)
+            self._gbucket_cache[word1_id] = b
         return b
 
     def _region_of_filter(self, fw: Tuple[str, ...]) -> int:
         if not fw or fw[0] in (PLUS, HASH):
+            if (self.NG and len(fw) >= 2 and fw[0] == PLUS
+                    and fw[1] not in (PLUS, HASH)):
+                # "+"/w1/... pins level 1: level-1 g-bucket
+                return self._gbucket_of_id(self.interner.intern(fw[1]))
             return 0
         if self.NB == 1:
             return 1
@@ -206,6 +238,14 @@ class SubscriptionTable:
             return 1
         return self._bucket_of_id(word0_id)
 
+    def pub_gbucket(self, word1_id: int) -> int:
+        """Level-1 g-bucket a publish probes for wildcard-first filters
+        ("+"/w1/...). Topics with <2 levels probe g-bucket 1 (harmless:
+        nothing there can match them — g-bucket filters need >=2 levels)."""
+        if not self.NG:
+            return 0
+        return self._gbucket_of_id(word1_id)
+
     def _rebuild(self) -> None:
         """Repartition all regions (doubling total), re-homing every entry.
         Slot numbers change wholesale; ``resized`` forces the full upload
@@ -215,22 +255,33 @@ class SubscriptionTable:
         # on total, so pick NB first from the doubled hint, then count
         total_hint = max(2 * max(self.count, 1), self.cap)
         nb = _nb_for(total_hint)
+        ng = min(64, nb) if nb >= 16 else 0
         cache: Dict[int, int] = {}
-        need = [0] * (nb + 1)
+        gcache: Dict[int, int] = {}
+        need = [0] * (1 + ng + nb)
         for fw, _k, _v in old_entries:
             if not fw or fw[0] in (PLUS, HASH):
-                need[0] += 1
+                if (ng and len(fw) >= 2 and fw[0] == PLUS
+                        and fw[1] not in (PLUS, HASH)):
+                    wid = self.interner.intern(fw[1])
+                    g = gcache.get(wid)
+                    if g is None:
+                        g = _bucket_for(wid, ng)
+                        gcache[wid] = g
+                    need[g] += 1
+                else:
+                    need[0] += 1
             elif nb == 1:
                 need[1] += 1
             else:
                 wid = self.interner.intern(fw[0])
                 b = cache.get(wid)
                 if b is None:
-                    b = _bucket_for(wid, nb)
+                    b = ng + _bucket_for(wid, nb)
                     cache[wid] = b
                 need[b] += 1
         self._alloc_regions(total_hint, need)
-        assert self.NB == nb
+        assert self.NB == nb and self.NG == ng
         self._slot_of.clear()
         for fw, key, value in old_entries:
             self._insert(fw, key, value)
@@ -242,6 +293,12 @@ class SubscriptionTable:
         O(region) host work + dirty-slot scatter on the device — no resize,
         no recompile (S unchanged). Returns False when the spare is spent
         (caller falls back to the full rebuild)."""
+        if region <= self.NG:
+            # g-zone regions must stay inside [g00, gb_end): the sharded
+            # matcher covers that span densely and the two-probe kernel
+            # window-bounds probe B to it — relocating one out would
+            # silently hide its rows. Overflow there takes the rebuild.
+            return False
         old_start = int(self.reg_start[region])
         old_cap = int(self.reg_cap[region])
         new_cap = -(-2 * old_cap // REGION_ALIGN) * REGION_ALIGN
@@ -353,10 +410,14 @@ class SubscriptionTable:
         return row, len(topic), bool(topic) and topic[0].startswith("$")
 
     def encode_topic_ex(self, topic: Sequence[str]):
-        """encode_topic + the bucket region this topic's matches live in
-        (wildcard-first matches live in region 0, checked for every pub)."""
+        """encode_topic + the two probe regions: the level-0 bucket and
+        the level-1 g-bucket (wildcard-first filters with a concrete
+        level-1 word live there; the residual both-levels-wild region 0
+        is matched densely for every pub)."""
         row, n, dollar = self.encode_topic(topic)
-        return row, n, dollar, self.pub_bucket(int(row[0]) if n else UNKNOWN_ID)
+        w0 = int(row[0]) if n else UNKNOWN_ID
+        w1 = int(row[1]) if n >= 2 else UNKNOWN_ID
+        return (row, n, dollar, self.pub_bucket(w0), self.pub_gbucket(w1))
 
     def resolve(self, slots: Sequence[int]):
         """Matched slot indices → (filter, key, value) rows."""

@@ -487,73 +487,26 @@ def _epilogue(pub_len, pub_dollar, eff, hh, fw, act) -> jax.Array:
     return len_ok & ~(pub_dollar[:, None] & fw[None, :]) & act[None, :]
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("id_bits", "k", "glob_pad", "seg_max",
-                                    "gc"))
-def match_extract_windowed(
-    F_t: jax.Array,          # bf16 [K, S] coded operands (build_operands)
-    t1: jax.Array,           # f32 [S]
-    sub_eff_len: jax.Array,  # int32 [S]
-    has_hash: jax.Array,     # bool [S]
-    first_wild: jax.Array,   # bool [S]
-    active: jax.Array,       # bool [S]
-    pub_words: jax.Array,    # int32 [B, L]  original batch order
-    pub_len: jax.Array,      # int32 [B]
-    pub_dollar: jax.Array,   # bool [B]
-    t_pw: jax.Array,         # int32 [T, TP, L]  bucket-sorted pub tiles
-    t_pl: jax.Array,         # int32 [T, TP]
-    t_pd: jax.Array,         # bool [T, TP]
-    t_start: jax.Array,      # int32 [T] clamped window start per tile
-    *,
-    id_bits: int,
-    k: int,
-    glob_pad: int,           # region-0 width (wildcard-first rows), %2048
-    seg_max: int,            # window width, %2048
-    gc: int,                 # pub-chunk size for the global phase
-) -> Tuple[jax.Array, ...]:
-    """The v3 production match path — ONE fused executable per batch.
+def empty_probe_tiles(TP: int, L: int):
+    """Placeholder probe-B tile inputs for tables without a g-zone
+    (seg2_max=0 skips the group at trace time; shapes must still bind)."""
+    import numpy as np
 
-    Replaces :func:`match_extract_bucketed`'s greedy variable tiling +
-    ``lax.map``: per-execution overhead on the TPU runtime is ~5ms
-    regardless of op count (measured), ``lax.map`` serialises tile
-    launches, and variable tile counts recompile — so this kernel uses a
-    STATIC tile count with the loop unrolled at trace time.
+    return (np.zeros((1, TP, L), np.int32), np.zeros((1, TP), np.int32),
+            np.zeros((1, TP), bool), np.zeros(1, np.int32))
 
-    Two phases against the bucket-partitioned table (models/tpu_table.py):
 
-    1. GLOBAL: every publish × region 0 (wildcard-first filters), chunked
-       to ``gc`` pubs so the [gc, glob_pad] f32 mismatch intermediate
-       stays bounded (XLA materialises it when the pack epilogue blocks
-       matmul fusion — [B, S]-sized f32 at B=2048 OOMs the compile).
-    2. WINDOWS: publishes sorted by level-0 bucket, cut into T = B/TP
-       fixed tiles; tile i matmuls a traced-start ``dynamic_slice`` window
-       of ``seg_max`` contiguous rows (contiguous: no gathers — a
-       [T,K,R]-window gather measured 10-60x slower than the matmul it
-       feeds). Pubs whose bucket region exceeds their tile's window are
-       handled host-side (prepare_windows returns them as leftovers).
-
-    Returns ``(gidx, gvalid, gcount, tidx, tvalid, tcount)``; tile
-    indices are global slot ids. Exact — the coded matmul is bit-exact
-    (build_operands) and a row-guard keeps region-0 rows out of windows.
-    """
+def _window_tiles(F_t, t1, sub_eff_len, has_hash, first_wild, active,
+                  t_pw, t_pl, t_pd, t_start, *, id_bits, k, seg_max,
+                  glob_pad, wild_rows):
+    """Unrolled window-tile group: tile i matmuls a traced-start
+    ``dynamic_slice`` window of ``seg_max`` contiguous rows. ``wild_rows``
+    selects which rows this group may match: probe A (level-0 buckets)
+    matches only concrete-first rows, probe B (level-1 g-buckets) only
+    wildcard-first rows — the split is what makes A- and B-windows unable
+    to duplicate each other's matches even over the relocation spare
+    tail."""
     Kd = F_t.shape[0]
-    B = pub_words.shape[0]
-    gouts = []
-    for c in range(0, B, gc):
-        sl = slice(c, c + gc)
-        G = build_pub_operand(pub_words[sl], id_bits)
-        mm = lax.dot_general(
-            G, F_t[:, :glob_pad], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) + t1[None, :glob_pad]
-        m = (mm == 0.0) & _epilogue(
-            pub_len[sl], pub_dollar[sl], sub_eff_len[:glob_pad],
-            has_hash[:glob_pad], first_wild[:glob_pad], active[:glob_pad])
-        gouts.append(extract_indices_packed(_pack_mask(m), k, 2048))
-    gidx = jnp.concatenate([o[0] for o in gouts], axis=0)
-    gvalid = jnp.concatenate([o[1] for o in gouts], axis=0)
-    gcount = jnp.concatenate([o[2] for o in gouts], axis=0)
-
     T = t_pw.shape[0]
     j = jnp.arange(seg_max, dtype=jnp.int32)
     touts = []
@@ -571,14 +524,102 @@ def match_extract_windowed(
             preferred_element_type=jnp.float32,
         ) + t1s[None, :]
         rowok = j[None, :] >= (glob_pad - start)  # region 0 never re-matched
+        split = fws[None, :] if wild_rows else ~fws[None, :]
         m = (mm == 0.0) & _epilogue(
-            t_pl[ti], t_pd[ti], effs, hhs, fws, acts) & rowok
+            t_pl[ti], t_pd[ti], effs, hhs, fws, acts) & rowok & split
         i2, v2, c2 = extract_indices_packed(_pack_mask(m), k, 2048)
         touts.append((i2 + start, v2, c2))
-    tidx = jnp.stack([o[0] for o in touts])
-    tvalid = jnp.stack([o[1] for o in touts])
-    tcount = jnp.stack([o[2] for o in touts])
-    return gidx, gvalid, gcount, tidx, tvalid, tcount
+    return (jnp.stack([o[0] for o in touts]),
+            jnp.stack([o[1] for o in touts]),
+            jnp.stack([o[2] for o in touts]))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("id_bits", "k", "glob_pad", "seg_max",
+                                    "seg2_max", "gc"))
+def match_extract_windowed(
+    F_t: jax.Array,          # bf16 [K, S] coded operands (build_operands)
+    t1: jax.Array,           # f32 [S]
+    sub_eff_len: jax.Array,  # int32 [S]
+    has_hash: jax.Array,     # bool [S]
+    first_wild: jax.Array,   # bool [S]
+    active: jax.Array,       # bool [S]
+    pub_words: jax.Array,    # int32 [B, L]  original batch order
+    pub_len: jax.Array,      # int32 [B]
+    pub_dollar: jax.Array,   # bool [B]
+    t_pw: jax.Array,         # int32 [T, TP, L]  probe-A tiles (L0 buckets)
+    t_pl: jax.Array,         # int32 [T, TP]
+    t_pd: jax.Array,         # bool [T, TP]
+    t_start: jax.Array,      # int32 [T] clamped window start per tile
+    t2_pw: jax.Array,        # int32 [T2, TP, L] probe-B tiles (L1 g-buckets)
+    t2_pl: jax.Array,        # int32 [T2, TP]
+    t2_pd: jax.Array,        # bool [T2, TP]
+    t2_start: jax.Array,     # int32 [T2]
+    *,
+    id_bits: int,
+    k: int,
+    glob_pad: int,           # region-0 width (both-levels-wild rows), %2048
+    seg_max: int,            # probe-A window width, %2048
+    seg2_max: int,           # probe-B window width, %2048 (0 = no probe B)
+    gc: int,                 # pub-chunk size for the dense phase
+) -> Tuple[jax.Array, ...]:
+    """The production match path — ONE fused executable per batch.
+
+    Design notes (measured on the TPU runtime): per-execution overhead is
+    ~5ms regardless of op count, ``lax.map`` serialises tile launches,
+    variable tile counts recompile, F-window gathers are 10-60x slower
+    than the matmuls they feed, and [B, S] f32 intermediates OOM the
+    compile past B=1024 — hence static unrolled tiles over contiguous
+    ``dynamic_slice`` windows and a pub-chunked dense phase.
+
+    Three phases against the two-level bucket layout (models/tpu_table.py
+    — the trie's first- and second-edge narrowing as dense windows):
+
+    1. DENSE: every publish × region 0 (filters whose first TWO levels
+       are wildcards — a residual sliver), in ``gc`` pub chunks.
+    2. PROBE A: publishes tiled by their level-0 word's bucket; windows
+       match only concrete-first rows.
+    3. PROBE B: publishes tiled by their level-1 word's g-bucket
+       (wildcard-first filters with a concrete level 1); windows match
+       only wildcard-first rows.
+
+    Returns ``(gidx, gvalid, gcount, tidx, tvalid, tcount, t2idx,
+    t2valid, t2count)``; tile indices are global slot ids. Exact — the
+    coded matmul is bit-exact (build_operands) and the probe split +
+    row guard make double counting impossible.
+    """
+    B = pub_words.shape[0]
+    gouts = []
+    for c in range(0, B, gc):
+        sl = slice(c, c + gc)
+        G = build_pub_operand(pub_words[sl], id_bits)
+        mm = lax.dot_general(
+            G, F_t[:, :glob_pad], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + t1[None, :glob_pad]
+        m = (mm == 0.0) & _epilogue(
+            pub_len[sl], pub_dollar[sl], sub_eff_len[:glob_pad],
+            has_hash[:glob_pad], first_wild[:glob_pad], active[:glob_pad])
+        gouts.append(extract_indices_packed(_pack_mask(m), k, 2048))
+    gidx = jnp.concatenate([o[0] for o in gouts], axis=0)
+    gvalid = jnp.concatenate([o[1] for o in gouts], axis=0)
+    gcount = jnp.concatenate([o[2] for o in gouts], axis=0)
+
+    args = (F_t, t1, sub_eff_len, has_hash, first_wild, active)
+    tidx, tvalid, tcount = _window_tiles(
+        *args, t_pw, t_pl, t_pd, t_start, id_bits=id_bits, k=k,
+        seg_max=seg_max, glob_pad=glob_pad, wild_rows=False)
+    if seg2_max:
+        t2idx, t2valid, t2count = _window_tiles(
+            *args, t2_pw, t2_pl, t2_pd, t2_start, id_bits=id_bits, k=k,
+            seg_max=seg2_max, glob_pad=glob_pad, wild_rows=True)
+    else:
+        T2, TP = t2_pw.shape[0], t2_pw.shape[1]
+        t2idx = jnp.zeros((T2, TP, k), jnp.int32)
+        t2valid = jnp.zeros((T2, TP, k), bool)
+        t2count = jnp.zeros((T2, TP), jnp.int32)
+    return (gidx, gvalid, gcount, tidx, tvalid, tcount,
+            t2idx, t2valid, t2count)
 
 
 @functools.partial(jax.jit, static_argnames=("id_bits",))

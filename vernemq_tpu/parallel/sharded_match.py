@@ -192,12 +192,15 @@ def build_sharded_windowed(mesh: Mesh, *, id_bits: int, k: int,
     total-match count is psum-reduced over both mesh axes (ICI
     collectives) and returned replicated.
     """
+    import math
+
     nsub = mesh.shape["sub"]
     GW = glob_pad // nsub
-    # packed-extraction block: <=2048 and dividing the region width (GW is
-    # pow2/nsub-pow2, so itself pow2 — any pow2 <= GW divides it)
-    gblock = min(2048, GW)
-    assert glob_pad % nsub == 0 and seg_max <= Sl
+    # packed-extraction block: must divide the per-shard region width and
+    # be a multiple of 32 — GW is 2048-aligned/nsub, so gcd with 2048
+    # gives the largest valid block
+    gblock = math.gcd(GW, 2048)
+    assert glob_pad % nsub == 0 and seg_max <= Sl and gblock >= 32
 
     def local(F_sh, t1_sh, eff_sh, hh_sh, fw_sh, act_sh,
               Fg, t1g, effg, hhg, fwg, actg,
@@ -329,7 +332,10 @@ class ShardedWindowedMatcher:
             t.words, t.eff_len, id_bits=t.id_bits)
         F_t = np.asarray(F_t)
         t1 = np.asarray(t1)
-        glob = int(t.reg_cap[0])
+        # dense phase covers the whole g-zone (region 0 + level-1
+        # g-buckets): the sharded path keeps one dense probe (two-level
+        # probing is a single-chip optimisation for now)
+        glob = t.gb_end
         sF = NamedSharding(self.mesh, P(None, "sub"))
         s1 = NamedSharding(self.mesh, P("sub"))
         rep2 = NamedSharding(self.mesh, P(None, None))
@@ -385,7 +391,10 @@ class ShardedWindowedMatcher:
                      Fg, t1g, effg, hhg, fwg, actg)
 
     def _fn_for(self, Bpad: int, T: int, seg_max: int, gc: int):
-        key = (Bpad, T, seg_max, gc)
+        # _glob (the dense width) and _S (hence Sl) are baked into the
+        # compiled fn as Python constants — a rebuild can move them while
+        # leaving the other dims unchanged, so they must key the cache
+        key = (Bpad, T, seg_max, gc, self._glob, self._S)
         fn = self._fns.get(key)
         if fn is None:
             fn = build_sharded_windowed(
@@ -415,14 +424,18 @@ class ShardedWindowedMatcher:
         pd = np.zeros(Bpad, dtype=bool)
         pb = np.zeros(n, dtype=np.int32)
         for i, topic in enumerate(topics):
-            row, ln, dollar, bucket = self.table.encode_topic_ex(topic)
+            row, ln, dollar, bucket, _gb = self.table.encode_topic_ex(topic)
             pw[i], pl[i], pd[i], pb[i] = row, ln, dollar, bucket
         # per-shard pub assignment by bucket-row ownership
         shard_of = np.minimum(self._reg_start[pb] // Sl, nsub - 1).astype(int)
         Bsh = max(8, min(Bpad, _pow2ceil(2 * Bpad // nsub)))
         slot_tiles = max(1, Bsh // TILE_PUBS)
-        bucket_max = (int((self._reg_end[1:] - self._reg_start[1:]).max())
-                      if len(self._reg_start) > 1 else 0)
+        # level-0 buckets only: the g-zone (regions 1..NG) is matched
+        # densely here and must not inflate the window size
+        ng = self.table.NG
+        bucket_max = (int((self._reg_end[1 + ng:]
+                           - self._reg_start[1 + ng:]).max())
+                      if len(self._reg_start) > 1 + ng else 0)
         # window must divide into 2048 blocks (packed extraction) and fit
         # the shard slice; Sl itself may not be 2048-aligned
         sl_cap = Sl - Sl % 2048
