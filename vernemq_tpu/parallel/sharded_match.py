@@ -368,6 +368,12 @@ class ShardedWindowedMatcher:
         t = self.table
         slots = np.fromiter(t.dirty, dtype=np.int32)
         t.dirty.clear()
+        # pow2-pad the delta (idempotent duplicate writes) so distinct
+        # dirty counts don't each compile a fresh scatter
+        Dpad = _pow2ceil(len(slots))
+        if Dpad != len(slots):
+            slots = np.concatenate(
+                [slots, np.full(Dpad - len(slots), slots[-1], np.int32)])
         (F_t, t1, eff, hh, fw, act,
          Fg, t1g, effg, hhg, fwg, actg) = self._dev
         d_words = t.words[slots]
