@@ -448,27 +448,42 @@ class TpuMatcher:
         t2idx = np.asarray(t2idx)
         t2valid = np.asarray(t2valid)
         t2count = np.asarray(t2count)
+        # vectorised assembly: per-pub python indexing costs ~4ms/1024 pubs
+        # — one np.nonzero per part + row-split instead
+        def split_rows(idx2d, valid2d):
+            rows, cols = np.nonzero(valid2d)
+            vals = idx2d[rows, cols]
+            bounds = np.searchsorted(rows, np.arange(n + 1))
+            return vals, bounds
+
+        gv, gb_ = split_rows(gidx[:n], gvalid[:n])
+        ta_idx = tidx[tile_of, pos_of]        # [n, k]
+        ta_val = tvalid[tile_of, pos_of]
+        av, ab = split_rows(ta_idx, ta_val)
+        counts = gcount[:n].astype(np.int64) + tcount[tile_of, pos_of]
+        clipped = (gcount[:n] > k) | (tcount[tile_of, pos_of] > k)
+        if seg2:
+            tb_idx = t2idx[tile2_of, pos2_of]
+            tb_val = t2valid[tile2_of, pos2_of]
+            bv, bb = split_rows(tb_idx, tb_val)
+            counts = counts + t2count[tile2_of, pos2_of]
+            clipped = clipped | (t2count[tile2_of, pos2_of] > k)
         left = set(leftovers) | set(left2)
-        idx_rows, counts = [], np.zeros(n, dtype=np.int64)
+        # per-part truncation: if any part clipped at k, report a count
+        # > max_fanout so the caller takes the exact host path; leftovers
+        # (untiled pubs) force the same
+        counts[clipped] = self.max_fanout + 1
+        idx_rows = []
         empty = np.zeros(0, dtype=np.int32)
         for i in range(n):
             if i in left:
                 idx_rows.append(empty)
-                counts[i] = self.max_fanout + 1  # force exact host match
+                counts[i] = self.max_fanout + 1
                 continue
-            ti, j = tile_of[i], pos_of[i]
-            parts = [gidx[i][gvalid[i]], tidx[ti, j][tvalid[ti, j]]]
-            total = int(gcount[i]) + int(tcount[ti, j])
-            clipped = gcount[i] > k or tcount[ti, j] > k
+            parts = [gv[gb_[i]:gb_[i + 1]], av[ab[i]:ab[i + 1]]]
             if seg2:
-                t2i, j2 = tile2_of[i], pos2_of[i]
-                parts.append(t2idx[t2i, j2][t2valid[t2i, j2]])
-                total += int(t2count[t2i, j2])
-                clipped = clipped or t2count[t2i, j2] > k
+                parts.append(bv[bb[i]:bb[i + 1]])
             idx_rows.append(np.concatenate(parts))
-            # per-part truncation: if any part clipped at k, report a
-            # count > max_fanout so the caller takes the exact host path
-            counts[i] = total if not clipped else self.max_fanout + 1
         return idx_rows, counts
 
     def _host_match(self, topic: Sequence[str], snapshot=None) -> List[Row]:
