@@ -482,6 +482,10 @@ def main() -> int:
         "batch": args.batch,
         "configs": configs,
     }
+    if platform == "cpu":
+        result["note"] = (
+            "CPU smoke run (accelerator unreachable or forced): "
+            "reduced scale, not comparable to TPU numbers")
     if headline is not None:
         result.update({
             "publishes_per_sec": round(headline["publishes_per_sec"]),
