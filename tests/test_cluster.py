@@ -628,3 +628,36 @@ async def test_partial_ae_transfers_delta_not_state():
         assert 0 < moved["entries"] < 500, moved
     finally:
         await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_cross_node_pubsub_tpu_view():
+    """Cross-node fanout with default_reg_view='tpu' on both nodes: remote
+    subscriptions collapse to per-node pointer rows in the DEVICE table,
+    and a publish on either node reaches the remote subscriber through
+    the batched matcher (the vmq_reg_trie remote-entry seam,
+    vmq_reg_trie.erl:503-520, on the TPU path)."""
+    nodes = await make_cluster(2, default_reg_view="tpu")
+    try:
+        a, b = nodes
+        sub = await connected(a, "tsub")
+        await sub.subscribe("tv/+/x", qos=1)
+        pub = await connected(b, "tpub")
+        await pub.publish("tv/1/x", b"cross", qos=1)
+        m = await sub.recv()
+        assert m.payload == b"cross"
+        # local fanout on the same node too
+        sub2 = await connected(b, "tsub2")
+        await sub2.subscribe("tv/#", qos=0)
+        await pub.publish("tv/2/x", b"both", qos=1)
+        assert (await sub.recv()).payload == b"both"
+        assert (await sub2.recv()).payload == b"both"
+        # unsubscribe propagates through the device table delta stream
+        await sub.unsubscribe("tv/+/x")
+        await pub.publish("tv/3/x", b"only2", qos=0)
+        assert (await sub2.recv()).payload == b"only2"
+        assert sub.messages.empty()
+        for c in (sub, sub2, pub):
+            await c.disconnect()
+    finally:
+        await stop_cluster(nodes)
