@@ -661,3 +661,37 @@ async def test_cross_node_pubsub_tpu_view():
             await c.disconnect()
     finally:
         await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_shared_subscription_cross_node_tpu_view():
+    """$share group rows through the DEVICE matcher in a 2-node cluster:
+    prefer_local picks the publisher-side member; member departure fails
+    over to the remote member via remote enqueue — the
+    vmq_shared_subscriptions.erl:26-63 flow with the fold served by the
+    TPU table's group rows."""
+    nodes = await make_cluster(2, default_reg_view="tpu")
+    try:
+        a, b = nodes
+        local = await connected(a, "s-local")
+        remote = await connected(b, "s-remote")
+        await local.subscribe("$share/g2/jobs/#", qos=0)
+        await remote.subscribe("$share/g2/jobs/#", qos=0)
+        view = a.broker.registry.reg_view("tpu")
+        await wait_until(
+            lambda: len(view.fold("", ["jobs", "1"])) == 2)
+        pub = await connected(a, "s-pub")
+        for i in range(4):
+            await pub.publish("jobs/1", b"t%d" % i, qos=0)
+        for i in range(4):
+            assert (await local.recv()).payload == b"t%d" % i
+        with pytest.raises(asyncio.TimeoutError):
+            await remote.recv(timeout=0.3)
+        await local.disconnect()
+        await wait_until(lambda: len(view.fold("", ["jobs", "1"])) == 1)
+        await pub.publish("jobs/2", b"fo", qos=0)
+        assert (await remote.recv()).payload == b"fo"
+        await remote.disconnect()
+        await pub.disconnect()
+    finally:
+        await stop_cluster(nodes)
