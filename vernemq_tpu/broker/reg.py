@@ -624,11 +624,30 @@ class Registry:
     def stats(self) -> Dict[str, float]:
         total = sum(len(t) for t in self._tries.values())
         mem = sum(t.stats()["memory"] for t in self._tries.values())
-        return {
+        out = {
             "router_subscriptions": total,
             "router_memory": mem,
             "queue_processes": len(self.queues),
         }
+        # device-matcher gauges when the TPU reg view is live (the
+        # router_subscriptions/router_memory pair extended with the HBM
+        # table's health — fallbacks rising means fanouts exceed
+        # tpu_max_fanout and the exact host path is absorbing them)
+        tpu = self.reg_views.get("tpu")
+        if tpu is not None:
+            for mp, m in getattr(tpu, "_matchers", {}).items():
+                ts = m.table.stats()
+                out["tpu_table_rows"] = out.get("tpu_table_rows", 0) + \
+                    ts["subscriptions"]
+                out["tpu_table_bytes"] = out.get("tpu_table_bytes", 0) + \
+                    ts["table_bytes"]
+                out["tpu_match_batches"] = out.get("tpu_match_batches", 0) \
+                    + m.match_batches
+                out["tpu_match_publishes"] = \
+                    out.get("tpu_match_publishes", 0) + m.match_publishes
+                out["tpu_host_fallbacks"] = \
+                    out.get("tpu_host_fallbacks", 0) + m.host_fallbacks
+        return out
 
     def fold_subscriptions(self, mountpoint: str = ""):
         """Iterate every (filter, key, opts) — warm-load feed for the TPU
