@@ -470,3 +470,34 @@ def test_two_level_probe_parity():
     # exact host fallback and parity alone cannot see it
     assert m.host_fallbacks < m.match_publishes // 4, (
         m.host_fallbacks, m.match_publishes)
+
+
+@pytest.mark.asyncio
+async def test_tpu_view_degrades_to_trie_when_accelerator_down(event_loop):
+    """default_reg_view=tpu with an unreachable/hung accelerator must not
+    freeze the broker: the reg-view seam degrades loudly to the host trie
+    and traffic flows."""
+    from vernemq_tpu.broker import reg as regmod
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    old = regmod._accel_probe_result
+    regmod._accel_probe_result = False  # simulate a wedged tunnel
+    try:
+        b, s = await start_broker(
+            Config(systree_enabled=False, allow_anonymous=True,
+                   default_reg_view="tpu"), port=0)
+        try:
+            c = MQTTClient(s.host, s.port, client_id="fb")
+            await c.connect()
+            await c.subscribe("d/#", qos=0)
+            await c.publish("d/x", b"alive", qos=0)
+            assert (await c.recv()).payload == b"alive"
+            assert b.registry.reg_views["tpu"] is b.registry.reg_views["trie"]
+            await c.disconnect()
+        finally:
+            await b.stop()
+            await s.stop()
+    finally:
+        regmod._accel_probe_result = old

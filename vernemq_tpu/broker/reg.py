@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import logging
 import random
 import time
 from typing import TYPE_CHECKING, Any, Dict, Iterable, List, Optional, Sequence, Tuple
@@ -39,6 +40,8 @@ from .subscriber_db import SubscriberDB, SubscriberRecord, opts_to_dict
 
 if TYPE_CHECKING:
     from .broker import Broker
+
+log = logging.getLogger("vernemq_tpu.reg")
 
 
 class RetainedMsg:
@@ -67,6 +70,39 @@ class TrieRegView:
         """Yield match rows: (filter, key, subopts). Keys are SubscriberId
         for plain subs or ("$g", group, SubscriberId) for shared subs."""
         return self._registry.trie(mountpoint).match(topic)
+
+
+_accel_probe_result: Optional[bool] = None
+
+
+def _probe_accelerator(timeout: float = 60.0) -> bool:
+    """True iff the default JAX backend initialises and executes. Runs in
+    a SUBPROCESS with a hard timeout: a wedged accelerator tunnel hangs
+    backend init indefinitely and holds a process-wide lock, so an
+    in-process attempt can never be abandoned (bench.py learned this the
+    hard way in r1). The subprocess honours JAX_PLATFORMS via jax.config
+    because this image's jax ignores the env var."""
+    global _accel_probe_result
+    if _accel_probe_result is not None:
+        return _accel_probe_result
+    import subprocess
+    import sys as _sys
+
+    code = (
+        "import os, jax, numpy as np, jax.numpy as jnp\n"
+        "p = os.environ.get('JAX_PLATFORMS')\n"
+        "if p and p != 'axon':\n"
+        "    jax.config.update('jax_platforms', p)\n"
+        "np.asarray((jax.device_put(jnp.ones((8, 8))) + 1).sum())\n"
+    )
+    try:
+        r = subprocess.run([_sys.executable, "-c", code],
+                           capture_output=True, timeout=timeout)
+        _accel_probe_result = r.returncode == 0
+    except subprocess.SubprocessError:
+        _accel_probe_result = False
+    return _accel_probe_result
+
 
 
 class Registry:
@@ -120,6 +156,17 @@ class Registry:
         name = name or self.broker.config.default_reg_view
         view = self.reg_views.get(name)
         if view is None and name == "tpu":
+            if not _probe_accelerator():
+                # a wedged accelerator tunnel HANGS jax backend init
+                # (holding a process-wide lock), which would freeze the
+                # whole broker at the first publish — degrade loudly to
+                # the host trie instead (the reg-view seam is exactly the
+                # place the reference lets deployments pick a view)
+                log.error("accelerator backend unavailable/hung; "
+                          "default_reg_view=tpu falling back to the host "
+                          "trie view")
+                self.reg_views["tpu"] = self.reg_views["trie"]
+                return self.reg_views["trie"]
             from ..models.tpu_matcher import TpuRegView
 
             view = self.reg_views["tpu"] = TpuRegView(
@@ -128,6 +175,15 @@ class Registry:
         if view is None:
             raise KeyError(f"unknown reg view {name!r}")
         return view
+
+    def batched_view_active(self) -> bool:
+        """True when sessions should publish through the BatchCollector —
+        i.e. the configured view is the TPU engine AND it actually came up
+        (the accelerator-down fallback swaps in the trie view, which has
+        no batch interface)."""
+        if self.broker.config.default_reg_view != "tpu":
+            return False
+        return hasattr(self.reg_view("tpu"), "fold_batch")
 
     # -- session registration ---------------------------------------------
 
@@ -383,7 +439,9 @@ class Registry:
         vmq_reg_trie consuming subscriber-db change events; BASELINE
         config 5 trie-delta streaming)."""
         view = self.reg_views.get("tpu")
-        if view is not None:
+        if view is not None and hasattr(view, "on_delta"):
+            # (the accelerator-down fallback aliases "tpu" to the trie
+            # view, which is fed through the trie events directly)
             view.on_delta(op, mountpoint, filter_words, key, opts)
 
     def unsubscribe(self, sid: SubscriberId, topics: List[List[str]]) -> List[bool]:

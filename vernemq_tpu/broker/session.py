@@ -543,7 +543,7 @@ class Session:
         submits without awaiting the batch window so one publisher can fill
         a batch instead of sending one message per window."""
         try:
-            if self.broker.config.default_reg_view == "tpu":
+            if self.broker.registry.batched_view_active():
                 if nowait:
                     n = self.broker.registry.publish_nowait(msg, from_sid=self.sid)
                 else:
