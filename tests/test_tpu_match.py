@@ -501,3 +501,53 @@ async def test_tpu_view_degrades_to_trie_when_accelerator_down(event_loop):
             await s.stop()
     finally:
         regmod._accel_probe_result = old
+
+
+@pytest.mark.asyncio
+async def test_tpu_view_recovers_when_accelerator_returns(event_loop):
+    """The degraded broker re-probes and swaps the real TPU view back in
+    when the accelerator recovers — no restart."""
+    import asyncio
+
+    from vernemq_tpu.broker import reg as regmod
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    old = regmod._accel_probe_result
+    regmod._accel_probe_result = False
+    b = s = None
+    try:
+        b, s = await start_broker(
+            Config(systree_enabled=False, allow_anonymous=True,
+                   default_reg_view="tpu"), port=0)
+        b.registry._arm_accel_recovery(interval=0.05)
+        assert not b.registry.batched_view_active()
+        # keep the fallback cached for the first re-probe, then "recover"
+        orig_probe = regmod._probe_accelerator
+
+        def fake_probe(timeout=60.0):
+            regmod._accel_probe_result = True
+            return True
+
+        regmod._probe_accelerator = fake_probe
+        try:
+            for _ in range(100):
+                await asyncio.sleep(0.05)
+                if b.registry.batched_view_active():
+                    break
+            assert b.registry.batched_view_active()
+        finally:
+            regmod._probe_accelerator = orig_probe
+        # traffic flows through the recovered engine
+        c = MQTTClient(s.host, s.port, client_id="rc")
+        await c.connect()
+        await c.subscribe("r/#", qos=0)
+        await c.publish("r/1", b"back", qos=0)
+        assert (await c.recv()).payload == b"back"
+        await c.disconnect()
+    finally:
+        regmod._accel_probe_result = old
+        if b is not None:
+            await b.stop()
+            await s.stop()

@@ -161,11 +161,14 @@ class Registry:
                 # (holding a process-wide lock), which would freeze the
                 # whole broker at the first publish — degrade loudly to
                 # the host trie instead (the reg-view seam is exactly the
-                # place the reference lets deployments pick a view)
+                # place the reference lets deployments pick a view) and
+                # keep re-probing so the engine comes back without a
+                # broker restart
                 log.error("accelerator backend unavailable/hung; "
                           "default_reg_view=tpu falling back to the host "
-                          "trie view")
+                          "trie view (will re-probe)")
                 self.reg_views["tpu"] = self.reg_views["trie"]
+                self._arm_accel_recovery()
                 return self.reg_views["trie"]
             from ..models.tpu_matcher import TpuRegView
 
@@ -175,6 +178,32 @@ class Registry:
         if view is None:
             raise KeyError(f"unknown reg view {name!r}")
         return view
+
+    def _arm_accel_recovery(self, interval: float = 60.0) -> None:
+        """Supervised re-probe loop: when the accelerator comes back, swap
+        the real TPU view in (sessions notice via batched_view_active on
+        their next publish)."""
+        sup = getattr(self.broker, "supervisor", None)
+        if sup is None or "accel-recovery" in sup._tasks:
+            return
+
+        async def recover():
+            global _accel_probe_result
+            loop = asyncio.get_event_loop()
+            while True:
+                await asyncio.sleep(interval)
+                _accel_probe_result = None  # bypass the cache
+                ok = await loop.run_in_executor(None, _probe_accelerator)
+                if ok:
+                    from ..models.tpu_matcher import TpuRegView
+
+                    self.reg_views["tpu"] = TpuRegView(
+                        self, max_fanout=self.broker.config.tpu_max_fanout)
+                    log.warning("accelerator recovered; TPU reg view "
+                                "re-enabled")
+                    return
+
+        sup.spawn("accel-recovery", recover)
 
     def batched_view_active(self) -> bool:
         """True when sessions should publish through the BatchCollector —
