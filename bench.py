@@ -123,21 +123,24 @@ def zipf_topics(rng: random.Random, pools, n: int):
 # ----------------------------------------------------- device-path driver
 
 class WindowedBench:
-    """Drives the production windowed kernel exactly the way
-    TpuMatcher._match_windowed does (same prepare_windows + kernel), with
-    pipelined submission: encode/prep of batch i+1 overlaps the device on
-    batch i (async dispatch); one checksum derived from every batch is
-    pulled at the end as the honest barrier."""
+    """Drives the production flat-compaction kernel exactly the way
+    TpuMatcher._match_windowed does (same prepare_windows emit="sel" +
+    match_extract_windowed_flat), with pipelined submission: encode/prep
+    of batch i+1 overlaps the device on batch i, and every batch's FULL
+    result (flat ids + prefixes + totals + overflow) is pulled to host —
+    the honest production round trip, overlapped ``depth`` batches deep."""
 
-    def __init__(self, jax, table, pools, rng, batch, max_fanout=256):
+    def __init__(self, jax, table, pools, rng, batch, max_fanout=256,
+                 flat_avg=128, depth=3):
         from vernemq_tpu.models.tpu_matcher import TpuMatcher
 
         self.jax = jax
         self.rng = rng
         self.pools = pools
         self.batch = batch
+        self.depth = depth
         self.m = TpuMatcher(max_levels=table.L, initial_capacity=16,
-                            max_fanout=max_fanout)
+                            max_fanout=max_fanout, flat_avg=flat_avg)
         self.m.table = table
         table.resized = True  # force first full upload for this matcher
         t0 = time.perf_counter()
@@ -149,53 +152,31 @@ class WindowedBench:
             "bench requires the bucketed windowed path"
 
     def _prep(self, topics):
-        from vernemq_tpu.models.tpu_matcher import prepare_windows
-
+        """The exact production host prep (TpuMatcher._flat_prep), with
+        encode/prep timed separately."""
         m = self.m
         t0 = time.perf_counter()
         pw, pl, pd, pb, gb = m._encode_batch_ex(topics)
         t1 = time.perf_counter()
         S = int(m._dev_arrays[0].shape[0])
-        T, seg_max, gc, T2, seg2, gb_end = m._geometry(
-            S, m._glob_pad, m._reg_start, m._reg_end, pw.shape[0])
-        tiles = prepare_windows(pw, pl, pd, pb, len(topics), m._reg_start,
-                                m._reg_end, S, T, seg_max, row_lo=gb_end)
-        tiles = (tiles[0], tiles[1], tiles[2], tiles[3] + gb_end) + tiles[4:]
-        if seg2:
-            tiles2 = prepare_windows(pw, pl, pd, gb, len(topics),
-                                     m._reg_start, m._reg_end, S, T2, seg2,
-                                     row_lo=m._glob_pad, row_hi=gb_end)
-            tiles2 = ((tiles2[0], tiles2[1], tiles2[2],
-                       tiles2[3] + m._glob_pad) + tiles2[4:])
-        else:
-            from vernemq_tpu.ops.match_kernel import empty_probe_tiles
-
-            tiles2 = empty_probe_tiles(tiles[0].shape[1], pw.shape[1]) + (
-                None, None, [])
+        args, statics, left = m._flat_prep(
+            m._reg_start, m._reg_end, m._glob_pad, m._ops_bits, S,
+            pw, pl, pd, pb, gb, len(topics))
         t2 = time.perf_counter()
-        return (pw, pl, pd, tiles, tiles2, seg_max, seg2, gc,
-                t1 - t0, t2 - t1)
+        return args, statics, t1 - t0, t2 - t1, len(left)
 
     def submit(self, prep):
-        """Dispatch ONE device call; returns (count arrays…) WITHOUT sync."""
+        """Dispatch ONE device call; returns device refs WITHOUT sync."""
         from vernemq_tpu.ops import match_kernel as K
 
         m = self.m
-        pw, pl, pd, tiles, tiles2, seg_max, seg2, gc, _, _ = prep
-        t_pw, t_pl, t_pd, t_start = tiles[:4]
-        t2_pw, t2_pl, t2_pd, t2_start = tiles2[:4]
+        args, statics, _, _, _ = prep
         F_t, t1 = m._operands
-        out = K.match_extract_windowed(
+        return K.match_extract_windowed_flat(
             F_t, t1, m._dev_arrays[1], m._dev_arrays[2], m._dev_arrays[3],
-            m._dev_arrays[4], pw, pl, pd, t_pw, t_pl, t_pd, t_start,
-            t2_pw, t2_pl, t2_pd, t2_start,
-            id_bits=m._ops_bits, k=m.max_fanout, glob_pad=m._glob_pad,
-            seg_max=seg_max, seg2_max=seg2, gc=gc)
-        return out, len(tiles[6]) + len(tiles2[6])
+            m._dev_arrays[4], *args, **statics)
 
     def run(self, iters, warmup=6, measure_resolve=True):
-        import jax.numpy as jnp
-
         topics_batches = [zipf_topics(self.rng, self.pools, self.batch)
                           for _ in range(min(iters, 8))]
         # warmup: compile + first-run executable warm (first executions on
@@ -203,38 +184,44 @@ class WindowedBench:
         enc_ms = prep_ms = 0.0
         for i in range(warmup):
             p = self._prep(topics_batches[i % len(topics_batches)])
-            out, _ = self.submit(p)
-            np.asarray(out[2]).sum()
+            out = self.submit(p)
+            np.asarray(out[0])
+
+        def pull(out):
+            # the production round trip: every result array to host
+            flat = np.asarray(out[0])
+            pre = np.asarray(out[1])
+            total = np.asarray(out[2])
+            ovf = np.asarray(out[3])
+            return int(total.sum(dtype=np.int64)), int(ovf.sum())
+
         leftover_total = 0
+        total_matches = 0
+        overflow_pubs = 0
+        inflight = []
         t_start = time.perf_counter()
-        acc = jnp.zeros((), jnp.int32)
-        counts = []
         for i in range(iters):
             p = self._prep(topics_batches[i % len(topics_batches)])
-            enc_ms += p[8]
-            prep_ms += p[9]
-            out, nleft = self.submit(p)
-            leftover_total += nleft
-            counts.append((out[2], out[5], out[8]))
-            acc = acc + out[2].sum() + out[5].sum() + out[8].sum()
-        np.asarray(acc)  # barrier derived from every batch
+            enc_ms += p[2]
+            prep_ms += p[3]
+            leftover_total += p[4]
+            inflight.append(self.submit(p))
+            if len(inflight) >= self.depth:
+                tm, ov = pull(inflight.pop(0))
+                total_matches += tm
+                overflow_pubs += ov
+        for out in inflight:
+            tm, ov = pull(out)
+            total_matches += tm
+            overflow_pubs += ov
         elapsed = time.perf_counter() - t_start
-        total_matches = int(sum(
-            np.asarray(g).sum(dtype=np.int64)
-            + np.asarray(t).sum(dtype=np.int64)
-            + np.asarray(t2).sum(dtype=np.int64) for g, t, t2 in counts))
-        # NOTE: tile counts include only window rows; global counts region
-        # 0 — together they are exact per-pub match totals (padded tile
-        # slots hold PAD pubs which match nothing concrete, but length 0
-        # can match a bare-'#' filter; the corpus has none at level 0).
 
         # synced round-trip latency (tunnel RTT included — see module doc)
         lat = []
         for i in range(min(6, iters)):
             p = self._prep(topics_batches[i % len(topics_batches)])
             t1 = time.perf_counter()
-            out, _ = self.submit(p)
-            np.asarray(out[2]).sum()
+            pull(self.submit(p))
             lat.append(time.perf_counter() - t1)
 
         resolve_ms = None
@@ -255,6 +242,7 @@ class WindowedBench:
             "synced_batch_ms_p99": 1e3 * float(np.percentile(lat, 99)),
             "full_path_batch_ms": resolve_ms,
             "leftover_pubs": leftover_total,
+            "overflow_pubs": overflow_pubs,
             "upload_s": round(self.upload_s, 3),
         }
 

@@ -153,7 +153,7 @@ async def test_broker_e2e_with_tpu_reg_view(event_loop):
 
 # ---------------------------------------------------------------------------
 # Bucketed path (level-0 bucket narrowing — models/tpu_table.py regions +
-# ops/match_kernel.match_extract_windowed). A big initial capacity forces
+# ops/match_kernel.match_extract_windowed_flat). A big initial capacity forces
 # NB > 1 so these run the windowed device path, not the full scan.
 # ---------------------------------------------------------------------------
 
@@ -551,3 +551,42 @@ async def test_tpu_view_recovers_when_accelerator_returns(event_loop):
         if b is not None:
             await b.stop()
             await s.stop()
+
+
+def test_flat_capacity_overflow_falls_back_exact():
+    """A batch whose total fanout exceeds the flat buffer (C =
+    Bpad*flat_avg) must stay exact: overflowed pubs take the host path
+    instead of losing matches (match_extract_windowed_flat's overflow
+    contract)."""
+    rng = random.Random(7)
+    m = _bucketed_matcher(max_fanout=256, flat_avg=1)  # C == Bpad: tiny
+    trie = SubscriptionTrie()
+    for i in range(9000):
+        f = corpus_filter(rng)
+        m.table.add(f, i, None)
+        trie.add(list(f), i, None)
+    topics = [(f"r{rng.randrange(16)}", f"d{rng.randrange(40)}",
+               f"m{rng.randrange(16)}") for _ in range(64)]
+    before = m.host_fallbacks
+    for topic, rows in zip(topics, m.match_batch(topics)):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+    assert m.host_fallbacks > before  # the tiny flat buffer did overflow
+
+
+def test_flat_padded_batch_tail_is_inert():
+    """Real pubs < padded batch: pad rows must contribute nothing to the
+    flat prefix (a bare-'#' filter matches the zero-length pad topic —
+    the n_real mask must exclude it)."""
+    m = _bucketed_matcher(max_fanout=64)
+    trie = SubscriptionTrie()
+    rng = random.Random(8)
+    m.table.add(["#"], -1, None)        # matches everything incl. pads
+    trie.add(["#"], -1, None)
+    for i in range(9000):
+        f = corpus_filter(rng)
+        m.table.add(f, i, None)
+        trie.add(list(f), i, None)
+    # 5 real topics in a padded batch (Bpad = 8)
+    topics = [(f"r{i}", f"d{i}", f"m{i}") for i in range(5)]
+    for topic, rows in zip(topics, m.match_batch(topics)):
+        assert norm(rows) == norm(trie.match(list(topic))), topic

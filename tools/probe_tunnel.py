@@ -1,0 +1,154 @@
+"""Isolate the axon-tunnel I/O cost model at the windowed kernel's real
+shapes: upload bandwidth, download bandwidth, whether outputs transfer
+eagerly, and per-call cost with numpy vs device-resident args.
+
+Run on the chip: python tools/probe_tunnel.py
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+
+def note(m):
+    print(m, file=sys.stderr, flush=True)
+
+
+def timeit(fn, n=8, warm=3):
+    for _ in range(warm):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    note(f"platform={dev.platform}")
+
+    # 1. upload: device_put of numpy, synced by a 1-element pull
+    @jax.jit
+    def first(x):
+        return x.reshape(-1)[:8]
+
+    for mb in (0.125, 0.5, 2.0, 8.0):
+        a = np.zeros((int(mb * 1e6) // 4,), np.int32)
+        per = timeit(lambda: np.asarray(first(jax.device_put(a, dev))))
+        note(f"upload {mb:6.3f}MB: {per*1e3:7.2f} ms  "
+             f"({mb/per:.1f} MB/s)")
+
+    # 2. download: np.asarray of a device array
+    for mb in (0.125, 0.5, 2.0, 8.0):
+        d = jax.device_put(np.zeros((int(mb * 1e6) // 4,), np.int32), dev)
+        np.asarray(d[:1])
+        per = timeit(lambda: np.asarray(d))
+        note(f"download {mb:6.3f}MB: {per*1e3:7.2f} ms  "
+             f"({mb/per:.1f} MB/s)")
+
+    # 3. per-call: numpy args at config-3 shapes, tiny output, pipelined
+    B, L, T, TP, k = 4096, 8, 26, 256, 256
+    pw = np.zeros((B, L), np.int32)
+    pl = np.zeros(B, np.int32)
+    pd = np.zeros(B, bool)
+    t_pw = np.zeros((T, TP, L), np.int32)
+    t_pl = np.zeros((T, TP), np.int32)
+    t_pd = np.zeros((T, TP), bool)
+    t_start = np.zeros(T, np.int32)
+    args_np = (pw, pl, pd, t_pw, t_pl, t_pd, t_start,
+               t_pw.copy(), t_pl.copy(), t_pd.copy(), t_start.copy())
+    nbytes = sum(a.nbytes for a in args_np)
+
+    @jax.jit
+    def f_small(*a):
+        return sum(x.sum(dtype=jnp.int32) for x in a)
+
+    def pipelined(args_fn, n=12):
+        acc = None
+        f_small(*args_fn())  # warm
+        np.asarray(f_small(*args_fn()))
+        t0 = time.perf_counter()
+        outs = [f_small(*args_fn()) for _ in range(n)]
+        acc = outs[0]
+        for o in outs[1:]:
+            acc = acc + o
+        np.asarray(acc)
+        return (time.perf_counter() - t0) / n
+
+    note(f"jit call, {len(args_np)} numpy args {nbytes/1e6:.2f}MB, tiny out: "
+         f"{pipelined(lambda: args_np)*1e3:7.2f} ms/call")
+
+    args_dev = tuple(jax.device_put(a, dev) for a in args_np)
+    jax.block_until_ready(args_dev)
+    note(f"jit call, same args device-resident, tiny out: "
+         f"{pipelined(lambda: args_dev)*1e3:7.2f} ms/call")
+
+    # one concatenated buffer vs many: is per-buffer overhead the cost?
+    flat = np.zeros((nbytes // 4,), np.int32)
+
+    @jax.jit
+    def f_flat(x):
+        return x.sum(dtype=jnp.int32)
+
+    def one_call():
+        return f_flat(jax.device_put(flat, dev))
+
+    np.asarray(one_call())
+    t0 = time.perf_counter()
+    outs = [one_call() for _ in range(12)]
+    acc = outs[0]
+    for o in outs[1:]:
+        acc = acc + o
+    np.asarray(acc)
+    note(f"jit call, ONE {nbytes/1e6:.2f}MB numpy arg, tiny out: "
+         f"{(time.perf_counter()-t0)/12*1e3:7.2f} ms/call")
+
+    # 4. big outputs (config-3 result shapes), device arg, refs kept,
+    # one checksum pull at the end — does output transfer eagerly?
+    x = jax.device_put(np.int32(1), dev)
+
+    @jax.jit
+    def f_bigout(x):
+        gidx = jnp.zeros((B, k), jnp.int32) + x
+        gval = jnp.zeros((B, k), bool)
+        gcnt = jnp.zeros((B,), jnp.int32) + x
+        tidx = jnp.zeros((T, TP, k), jnp.int32) + x
+        tval = jnp.zeros((T, TP, k), bool)
+        tcnt = jnp.zeros((T, TP), jnp.int32) + x
+        return gidx, gval, gcnt, tidx, tval, tcnt, gidx + 1, gval, tcnt
+
+    out_bytes = sum(np.prod(o.shape) * o.dtype.itemsize
+                    for o in jax.eval_shape(f_bigout, x))
+    f_bigout(x)
+    np.asarray(f_bigout(x)[2])
+    t0 = time.perf_counter()
+    n = 12
+    keep = []
+    acc = jnp.zeros((), jnp.int32)
+    for _ in range(n):
+        o = f_bigout(x)
+        keep.append(o)
+        acc = acc + o[2].sum()
+    np.asarray(acc)
+    per = (time.perf_counter() - t0) / n
+    note(f"jit call, device arg, {out_bytes/1e6:.1f}MB outputs kept as refs, "
+         f"checksum pull: {per*1e3:7.2f} ms/call")
+
+    # same but pull ALL outputs each call
+    t0 = time.perf_counter()
+    for _ in range(6):
+        o = f_bigout(x)
+        for a in o:
+            np.asarray(a)
+    per = (time.perf_counter() - t0) / 6
+    note(f"jit call, device arg, pull ALL {out_bytes/1e6:.1f}MB outputs: "
+         f"{per*1e3:7.2f} ms/call")
+
+
+if __name__ == "__main__":
+    main()

@@ -41,24 +41,28 @@ def main():
     for tile_pubs in (128, 256, 512):
         TM.TILE_PUBS = tile_pubs
         for B in (2048, 4096, 8192):
-            try:
-                wb = WindowedBench(jax, table, pools, rng, B, 256)
-                r = wb.run(20, warmup=8, measure_resolve=False)
-                line = (f"TP={tile_pubs} B={B}: "
-                        f"{r['matches_per_sec']/1e6:.2f}M matches/s "
-                        f"{r['publishes_per_sec']/1e3:.0f}k pubs/s "
-                        f"batch={r['batch_ms']:.2f}ms "
-                        f"enc={r['encode_ms']:.2f} prep={r['prep_ms']:.2f} "
-                        f"sync_p50={r['synced_batch_ms_p50']:.1f} "
-                        f"left={r['leftover_pubs']}")
-                note(line)
-                if best is None or r["matches_per_sec"] > best[0]:
-                    best = (r["matches_per_sec"], tile_pubs, B)
-            except Exception as e:
-                note(f"TP={tile_pubs} B={B} FAILED: {type(e).__name__}: "
-                     f"{str(e)[:120]}")
+            for fa in (96, 128):  # flat_avg: result-buffer slots per pub
+                try:
+                    wb = WindowedBench(jax, table, pools, rng, B, 256,
+                                       flat_avg=fa)
+                    r = wb.run(20, warmup=8, measure_resolve=False)
+                    line = (f"TP={tile_pubs} B={B} FA={fa}: "
+                            f"{r['matches_per_sec']/1e6:.2f}M matches/s "
+                            f"{r['publishes_per_sec']/1e3:.0f}k pubs/s "
+                            f"batch={r['batch_ms']:.2f}ms "
+                            f"enc={r['encode_ms']:.2f} "
+                            f"prep={r['prep_ms']:.2f} "
+                            f"sync_p50={r['synced_batch_ms_p50']:.1f} "
+                            f"left={r['leftover_pubs']} "
+                            f"ovf={r['overflow_pubs']}")
+                    note(line)
+                    if best is None or r["matches_per_sec"] > best[0]:
+                        best = (r["matches_per_sec"], tile_pubs, B, fa)
+                except Exception as e:
+                    note(f"TP={tile_pubs} B={B} FA={fa} FAILED: "
+                         f"{type(e).__name__}: {str(e)[:120]}")
     if best:
-        note(f"BEST: TILE_PUBS={best[1]} B={best[2]} "
+        note(f"BEST: TILE_PUBS={best[1]} B={best[2]} flat_avg={best[3]} "
              f"{best[0]/1e6:.2f}M matches/s")
 
 

@@ -74,8 +74,8 @@ def prepare_windows(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
                     pb: np.ndarray, n: int, reg_start: np.ndarray,
                     reg_end: np.ndarray, S: int, T: int, seg_max: int,
                     row_lo: int = 0, row_hi: Optional[int] = None,
-                    tp: Optional[int] = None):
-    """Host prep for :func:`match_extract_windowed`: sort the n real
+                    tp: Optional[int] = None, emit: str = "rows"):
+    """Host prep for the windowed kernels: sort the n real
     publishes by bucket, pack into at most T fixed tiles of ``tp``
     (default TILE_PUBS) slots each, window each tile at its first region's
     start. Pubs that cannot be tiled (window budget exhausted, or their
@@ -86,6 +86,12 @@ def prepare_windows(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
     path preps each shard against its own rows; starts are emitted
     shard-local). Returns ``(t_pw, t_pl, t_pd, t_start, tile_of, pos_of,
     leftovers)``.
+
+    ``emit="sel"`` skips building the duplicated row tiles and instead
+    returns ``(t_sel, t_start, tile_of, pos_of, leftovers)`` where
+    ``t_sel`` is a [T, TP] int32 pub-index selector (pad slots point at
+    pub 0) — the flat kernel gathers tile pubs on device, cutting the
+    per-batch upload ~8x (match_extract_windowed_flat).
     """
     L = pw.shape[1]
     TP = tp or TILE_PUBS
@@ -100,9 +106,12 @@ def prepare_windows(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
     rs = reg_start[pbn].astype(np.int64)
     re_ = reg_end[pbn].astype(np.int64)
     order = np.argsort(rs, kind="stable")
-    t_pw = np.full((T, TP, L), np.int32(K.PAD_ID), dtype=np.int32)
-    t_pl = np.zeros((T, TP), dtype=np.int32)
-    t_pd = np.zeros((T, TP), dtype=bool)
+    rows_mode = emit == "rows"
+    if rows_mode:
+        t_pw = np.full((T, TP, L), np.int32(K.PAD_ID), dtype=np.int32)
+        t_pl = np.zeros((T, TP), dtype=np.int32)
+        t_pd = np.zeros((T, TP), dtype=bool)
+    t_sel = np.zeros((T, TP), dtype=np.int32)
     t_start = np.zeros(T, dtype=np.int32)
     tile_of = np.full(n, -1, dtype=np.int32)
     pos_of = np.zeros(n, dtype=np.int32)
@@ -147,17 +156,21 @@ def prepare_windows(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
     for tid, slot0, lo, cnt in spans:
         sel = order[lo:lo + cnt]
         sl = slice(slot0, slot0 + cnt)
-        t_pw[tid, sl] = pw[sel]
-        t_pl[tid, sl] = pl[sel]
-        t_pd[tid, sl] = pd[sel]
+        if rows_mode:
+            t_pw[tid, sl] = pw[sel]
+            t_pl[tid, sl] = pl[sel]
+            t_pd[tid, sl] = pd[sel]
+        t_sel[tid, sl] = sel
         tile_of[sel] = tid
         pos_of[sel] = np.arange(slot0, slot0 + cnt, dtype=np.int32)
+    if not rows_mode:
+        return t_sel, t_start, tile_of, pos_of, leftovers
     return t_pw, t_pl, t_pd, t_start, tile_of, pos_of, leftovers
 
 
 class TpuMatcher:
     def __init__(self, max_levels: int = 16, initial_capacity: int = 1024,
-                 max_fanout: int = 256, device=None):
+                 max_fanout: int = 256, device=None, flat_avg: int = 128):
         import threading
 
         import jax
@@ -165,6 +178,11 @@ class TpuMatcher:
         self._jax = jax
         self.table = SubscriptionTable(max_levels, initial_capacity)
         self.max_fanout = max_fanout
+        # flat-compaction capacity per pub AVERAGED over the batch (the
+        # [C = Bpad*flat_avg] device result buffer); a batch whose total
+        # fanout exceeds it degrades per-pub to the host path, it never
+        # drops
+        self.flat_avg = flat_avg
         self.device = device or jax.devices()[0]
         self._dev_arrays: Optional[Tuple] = None
         self._operands: Optional[Tuple] = None  # (F_t, t1) coded MXU operands
@@ -345,7 +363,7 @@ class TpuMatcher:
         self.match_batches += 1
         self.match_publishes += len(topics)
         if bucketed:
-            idx_rows, counts = self._match_windowed(
+            idx_rows, need_host = self._match_windowed(
                 dev_arrays, operands, reg_start, reg_end, glob_pad, bits,
                 pw, pl, pd, pb, gb, len(topics))
         else:
@@ -364,23 +382,25 @@ class TpuMatcher:
             valid = np.asarray(valid)
             counts = np.asarray(count)
             idx_rows = [idx[i][valid[i]] for i in range(len(topics))]
+            need_host = counts[:len(topics)] > self.max_fanout
         out: List[List[Row]] = []
         for i, topic in enumerate(topics):
+            if need_host[i]:
+                # truncated fanout / untiled pub: fall back to exact host
+                # matching so no subscriber is silently skipped
+                self.host_fallbacks += 1
+                rows = self._host_match(topic, snapshot)
+                out.append(rows)
+                continue
             rows = [
                 e for e in (snapshot[s] for s in idx_rows[i]) if e is not None
             ]
-            if counts[i] > self.max_fanout:
-                # truncated fanout: fall back to exact host matching for this
-                # topic so no subscriber is silently skipped
-                self.host_fallbacks += 1
-                rows = self._host_match(topic, snapshot)
-            else:
-                with self.lock:
-                    if len(self.table.overflow):
-                        # >L-level filters live host-side; device rows stay
-                        # valid for any topic length (only concrete levels
-                        # <= L are compared)
-                        rows = rows + self.table.overflow.match(list(topic))
+            with self.lock:
+                if len(self.table.overflow):
+                    # >L-level filters live host-side; device rows stay
+                    # valid for any topic length (only concrete levels
+                    # <= L are compared)
+                    rows = rows + self.table.overflow.match(list(topic))
             out.append(rows)
         return out
 
@@ -400,91 +420,77 @@ class TpuMatcher:
             T2, seg2 = 1, 0
         return T, seg_max, gc, T2, seg2, gb_end
 
-    def _match_windowed(self, dev_arrays, operands, reg_start, reg_end,
-                        glob_pad, bits, pw, pl, pd, pb, gb, n):
-        """Run the windowed device path (the production kernel): a dense
-        pass over region 0 plus probe-A (level-0 bucket) and probe-B
-        (level-1 g-bucket) window tiles; returns (per-pub slot index
-        lists, per-pub total counts) in original batch order.
-        Window-overflow pubs ("leftovers") are matched exactly on the
-        host — their count entry is forced past max_fanout so the caller
-        takes the host path for them."""
-        S = int(dev_arrays[0].shape[0])
-        k = self.max_fanout
+    def _flat_prep(self, reg_start, reg_end, glob_pad, bits, S,
+                   pw, pl, pd, pb, gb, n):
+        """Host prep for :func:`K.match_extract_windowed_flat`: window
+        geometry, selector tiles, per-pub tile coordinates, flat
+        capacity. Returns ``(args, statics, left)`` — the kernel's
+        trailing positional args + static kwargs (the leading six are the
+        device table arrays), and the set of host-fallback pubs (window
+        overflow). Registry state (reg_start/…) is passed in, not read
+        off self, so a caller can pin the snapshot its device arrays were
+        built from. Shared by match_batch and the bench driver so the
+        bench measures exactly the production call."""
         Bpad = pw.shape[0]
         T, seg_max, gc, T2, seg2, gb_end = self._geometry(
             S, glob_pad, reg_start, reg_end, Bpad)
-        (t_pw, t_pl, t_pd, t_start, tile_of, pos_of,
+        (t_sel, t_start, tile_of, pos_of,
          leftovers) = prepare_windows(pw, pl, pd, pb, n, reg_start,
                                       reg_end, S, T, seg_max,
-                                      row_lo=gb_end)
+                                      row_lo=gb_end, emit="sel")
         t_start = t_start + gb_end  # starts are row_lo-relative
+        a_tile = np.full(Bpad, -1, dtype=np.int32)
+        a_pos = np.zeros(Bpad, dtype=np.int32)
+        a_tile[:n] = tile_of
+        a_pos[:n] = pos_of
+        b_tile = np.full(Bpad, -1, dtype=np.int32)
+        b_pos = np.zeros(Bpad, dtype=np.int32)
         if seg2:
-            (t2_pw, t2_pl, t2_pd, t2_start, tile2_of, pos2_of,
+            (t2_sel, t2_start, tile2_of, pos2_of,
              left2) = prepare_windows(pw, pl, pd, gb, n, reg_start,
                                       reg_end, S, T2, seg2,
-                                      row_lo=glob_pad, row_hi=gb_end)
+                                      row_lo=glob_pad, row_hi=gb_end,
+                                      emit="sel")
             t2_start = t2_start + glob_pad
+            b_tile[:n] = tile2_of
+            b_pos[:n] = pos2_of
         else:
-            t2_pw, t2_pl, t2_pd, t2_start = K.empty_probe_tiles(
-                t_pw.shape[1], pw.shape[1])
-            tile2_of = np.full(n, -1, np.int32)
-            pos2_of = np.zeros(n, np.int32)
+            t2_sel = np.zeros((1, t_sel.shape[1]), np.int32)
+            t2_start = np.zeros(1, np.int32)
             left2 = []
-        F_t, t1 = operands
-        (gidx, gvalid, gcount, tidx, tvalid, tcount,
-         t2idx, t2valid, t2count) = K.match_extract_windowed(
-            F_t, t1, dev_arrays[1], dev_arrays[2], dev_arrays[3],
-            dev_arrays[4], pw, pl, pd, t_pw, t_pl, t_pd, t_start,
-            t2_pw, t2_pl, t2_pd, t2_start,
-            id_bits=bits, k=k, glob_pad=glob_pad, seg_max=seg_max,
-            seg2_max=seg2, gc=gc)
-        gidx = np.asarray(gidx)
-        gvalid = np.asarray(gvalid)
-        gcount = np.asarray(gcount)
-        tidx = np.asarray(tidx)
-        tvalid = np.asarray(tvalid)
-        tcount = np.asarray(tcount)
-        t2idx = np.asarray(t2idx)
-        t2valid = np.asarray(t2valid)
-        t2count = np.asarray(t2count)
-        # vectorised assembly: per-pub python indexing costs ~4ms/1024 pubs
-        # — one np.nonzero per part + row-split instead
-        def split_rows(idx2d, valid2d):
-            rows, cols = np.nonzero(valid2d)
-            vals = idx2d[rows, cols]
-            bounds = np.searchsorted(rows, np.arange(n + 1))
-            return vals, bounds
+        args = (pw, pl, pd, np.int32(n), t_sel, t_start, t2_sel, t2_start,
+                a_tile, a_pos, b_tile, b_pos)
+        statics = dict(id_bits=bits, k=self.max_fanout, glob_pad=glob_pad,
+                       seg_max=seg_max, seg2_max=seg2, gc=gc,
+                       C=Bpad * self.flat_avg)
+        return args, statics, set(leftovers) | set(left2)
 
-        gv, gb_ = split_rows(gidx[:n], gvalid[:n])
-        ta_idx = tidx[tile_of, pos_of]        # [n, k]
-        ta_val = tvalid[tile_of, pos_of]
-        av, ab = split_rows(ta_idx, ta_val)
-        counts = gcount[:n].astype(np.int64) + tcount[tile_of, pos_of]
-        clipped = (gcount[:n] > k) | (tcount[tile_of, pos_of] > k)
-        if seg2:
-            tb_idx = t2idx[tile2_of, pos2_of]
-            tb_val = t2valid[tile2_of, pos2_of]
-            bv, bb = split_rows(tb_idx, tb_val)
-            counts = counts + t2count[tile2_of, pos2_of]
-            clipped = clipped | (t2count[tile2_of, pos2_of] > k)
-        left = set(leftovers) | set(left2)
-        # per-part truncation: if any part clipped at k, report a count
-        # > max_fanout so the caller takes the exact host path; leftovers
-        # (untiled pubs) force the same
-        counts[clipped] = self.max_fanout + 1
-        idx_rows = []
-        empty = np.zeros(0, dtype=np.int32)
-        for i in range(n):
-            if i in left:
-                idx_rows.append(empty)
-                counts[i] = self.max_fanout + 1
-                continue
-            parts = [gv[gb_[i]:gb_[i + 1]], av[ab[i]:ab[i + 1]]]
-            if seg2:
-                parts.append(bv[bb[i]:bb[i + 1]])
-            idx_rows.append(np.concatenate(parts))
-        return idx_rows, counts
+    def _match_windowed(self, dev_arrays, operands, reg_start, reg_end,
+                        glob_pad, bits, pw, pl, pd, pb, gb, n):
+        """Run the windowed device path (the production kernel, flat
+        variant): a dense pass over region 0 plus probe-A (level-0
+        bucket) and probe-B (level-1 g-bucket) window tiles, compacted
+        device-side into one flat buffer. Returns (per-pub slot index
+        views, need_host bool array) in original batch order; need_host
+        marks pubs the device could not serve exactly (window-overflow
+        leftovers, per-part clip at k, flat-capacity overflow) for the
+        exact host fallback."""
+        S = int(dev_arrays[0].shape[0])
+        args, statics, left = self._flat_prep(
+            reg_start, reg_end, glob_pad, bits, S, pw, pl, pd, pb, gb, n)
+        F_t, t1 = operands
+        flat, pre, total, overflow = K.match_extract_windowed_flat(
+            F_t, t1, dev_arrays[1], dev_arrays[2], dev_arrays[3],
+            dev_arrays[4], *args, **statics)
+        flat = np.asarray(flat)
+        pre = np.asarray(pre)
+        total = np.asarray(total)
+        need_host = np.asarray(overflow)[:n].copy()
+        for i in left:
+            need_host[i] = True
+        # per-pub results are VIEWS into flat — no per-pub copies
+        idx_rows = [flat[pre[i]:pre[i] + total[i]] for i in range(n)]
+        return idx_rows, need_host
 
     def _host_match(self, topic: Sequence[str], snapshot=None) -> List[Row]:
         from ..protocol.topic import match_dollar_aware

@@ -487,30 +487,30 @@ def _epilogue(pub_len, pub_dollar, eff, hh, fw, act) -> jax.Array:
     return len_ok & ~(pub_dollar[:, None] & fw[None, :]) & act[None, :]
 
 
-def empty_probe_tiles(TP: int, L: int):
-    """Placeholder probe-B tile inputs for tables without a g-zone
-    (seg2_max=0 skips the group at trace time; shapes must still bind)."""
-    import numpy as np
-
-    return (np.zeros((1, TP, L), np.int32), np.zeros((1, TP), np.int32),
-            np.zeros((1, TP), bool), np.zeros(1, np.int32))
-
-
-def _window_tiles(F_t, t1, sub_eff_len, has_hash, first_wild, active,
-                  t_pw, t_pl, t_pd, t_start, *, id_bits, k, seg_max,
-                  glob_pad, wild_rows):
+def _window_tiles_sel(F_t, t1, sub_eff_len, has_hash, first_wild, active,
+                      pub_words, pub_len, pub_dollar, t_sel, t_start, *,
+                      id_bits, k, seg_max, glob_pad, wild_rows):
     """Unrolled window-tile group: tile i matmuls a traced-start
-    ``dynamic_slice`` window of ``seg_max`` contiguous rows. ``wild_rows``
-    selects which rows this group may match: probe A (level-0 buckets)
-    matches only concrete-first rows, probe B (level-1 g-buckets) only
-    wildcard-first rows — the split is what makes A- and B-windows unable
-    to duplicate each other's matches even over the relocation spare
-    tail."""
+    ``dynamic_slice`` window of ``seg_max`` contiguous rows, against the
+    TP pubs GATHERED from the batch by its [TP] selector row (shipping
+    [T, TP] selectors instead of duplicated [T, TP, L] word rows cuts the
+    host→device argument bytes ~8x — the tunnel transfer is a first-order
+    cost on this runtime). ``wild_rows`` selects which rows this group
+    may match: probe A (level-0 buckets) matches only concrete-first
+    rows, probe B (level-1 g-buckets) only wildcard-first rows — the
+    split is what makes A- and B-windows unable to duplicate each other's
+    matches even over the relocation spare tail. Pad slots select pub
+    row 0; their matches are computed but never gathered into any pub's
+    result (a_tile/a_pos only name real slots)."""
     Kd = F_t.shape[0]
-    T = t_pw.shape[0]
+    T = t_sel.shape[0]
     j = jnp.arange(seg_max, dtype=jnp.int32)
     touts = []
     for ti in range(T):
+        sel = t_sel[ti]
+        pwt = jnp.take(pub_words, sel, axis=0)   # [TP, L] tiny gather
+        plt = jnp.take(pub_len, sel)
+        pdt = jnp.take(pub_dollar, sel)
         start = t_start[ti]
         Fseg = lax.dynamic_slice(F_t, (0, start), (Kd, seg_max))
         t1s = lax.dynamic_slice(t1, (start,), (seg_max,))
@@ -518,15 +518,15 @@ def _window_tiles(F_t, t1, sub_eff_len, has_hash, first_wild, active,
         hhs = lax.dynamic_slice(has_hash, (start,), (seg_max,))
         fws = lax.dynamic_slice(first_wild, (start,), (seg_max,))
         acts = lax.dynamic_slice(active, (start,), (seg_max,))
-        Gt = build_pub_operand(t_pw[ti], id_bits)
+        Gt = build_pub_operand(pwt, id_bits)
         mm = lax.dot_general(
             Gt, Fseg, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) + t1s[None, :]
         rowok = j[None, :] >= (glob_pad - start)  # region 0 never re-matched
         split = fws[None, :] if wild_rows else ~fws[None, :]
-        m = (mm == 0.0) & _epilogue(
-            t_pl[ti], t_pd[ti], effs, hhs, fws, acts) & rowok & split
+        m = (mm == 0.0) & _epilogue(plt, pdt, effs, hhs, fws, acts) \
+            & rowok & split
         i2, v2, c2 = extract_indices_packed(_pack_mask(m), k, 2048)
         touts.append((i2 + start, v2, c2))
     return (jnp.stack([o[0] for o in touts]),
@@ -536,8 +536,8 @@ def _window_tiles(F_t, t1, sub_eff_len, has_hash, first_wild, active,
 
 @functools.partial(jax.jit,
                    static_argnames=("id_bits", "k", "glob_pad", "seg_max",
-                                    "seg2_max", "gc"))
-def match_extract_windowed(
+                                    "seg2_max", "gc", "C"))
+def match_extract_windowed_flat(
     F_t: jax.Array,          # bf16 [K, S] coded operands (build_operands)
     t1: jax.Array,           # f32 [S]
     sub_eff_len: jax.Array,  # int32 [S]
@@ -547,33 +547,31 @@ def match_extract_windowed(
     pub_words: jax.Array,    # int32 [B, L]  original batch order
     pub_len: jax.Array,      # int32 [B]
     pub_dollar: jax.Array,   # bool [B]
-    t_pw: jax.Array,         # int32 [T, TP, L]  probe-A tiles (L0 buckets)
-    t_pl: jax.Array,         # int32 [T, TP]
-    t_pd: jax.Array,         # bool [T, TP]
-    t_start: jax.Array,      # int32 [T] clamped window start per tile
-    t2_pw: jax.Array,        # int32 [T2, TP, L] probe-B tiles (L1 g-buckets)
-    t2_pl: jax.Array,        # int32 [T2, TP]
-    t2_pd: jax.Array,        # bool [T2, TP]
+    n_real: jax.Array,       # int32 scalar: real pubs (rest is padding)
+    t_sel: jax.Array,        # int32 [T, TP]  probe-A tile pub selectors
+    t_start: jax.Array,      # int32 [T]
+    t2_sel: jax.Array,       # int32 [T2, TP] probe-B tile pub selectors
     t2_start: jax.Array,     # int32 [T2]
+    a_tile: jax.Array,       # int32 [B] probe-A tile per pub (-1 = none)
+    a_pos: jax.Array,        # int32 [B] slot within that tile
+    b_tile: jax.Array,       # int32 [B] probe-B tile per pub (-1 = none)
+    b_pos: jax.Array,        # int32 [B]
     *,
     id_bits: int,
     k: int,
-    glob_pad: int,           # region-0 width (both-levels-wild rows), %2048
-    seg_max: int,            # probe-A window width, %2048
-    seg2_max: int,           # probe-B window width, %2048 (0 = no probe B)
-    gc: int,                 # pub-chunk size for the dense phase
-) -> Tuple[jax.Array, ...]:
-    """The production match path — ONE fused executable per batch.
+    glob_pad: int,
+    seg_max: int,
+    seg2_max: int,
+    gc: int,
+    C: int,                  # flat result capacity (slots)
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """The production match path — ONE fused executable per batch, with
+    device-side FLAT COMPACTION.
 
-    Design notes (measured on the TPU runtime): per-execution overhead is
-    ~5ms regardless of op count, ``lax.map`` serialises tile launches,
-    variable tile counts recompile, F-window gathers are 10-60x slower
-    than the matmuls they feed, and [B, S] f32 intermediates OOM the
-    compile past B=1024 — hence static unrolled tiles over contiguous
-    ``dynamic_slice`` windows and a pub-chunked dense phase.
-
-    Three phases against the two-level bucket layout (models/tpu_table.py
-    — the trie's first- and second-edge narrowing as dense windows):
+    Three match phases against the two-level bucket layout
+    (models/tpu_table.py — the trie's first- and second-edge narrowing
+    as dense windows; the per-publish ETS walk of
+    ``vmq_reg_trie.erl:358-383`` recast as batched matmuls):
 
     1. DENSE: every publish × region 0 (filters whose first TWO levels
        are wildcards — a residual sliver), in ``gc`` pub chunks.
@@ -583,12 +581,38 @@ def match_extract_windowed(
        (wildcard-first filters with a concrete level 1); windows match
        only wildcard-first rows.
 
-    Returns ``(gidx, gvalid, gcount, tidx, tvalid, tcount, t2idx,
-    t2valid, t2count)``; tile indices are global slot ids. Exact — the
-    coded matmul is bit-exact (build_operands) and the probe split +
-    row guard make double counting impossible.
+    Design notes (measured on the TPU runtime): per-execution overhead
+    is ~5ms regardless of op count, ``lax.map`` serialises tile
+    launches, variable tile counts recompile, F-window gathers are
+    10-60x slower than the matmuls they feed, and [B, S] f32
+    intermediates OOM the compile past B=1024 — hence static unrolled
+    tiles over contiguous ``dynamic_slice`` windows and a pub-chunked
+    dense phase. Exact: the coded matmul is bit-exact (build_operands)
+    and the probe split + row guard make double counting impossible.
+
+    The padded per-part ``(idx [·,k], valid, count)`` results never
+    leave the device: tile
+    results are gathered back to publish order, a prefix sum over per-pub
+    totals assigns each publish a contiguous range, and all matched slot
+    ids scatter into ONE ``[C]`` buffer. The host round trip shrinks from
+    ~15MB of padded idx/valid arrays to ``4C + O(B)`` bytes (~2MB at
+    B=4096) — on a tunnel-attached accelerator (~65ms RTT, ~100MB/s) the
+    transfer, not the matmul, is the dominant per-batch cost; on a local
+    PCIe host the reduction still cuts resolve-side memory traffic.
+
+    Up-side traffic shrinks the same way: tiles are [T, TP] pub
+    *selectors* (gathered on device) instead of duplicated [T, TP, L]
+    word rows.
+
+    Returns ``(flat [C] int32, pre [B] int32, total [B] int32,
+    overflow [B] bool)``: publish i's matched slot ids are
+    ``flat[pre[i] : pre[i]+total[i]]`` unless ``overflow[i]`` (flat
+    capacity exhausted or a part clipped at k — exact host fallback, the
+    same escape hatch as the padded path's count>k contract).
     """
     B = pub_words.shape[0]
+    real = jnp.arange(B, dtype=jnp.int32) < n_real
+
     gouts = []
     for c in range(0, B, gc):
         sl = slice(c, c + gc)
@@ -605,21 +629,57 @@ def match_extract_windowed(
     gvalid = jnp.concatenate([o[1] for o in gouts], axis=0)
     gcount = jnp.concatenate([o[2] for o in gouts], axis=0)
 
-    args = (F_t, t1, sub_eff_len, has_hash, first_wild, active)
-    tidx, tvalid, tcount = _window_tiles(
-        *args, t_pw, t_pl, t_pd, t_start, id_bits=id_bits, k=k,
+    args = (F_t, t1, sub_eff_len, has_hash, first_wild, active,
+            pub_words, pub_len, pub_dollar)
+    tidx, tvalid, tcount = _window_tiles_sel(
+        *args, t_sel, t_start, id_bits=id_bits, k=k,
         seg_max=seg_max, glob_pad=glob_pad, wild_rows=False)
+    okA = a_tile >= 0
+    at = jnp.maximum(a_tile, 0)
+    aidx = tidx[at, a_pos]                        # [B, k]
+    avalid = tvalid[at, a_pos] & okA[:, None]
+    acnt = jnp.where(okA, tcount[at, a_pos], 0)
     if seg2_max:
-        t2idx, t2valid, t2count = _window_tiles(
-            *args, t2_pw, t2_pl, t2_pd, t2_start, id_bits=id_bits, k=k,
+        t2idx, t2valid, t2count = _window_tiles_sel(
+            *args, t2_sel, t2_start, id_bits=id_bits, k=k,
             seg_max=seg2_max, glob_pad=glob_pad, wild_rows=True)
+        okB = b_tile >= 0
+        bt = jnp.maximum(b_tile, 0)
+        bidx = t2idx[bt, b_pos]
+        bvalid = t2valid[bt, b_pos] & okB[:, None]
+        bcnt = jnp.where(okB, t2count[bt, b_pos], 0)
     else:
-        T2, TP = t2_pw.shape[0], t2_pw.shape[1]
-        t2idx = jnp.zeros((T2, TP, k), jnp.int32)
-        t2valid = jnp.zeros((T2, TP, k), bool)
-        t2count = jnp.zeros((T2, TP), jnp.int32)
-    return (gidx, gvalid, gcount, tidx, tvalid, tcount,
-            t2idx, t2valid, t2count)
+        bidx = jnp.zeros((B, k), jnp.int32)
+        bvalid = jnp.zeros((B, k), bool)
+        bcnt = jnp.zeros((B,), jnp.int32)
+
+    # flat compaction: pad pubs contribute nothing; each real pub owns
+    # the contiguous range [pre, pre+total). Budget with counts CLAMPED
+    # to k: at most k entries per part are ever extracted, and a pub
+    # whose raw count exceeds k is host-matched anyway (clip flag below)
+    # — charging the raw count would let one mega-fanout pub reserve its
+    # entire raw fanout and cascade spurious capacity overflows (= slow
+    # exact host scans) across the rest of the batch.
+    clip = (gcount > k) | (acnt > k) | (bcnt > k)
+    gcnt = jnp.minimum(jnp.where(real, gcount, 0), k)
+    acnt = jnp.minimum(jnp.where(real, acnt, 0), k)
+    bcnt = jnp.minimum(jnp.where(real, bcnt, 0), k)
+    total = gcnt + acnt + bcnt
+    pre = jnp.cumsum(total) - total               # exclusive prefix
+    j = jnp.arange(k, dtype=jnp.int32)[None, :]
+    flat = jnp.zeros((C,), jnp.int32)
+
+    def scat(flat, base, idx, valid, cnt):
+        # extraction guarantees rank j holds the j-th match (j < count)
+        pos = base[:, None] + j
+        p = jnp.where(valid & real[:, None] & (j < cnt[:, None]), pos, C)
+        return flat.at[p].set(idx, mode="drop")
+
+    flat = scat(flat, pre, gidx, gvalid, gcnt)
+    flat = scat(flat, pre + gcnt, aidx, avalid, acnt)
+    flat = scat(flat, pre + gcnt + acnt, bidx, bvalid, bcnt)
+    overflow = ((pre + total > C) | clip) & real
+    return (flat, pre.astype(jnp.int32), total.astype(jnp.int32), overflow)
 
 
 @functools.partial(jax.jit, static_argnames=("id_bits",))

@@ -178,8 +178,10 @@ def build_sharded_windowed(mesh: Mesh, *, id_bits: int, k: int,
                            glob_pad: int, seg_max: int, gc: int, T: int,
                            Sl: int, with_total: bool = False):
     """The windowed production matcher under shard_map on a
-    ('batch', 'sub') mesh — the multi-chip form of
-    :func:`ops.match_kernel.match_extract_windowed`.
+    ('batch', 'sub') mesh — the multi-chip form of the single-chip
+    windowed kernel (:func:`ops.match_kernel.match_extract_windowed_flat`
+    minus the flat compaction: per-shard padded results are gathered over
+    ICI and compacted host-side).
 
     Sharding (SURVEY.md §5.7/§5.8): the coded operand matrix F_t is
     column-sharded over 'sub' (each device owns Sl contiguous table rows —
