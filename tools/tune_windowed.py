@@ -1,7 +1,14 @@
-"""Parameter sweep for the windowed kernel on the real chip: batch size x
-tile width. Prints one line per config; run after any kernel change.
+"""Parameter sweep for the flat windowed kernel on the real chip:
+batch size x tile width x window-fairness x flat capacity. Prints one
+line per config; run after any kernel change.
 
-Usage: python tools/tune_windowed.py [subs]
+Usage:
+  python tools/tune_windowed.py [subs] [--cpu]
+      [--tp 128,256] [--b 2048,4096,8192] [--fm 1,2,4] [--fa 128]
+
+Each axis takes a comma list; the grid is their product. Keep the grid
+small on a tunnel — every distinct (TP, B, FM) geometry is a fresh
+compile (~30-60s).
 """
 import random
 import sys
@@ -16,54 +23,71 @@ def note(m):
     print(m, file=sys.stderr, flush=True)
 
 
+def _axis(argv, name, default):
+    flag = f"--{name}"
+    if flag in argv:
+        i = argv.index(flag)
+        vals = [int(x) for x in argv[i + 1].split(",")]
+        del argv[i:i + 2]
+        return vals
+    return default
+
+
 def main():
-    if "--cpu" in sys.argv:
-        sys.argv.remove("--cpu")
+    argv = sys.argv[1:]
+    if "--cpu" in argv:
+        argv.remove("--cpu")
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    tps = _axis(argv, "tp", [128, 256])
+    bs = _axis(argv, "b", [2048, 4096, 8192])
+    fms = _axis(argv, "fm", [2])
+    fas = _axis(argv, "fa", [128])
     import jax
 
     from bench import WindowedBench, build_corpus
     from vernemq_tpu.models import tpu_matcher as TM
     from vernemq_tpu.models.tpu_table import SubscriptionTable
 
-    subs = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    subs = int(argv[0]) if argv else 1_000_000
     rng = random.Random(42)
     table = SubscriptionTable(max_levels=8,
                               initial_capacity=1 << (subs - 1).bit_length())
     t0 = time.perf_counter()
     pools = build_corpus(rng, subs, table)
     note(f"corpus {time.perf_counter()-t0:.1f}s platform="
-         f"{jax.devices()[0].platform}")
+         f"{jax.devices()[0].platform} grid: TP={tps} B={bs} FM={fms} "
+         f"FA={fas}")
 
     best = None
-    for tile_pubs in (128, 256, 512):
+    for tile_pubs in tps:
         TM.TILE_PUBS = tile_pubs
-        for B in (2048, 4096, 8192):
-            for fa in (96, 128):  # flat_avg: result-buffer slots per pub
-                try:
-                    wb = WindowedBench(jax, table, pools, rng, B, 256,
-                                       flat_avg=fa)
-                    r = wb.run(20, warmup=8, measure_resolve=False)
-                    line = (f"TP={tile_pubs} B={B} FA={fa}: "
-                            f"{r['matches_per_sec']/1e6:.2f}M matches/s "
-                            f"{r['publishes_per_sec']/1e3:.0f}k pubs/s "
-                            f"batch={r['batch_ms']:.2f}ms "
-                            f"enc={r['encode_ms']:.2f} "
-                            f"prep={r['prep_ms']:.2f} "
-                            f"sync_p50={r['synced_batch_ms_p50']:.1f} "
-                            f"left={r['leftover_pubs']} "
-                            f"ovf={r['overflow_pubs']}")
-                    note(line)
-                    if best is None or r["matches_per_sec"] > best[0]:
-                        best = (r["matches_per_sec"], tile_pubs, B, fa)
-                except Exception as e:
-                    note(f"TP={tile_pubs} B={B} FA={fa} FAILED: "
-                         f"{type(e).__name__}: {str(e)[:120]}")
+        for fm in fms:
+            TM.FAIR_MULT = fm
+            for B in bs:
+                for fa in fas:
+                    tag = f"TP={tile_pubs} FM={fm} B={B} FA={fa}"
+                    try:
+                        wb = WindowedBench(jax, table, pools, rng, B, 256,
+                                           flat_avg=fa)
+                        r = wb.run(20, warmup=8, measure_resolve=False)
+                        note(f"{tag}: "
+                             f"{r['matches_per_sec']/1e6:.2f}M matches/s "
+                             f"{r['publishes_per_sec']/1e3:.0f}k pubs/s "
+                             f"batch={r['batch_ms']:.2f}ms "
+                             f"enc={r['encode_ms']:.2f} "
+                             f"prep={r['prep_ms']:.2f} "
+                             f"sync_p50={r['synced_batch_ms_p50']:.1f} "
+                             f"left={r['leftover_pubs']} "
+                             f"ovf={r['overflow_pubs']}")
+                        if best is None or r["matches_per_sec"] > best[0]:
+                            best = (r["matches_per_sec"], tag)
+                    except Exception as e:
+                        note(f"{tag} FAILED: {type(e).__name__}: "
+                             f"{str(e)[:120]}")
     if best:
-        note(f"BEST: TILE_PUBS={best[1]} B={best[2]} flat_avg={best[3]} "
-             f"{best[0]/1e6:.2f}M matches/s")
+        note(f"BEST: {best[1]} {best[0]/1e6:.2f}M matches/s")
 
 
 if __name__ == "__main__":

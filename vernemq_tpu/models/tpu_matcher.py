@@ -32,6 +32,9 @@ from .tpu_table import SubscriptionTable
 Row = Tuple[Tuple[str, ...], Hashable, Any]
 
 TILE_PUBS = 256  # pubs per window tile (MXU row-tile friendly)
+FAIR_MULT = 2    # window width vs per-tile fair share of the zone (the
+                 # wider the window, the fewer tiles but the more rows
+                 # each tile matmuls — an on-chip tuning knob)
 
 
 def _pow2ceil(n: int) -> int:
@@ -49,7 +52,7 @@ def window_params(S: int, glob_pad: int, bucket_max: int, Bpad: int,
     slot_tiles = max(1, Bpad // TILE_PUBS)
     zone = (S - glob_pad) if zone is None else zone
     zone = max(zone, 4096)  # bucketed zones are >=4096 and 2048-aligned
-    fair = 2 * zone // slot_tiles
+    fair = FAIR_MULT * zone // slot_tiles
     # pow2 ≥ 4096 (so %2048 holds for the packed extraction), clamped to
     # the zone (prepare_windows row bounds) and S (dynamic_slice bound) AND
     # to a memory cap: the [TP, seg] f32 mismatch intermediate must stay
