@@ -128,7 +128,7 @@ async def test_broker_e2e_with_tpu_reg_view(event_loop):
 
     b, server = await start_broker(
         Config(systree_enabled=False, allow_anonymous=True, default_reg_view="tpu",
-               tpu_batch_window_us=500),
+               tpu_batch_window_us=500, tpu_host_batch_threshold=0),
         port=0,
     )
     try:
@@ -141,9 +141,46 @@ async def test_broker_e2e_with_tpu_reg_view(event_loop):
             await pub.publish(f"tpu/{i}/x", f"m{i}".encode(), qos=1)
         got = sorted([(await sub.recv()).payload for _ in range(5)])
         assert got == [f"m{i}".encode() for i in range(5)]
-        # matched via the device path
+        # matched via the device path (hybrid dispatch disabled above)
         view = b.registry.reg_view("tpu")
         assert view.matcher("").match_publishes >= 5
+        await sub.disconnect()
+        await pub.disconnect()
+    finally:
+        await b.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_hybrid_dispatch_small_flush_serves_host_side(event_loop):
+    """Flushes at or below tpu_host_batch_threshold resolve on the host
+    trie (no device call, no executor hop — SURVEY §7.2 hybrid
+    dispatch); the device matcher sees nothing and delivery is exact."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    b, server = await start_broker(
+        Config(systree_enabled=False, allow_anonymous=True,
+               default_reg_view="tpu", tpu_batch_window_us=200,
+               tpu_host_batch_threshold=8),
+        port=0,
+    )
+    try:
+        sub = MQTTClient(server.host, server.port, "hy-sub")
+        await sub.connect()
+        await sub.subscribe("hy/+/x", qos=1)
+        pub = MQTTClient(server.host, server.port, "hy-pub")
+        await pub.connect()
+        for i in range(4):  # sequential QoS1: one-pub flushes
+            await pub.publish(f"hy/{i}/x", f"m{i}".encode(), qos=1)
+        got = sorted([(await sub.recv()).payload for _ in range(4)])
+        assert got == [f"m{i}".encode() for i in range(4)]
+        col = b.batch_collector()
+        assert col.host_hybrid_pubs >= 4
+        view = b.registry.reg_view("tpu")
+        mm = view._matchers.get("")
+        assert mm is None or mm.match_publishes == 0
         await sub.disconnect()
         await pub.disconnect()
     finally:

@@ -393,6 +393,7 @@ class Broker:
             self._collector = BatchCollector(
                 self.registry.reg_view("tpu"),
                 window_us=self.config.tpu_batch_window_us,
+                host_threshold=self.config.tpu_host_batch_threshold,
             )
         return self._collector
 

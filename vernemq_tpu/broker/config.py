@@ -56,6 +56,9 @@ DEFAULTS: Dict[str, Any] = {
     "default_reg_view": "trie",  # trie | tpu — the reg-view seam (vmq_mqtt_fsm.erl:105)
     "tpu_batch_window_us": 200,
     "tpu_max_fanout": 1024,
+    # flushes this small are matched on the host trie instead of paying a
+    # device round trip (hybrid dispatch, SURVEY.md §7.2); 0 disables
+    "tpu_host_batch_threshold": 8,
     # systree / metrics
     "systree_enabled": True,
     "systree_interval": 20,

@@ -734,6 +734,10 @@ class Registry:
                     out.get("tpu_match_publishes", 0) + m.match_publishes
                 out["tpu_host_fallbacks"] = \
                     out.get("tpu_host_fallbacks", 0) + m.host_fallbacks
+        col = getattr(self.broker, "_collector", None)
+        if col is not None:
+            # small flushes served host-side by hybrid dispatch
+            out["tpu_hybrid_host_pubs"] = col.host_hybrid_pubs
         return out
 
     def fold_subscriptions(self, mountpoint: str = ""):

@@ -567,10 +567,19 @@ class BatchCollector:
     Equivalent host-side role to the NIF batching layer in the north-star
     design (BASELINE.json)."""
 
-    def __init__(self, view: TpuRegView, window_us: int = 200, max_batch: int = 4096):
+    def __init__(self, view: TpuRegView, window_us: int = 200,
+                 max_batch: int = 4096, host_threshold: int = 8):
         self.view = view
         self.window = window_us / 1e6
         self.max_batch = max_batch
+        # hybrid dispatch (SURVEY.md §7.2): a flush this small is served
+        # by the host trie ON the event loop — sub-ms exact match, no
+        # device round trip, no executor hop. The trie is maintained from
+        # the same subscriber-db events as the device table, and on-loop
+        # access is race-free (all trie mutation happens loop-side).
+        # Batches above the threshold amortise the device call.
+        self.host_threshold = host_threshold
+        self.host_hybrid_pubs = 0
         self._pending: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
         self._flush_handle: Optional[asyncio.TimerHandle] = None
 
@@ -592,6 +601,18 @@ class BatchCollector:
         pending, self._pending = self._pending, []
         if not pending:
             return
+        if len(pending) <= self.host_threshold:
+            reg = getattr(self.view, "registry", None)
+            if reg is not None:
+                self.host_hybrid_pubs += len(pending)
+                for mp, topic, fut in pending:
+                    if fut.done():
+                        continue
+                    try:
+                        fut.set_result(reg.trie(mp).match(list(topic)))
+                    except Exception as e:
+                        fut.set_exception(e)
+                return
         asyncio.get_event_loop().create_task(self._flush_async(pending))
 
     async def _flush_async(self, pending) -> None:
