@@ -205,6 +205,10 @@ class TpuMatcher:
         self._enc_gen: Tuple[int, int] = (-1, -1)
         # guards table mutation (event loop) vs sync/match (executor thread)
         self.lock = threading.Lock()
+        # matches currently holding the device arrays (captured under the
+        # lock, used after release): while > 0, sync() must not DONATE the
+        # buffers to a delta scatter or the in-flight call's args die
+        self._inflight = 0
 
     # ------------------------------------------------------------ delta sync
 
@@ -265,15 +269,22 @@ class TpuMatcher:
         slots_dev = self._jax.device_put(slots, self.device)
         w_dev = self._jax.device_put(t.words[slots], self.device)
         e_dev = self._jax.device_put(t.eff_len[slots], self.device)
-        self._dev_arrays = K.apply_delta(
+        # donating scatter updates in place (a 128-slot delta at 5M subs
+        # otherwise copies ~500MB of HBM, ~300ms measured); fall back to
+        # the copying variant while a dispatched match still holds refs
+        delta = K.apply_delta if self._inflight == 0 else K.apply_delta_copy
+        delta_ops = (K.apply_delta_operands if self._inflight == 0
+                     else K.apply_delta_operands_copy)
+        self._dev_arrays = delta(
             sw, el, hh, fw, ac, slots_dev, w_dev, e_dev,
             self._jax.device_put(t.has_hash[slots], self.device),
             self._jax.device_put(t.first_wild[slots], self.device),
             self._jax.device_put(t.active[slots], self.device),
         )
         if self._operands is not None:
-            self._operands = K.apply_delta_operands(
-                *self._operands, slots_dev, w_dev, e_dev, self._ops_bits)
+            self._operands = delta_ops(
+                *self._operands, slots_dev, w_dev, e_dev,
+                id_bits=self._ops_bits)
         # region geometry may have moved WITHOUT a resize (bucket
         # relocation into the spare tail) — refresh the window view
         self._reg_start = t.reg_start.copy()
@@ -363,29 +374,36 @@ class TpuMatcher:
                 pw, pl, pd, pb, gb = self._encode_batch_ex(topics)
             else:
                 pw, pl, pd = self.encode_batch(topics)
+            self._inflight += 1  # sync() must not donate our buffers away
         self.match_batches += 1
         self.match_publishes += len(topics)
-        if bucketed:
-            idx_rows, need_host = self._match_windowed(
-                dev_arrays, operands, reg_start, reg_end, glob_pad, bits,
-                pw, pl, pd, pb, gb, len(topics))
-        else:
-            chunk = 1024 if pw.shape[0] > 1024 else 0  # lax.map serialises
-            # full-scan fallback: MXU matmul path needs byte-splittable ids
-            # and a block-aligned table; else the VPU scan. The -1 keeps the
-            # top id clear of UNKNOWN_ID's byte planes (-2 → 254,255,255)
-            S = dev_arrays[0].shape[0]
-            fast = (len(self.table.interner) < (1 << 24) - K.FIRST_WORD_ID - 1
-                    and S % 2048 == 0 and S >= 2048)
-            matcher = K.match_extract_mxu if fast else K.match_extract
-            idx, valid, count = matcher(
-                *dev_arrays, pw, pl, pd, k=self.max_fanout, chunk=chunk
-            )
-            idx = np.asarray(idx)
-            valid = np.asarray(valid)
-            counts = np.asarray(count)
-            idx_rows = [idx[i][valid[i]] for i in range(len(topics))]
-            need_host = counts[:len(topics)] > self.max_fanout
+        try:
+            if bucketed:
+                idx_rows, need_host = self._match_windowed(
+                    dev_arrays, operands, reg_start, reg_end, glob_pad,
+                    bits, pw, pl, pd, pb, gb, len(topics))
+            else:
+                chunk = 1024 if pw.shape[0] > 1024 else 0  # lax.map serialises
+                # full-scan fallback: MXU matmul path needs byte-splittable
+                # ids and a block-aligned table; else the VPU scan. The -1
+                # keeps the top id clear of UNKNOWN_ID's byte planes
+                # (-2 → 254,255,255)
+                S = dev_arrays[0].shape[0]
+                fast = (len(self.table.interner)
+                        < (1 << 24) - K.FIRST_WORD_ID - 1
+                        and S % 2048 == 0 and S >= 2048)
+                matcher = K.match_extract_mxu if fast else K.match_extract
+                idx, valid, count = matcher(
+                    *dev_arrays, pw, pl, pd, k=self.max_fanout, chunk=chunk
+                )
+                idx = np.asarray(idx)
+                valid = np.asarray(valid)
+                counts = np.asarray(count)
+                idx_rows = [idx[i][valid[i]] for i in range(len(topics))]
+                need_host = counts[:len(topics)] > self.max_fanout
+        finally:
+            with self.lock:
+                self._inflight -= 1
         out: List[List[Row]] = []
         for i, topic in enumerate(topics):
             if need_host[i]:

@@ -682,7 +682,8 @@ def match_extract_windowed_flat(
     return (flat, pre.astype(jnp.int32), total.astype(jnp.int32), overflow)
 
 
-@functools.partial(jax.jit, static_argnames=("id_bits",))
+@functools.partial(jax.jit, static_argnames=("id_bits",),
+                   donate_argnums=(0, 1))
 def apply_delta_operands(
     F_t: jax.Array, t1: jax.Array,
     slots: jax.Array,     # int32 [D]
@@ -691,7 +692,8 @@ def apply_delta_operands(
     id_bits: int = 16,
 ):
     """Scatter-update the coded operand columns for dirty table slots
-    (companion to :func:`apply_delta` for the derived F/t1 arrays)."""
+    (companion to :func:`apply_delta` for the derived F/t1 arrays;
+    F_t/t1 are DONATED — see apply_delta's donation note)."""
     F_d, t1_d = build_operands(d_words, d_eff_len, id_bits)
     return F_t.at[:, slots].set(F_d), t1.at[slots].set(t1_d)
 
@@ -728,7 +730,7 @@ def match_topk(
     return _run_chunked(one, pub_words, pub_len, pub_dollar, chunk)
 
 
-@jax.jit
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
 def apply_delta(
     sub_words: jax.Array,
     sub_eff_len: jax.Array,
@@ -746,10 +748,24 @@ def apply_delta(
     table — the trie-delta stream (BASELINE config 5): subscribe/unsubscribe
     events accumulate host-side and apply in one scatter instead of
     re-uploading the table (the analog of vmq_reg_trie consuming
-    subscriber-db change events incrementally)."""
+    subscriber-db change events incrementally).
+
+    The table arrays are DONATED: without donation every functional
+    ``.at[].set`` copies the full S-row array, so a 128-slot delta at 5M
+    subs moved ~500MB of HBM and cost ~300ms (measured, BENCH config 5);
+    with donation XLA scatters in place. Callers must drop their old
+    references (TpuMatcher.sync reassigns _dev_arrays from the return)."""
     sub_words = sub_words.at[slots].set(d_words)
     sub_eff_len = sub_eff_len.at[slots].set(d_eff_len)
     has_hash = has_hash.at[slots].set(d_has_hash)
     first_wild = first_wild.at[slots].set(d_first_wild)
     active = active.at[slots].set(d_active)
     return sub_words, sub_eff_len, has_hash, first_wild, active
+
+
+# non-donating variants: used while a dispatched match still holds the
+# current buffers (donating them mid-flight would invalidate the match's
+# args — TpuMatcher.sync picks per call via its in-flight counter)
+apply_delta_copy = jax.jit(apply_delta.__wrapped__)
+apply_delta_operands_copy = jax.jit(apply_delta_operands.__wrapped__,
+                                    static_argnames=("id_bits",))
