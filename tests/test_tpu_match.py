@@ -627,3 +627,40 @@ def test_flat_padded_batch_tail_is_inert():
     topics = [(f"r{i}", f"d{i}", f"m{i}") for i in range(5)]
     for topic, rows in zip(topics, m.match_batch(topics)):
         assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_flat_overflow_property_parity():
+    """Hypothesis: with a deliberately starved flat buffer (flat_avg=1)
+    and tiny per-part k, random corpora with heavy duplicate filters
+    stay in exact parity — every clipped/overflowed pub must fall back
+    to the exact host path, and the prefix math after an overflowed pub
+    must not corrupt its neighbours' ranges (the clamp-to-k budget)."""
+    from hypothesis import given, settings, strategies as st
+
+    word = st.sampled_from(["r0", "r1", "d0", "d1", "m0"])
+    filt = st.lists(
+        st.one_of(word, st.sampled_from(["+", "#"])),
+        min_size=1, max_size=4,
+    ).filter(lambda f: "#" not in f[:-1])
+    topic = st.lists(word, min_size=1, max_size=4)
+
+    @settings(max_examples=20, deadline=None)
+    @given(st.lists(filt, min_size=5, max_size=60),
+           st.lists(topic, min_size=4, max_size=24))
+    def run(filters, topics):
+        m = _bucketed_matcher(max_fanout=16, flat_avg=1)
+        trie = SubscriptionTrie()
+        for i in range(9000):  # engage the bucketed layout
+            f = [f"fill{i % 13}", f"x{i % 7}", "+"]
+            m.table.add(f, 100000 + i, None)
+            trie.add(list(f), 100000 + i, None)
+        for i, f in enumerate(filters):
+            # duplicates across keys force fanouts past k=16
+            for dup in range(3):
+                m.table.add(list(f), (i, dup), None)
+                trie.add(list(f), (i, dup), None)
+        got = m.match_batch([tuple(t) for t in topics])
+        for t, rows in zip(topics, got):
+            assert norm(rows) == norm(trie.match(list(t))), t
+
+    run()
