@@ -193,3 +193,23 @@ def test_windowed_sharded_relocation_churn():
     got = m.match_batch(probe)
     for topic, rows in zip(probe, got):
         assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_windowed_sharded_overflow_and_clip_fall_back_exact():
+    """Starved flat buffer (flat_avg=1) + tiny per-part k on the SHARDED
+    flat kernel: clipped (>k) and capacity-overflowed pubs must fall back
+    to the exact host path without corrupting their neighbours' prefix
+    ranges — parity holds for every pub in the batch."""
+    table, trie, pools, rng = build_bucketed(11, 40_000, 1 << 16)
+    # heavy duplicates on one hot filter so fanout blows past k=8
+    l0, l1, l2 = pools
+    for d in range(40):
+        table.add([l0[0], l1[0], l2[0]], ("dup", d), None)
+        trie.add([l0[0], l1[0], l2[0]], ("dup", d), None)
+    mesh = make_mesh(batch=2)
+    m = ShardedWindowedMatcher(table, mesh, max_fanout=8, flat_avg=1)
+    topics = [(l0[0], l1[0], l2[0])] * 3 + topics_for(rng, pools, 29)
+    got = m.match_batch(topics)
+    for topic, rows in zip(topics, got):
+        want = sorted((k for _, k, _ in trie.match(list(topic))), key=repr)
+        assert sorted((k for _, k, _ in rows), key=repr) == want, topic

@@ -176,23 +176,25 @@ from ..ops.match_kernel import (
 
 def build_sharded_windowed(mesh: Mesh, *, id_bits: int, k: int,
                            glob_pad: int, seg_max: int, gc: int, T: int,
-                           Sl: int, with_total: bool = False):
-    """The windowed production matcher under shard_map on a
-    ('batch', 'sub') mesh — the multi-chip form of the single-chip
-    windowed kernel (:func:`ops.match_kernel.match_extract_windowed_flat`
-    minus the flat compaction: per-shard padded results are gathered over
-    ICI and compacted host-side).
+                           Sl: int, Cl: int, with_total: bool = False):
+    """The flat windowed production matcher under shard_map on a
+    ('batch', 'sub') mesh — the multi-chip form of
+    :func:`ops.match_kernel.match_extract_windowed_flat`.
 
     Sharding (SURVEY.md §5.7/§5.8): the coded operand matrix F_t is
     column-sharded over 'sub' (each device owns Sl contiguous table rows —
     the per-node trie replica seam vmq_reg_trie.erl:503-520 recast as row
-    slices); the publish batch is sharded over 'batch'. Region 0
-    (wildcard-first rows) travels replicated and each 'sub' shard matches
-    its glob_pad/n_sub column chunk, so no work is duplicated. Tile
-    windows are shard-local dynamic slices; tile inputs are prepped per
-    shard by the host (prepare_windows with row_lo/row_hi). The scalar
-    total-match count is psum-reduced over both mesh axes (ICI
-    collectives) and returned replicated.
+    slices); the publish batch is sharded over 'batch'. The dense zone
+    (region 0 + level-1 g-buckets) travels replicated and each 'sub'
+    shard matches its column chunk, so no work is duplicated. Probe-A
+    tiles are per-(batch,sub) DEVICE-LOCAL: [nb, nsub, T, TP] selector
+    indices into the device's local pub slice, windows are shard-local
+    dynamic slices. Each device flat-compacts ITS OWN matches (dense
+    chunk + its probe tiles) into a [Cl] buffer with per-pub prefix
+    ranges exactly like the single-chip kernel; the host concatenates a
+    pub's ranges across the 'sub' row. No per-batch collective is needed
+    for results — the optional psum'd total is the dryrun's ICI
+    demonstration (production skips the collective latency).
     """
     import math
 
@@ -206,15 +208,15 @@ def build_sharded_windowed(mesh: Mesh, *, id_bits: int, k: int,
 
     def local(F_sh, t1_sh, eff_sh, hh_sh, fw_sh, act_sh,
               Fg, t1g, effg, hhg, fwg, actg,
-              pw, pl, pd,
-              t_pw, t_pl, t_pd, t_start):
+              pw, pl, pd, real,
+              t_sel, t_start, a_tile, a_pos, a_shard):
         Kd = F_sh.shape[0]
-        t_pw, t_pl, t_pd, t_start = (t_pw[0], t_pl[0], t_pd[0], t_start[0])
+        t_sel, t_start = t_sel[0, 0], t_start[0, 0]
         sidx = lax.axis_index("sub")
         j = jnp.arange(seg_max, dtype=jnp.int32)
 
-        # global phase: this shard's column chunk of region 0, all pubs of
-        # this batch shard, in gc-sized pub chunks
+        # dense phase: this shard's column chunk of the dense zone, all
+        # pubs of this batch shard, in gc-sized pub chunks
         goff = sidx * GW
         Fg_c = lax.dynamic_slice(Fg, (0, goff), (Kd, GW))
         t1g_c = lax.dynamic_slice(t1g, (goff,), (GW,))
@@ -237,37 +239,65 @@ def build_sharded_windowed(mesh: Mesh, *, id_bits: int, k: int,
         gvalid = jnp.concatenate([o[1] for o in gouts], axis=0)
         gcount = jnp.concatenate([o[2] for o in gouts], axis=0)
 
-        # tile phase against this shard's row slice
+        # probe-A tile phase against this shard's row slice: tile pubs
+        # gathered from the LOCAL pub slice by selector
         touts = []
         for ti in range(T):
+            sel = t_sel[ti]
+            pwt = jnp.take(pw, sel, axis=0)
+            plt = jnp.take(pl, sel)
+            pdt = jnp.take(pd, sel)
             start = t_start[ti]
-            Kd_ = F_sh.shape[0]
-            Fseg = lax.dynamic_slice(F_sh, (0, start), (Kd_, seg_max))
+            Fseg = lax.dynamic_slice(F_sh, (0, start), (Kd, seg_max))
             t1s = lax.dynamic_slice(t1_sh, (start,), (seg_max,))
             effs = lax.dynamic_slice(eff_sh, (start,), (seg_max,))
             hhs = lax.dynamic_slice(hh_sh, (start,), (seg_max,))
             fws = lax.dynamic_slice(fw_sh, (start,), (seg_max,))
             acts = lax.dynamic_slice(act_sh, (start,), (seg_max,))
-            Gt = build_pub_operand(t_pw[ti], id_bits)
+            Gt = build_pub_operand(pwt, id_bits)
             mm = lax.dot_general(Gt, Fseg, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
             abs_start = sidx * Sl + start
             rowok = (j[None, :] + abs_start) >= glob_pad
             m = (mm + t1s[None, :] == 0.0) & _epilogue(
-                t_pl[ti], t_pd[ti], effs, hhs, fws, acts) & rowok
+                plt, pdt, effs, hhs, fws, acts) & rowok
             i2, v2, c2 = extract_indices_packed(_pack_mask(m), k, 2048)
             touts.append((i2 + abs_start, v2, c2))
         tidx = jnp.stack([o[0] for o in touts])
         tvalid = jnp.stack([o[1] for o in touts])
         tcount = jnp.stack([o[2] for o in touts])
 
-        outs = (gidx[:, None], gvalid[:, None], gcount[:, None],
-                tidx[None], tvalid[None], tcount[None])
+        # flat compaction (single-chip contract, per device): matches of
+        # this device's pubs on this shard's rows
+        okA = (a_shard == sidx) & (a_tile >= 0) & real
+        at = jnp.maximum(a_tile, 0)
+        aidx = tidx[at, a_pos]
+        avalid = tvalid[at, a_pos] & okA[:, None]
+        acnt = jnp.where(okA, tcount[at, a_pos], 0)
+        clip = (gcount > k) | (acnt > k)
+        gcnt = jnp.minimum(jnp.where(real, gcount, 0), k)
+        acnt = jnp.minimum(acnt, k)
+        cnt = gcnt + acnt
+        pre = jnp.cumsum(cnt) - cnt
+        jk = jnp.arange(k, dtype=jnp.int32)[None, :]
+        flat = jnp.zeros((Cl,), jnp.int32)
+
+        def scat(flat, base, idx, valid, cn):
+            pos = base[:, None] + jk
+            p = jnp.where(valid & real[:, None] & (jk < cn[:, None]),
+                          pos, Cl)
+            return flat.at[p].set(idx, mode="drop")
+
+        flat = scat(flat, pre, gidx, gvalid, gcnt)
+        flat = scat(flat, pre + gcnt, aidx, avalid, acnt)
+        ovf = ((pre + cnt > Cl) | clip) & real
+
+        outs = (flat[None, None], pre[None, None].astype(jnp.int32),
+                cnt[None, None].astype(jnp.int32), ovf[None, None])
         if with_total:
             # ICI collective: cluster-wide match total (dryrun exercises
             # it; production skips the per-batch collective latency)
-            total = lax.psum(lax.psum(
-                gcount.sum() + tcount.sum(), "sub"), "batch")
+            total = lax.psum(lax.psum(cnt.sum(), "sub"), "batch")
             outs = outs + (total,)
         return outs
 
@@ -277,15 +307,13 @@ def build_sharded_windowed(mesh: Mesh, *, id_bits: int, k: int,
         in_specs=(
             P(None, "sub"), P("sub"), P("sub"), P("sub"), P("sub"), P("sub"),
             P(None, None), P(None), P(None), P(None), P(None), P(None),
-            P("batch", None), P("batch"), P("batch"),
-            P("sub", None, None, None), P("sub", None, None),
-            P("sub", None, None), P("sub", None),
+            P("batch", None), P("batch"), P("batch"), P("batch"),
+            P("batch", "sub", None, None), P("batch", "sub", None),
+            P("batch"), P("batch"), P("batch"),
         ),
         out_specs=(
             P("batch", "sub", None), P("batch", "sub", None),
-            P("batch", "sub"),
-            P("sub", None, None, None), P("sub", None, None, None),
-            P("sub", None, None),
+            P("batch", "sub", None), P("batch", "sub", None),
         ) + ((P(),) if with_total else ()),
         check_vma=False,
     )
@@ -300,13 +328,14 @@ class ShardedWindowedMatcher:
     their shard's tile slots) fall back to exact host matching."""
 
     def __init__(self, table, mesh: Mesh, max_fanout: int = 128,
-                 with_total: bool = False):
+                 with_total: bool = False, flat_avg: int = 128):
         self.table = table
         self.mesh = mesh
         self.nsub = mesh.shape["sub"]
         self.nb = mesh.shape["batch"]
         self.max_fanout = max_fanout
         self.with_total = with_total
+        self.flat_avg = flat_avg
         self._dev = None
         self._fns = {}
         self._geom = None
@@ -398,17 +427,18 @@ class ShardedWindowedMatcher:
         self._dev = (F_t, t1, eff, hh, fw, act,
                      Fg, t1g, effg, hhg, fwg, actg)
 
-    def _fn_for(self, Bpad: int, T: int, seg_max: int, gc: int):
+    def _fn_for(self, Bpad: int, T: int, seg_max: int, gc: int, Cl: int):
         # _glob (the dense width) and _S (hence Sl) are baked into the
         # compiled fn as Python constants — a rebuild can move them while
         # leaving the other dims unchanged, so they must key the cache
-        key = (Bpad, T, seg_max, gc, self._glob, self._S)
+        key = (Bpad, T, seg_max, gc, Cl, self._glob, self._S)
         fn = self._fns.get(key)
         if fn is None:
             fn = build_sharded_windowed(
                 self.mesh, id_bits=self._bits, k=self.max_fanout,
                 glob_pad=self._glob, seg_max=seg_max, gc=gc, T=T,
-                Sl=self._S // self.nsub, with_total=self.with_total)
+                Sl=self._S // self.nsub, Cl=Cl,
+                with_total=self.with_total)
             self._fns[key] = fn
         return fn
 
@@ -420,24 +450,28 @@ class ShardedWindowedMatcher:
         self.sync()
         n = len(topics)
         S, glob, nsub = self._S, self._glob, self.nsub
+        nb = self.nb
         Sl = S // nsub
         # batch padding: divisible by the batch axis and pow2-laddered
-        Bpad = self.nb
+        Bpad = nb
         while Bpad < n:
             Bpad *= 2
-        Bpad = max(Bpad, 8 * self.nb)
+        Bpad = max(Bpad, 8 * nb)
+        Bl = Bpad // nb  # local pub slice per batch row
         L = self.table.L
         pw = np.full((Bpad, L), np.int32(-2), dtype=np.int32)
         pl = np.zeros(Bpad, dtype=np.int32)
         pd = np.zeros(Bpad, dtype=bool)
+        real = np.zeros(Bpad, dtype=bool)
+        real[:n] = True
         pb = np.zeros(n, dtype=np.int32)
         for i, topic in enumerate(topics):
             row, ln, dollar, bucket, _gb = self.table.encode_topic_ex(topic)
             pw[i], pl[i], pd[i], pb[i] = row, ln, dollar, bucket
-        # per-shard pub assignment by bucket-row ownership
-        shard_of = np.minimum(self._reg_start[pb] // Sl, nsub - 1).astype(int)
-        Bsh = max(8, min(Bpad, _pow2ceil(2 * Bpad // nsub)))
-        slot_tiles = max(1, Bsh // TILE_PUBS)
+        # per-shard pub assignment by bucket-row ownership (pads: -1)
+        shard_of = np.full(Bpad, -1, dtype=np.int32)
+        shard_of[:n] = np.minimum(self._reg_start[pb] // Sl, nsub - 1)
+        slot_tiles = max(1, -(-Bl // TILE_PUBS))
         # level-0 buckets only: the g-zone (regions 1..NG) is matched
         # densely here and must not inflate the window size
         ng = self.table.NG
@@ -451,67 +485,48 @@ class ShardedWindowedMatcher:
                       sl_cap)
         # span budget: tiles close on window overflow even with free slots
         T = slot_tiles + -(-Sl // seg_max) + 2
-        gc = min(Bpad // self.nb, 1024)
+        gc = min(Bl, 1024)
+        Cl = Bl * self.flat_avg
         TP = TILE_PUBS
-        t_pw = np.full((nsub, T, TP, L), np.int32(0), dtype=np.int32)
-        t_pl = np.zeros((nsub, T, TP), dtype=np.int32)
-        t_pd = np.zeros((nsub, T, TP), dtype=bool)
-        t_start = np.zeros((nsub, T), dtype=np.int32)
-        tile_of = np.full(n, -1, dtype=np.int64)  # packed shard*T*TP + ...
+        t_sel = np.zeros((nb, nsub, T, TP), dtype=np.int32)
+        t_start = np.zeros((nb, nsub, T), dtype=np.int32)
+        a_tile = np.full(Bpad, -1, dtype=np.int32)
+        a_pos = np.zeros(Bpad, dtype=np.int32)
         leftovers = set()
-        for s in range(nsub):
-            mine = np.nonzero(shard_of == s)[0]
-            if len(mine) == 0:
-                continue
-            if len(mine) > Bsh:
-                leftovers.update(int(i) for i in mine[Bsh:])
-                mine = mine[:Bsh]
-            pw_s = np.full((Bsh, L), np.int32(-2), dtype=np.int32)
-            pl_s = np.zeros(Bsh, dtype=np.int32)
-            pd_s = np.zeros(Bsh, dtype=bool)
-            pb_s = np.zeros(len(mine), dtype=np.int32)
-            pw_s[:len(mine)] = pw[mine]
-            pl_s[:len(mine)] = pl[mine]
-            pd_s[:len(mine)] = pd[mine]
-            pb_s[:] = pb[mine]
-            (tps, tls, tds, tss, tof, pof, left) = prepare_windows(
-                pw_s, pl_s, pd_s, pb_s, len(mine), self._reg_start,
-                self._reg_end, S, T, seg_max,
-                row_lo=s * Sl, row_hi=(s + 1) * Sl)
-            t_pw[s], t_pl[s], t_pd[s], t_start[s] = tps, tls, tds, tss
-            for li in left:
-                leftovers.add(int(mine[li]))
-            for local_i, orig in enumerate(mine):
-                if tof[local_i] >= 0:
-                    tile_of[orig] = ((s * T + tof[local_i]) * TP
-                                     + pof[local_i])
-        fn = self._fn_for(Bpad, T, seg_max, gc)
-        res = fn(*self._dev, pw, pl, pd, t_pw, t_pl, t_pd, t_start)
-        (gidx, gvalid, gcount, tidx, tvalid, tcount) = res[:6]
-        gidx = np.asarray(gidx)      # [Bpad, nsub, k]
-        gvalid = np.asarray(gvalid)
-        gcount = np.asarray(gcount)  # [Bpad, nsub]
-        tidx = np.asarray(tidx)      # [nsub, T, TP, k]
-        tvalid = np.asarray(tvalid)
-        tcount = np.asarray(tcount)
-        k = self.max_fanout
+        for r in range(nb):
+            lo = r * Bl
+            sor = shard_of[lo:lo + Bl]
+            for s in range(nsub):
+                mine = np.nonzero(sor == s)[0]  # row-local indices
+                if len(mine) == 0:
+                    continue
+                sel = lo + mine
+                (tsc, tss, tof, pof, left) = prepare_windows(
+                    pw[sel], pl[sel], pd[sel], pb[sel],
+                    len(mine), self._reg_start, self._reg_end, S, T,
+                    seg_max, row_lo=s * Sl, row_hi=(s + 1) * Sl,
+                    emit="sel")
+                # map compact-space selectors back to row-local indices
+                t_sel[r, s] = mine[tsc]
+                t_start[r, s] = tss
+                placed = tof >= 0
+                a_tile[sel[placed]] = tof[placed]
+                a_pos[sel[placed]] = pof[placed]
+                for li in left:
+                    leftovers.add(int(sel[li]))
+        fn = self._fn_for(Bpad, T, seg_max, gc, Cl)
+        res = fn(*self._dev, pw, pl, pd, real,
+                 t_sel, t_start, a_tile, a_pos, shard_of)
+        flat, pre, cnt, ovf = (np.asarray(x) for x in res[:4])
+        # flat [nb, nsub, Cl]; pre/cnt/ovf [nb, nsub, Bl]
         out = []
         for i, topic in enumerate(topics):
-            if i in leftovers:
+            r, j = divmod(i, Bl)
+            if i in leftovers or ovf[r, :, j].any():
                 out.append(self._host_match(topic))
                 continue
-            clipped = bool((gcount[i] > k).any())
-            parts = [gidx[i, s][gvalid[i, s]] for s in range(nsub)]
-            packed = tile_of[i]
-            if packed >= 0:
-                st = int(packed // TP)
-                s, ti, pos = st // T, st % T, int(packed % TP)
-                if tcount[s, ti, pos] > k:
-                    clipped = True
-                parts.append(tidx[s, ti, pos][tvalid[s, ti, pos]])
-            if clipped:
-                out.append(self._host_match(topic))
-                continue
+            parts = [flat[r, s, pre[r, s, j]:pre[r, s, j] + cnt[r, s, j]]
+                     for s in range(nsub)]
             rows = self.table.resolve(np.concatenate(parts))
             if len(self.table.overflow):
                 rows = rows + self.table.overflow.match(list(topic))
