@@ -1,0 +1,119 @@
+"""Plumtree epidemic-broadcast-tree unit tests (cluster/plumtree.py):
+delivery, tree convergence (flood decays to ~one delivery per node),
+prune on duplicates, and graft repair when an eager link loses a
+payload. Uses an in-memory router instead of the framed TCP channel."""
+
+from vernemq_tpu.cluster.plumtree import Plumtree
+
+
+class Net:
+    """Synchronous in-memory mesh router for N Plumtree nodes."""
+
+    def __init__(self, names, fanout=2, drop=None):
+        self.nodes = {}
+        self.queue = []
+        self.delivered = {n: [] for n in names}
+        self.drop = drop or (lambda src, dst, cmd: False)
+        for n in names:
+            self.nodes[n] = Plumtree(
+                n, (lambda src: lambda dst, cmd, term:
+                    self._enqueue(src, dst, cmd, term))(n),
+                eager_fanout=fanout)
+        for a in names:
+            for b in names:
+                if a != b:
+                    self.nodes[a].peer_up(b)
+
+    def _enqueue(self, src, dst, cmd, term):
+        if self.drop(src, dst, cmd):
+            return True
+        self.queue.append((src, dst, cmd, term))
+        return True
+
+    def run(self):
+        """Drain until quiescent; returns per-cmd counts."""
+        counts = {}
+        steps = 0
+        while self.queue:
+            steps += 1
+            assert steps < 100_000, "broadcast storm did not quiesce"
+            src, dst, cmd, term = self.queue.pop(0)
+            pt = self.nodes.get(dst)
+            if pt is None:
+                continue
+            counts[cmd] = counts.get(cmd, 0) + 1
+            if cmd == b"mtg":
+                mid, prefix, key, entry = term
+                if pt.on_gossip(src, mid, prefix, key, entry):
+                    self.delivered[dst].append((prefix, key, tuple(entry)))
+            elif cmd == b"mti":
+                pt.on_ihave(src, term[0])
+                # no event loop in unit tests: pending grafts fire
+                # immediately inside _arm_graft_timer
+            elif cmd == b"mtr":
+                pt.on_graft(src, term[0])
+            elif cmd == b"mtp":
+                pt.on_prune(src)
+        return counts
+
+
+def test_broadcast_reaches_every_node():
+    names = [f"n{i}" for i in range(8)]
+    net = Net(names, fanout=3)
+    net.nodes["n0"].broadcast("p", "k", [1, "v", 7])
+    net.run()
+    for n in names[1:]:
+        assert net.delivered[n] == [("p", "k", (1, "v", 7))], n
+
+
+def test_tree_converges_to_one_delivery_per_node():
+    """After the first storm prunes cycle links, later broadcasts arrive
+    at each node ~once: total gossip frames approach n-1 (a tree), far
+    below the flood's n*(n-1)."""
+    names = [f"n{i}" for i in range(10)]
+    net = Net(names, fanout=3)
+    # warm-up storms let prunes carve the tree
+    for r in range(4):
+        net.nodes["n0"].broadcast("p", f"warm{r}", [r])
+        net.run()
+    counts = {}
+    net.nodes["n0"].broadcast("p", "steady", [99])
+    counts = net.run()
+    assert all(("p", "steady", (99,)) in net.delivered[n]
+               for n in names[1:])
+    gossip = counts.get(b"mtg", 0)
+    n = len(names)
+    assert gossip <= 2 * (n - 1), f"still flooding: {gossip} gossip frames"
+
+
+def test_graft_repairs_lost_payload():
+    """An eager link that silently drops the payload: the victim only
+    hears the IHAVE from a lazy link, grafts it to eager, and pulls the
+    payload — delivery still happens everywhere."""
+    names = ["a", "b", "c"]
+    # drop all gossip INTO c except from b, so c must graft b's IHAVE
+    def drop(src, dst, cmd):
+        return cmd == b"mtg" and dst == "c" and src == "a"
+
+    net = Net(names, fanout=1, drop=drop)
+    # make a's eager = {b}, lazy = {c}; b's eager = {a} or {c}
+    net.nodes["a"].eager = {"b"}
+    net.nodes["a"].lazy = {"c"}
+    net.nodes["b"].eager = {"a"}
+    net.nodes["b"].lazy = {"c"}
+    net.nodes["c"].eager = {"a"}
+    net.nodes["c"].lazy = {"b"}
+    net.nodes["a"].broadcast("p", "k", ["payload"])
+    net.run()
+    assert ("p", "k", ("payload",)) in net.delivered["b"]
+    assert ("p", "k", ("payload",)) in net.delivered["c"]
+    assert net.nodes["c"].grafts >= 1
+
+
+def test_peer_down_promotes_lazy_link():
+    pt = Plumtree("x", lambda *a: True, eager_fanout=1)
+    pt.peer_up("e1")
+    pt.peer_up("l1")
+    assert pt.eager == {"e1"} and pt.lazy == {"l1"}
+    pt.peer_down("e1")
+    assert pt.eager == {"l1"} and not pt.lazy

@@ -58,7 +58,11 @@ class Cluster:
         self.metadata.subscribe(MEMBERS, self._on_member_change)
         if hasattr(self.metadata, "attach_cluster"):  # SWC backend
             self.metadata.attach_cluster(self)
-        else:  # LWW backend: delta broadcast + full-state AE
+            self.plumtree = None
+        else:  # LWW backend: plumtree broadcast tree + digest AE
+            from .plumtree import Plumtree
+
+            self.plumtree = Plumtree(self.node_name, self._pt_send)
             self.metadata.broadcast = self._broadcast_meta
         broker.cluster = self
         broker.registry.remote_publish = self.publish
@@ -258,6 +262,8 @@ class Cluster:
             if w is not None:
                 w.stop()
             self._status.pop(node, None)
+            if self.plumtree is not None:
+                self.plumtree.peer_down(node)
             self.broker.registry.node_left(node)
 
     # -------------------------------------------------------- channel status
@@ -269,6 +275,11 @@ class Cluster:
             return
         old = self._status.get(node)
         self._status[node] = status
+        if self.plumtree is not None:
+            if status == "up":
+                self.plumtree.peer_up(node)
+            elif status == "down":
+                self.plumtree.peer_down(node)
         if old == "up" and status == "down":
             self.netsplit_detected += 1
             self.metrics.incr("netsplit_detected")
@@ -444,10 +455,19 @@ class Cluster:
         if w is not None:
             w.send_frame(frame(b"syr", key))
 
+    def _pt_send(self, node: str, cmd: bytes, term: Any) -> bool:
+        w = self._writers.get(node)
+        if w is None or w.status == "down":
+            return False
+        return w.send_frame(frame(cmd, term))
+
     def _broadcast_meta(self, prefix: str, key: Any, entry) -> None:
-        # the codec preserves tuple/list distinction, so keys travel as-is
-        data = frame(b"mta", (prefix, key, list(entry)))
-        for w in self._writers.values():
-            w.send_frame(data)
-        for w in self._bootstrap:
-            w.send_frame(data)
+        # the codec preserves tuple/list distinction, so keys travel as-is.
+        # Joined peers get the write via the plumtree broadcast tree
+        # (eager gossip + lazy IHAVE, vmq_plumtree.erl:46-104 analog);
+        # pre-handshake bootstrap channels still get a plain flood frame.
+        self.plumtree.broadcast(prefix, key, list(entry))
+        if self._bootstrap:
+            data = frame(b"mta", (prefix, key, list(entry)))
+            for w in self._bootstrap:
+                w.send_frame(data)

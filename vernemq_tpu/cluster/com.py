@@ -108,6 +108,24 @@ class ClusterCom:
             if hasattr(cluster.metadata, "merge"):
                 prefix, key, entry = term
                 cluster.metadata.merge(prefix, codec.dekey(key), tuple(entry))
+        elif cmd == b"mtg":
+            # plumtree eager gossip: merge on first sight of the id, then
+            # the tree re-pushes (Plumtree.on_gossip); duplicates prune
+            pt = cluster.plumtree
+            if pt is not None and hasattr(cluster.metadata, "merge"):
+                mid, prefix, key, entry = term
+                if pt.on_gossip(origin, mid, prefix, key, list(entry)):
+                    cluster.metadata.merge(prefix, codec.dekey(key),
+                                           tuple(entry))
+        elif cmd == b"mti":
+            if cluster.plumtree is not None:
+                cluster.plumtree.on_ihave(origin, term[0])
+        elif cmd == b"mtr":
+            if cluster.plumtree is not None:
+                cluster.plumtree.on_graft(origin, term[0])
+        elif cmd == b"mtp":
+            if cluster.plumtree is not None:
+                cluster.plumtree.on_prune(origin)
         elif cmd == b"mtf":
             if hasattr(cluster.metadata, "merge_full"):
                 applied = cluster.metadata.merge_full(
