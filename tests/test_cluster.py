@@ -695,3 +695,34 @@ async def test_shared_subscription_cross_node_tpu_view():
         await pub.disconnect()
     finally:
         await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_plumtree_eight_node_convergence(event_loop):
+    """8-node cluster on the real framed channel with LWW metadata:
+    subscription writes disseminate over the plumtree broadcast tree
+    (eager gossip + lazy IHAVE) — every node's trie converges, cross-
+    cluster delivery works, and the tree actually engaged (gossip rx on
+    far nodes, lazy links exist once peers exceed the eager fanout)."""
+    nodes = await make_cluster(8)
+    try:
+        sub = await connected(nodes[7], "pt-sub")
+        await sub.subscribe("pt/+/t", qos=1)
+        # subscription metadata must reach node0 through the tree
+        await wait_until(lambda: len(
+            nodes[0].broker.registry.trie("").match(["pt", "x", "t"])) == 1)
+        pub = await connected(nodes[0], "pt-pub")
+        await pub.publish("pt/x/t", b"tree", qos=1)
+        got = await sub.recv(10)
+        assert got.payload == b"tree"
+        pt7 = nodes[7].cluster.plumtree
+        assert pt7 is not None and pt7.rx > 0
+        # 7 peers > eager_fanout 4: lazy links must exist on every node
+        for n in nodes:
+            pt = n.cluster.plumtree
+            assert len(pt.eager) <= pt.eager_fanout + pt.grafts + 1
+            assert pt.eager or pt.lazy
+        await sub.disconnect()
+        await pub.disconnect()
+    finally:
+        await stop_cluster(nodes)
