@@ -247,3 +247,41 @@ def test_format_table_empty():
     assert format_table([]) == "(no rows)"
     out = format_table([{"a": 1, "b": None}, {"a": 22, "c": True}])
     assert "22" in out and "true" in out
+
+
+@pytest.mark.asyncio
+async def test_session_disconnect_command(broker):
+    """vmq-admin session disconnect kicks a live session; cleanup=true
+    also discards its subscriber record (vmq_info_cli disconnect)."""
+    b, server, _ = broker
+    c = await connected(broker, "kickme")
+    await c.subscribe("k/x", qos=1)
+    reg = register_core_commands(CommandRegistry())
+    out = reg.run(b, ["session", "disconnect", "client-id=kickme",
+                      "cleanup=true"])
+    assert "disconnect scheduled" in out
+    for _ in range(100):
+        await asyncio.sleep(0.02)
+        if ("", "kickme") not in b.sessions:
+            break
+    assert ("", "kickme") not in b.sessions
+    assert b.registry.db.read(("", "kickme")) is None  # cleaned up
+
+
+@pytest.mark.asyncio
+async def test_webhooks_cli_register_show_deregister(broker):
+    b, _, _ = broker
+    b.plugins.enable("vmq_webhooks")
+    reg = register_core_commands(CommandRegistry())
+    out = reg.run(b, ["webhooks", "register", "hook=auth_on_publish",
+                      "endpoint=http://127.0.0.1:1/hk"])
+    assert "registered" in out
+    table = reg.run(b, ["webhooks", "show"])["table"]
+    assert table == [{"hook": "auth_on_publish",
+                      "endpoint": "http://127.0.0.1:1/hk",
+                      "base64payload": True}]
+    reg.run(b, ["webhooks", "deregister", "hook=auth_on_publish",
+                "endpoint=http://127.0.0.1:1/hk"])
+    assert reg.run(b, ["webhooks", "show"])["table"] == []
+    with pytest.raises(CommandError):
+        reg.run(b, ["webhooks", "register", "hook=nope", "endpoint=x"])

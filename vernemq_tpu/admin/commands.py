@@ -143,6 +143,16 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin cluster fix-dead-queues [targets=n1,n2]")
     reg.register(["cluster", "migrations"], _cluster_migrations,
                  "vmq-admin cluster migrations")
+    reg.register(["session", "disconnect"], _session_disconnect,
+                 "vmq-admin session disconnect client-id=CID "
+                 "[mountpoint=] [cleanup=true]")
+    reg.register(["webhooks", "register"], _webhooks_register,
+                 "vmq-admin webhooks register hook=H endpoint=URL "
+                 "[base64payload=true]")
+    reg.register(["webhooks", "deregister"], _webhooks_deregister,
+                 "vmq-admin webhooks deregister hook=H endpoint=URL")
+    reg.register(["webhooks", "show"], _webhooks_show,
+                 "vmq-admin webhooks show")
     reg.register(["session", "show"], _session_show,
                  "vmq-admin session show [--limit=N] [client_id=X] "
                  "[--<field>...]")
@@ -289,6 +299,67 @@ def _loose_eq(row_value: Any, want: Any) -> bool:
     if isinstance(want, bool) or isinstance(row_value, bool):
         return str(row_value).lower() == str(want).lower()
     return str(row_value) == str(want)
+
+
+def _session_disconnect(broker, flags):
+    """Forcibly disconnect a live session (vmq-admin session disconnect,
+    vmq_info_cli's disconnect command); cleanup=true also discards the
+    persistent queue (clean-session semantics on the way out)."""
+    import asyncio
+
+    cid = flags.get("client-id") or flags.get("client_id")
+    if not cid:
+        raise CommandError("client-id is required")
+    mp = flags.get("mountpoint", "")
+    sid = (mp, cid)
+    session = broker.sessions.get(sid)
+    if session is None:
+        raise CommandError(f"no live session for {sid!r}")
+    cleanup = str(flags.get("cleanup", "false")).lower() in ("true", "1")
+
+    async def _close():
+        await session.close("administrative_action", send_will=False)
+        if cleanup:
+            broker.registry.cleanup_subscriber(sid)
+
+    asyncio.get_event_loop().create_task(_close())
+    return f"disconnect scheduled for {cid!r}" + \
+        (" (with cleanup)" if cleanup else "")
+
+
+def _webhooks_plugin(broker):
+    p = broker.plugins._enabled.get("vmq_webhooks")
+    if p is None:
+        raise CommandError("vmq_webhooks plugin is not enabled")
+    return p
+
+
+def _webhooks_register(broker, flags):
+    hook, endpoint = flags.get("hook"), flags.get("endpoint")
+    if not hook or not endpoint:
+        raise CommandError("hook and endpoint are required")
+    b64 = str(flags.get("base64payload", "true")).lower() in ("true", "1")
+    try:
+        _webhooks_plugin(broker).register_endpoint(
+            hook, endpoint, base64_payload=b64)
+    except ValueError as e:
+        raise CommandError(str(e)) from None
+    return f"registered {endpoint} for {hook}"
+
+
+def _webhooks_deregister(broker, flags):
+    hook, endpoint = flags.get("hook"), flags.get("endpoint")
+    if not hook or not endpoint:
+        raise CommandError("hook and endpoint are required")
+    _webhooks_plugin(broker).deregister_endpoint(hook, endpoint)
+    return f"deregistered {endpoint} for {hook}"
+
+
+def _webhooks_show(broker, flags):
+    p = _webhooks_plugin(broker)
+    return {"table": [
+        {"hook": h, "endpoint": e, "base64payload": o.get("base64_payload")}
+        for h, lst in sorted(p.endpoints.items()) for e, o in lst]}
 
 
 def _session_show(broker, flags):
