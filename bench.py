@@ -131,7 +131,7 @@ class WindowedBench:
     the honest production round trip, overlapped ``depth`` batches deep."""
 
     def __init__(self, jax, table, pools, rng, batch, max_fanout=256,
-                 flat_avg=128, depth=3):
+                 flat_avg=128, depth=3, variant="flat"):
         from vernemq_tpu.models.tpu_matcher import TpuMatcher
 
         self.jax = jax
@@ -139,6 +139,7 @@ class WindowedBench:
         self.pools = pools
         self.batch = batch
         self.depth = depth
+        self.variant = variant  # "flat" (scatter buffer) | "rows" (gather)
         self.m = TpuMatcher(max_levels=table.L, initial_capacity=16,
                             max_fanout=max_fanout, flat_avg=flat_avg)
         self.m.table = table
@@ -172,9 +173,13 @@ class WindowedBench:
         m = self.m
         args, statics, _, _, _ = prep
         F_t, t1 = m._operands
-        return K.match_extract_windowed_flat(
-            F_t, t1, m._dev_arrays[1], m._dev_arrays[2], m._dev_arrays[3],
-            m._dev_arrays[4], *args, **statics)
+        head = (F_t, t1, m._dev_arrays[1], m._dev_arrays[2],
+                m._dev_arrays[3], m._dev_arrays[4])
+        if self.variant == "rows":
+            st = dict(statics)
+            st["kf"] = st.pop("C") // args[0].shape[0]  # same bytes as flat
+            return K.match_extract_windowed_rows(*head, *args, **st)
+        return K.match_extract_windowed_flat(*head, *args, **statics)
 
     def run(self, iters, warmup=6, measure_resolve=True):
         topics_batches = [zipf_topics(self.rng, self.pools, self.batch)
@@ -189,10 +194,15 @@ class WindowedBench:
 
         def pull(out):
             # the production round trip: every result array to host
-            flat = np.asarray(out[0])
-            pre = np.asarray(out[1])
-            total = np.asarray(out[2])
-            ovf = np.asarray(out[3])
+            if self.variant == "rows":
+                np.asarray(out[0])
+                total = np.asarray(out[1])
+                ovf = np.asarray(out[2])
+            else:
+                np.asarray(out[0])
+                np.asarray(out[1])
+                total = np.asarray(out[2])
+                ovf = np.asarray(out[3])
             return int(total.sum(dtype=np.int64)), int(ovf.sum())
 
         leftover_total = 0

@@ -664,3 +664,45 @@ def test_flat_overflow_property_parity():
             assert norm(rows) == norm(trie.match(list(t))), t
 
     run()
+
+
+def test_rows_variant_matches_flat_kernel():
+    """match_extract_windowed_rows (gather-merge, no scatter) returns the
+    same per-pub slot sets as the production flat kernel on a bucketed
+    corpus — the A/B candidate for hardware where scatters dominate."""
+    import numpy as np
+
+    from vernemq_tpu.ops import match_kernel as K
+
+    rng = random.Random(21)
+    m = _bucketed_matcher(max_fanout=64)
+    for i in range(10000):
+        m.table.add(corpus_filter(rng), i, None)
+    topics = [(f"r{rng.randrange(16)}", f"d{rng.randrange(40)}",
+               f"m{rng.randrange(16)}") for _ in range(64)]
+    with m.lock:
+        m.sync()
+    pw, pl, pd, pb, gb = m._encode_batch_ex(topics)
+    S = int(m._dev_arrays[0].shape[0])
+    args, statics, left = m._flat_prep(
+        m._reg_start, m._reg_end, m._glob_pad, m._ops_bits, S,
+        pw, pl, pd, pb, gb, len(topics))
+    head = (m._operands[0], m._operands[1], m._dev_arrays[1],
+            m._dev_arrays[2], m._dev_arrays[3], m._dev_arrays[4])
+    flat, pre, total, ovf = (np.asarray(x) for x in
+                             K.match_extract_windowed_flat(
+                                 *head, *args, **statics))
+    st = dict(statics)
+    st["kf"] = st.pop("C") // pw.shape[0]
+    rows, rtotal, rovf = (np.asarray(x) for x in
+                          K.match_extract_windowed_rows(
+                              *head, *args, **st))
+    assert not left
+    np.testing.assert_array_equal(total[:64], rtotal[:64])
+    np.testing.assert_array_equal(ovf[:64], rovf[:64])
+    for i in range(64):
+        if ovf[i]:
+            continue
+        a = sorted(flat[pre[i]:pre[i] + total[i]])
+        b = sorted(rows[i, :rtotal[i]])
+        assert a == b, (i, topics[i])

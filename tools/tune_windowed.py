@@ -40,6 +40,10 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    variant = "flat"
+    if "--rows" in argv:  # gather-merge kernel instead of scatter-flat
+        argv.remove("--rows")
+        variant = "rows"
     tps = _axis(argv, "tp", [128, 256])
     bs = _axis(argv, "b", [2048, 4096, 8192])
     fms = _axis(argv, "fm", [2])
@@ -67,10 +71,10 @@ def main():
             TM.FAIR_MULT = fm
             for B in bs:
                 for fa in fas:
-                    tag = f"TP={tile_pubs} FM={fm} B={B} FA={fa}"
+                    tag = f"TP={tile_pubs} FM={fm} B={B} FA={fa} V={variant}"
                     try:
                         wb = WindowedBench(jax, table, pools, rng, B, 256,
-                                           flat_avg=fa)
+                                           flat_avg=fa, variant=variant)
                         r = wb.run(20, warmup=8, measure_resolve=False)
                         note(f"{tag}: "
                              f"{r['matches_per_sec']/1e6:.2f}M matches/s "

@@ -682,6 +682,92 @@ def match_extract_windowed_flat(
     return (flat, pre.astype(jnp.int32), total.astype(jnp.int32), overflow)
 
 
+@functools.partial(jax.jit,
+                   static_argnames=("id_bits", "k", "glob_pad", "seg_max",
+                                    "seg2_max", "gc", "kf"))
+def match_extract_windowed_rows(
+    F_t: jax.Array, t1: jax.Array, sub_eff_len: jax.Array,
+    has_hash: jax.Array, first_wild: jax.Array, active: jax.Array,
+    pub_words: jax.Array, pub_len: jax.Array, pub_dollar: jax.Array,
+    n_real: jax.Array,
+    t_sel: jax.Array, t_start: jax.Array,
+    t2_sel: jax.Array, t2_start: jax.Array,
+    a_tile: jax.Array, a_pos: jax.Array,
+    b_tile: jax.Array, b_pos: jax.Array,
+    *, id_bits: int, k: int, glob_pad: int, seg_max: int, seg2_max: int,
+    gc: int, kf: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Gather-merge variant of :func:`match_extract_windowed_flat`: same
+    three match phases and per-pub gathers, but the per-part results
+    merge into a padded ``[B, kf]`` row per publish via rank-wise selects
+    + take_along_axis — NO scatter (TPU scatters serialize; if the flat
+    buffer's 3x[B,k] scatter dominates on hardware this variant trades
+    it for three gathers at the cost of a fixed per-pub cap ``kf``
+    instead of flat's batch-averaged capacity).
+
+    Returns ``(rows [B, kf] int32, total [B] int32, overflow [B] bool)``;
+    publish i's matched slots are ``rows[i, :total[i]]`` unless
+    ``overflow[i]`` (total > kf, or a part clipped at k).
+    """
+    B = pub_words.shape[0]
+    real = jnp.arange(B, dtype=jnp.int32) < n_real
+
+    gouts = []
+    for c in range(0, B, gc):
+        sl = slice(c, c + gc)
+        G = build_pub_operand(pub_words[sl], id_bits)
+        mm = lax.dot_general(
+            G, F_t[:, :glob_pad], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + t1[None, :glob_pad]
+        m = (mm == 0.0) & _epilogue(
+            pub_len[sl], pub_dollar[sl], sub_eff_len[:glob_pad],
+            has_hash[:glob_pad], first_wild[:glob_pad], active[:glob_pad])
+        gouts.append(extract_indices_packed(_pack_mask(m), k, 2048))
+    gidx = jnp.concatenate([o[0] for o in gouts], axis=0)
+    gcount = jnp.concatenate([o[2] for o in gouts], axis=0)
+
+    args = (F_t, t1, sub_eff_len, has_hash, first_wild, active,
+            pub_words, pub_len, pub_dollar)
+    tidx, tvalid, tcount = _window_tiles_sel(
+        *args, t_sel, t_start, id_bits=id_bits, k=k,
+        seg_max=seg_max, glob_pad=glob_pad, wild_rows=False)
+    okA = a_tile >= 0
+    at = jnp.maximum(a_tile, 0)
+    aidx = tidx[at, a_pos]
+    acnt = jnp.where(okA, tcount[at, a_pos], 0)
+    if seg2_max:
+        t2idx, t2valid, t2count = _window_tiles_sel(
+            *args, t2_sel, t2_start, id_bits=id_bits, k=k,
+            seg_max=seg2_max, glob_pad=glob_pad, wild_rows=True)
+        okB = b_tile >= 0
+        bt = jnp.maximum(b_tile, 0)
+        bidx = t2idx[bt, b_pos]
+        bcnt = jnp.where(okB, t2count[bt, b_pos], 0)
+    else:
+        bidx = jnp.zeros((B, k), jnp.int32)
+        bcnt = jnp.zeros((B,), jnp.int32)
+
+    clip = (gcount > k) | (acnt > k) | (bcnt > k)
+    gcnt = jnp.minimum(jnp.where(real, gcount, 0), k)
+    acnt = jnp.minimum(jnp.where(real, acnt, 0), k)
+    bcnt = jnp.minimum(jnp.where(real, bcnt, 0), k)
+    total = gcnt + acnt + bcnt
+    r = jnp.arange(kf, dtype=jnp.int32)[None, :]        # [1, kf]
+    offA = gcnt[:, None]
+    offB = (gcnt + acnt)[:, None]
+    inA = (r >= offA) & (r < offB)
+    inB = r >= offB
+    kc = k - 1
+    pick = lambda src, ranks: jnp.take_along_axis(
+        src, jnp.clip(ranks, 0, kc), axis=1)
+    merged = jnp.where(
+        inB, pick(bidx, r - offB),
+        jnp.where(inA, pick(aidx, r - offA), pick(gidx, jnp.minimum(r, kc))))
+    overflow = ((total > kf) | clip) & real
+    return merged, total.astype(jnp.int32), overflow
+
+
 @functools.partial(jax.jit, static_argnames=("id_bits",),
                    donate_argnums=(0, 1))
 def apply_delta_operands(
