@@ -1,0 +1,89 @@
+"""Broker-level load test: real MQTT clients over TCP on localhost.
+
+Measures end-to-end publish->deliver throughput through the full broker
+path (parser -> session FSM -> reg view -> queue -> writer), the layer
+above bench.py's kernel-level numbers. Usage:
+
+  python tools/loadtest.py [--subs 50] [--pubs 8] [--secs 5]
+      [--view trie|tpu] [--qos 0]
+"""
+import argparse
+import asyncio
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--subs", type=int, default=50)
+    ap.add_argument("--pubs", type=int, default=8)
+    ap.add_argument("--secs", type=float, default=5.0)
+    ap.add_argument("--qos", type=int, default=0)
+    ap.add_argument("--view", default="trie")
+    ap.add_argument("--payload", type=int, default=64)
+    args = ap.parse_args()
+
+    if args.view == "tpu":
+        import jax  # noqa: F401  (matcher path needs a backend)
+
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    b, server = await start_broker(
+        Config(systree_enabled=False, allow_anonymous=True,
+               default_reg_view=args.view, sysmon_enabled=False),
+        port=0)
+    received = 0
+    done = asyncio.Event()
+
+    async def subscriber(i: int) -> None:
+        nonlocal received
+        c = MQTTClient(server.host, server.port, f"lt-sub{i}")
+        await c.connect()
+        await c.subscribe(f"lt/{i % 16}/+", qos=args.qos)
+        while not done.is_set():
+            try:
+                f = await c.recv(0.5)
+            except Exception:
+                continue
+            if f is not None:
+                received += 1
+        await c.disconnect()
+
+    sent = 0
+
+    async def publisher(i: int) -> None:
+        nonlocal sent
+        c = MQTTClient(server.host, server.port, f"lt-pub{i}")
+        await c.connect()
+        payload = b"x" * args.payload
+        j = 0
+        while not done.is_set():
+            await c.publish(f"lt/{j % 16}/m{i}", payload, qos=args.qos)
+            sent += 1
+            j += 1
+            if j % 64 == 0:
+                await asyncio.sleep(0)  # let the loop breathe
+        await c.disconnect()
+
+    subs = [asyncio.create_task(subscriber(i)) for i in range(args.subs)]
+    await asyncio.sleep(0.5)
+    t0 = time.perf_counter()
+    pubs = [asyncio.create_task(publisher(i)) for i in range(args.pubs)]
+    await asyncio.sleep(args.secs)
+    done.set()
+    elapsed = time.perf_counter() - t0
+    await asyncio.gather(*pubs, *subs, return_exceptions=True)
+    await b.stop()
+    await server.stop()
+    # each publish matches subs/16 subscribers on its topic bucket
+    print(f"view={args.view} qos={args.qos} pubs/s={sent/elapsed:.0f} "
+          f"deliveries/s={received/elapsed:.0f} "
+          f"(subscribers={args.subs}, publishers={args.pubs})")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
