@@ -55,7 +55,11 @@ DEFAULTS: Dict[str, Any] = {
     # matcher
     "default_reg_view": "trie",  # trie | tpu — the reg-view seam (vmq_mqtt_fsm.erl:105)
     "tpu_batch_window_us": 200,
-    "tpu_max_fanout": 1024,
+    # per-part device fanout cap (k): beyond it the pub falls back to the
+    # exact host match — 256 balances extraction cost vs fallback rate
+    "tpu_max_fanout": 256,
+    # flat result-buffer slots per pub, batch-averaged (C = Bpad * this)
+    "tpu_flat_avg": 128,
     # flushes this small are matched on the host trie instead of paying a
     # device round trip (hybrid dispatch, SURVEY.md §7.2); 0 disables
     "tpu_host_batch_threshold": 8,

@@ -173,7 +173,8 @@ class Registry:
             from ..models.tpu_matcher import TpuRegView
 
             view = self.reg_views["tpu"] = TpuRegView(
-                self, max_fanout=self.broker.config.tpu_max_fanout
+                self, max_fanout=self.broker.config.tpu_max_fanout,
+                flat_avg=self.broker.config.tpu_flat_avg,
             )
         if view is None:
             raise KeyError(f"unknown reg view {name!r}")
@@ -198,7 +199,8 @@ class Registry:
                     from ..models.tpu_matcher import TpuRegView
 
                     self.reg_views["tpu"] = TpuRegView(
-                        self, max_fanout=self.broker.config.tpu_max_fanout)
+                        self, max_fanout=self.broker.config.tpu_max_fanout,
+                        flat_avg=self.broker.config.tpu_flat_avg)
                     log.warning("accelerator recovered; TPU reg view "
                                 "re-enabled")
                     return

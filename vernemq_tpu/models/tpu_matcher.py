@@ -538,10 +538,12 @@ class TpuRegView:
     name = "tpu"
 
     def __init__(self, registry, max_levels: int = 16,
-                 initial_capacity: int = 1024, max_fanout: int = 256):
+                 initial_capacity: int = 1024, max_fanout: int = 256,
+                 flat_avg: int = 128):
         self.registry = registry
         self._matchers: Dict[str, TpuMatcher] = {}
-        self._mk = lambda: TpuMatcher(max_levels, initial_capacity, max_fanout)
+        self._mk = lambda: TpuMatcher(max_levels, initial_capacity,
+                                      max_fanout, flat_avg=flat_avg)
 
     def matcher(self, mountpoint: str = "") -> TpuMatcher:
         """Get/create the mountpoint's matcher. Warm-load MUST run on the
