@@ -47,6 +47,11 @@ _PLUGIN_OPT_ALIASES = {
 _METADATA_IMPLS = {"vmq_plumtree": "lww", "vmq_swc": "swc",
                    "lww": "lww", "swc": "swc"}
 
+# reference vernemq.conf spellings -> our DEFAULTS names
+_KEY_ALIASES = {
+    "message_size_limit": "max_message_size",  # vmq_server.schema:62
+}
+
 
 class ConfError(ValueError):
     def __init__(self, lineno: int, line: str, why: str):
@@ -182,6 +187,7 @@ def parse_conf(text: str) -> Dict[str, Any]:
             raise ConfError(lineno, line,
                             f"'{key}' is not settable directly; use "
                             f"{'plugins.<name> = on' if key == 'plugins' else 'listener.<kind>.<name> = ip:port'}")
+        key = _KEY_ALIASES.get(key, key)
         if key not in DEFAULTS:
             raise ConfError(lineno, line, f"unknown config key {key}")
         settings[key] = _coerce(key, value, lineno, line)
