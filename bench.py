@@ -151,6 +151,15 @@ class WindowedBench:
         self.upload_s = time.perf_counter() - t0
         assert self.m._bucketed and self.m._operands is not None, \
             "bench requires the bucketed windowed path"
+        if variant == "pallas":
+            # same alignment gate as TpuMatcher._match_windowed: the
+            # Pallas block index maps truncate starts to SEG_BLK units,
+            # so an unaligned (small-bucketed) table would yield shifted
+            # slot ids with no error
+            S = int(self.m._dev_arrays[0].shape[0])
+            assert (S % 2048 == 0 and self.m._glob_pad % 2048 == 0
+                    and self.m._gb_end % 2048 == 0), \
+                "pallas variant requires a 2048-aligned table layout"
 
     def _prep(self, topics):
         """The exact production host prep (TpuMatcher._flat_prep), with
@@ -162,7 +171,8 @@ class WindowedBench:
         S = int(m._dev_arrays[0].shape[0])
         args, statics, left = m._flat_prep(
             m._reg_start, m._reg_end, m._glob_pad, m._ops_bits, S,
-            pw, pl, pd, pb, gb, len(topics))
+            pw, pl, pd, pb, gb, len(topics),
+            align=2048 if self.variant == "pallas" else 0)
         t2 = time.perf_counter()
         return args, statics, t1 - t0, t2 - t1, len(left)
 
@@ -179,6 +189,11 @@ class WindowedBench:
             st = dict(statics)
             st["kf"] = st.pop("C") // args[0].shape[0]  # same bytes as flat
             return K.match_extract_windowed_rows(*head, *args, **st)
+        if self.variant == "pallas":
+            from vernemq_tpu.ops import pallas_match as P
+
+            return P.match_extract_windowed_flat_pallas(
+                *head, *args, **statics, interpret=P._use_interpret())
         return K.match_extract_windowed_flat(*head, *args, **statics)
 
     def run(self, iters, warmup=6, measure_resolve=True):

@@ -3,7 +3,7 @@ batch size x tile width x window-fairness x flat capacity. Prints one
 line per config; run after any kernel change.
 
 Usage:
-  python tools/tune_windowed.py [subs] [--cpu]
+  python tools/tune_windowed.py [subs] [--cpu] [--rows | --pallas]
       [--tp 128,256] [--b 2048,4096,8192] [--fm 1,2,4] [--fa 128]
 
 Each axis takes a comma list; the grid is their product. Keep the grid
@@ -44,6 +44,9 @@ def main():
     if "--rows" in argv:  # gather-merge kernel instead of scatter-flat
         argv.remove("--rows")
         variant = "rows"
+    if "--pallas" in argv:  # fused Pallas tile matcher (probe phases)
+        argv.remove("--pallas")
+        variant = "pallas"
     tps = _axis(argv, "tp", [128, 256])
     bs = _axis(argv, "b", [2048, 4096, 8192])
     fms = _axis(argv, "fm", [2])

@@ -60,6 +60,10 @@ DEFAULTS: Dict[str, Any] = {
     "tpu_max_fanout": 256,
     # flat result-buffer slots per pub, batch-averaged (C = Bpad * this)
     "tpu_flat_avg": 128,
+    # fused Pallas tile matcher for the probe phases (ops/pallas_match.py);
+    # off by default until the on-chip A/B (tools/tune_windowed.py
+    # --pallas) shows a win — self-disables if Mosaic lowering fails
+    "tpu_use_pallas": False,
     # flushes this small are matched on the host trie instead of paying a
     # device round trip (hybrid dispatch, SURVEY.md §7.2); 0 disables
     "tpu_host_batch_threshold": 8,

@@ -175,6 +175,7 @@ class Registry:
             view = self.reg_views["tpu"] = TpuRegView(
                 self, max_fanout=self.broker.config.tpu_max_fanout,
                 flat_avg=self.broker.config.tpu_flat_avg,
+                use_pallas=self.broker.config.tpu_use_pallas,
             )
         if view is None:
             raise KeyError(f"unknown reg view {name!r}")
@@ -200,7 +201,8 @@ class Registry:
 
                     self.reg_views["tpu"] = TpuRegView(
                         self, max_fanout=self.broker.config.tpu_max_fanout,
-                        flat_avg=self.broker.config.tpu_flat_avg)
+                        flat_avg=self.broker.config.tpu_flat_avg,
+                        use_pallas=self.broker.config.tpu_use_pallas)
                     log.warning("accelerator recovered; TPU reg view "
                                 "re-enabled")
                     return

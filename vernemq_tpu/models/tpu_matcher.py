@@ -42,13 +42,15 @@ def _pow2ceil(n: int) -> int:
 
 
 def window_params(S: int, glob_pad: int, bucket_max: int, Bpad: int,
-                  zone: Optional[int] = None):
+                  zone: Optional[int] = None, align: int = 0):
     """Static kernel geometry for a padded batch: tile count T (fixed per
     Bpad — shape-stable), window width seg_max (pow2, ≥ every bucket
     region and ≥ 2x the per-tile fair share of the zone), and the dense
     chunk gc. ``zone`` is the row span the tiles must cover (probe A: the
     level-0 buckets; probe B: the g-bucket zone) — defaults to
-    S - glob_pad. Together these bound recompiles to the Bpad ladder."""
+    S - glob_pad. Together these bound recompiles to the Bpad ladder.
+    ``align`` (the Pallas path's SEG_BLK) widens seg_max by one block so
+    flooring window starts to the alignment never strands a region."""
     slot_tiles = max(1, Bpad // TILE_PUBS)
     zone = (S - glob_pad) if zone is None else zone
     zone = max(zone, 4096)  # bucketed zones are >=4096 and 2048-aligned
@@ -59,8 +61,8 @@ def window_params(S: int, glob_pad: int, bucket_max: int, Bpad: int,
     # ~256MB or multi-million-row tables (5M+ subs) blow the compile —
     # span tiles absorb the difference (same FLOPs, bounded memory)
     SEG_CAP = 262_144
-    seg_max = min(_pow2ceil(max(4096, bucket_max, fair)),
-                  max(SEG_CAP, _pow2ceil(bucket_max)),
+    seg_max = min(_pow2ceil(max(4096, bucket_max + align, fair)),
+                  max(SEG_CAP, _pow2ceil(bucket_max + align)),
                   zone - zone % 2048, S)
     # greedy packing closes a tile when its window span fills even if pub
     # slots remain, so tiles-needed ≈ slot tiles + span tiles; budget both
@@ -77,7 +79,8 @@ def prepare_windows(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
                     pb: np.ndarray, n: int, reg_start: np.ndarray,
                     reg_end: np.ndarray, S: int, T: int, seg_max: int,
                     row_lo: int = 0, row_hi: Optional[int] = None,
-                    tp: Optional[int] = None, emit: str = "rows"):
+                    tp: Optional[int] = None, emit: str = "rows",
+                    align: int = 0):
     """Host prep for the windowed kernels: sort the n real
     publishes by bucket, pack into at most T fixed tiles of ``tp``
     (default TILE_PUBS) slots each, window each tile at its first region's
@@ -150,6 +153,20 @@ def prepare_windows(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
                     break
                 ti += 1
                 cur_start = max(min(s0, hi_cap - seg_max), row_lo)
+                if align:
+                    # Pallas windows start on SEG_BLK boundaries (block
+                    # index maps). Callers must guarantee row_lo (and the
+                    # hi_cap - seg_max clamp) are themselves aligned —
+                    # the production gate in _match_windowed checks
+                    # S/glob_pad/gb_end % 2048 — and window_params
+                    # widened seg_max by one block so flooring still
+                    # spans the region. The assert below turns a missed
+                    # gate into a loud failure instead of silently
+                    # shifted slot ids (start_blk truncation).
+                    cur_start = max(cur_start - cur_start % align, row_lo)
+                    assert cur_start % align == 0, (
+                        "unaligned window start: caller must gate on "
+                        "row_lo/table alignment before using align=")
                 cur_used = 0
                 t_start[ti] = cur_start - row_lo
             take = min(c - placed, TP - cur_used)
@@ -173,7 +190,8 @@ def prepare_windows(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
 
 class TpuMatcher:
     def __init__(self, max_levels: int = 16, initial_capacity: int = 1024,
-                 max_fanout: int = 256, device=None, flat_avg: int = 128):
+                 max_fanout: int = 256, device=None, flat_avg: int = 128,
+                 use_pallas: bool = False):
         import threading
 
         import jax
@@ -181,6 +199,11 @@ class TpuMatcher:
         self._jax = jax
         self.table = SubscriptionTable(max_levels, initial_capacity)
         self.max_fanout = max_fanout
+        # Pallas tile matcher for the probe phases (ops/pallas_match.py);
+        # flips itself off permanently if Mosaic lowering fails on the
+        # attached runtime (the XLA kernel is the always-works fallback)
+        self.use_pallas = use_pallas
+        self._pallas_broken = False
         # flat-compaction capacity per pub AVERAGED over the batch (the
         # [C = Bpad*flat_avg] device result buffer); a batch whose total
         # fanout exceeds it degrades per-pub to the host path, it never
@@ -428,24 +451,24 @@ class TpuMatcher:
             out.append(rows)
         return out
 
-    def _geometry(self, S, glob_pad, reg_start, reg_end, Bpad):
+    def _geometry(self, S, glob_pad, reg_start, reg_end, Bpad, align=0):
         """Static kernel geometry for both probes at this batch size."""
         ng = self._ng
         gb_end = self._gb_end
         amax = (int((reg_end[1 + ng:] - reg_start[1 + ng:]).max())
                 if len(reg_start) > 1 + ng else 0)
         T, seg_max, gc = window_params(S, glob_pad, amax, Bpad,
-                                       zone=S - gb_end)
+                                       zone=S - gb_end, align=align)
         if ng:
             gmax = int((reg_end[1:1 + ng] - reg_start[1:1 + ng]).max())
             T2, seg2, _ = window_params(S, glob_pad, gmax, Bpad,
-                                        zone=gb_end - glob_pad)
+                                        zone=gb_end - glob_pad, align=align)
         else:
             T2, seg2 = 1, 0
         return T, seg_max, gc, T2, seg2, gb_end
 
     def _flat_prep(self, reg_start, reg_end, glob_pad, bits, S,
-                   pw, pl, pd, pb, gb, n):
+                   pw, pl, pd, pb, gb, n, align=0):
         """Host prep for :func:`K.match_extract_windowed_flat`: window
         geometry, selector tiles, per-pub tile coordinates, flat
         capacity. Returns ``(args, statics, left)`` — the kernel's
@@ -457,11 +480,12 @@ class TpuMatcher:
         bench measures exactly the production call."""
         Bpad = pw.shape[0]
         T, seg_max, gc, T2, seg2, gb_end = self._geometry(
-            S, glob_pad, reg_start, reg_end, Bpad)
+            S, glob_pad, reg_start, reg_end, Bpad, align=align)
         (t_sel, t_start, tile_of, pos_of,
          leftovers) = prepare_windows(pw, pl, pd, pb, n, reg_start,
                                       reg_end, S, T, seg_max,
-                                      row_lo=gb_end, emit="sel")
+                                      row_lo=gb_end, emit="sel",
+                                      align=align)
         t_start = t_start + gb_end  # starts are row_lo-relative
         a_tile = np.full(Bpad, -1, dtype=np.int32)
         a_pos = np.zeros(Bpad, dtype=np.int32)
@@ -474,7 +498,7 @@ class TpuMatcher:
              left2) = prepare_windows(pw, pl, pd, gb, n, reg_start,
                                       reg_end, S, T2, seg2,
                                       row_lo=glob_pad, row_hi=gb_end,
-                                      emit="sel")
+                                      emit="sel", align=align)
             t2_start = t2_start + glob_pad
             b_tile[:n] = tile2_of
             b_pos[:n] = pos2_of
@@ -500,12 +524,33 @@ class TpuMatcher:
         leftovers, per-part clip at k, flat-capacity overflow) for the
         exact host fallback."""
         S = int(dev_arrays[0].shape[0])
+        pallas = (self.use_pallas and not self._pallas_broken
+                  and S % 2048 == 0 and glob_pad % 2048 == 0
+                  and self._gb_end % 2048 == 0)
         args, statics, left = self._flat_prep(
-            reg_start, reg_end, glob_pad, bits, S, pw, pl, pd, pb, gb, n)
+            reg_start, reg_end, glob_pad, bits, S, pw, pl, pd, pb, gb, n,
+            align=2048 if pallas else 0)
         F_t, t1 = operands
-        flat, pre, total, overflow = K.match_extract_windowed_flat(
-            F_t, t1, dev_arrays[1], dev_arrays[2], dev_arrays[3],
-            dev_arrays[4], *args, **statics)
+        table_args = (F_t, t1, dev_arrays[1], dev_arrays[2], dev_arrays[3],
+                      dev_arrays[4])
+        if pallas:
+            from ..ops import pallas_match as P
+            try:
+                flat, pre, total, overflow = \
+                    P.match_extract_windowed_flat_pallas(
+                        *table_args, *args, **statics,
+                        interpret=P._use_interpret())
+            except Exception:  # Mosaic lowering unsupported on this runtime
+                import logging
+                logging.getLogger("vernemq_tpu.matcher").exception(
+                    "pallas tile matcher failed to lower; falling back to "
+                    "the XLA windowed kernel permanently")
+                self._pallas_broken = True
+                flat, pre, total, overflow = K.match_extract_windowed_flat(
+                    *table_args, *args, **statics)
+        else:
+            flat, pre, total, overflow = K.match_extract_windowed_flat(
+                *table_args, *args, **statics)
         flat = np.asarray(flat)
         pre = np.asarray(pre)
         total = np.asarray(total)
@@ -539,11 +584,12 @@ class TpuRegView:
 
     def __init__(self, registry, max_levels: int = 16,
                  initial_capacity: int = 1024, max_fanout: int = 256,
-                 flat_avg: int = 128):
+                 flat_avg: int = 128, use_pallas: bool = False):
         self.registry = registry
         self._matchers: Dict[str, TpuMatcher] = {}
         self._mk = lambda: TpuMatcher(max_levels, initial_capacity,
-                                      max_fanout, flat_avg=flat_avg)
+                                      max_fanout, flat_avg=flat_avg,
+                                      use_pallas=use_pallas)
 
     def matcher(self, mountpoint: str = "") -> TpuMatcher:
         """Get/create the mountpoint's matcher. Warm-load MUST run on the

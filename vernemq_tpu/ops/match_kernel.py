@@ -534,6 +534,71 @@ def _window_tiles_sel(F_t, t1, sub_eff_len, has_hash, first_wild, active,
             jnp.stack([o[2] for o in touts]))
 
 
+def _dense_region0(F_t, t1, sub_eff_len, has_hash, first_wild, active,
+                   pub_words, pub_len, pub_dollar, *, id_bits, k, glob_pad,
+                   gc):
+    """Phase 1 of the windowed kernels: every publish × region 0 (filters
+    whose first two levels are wildcards), in ``gc`` pub chunks. Returns
+    ``(gidx [B,k], gvalid [B,k], gcount [B])``."""
+    B = pub_words.shape[0]
+    gouts = []
+    for c in range(0, B, gc):
+        sl = slice(c, c + gc)
+        G = build_pub_operand(pub_words[sl], id_bits)
+        mm = lax.dot_general(
+            G, F_t[:, :glob_pad], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + t1[None, :glob_pad]
+        m = (mm == 0.0) & _epilogue(
+            pub_len[sl], pub_dollar[sl], sub_eff_len[:glob_pad],
+            has_hash[:glob_pad], first_wild[:glob_pad], active[:glob_pad])
+        gouts.append(extract_indices_packed(_pack_mask(m), k, 2048))
+    return (jnp.concatenate([o[0] for o in gouts], axis=0),
+            jnp.concatenate([o[1] for o in gouts], axis=0),
+            jnp.concatenate([o[2] for o in gouts], axis=0))
+
+
+def _gather_parts(tidx, tvalid, tcount, tile, pos):
+    """Gather tile results back to publish order: pub i's probe result is
+    tile ``tile[i]`` slot ``pos[i]`` (``tile < 0`` = pub has no window in
+    this probe). Returns ``(idx [B,k], valid [B,k], cnt [B])``."""
+    ok = tile >= 0
+    tt = jnp.maximum(tile, 0)
+    idx = tidx[tt, pos]
+    valid = tvalid[tt, pos] & ok[:, None]
+    cnt = jnp.where(ok, tcount[tt, pos], 0)
+    return idx, valid, cnt
+
+
+def _flat_combine(real, k, C, g, a, b):
+    """Flat compaction of the three per-pub result parts (each an
+    ``(idx, valid, cnt)`` triple): prefix-sum the clamped counts, scatter
+    every matched slot id into one [C] buffer. See
+    :func:`match_extract_windowed_flat` for the contract."""
+    (gidx, gvalid, gcount), (aidx, avalid, acnt), (bidx, bvalid, bcnt) = \
+        g, a, b
+    clip = (gcount > k) | (acnt > k) | (bcnt > k)
+    gcnt = jnp.minimum(jnp.where(real, gcount, 0), k)
+    acnt = jnp.minimum(jnp.where(real, acnt, 0), k)
+    bcnt = jnp.minimum(jnp.where(real, bcnt, 0), k)
+    total = gcnt + acnt + bcnt
+    pre = jnp.cumsum(total) - total               # exclusive prefix
+    j = jnp.arange(k, dtype=jnp.int32)[None, :]
+    flat = jnp.zeros((C,), jnp.int32)
+
+    def scat(flat, base, idx, valid, cnt):
+        # extraction guarantees rank j holds the j-th match (j < count)
+        pos = base[:, None] + j
+        p = jnp.where(valid & real[:, None] & (j < cnt[:, None]), pos, C)
+        return flat.at[p].set(idx, mode="drop")
+
+    flat = scat(flat, pre, gidx, gvalid, gcnt)
+    flat = scat(flat, pre + gcnt, aidx, avalid, acnt)
+    flat = scat(flat, pre + gcnt + acnt, bidx, bvalid, bcnt)
+    overflow = ((pre + total > C) | clip) & real
+    return (flat, pre.astype(jnp.int32), total.astype(jnp.int32), overflow)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("id_bits", "k", "glob_pad", "seg_max",
                                     "seg2_max", "gc", "C"))
@@ -613,73 +678,33 @@ def match_extract_windowed_flat(
     B = pub_words.shape[0]
     real = jnp.arange(B, dtype=jnp.int32) < n_real
 
-    gouts = []
-    for c in range(0, B, gc):
-        sl = slice(c, c + gc)
-        G = build_pub_operand(pub_words[sl], id_bits)
-        mm = lax.dot_general(
-            G, F_t[:, :glob_pad], (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) + t1[None, :glob_pad]
-        m = (mm == 0.0) & _epilogue(
-            pub_len[sl], pub_dollar[sl], sub_eff_len[:glob_pad],
-            has_hash[:glob_pad], first_wild[:glob_pad], active[:glob_pad])
-        gouts.append(extract_indices_packed(_pack_mask(m), k, 2048))
-    gidx = jnp.concatenate([o[0] for o in gouts], axis=0)
-    gvalid = jnp.concatenate([o[1] for o in gouts], axis=0)
-    gcount = jnp.concatenate([o[2] for o in gouts], axis=0)
+    g = _dense_region0(F_t, t1, sub_eff_len, has_hash, first_wild, active,
+                       pub_words, pub_len, pub_dollar, id_bits=id_bits,
+                       k=k, glob_pad=glob_pad, gc=gc)
 
     args = (F_t, t1, sub_eff_len, has_hash, first_wild, active,
             pub_words, pub_len, pub_dollar)
     tidx, tvalid, tcount = _window_tiles_sel(
         *args, t_sel, t_start, id_bits=id_bits, k=k,
         seg_max=seg_max, glob_pad=glob_pad, wild_rows=False)
-    okA = a_tile >= 0
-    at = jnp.maximum(a_tile, 0)
-    aidx = tidx[at, a_pos]                        # [B, k]
-    avalid = tvalid[at, a_pos] & okA[:, None]
-    acnt = jnp.where(okA, tcount[at, a_pos], 0)
+    a = _gather_parts(tidx, tvalid, tcount, a_tile, a_pos)
     if seg2_max:
         t2idx, t2valid, t2count = _window_tiles_sel(
             *args, t2_sel, t2_start, id_bits=id_bits, k=k,
             seg_max=seg2_max, glob_pad=glob_pad, wild_rows=True)
-        okB = b_tile >= 0
-        bt = jnp.maximum(b_tile, 0)
-        bidx = t2idx[bt, b_pos]
-        bvalid = t2valid[bt, b_pos] & okB[:, None]
-        bcnt = jnp.where(okB, t2count[bt, b_pos], 0)
+        b = _gather_parts(t2idx, t2valid, t2count, b_tile, b_pos)
     else:
-        bidx = jnp.zeros((B, k), jnp.int32)
-        bvalid = jnp.zeros((B, k), bool)
-        bcnt = jnp.zeros((B,), jnp.int32)
+        b = (jnp.zeros((B, k), jnp.int32), jnp.zeros((B, k), bool),
+             jnp.zeros((B,), jnp.int32))
 
     # flat compaction: pad pubs contribute nothing; each real pub owns
     # the contiguous range [pre, pre+total). Budget with counts CLAMPED
     # to k: at most k entries per part are ever extracted, and a pub
-    # whose raw count exceeds k is host-matched anyway (clip flag below)
-    # — charging the raw count would let one mega-fanout pub reserve its
+    # whose raw count exceeds k is host-matched anyway (clip flag) —
+    # charging the raw count would let one mega-fanout pub reserve its
     # entire raw fanout and cascade spurious capacity overflows (= slow
     # exact host scans) across the rest of the batch.
-    clip = (gcount > k) | (acnt > k) | (bcnt > k)
-    gcnt = jnp.minimum(jnp.where(real, gcount, 0), k)
-    acnt = jnp.minimum(jnp.where(real, acnt, 0), k)
-    bcnt = jnp.minimum(jnp.where(real, bcnt, 0), k)
-    total = gcnt + acnt + bcnt
-    pre = jnp.cumsum(total) - total               # exclusive prefix
-    j = jnp.arange(k, dtype=jnp.int32)[None, :]
-    flat = jnp.zeros((C,), jnp.int32)
-
-    def scat(flat, base, idx, valid, cnt):
-        # extraction guarantees rank j holds the j-th match (j < count)
-        pos = base[:, None] + j
-        p = jnp.where(valid & real[:, None] & (j < cnt[:, None]), pos, C)
-        return flat.at[p].set(idx, mode="drop")
-
-    flat = scat(flat, pre, gidx, gvalid, gcnt)
-    flat = scat(flat, pre + gcnt, aidx, avalid, acnt)
-    flat = scat(flat, pre + gcnt + acnt, bidx, bvalid, bcnt)
-    overflow = ((pre + total > C) | clip) & real
-    return (flat, pre.astype(jnp.int32), total.astype(jnp.int32), overflow)
+    return _flat_combine(real, k, C, g, a, b)
 
 
 @functools.partial(jax.jit,
