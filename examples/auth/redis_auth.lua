@@ -1,0 +1,47 @@
+-- Redis-backed auth for vernemq_tpu, in the reference's bundled-script
+-- shape (vmq_diversity priv/auth/redis.lua seat; fresh implementation).
+--
+-- Provisioning: store under the Redis key
+--     json.encode({mountpoint, client_id, username})   -- compact JSON
+-- a JSON object:
+--     { "passhash":      "<bcrypt hash>",
+--       "publish_acl":   [ {"pattern": "a/b/+"}, ... ],
+--       "subscribe_acl": [ {"pattern": "c/#"}, ... ] }
+-- Patterns support MQTT wildcards and %m/%c/%u substitution.
+--
+-- Enable with:  diversity_scripts = ["examples/auth/redis_auth.lua"]
+
+require "auth_commons"
+
+function auth_on_register(reg)
+    if reg.username ~= nil and reg.password ~= nil then
+        local key = json.encode({reg.mountpoint, reg.client_id, reg.username})
+        local res = redis.cmd(pool, "get " .. key)
+        if res then
+            res = json.decode(res)
+            if res.passhash == bcrypt.hashpw(reg.password, res.passhash) then
+                cache_insert(reg.mountpoint, reg.client_id, reg.username,
+                             res.publish_acl, res.subscribe_acl)
+                return true
+            end
+        end
+    end
+    return false
+end
+
+pool = "auth_redis"
+redis.ensure_pool({
+    pool_id = pool,
+    host = "127.0.0.1",
+    port = 6379,
+    -- password = "...", database = 0,
+})
+
+hooks = {
+    auth_on_register = auth_on_register,
+    auth_on_publish = auth_on_publish,       -- cache-fronted defaults
+    auth_on_subscribe = auth_on_subscribe,   -- (auth_commons)
+    auth_on_register_m5 = auth_on_register_m5,
+    on_client_gone = on_client_gone,
+    on_client_offline = on_client_offline,
+}

@@ -1,0 +1,429 @@
+"""Pure-Python datastore connectors for the scripting plugins — the
+"batteries" seat of the reference's ``vmq_diversity`` bundled drivers
+(epgsql/eredis/mcd pools, ``vmq_diversity.erl`` pool supervision).
+
+This image ships no DB client libraries and has no package egress, so
+each connector speaks the wire protocol directly over a TCP socket:
+
+- :class:`RedisPool` — RESP2 (the protocol of ``eredis``): inline
+  command arrays, bulk/array/integer/error replies, AUTH + SELECT on
+  connect.
+- :class:`MemcachedPool` — memcached text protocol (``mcd`` seat):
+  get/set/delete.
+- :class:`PostgresPool` — PostgreSQL v3 wire protocol (``epgsql`` seat):
+  startup, cleartext + MD5 auth, the extended-query flow
+  (Parse/Bind/Describe/Execute/Sync) with text-format results so
+  ``$1``-style parameters work exactly like the reference's bundled
+  ``postgres.lua`` expects.
+
+MySQL and MongoDB keep their module surface but raise a clear
+"driver not built in" error from ``ensure_pool`` (their wire protocols —
+handshake crypto, BSON — are out of scope; the reference treats those
+pools the same way when the dep is missing: the script fails to init).
+
+Pools are deliberately tiny: one socket per pool guarded by a lock
+(hooks run on executor threads), reconnect-on-error. The reference's
+poolboy concurrency can be layered later; correctness and the script
+API shape come first.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["RedisPool", "MemcachedPool", "PostgresPool", "PoolError",
+           "POOL_REGISTRIES", "ensure_pool", "get_pool"]
+
+
+class PoolError(Exception):
+    pass
+
+
+class _SocketClient:
+    """Shared plumbing: lazy connect, lock, reconnect-once-on-error."""
+
+    def __init__(self, host: str, port: int, timeout: float = 3.0):
+        self.host = host
+        self.port = int(port)
+        self.timeout = timeout
+        self.sock: Optional[socket.socket] = None
+        self.lock = threading.Lock()
+
+    def _connect(self) -> None:
+        self.close()
+        s = socket.create_connection((self.host, self.port),
+                                     timeout=self.timeout)
+        s.settimeout(self.timeout)
+        self.sock = s
+        self._on_connect()
+
+    def _on_connect(self) -> None:  # override
+        pass
+
+    def _ensure(self) -> socket.socket:
+        if self.sock is None:
+            self._connect()
+        return self.sock  # type: ignore[return-value]
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+    def _recv_exact(self, n: int) -> bytes:
+        s = self._ensure()
+        buf = b""
+        while len(buf) < n:
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise PoolError("connection closed")
+            buf += chunk
+        return buf
+
+
+# ------------------------------------------------------------------- redis
+
+
+class RedisPool(_SocketClient):
+    """Minimal RESP2 client. ``cmd`` takes either an args list or a
+    single command string split on whitespace (the shape the reference's
+    ``redis.cmd(pool, "get " .. key)`` scripts use; keys produced by
+    ``json.encode`` contain no spaces)."""
+
+    def __init__(self, host="127.0.0.1", port=6379, password=None,
+                 database=0, timeout=3.0):
+        super().__init__(host, port, timeout)
+        self.password = password
+        self.database = int(database or 0)
+
+    def _on_connect(self) -> None:
+        if self.password:
+            self._roundtrip(["AUTH", self.password])
+        if self.database:
+            self._roundtrip(["SELECT", str(self.database)])
+
+    def _encode(self, args: List[Any]) -> bytes:
+        out = [b"*%d\r\n" % len(args)]
+        for a in args:
+            b = a if isinstance(a, bytes) else str(a).encode()
+            out.append(b"$%d\r\n%s\r\n" % (len(b), b))
+        return b"".join(out)
+
+    def _read_line(self) -> bytes:
+        buf = b""
+        while not buf.endswith(b"\r\n"):
+            buf += self._recv_exact(1)
+        return buf[:-2]
+
+    def _read_reply(self):
+        line = self._read_line()
+        t, rest = line[:1], line[1:]
+        if t == b"+":
+            return rest.decode()
+        if t == b"-":
+            raise PoolError(f"redis: {rest.decode()}")
+        if t == b":":
+            return int(rest)
+        if t == b"$":
+            n = int(rest)
+            if n < 0:
+                return None
+            data = self._recv_exact(n + 2)[:-2]
+            try:
+                return data.decode()
+            except UnicodeDecodeError:
+                return data
+        if t == b"*":
+            n = int(rest)
+            if n < 0:
+                return None
+            return [self._read_reply() for _ in range(n)]
+        raise PoolError(f"redis: bad reply type {t!r}")
+
+    def _roundtrip(self, args: List[Any]):
+        s = self._ensure()
+        s.sendall(self._encode(args))
+        return self._read_reply()
+
+    def cmd(self, command, *args):
+        if isinstance(command, str) and not args:
+            parts: List[Any] = command.split()
+        else:
+            parts = [command, *args]
+        if not parts:
+            raise PoolError("redis: empty command")
+        with self.lock:
+            try:
+                return self._roundtrip(parts)
+            except PoolError as e:
+                if str(e) == "connection closed":  # _recv_exact: stale socket
+                    self._connect()
+                    return self._roundtrip(parts)
+                raise  # server-reported error (-ERR): do not re-send
+            except OSError:
+                # one reconnect attempt (stale pool socket)
+                self._connect()
+                return self._roundtrip(parts)
+
+
+# ---------------------------------------------------------------- memcached
+
+
+class MemcachedPool(_SocketClient):
+    """Memcached text protocol: get/set/delete (flags unused)."""
+
+    def __init__(self, host="127.0.0.1", port=11211, timeout=3.0):
+        super().__init__(host, port, timeout)
+
+    def _read_line(self) -> bytes:
+        buf = b""
+        while not buf.endswith(b"\r\n"):
+            buf += self._recv_exact(1)
+        return buf[:-2]
+
+    @staticmethod
+    def _check_key(key: str) -> str:
+        """The text protocol delimits on whitespace/CRLF, so a key built
+        from client-controlled input (client ids!) could otherwise desync
+        the stream or inject commands (a CRLF in a ``set`` key would smuggle
+        arbitrary follow-on commands). Same limits as memcached itself:
+        <=250 bytes, no whitespace/control characters."""
+        if not key or len(key) > 250 \
+                or any(c.isspace() or ord(c) < 33 for c in key):
+            raise PoolError(f"memcached: invalid key {key[:64]!r} "
+                            "(whitespace/control chars not allowed)")
+        return key
+
+    def get(self, key: str):
+        key = self._check_key(key)
+        with self.lock:
+            s = self._ensure()
+            s.sendall(b"get %s\r\n" % key.encode())
+            line = self._read_line()
+            if line == b"END":
+                return None
+            if not line.startswith(b"VALUE "):
+                raise PoolError(f"memcached: {line!r}")
+            _v, _k, _flags, length = line.split()[:4]
+            data = self._recv_exact(int(length) + 2)[:-2]
+            end = self._read_line()
+            if end != b"END":
+                raise PoolError(f"memcached: expected END, got {end!r}")
+            try:
+                return data.decode()
+            except UnicodeDecodeError:
+                return data
+
+    def set(self, key: str, value, exptime: int = 0) -> bool:
+        key = self._check_key(key)
+        data = value if isinstance(value, bytes) else str(value).encode()
+        with self.lock:
+            s = self._ensure()
+            s.sendall(b"set %s 0 %d %d\r\n%s\r\n"
+                      % (key.encode(), int(exptime), len(data), data))
+            return self._read_line() == b"STORED"
+
+    def delete(self, key: str) -> bool:
+        key = self._check_key(key)
+        with self.lock:
+            s = self._ensure()
+            s.sendall(b"delete %s\r\n" % key.encode())
+            return self._read_line() == b"DELETED"
+
+
+# ----------------------------------------------------------------- postgres
+
+
+class PostgresPool(_SocketClient):
+    """PostgreSQL v3 wire protocol, extended-query flow with text-format
+    params/results (``vmq_lvldb`` has no seat here — this is purely the
+    epgsql role for auth scripts: ``postgres.execute(pool, sql, $1...)``).
+
+    Auth supported: trust, cleartext password (3), MD5 (5). SCRAM is not
+    implemented — the operator points the broker at a user with md5 or
+    password auth (or trust on localhost), as was the norm for the
+    reference's epgsql era."""
+
+    def __init__(self, host="127.0.0.1", port=5432, user="vmq",
+                 password="", database="vmq", timeout=5.0):
+        super().__init__(host, port, timeout)
+        self.user = user
+        self.password = password or ""
+        self.database = database
+
+    # wire helpers
+    def _send_msg(self, type_: bytes, payload: bytes) -> None:
+        s = self._ensure()
+        s.sendall(type_ + struct.pack(">I", len(payload) + 4) + payload)
+
+    def _read_msg(self) -> Tuple[bytes, bytes]:
+        t = self._recv_exact(1)
+        (n,) = struct.unpack(">I", self._recv_exact(4))
+        return t, self._recv_exact(n - 4)
+
+    def _on_connect(self) -> None:
+        # StartupMessage (no type byte): protocol 3.0 + params
+        params = (f"user\0{self.user}\0database\0{self.database}\0\0"
+                  .encode())
+        payload = struct.pack(">I", 196608) + params
+        self.sock.sendall(struct.pack(">I", len(payload) + 4) + payload)
+        while True:
+            t, body = self._read_msg()
+            if t == b"R":
+                (code,) = struct.unpack(">I", body[:4])
+                if code == 0:        # AuthenticationOk
+                    continue
+                if code == 3:        # CleartextPassword
+                    self._send_msg(b"p", self.password.encode() + b"\0")
+                    continue
+                if code == 5:        # MD5Password
+                    salt = body[4:8]
+                    inner = hashlib.md5(
+                        (self.password + self.user).encode()).hexdigest()
+                    outer = hashlib.md5(
+                        inner.encode() + salt).hexdigest()
+                    self._send_msg(b"p", b"md5" + outer.encode() + b"\0")
+                    continue
+                raise PoolError(f"postgres: unsupported auth method {code}"
+                                " (use trust/password/md5)")
+            elif t == b"E":
+                raise PoolError(f"postgres: {self._parse_error(body)}")
+            elif t == b"Z":          # ReadyForQuery
+                return
+            # S (ParameterStatus) / K (BackendKeyData): ignore
+
+    @staticmethod
+    def _parse_error(body: bytes) -> str:
+        fields = {}
+        for part in body.split(b"\0"):
+            if part:
+                fields[chr(part[0])] = part[1:].decode("utf-8", "replace")
+        return fields.get("M", "unknown error")
+
+    def execute(self, sql: str, *params) -> List[Dict[str, Any]]:
+        """Run one parameterised statement; returns rows as dicts keyed
+        by column name (the shape the bundled Lua scripts index:
+        ``row.publish_acl``)."""
+        with self.lock:
+            try:
+                return self._execute(sql, params)
+            except (OSError, PoolError) as e:
+                if isinstance(e, PoolError) and "postgres:" in str(e):
+                    raise  # server-reported error: do not blind-retry
+                self._connect()
+                return self._execute(sql, params)
+
+    def _execute(self, sql: str, params) -> List[Dict[str, Any]]:
+        self._ensure()
+        # Parse (unnamed statement) / Bind (text params, text results) /
+        # Describe portal / Execute / Sync
+        self._send_msg(b"P", b"\0" + sql.encode() + b"\0"
+                       + struct.pack(">H", 0))
+        bind = [b"\0\0", struct.pack(">H", 0),
+                struct.pack(">H", len(params))]
+        for p in params:
+            if p is None:
+                bind.append(struct.pack(">i", -1))
+            else:
+                b = (p if isinstance(p, bytes)
+                     else _pg_text(p).encode())
+                bind.append(struct.pack(">I", len(b)) + b)
+        bind.append(struct.pack(">H", 0))
+        self._send_msg(b"B", b"".join(bind))
+        self._send_msg(b"D", b"P\0")
+        self._send_msg(b"E", b"\0" + struct.pack(">I", 0))
+        self._send_msg(b"S", b"")
+
+        cols: List[str] = []
+        rows: List[Dict[str, Any]] = []
+        err: Optional[str] = None
+        while True:
+            t, body = self._read_msg()
+            if t == b"T":            # RowDescription
+                (n,) = struct.unpack(">H", body[:2])
+                cols = []
+                off = 2
+                for _ in range(n):
+                    end = body.index(b"\0", off)
+                    cols.append(body[off:end].decode())
+                    off = end + 1 + 18  # fixed per-field tail
+            elif t == b"D":          # DataRow
+                (n,) = struct.unpack(">H", body[:2])
+                off = 2
+                row: Dict[str, Any] = {}
+                for i in range(n):
+                    (ln,) = struct.unpack(">i", body[off:off + 4])
+                    off += 4
+                    if ln < 0:
+                        val = None
+                    else:
+                        val = body[off:off + ln].decode("utf-8", "replace")
+                        off += ln
+                    row[cols[i] if i < len(cols) else str(i + 1)] = val
+                rows.append(row)
+            elif t == b"E":
+                err = self._parse_error(body)
+            elif t == b"Z":          # ReadyForQuery — done
+                if err is not None:
+                    raise PoolError(f"postgres: {err}")
+                return rows
+            # C (CommandComplete), 1/2 (Parse/BindComplete), n — ignore
+
+
+def _pg_text(p) -> str:
+    if p is True:
+        return "t"
+    if p is False:
+        return "f"
+    return str(p)
+
+
+# ------------------------------------------------------------ pool registry
+
+#: pool_id → client, per driver kind
+POOL_REGISTRIES: Dict[str, Dict[str, Any]] = {
+    "redis": {}, "memcached": {}, "postgres": {},
+}
+
+_FACTORIES = {
+    "redis": lambda cfg: RedisPool(
+        host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 6379),
+        password=cfg.get("password"), database=cfg.get("database", 0)),
+    "memcached": lambda cfg: MemcachedPool(
+        host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 11211)),
+    "postgres": lambda cfg: PostgresPool(
+        host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 5432),
+        user=cfg.get("user", "root"), password=cfg.get("password", ""),
+        database=cfg.get("database", "vernemq_db")),
+}
+
+
+def ensure_pool(kind: str, config: Dict[str, Any]) -> str:
+    """Create (or reuse) a named pool; returns the pool id. Mirrors the
+    Lua-visible ``<driver>.ensure_pool{pool_id=...}`` contract."""
+    if kind in ("mysql", "mongodb"):
+        raise PoolError(
+            f"{kind}: driver not built into this distribution (redis, "
+            "memcached, postgres and http are; see plugins/connectors.py)")
+    if kind not in _FACTORIES:
+        raise PoolError(f"unknown datastore kind {kind!r}")
+    pool_id = str(config.get("pool_id") or f"{kind}_default")
+    reg = POOL_REGISTRIES[kind]
+    if pool_id not in reg:
+        reg[pool_id] = _FACTORIES[kind](config)
+    return pool_id
+
+
+def get_pool(kind: str, pool_id: str):
+    try:
+        return POOL_REGISTRIES[kind][str(pool_id)]
+    except KeyError:
+        raise PoolError(f"no such {kind} pool {pool_id!r} "
+                        "(call ensure_pool first)") from None

@@ -7,11 +7,13 @@ interpreter (luerl) and hands Lua scripts the hook surface plus datastore
 connectors (``vmq_diversity_plugin.erl:18-50``), a per-script KV store
 (``vmq_diversity_ets.erl``), and an auth/ACL cache
 (``vmq_diversity_cache.erl``) so ``auth_on_publish``/``auth_on_subscribe``
-hit cached ACLs instead of the datastore. The TPU-era broker is Python all
-the way down, so the natural scripting language *is* Python: scripts are
-plain ``.py`` files exec'd with a helper namespace — same trust model as
-the reference's operator-provided Lua (scripts run in-process with broker
-privileges).
+hit cached ACLs instead of the datastore. Two script engines share this
+machinery, selected by file extension: ``.lua`` runs on the in-tree Lua
+5.1 interpreter (``utils/lua.py`` + ``plugins/lua_bridge.py`` — the
+reference's script language, including its bundled-auth-script shapes and
+datastore modules), anything else as a plain Python file exec'd with the
+helper namespace below — same trust model either way (operator-provided
+scripts run in-process with broker privileges).
 
 Script surface (any subset):
 
@@ -34,10 +36,12 @@ Injected helpers:
 - ``log``: a logger
 - ``topic``: the topic algebra module (match/validate)
 
-Datastore connectors: the reference bundles postgres/mysql/mongodb/redis/
-memcached drivers. This image ships none of those client libraries, so
-scripts import drivers themselves when deployed where they exist; the
-ready-made auth-script pattern is documented in the test-suite example.
+Datastore connectors: pure-Python wire-protocol clients for redis,
+memcached and postgres ship in ``plugins/connectors.py`` (the reference's
+bundled eredis/mcd/epgsql pools); Lua scripts reach them as the
+``redis``/``memcached``/``postgres`` modules, Python scripts can import
+them directly. mysql/mongodb keep the module surface but report
+"driver not built in".
 """
 
 from __future__ import annotations
@@ -188,9 +192,8 @@ class Script:
             # http connector (the hackney seat of vmq_diversity): auth
             # scripts talk to REST auth backends; blocking with a short
             # timeout — the reference's Lua pools block a worker the same
-            # way. Datastore-specific drivers (postgres/mysql/mongo/redis)
-            # need client libraries this image doesn't ship; the HTTP
-            # connector + examples/auth/ scripts cover the same seat.
+            # way. Datastore wire clients (redis/memcached/postgres) live
+            # in plugins/connectors.py for scripts that want them.
             "http": HttpConnector(),
         }
         exec(compile(src, self.path, "exec"), ns)
@@ -211,8 +214,16 @@ class ScriptingPlugin:
 
     # ------------------------------------------------------------- scripts
 
-    def load_script(self, path: str) -> Script:
-        s = Script(path, self)
+    def load_script(self, path: str):
+        """Engine by extension: ``.lua`` runs on the in-tree Lua
+        interpreter (utils/lua.py via lua_bridge — the reference's
+        native script language), anything else as a Python script."""
+        if path.endswith(".lua"):
+            from .lua_bridge import LuaScript
+
+            s = LuaScript(path, self)
+        else:
+            s = Script(path, self)
         self.scripts[path] = s
         return s
 
