@@ -607,6 +607,32 @@ class Session:
         return True
 
     def _send_publish(self, msg: Msg, pid: Optional[int], dup: bool = False) -> None:
+        self.broker.hooks_fire_all(
+            "on_deliver", self.username, self.sid, msg.topic, msg.payload
+        )
+        if (pid is None and msg.qos == 0 and not dup
+                and self.proto_ver != PROTO_5
+                and self.broker.tracer is None and not self.closed):
+            # QoS0 v4 fanout fast path: the wire frame is identical for
+            # every v4 QoS0 recipient of this Msg (no packet id, no
+            # props, no per-session alias state), so serialise once and
+            # cache the bytes on the Msg — at fanout 50 this removes 98%
+            # of the serialise cost on the delivery path (the analog of
+            # the reference serialising in vmq_mqtt_fsm once per frame,
+            # but across recipients)
+            data = getattr(msg, "_wire_v4_q0", None)
+            if data is None:
+                frame = Publish(topic=T.unword(list(msg.topic)),
+                                payload=msg.payload, qos=0,
+                                retain=msg.retain, dup=False,
+                                packet_id=None, properties={})
+                data = self.codec.serialise(frame)
+                msg._wire_v4_q0 = data
+            self.transport.write(data)
+            m = self.broker.metrics
+            m.incr("bytes_sent", len(data))
+            m.incr("mqtt_publish_sent")
+            return
         props = dict(msg.properties)
         topic_str = T.unword(list(msg.topic))
         if self.proto_ver == PROTO_5:
@@ -629,9 +655,6 @@ class Session:
         frame = Publish(
             topic=topic_str, payload=msg.payload, qos=msg.qos,
             retain=msg.retain, dup=dup, packet_id=pid, properties=props,
-        )
-        self.broker.hooks_fire_all(
-            "on_deliver", self.username, self.sid, msg.topic, msg.payload
         )
         self.send(frame)
         self.broker.metrics.incr("mqtt_publish_sent")
