@@ -346,12 +346,11 @@ def test_postgres_connector_md5_and_params():
         srv.close()
 
 
-def test_mysql_mongodb_unavailable_is_loud():
+def test_mongodb_unavailable_is_loud():
     from vernemq_tpu.plugins.connectors import PoolError, ensure_pool
 
-    for kind in ("mysql", "mongodb"):
-        with pytest.raises(PoolError, match="not built in"):
-            ensure_pool(kind, {"pool_id": "x"})
+    with pytest.raises(PoolError, match="not built in"):
+        ensure_pool("mongodb", {"pool_id": "x"})
 
 
 # ---------------------------------------------------- bridge + hook flow
@@ -650,14 +649,14 @@ hooks = { on_publish = on_publish, on_deliver = on_deliver,
     assert s.kv["t"]["reg"] == "c1|u2"
 
 
-def test_mysql_execute_is_clean_error(tmp_path):
+def test_mongodb_find_one_is_clean_error(tmp_path):
     from vernemq_tpu.plugins.scripting import ScriptingPlugin
 
     path = tmp_path / "my.lua"
     path.write_text("""
 function auth_on_register(reg)
     local ok, err = pcall(function()
-        return mysql.execute("p", "select 1", reg.client_id)
+        return mongodb.find_one("p", {client_id = reg.client_id})
     end)
     kv.insert("t", "err", err)
     return false
@@ -735,3 +734,215 @@ def test_lua_table_append_linear():
     assert t.length() == 14999
     t.set(15000, "back")
     assert t.length() == 30000
+
+
+# ----------------------------------------------------------------- mysql
+
+
+def _fake_mysql(user, password, rows_for):
+    """Threaded MySQL server: v10 greeting, mysql_native_password check,
+    COM_QUERY text-protocol result sets from ``rows_for(sql)``."""
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    salt = b"12345678abcdefghijkl"  # 20 bytes
+
+    def native_token(pw):
+        if not pw:
+            return b""
+        s1 = hashlib.sha1(pw.encode()).digest()
+        s2 = hashlib.sha1(s1).digest()
+        s3 = hashlib.sha1(salt + s2).digest()
+        return bytes(a ^ b for a, b in zip(s1, s3))
+
+    def pkt(seq, payload):
+        return len(payload).to_bytes(3, "little") + bytes([seq]) + payload
+
+    def lenenc_str(b):
+        return bytes([len(b)]) + b
+
+    def read_pkt(conn):
+        head = b""
+        while len(head) < 4:
+            c = conn.recv(4 - len(head))
+            if not c:
+                return None, 0
+            head += c
+        n = int.from_bytes(head[:3], "little")
+        body = b""
+        while len(body) < n:
+            body += conn.recv(n - len(body))
+        return body, head[3]
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            greeting = (bytes([10]) + b"8.0-fake\0"
+                        + (1234).to_bytes(4, "little")
+                        + salt[:8] + b"\0"
+                        + (0xFFFF).to_bytes(2, "little")  # caps lo
+                        + bytes([33])
+                        + (2).to_bytes(2, "little")       # status
+                        + (0x000F).to_bytes(2, "little")  # caps hi
+                        + bytes([21]) + b"\0" * 10
+                        + salt[8:] + b"\0"
+                        + b"mysql_native_password\0")
+            conn.sendall(pkt(0, greeting))
+            body, seq = read_pkt(conn)
+            if body is None:
+                conn.close()
+                continue
+            # handshake response 41: caps(4) maxpkt(4) charset(1) 23x
+            off = 4 + 4 + 1 + 23
+            end = body.index(b"\0", off)
+            got_user = body[off:end].decode()
+            off = end + 1
+            tlen = body[off]
+            token = body[off + 1:off + 1 + tlen]
+            if got_user != user or token != native_token(password):
+                conn.sendall(pkt(seq + 1, b"\xff" + (1045).to_bytes(2, "little")
+                                 + b"#28000Access denied"))
+                conn.close()
+                continue
+            conn.sendall(pkt(seq + 1, b"\x00\x00\x00\x02\x00\x00\x00"))
+            while True:
+                body, seq = read_pkt(conn)
+                if body is None or body[:1] != b"\x03":
+                    break
+                sql = body[1:].decode()
+                cols, rows = rows_for(sql)
+                s = 1
+                conn.sendall(pkt(s, bytes([len(cols)])))
+                for c in cols:
+                    s += 1
+                    cb = c.encode()
+                    cdef = (lenenc_str(b"def") + lenenc_str(b"") +
+                            lenenc_str(b"t") + lenenc_str(b"t") +
+                            lenenc_str(cb) + lenenc_str(cb) +
+                            bytes([0x0c]) + (33).to_bytes(2, "little") +
+                            (255).to_bytes(4, "little") + bytes([253]) +
+                            (0).to_bytes(2, "little") + bytes([0]) +
+                            b"\0\0")
+                    conn.sendall(pkt(s, cdef))
+                s += 1
+                conn.sendall(pkt(s, b"\xfe\x00\x00\x02\x00"))  # EOF
+                for r in rows:
+                    s += 1
+                    rb = b"".join(lenenc_str(str(v).encode()) for v in r)
+                    conn.sendall(pkt(s, rb))
+                s += 1
+                conn.sendall(pkt(s, b"\xfe\x00\x00\x02\x00"))  # EOF
+
+    threading.Thread(target=serve, daemon=True).start()
+    return srv.getsockname()[1], srv
+
+
+def test_mysql_connector_auth_and_query():
+    from vernemq_tpu.plugins.connectors import MysqlPool, PoolError
+
+    seen = {}
+
+    def rows_for(sql):
+        seen["sql"] = sql
+        if "X'" + b"bob".hex() + "'" in sql:
+            return ["publish_acl", "subscribe_acl"], [
+                ('[{"pattern":"plant/#"}]', "[]")]
+        return ["publish_acl", "subscribe_acl"], []
+
+    port, srv = _fake_mysql("vmq", "mypw", rows_for)
+    try:
+        my = MysqlPool(port=port, user="vmq", password="mypw",
+                       database="db")
+        rows = my.execute("SELECT publish_acl, subscribe_acl FROM t "
+                          "WHERE username=? AND password=PASSWORD(?)",
+                          "bob", "x'); DROP TABLE t; --")
+        assert len(rows) == 1
+        assert json.loads(rows[0]["publish_acl"]) == [{"pattern": "plant/#"}]
+        # injection-shaped param arrived as an inert hex literal
+        # (immune to sql_mode quoting differences)
+        assert "DROP TABLE" not in seen["sql"]
+        assert "X'" + b"x'); DROP TABLE t; --".hex() + "'" in seen["sql"]
+        assert my.execute("SELECT a, b FROM t WHERE username=?",
+                          "none") == []
+        my.close()
+        bad = MysqlPool(port=port, user="vmq", password="wrong",
+                        database="db")
+        with pytest.raises(PoolError, match="Access denied"):
+            bad.execute("SELECT 1")
+    finally:
+        srv.close()
+
+
+MYSQL_AUTH_LUA = """
+require "auth_commons"
+function auth_on_register(reg)
+    if reg.username ~= nil and reg.password ~= nil then
+        results = mysql.execute(pool,
+            [[SELECT publish_acl, subscribe_acl FROM vmq_auth_acl
+              WHERE client_id=? AND username=? AND
+              password=]] .. mysql.hash_method(),
+            reg.client_id, reg.username, reg.password)
+        if #results == 1 then
+            row = results[1]
+            cache_insert(reg.mountpoint, reg.client_id, reg.username,
+                         json.decode(row.publish_acl),
+                         json.decode(row.subscribe_acl))
+            return true
+        end
+        return false
+    end
+end
+pool = "auth_mysql_%s"
+mysql.ensure_pool({ pool_id = pool, host = "127.0.0.1", port = %d,
+                    user = "vmq", password = "mypw", database = "db" })
+hooks = { auth_on_register = auth_on_register,
+          auth_on_publish = auth_on_publish,
+          auth_on_subscribe = auth_on_subscribe }
+"""
+
+
+def test_lua_mysql_auth_script_flow(tmp_path):
+    """The reference's bundled mysql.lua shape end to end, including
+    mysql.hash_method()."""
+    from vernemq_tpu.plugins.scripting import ScriptingPlugin
+
+    def rows_for(sql):
+        assert "PASSWORD(" in sql  # hash_method default
+        if ("X'" + b"carol".hex() + "'" in sql
+                and "X'" + b"mqtt-pw".hex() + "'" in sql):
+            return ["publish_acl", "subscribe_acl"], [
+                ('[{"pattern":"site/%u/#"}]', "[]")]
+        return ["publish_acl", "subscribe_acl"], []
+
+    port, srv = _fake_mysql("vmq", "mypw", rows_for)
+    try:
+        path = tmp_path / "mysql_auth.lua"
+        path.write_text(MYSQL_AUTH_LUA % ("flow", port))
+        plugin = ScriptingPlugin(_FakeBroker(), scripts=[str(path)])
+        s = plugin.scripts[str(path)]
+        sid = ("", "m-1")
+        peer = ("10.0.0.3", 1883)
+        assert s.hooks["auth_on_register"](
+            peer, sid, "carol", "mqtt-pw", True) == "ok"
+        assert plugin.cache.lookup(
+            sid, "publish", ["site", "carol", "x"])[0] is True
+        assert s.hooks["auth_on_register"](
+            peer, sid, "carol", "badpw", True) == ("error", "not_authorized")
+    finally:
+        srv.close()
+
+
+def test_mysql_param_count_mismatch_is_loud():
+    from vernemq_tpu.plugins.connectors import MysqlPool, PoolError
+
+    my = MysqlPool(port=1)  # never connects: substitution runs first
+    with pytest.raises(PoolError, match="more \\? placeholders"):
+        my._substitute("SELECT ? WHERE a=?", ("one",))
+    with pytest.raises(PoolError, match="parameters for 1"):
+        my._substitute("SELECT ?", ("one", "extra"))
+    # ? inside string literals is not a placeholder
+    assert my._substitute("SELECT '?' , ?", ("v",)) == \
+        "SELECT '?' , X'" + b"v".hex() + "'"

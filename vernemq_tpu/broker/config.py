@@ -60,6 +60,10 @@ DEFAULTS: Dict[str, Any] = {
     "tpu_max_fanout": 256,
     # flat result-buffer slots per pub, batch-averaged (C = Bpad * this)
     "tpu_flat_avg": 128,
+    # scripting: SQL function wrapping the password in the bundled
+    # mysql auth-script query — password | md5 | sha1 | sha256
+    # (vmq_diversity_mysql.erl:119-129 hash_method)
+    "mysql_password_hash_method": "password",
     # fused Pallas tile matcher for the probe phases (ops/pallas_match.py);
     # off by default until the on-chip A/B (tools/tune_windowed.py
     # --pallas) shows a win — self-disables if Mosaic lowering fails

@@ -15,11 +15,15 @@ each connector speaks the wire protocol directly over a TCP socket:
   (Parse/Bind/Describe/Execute/Sync) with text-format results so
   ``$1``-style parameters work exactly like the reference's bundled
   ``postgres.lua`` expects.
+- :class:`MysqlPool` — MySQL client protocol (``emysql`` seat):
+  mysql_native_password handshake + COM_QUERY text protocol with
+  escaped client-side ``?`` substitution, the contract of the bundled
+  ``mysql.lua``.
 
-MySQL and MongoDB keep their module surface but raise a clear
-"driver not built in" error from ``ensure_pool`` (their wire protocols —
-handshake crypto, BSON — are out of scope; the reference treats those
-pools the same way when the dep is missing: the script fails to init).
+MongoDB keeps its module surface but raises a clear "driver not built
+in" error from ``ensure_pool`` (BSON + OP_MSG out of scope; the
+reference treats a missing dep the same way: the script fails to
+init).
 
 Pools are deliberately tiny: one socket per pool guarded by a lock
 (hooks run on executor threads), reconnect-on-error. The reference's
@@ -35,8 +39,8 @@ import struct
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
-__all__ = ["RedisPool", "MemcachedPool", "PostgresPool", "PoolError",
-           "POOL_REGISTRIES", "ensure_pool", "get_pool"]
+__all__ = ["RedisPool", "MemcachedPool", "PostgresPool", "MysqlPool",
+           "PoolError", "POOL_REGISTRIES", "ensure_pool", "get_pool"]
 
 
 class PoolError(Exception):
@@ -238,6 +242,220 @@ class MemcachedPool(_SocketClient):
             return self._read_line() == b"DELETED"
 
 
+# ------------------------------------------------------------------- mysql
+
+
+class MysqlPool(_SocketClient):
+    """MySQL client protocol (the ``emysql`` seat): ``mysql_native_password``
+    handshake + ``COM_QUERY`` text protocol with client-side ``?``
+    parameter substitution (properly escaped string literals — the same
+    contract the reference's bundled ``mysql.lua`` uses:
+    ``mysql.execute(pool, "... WHERE username=?", u)``).
+
+    Auth: mysql_native_password (token = SHA1(pw) XOR SHA1(salt +
+    SHA1(SHA1(pw)))). caching_sha2_password (the 8.0 default) is not
+    implemented — point the broker at a user created WITH
+    mysql_native_password, as the epgsql-era reference required."""
+
+    def __init__(self, host="127.0.0.1", port=3306, user="root",
+                 password="", database="vernemq_db", timeout=5.0):
+        super().__init__(host, port, timeout)
+        self.user = user
+        self.password = password or ""
+        self.database = database
+        self._seq = 0
+
+    # packet framing: 3-byte little-endian length + 1-byte sequence id
+    def _send_packet(self, payload: bytes) -> None:
+        s = self._ensure()
+        s.sendall(len(payload).to_bytes(3, "little")
+                  + bytes([self._seq & 0xFF]) + payload)
+        self._seq += 1
+
+    def _read_packet(self) -> bytes:
+        head = self._recv_exact(4)
+        n = int.from_bytes(head[:3], "little")
+        self._seq = head[3] + 1
+        return self._recv_exact(n)
+
+    @staticmethod
+    def _lenenc(data: bytes, off: int) -> Tuple[Optional[int], int]:
+        first = data[off]
+        if first < 0xFB:
+            return first, off + 1
+        if first == 0xFB:  # NULL
+            return None, off + 1
+        if first == 0xFC:
+            return int.from_bytes(data[off + 1:off + 3], "little"), off + 3
+        if first == 0xFD:
+            return int.from_bytes(data[off + 1:off + 4], "little"), off + 4
+        return int.from_bytes(data[off + 1:off + 9], "little"), off + 9
+
+    def _lenenc_str(self, data: bytes, off: int) -> Tuple[Optional[bytes], int]:
+        n, off = self._lenenc(data, off)
+        if n is None:
+            return None, off
+        return data[off:off + n], off + n
+
+    def _on_connect(self) -> None:
+        self._seq = 0
+        greet = self._read_packet()
+        if greet[:1] == b"\xff":
+            raise PoolError(f"mysql: {self._err_text(greet)}")
+        # v10 handshake: version byte, server version (nul), thread id,
+        # 8 bytes auth data, filler, caps, ..., 12+ more auth bytes
+        off = 1
+        off = greet.index(b"\0", off) + 1   # server version
+        off += 4                             # thread id
+        salt = greet[off:off + 8]
+        off += 8 + 1                         # auth-part-1 + filler
+        off += 2 + 1 + 2 + 2                 # caps-lo, charset, status, caps-hi
+        alen = greet[off]
+        off += 1 + 10                        # auth data len + reserved
+        part2 = greet[off:off + max(13, alen - 8)]
+        salt = salt + part2.rstrip(b"\0")[:12]
+        token = self._native_token(salt)
+        CLIENT_PROTOCOL_41 = 0x0200
+        CLIENT_SECURE_CONNECTION = 0x8000
+        CLIENT_PLUGIN_AUTH = 0x80000
+        CLIENT_CONNECT_WITH_DB = 0x08
+        caps = (CLIENT_PROTOCOL_41 | CLIENT_SECURE_CONNECTION
+                | CLIENT_PLUGIN_AUTH | CLIENT_CONNECT_WITH_DB)
+        resp = (struct.pack("<IIB23x", caps, 1 << 24, 33)
+                + self.user.encode() + b"\0"
+                + bytes([len(token)]) + token
+                + (self.database or "").encode() + b"\0"
+                + b"mysql_native_password\0")
+        self._send_packet(resp)
+        ok = self._read_packet()
+        if ok[:1] == b"\xff":
+            raise PoolError(f"mysql: {self._err_text(ok)}")
+        if ok[:1] == b"\xfe":
+            raise PoolError("mysql: server requested an auth switch "
+                            "(only mysql_native_password is supported)")
+
+    def _native_token(self, salt: bytes) -> bytes:
+        if not self.password:
+            return b""
+        s1 = hashlib.sha1(self.password.encode()).digest()
+        s2 = hashlib.sha1(s1).digest()
+        s3 = hashlib.sha1(salt + s2).digest()
+        return bytes(a ^ b for a, b in zip(s1, s3))
+
+    @staticmethod
+    def _err_text(pkt: bytes) -> str:
+        # 0xff, errno(2), '#' + sqlstate(5) when CLIENT_PROTOCOL_41
+        body = pkt[3:]
+        if body[:1] == b"#":
+            body = body[6:]
+        return body.decode("utf-8", "replace")
+
+    @staticmethod
+    def _escape(v) -> str:
+        if v is None:
+            return "NULL"
+        if v is True:
+            return "1"
+        if v is False:
+            return "0"
+        if isinstance(v, (int, float)):
+            return str(v)
+        # strings go out as hex literals (X'...'): no escaping at all, so
+        # the encoding is immune to sql_mode — backslash-escaping would be
+        # injectable under NO_BACKSLASH_ESCAPES, and '' doubling under the
+        # default mode if the value ends with a backslash
+        b = v if isinstance(v, bytes) else str(v).encode(
+            "utf-8", "surrogateescape")
+        return "X'" + b.hex() + "'" if b else "''"
+
+    def _substitute(self, sql: str, params) -> str:
+        """Replace ``?`` placeholders outside string literals; placeholder
+        and parameter counts must agree exactly (a silently dropped
+        parameter in an auth query could skip the password predicate)."""
+        out = []
+        it = iter(params)
+        used = 0
+        in_str: Optional[str] = None
+        i = 0
+        while i < len(sql):
+            c = sql[i]
+            if in_str:
+                out.append(c)
+                if c == "\\" and i + 1 < len(sql):
+                    out.append(sql[i + 1])
+                    i += 1
+                elif c == in_str:
+                    in_str = None
+            elif c in ("'", '"'):
+                in_str = c
+                out.append(c)
+            elif c == "?":
+                try:
+                    out.append(self._escape(next(it)))
+                    used += 1
+                except StopIteration:
+                    raise PoolError("mysql: more ? placeholders than "
+                                    "parameters") from None
+            else:
+                out.append(c)
+            i += 1
+        if used != len(params):
+            raise PoolError(f"mysql: {len(params)} parameters for "
+                            f"{used} ? placeholders")
+        return "".join(out)
+
+    def execute(self, sql: str, *params) -> List[Dict[str, Any]]:
+        with self.lock:
+            try:
+                return self._execute(sql, params)
+            except PoolError as e:
+                if str(e).startswith("mysql:"):
+                    raise  # server-reported: do not blind-retry
+                self._connect()
+                return self._execute(sql, params)
+            except OSError:
+                self._connect()
+                return self._execute(sql, params)
+
+    def _execute(self, sql: str, params) -> List[Dict[str, Any]]:
+        self._ensure()
+        self._seq = 0
+        self._send_packet(b"\x03" + self._substitute(sql, params).encode())
+        first = self._read_packet()
+        if first[:1] == b"\xff":
+            raise PoolError(f"mysql: {self._err_text(first)}")
+        if first[:1] == b"\x00":   # OK packet (no result set)
+            return []
+        ncols, _ = self._lenenc(first, 0)
+        cols: List[str] = []
+        for _ in range(ncols):
+            cdef = self._read_packet()
+            # column def 41: catalog, schema, table, org_table, name, ...
+            off = 0
+            parts = []
+            for _f in range(5):
+                v, off = self._lenenc_str(cdef, off)
+                parts.append(v)
+            cols.append((parts[4] or b"").decode())
+        eof = self._read_packet()
+        if eof[:1] != b"\xfe":
+            raise PoolError("mysql: missing EOF after column definitions")
+        rows: List[Dict[str, Any]] = []
+        while True:
+            pkt = self._read_packet()
+            if pkt[:1] == b"\xfe" and len(pkt) < 9:   # EOF
+                return rows
+            if pkt[:1] == b"\xff":
+                raise PoolError(f"mysql: {self._err_text(pkt)}")
+            off = 0
+            row: Dict[str, Any] = {}
+            for i in range(ncols):
+                v, off = self._lenenc_str(pkt, off)
+                row[cols[i]] = None if v is None else v.decode(
+                    "utf-8", "replace")
+            rows.append(row)
+
+
 # ----------------------------------------------------------------- postgres
 
 
@@ -389,7 +607,7 @@ def _pg_text(p) -> str:
 
 #: pool_id → client, per driver kind
 POOL_REGISTRIES: Dict[str, Dict[str, Any]] = {
-    "redis": {}, "memcached": {}, "postgres": {},
+    "redis": {}, "memcached": {}, "postgres": {}, "mysql": {},
 }
 
 _FACTORIES = {
@@ -402,16 +620,21 @@ _FACTORIES = {
         host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 5432),
         user=cfg.get("user", "root"), password=cfg.get("password", ""),
         database=cfg.get("database", "vernemq_db")),
+    "mysql": lambda cfg: MysqlPool(
+        host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 3306),
+        user=cfg.get("user", "root"), password=cfg.get("password", ""),
+        database=cfg.get("database", "vernemq_db")),
 }
 
 
 def ensure_pool(kind: str, config: Dict[str, Any]) -> str:
     """Create (or reuse) a named pool; returns the pool id. Mirrors the
     Lua-visible ``<driver>.ensure_pool{pool_id=...}`` contract."""
-    if kind in ("mysql", "mongodb"):
+    if kind == "mongodb":
         raise PoolError(
-            f"{kind}: driver not built into this distribution (redis, "
-            "memcached, postgres and http are; see plugins/connectors.py)")
+            "mongodb: driver not built into this distribution (redis, "
+            "memcached, postgres, mysql and http are; see "
+            "plugins/connectors.py)")
     if kind not in _FACTORIES:
         raise PoolError(f"unknown datastore kind {kind!r}")
     pool_id = str(config.get("pool_id") or f"{kind}_default")

@@ -243,7 +243,7 @@ class LuaScript:
             def _stub(*_args):
                 raise LuaError(
                     f"{kind}: driver not built into this distribution "
-                    "(redis, memcached, postgres and http are)")
+                    "(redis, memcached, postgres, mysql and http are)")
             return _stub
 
         module("redis", {"ensure_pool": ensure("redis"),
@@ -254,8 +254,22 @@ class LuaScript:
                              "delete": pool_call("memcached", "delete")})
         module("postgres", {"ensure_pool": ensure("postgres"),
                             "execute": pool_call("postgres", "execute")})
+
+        def mysql_hash_method():
+            # the reference maps the pool's password_hash_method config
+            # to the SQL hashing call (vmq_diversity_mysql.erl:119-129)
+            try:
+                method = str(self.plugin.broker.config.get(
+                    "mysql_password_hash_method", "password"))
+            except Exception:
+                method = "password"
+            return {"password": "PASSWORD(?)", "md5": "MD5(?)",
+                    "sha1": "SHA1(?)",
+                    "sha256": "SHA2(?, 256)"}.get(method, "PASSWORD(?)")
+
         module("mysql", {"ensure_pool": ensure("mysql"),
-                         "execute": unavailable("mysql")})
+                         "execute": pool_call("mysql", "execute"),
+                         "hash_method": mysql_hash_method})
         module("mongodb", {"ensure_pool": ensure("mongodb"),
                            "find_one": unavailable("mongodb")})
 
