@@ -346,11 +346,15 @@ def test_postgres_connector_md5_and_params():
         srv.close()
 
 
-def test_mongodb_unavailable_is_loud():
-    from vernemq_tpu.plugins.connectors import PoolError, ensure_pool
+def test_bson_roundtrip():
+    from vernemq_tpu.plugins.connectors import bson_decode, bson_encode
 
-    with pytest.raises(PoolError, match="not built in"):
-        ensure_pool("mongodb", {"pool_id": "x"})
+    doc = {"s": "str", "i": 42, "big": 1 << 40, "f": 1.5, "b": True,
+           "n": None, "sub": {"x": 1}, "arr": ["a", 2, False],
+           "bin": b"\x00\x01"}
+    back, end = bson_decode(bson_encode(doc))
+    assert back == doc
+    assert end == len(bson_encode(doc))
 
 
 # ---------------------------------------------------- bridge + hook flow
@@ -649,14 +653,15 @@ hooks = { on_publish = on_publish, on_deliver = on_deliver,
     assert s.kv["t"]["reg"] == "c1|u2"
 
 
-def test_mongodb_find_one_is_clean_error(tmp_path):
+def test_mongodb_unknown_pool_is_clean_error(tmp_path):
     from vernemq_tpu.plugins.scripting import ScriptingPlugin
 
     path = tmp_path / "my.lua"
     path.write_text("""
 function auth_on_register(reg)
     local ok, err = pcall(function()
-        return mongodb.find_one("p", {client_id = reg.client_id})
+        return mongodb.find_one("no-such-pool", "c",
+                                {client_id = reg.client_id})
     end)
     kv.insert("t", "err", err)
     return false
@@ -666,7 +671,7 @@ hooks = { auth_on_register = auth_on_register }
     plugin = ScriptingPlugin(_FakeBroker(), scripts=[str(path)])
     s = plugin.scripts[str(path)]
     s.hooks["auth_on_register"](None, ("", "c"), "u", "p", True)
-    assert "not built into" in s.kv["t"]["err"]
+    assert "no such mongodb pool" in s.kv["t"]["err"]
 
 
 def test_memcached_rejects_injection_keys():
@@ -943,6 +948,236 @@ def test_mysql_param_count_mismatch_is_loud():
         my._substitute("SELECT ? WHERE a=?", ("one",))
     with pytest.raises(PoolError, match="parameters for 1"):
         my._substitute("SELECT ?", ("one", "extra"))
-    # ? inside string literals is not a placeholder
+    # ? inside string literals is not a placeholder; strings arrive as
+    # charset-converted hex literals (sql_mode-immune, text collation)
     assert my._substitute("SELECT '?' , ?", ("v",)) == \
-        "SELECT '?' , X'" + b"v".hex() + "'"
+        "SELECT '?' , CONVERT(X'" + b"v".hex() + "' USING utf8mb4)"
+
+
+# --------------------------------------------------------------- mongodb
+
+
+def _fake_mongo(user, password, docs):
+    """Threaded MongoDB OP_MSG server: SCRAM-SHA-256 auth + `find`.
+    ``docs`` is a list of documents; `find` returns the first whose
+    fields are a superset of the filter."""
+    import base64
+    import hmac as hmac_mod
+    import os as os_mod
+
+    from vernemq_tpu.plugins.connectors import bson_decode, bson_encode
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    salt = os_mod.urandom(16)
+    iters = 4096
+    salted = hashlib.pbkdf2_hmac("sha256", password.encode(), salt, iters)
+    stored = hashlib.sha256(
+        hmac_mod.new(salted, b"Client Key", hashlib.sha256).digest()).digest()
+    server_key = hmac_mod.new(salted, b"Server Key", hashlib.sha256).digest()
+
+    def read_msg(conn):
+        head = b""
+        while len(head) < 16:
+            c = conn.recv(16 - len(head))
+            if not c:
+                return None, 0
+            head += c
+        ln, rid, _resp, _op = struct.unpack("<iiii", head)
+        body = b""
+        while len(body) < ln - 16:
+            body += conn.recv(ln - 16 - len(body))
+        doc, _ = bson_decode(body, 5)
+        return doc, rid
+
+    def send_reply(conn, rid, doc):
+        body = struct.pack("<I", 0) + b"\x00" + bson_encode(doc)
+        conn.sendall(struct.pack("<iiii", 16 + len(body), 1, rid, 2013)
+                     + body)
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            state = {}
+            while True:
+                cmd, rid = read_msg(conn)
+                if cmd is None:
+                    break
+                if "saslStart" in cmd:
+                    cf = cmd["payload"].decode()
+                    bare = cf[3:]  # strip "n,,"
+                    fields = dict(p.split("=", 1)
+                                  for p in bare.split(","))
+                    if fields["n"] != user:
+                        send_reply(conn, rid,
+                                   {"ok": 0.0, "errmsg": "auth failed"})
+                        continue
+                    rnonce = fields["r"] + base64.b64encode(
+                        os_mod.urandom(9)).decode()
+                    sfirst = (f"r={rnonce},"
+                              f"s={base64.b64encode(salt).decode()},"
+                              f"i={iters}")
+                    state["auth_msg_head"] = bare + "," + sfirst
+                    state["rnonce"] = rnonce
+                    send_reply(conn, rid, {
+                        "ok": 1.0, "conversationId": 1, "done": False,
+                        "payload": sfirst.encode()})
+                elif "saslContinue" in cmd:
+                    fin = cmd["payload"].decode()
+                    fields = dict(p.split("=", 1)
+                                  for p in fin.split(",", 2)
+                                  if "=" in p)
+                    proof = base64.b64decode(fields["p"])
+                    without_proof = fin[:fin.index(",p=")]
+                    auth_msg = (state["auth_msg_head"] + ","
+                                + without_proof).encode()
+                    sig = hmac_mod.new(stored, auth_msg,
+                                       hashlib.sha256).digest()
+                    ckey = bytes(a ^ b for a, b in zip(proof, sig))
+                    if hashlib.sha256(ckey).digest() != stored:
+                        send_reply(conn, rid,
+                                   {"ok": 0.0, "errmsg": "auth failed"})
+                        continue
+                    v = hmac_mod.new(server_key, auth_msg,
+                                     hashlib.sha256).digest()
+                    send_reply(conn, rid, {
+                        "ok": 1.0, "conversationId": 1, "done": True,
+                        "payload": ("v=" + base64.b64encode(v).decode()
+                                    ).encode()})
+                elif "find" in cmd:
+                    flt = cmd.get("filter") or {}
+                    hit = [d for d in docs
+                           if all(d.get(k) == v for k, v in flt.items())]
+                    send_reply(conn, rid, {
+                        "ok": 1.0,
+                        "cursor": {"id": 0,
+                                   "ns": cmd.get("$db", "") + "."
+                                   + cmd["find"],
+                                   "firstBatch": hit[:1]}})
+                else:
+                    send_reply(conn, rid,
+                               {"ok": 0.0, "errmsg": "unknown command"})
+
+    threading.Thread(target=serve, daemon=True).start()
+    return srv.getsockname()[1], srv
+
+
+def test_mongodb_connector_scram_and_find():
+    from vernemq_tpu.plugins.connectors import MongodbPool, PoolError
+
+    docs = [{"client_id": "dev-3", "username": "dana",
+             "passhash": "$2b$fake", "max_qos": 1}]
+    port, srv = _fake_mongo("vmq", "mongopw", docs)
+    try:
+        mp = MongodbPool(port=port, user="vmq", password="mongopw",
+                         database="db")
+        doc = mp.find_one("vmq_acl_auth", {"client_id": "dev-3",
+                                           "username": "dana"})
+        assert doc["passhash"] == "$2b$fake" and doc["max_qos"] == 1
+        assert mp.find_one("vmq_acl_auth", {"client_id": "ghost"}) is None
+        mp.close()
+        bad = MongodbPool(port=port, user="vmq", password="wrongpw",
+                          database="db")
+        with pytest.raises(PoolError):
+            bad.find_one("c", {})
+    finally:
+        srv.close()
+
+
+MONGO_AUTH_LUA = """
+require "auth_commons"
+function auth_on_register(reg)
+    if reg.username ~= nil and reg.password ~= nil then
+        doc = mongodb.find_one(pool, "vmq_acl_auth",
+                               {mountpoint = reg.mountpoint,
+                                client_id = reg.client_id,
+                                username = reg.username})
+        if doc ~= false then
+            if doc.passhash == bcrypt.hashpw(reg.password, doc.passhash) then
+                cache_insert(reg.mountpoint, reg.client_id, reg.username,
+                             doc.publish_acl, doc.subscribe_acl)
+                return true
+            end
+        end
+    end
+    return false
+end
+pool = "auth_mongodb_%s"
+mongodb.ensure_pool({ pool_id = pool, host = "127.0.0.1", port = %d,
+                      login = "vmq", password = "mongopw",
+                      database = "db" })
+hooks = { auth_on_register = auth_on_register,
+          auth_on_publish = auth_on_publish,
+          auth_on_subscribe = auth_on_subscribe }
+"""
+
+
+def test_lua_mongodb_auth_script_flow(tmp_path):
+    """The reference's bundled mongodb.lua shape end to end: SCRAM auth,
+    find_one, bcrypt verify, doc-embedded ACL arrays."""
+    from vernemq_tpu.native import bcrypt
+    from vernemq_tpu.plugins.scripting import ScriptingPlugin
+
+    ph = bcrypt.hashpw("mqtt-secret")
+    docs = [{"mountpoint": "", "client_id": "m-9", "username": "dana",
+             "passhash": ph,
+             "publish_acl": [{"pattern": "farm/%c/#"}],
+             "subscribe_acl": [{"pattern": "farm/#"}]}]
+    port, srv = _fake_mongo("vmq", "mongopw", docs)
+    try:
+        path = tmp_path / "mongo_auth.lua"
+        path.write_text(MONGO_AUTH_LUA % ("flow", port))
+        plugin = ScriptingPlugin(_FakeBroker(), scripts=[str(path)])
+        s = plugin.scripts[str(path)]
+        sid = ("", "m-9")
+        peer = ("10.0.0.4", 1883)
+        assert s.hooks["auth_on_register"](
+            peer, sid, "dana", "mqtt-secret", True) == "ok"
+        assert plugin.cache.lookup(
+            sid, "publish", ["farm", "m-9", "x"])[0] is True
+        assert s.hooks["auth_on_register"](
+            peer, sid, "dana", "bad", True) == ("error", "not_authorized")
+        # unknown client: find_one -> false -> deny without indexing nil
+        assert s.hooks["auth_on_register"](
+            peer, ("", "ghost"), "dana", "mqtt-secret", True) == \
+            ("error", "not_authorized")
+    finally:
+        srv.close()
+
+
+def test_mongodb_failed_auth_does_not_leave_session(tmp_path):
+    """A failed SCRAM handshake must tear the socket down: otherwise the
+    second call would reuse the server-side session and silently bypass
+    the verification that just failed."""
+    from vernemq_tpu.plugins.connectors import MongodbPool, PoolError
+
+    port, srv = _fake_mongo("vmq", "rightpw", [{"client_id": "x"}])
+    try:
+        bad = MongodbPool(port=port, user="vmq", password="wrongpw",
+                          database="db")
+        for _ in range(2):  # both calls must fail identically
+            with pytest.raises(PoolError):
+                bad.find_one("c", {})
+            assert bad.sock is None
+    finally:
+        srv.close()
+
+
+def test_mysql_hash_method_per_pool(tmp_path):
+    from vernemq_tpu.plugins.scripting import ScriptingPlugin
+
+    path = tmp_path / "hm.lua"
+    path.write_text("""
+mysql.ensure_pool({ pool_id = "hm_sha", host = "127.0.0.1", port = 1,
+                    password_hash_method = "sha256" })
+hm_default = mysql.hash_method()
+hm_pool = mysql.hash_method("hm_sha")
+""")
+    plugin = ScriptingPlugin(_FakeBroker(), scripts=[str(path)])
+    rt = plugin.scripts[str(path)].runtime
+    assert rt.get_global("hm_default") == "PASSWORD(?)"
+    assert rt.get_global("hm_pool") == "SHA2(?, 256)"

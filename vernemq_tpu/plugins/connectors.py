@@ -20,10 +20,12 @@ each connector speaks the wire protocol directly over a TCP socket:
   escaped client-side ``?`` substitution, the contract of the bundled
   ``mysql.lua``.
 
-MongoDB keeps its module surface but raises a clear "driver not built
-in" error from ``ensure_pool`` (BSON + OP_MSG out of scope; the
-reference treats a missing dep the same way: the script fails to
-init).
+- :class:`MongodbPool` — MongoDB OP_MSG command transport over a BSON
+  subset with SCRAM-SHA-256 auth; ``find_one(collection, selector)`` is
+  the bundled ``mongodb.lua`` contract.
+
+With that, every datastore the reference bundles a driver for is
+covered by a built-in wire client.
 
 Pools are deliberately tiny: one socket per pool guarded by a lock
 (hooks run on executor threads), reconnect-on-error. The reference's
@@ -40,7 +42,8 @@ import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["RedisPool", "MemcachedPool", "PostgresPool", "MysqlPool",
-           "PoolError", "POOL_REGISTRIES", "ensure_pool", "get_pool"]
+           "MongodbPool", "PoolError", "POOL_REGISTRIES", "ensure_pool",
+           "get_pool", "bson_encode", "bson_decode"]
 
 
 class PoolError(Exception):
@@ -63,7 +66,16 @@ class _SocketClient:
                                      timeout=self.timeout)
         s.settimeout(self.timeout)
         self.sock = s
-        self._on_connect()
+        try:
+            self._on_connect()
+        except BaseException:
+            # a half-set-up session must not linger as self.sock: the next
+            # call would reuse the socket WITHOUT the auth/verification
+            # that just failed (e.g. a mongod session whose SCRAM server
+            # signature didn't verify is authenticated server-side — every
+            # call after the first would silently bypass the check)
+            self.close()
+            raise
 
     def _on_connect(self) -> None:  # override
         pass
@@ -242,6 +254,215 @@ class MemcachedPool(_SocketClient):
             return self._read_line() == b"DELETED"
 
 
+# ------------------------------------------------------------------ mongodb
+
+
+def bson_encode(doc: Dict[str, Any]) -> bytes:
+    """Encode a Python dict as a BSON document (the subset auth documents
+    use: str, int32/64, float, bool, None, bytes, nested dict/list)."""
+    out = bytearray()
+    for k, v in doc.items():
+        key = str(k).encode() + b"\0"
+        if isinstance(v, bool):
+            out += b"\x08" + key + (b"\x01" if v else b"\x00")
+        elif isinstance(v, int):
+            if -(1 << 31) <= v < (1 << 31):
+                out += b"\x10" + key + struct.pack("<i", v)
+            else:
+                out += b"\x12" + key + struct.pack("<q", v)
+        elif isinstance(v, float):
+            out += b"\x01" + key + struct.pack("<d", v)
+        elif isinstance(v, str):
+            b = v.encode()
+            out += b"\x02" + key + struct.pack("<i", len(b) + 1) + b + b"\0"
+        elif v is None:
+            out += b"\x0a" + key
+        elif isinstance(v, bytes):
+            out += b"\x05" + key + struct.pack("<i", len(v)) + b"\x00" + v
+        elif isinstance(v, dict):
+            out += b"\x03" + key + bson_encode(v)
+        elif isinstance(v, (list, tuple)):
+            out += b"\x04" + key + bson_encode(
+                {str(i): x for i, x in enumerate(v)})
+        else:
+            raise PoolError(f"mongodb: cannot BSON-encode {type(v).__name__}")
+    return struct.pack("<i", len(out) + 5) + bytes(out) + b"\0"
+
+
+def bson_decode(data: bytes, off: int = 0) -> Tuple[Dict[str, Any], int]:
+    """Decode one BSON document starting at ``off``; returns (doc, end)."""
+    (total,) = struct.unpack_from("<i", data, off)
+    end = off + total
+    off += 4
+    doc: Dict[str, Any] = {}
+    while off < end - 1:
+        t = data[off]
+        off += 1
+        zero = data.index(b"\0", off)
+        key = data[off:zero].decode()
+        off = zero + 1
+        if t == 0x01:
+            (val,) = struct.unpack_from("<d", data, off)
+            off += 8
+        elif t == 0x02:
+            (n,) = struct.unpack_from("<i", data, off)
+            val = data[off + 4:off + 4 + n - 1].decode("utf-8", "replace")
+            off += 4 + n
+        elif t in (0x03, 0x04):
+            sub, off = bson_decode(data, off)
+            val = ([sub[str(i)] for i in range(len(sub))] if t == 0x04
+                   else sub)
+        elif t == 0x05:
+            (n,) = struct.unpack_from("<i", data, off)
+            val = data[off + 5:off + 5 + n]
+            off += 5 + n
+        elif t == 0x07:  # ObjectId → hex string
+            val = data[off:off + 12].hex()
+            off += 12
+        elif t == 0x08:
+            val = data[off] == 1
+            off += 1
+        elif t == 0x09 or t == 0x12:  # datetime(ms) / int64
+            (val,) = struct.unpack_from("<q", data, off)
+            off += 8
+        elif t == 0x0A:
+            val = None
+        elif t == 0x10:
+            (val,) = struct.unpack_from("<i", data, off)
+            off += 4
+        else:
+            raise PoolError(f"mongodb: unsupported BSON type 0x{t:02x}")
+        doc[key] = val
+    return doc, end
+
+
+class MongodbPool(_SocketClient):
+    """MongoDB wire protocol (the reference's mongodb driver seat):
+    OP_MSG (opcode 2013, kind-0 section) command transport over a BSON
+    subset, with optional SCRAM-SHA-256 authentication (RFC 5802 over
+    the ``saslStart``/``saslContinue`` command round-trips). The script
+    surface is ``find_one(collection, selector)`` — the shape the
+    bundled ``mongodb.lua`` auth script uses — plus ``command`` for
+    anything else."""
+
+    _OP_MSG = 2013
+
+    def __init__(self, host="127.0.0.1", port=27017, user=None,
+                 password="", database="vernemq_db", timeout=5.0):
+        super().__init__(host, port, timeout)
+        self.user = user
+        self.password = password or ""
+        self.database = database
+        self._req_id = 0
+
+    # wire
+    def _send_msg(self, cmd_doc: Dict[str, Any]) -> None:
+        s = self._ensure()
+        self._req_id += 1
+        body = struct.pack("<I", 0) + b"\x00" + bson_encode(cmd_doc)
+        s.sendall(struct.pack("<iiii", 16 + len(body), self._req_id, 0,
+                              self._OP_MSG) + body)
+
+    def _read_msg(self) -> Dict[str, Any]:
+        head = self._recv_exact(16)
+        (ln, _rid, _resp, opcode) = struct.unpack("<iiii", head)
+        body = self._recv_exact(ln - 16)
+        if opcode != self._OP_MSG:
+            raise PoolError(f"mongodb: unexpected opcode {opcode}")
+        # flags(4) + kind byte, then one BSON doc (kind 0)
+        if body[4] != 0:
+            raise PoolError("mongodb: unsupported OP_MSG section kind")
+        doc, _ = bson_decode(body, 5)
+        return doc
+
+    def command(self, doc: Dict[str, Any], db: Optional[str] = None):
+        with self.lock:
+            try:
+                return self._command(doc, db)
+            except PoolError as e:
+                if str(e).startswith("mongodb:"):
+                    raise
+                self._connect()
+                return self._command(doc, db)
+            except OSError:
+                self._connect()
+                return self._command(doc, db)
+
+    def _command(self, doc: Dict[str, Any], db: Optional[str] = None):
+        """One command round-trip (no locking — ``command`` wraps this,
+        and ``_on_connect`` runs inside an in-progress ``_connect``)."""
+        self._ensure()
+        out = dict(doc)
+        out["$db"] = db or self.database
+        self._send_msg(out)
+        reply = self._read_msg()
+        if not reply.get("ok"):
+            raise PoolError(f"mongodb: {reply.get('errmsg', 'command failed')}")
+        return reply
+
+    def find_one(self, collection: str, selector: Dict[str, Any]):
+        """Returns the first matching document or None."""
+        reply = self.command({"find": str(collection),
+                              "filter": dict(selector or {}), "limit": 1})
+        batch = (reply.get("cursor") or {}).get("firstBatch") or []
+        return batch[0] if batch else None
+
+    # SCRAM-SHA-256 (RFC 5802/7677 over saslStart/saslContinue)
+    def _on_connect(self) -> None:
+        if not self.user:
+            return
+        import base64
+        import hmac as hmac_mod
+        import os as os_mod
+
+        user = str(self.user).replace("=", "=3D").replace(",", "=2C")
+        nonce = base64.b64encode(os_mod.urandom(18)).decode()
+        first_bare = f"n={user},r={nonce}"
+        start = self._command({
+            "saslStart": 1, "mechanism": "SCRAM-SHA-256",
+            "payload": ("n,," + first_bare).encode(),
+            "options": {"skipEmptyExchange": True}})
+        server_first = start["payload"].decode()
+        fields = dict(p.split("=", 1) for p in server_first.split(","))
+        if not fields["r"].startswith(nonce):
+            raise PoolError("mongodb: SCRAM server nonce mismatch")
+        salt = base64.b64decode(fields["s"])
+        iters = int(fields["i"])
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(),
+                                     salt, iters)
+        client_key = hmac_mod.new(salted, b"Client Key",
+                                  hashlib.sha256).digest()
+        stored = hashlib.sha256(client_key).digest()
+        without_proof = "c=biws,r=" + fields["r"]
+        auth_msg = ",".join((first_bare, server_first,
+                             without_proof)).encode()
+        sig = hmac_mod.new(stored, auth_msg, hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, sig))
+        final = (without_proof + ",p="
+                 + base64.b64encode(proof).decode())
+        cont = self._command({
+            "saslContinue": 1, "conversationId":
+                start.get("conversationId", 1),
+            "payload": final.encode()})
+        server_final = cont["payload"].decode()
+        server_key = hmac_mod.new(salted, b"Server Key",
+                                  hashlib.sha256).digest()
+        want_v = hmac_mod.new(server_key, auth_msg,
+                              hashlib.sha256).digest()
+        got_v = base64.b64decode(
+            dict(p.split("=", 1)
+                 for p in server_final.split(","))["v"])
+        if got_v != want_v:
+            raise PoolError("mongodb: SCRAM server signature invalid "
+                            "(server does not know the password)")
+        while not cont.get("done", True):
+            cont = self._command({
+                "saslContinue": 1,
+                "conversationId": start.get("conversationId", 1),
+                "payload": b""})
+
+
+
 # ------------------------------------------------------------------- mysql
 
 
@@ -363,10 +584,18 @@ class MysqlPool(_SocketClient):
         # strings go out as hex literals (X'...'): no escaping at all, so
         # the encoding is immune to sql_mode — backslash-escaping would be
         # injectable under NO_BACKSLASH_ESCAPES, and '' doubling under the
-        # default mode if the value ends with a backslash
-        b = v if isinstance(v, bytes) else str(v).encode(
-            "utf-8", "surrogateescape")
-        return "X'" + b.hex() + "'" if b else "''"
+        # default mode if the value ends with a backslash. A bare hex
+        # literal is binary-charset though, which would force byte-exact
+        # (case/trailing-space sensitive) comparisons against text
+        # columns; CONVERT(... USING utf8mb4) restores the text charset
+        # so comparisons use the column's collation like a quoted
+        # literal would. Raw bytes stay binary.
+        if isinstance(v, bytes):
+            return "X'" + v.hex() + "'" if v else "''"
+        b = str(v).encode("utf-8", "surrogateescape")
+        if not b:
+            return "''"
+        return f"CONVERT(X'{b.hex()}' USING utf8mb4)"
 
     def _substitute(self, sql: str, params) -> str:
         """Replace ``?`` placeholders outside string literals; placeholder
@@ -608,6 +837,14 @@ def _pg_text(p) -> str:
 #: pool_id → client, per driver kind
 POOL_REGISTRIES: Dict[str, Dict[str, Any]] = {
     "redis": {}, "memcached": {}, "postgres": {}, "mysql": {},
+    "mongodb": {},
+}
+
+#: pool_id -> the config dict it was created with (secrets included —
+#: in-process only, never serialised); lets per-pool settings like
+#: mysql password_hash_method be resolved after creation
+POOL_CONFIGS: Dict[str, Dict[str, Dict[str, Any]]] = {
+    k: {} for k in POOL_REGISTRIES
 }
 
 _FACTORIES = {
@@ -624,23 +861,24 @@ _FACTORIES = {
         host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 3306),
         user=cfg.get("user", "root"), password=cfg.get("password", ""),
         database=cfg.get("database", "vernemq_db")),
+    "mongodb": lambda cfg: MongodbPool(
+        host=cfg.get("host", "127.0.0.1"), port=cfg.get("port", 27017),
+        user=cfg.get("login") or cfg.get("user"),
+        password=cfg.get("password", ""),
+        database=cfg.get("database", "vernemq_db")),
 }
 
 
 def ensure_pool(kind: str, config: Dict[str, Any]) -> str:
     """Create (or reuse) a named pool; returns the pool id. Mirrors the
     Lua-visible ``<driver>.ensure_pool{pool_id=...}`` contract."""
-    if kind == "mongodb":
-        raise PoolError(
-            "mongodb: driver not built into this distribution (redis, "
-            "memcached, postgres, mysql and http are; see "
-            "plugins/connectors.py)")
     if kind not in _FACTORIES:
         raise PoolError(f"unknown datastore kind {kind!r}")
     pool_id = str(config.get("pool_id") or f"{kind}_default")
     reg = POOL_REGISTRIES[kind]
     if pool_id not in reg:
         reg[pool_id] = _FACTORIES[kind](config)
+        POOL_CONFIGS[kind][pool_id] = dict(config)
     return pool_id
 
 

@@ -27,9 +27,10 @@ Injected Lua modules (the vmq_diversity script surface):
 - ``http.get/post_json``
 - ``bcrypt.hashpw/checkpw/gensalt`` (native bcrypt)
 - ``redis.ensure_pool/cmd``, ``memcached.ensure_pool/get/set/delete``,
-  ``postgres.ensure_pool/execute`` — pure-Python wire-protocol clients
-  (``plugins/connectors.py``); ``mysql``/``mongodb`` raise a clear
-  "driver not built in" error from ``ensure_pool``
+  ``postgres.ensure_pool/execute``, ``mysql.ensure_pool/execute/
+  hash_method``, ``mongodb.ensure_pool/find_one/command`` — pure-Python
+  wire-protocol clients (``plugins/connectors.py``), covering every
+  datastore the reference bundles a driver for
 - ``log.info/warning/error/debug``
 
 ``require "auth/auth_commons"`` resolves to the bundled commons module
@@ -239,13 +240,6 @@ class LuaScript:
                 return to_lua(res)
             return _call
 
-        def unavailable(kind):
-            def _stub(*_args):
-                raise LuaError(
-                    f"{kind}: driver not built into this distribution "
-                    "(redis, memcached, postgres, mysql and http are)")
-            return _stub
-
         module("redis", {"ensure_pool": ensure("redis"),
                          "cmd": pool_call("redis", "cmd")})
         module("memcached", {"ensure_pool": ensure("memcached"),
@@ -255,23 +249,46 @@ class LuaScript:
         module("postgres", {"ensure_pool": ensure("postgres"),
                             "execute": pool_call("postgres", "execute")})
 
-        def mysql_hash_method():
-            # the reference maps the pool's password_hash_method config
-            # to the SQL hashing call (vmq_diversity_mysql.erl:119-129)
-            try:
-                method = str(self.plugin.broker.config.get(
-                    "mysql_password_hash_method", "password"))
-            except Exception:
-                method = "password"
+        def mysql_hash_method(pool_id=None):
+            # the reference maps the configured password_hash_method to
+            # the SQL hashing call (vmq_diversity_mysql.erl:119-129 —
+            # there a single app-level mysql config). Here a pool_id
+            # argument resolves that pool's own setting (from its
+            # ensure_pool config) so two pools can hash differently;
+            # without one, the broker-global knob applies.
+            method = None
+            if pool_id is not None:
+                method = C.POOL_CONFIGS["mysql"].get(
+                    str(pool_id), {}).get("password_hash_method")
+            if method is None:
+                try:
+                    method = str(self.plugin.broker.config.get(
+                        "mysql_password_hash_method", "password"))
+                except Exception:
+                    method = "password"
             return {"password": "PASSWORD(?)", "md5": "MD5(?)",
                     "sha1": "SHA1(?)",
-                    "sha256": "SHA2(?, 256)"}.get(method, "PASSWORD(?)")
+                    "sha256": "SHA2(?, 256)"}.get(str(method),
+                                                  "PASSWORD(?)")
 
         module("mysql", {"ensure_pool": ensure("mysql"),
                          "execute": pool_call("mysql", "execute"),
                          "hash_method": mysql_hash_method})
+
+        def mongo_find_one(pool_id, collection, selector=None):
+            # the bundled mongodb.lua checks `doc ~= false` — a missing
+            # document must come back as false, not nil
+            try:
+                client = C.get_pool("mongodb", pool_id)
+                doc = client.find_one(
+                    collection, from_lua(selector) if selector else {})
+            except C.PoolError as e:
+                raise LuaError(str(e)) from None
+            return to_lua(doc) if doc is not None else False
+
         module("mongodb", {"ensure_pool": ensure("mongodb"),
-                           "find_one": unavailable("mongodb")})
+                           "find_one": mongo_find_one,
+                           "command": pool_call("mongodb", "command")})
 
         # logger
         lg = logging.getLogger(f"vernemq_tpu.lua.{os.path.basename(self.path)}")
