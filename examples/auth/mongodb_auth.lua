@@ -1,0 +1,51 @@
+-- MongoDB-backed auth for vernemq_tpu, in the reference's bundled-
+-- script shape (vmq_diversity priv/auth/mongodb.lua seat; fresh
+-- implementation).
+--
+-- Provisioning: documents in collection `vmq_acl_auth` shaped as
+--     { mountpoint:    "",
+--       client_id:     "...",
+--       username:      "...",
+--       passhash:      "<bcrypt hash>",
+--       publish_acl:   [ {pattern: "a/b/+"} , ... ],
+--       subscribe_acl: [ {pattern: "c/#"} , ... ] }
+-- Patterns support MQTT wildcards and %m/%c/%u substitution.
+--
+-- Enable with:  diversity_scripts = ["examples/auth/mongodb_auth.lua"]
+
+require "auth_commons"
+
+function auth_on_register(reg)
+    if reg.username ~= nil and reg.password ~= nil then
+        local doc = mongodb.find_one(pool, "vmq_acl_auth",
+                                     {mountpoint = reg.mountpoint,
+                                      client_id = reg.client_id,
+                                      username = reg.username})
+        if doc ~= false then
+            if doc.passhash == bcrypt.hashpw(reg.password, doc.passhash) then
+                cache_insert(reg.mountpoint, reg.client_id, reg.username,
+                             doc.publish_acl, doc.subscribe_acl)
+                return true
+            end
+        end
+    end
+    return false
+end
+
+pool = "auth_mongodb"
+mongodb.ensure_pool({
+    pool_id = pool,
+    host = "127.0.0.1",
+    port = 27017,
+    -- login = "vmq", password = "...",  (SCRAM-SHA-256)
+    database = "vmq_auth",
+})
+
+hooks = {
+    auth_on_register = auth_on_register,
+    auth_on_publish = auth_on_publish,
+    auth_on_subscribe = auth_on_subscribe,
+    auth_on_register_m5 = auth_on_register_m5,
+    on_client_gone = on_client_gone,
+    on_client_offline = on_client_offline,
+}

@@ -1,0 +1,61 @@
+-- MySQL-backed auth for vernemq_tpu, in the reference's bundled-script
+-- shape (vmq_diversity priv/auth/mysql.lua seat; fresh implementation).
+--
+-- Provisioning:
+--     CREATE TABLE vmq_auth_acl (
+--       mountpoint    varchar(10)  NOT NULL,
+--       client_id     varchar(128) NOT NULL,
+--       username      varchar(128) NOT NULL,
+--       password      varchar(128),
+--       publish_acl   text,
+--       subscribe_acl text,
+--       PRIMARY KEY (mountpoint, client_id, username));
+-- Password hashing is selected by mysql.hash_method() — per-pool
+-- password_hash_method (password | md5 | sha1 | sha256), falling back
+-- to the broker's mysql_password_hash_method knob. Note MySQL >= 8.0
+-- removed PASSWORD(); use sha256 there.
+--
+-- Enable with:  diversity_scripts = ["examples/auth/mysql_auth.lua"]
+
+require "auth_commons"
+
+function auth_on_register(reg)
+    if reg.username ~= nil and reg.password ~= nil then
+        local results = mysql.execute(pool,
+            [[SELECT publish_acl, subscribe_acl
+              FROM vmq_auth_acl
+              WHERE mountpoint=? AND client_id=? AND username=?
+                AND password=]] .. mysql.hash_method(pool),
+            reg.mountpoint, reg.client_id, reg.username, reg.password)
+        if #results == 1 then
+            local row = results[1]
+            cache_insert(reg.mountpoint, reg.client_id, reg.username,
+                         json.decode(row.publish_acl),
+                         json.decode(row.subscribe_acl))
+            return true
+        end
+    end
+    -- no/partial credentials or no matching row: deny (false), never
+    -- fall through to the next plugin (nil would mean "next")
+    return false
+end
+
+pool = "auth_mysql"
+mysql.ensure_pool({
+    pool_id = pool,
+    host = "127.0.0.1",
+    port = 3306,
+    user = "vmq",
+    password = "vmq",
+    database = "vmq_auth",
+    -- password_hash_method = "sha256",
+})
+
+hooks = {
+    auth_on_register = auth_on_register,
+    auth_on_publish = auth_on_publish,
+    auth_on_subscribe = auth_on_subscribe,
+    auth_on_register_m5 = auth_on_register_m5,
+    on_client_gone = on_client_gone,
+    on_client_offline = on_client_offline,
+}

@@ -1,0 +1,60 @@
+-- PostgreSQL-backed auth for vernemq_tpu, in the reference's bundled-
+-- script shape (vmq_diversity priv/auth/postgres.lua seat; fresh
+-- implementation).
+--
+-- Provisioning (crypt()-hashed passwords via pgcrypto):
+--     CREATE EXTENSION pgcrypto;
+--     CREATE TABLE vmq_auth_acl (
+--       mountpoint    varchar(10)  NOT NULL,
+--       client_id     varchar(128) NOT NULL,
+--       username      varchar(128) NOT NULL,
+--       password      varchar(128),
+--       publish_acl   json,
+--       subscribe_acl json,
+--       PRIMARY KEY (mountpoint, client_id, username));
+-- ACL JSON arrays hold {"pattern": "..."} objects; MQTT wildcards and
+-- %m/%c/%u substitution are allowed inside a pattern.
+--
+-- Enable with:  diversity_scripts = ["examples/auth/postgres_auth.lua"]
+
+require "auth_commons"
+
+function auth_on_register(reg)
+    if reg.username ~= nil and reg.password ~= nil then
+        local results = postgres.execute(pool,
+            [[SELECT publish_acl::TEXT, subscribe_acl::TEXT
+              FROM vmq_auth_acl
+              WHERE mountpoint=$1 AND client_id=$2 AND username=$3
+                AND password=crypt($4, password)]],
+            reg.mountpoint, reg.client_id, reg.username, reg.password)
+        if #results == 1 then
+            local row = results[1]
+            cache_insert(reg.mountpoint, reg.client_id, reg.username,
+                         json.decode(row.publish_acl),
+                         json.decode(row.subscribe_acl))
+            return true
+        end
+    end
+    -- no/partial credentials or no matching row: deny (false), never
+    -- fall through to the next plugin (nil would mean "next")
+    return false
+end
+
+pool = "auth_postgres"
+postgres.ensure_pool({
+    pool_id = pool,
+    host = "127.0.0.1",
+    port = 5432,
+    user = "vmq",
+    password = "vmq",
+    database = "vmq_auth",
+})
+
+hooks = {
+    auth_on_register = auth_on_register,
+    auth_on_publish = auth_on_publish,
+    auth_on_subscribe = auth_on_subscribe,
+    auth_on_register_m5 = auth_on_register_m5,
+    on_client_gone = on_client_gone,
+    on_client_offline = on_client_offline,
+}

@@ -1181,3 +1181,88 @@ hm_pool = mysql.hash_method("hm_sha")
     rt = plugin.scripts[str(path)].runtime
     assert rt.get_global("hm_default") == "PASSWORD(?)"
     assert rt.get_global("hm_pool") == "SHA2(?, 256)"
+
+
+# ------------------------------------------ examples + script admin CLI
+
+
+def test_bundled_example_scripts_load():
+    """Every shipped example auth script parses, inits its pool module,
+    and exports the expected hooks (no live datastore needed — pools
+    connect lazily)."""
+    import pathlib
+
+    from vernemq_tpu.plugins.scripting import ScriptingPlugin
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    paths = sorted(str(p) for p in (root / "examples" / "auth").glob("*.lua"))
+    assert len(paths) >= 4  # redis, postgres, mysql, mongodb
+    plugin = ScriptingPlugin(_FakeBroker(), scripts=paths)
+    for p in paths:
+        hooks = plugin.scripts[p].hooks
+        assert "auth_on_register" in hooks, p
+        assert "auth_on_publish" in hooks, p
+
+
+@pytest.mark.asyncio
+async def test_script_admin_commands(tmp_path):
+    from vernemq_tpu.admin.commands import (CommandError, CommandRegistry,
+                                            register_core_commands)
+
+    path = tmp_path / "adm.lua"
+    path.write_text("""
+marker = "v1"
+function auth_on_register(reg) return true end
+hooks = { auth_on_register = auth_on_register }
+""")
+    broker, server = await start_broker(
+        Config(systree_enabled=False, allow_anonymous=True), port=0)
+    try:
+        broker.plugins.enable("vmq_diversity", scripts=[str(path)])
+        reg = register_core_commands(CommandRegistry())
+        res = reg.run(broker, ["script", "show"])
+        assert res["table"][0]["script"] == str(path)
+        assert "auth_on_register" in res["table"][0]["hooks"]
+        # reload picks up edits
+        path.write_text("""
+marker = "v2"
+function auth_on_register(reg) return false end
+hooks = { auth_on_register = auth_on_register }
+""")
+        out = reg.run(broker, [
+            "script", "reload", f"path={path}"])
+        assert "reloaded" in out
+        s = broker.plugins.get("vmq_diversity").scripts[str(path)]
+        assert s.runtime.get_global("marker") == "v2"
+        with pytest.raises(CommandError):
+            reg.run(broker, ["script", "reload", "path=/nope.lua"])
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+def test_ensure_pool_config_change_rebuilds():
+    from vernemq_tpu.plugins import connectors as C
+
+    pid = C.ensure_pool("redis", {"pool_id": "rb_test", "port": 1111})
+    first = C.get_pool("redis", pid)
+    # same config: same client
+    C.ensure_pool("redis", {"pool_id": "rb_test", "port": 1111})
+    assert C.get_pool("redis", pid) is first
+    # changed config (script reload): rebuilt client with new settings
+    C.ensure_pool("redis", {"pool_id": "rb_test", "port": 2222})
+    second = C.get_pool("redis", pid)
+    assert second is not first and second.port == 2222
+
+
+def test_mysql_binary_param_stays_byte_exact():
+    from vernemq_tpu.plugins.connectors import MysqlPool
+
+    my = MysqlPool(port=1)
+    # binary password smuggled through surrogateescape must NOT be
+    # wrapped in CONVERT (truncation at the first invalid byte)
+    bad = b"\xffsecret".decode("utf-8", "surrogateescape")
+    lit = my._escape(bad)
+    assert lit == "X'" + b"\xffsecret".hex() + "'"
+    assert my._escape("plain") == \
+        "CONVERT(X'" + b"plain".hex() + "' USING utf8mb4)"

@@ -176,6 +176,10 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
     reg.register(["churney", "report"], _churney_report,
                  "vmq-admin churney report")
     reg.register(["churney", "stop"], _churney_stop, "vmq-admin churney stop")
+    reg.register(["script", "show"], _script_show,
+                 "vmq-admin script show")
+    reg.register(["script", "reload"], _script_reload,
+                 "vmq-admin script reload path=/path/to/script.lua")
     reg.register(["plugin", "enable"], _plugin_enable,
                  "vmq-admin plugin enable name=PluginName [opt=val...]")
     reg.register(["plugin", "disable"], _plugin_disable,
@@ -502,6 +506,32 @@ def _bridge_show(broker, flags):
     plugin = broker.plugins.get("vmq_bridge")
     rows = plugin.show() if plugin is not None else []
     return {"table": rows}
+
+
+def _script_show(broker, flags):
+    """vmq-admin script show — loaded Lua/Python scripts and their hooks
+    (vmq_diversity_cli 'script' command group)."""
+    plugin = broker.plugins.get("vmq_diversity")
+    if plugin is None:
+        return {"table": []}
+    return {"table": plugin.show()}
+
+
+def _script_reload(broker, flags):
+    """vmq-admin script reload path=... (vmq_diversity_cli reload)."""
+    plugin = broker.plugins.get("vmq_diversity")
+    if plugin is None:
+        raise CommandError("vmq_diversity plugin not enabled")
+    path = flags.get("path")
+    if not isinstance(path, str):
+        raise CommandError("path=/path/to/script required")
+    if path not in plugin.scripts:
+        raise CommandError(f"no such script {path!r}")
+    try:
+        plugin.reload_script(path)
+    except Exception as e:  # syntax error / missing file: clean CLI error
+        raise CommandError(f"reload failed: {e}") from e
+    return f"script {path} reloaded"
 
 
 def _plugin_show(broker, flags):

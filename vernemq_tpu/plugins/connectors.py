@@ -592,7 +592,14 @@ class MysqlPool(_SocketClient):
         # literal would. Raw bytes stay binary.
         if isinstance(v, bytes):
             return "X'" + v.hex() + "'" if v else "''"
-        b = str(v).encode("utf-8", "surrogateescape")
+        try:
+            b = str(v).encode("utf-8")
+        except UnicodeEncodeError:
+            # non-UTF-8 bytes smuggled through surrogateescape (binary
+            # MQTT passwords): CONVERT would truncate at the first bad
+            # byte — keep the byte-exact binary literal instead
+            b = str(v).encode("utf-8", "surrogateescape")
+            return "X'" + b.hex() + "'" if b else "''"
         if not b:
             return "''"
         return f"CONVERT(X'{b.hex()}' USING utf8mb4)"
@@ -876,9 +883,22 @@ def ensure_pool(kind: str, config: Dict[str, Any]) -> str:
         raise PoolError(f"unknown datastore kind {kind!r}")
     pool_id = str(config.get("pool_id") or f"{kind}_default")
     reg = POOL_REGISTRIES[kind]
+    cfg = dict(config)
     if pool_id not in reg:
         reg[pool_id] = _FACTORIES[kind](config)
-        POOL_CONFIGS[kind][pool_id] = dict(config)
+        POOL_CONFIGS[kind][pool_id] = cfg
+    elif POOL_CONFIGS[kind].get(pool_id) != cfg:
+        # re-declared with different settings (script reload): rebuild so
+        # the new host/credentials/options actually apply — otherwise a
+        # reload would report success while the pool silently kept its
+        # old connection settings
+        old = reg[pool_id]
+        reg[pool_id] = _FACTORIES[kind](config)
+        POOL_CONFIGS[kind][pool_id] = cfg
+        try:
+            old.close()
+        except Exception:
+            pass
     return pool_id
 
 
