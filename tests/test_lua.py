@@ -177,32 +177,38 @@ def _fake_redis(db):
     srv.bind(("127.0.0.1", 0))
     srv.listen(4)
 
+    def handle(conn):
+        f = conn.makefile("rb")
+        while True:
+            line = f.readline().strip()
+            if not line:
+                return
+            n = int(line[1:])
+            args = []
+            for _ in range(n):
+                ln = f.readline().strip()
+                args.append(f.read(int(ln[1:]) + 2)[:-2])
+            cmd = args[0].upper()
+            if cmd == b"GET":
+                v = db.get(args[1])
+                conn.sendall(b"$-1\r\n" if v is None
+                             else b"$%d\r\n%s\r\n" % (len(v), v))
+            elif cmd == b"SET":
+                db[args[1]] = args[2]
+                conn.sendall(b"+OK\r\n")
+            else:
+                conn.sendall(b"+OK\r\n")
+
     def serve():
         while True:
             try:
                 conn, _ = srv.accept()
             except OSError:
                 return
-            f = conn.makefile("rb")
-            while True:
-                line = f.readline().strip()
-                if not line:
-                    break
-                n = int(line[1:])
-                args = []
-                for _ in range(n):
-                    ln = f.readline().strip()
-                    args.append(f.read(int(ln[1:]) + 2)[:-2])
-                cmd = args[0].upper()
-                if cmd == b"GET":
-                    v = db.get(args[1])
-                    conn.sendall(b"$-1\r\n" if v is None
-                                 else b"$%d\r\n%s\r\n" % (len(v), v))
-                elif cmd == b"SET":
-                    db[args[1]] = args[2]
-                    conn.sendall(b"+OK\r\n")
-                else:
-                    conn.sendall(b"+OK\r\n")
+            # one handler thread per connection: client pools open
+            # several sockets concurrently
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
 
     threading.Thread(target=serve, daemon=True).start()
     return srv.getsockname()[1], srv
@@ -224,77 +230,81 @@ def _fake_postgres(user, password, rows_for):
                 conn, _ = srv.accept()
             except OSError:
                 return
-            # startup
-            (ln,) = struct.unpack(">I", conn.recv(4))
-            conn.recv(ln - 4)
-            salt = b"s@lt"
-            conn.sendall(msg(b"R", struct.pack(">I", 5) + salt))
-            t = conn.recv(1)
-            assert t == b"p"
-            (ln,) = struct.unpack(">I", conn.recv(4))
-            got = conn.recv(ln - 4).rstrip(b"\0").decode()
-            inner = hashlib.md5((password + user).encode()).hexdigest()
-            want = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
-            if got != want:
-                conn.sendall(msg(b"E", b"SFATAL\0Mpassword authentication "
-                                 b"failed\0\0"))
-                conn.close()
-                continue
-            conn.sendall(msg(b"R", struct.pack(">I", 0)))
-            conn.sendall(msg(b"Z", b"I"))
-            # extended-query loop
-            sql, params = "", []
-            buf = b""
-            while True:
-                try:
-                    data = conn.recv(65536)
-                except OSError:
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+
+    def handle(conn):
+        # startup
+        (ln,) = struct.unpack(">I", conn.recv(4))
+        conn.recv(ln - 4)
+        salt = b"s@lt"
+        conn.sendall(msg(b"R", struct.pack(">I", 5) + salt))
+        t = conn.recv(1)
+        assert t == b"p"
+        (ln,) = struct.unpack(">I", conn.recv(4))
+        got = conn.recv(ln - 4).rstrip(b"\0").decode()
+        inner = hashlib.md5((password + user).encode()).hexdigest()
+        want = "md5" + hashlib.md5(inner.encode() + salt).hexdigest()
+        if got != want:
+            conn.sendall(msg(b"E", b"SFATAL\0Mpassword authentication "
+                             b"failed\0\0"))
+            conn.close()
+            return
+        conn.sendall(msg(b"R", struct.pack(">I", 0)))
+        conn.sendall(msg(b"Z", b"I"))
+        # extended-query loop
+        sql, params = "", []
+        buf = b""
+        while True:
+            try:
+                data = conn.recv(65536)
+            except OSError:
+                break
+            if not data:
+                break
+            buf += data
+            while len(buf) >= 5:
+                t = buf[:1]
+                (ln,) = struct.unpack(">I", buf[1:5])
+                if len(buf) < 1 + ln:
                     break
-                if not data:
-                    break
-                buf += data
-                while len(buf) >= 5:
-                    t = buf[:1]
-                    (ln,) = struct.unpack(">I", buf[1:5])
-                    if len(buf) < 1 + ln:
-                        break
-                    body = buf[5:1 + ln]
-                    buf = buf[1 + ln:]
-                    if t == b"P":
-                        sql = body.split(b"\0")[1].decode()
-                        conn.sendall(msg(b"1", b""))
-                    elif t == b"B":
-                        off = body.index(b"\0") + 1
-                        off = body.index(b"\0", off) + 1
-                        (nfmt,) = struct.unpack(">H", body[off:off + 2])
-                        off += 2 + 2 * nfmt
-                        (np_,) = struct.unpack(">H", body[off:off + 2])
-                        off += 2
-                        params = []
-                        for _ in range(np_):
-                            (pl,) = struct.unpack(">i", body[off:off + 4])
-                            off += 4
-                            if pl < 0:
-                                params.append(None)
-                            else:
-                                params.append(body[off:off + pl].decode())
-                                off += pl
-                        conn.sendall(msg(b"2", b""))
-                    elif t == b"S":
-                        cols, rows = rows_for(sql, params)
-                        desc = [struct.pack(">H", len(cols))]
-                        for c in cols:
-                            desc.append(c.encode() + b"\0"
-                                        + b"\0" * 18)
-                        conn.sendall(msg(b"T", b"".join(desc)))
-                        for r in rows:
-                            dr = [struct.pack(">H", len(r))]
-                            for v in r:
-                                b = str(v).encode()
-                                dr.append(struct.pack(">I", len(b)) + b)
-                            conn.sendall(msg(b"D", b"".join(dr)))
-                        conn.sendall(msg(b"C", b"SELECT\0"))
-                        conn.sendall(msg(b"Z", b"I"))
+                body = buf[5:1 + ln]
+                buf = buf[1 + ln:]
+                if t == b"P":
+                    sql = body.split(b"\0")[1].decode()
+                    conn.sendall(msg(b"1", b""))
+                elif t == b"B":
+                    off = body.index(b"\0") + 1
+                    off = body.index(b"\0", off) + 1
+                    (nfmt,) = struct.unpack(">H", body[off:off + 2])
+                    off += 2 + 2 * nfmt
+                    (np_,) = struct.unpack(">H", body[off:off + 2])
+                    off += 2
+                    params = []
+                    for _ in range(np_):
+                        (pl,) = struct.unpack(">i", body[off:off + 4])
+                        off += 4
+                        if pl < 0:
+                            params.append(None)
+                        else:
+                            params.append(body[off:off + pl].decode())
+                            off += pl
+                    conn.sendall(msg(b"2", b""))
+                elif t == b"S":
+                    cols, rows = rows_for(sql, params)
+                    desc = [struct.pack(">H", len(cols))]
+                    for c in cols:
+                        desc.append(c.encode() + b"\0"
+                                    + b"\0" * 18)
+                    conn.sendall(msg(b"T", b"".join(desc)))
+                    for r in rows:
+                        dr = [struct.pack(">H", len(r))]
+                        for v in r:
+                            b = str(v).encode()
+                            dr.append(struct.pack(">I", len(b)) + b)
+                        conn.sendall(msg(b"D", b"".join(dr)))
+                    conn.sendall(msg(b"C", b"SELECT\0"))
+                    conn.sendall(msg(b"Z", b"I"))
 
     threading.Thread(target=serve, daemon=True).start()
     return srv.getsockname()[1], srv
@@ -785,61 +795,65 @@ def _fake_mysql(user, password, rows_for):
                 conn, _ = srv.accept()
             except OSError:
                 return
-            greeting = (bytes([10]) + b"8.0-fake\0"
-                        + (1234).to_bytes(4, "little")
-                        + salt[:8] + b"\0"
-                        + (0xFFFF).to_bytes(2, "little")  # caps lo
-                        + bytes([33])
-                        + (2).to_bytes(2, "little")       # status
-                        + (0x000F).to_bytes(2, "little")  # caps hi
-                        + bytes([21]) + b"\0" * 10
-                        + salt[8:] + b"\0"
-                        + b"mysql_native_password\0")
-            conn.sendall(pkt(0, greeting))
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+
+    def handle(conn):
+        greeting = (bytes([10]) + b"8.0-fake\0"
+                    + (1234).to_bytes(4, "little")
+                    + salt[:8] + b"\0"
+                    + (0xFFFF).to_bytes(2, "little")  # caps lo
+                    + bytes([33])
+                    + (2).to_bytes(2, "little")       # status
+                    + (0x000F).to_bytes(2, "little")  # caps hi
+                    + bytes([21]) + b"\0" * 10
+                    + salt[8:] + b"\0"
+                    + b"mysql_native_password\0")
+        conn.sendall(pkt(0, greeting))
+        body, seq = read_pkt(conn)
+        if body is None:
+            conn.close()
+            return
+        # handshake response 41: caps(4) maxpkt(4) charset(1) 23x
+        off = 4 + 4 + 1 + 23
+        end = body.index(b"\0", off)
+        got_user = body[off:end].decode()
+        off = end + 1
+        tlen = body[off]
+        token = body[off + 1:off + 1 + tlen]
+        if got_user != user or token != native_token(password):
+            conn.sendall(pkt(seq + 1, b"\xff" + (1045).to_bytes(2, "little")
+                             + b"#28000Access denied"))
+            conn.close()
+            return
+        conn.sendall(pkt(seq + 1, b"\x00\x00\x00\x02\x00\x00\x00"))
+        while True:
             body, seq = read_pkt(conn)
-            if body is None:
-                conn.close()
-                continue
-            # handshake response 41: caps(4) maxpkt(4) charset(1) 23x
-            off = 4 + 4 + 1 + 23
-            end = body.index(b"\0", off)
-            got_user = body[off:end].decode()
-            off = end + 1
-            tlen = body[off]
-            token = body[off + 1:off + 1 + tlen]
-            if got_user != user or token != native_token(password):
-                conn.sendall(pkt(seq + 1, b"\xff" + (1045).to_bytes(2, "little")
-                                 + b"#28000Access denied"))
-                conn.close()
-                continue
-            conn.sendall(pkt(seq + 1, b"\x00\x00\x00\x02\x00\x00\x00"))
-            while True:
-                body, seq = read_pkt(conn)
-                if body is None or body[:1] != b"\x03":
-                    break
-                sql = body[1:].decode()
-                cols, rows = rows_for(sql)
-                s = 1
-                conn.sendall(pkt(s, bytes([len(cols)])))
-                for c in cols:
-                    s += 1
-                    cb = c.encode()
-                    cdef = (lenenc_str(b"def") + lenenc_str(b"") +
-                            lenenc_str(b"t") + lenenc_str(b"t") +
-                            lenenc_str(cb) + lenenc_str(cb) +
-                            bytes([0x0c]) + (33).to_bytes(2, "little") +
-                            (255).to_bytes(4, "little") + bytes([253]) +
-                            (0).to_bytes(2, "little") + bytes([0]) +
-                            b"\0\0")
-                    conn.sendall(pkt(s, cdef))
+            if body is None or body[:1] != b"\x03":
+                break
+            sql = body[1:].decode()
+            cols, rows = rows_for(sql)
+            s = 1
+            conn.sendall(pkt(s, bytes([len(cols)])))
+            for c in cols:
                 s += 1
-                conn.sendall(pkt(s, b"\xfe\x00\x00\x02\x00"))  # EOF
-                for r in rows:
-                    s += 1
-                    rb = b"".join(lenenc_str(str(v).encode()) for v in r)
-                    conn.sendall(pkt(s, rb))
+                cb = c.encode()
+                cdef = (lenenc_str(b"def") + lenenc_str(b"") +
+                        lenenc_str(b"t") + lenenc_str(b"t") +
+                        lenenc_str(cb) + lenenc_str(cb) +
+                        bytes([0x0c]) + (33).to_bytes(2, "little") +
+                        (255).to_bytes(4, "little") + bytes([253]) +
+                        (0).to_bytes(2, "little") + bytes([0]) +
+                        b"\0\0")
+                conn.sendall(pkt(s, cdef))
+            s += 1
+            conn.sendall(pkt(s, b"\xfe\x00\x00\x02\x00"))  # EOF
+            for r in rows:
                 s += 1
-                conn.sendall(pkt(s, b"\xfe\x00\x00\x02\x00"))  # EOF
+                rb = b"".join(lenenc_str(str(v).encode()) for v in r)
+                conn.sendall(pkt(s, rb))
+            s += 1
+            conn.sendall(pkt(s, b"\xfe\x00\x00\x02\x00"))  # EOF
 
     threading.Thread(target=serve, daemon=True).start()
     return srv.getsockname()[1], srv
@@ -1002,65 +1016,69 @@ def _fake_mongo(user, password, docs):
                 conn, _ = srv.accept()
             except OSError:
                 return
-            state = {}
-            while True:
-                cmd, rid = read_msg(conn)
-                if cmd is None:
-                    break
-                if "saslStart" in cmd:
-                    cf = cmd["payload"].decode()
-                    bare = cf[3:]  # strip "n,,"
-                    fields = dict(p.split("=", 1)
-                                  for p in bare.split(","))
-                    if fields["n"] != user:
-                        send_reply(conn, rid,
-                                   {"ok": 0.0, "errmsg": "auth failed"})
-                        continue
-                    rnonce = fields["r"] + base64.b64encode(
-                        os_mod.urandom(9)).decode()
-                    sfirst = (f"r={rnonce},"
-                              f"s={base64.b64encode(salt).decode()},"
-                              f"i={iters}")
-                    state["auth_msg_head"] = bare + "," + sfirst
-                    state["rnonce"] = rnonce
-                    send_reply(conn, rid, {
-                        "ok": 1.0, "conversationId": 1, "done": False,
-                        "payload": sfirst.encode()})
-                elif "saslContinue" in cmd:
-                    fin = cmd["payload"].decode()
-                    fields = dict(p.split("=", 1)
-                                  for p in fin.split(",", 2)
-                                  if "=" in p)
-                    proof = base64.b64decode(fields["p"])
-                    without_proof = fin[:fin.index(",p=")]
-                    auth_msg = (state["auth_msg_head"] + ","
-                                + without_proof).encode()
-                    sig = hmac_mod.new(stored, auth_msg,
-                                       hashlib.sha256).digest()
-                    ckey = bytes(a ^ b for a, b in zip(proof, sig))
-                    if hashlib.sha256(ckey).digest() != stored:
-                        send_reply(conn, rid,
-                                   {"ok": 0.0, "errmsg": "auth failed"})
-                        continue
-                    v = hmac_mod.new(server_key, auth_msg,
-                                     hashlib.sha256).digest()
-                    send_reply(conn, rid, {
-                        "ok": 1.0, "conversationId": 1, "done": True,
-                        "payload": ("v=" + base64.b64encode(v).decode()
-                                    ).encode()})
-                elif "find" in cmd:
-                    flt = cmd.get("filter") or {}
-                    hit = [d for d in docs
-                           if all(d.get(k) == v for k, v in flt.items())]
-                    send_reply(conn, rid, {
-                        "ok": 1.0,
-                        "cursor": {"id": 0,
-                                   "ns": cmd.get("$db", "") + "."
-                                   + cmd["find"],
-                                   "firstBatch": hit[:1]}})
-                else:
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+
+    def handle(conn):
+        state = {}
+        while True:
+            cmd, rid = read_msg(conn)
+            if cmd is None:
+                break
+            if "saslStart" in cmd:
+                cf = cmd["payload"].decode()
+                bare = cf[3:]  # strip "n,,"
+                fields = dict(p.split("=", 1)
+                              for p in bare.split(","))
+                if fields["n"] != user:
                     send_reply(conn, rid,
-                               {"ok": 0.0, "errmsg": "unknown command"})
+                               {"ok": 0.0, "errmsg": "auth failed"})
+                    continue
+                rnonce = fields["r"] + base64.b64encode(
+                    os_mod.urandom(9)).decode()
+                sfirst = (f"r={rnonce},"
+                          f"s={base64.b64encode(salt).decode()},"
+                          f"i={iters}")
+                state["auth_msg_head"] = bare + "," + sfirst
+                state["rnonce"] = rnonce
+                send_reply(conn, rid, {
+                    "ok": 1.0, "conversationId": 1, "done": False,
+                    "payload": sfirst.encode()})
+            elif "saslContinue" in cmd:
+                fin = cmd["payload"].decode()
+                fields = dict(p.split("=", 1)
+                              for p in fin.split(",", 2)
+                              if "=" in p)
+                proof = base64.b64decode(fields["p"])
+                without_proof = fin[:fin.index(",p=")]
+                auth_msg = (state["auth_msg_head"] + ","
+                            + without_proof).encode()
+                sig = hmac_mod.new(stored, auth_msg,
+                                   hashlib.sha256).digest()
+                ckey = bytes(a ^ b for a, b in zip(proof, sig))
+                if hashlib.sha256(ckey).digest() != stored:
+                    send_reply(conn, rid,
+                               {"ok": 0.0, "errmsg": "auth failed"})
+                    continue
+                v = hmac_mod.new(server_key, auth_msg,
+                                 hashlib.sha256).digest()
+                send_reply(conn, rid, {
+                    "ok": 1.0, "conversationId": 1, "done": True,
+                    "payload": ("v=" + base64.b64encode(v).decode()
+                                ).encode()})
+            elif "find" in cmd:
+                flt = cmd.get("filter") or {}
+                hit = [d for d in docs
+                       if all(d.get(k) == v for k, v in flt.items())]
+                send_reply(conn, rid, {
+                    "ok": 1.0,
+                    "cursor": {"id": 0,
+                               "ns": cmd.get("$db", "") + "."
+                               + cmd["find"],
+                               "firstBatch": hit[:1]}})
+            else:
+                send_reply(conn, rid,
+                           {"ok": 0.0, "errmsg": "unknown command"})
 
     threading.Thread(target=serve, daemon=True).start()
     return srv.getsockname()[1], srv
@@ -1266,3 +1284,65 @@ def test_mysql_binary_param_stays_byte_exact():
     assert lit == "X'" + b"\xffsecret".hex() + "'"
     assert my._escape("plain") == \
         "CONVERT(X'" + b"plain".hex() + "' USING utf8mb4)"
+
+
+def test_client_pool_concurrent_checkout():
+    """The poolboy seat: N clients serve concurrent calls in parallel;
+    exhaustion blocks then errors loudly. Synchronised with events, not
+    sleeps, so a loaded machine cannot flake it."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from vernemq_tpu.plugins.connectors import ClientPool, PoolError
+
+    gate = threading.Event()
+    peak = {"now": 0, "max": 0}
+    lk = threading.Lock()
+
+    class Slow:
+        def __init__(self):
+            self.closed = False
+
+        def work(self):
+            with lk:
+                peak["now"] += 1
+                peak["max"] = max(peak["max"], peak["now"])
+                if peak["now"] == 4:  # all 4 clients checked out at once
+                    gate.set()
+            assert gate.wait(10)
+            with lk:
+                peak["now"] -= 1
+            return "ok"
+
+        def close(self):
+            self.closed = True
+
+    pool = ClientPool(Slow, size=4)
+    with ThreadPoolExecutor(8) as ex:
+        res = [f.result() for f in
+               [ex.submit(pool.work) for _ in range(8)]]
+    assert res == ["ok"] * 8
+    assert peak["max"] == 4  # true parallelism across distinct clients
+
+    # exhaustion: the only client provably held -> loud error, no deadlock
+    hold = threading.Event()
+    held = threading.Event()
+
+    class Holder:
+        def grab(self):
+            held.set()
+            assert hold.wait(10)
+            return True
+
+        def close(self):
+            pass
+
+    tiny = ClientPool(Holder, size=1, checkout_timeout=0.1)
+    with ThreadPoolExecutor(2) as ex:
+        f1 = ex.submit(tiny.grab)
+        assert held.wait(10)  # client is checked out for sure
+        with pytest.raises(PoolError, match="pool exhausted"):
+            tiny.grab()
+        hold.set()
+        assert f1.result() is True
+    pool.close()
+    assert all(c.closed for c in pool._clients)

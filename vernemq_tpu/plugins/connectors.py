@@ -35,15 +35,17 @@ API shape come first.
 
 from __future__ import annotations
 
+import functools
 import hashlib
+import queue
 import socket
 import struct
 import threading
 from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = ["RedisPool", "MemcachedPool", "PostgresPool", "MysqlPool",
-           "MongodbPool", "PoolError", "POOL_REGISTRIES", "ensure_pool",
-           "get_pool", "bson_encode", "bson_decode"]
+           "MongodbPool", "ClientPool", "PoolError", "POOL_REGISTRIES",
+           "ensure_pool", "get_pool", "bson_encode", "bson_decode"]
 
 
 class PoolError(Exception):
@@ -839,6 +841,63 @@ def _pg_text(p) -> str:
     return str(p)
 
 
+# ------------------------------------------------------------- client pools
+
+
+class ClientPool:
+    """N independently-connected clients behind one facade — the poolboy
+    seat of the reference's vmq_diversity pools: auth hooks run on
+    executor threads, and a single socket+lock would serialise every
+    datastore query in the broker. Method calls check a client out of
+    the free queue (blocking up to ``checkout_timeout``), run, and check
+    it back in; non-callable attributes (host/port/...) read through to
+    the first client."""
+
+    def __init__(self, factory, size: int = 5,
+                 checkout_timeout: float = 10.0):
+        self._clients = [factory() for _ in range(max(1, int(size)))]
+        self._free: queue.Queue = queue.Queue()
+        for c in self._clients:
+            self._free.put(c)
+        self._timeout = checkout_timeout
+
+    def _call(self, name, *args, **kw):
+        try:
+            c = self._free.get(timeout=self._timeout)
+        except queue.Empty:
+            raise PoolError(
+                f"pool exhausted: all {len(self._clients)} connections "
+                f"busy for {self._timeout}s") from None
+        try:
+            return getattr(c, name)(*args, **kw)
+        finally:
+            self._free.put(c)
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        attr = getattr(self._clients[0], name)
+        if not callable(attr):
+            return attr
+        wrapper = (self._close_all if name == "close"
+                   else functools.partial(self._call, name))
+        # cache so subsequent lookups skip __getattr__ entirely (this is
+        # the auth-hook hot path)
+        self.__dict__[name] = wrapper
+        return wrapper
+
+    def _close_all(self):
+        for c in self._clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    @property
+    def size(self) -> int:
+        return len(self._clients)
+
+
 # ------------------------------------------------------------ pool registry
 
 #: pool_id → client, per driver kind
@@ -876,6 +935,13 @@ _FACTORIES = {
 }
 
 
+def _build(kind: str, config: Dict[str, Any]):
+    """A ClientPool of ``size`` lazily-connecting clients (the
+    reference's per-pool ``size`` knob; poolboy default 5)."""
+    return ClientPool(lambda: _FACTORIES[kind](config),
+                      size=config.get("size", 5))
+
+
 def ensure_pool(kind: str, config: Dict[str, Any]) -> str:
     """Create (or reuse) a named pool; returns the pool id. Mirrors the
     Lua-visible ``<driver>.ensure_pool{pool_id=...}`` contract."""
@@ -885,7 +951,7 @@ def ensure_pool(kind: str, config: Dict[str, Any]) -> str:
     reg = POOL_REGISTRIES[kind]
     cfg = dict(config)
     if pool_id not in reg:
-        reg[pool_id] = _FACTORIES[kind](config)
+        reg[pool_id] = _build(kind, config)
         POOL_CONFIGS[kind][pool_id] = cfg
     elif POOL_CONFIGS[kind].get(pool_id) != cfg:
         # re-declared with different settings (script reload): rebuild so
@@ -893,7 +959,7 @@ def ensure_pool(kind: str, config: Dict[str, Any]) -> str:
         # reload would report success while the pool silently kept its
         # old connection settings
         old = reg[pool_id]
-        reg[pool_id] = _FACTORIES[kind](config)
+        reg[pool_id] = _build(kind, config)
         POOL_CONFIGS[kind][pool_id] = cfg
         try:
             old.close()
