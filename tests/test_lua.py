@@ -1346,3 +1346,64 @@ def test_client_pool_concurrent_checkout():
         assert f1.result() is True
     pool.close()
     assert all(c.closed for c in pool._clients)
+
+
+# ------------------------------------------------------ robustness / fuzz
+
+
+def test_lua_malformed_input_always_lua_error():
+    """Any malformed script must raise LuaError — never a raw Python
+    exception escaping into the broker's hook machinery. Token-soup and
+    char-soup fuzz plus known runtime-fault shapes."""
+    import random
+    import string as _string
+
+    rng = random.Random(7)
+    tokens = ["if", "then", "end", "for", "do", "while", "function",
+              "return", "local", "(", ")", "{", "}", "[", "]", "=", "==",
+              "..", ",", ";", "+", "-", "*", "/", "%", "#", "not", "and",
+              "or", "x", "y", "42", '"s"', "nil", "true", "[[", "]]",
+              ".", ":", "'q'", "...", "<", "~="]
+    cases = [" ".join(rng.choice(tokens)
+                      for _ in range(rng.randint(1, 12)))
+             for _ in range(400)]
+    cases += ["".join(rng.choice(_string.printable)
+                      for _ in range(rng.randint(1, 60)))
+              for _ in range(400)]
+    cases += [
+        "x = " + "(" * 5000 + "1" + ")" * 5000,   # parser recursion
+        "function f() return f() + 1 end f()",     # runtime recursion
+        "x = {} + 1", "x = #42", "x = nil .. 'a'", "t = {} t.x.y = 1",
+        "x = ('a')()", "for i = 'a', 2 do end", "x = -{}",
+        "t = {} t[nil] = 1", "x = 1 < 'a'",
+        "string.sub()", "string.format('%d')", "table.insert()",
+        # stdlib faults that historically escaped as raw ValueError/
+        # OverflowError/MemoryError (must all become LuaError)
+        "x = math.sqrt(-1)", "x = math.log(0)", "x = math.fmod(1, 0)",
+        "x = math.floor(1/0)", "x = math.ceil(0/0)",
+        "x = string.rep('a', 1e18)", "x = string.char(-1)",
+        "x = string.char(1e9)", "x = tonumber('x', 99)",
+        "x = ('%d'):format('zz')",
+    ]
+    for src in cases:
+        rt = LuaRuntime(max_steps=20_000)
+        try:
+            rt.execute(src)
+        except LuaError:
+            pass  # the only acceptable failure mode
+        # success is fine too (soup can be valid Lua)
+
+
+def test_lua_stack_overflow_is_catchable():
+    rt = LuaRuntime()
+    rt.execute("""
+ok, err = pcall(function()
+    local function f() return f() + 1 end
+    return f()
+end)
+""")
+    assert rt.get_global("ok") is False
+    # bad host-function arity is a pcall-able Lua error too
+    rt.execute("ok2, err2 = pcall(function() return string.sub() end)")
+    assert rt.get_global("ok2") is False
+    assert "host function error" in str(rt.get_global("err2"))

@@ -126,8 +126,11 @@ class LuaScript:
             try:
                 res = self.runtime.call(lua_fn, lua_args)
             except LuaError as e:
+                # exc_info surfaces the chained host-function traceback
+                # (LuaError.__cause__) when the fault is broker-side, not
+                # script-side — see utils/lua.py host-call conversion
                 log.error("lua script %s hook %s: %s", self.path, name,
-                          e.value)
+                          e.value, exc_info=e.__cause__ is not None)
                 raise
             return _convert_result(name, res)
 

@@ -728,19 +728,27 @@ class LuaRuntime:
     # ------------------------------------------------------------- public
 
     def execute(self, src: str, chunkname: str = "script"):
-        toks = _lex(src, chunkname)
-        ast = _Parser(toks, chunkname).parse_chunk()
-        env = _Env()
         try:
+            toks = _lex(src, chunkname)
+            ast = _Parser(toks, chunkname).parse_chunk()
+            env = _Env()
             self._exec_block(ast, env, [])
         except _Return as r:
             return r.values
+        except RecursionError:
+            # pathological nesting/recursion must surface as a Lua error
+            # (hooks run these scripts in-process — a raw RecursionError
+            # would escape the hook error handling)
+            raise LuaError(f"{chunkname}: stack overflow") from None
         return []
 
     def call(self, fn, args: List[Any]) -> List[Any]:
         """Call a Lua (or Python) function value with a Python arg list,
         returning the full result list."""
-        return self._call(fn, list(args), 0)
+        try:
+            return self._call(fn, list(args), 0)
+        except RecursionError:
+            raise LuaError("stack overflow") from None
 
     def get_global(self, name: str):
         return self.globals.get(name)
@@ -775,7 +783,22 @@ class LuaRuntime:
                     return self._call(h, [fn] + args, line)
             raise LuaError(f"attempt to call a table value (line {line})")
         if callable(fn):
-            res = fn(*args)
+            try:
+                res = fn(*args)
+            except (LuaError, _Break, _Return):
+                raise
+            except Exception as e:
+                # any Python fault in a host function (arity TypeError,
+                # math-domain ValueError, OverflowError, MemoryError from
+                # string.rep('a', 1e18), ...) surfaces as a Lua error —
+                # catchable with pcall, never a raw Python exception
+                # escaping into the broker's hook machinery. Chained
+                # `from e` so the original traceback survives on
+                # __cause__ for host-side debugging (a genuine bug in a
+                # connector body is still loggable with exc_info).
+                raise LuaError(
+                    f"host function error (line {line}): "
+                    f"{type(e).__name__}: {e}") from e
             if isinstance(res, tuple):
                 return list(res)
             return [] if res is None else [res]
