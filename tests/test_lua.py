@@ -1362,8 +1362,8 @@ def test_lua_malformed_input_always_lua_error():
     tokens = ["if", "then", "end", "for", "do", "while", "function",
               "return", "local", "(", ")", "{", "}", "[", "]", "=", "==",
               "..", ",", ";", "+", "-", "*", "/", "%", "#", "not", "and",
-              "or", "x", "y", "42", '"s"', "nil", "true", "[[", "]]",
-              ".", ":", "'q'", "...", "<", "~="]
+              "or", "x", "y", "42", "0", "^", "1e308", '"s"', "nil",
+              "true", "[[", "]]", ".", ":", "'q'", "...", "<", "~="]
     cases = [" ".join(rng.choice(tokens)
                       for _ in range(rng.randint(1, 12)))
              for _ in range(400)]
@@ -1384,6 +1384,10 @@ def test_lua_malformed_input_always_lua_error():
         "x = string.rep('a', 1e18)", "x = string.char(-1)",
         "x = string.char(1e9)", "x = tonumber('x', 99)",
         "x = ('%d'):format('zz')",
+        # interpreter-level arithmetic saturation (raw OverflowError
+        # historically escaped _binop)
+        "x = 2 ^ 10000", "x = (-2) ^ 10001", "x = 0 ^ -1",
+        "x = (1/0) % 2", "x = (0/0) % 3", "x = 10 ^ 308 * 10",
     ]
     for src in cases:
         rt = LuaRuntime(max_steps=20_000)

@@ -23,6 +23,9 @@ async def main() -> None:
     ap.add_argument("--qos", type=int, default=0)
     ap.add_argument("--view", default="trie")
     ap.add_argument("--payload", type=int, default=64)
+    ap.add_argument("--window", type=int, default=1,
+                    help="pipelined unacked publishes per publisher "
+                         "(QoS>0; 1 = await each ack)")
     args = ap.parse_args()
 
     if args.view == "tpu":
@@ -54,19 +57,43 @@ async def main() -> None:
         await c.disconnect()
 
     sent = 0
+    failed = 0
 
     async def publisher(i: int) -> None:
-        nonlocal sent
+        nonlocal sent, failed
         c = MQTTClient(server.host, server.port, f"lt-pub{i}")
         await c.connect()
         payload = b"x" * args.payload
         j = 0
+        inflight: set = set()
+
+        def reap(f):
+            inflight.discard(f)
+            if not f.cancelled() and f.exception() is not None:
+                nonlocal failed
+                failed += 1  # acked count excludes this one
+
         while not done.is_set():
-            await c.publish(f"lt/{j % 16}/m{i}", payload, qos=args.qos)
+            if args.qos and args.window > 1:
+                # pipelined QoS1: keep up to `window` unacked publishes
+                # in flight (awaiting each PUBACK serialises the
+                # publisher on broker RTT and measures the client, not
+                # the broker — the reference's inflight-window behavior)
+                fut = asyncio.ensure_future(
+                    c.publish(f"lt/{j % 16}/m{i}", payload, qos=args.qos))
+                inflight.add(fut)
+                fut.add_done_callback(reap)
+                if len(inflight) >= args.window:
+                    await asyncio.wait(
+                        inflight, return_when=asyncio.FIRST_COMPLETED)
+            else:
+                await c.publish(f"lt/{j % 16}/m{i}", payload, qos=args.qos)
             sent += 1
             j += 1
             if j % 64 == 0:
                 await asyncio.sleep(0)  # let the loop breathe
+        if inflight:
+            await asyncio.gather(*inflight, return_exceptions=True)
         await c.disconnect()
 
     subs = [asyncio.create_task(subscriber(i)) for i in range(args.subs)]
@@ -80,9 +107,11 @@ async def main() -> None:
     await b.stop()
     await server.stop()
     # each publish matches subs/16 subscribers on its topic bucket
-    print(f"view={args.view} qos={args.qos} pubs/s={sent/elapsed:.0f} "
+    acked = sent - failed
+    print(f"view={args.view} qos={args.qos} pubs/s={acked/elapsed:.0f} "
           f"deliveries/s={received/elapsed:.0f} "
-          f"(subscribers={args.subs}, publishers={args.pubs})")
+          f"(subscribers={args.subs}, publishers={args.pubs}"
+          + (f", failed={failed}" if failed else "") + ")")
 
 
 if __name__ == "__main__":

@@ -740,6 +740,15 @@ class LuaRuntime:
             # (hooks run these scripts in-process — a raw RecursionError
             # would escape the hook error handling)
             raise LuaError(f"{chunkname}: stack overflow") from None
+        except LuaError:
+            raise
+        except Exception as e:
+            # defense in depth: NOTHING but LuaError may escape the
+            # interpreter into the broker (an interpreter bug must fail
+            # the one script, not the hook machinery); the original
+            # traceback survives on __cause__
+            raise LuaError(f"{chunkname}: internal error: "
+                           f"{type(e).__name__}: {e}") from e
         return []
 
     def call(self, fn, args: List[Any]) -> List[Any]:
@@ -749,6 +758,11 @@ class LuaRuntime:
             return self._call(fn, list(args), 0)
         except RecursionError:
             raise LuaError("stack overflow") from None
+        except LuaError:
+            raise
+        except Exception as e:  # same escape barrier as execute()
+            raise LuaError(f"internal error: {type(e).__name__}: {e}") \
+                from e
 
     def get_global(self, name: str):
         return self.globals.get(name)
@@ -1091,9 +1105,21 @@ class LuaRuntime:
         if o == "%":
             if rn == 0:
                 return _math.nan
-            return ln - _math.floor(ln / rn) * rn
+            try:
+                return ln - _math.floor(ln / rn) * rn
+            except (OverflowError, ValueError):
+                return _math.nan  # inf/nan operand: no integral quotient
         if o == "^":
-            return float(ln) ** float(rn)
+            try:
+                return float(ln) ** float(rn)
+            except OverflowError:
+                # C pow semantics (Lua 5.1): huge results saturate to
+                # ±inf (sign = negative base with odd integer exponent)
+                neg = (ln < 0 and float(rn).is_integer()
+                       and int(rn) % 2 == 1)
+                return -_math.inf if neg else _math.inf
+            except ZeroDivisionError:  # 0 ^ negative
+                return _math.inf
         raise LuaError(f"unknown operator {o}")  # pragma: no cover
 
     @staticmethod
