@@ -1,0 +1,33 @@
+#!/bin/bash
+# Probe the axon tunnel every 5 min; when it answers, fire the bench and
+# tuning sweeps once, recording everything under /tmp/tpu_watch/.
+set -u
+OUT=/tmp/tpu_watch
+mkdir -p "$OUT"
+cd /root/repo
+while true; do
+  if timeout 60 python - <<'EOF' >/dev/null 2>&1
+import jax
+ds = jax.devices()
+assert ds and ds[0].platform != "cpu", ds
+EOF
+  then
+    date > "$OUT/recovered_at"
+    echo "tunnel recovered, running bench" >> "$OUT/log"
+    timeout 1800 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
+    echo "bench rc=$?" >> "$OUT/log"
+    timeout 1200 python tools/tune_windowed.py 1000000 --tp 256 --b 4096 --fm 2 --fa 128 \
+      > "$OUT/tune_flat.txt" 2>&1
+    echo "tune_flat rc=$?" >> "$OUT/log"
+    timeout 1200 python tools/tune_windowed.py 1000000 --tp 256 --b 4096 --fm 2 --fa 128 --rows \
+      > "$OUT/tune_rows.txt" 2>&1
+    echo "tune_rows rc=$?" >> "$OUT/log"
+    timeout 1200 python tools/tune_windowed.py 1000000 --tp 256 --b 4096 --fm 2 --fa 128 --pallas \
+      > "$OUT/tune_pallas.txt" 2>&1
+    echo "tune_pallas rc=$?" >> "$OUT/log"
+    touch "$OUT/DONE"
+    exit 0
+  fi
+  date >> "$OUT/probe_failures"
+  sleep 300
+done
