@@ -4,7 +4,7 @@
 set -u
 OUT=/tmp/tpu_watch
 mkdir -p "$OUT"
-cd /root/repo
+cd /root/repo || exit 1
 while true; do
   if timeout 60 python - <<'EOF' >/dev/null 2>&1
 import jax
