@@ -1,0 +1,244 @@
+// MQTT v3.1.1 wire-codec fast path — CPython extension.
+//
+// Role: the per-frame cost of the pure-Python codec dominates the broker's
+// host delivery path at high fanout (profiled: parse + serialise + wire
+// helpers ~25% of broker CPU under tools/loadtest.py). This module
+// accelerates exactly the two hot shapes — PUBLISH frames and the 2-byte
+// ack family (PUBACK/PUBREC/PUBREL/PUBCOMP) — and *refuses* everything
+// else (returns the FALLBACK sentinel), so the Python codec remains the
+// single source of truth for CONNECT/SUBSCRIBE/... and for every
+// malformed-input error path (identical ParseError behavior; the C side
+// never raises for protocol errors, it just declines).
+//
+// A CPython extension (not a ctypes .so like the other native components):
+// per-call ctypes marshalling costs about as much as the Python code it
+// would replace; the C API call is ~20x cheaper and can build the result
+// objects directly.
+//
+// Reference seam: vmq_parser.erl's zero-copy binary parse/serialise of
+// the same frames (apps/vmq_commons/src/vmq_parser.erl) — this is its
+// native-speed equivalent for the TPU-era broker.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr int PUBLISH = 3;
+constexpr int PUBACK = 4;
+constexpr int PUBREC = 5;
+constexpr int PUBREL = 6;
+constexpr int PUBCOMP = 7;
+constexpr int PINGREQ = 12;
+constexpr int PINGRESP = 13;
+
+// result kinds (first tuple element)
+constexpr long K_MORE = 0;      // need more bytes
+constexpr long K_PUBLISH = 1;   // (1, topic, payload, qos, retain, dup, pid, consumed)
+constexpr long K_ACK = 2;       // (2, ptype, pid, consumed)
+constexpr long K_PING = 4;      // (4, ptype, consumed)
+constexpr long K_FALLBACK = 3;  // let the Python codec handle it
+
+// Decode the remaining-length varint at data[1..]; returns false if more
+// bytes are needed or the varint is invalid/oversized (fallback decides).
+bool decode_varint(const unsigned char* data, Py_ssize_t len,
+                   Py_ssize_t* value, Py_ssize_t* header_len,
+                   bool* invalid) {
+  Py_ssize_t v = 0;
+  int shift = 0;
+  for (Py_ssize_t i = 1; i < len && i <= 4; ++i) {
+    unsigned char b = data[i];
+    v |= static_cast<Py_ssize_t>(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      *value = v;
+      *header_len = i + 1;
+      return true;
+    }
+    shift += 7;
+  }
+  if (len >= 5) *invalid = true;  // 5-byte varint: protocol error
+  return false;
+}
+
+// parse_fast(data: bytes, max_size: int) ->
+//   (K_MORE,) | (K_PUBLISH, ...) | (K_ACK, ...) | (K_PING, ...)
+//   | (K_FALLBACK,)
+PyObject* parse_fast(PyObject*, PyObject* args) {
+  Py_buffer view;
+  Py_ssize_t max_size = 0;
+  if (!PyArg_ParseTuple(args, "y*|n", &view, &max_size)) return nullptr;
+  // contiguous read-only request: y* guarantees C-contiguous
+  struct Releaser {
+    Py_buffer* v;
+    ~Releaser() { PyBuffer_Release(v); }
+  } releaser{&view};
+  const unsigned char* d = static_cast<const unsigned char*>(view.buf);
+  const Py_ssize_t len = view.len;
+  if (len < 2) return Py_BuildValue("(l)", K_MORE);
+
+  const int ptype = d[0] >> 4;
+  const int flags = d[0] & 0x0F;
+  if (ptype != PUBLISH && ptype != PUBACK && ptype != PUBREC &&
+      ptype != PUBREL && ptype != PUBCOMP && ptype != PINGREQ &&
+      ptype != PINGRESP)
+    return Py_BuildValue("(l)", K_FALLBACK);
+
+  Py_ssize_t body_len = 0, header = 0;
+  bool invalid = false;
+  if (!decode_varint(d, len, &body_len, &header, &invalid))
+    return Py_BuildValue("(l)", invalid ? K_FALLBACK : K_MORE);
+  if (max_size > 0 && body_len > max_size)
+    return Py_BuildValue("(l)", K_FALLBACK);  // python raises ParseError
+  if (len - header < body_len) return Py_BuildValue("(l)", K_MORE);
+  const unsigned char* body = d + header;
+  const Py_ssize_t consumed = header + body_len;
+
+  if (ptype == PINGREQ || ptype == PINGRESP) {
+    if (flags != 0 || body_len != 0) return Py_BuildValue("(l)", K_FALLBACK);
+    return Py_BuildValue("(lln)", K_PING, (long)ptype, consumed);
+  }
+
+  if (ptype != PUBLISH) {
+    const int want_flags = (ptype == PUBREL) ? 2 : 0;
+    if (flags != want_flags || body_len != 2)
+      return Py_BuildValue("(l)", K_FALLBACK);
+    const long pid = (body[0] << 8) | body[1];
+    return Py_BuildValue("(llln)", K_ACK, (long)ptype, pid, consumed);
+  }
+
+  // PUBLISH
+  const int dup = (flags & 0x08) ? 1 : 0;
+  const int qos = (flags >> 1) & 0x03;
+  const int retain = flags & 0x01;
+  if (qos == 3) return Py_BuildValue("(l)", K_FALLBACK);  // invalid_qos
+  if (body_len < 2) return Py_BuildValue("(l)", K_FALLBACK);
+  const Py_ssize_t tlen = (body[0] << 8) | body[1];
+  Py_ssize_t pos = 2 + tlen;
+  if (pos > body_len) return Py_BuildValue("(l)", K_FALLBACK);
+  long pid = 0;
+  int has_pid = 0;
+  if (qos > 0) {
+    if (pos + 2 > body_len) return Py_BuildValue("(l)", K_FALLBACK);
+    pid = (body[pos] << 8) | body[pos + 1];
+    pos += 2;
+    has_pid = 1;
+    if (pid == 0) return Py_BuildValue("(l)", K_FALLBACK);  // invalid pid
+  }
+  // NUL bytes are banned in topics (MQTT-1.5.3-2; the python codec's
+  // no_null_allowed) — decline so the python path raises canonically
+  if (std::memchr(body + 2, 0, tlen) != nullptr)
+    return Py_BuildValue("(l)", K_FALLBACK);
+  PyObject* topic = PyUnicode_DecodeUTF8(
+      reinterpret_cast<const char*>(body + 2), tlen, nullptr);
+  if (topic == nullptr) {
+    PyErr_Clear();  // invalid utf-8: python path produces the exact error
+    return Py_BuildValue("(l)", K_FALLBACK);
+  }
+  PyObject* payload = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(body + pos), body_len - pos);
+  if (payload == nullptr) {
+    Py_DECREF(topic);
+    return nullptr;
+  }
+  PyObject* pid_obj;
+  if (has_pid) {
+    pid_obj = PyLong_FromLong(pid);
+  } else {
+    pid_obj = Py_None;
+    Py_INCREF(Py_None);
+  }
+  PyObject* out = Py_BuildValue("(lNNiiiNn)", K_PUBLISH, topic, payload,
+                                qos, retain, dup, pid_obj, consumed);
+  return out;
+}
+
+// serialise_publish(topic: str, payload: bytes, qos, retain, dup,
+//                   packet_id or None) -> bytes (one allocation)
+PyObject* serialise_publish(PyObject*, PyObject* args) {
+  PyObject* topic_obj;
+  const char* payload;
+  Py_ssize_t payload_len;
+  int qos, retain, dup;
+  PyObject* pid_obj;
+  if (!PyArg_ParseTuple(args, "Uy#iiiO", &topic_obj, &payload, &payload_len,
+                        &qos, &retain, &dup, &pid_obj))
+    return nullptr;
+  Py_ssize_t tlen;
+  const char* topic = PyUnicode_AsUTF8AndSize(topic_obj, &tlen);
+  if (topic == nullptr) return nullptr;
+  if (tlen > 65535) {
+    PyErr_SetString(PyExc_ValueError, "topic too long");
+    return nullptr;
+  }
+  const int has_pid = (pid_obj != Py_None);
+  long pid = 0;
+  if (has_pid) {
+    pid = PyLong_AsLong(pid_obj);
+    if (pid == -1 && PyErr_Occurred()) return nullptr;
+    if (pid < 1 || pid > 65535) {
+      // refuse (ValueError): the python wrapper falls back to the pure
+      // codec so the canonical error (OverflowError from to_bytes)
+      // surfaces — never a silently truncated pid on the wire
+      PyErr_SetString(PyExc_ValueError, "packet_id out of range");
+      return nullptr;
+    }
+  }
+  if (qos > 0 && !has_pid) {
+    PyErr_SetString(PyExc_ValueError, "missing_packet_id");
+    return nullptr;
+  }
+  const Py_ssize_t body_len =
+      2 + tlen + (qos > 0 ? 2 : 0) + payload_len;
+  // remaining-length varint
+  unsigned char var[4];
+  int var_len = 0;
+  Py_ssize_t rem = body_len;
+  do {
+    unsigned char b = rem & 0x7F;
+    rem >>= 7;
+    if (rem) b |= 0x80;
+    var[var_len++] = b;
+  } while (rem && var_len < 4);
+  if (rem) {
+    PyErr_SetString(PyExc_ValueError, "frame too large");
+    return nullptr;
+  }
+  PyObject* out =
+      PyBytes_FromStringAndSize(nullptr, 1 + var_len + body_len);
+  if (out == nullptr) return nullptr;
+  unsigned char* w =
+      reinterpret_cast<unsigned char*>(PyBytes_AS_STRING(out));
+  *w++ = static_cast<unsigned char>(
+      (PUBLISH << 4) | (dup ? 0x08 : 0) | ((qos & 3) << 1) |
+      (retain ? 1 : 0));
+  std::memcpy(w, var, var_len);
+  w += var_len;
+  *w++ = static_cast<unsigned char>(tlen >> 8);
+  *w++ = static_cast<unsigned char>(tlen & 0xFF);
+  std::memcpy(w, topic, tlen);
+  w += tlen;
+  if (qos > 0) {
+    *w++ = static_cast<unsigned char>((pid >> 8) & 0xFF);
+    *w++ = static_cast<unsigned char>(pid & 0xFF);
+  }
+  std::memcpy(w, payload, payload_len);
+  return out;
+}
+
+PyMethodDef methods[] = {
+    {"parse_fast", parse_fast, METH_VARARGS,
+     "Parse one v4 frame if it is a hot-path shape; (3,) = fallback."},
+    {"serialise_publish", serialise_publish, METH_VARARGS,
+     "Serialise a v4 PUBLISH frame in one allocation."},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyModuleDef module = {PyModuleDef_HEAD_INIT, "_vmq_codec",
+                      "MQTT v4 wire-codec fast path", -1, methods,
+                      nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__vmq_codec() { return PyModule_Create(&module); }

@@ -1,0 +1,192 @@
+"""Native wire-codec fast path (native/codec.cc): byte-for-byte parity
+with the pure-Python codec on the hot shapes, correct fallback on
+everything else, and identical error behavior (the C side declines
+malformed input so the Python path produces the canonical ParseError).
+"""
+
+import random
+
+import pytest
+
+from vernemq_tpu.protocol import codec_v4 as C
+from vernemq_tpu.protocol.types import (ParseError, Pingreq, Puback,
+                                        Pubcomp, Publish, Pubrec, Pubrel)
+
+pytestmark = pytest.mark.skipif(
+    C._C is None, reason="native codec extension not built")
+
+
+def both_parse(data, max_size=0):
+    """Parse through the native path and the pure-Python path."""
+    native = C.parse(bytes(data), max_size)
+    saved, C._C = C._C, None
+    try:
+        py = C.parse(bytes(data), max_size)
+    finally:
+        C._C = saved
+    return native, py
+
+
+def rand_publish(rng):
+    n = rng.randint(1, 5)
+    topic = "/".join(f"w{rng.randint(0, 50)}" for _ in range(n))
+    qos = rng.randint(0, 2)
+    return Publish(
+        topic=topic,
+        payload=bytes(rng.randbytes(rng.randint(0, 300))),
+        qos=qos, retain=rng.random() < 0.3, dup=qos > 0 and rng.random() < 0.2,
+        packet_id=rng.randint(1, 65535) if qos else None)
+
+
+def test_publish_roundtrip_parity():
+    rng = random.Random(4)
+    for _ in range(300):
+        fr = rand_publish(rng)
+        data = C.serialise(fr)
+        # serialise parity: python serialiser produces identical bytes
+        saved, C._C = C._C, None
+        try:
+            assert C.serialise(fr) == data
+        finally:
+            C._C = saved
+        (nf, nrest), (pf, prest) = both_parse(data + b"tail")
+        assert nf == pf == fr
+        assert bytes(nrest) == bytes(prest) == b"tail"
+
+
+def test_ack_and_ping_parity():
+    for fr in (Puback(packet_id=1), Pubrec(packet_id=65535),
+               Pubrel(packet_id=77), Pubcomp(packet_id=3), Pingreq()):
+        data = C.serialise(fr)
+        (nf, nrest), (pf, prest) = both_parse(data)
+        assert nf == pf == fr
+        assert bytes(nrest) == bytes(prest) == b""
+
+
+def test_incremental_feed_parity():
+    """Byte-at-a-time feeding returns need-more until the frame
+    completes — same boundaries as the Python parser."""
+    fr = Publish(topic="a/b", payload=b"p" * 200, qos=1, packet_id=9)
+    data = C.serialise(fr)
+    for cut in range(len(data)):
+        (nf, _), (pf, _) = both_parse(data[:cut])
+        assert nf is None and pf is None, cut
+    (nf, _), (pf, _) = both_parse(data)
+    assert nf == pf == fr
+
+
+def test_malformed_errors_identical():
+    bad = [
+        bytes([0x30 | 0x06, 2, 0, 0]),           # qos 3
+        bytes([0x32, 4, 0, 1, 97, 0]),           # truncated pid region
+        bytes([0x32, 6, 0, 2, 97, 98, 0, 0]),    # pid 0
+        bytes([0x40, 3, 0, 1, 2]),               # puback wrong length
+        bytes([0x42, 2, 0, 1]),                  # puback wrong flags
+        b"\x30\xff\xff\xff\xff\x01",             # 5-byte varint
+        bytes([0x30, 4, 0, 3, 0xff, 0xfe]),      # invalid utf-8 topic
+    ]
+    for data in bad:
+        n_exc = p_exc = None
+        try:
+            C.parse(data)
+        except ParseError as e:
+            n_exc = str(e)
+        saved, C._C = C._C, None
+        try:
+            try:
+                C.parse(data)
+            except ParseError as e:
+                p_exc = str(e)
+        finally:
+            C._C = saved
+        assert n_exc == p_exc, (data.hex(), n_exc, p_exc)
+
+
+def test_oversize_frame_raises_both_paths():
+    fr = Publish(topic="t", payload=b"x" * 1000, qos=0)
+    data = C.serialise(fr)
+    with pytest.raises(ParseError, match="frame_too_large"):
+        C.parse(data, max_size=100)
+    saved, C._C = C._C, None
+    try:
+        with pytest.raises(ParseError, match="frame_too_large"):
+            C.parse(data, max_size=100)
+    finally:
+        C._C = saved
+
+
+def test_memoryview_zero_copy_rest():
+    fr = Publish(topic="m/v", payload=b"z" * 50, qos=0)
+    data = C.serialise(fr) * 3
+    view = memoryview(data)
+    frames = 0
+    while True:
+        frame, view = C.parse(view)
+        if frame is None:
+            break
+        assert frame.topic == "m/v"
+        frames += 1
+        if not len(view):
+            break
+    assert frames == 3
+
+
+def test_non_hot_frames_fall_back():
+    """CONNECT/SUBSCRIBE/... take the Python path unchanged."""
+    from vernemq_tpu.protocol.types import Connect, SubOpts, Subscribe
+
+    for fr in (Connect(client_id="c1", keepalive=30, clean_start=True),
+               Subscribe(packet_id=5, topics=[("a/#", SubOpts(qos=1))])):
+        data = C.serialise(fr)
+        (nf, _), (pf, _) = both_parse(data)
+        assert nf == pf == fr
+
+
+def test_nul_topic_rejected_identically():
+    # MQTT-1.5.3-2: U+0000 banned in topics — the native path must not
+    # accept what the pure path rejects
+    frame = bytes([0x30, 5, 0, 3]) + b"a\x00b"
+    n_exc = p_exc = None
+    try:
+        C.parse(frame)
+    except ParseError as e:
+        n_exc = str(e)
+    saved, C._C = C._C, None
+    try:
+        try:
+            C.parse(frame)
+        except ParseError as e:
+            p_exc = str(e)
+    finally:
+        C._C = saved
+    assert n_exc == p_exc and n_exc is not None
+
+
+def test_out_of_range_pid_not_truncated():
+    fr = Publish(topic="t", payload=b"", qos=1, packet_id=70000)
+    with pytest.raises(OverflowError):
+        C.serialise(fr)  # same loud error as the pure path, no silent
+    saved, C._C = C._C, None  # truncation to pid 4464 on the wire
+    try:
+        with pytest.raises(OverflowError):
+            C.serialise(fr)
+    finally:
+        C._C = saved
+
+
+def test_oversize_topic_error_contract():
+    fr = Publish(topic="t" * 70000, payload=b"", qos=0)
+    n_exc = p_exc = None
+    try:
+        C.serialise(fr)
+    except Exception as e:
+        n_exc = type(e).__name__
+    saved, C._C = C._C, None
+    try:
+        try:
+            C.serialise(fr)
+        except Exception as e:
+            p_exc = type(e).__name__
+    finally:
+        C._C = saved
+    assert n_exc == p_exc and n_exc not in (None, "ValueError")

@@ -31,7 +31,7 @@ _build_attempted = False
 
 
 _TARGETS = ("libvmq_kvstore.so", "libvmq_counters.so", "libvmq_bcrypt.so",
-            "vmq-passwd")
+            "vmq-passwd", "_vmq_codec.so")
 
 
 def _all_built() -> bool:
@@ -51,8 +51,16 @@ def _ensure_built() -> bool:
         if not os.path.exists(os.path.join(NATIVE_DIR, "Makefile")):
             return False
         try:
-            subprocess.run(["make", "-C", NATIVE_DIR], check=True,
-                           capture_output=True, timeout=120)
+            import sysconfig
+
+            # pin the Python headers to THIS interpreter: PATH's python3
+            # may be a different minor version, and a cross-ABI
+            # _vmq_codec.so would fail to import (silently losing the
+            # codec fast path)
+            subprocess.run(
+                ["make", "-C", NATIVE_DIR,
+                 f"PY_INC={sysconfig.get_paths()['include']}"],
+                check=True, capture_output=True, timeout=120)
         except (OSError, subprocess.SubprocessError) as e:
             log.warning("native build failed, using Python fallbacks: %s", e)
             return False
@@ -73,6 +81,47 @@ def load_library(name: str):
     except OSError as e:
         log.warning("cannot load %s: %s", path, e)
         return None
+
+
+def load_extension(name: str):
+    """Import a CPython extension module from the native build dir, or
+    None. Extensions (vs ctypes libs) are used where per-call
+    marshalling overhead matters — the wire codec's per-frame path."""
+    if os.environ.get("VMQ_NO_NATIVE"):
+        return None
+    if not _ensure_built():
+        return None
+    path = os.path.join(BUILD_DIR, name + ".so")
+    if not os.path.exists(path):
+        return None
+    import importlib.machinery
+    import importlib.util
+
+    def _import():
+        loader = importlib.machinery.ExtensionFileLoader(name, path)
+        spec = importlib.util.spec_from_loader(name, loader)
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        return mod
+
+    try:
+        return _import()
+    except Exception:
+        # stale artifact from another interpreter ABI: rebuild once for
+        # THIS interpreter and retry (otherwise the fast path would stay
+        # silently disabled forever — _ensure_built sees the file exists)
+        try:
+            import sysconfig
+
+            subprocess.run(
+                ["make", "-C", NATIVE_DIR, "-B", os.path.relpath(
+                    path, NATIVE_DIR),
+                 f"PY_INC={sysconfig.get_paths()['include']}"],
+                check=True, capture_output=True, timeout=120)
+            return _import()
+        except Exception as e:  # pragma: no cover - toolchain missing
+            log.warning("cannot import extension %s: %s", path, e)
+            return None
 
 
 def passwd_tool_path() -> str:

@@ -53,8 +53,41 @@ from .types import (
 
 PROTO_NAMES = {PROTO_31: "MQIsdp", PROTO_311: "MQTT"}
 
+# native wire-codec fast path (native/codec.cc): accelerates PUBLISH and
+# the 2-byte ack family — the per-frame hot shapes — and declines
+# everything else, so this module stays the single source of truth for
+# all other frame types and for every malformed-input error. None when
+# no toolchain / VMQ_NO_NATIVE.
+try:
+    from ..native import load_extension as _load_ext
+
+    _C = _load_ext("_vmq_codec")
+except Exception:  # pragma: no cover - import cycle / bad install
+    _C = None
+
+_ACK_CTORS = {PUBACK: Puback, PUBREC: Pubrec, PUBREL: Pubrel,
+              PUBCOMP: Pubcomp}
+
 
 def parse(data: bytes, max_size: int = 0) -> Tuple[Optional[Frame], bytes]:
+    if _C is not None:
+        r = _C.parse_fast(data, max_size)
+        kind = r[0]
+        if kind == 1:  # publish
+            _, topic, payload, qos, retain, dup, pid, consumed = r
+            return Publish(topic=topic, payload=payload, qos=qos,
+                           retain=bool(retain), dup=bool(dup),
+                           packet_id=pid), data[consumed:]
+        if kind == 2:  # puback family
+            _, ptype, pid, consumed = r
+            return _ACK_CTORS[ptype](packet_id=pid), data[consumed:]
+        if kind == 4:  # ping
+            _, ptype, consumed = r
+            return (Pingreq() if ptype == PINGREQ else Pingresp()), \
+                data[consumed:]
+        if kind == 0:  # need more bytes
+            return None, data
+        # kind == 3: not a hot shape (or malformed) — python path below
     split = wire.split_frame(data, max_size)
     if split is None:
         return None, data
@@ -239,11 +272,20 @@ def _parse_unsubscribe(flags: int, body: bytes) -> Unsubscribe:
 def serialise(frame: Frame) -> bytes:
     t = type(frame)
     if t is Publish:
+        if frame.qos and not frame.packet_id:
+            raise ParseError("missing_packet_id")
+        if _C is not None:
+            try:
+                return _C.serialise_publish(
+                    frame.topic, frame.payload, frame.qos,
+                    1 if frame.retain else 0, 1 if frame.dup else 0,
+                    frame.packet_id if frame.qos else None)
+            except ValueError:
+                pass  # C refuses (pid range, topic length, frame size):
+                # the pure path below raises the CANONICAL error type
         if frame.qos == 0:
             pid = b""
         else:
-            if not frame.packet_id:
-                raise ParseError("missing_packet_id")
             pid = frame.packet_id.to_bytes(2, "big")
         flags = (0x08 if frame.dup else 0) | (frame.qos << 1) | (0x01 if frame.retain else 0)
         return wire.fixed_header(PUBLISH, flags, wire.put_utf8(frame.topic) + pid + frame.payload)
