@@ -1,4 +1,4 @@
-// MQTT v3.1.1 wire-codec fast path — CPython extension.
+// MQTT v3.1.1 / v5 wire-codec fast path — CPython extension.
 //
 // Role: the per-frame cost of the pure-Python codec dominates the broker's
 // host delivery path at high fanout (profiled: parse + serialise + wire
@@ -63,13 +63,17 @@ bool decode_varint(const unsigned char* data, Py_ssize_t len,
   return false;
 }
 
-// parse_fast(data: bytes, max_size: int) ->
+// parse_fast(data: bytes, max_size: int = 0, v5: bool = False) ->
 //   (K_MORE,) | (K_PUBLISH, ...) | (K_ACK, ...) | (K_PING, ...)
 //   | (K_FALLBACK,)
+// v5 mode additionally requires an EMPTY property block on PUBLISH and
+// declines pid==0 acks (v5 raises where v4 accepts).
 PyObject* parse_fast(PyObject*, PyObject* args) {
   Py_buffer view;
   Py_ssize_t max_size = 0;
-  if (!PyArg_ParseTuple(args, "y*|n", &view, &max_size)) return nullptr;
+  int v5 = 0;
+  if (!PyArg_ParseTuple(args, "y*|np", &view, &max_size, &v5))
+    return nullptr;
   // contiguous read-only request: y* guarantees C-contiguous
   struct Releaser {
     Py_buffer* v;
@@ -102,10 +106,14 @@ PyObject* parse_fast(PyObject*, PyObject* args) {
   }
 
   if (ptype != PUBLISH) {
+    // hot shape: the 2-byte body (pid only). v5 acks may carry a reason
+    // code + properties — those fall back; a v5 2-byte ack means rc=0.
     const int want_flags = (ptype == PUBREL) ? 2 : 0;
     if (flags != want_flags || body_len != 2)
       return Py_BuildValue("(l)", K_FALLBACK);
     const long pid = (body[0] << 8) | body[1];
+    if (v5 && pid == 0)  // v5 raises invalid_packet_id; v4 accepts
+      return Py_BuildValue("(l)", K_FALLBACK);
     return Py_BuildValue("(llln)", K_ACK, (long)ptype, pid, consumed);
   }
 
@@ -126,6 +134,14 @@ PyObject* parse_fast(PyObject*, PyObject* args) {
     pos += 2;
     has_pid = 1;
     if (pid == 0) return Py_BuildValue("(l)", K_FALLBACK);  // invalid pid
+  }
+  if (v5) {
+    // v5 PUBLISH carries a property block after the pid: the hot shape
+    // is an EMPTY one (single 0x00 length byte); anything else falls
+    // back to the python property parser
+    if (pos >= body_len || body[pos] != 0)
+      return Py_BuildValue("(l)", K_FALLBACK);
+    pos += 1;
   }
   // NUL bytes are banned in topics (MQTT-1.5.3-2; the python codec's
   // no_null_allowed) — decline so the python path raises canonically
@@ -163,8 +179,10 @@ PyObject* serialise_publish(PyObject*, PyObject* args) {
   Py_ssize_t payload_len;
   int qos, retain, dup;
   PyObject* pid_obj;
-  if (!PyArg_ParseTuple(args, "Uy#iiiO", &topic_obj, &payload, &payload_len,
-                        &qos, &retain, &dup, &pid_obj))
+  int v5 = 0;  // v5: append the empty property block (callers only use
+               // this path when frame.properties is empty)
+  if (!PyArg_ParseTuple(args, "Uy#iiiO|p", &topic_obj, &payload,
+                        &payload_len, &qos, &retain, &dup, &pid_obj, &v5))
     return nullptr;
   Py_ssize_t tlen;
   const char* topic = PyUnicode_AsUTF8AndSize(topic_obj, &tlen);
@@ -191,7 +209,7 @@ PyObject* serialise_publish(PyObject*, PyObject* args) {
     return nullptr;
   }
   const Py_ssize_t body_len =
-      2 + tlen + (qos > 0 ? 2 : 0) + payload_len;
+      2 + tlen + (qos > 0 ? 2 : 0) + (v5 ? 1 : 0) + payload_len;
   // remaining-length varint
   unsigned char var[4];
   int var_len = 0;
@@ -224,21 +242,32 @@ PyObject* serialise_publish(PyObject*, PyObject* args) {
     *w++ = static_cast<unsigned char>((pid >> 8) & 0xFF);
     *w++ = static_cast<unsigned char>(pid & 0xFF);
   }
+  if (v5) *w++ = 0;  // empty property block
   std::memcpy(w, payload, payload_len);
   return out;
 }
 
 PyMethodDef methods[] = {
     {"parse_fast", parse_fast, METH_VARARGS,
-     "Parse one v4 frame if it is a hot-path shape; (3,) = fallback."},
+     "Parse one v4/v5 frame if it is a hot-path shape; (3,) = fallback."},
     {"serialise_publish", serialise_publish, METH_VARARGS,
-     "Serialise a v4 PUBLISH frame in one allocation."},
+     "Serialise a v4/v5 PUBLISH frame in one allocation."},
     {nullptr, nullptr, 0, nullptr}};
 
 PyModuleDef module = {PyModuleDef_HEAD_INIT, "_vmq_codec",
-                      "MQTT v4 wire-codec fast path", -1, methods,
+                      "MQTT v4/v5 wire-codec fast path", -1, methods,
                       nullptr, nullptr, nullptr, nullptr};
+
+// Bumped whenever a function signature or result layout changes: the
+// loader refuses an older prebuilt .so (a stale-ABI artifact would
+// otherwise raise TypeError at call time deep inside the parse path).
+constexpr long FASTPATH_VERSION = 2;
 
 }  // namespace
 
-PyMODINIT_FUNC PyInit__vmq_codec() { return PyModule_Create(&module); }
+PyMODINIT_FUNC PyInit__vmq_codec() {
+  PyObject* m = PyModule_Create(&module);
+  if (m != nullptr)
+    PyModule_AddIntConstant(m, "FASTPATH_VERSION", FASTPATH_VERSION);
+  return m;
+}

@@ -190,3 +190,126 @@ def test_oversize_topic_error_contract():
     finally:
         C._C = saved
     assert n_exc == p_exc and n_exc not in (None, "ValueError")
+
+
+# ---------------------------------------------------------------- MQTT 5
+
+
+def both_parse_v5(data, max_size=0):
+    from vernemq_tpu.protocol import codec_v5 as C5
+
+    native = C5.parse(bytes(data), max_size)
+    saved, C5._C = C5._C, None
+    try:
+        py = C5.parse(bytes(data), max_size)
+    finally:
+        C5._C = saved
+    return native, py
+
+
+def test_v5_publish_empty_props_parity():
+    from vernemq_tpu.protocol import codec_v5 as C5
+
+    rng = random.Random(11)
+    for _ in range(200):
+        fr = rand_publish(rng)
+        data = C5.serialise(fr)
+        saved, C5._C = C5._C, None
+        try:
+            assert C5.serialise(fr) == data  # byte-identical serialise
+        finally:
+            C5._C = saved
+        (nf, nrest), (pf, prest) = both_parse_v5(data + b"xx")
+        assert nf == pf
+        assert nf.topic == fr.topic and nf.payload == fr.payload
+        assert nf.properties == {}
+        assert bytes(nrest) == bytes(prest) == b"xx"
+
+
+def test_v5_publish_with_props_falls_back():
+    from vernemq_tpu.protocol import codec_v5 as C5
+
+    fr = Publish(topic="a/b", payload=b"p", qos=1, packet_id=4,
+                 properties={"message_expiry_interval": 30})
+    data = C5.serialise(fr)
+    (nf, _), (pf, _) = both_parse_v5(data)
+    assert nf == pf == fr  # python path parsed the properties
+
+
+def test_v5_acks_parity():
+    from vernemq_tpu.protocol import codec_v5 as C5
+
+    for fr in (Puback(packet_id=3), Pubrel(packet_id=9),
+               Pubrec(packet_id=1), Pubcomp(packet_id=2)):
+        data = C5.serialise(fr)
+        (nf, _), (pf, _) = both_parse_v5(data)
+        assert nf == pf == fr
+    # ack with a reason code: python path
+    rc = Puback(packet_id=5, reason_code=0x87)
+    data = C5.serialise(rc)
+    (nf, _), (pf, _) = both_parse_v5(data)
+    assert nf == pf == rc
+    # v5 pid 0 ack must raise on both paths (v4 would accept)
+    bad = bytes([0x40, 2, 0, 0])
+    for use_native in (True, False):
+        saved = C5._C
+        if not use_native:
+            C5._C = None
+        try:
+            with pytest.raises(ParseError, match="invalid_packet_id"):
+                C5.parse(bad)
+        finally:
+            C5._C = saved
+
+
+def test_differential_fuzz_random_bytes():
+    """Property-style differential test: arbitrary byte strings must
+    produce identical outcomes (frame + rest, need-more, or identical
+    ParseError) through the native and pure parse paths, v4 and v5."""
+    from vernemq_tpu.protocol import codec_v5 as C5
+
+    rng = random.Random(2024)
+    blobs = [bytes(rng.randbytes(rng.randint(0, 40))) for _ in range(4000)]
+    # bias towards plausible frames: valid type nibbles + small lengths
+    for _ in range(4000):
+        t = rng.choice([3, 4, 5, 6, 7, 12, 13]) << 4 | rng.randint(0, 15)
+        body = bytes(rng.randbytes(rng.randint(0, 20)))
+        blobs.append(bytes([t, len(body)]) + body)
+    for blob in blobs:
+        for mod, extra in ((C, ()), (C5, ())):
+            n_out = p_out = n_err = p_err = None
+            try:
+                n_out = mod.parse(blob)
+            except ParseError as e:
+                n_err = str(e)
+            saved, mod._C = mod._C, None
+            try:
+                try:
+                    p_out = mod.parse(blob)
+                except ParseError as e:
+                    p_err = str(e)
+            finally:
+                mod._C = saved
+            assert n_err == p_err, (mod.__name__, blob.hex(), n_err, p_err)
+            if n_out is not None:
+                nf, nrest = n_out
+                pf, prest = p_out
+                assert nf == pf, (mod.__name__, blob.hex())
+                assert bytes(nrest) == bytes(prest)
+
+
+def test_stale_extension_version_rejected():
+    """A prebuilt .so older than REQUIRED_VERSION must not be used (its
+    signatures would TypeError mid-parse); the loader rebuilds once and,
+    if still old, returns None."""
+    from vernemq_tpu.native import load_extension
+
+    mod = load_extension("_vmq_codec",
+                         min_version=10**9)  # impossible version
+    assert mod is None
+    # the normal requirement loads fine
+    from vernemq_tpu.protocol.fastpath import REQUIRED_VERSION
+
+    mod = load_extension("_vmq_codec", min_version=REQUIRED_VERSION)
+    assert mod is not None
+    assert mod.FASTPATH_VERSION >= REQUIRED_VERSION

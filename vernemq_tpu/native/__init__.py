@@ -83,10 +83,15 @@ def load_library(name: str):
         return None
 
 
-def load_extension(name: str):
+def load_extension(name: str, min_version: int = 0,
+                   version_attr: str = "FASTPATH_VERSION"):
     """Import a CPython extension module from the native build dir, or
     None. Extensions (vs ctypes libs) are used where per-call
-    marshalling overhead matters — the wire codec's per-frame path."""
+    marshalling overhead matters — the wire codec's per-frame path.
+    ``min_version`` guards against a stale prebuilt artifact whose
+    function signatures predate the caller (which would TypeError at
+    call time deep inside the hot path): an older module triggers one
+    forced rebuild, and if it is still old, None is returned."""
     if os.environ.get("VMQ_NO_NATIVE"):
         return None
     if not _ensure_built():
@@ -102,6 +107,10 @@ def load_extension(name: str):
         spec = importlib.util.spec_from_loader(name, loader)
         mod = importlib.util.module_from_spec(spec)
         loader.exec_module(mod)
+        if getattr(mod, version_attr, 0) < min_version:
+            raise ImportError(
+                f"{name} is version {getattr(mod, version_attr, 0)}, "
+                f"caller needs >= {min_version}")
         return mod
 
     try:

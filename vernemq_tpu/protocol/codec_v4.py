@@ -53,41 +53,23 @@ from .types import (
 
 PROTO_NAMES = {PROTO_31: "MQIsdp", PROTO_311: "MQTT"}
 
-# native wire-codec fast path (native/codec.cc): accelerates PUBLISH and
-# the 2-byte ack family — the per-frame hot shapes — and declines
-# everything else, so this module stays the single source of truth for
-# all other frame types and for every malformed-input error. None when
-# no toolchain / VMQ_NO_NATIVE.
-try:
-    from ..native import load_extension as _load_ext
+# native wire-codec fast path (native/codec.cc via protocol/fastpath.py):
+# accelerates PUBLISH and the 2-byte ack family — the per-frame hot
+# shapes — and declines everything else, so this module stays the single
+# source of truth for all other frame types and for every
+# malformed-input error. None when no toolchain / VMQ_NO_NATIVE.
+from .fastpath import FALLBACK as _FALLBACK
+from .fastpath import load_native as _load_native
+from .fastpath import parse_native as _parse_native
 
-    _C = _load_ext("_vmq_codec")
-except Exception:  # pragma: no cover - import cycle / bad install
-    _C = None
-
-_ACK_CTORS = {PUBACK: Puback, PUBREC: Pubrec, PUBREL: Pubrel,
-              PUBCOMP: Pubcomp}
+_C = _load_native()
 
 
 def parse(data: bytes, max_size: int = 0) -> Tuple[Optional[Frame], bytes]:
     if _C is not None:
-        r = _C.parse_fast(data, max_size)
-        kind = r[0]
-        if kind == 1:  # publish
-            _, topic, payload, qos, retain, dup, pid, consumed = r
-            return Publish(topic=topic, payload=payload, qos=qos,
-                           retain=bool(retain), dup=bool(dup),
-                           packet_id=pid), data[consumed:]
-        if kind == 2:  # puback family
-            _, ptype, pid, consumed = r
-            return _ACK_CTORS[ptype](packet_id=pid), data[consumed:]
-        if kind == 4:  # ping
-            _, ptype, consumed = r
-            return (Pingreq() if ptype == PINGREQ else Pingresp()), \
-                data[consumed:]
-        if kind == 0:  # need more bytes
-            return None, data
-        # kind == 3: not a hot shape (or malformed) — python path below
+        res = _parse_native(_C, data, max_size, False)
+        if res is not _FALLBACK:
+            return res
     split = wire.split_frame(data, max_size)
     if split is None:
         return None, data

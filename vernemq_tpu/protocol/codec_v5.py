@@ -169,7 +169,24 @@ def serialise_properties(props: Properties) -> bytes:
     return wire.encode_varint(len(out)) + bytes(out)
 
 
+# native fast path for the v5 hot shapes (shared dispatch in
+# protocol/fastpath.py): PUBLISH with an EMPTY property block and 2-byte
+# (rc=0) acks; everything else — properties, reason codes, all other
+# frame types, every malformed-input error — stays on this module's
+# pure-Python parser
+from .fastpath import ACK_CTORS as _ACK_CTORS
+from .fastpath import FALLBACK as _FALLBACK
+from .fastpath import load_native as _load_native
+from .fastpath import parse_native as _parse_native
+
+_C = _load_native()
+
+
 def parse(data: bytes, max_size: int = 0) -> Tuple[Optional[Frame], bytes]:
+    if _C is not None:
+        res = _parse_native(_C, data, max_size, True)
+        if res is not _FALLBACK:
+            return res
     split = wire.split_frame(data, max_size)
     if split is None:
         return None, data
@@ -184,7 +201,7 @@ def _parse_body(ptype: int, flags: int, body: bytes) -> Frame:
         want = 2 if ptype == PUBREL else 0
         if flags != want:
             raise ParseError("malformed_packet")
-        cls = {PUBACK: Puback, PUBREC: Pubrec, PUBREL: Pubrel, PUBCOMP: Pubcomp}[ptype]
+        cls = _ACK_CTORS[ptype]
         pid, pos = wire.take_u16(body, 0)
         if pid == 0:
             raise ParseError("invalid_packet_id")
@@ -379,11 +396,19 @@ def _parse_connect(flags: int, body: bytes) -> Connect:
 def serialise(frame: Frame) -> bytes:
     t = type(frame)
     if t is Publish:
+        if frame.qos and not frame.packet_id:
+            raise ParseError("missing_packet_id")
+        if _C is not None and not frame.properties:
+            try:
+                return _C.serialise_publish(
+                    frame.topic, frame.payload, frame.qos,
+                    1 if frame.retain else 0, 1 if frame.dup else 0,
+                    frame.packet_id if frame.qos else None, True)
+            except ValueError:
+                pass  # C refuses: the pure path raises the canonical error
         if frame.qos == 0:
             pid = b""
         else:
-            if not frame.packet_id:
-                raise ParseError("missing_packet_id")
             pid = frame.packet_id.to_bytes(2, "big")
         flags = (0x08 if frame.dup else 0) | (frame.qos << 1) | (0x01 if frame.retain else 0)
         body = (

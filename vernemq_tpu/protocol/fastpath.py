@@ -1,0 +1,61 @@
+"""Shared dispatch for the native wire-codec fast path (native/codec.cc).
+
+One copy of the kind-dispatch logic serves both protocol codecs (v4 and
+v5 construct the same frame classes from ``types``); each codec calls
+:func:`parse_native` first and falls through to its pure-Python parser
+when the extension is absent or declines the frame. The loader demands
+``REQUIRED_VERSION`` so a stale prebuilt ``_vmq_codec.so`` (older
+function signatures) is rebuilt or rejected instead of raising
+TypeError mid-parse.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from .types import (PINGREQ, PUBACK, PUBCOMP, PUBREC, PUBREL, Frame,
+                    Pingreq, Pingresp, Puback, Pubcomp, Publish, Pubrec,
+                    Pubrel)
+
+#: bump together with FASTPATH_VERSION in native/codec.cc
+REQUIRED_VERSION = 2
+
+ACK_CTORS = {PUBACK: Puback, PUBREC: Pubrec, PUBREL: Pubrel,
+             PUBCOMP: Pubcomp}
+
+#: sentinel: the extension declined — run the pure-Python parser
+FALLBACK = object()
+
+
+def load_native():
+    """The codec extension, version-checked, or None."""
+    try:
+        from ..native import load_extension
+
+        return load_extension("_vmq_codec", min_version=REQUIRED_VERSION)
+    except Exception:  # pragma: no cover - import cycle / bad install
+        return None
+
+
+def parse_native(C, data, max_size: int, v5: bool):
+    """Try the native parse. Returns ``FALLBACK`` when the frame is not
+    a hot shape (the caller's pure parser owns it — including every
+    malformed-input error), else the codec ``parse`` contract:
+    ``(frame | None, rest)``."""
+    r = C.parse_fast(data, max_size, v5)
+    kind = r[0]
+    if kind == 1:  # publish (v5: empty property block)
+        _, topic, payload, qos, retain, dup, pid, consumed = r
+        return Publish(topic=topic, payload=payload, qos=qos,
+                       retain=bool(retain), dup=bool(dup),
+                       packet_id=pid), data[consumed:]
+    if kind == 2:  # 2-byte ack (v5: reason code 0, no properties)
+        _, ptype, pid, consumed = r
+        return ACK_CTORS[ptype](packet_id=pid), data[consumed:]
+    if kind == 4:  # ping
+        _, ptype, consumed = r
+        return (Pingreq() if ptype == PINGREQ else Pingresp()), \
+            data[consumed:]
+    if kind == 0:  # need more bytes
+        return None, data
+    return FALLBACK
