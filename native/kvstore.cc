@@ -183,6 +183,38 @@ int kv_put(Store* s, const uint8_t* key, uint32_t klen, const uint8_t* val,
   return 0;
 }
 
+// Batched put: N records under ONE lock acquisition (the offline write
+// path stores payload + per-subscriber ref + ordered-index entry per
+// message — three records whose per-call lock/append overhead tripled
+// the store cost; the reference amortises the same way with one
+// gen_server call covering the whole 3-key write,
+// vmq_lvldb_store.erl:339-358). keys/vals are packed back to back;
+// klens/vlens give the record boundaries. Returns 0, or -1 on the
+// first failed append (earlier records in the batch remain applied —
+// same partial-failure semantics as N independent puts).
+int kv_put_batch(Store* s, uint32_t n, const uint8_t* keys,
+                 const uint32_t* klens, const uint8_t* vals,
+                 const uint32_t* vlens) {
+  std::lock_guard<std::mutex> g(s->mu);
+  const uint8_t* kp = keys;
+  const uint8_t* vp = vals;
+  for (uint32_t i = 0; i < n; ++i) {
+    std::string k((const char*)kp, klens[i]);
+    kp += klens[i];
+    uint64_t voff;
+    auto it = s->index.find(k);
+    if (it != s->index.end()) {
+      s->garbage += HDR + k.size() + it->second.vlen;
+      s->live -= k.size() + it->second.vlen;
+    }
+    if (!s->append_record(OP_PUT, k, vp, vlens[i], &voff)) return -1;
+    s->index[k] = Entry{voff, vlens[i]};
+    s->live += k.size() + vlens[i];
+    vp += vlens[i];
+  }
+  return 0;
+}
+
 // Returns 1 if found (out/out_len set, caller frees), 0 if missing, -1 error.
 int kv_get(Store* s, const uint8_t* key, uint32_t klen, uint8_t** out,
            uint32_t* out_len) {

@@ -33,6 +33,9 @@ Store* kv_open(const char* path);
 void kv_close(Store* s);
 int kv_put(Store* s, const uint8_t* key, uint32_t klen, const uint8_t* val,
            uint32_t vlen);
+int kv_put_batch(Store* s, uint32_t n, const uint8_t* keys,
+                 const uint32_t* klens, const uint8_t* vals,
+                 const uint32_t* vlens);
 int kv_get(Store* s, const uint8_t* key, uint32_t klen, uint8_t** out,
            uint32_t* outlen);
 int kv_delete(Store* s, const uint8_t* key, uint32_t klen);
@@ -88,6 +91,21 @@ int main() {
         if (kv_get(s, (const uint8_t*)key, klen, &out, &outlen) == 0 && out)
           kv_free(out);
         if (i % 7 == 0) kv_delete(s, (const uint8_t*)key, klen);
+        if (i % 11 == 0) {
+          // batched writes race against the single-put/get/delete
+          // threads on the same store mutex
+          char kb[64];
+          int k1 = std::snprintf(kb, sizeof kb, "b%d-%da", t, i % 50);
+          int k2 = std::snprintf(kb + k1, sizeof kb - k1, "b%d-%db", t,
+                                 i % 50);
+          uint32_t klens[2] = {(uint32_t)k1, (uint32_t)k2};
+          uint32_t vlens[2] = {(uint32_t)vlen, (uint32_t)vlen};
+          char vb[64];
+          std::memcpy(vb, val, vlen);
+          std::memcpy(vb + vlen, val, vlen);
+          kv_put_batch(s, 2, (const uint8_t*)kb, klens,
+                       (const uint8_t*)vb, vlens);
+        }
       }
     });
   }

@@ -529,3 +529,29 @@ def test_tsan_target_exists():
                       "tsan_stress.cc")],
         capture_output=True)
     assert r.returncode == 0, r.stderr.decode()
+
+
+def test_kvstore_put_many_batch(tmp_path):
+    from vernemq_tpu.native.kvstore import KVStore, available
+
+    if not available():
+        import pytest
+        pytest.skip("native kvstore unavailable")
+    kv = KVStore(str(tmp_path / "batch.kv"))
+    pairs = [(f"k{i}".encode(), (f"v{i}" * (i % 7 + 1)).encode())
+             for i in range(500)]
+    kv.put_many(pairs)
+    for k, v in pairs:
+        assert kv.get(k) == v
+    # overwrite inside a batch updates garbage accounting + index
+    kv.put_many([(b"k1", b"new"), (b"k2", b"other"), (b"k1", b"newest")])
+    assert kv.get(b"k1") == b"newest"
+    assert kv.get(b"k2") == b"other"
+    kv.put_many([])  # no-op
+    # durability: reopen and re-read
+    kv.sync(); kv.close()
+    kv2 = KVStore(str(tmp_path / "batch.kv"))
+    assert kv2.get(b"k1") == b"newest"
+    assert kv2.get(b"k499") == pairs[499][1]
+    assert kv2.count() == 500
+    kv2.close()

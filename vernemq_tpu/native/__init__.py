@@ -116,10 +116,16 @@ def load_extension(name: str, min_version: int = 0,
     try:
         return _import()
     except Exception:
-        # stale artifact from another interpreter ABI: rebuild once for
-        # THIS interpreter and retry (otherwise the fast path would stay
-        # silently disabled forever — _ensure_built sees the file exists)
+        # stale artifact (another interpreter ABI, or older signatures
+        # than min_version): rebuild once for THIS interpreter and
+        # retry (otherwise the fast path would stay silently disabled
+        # forever — _ensure_built sees the file exists). CPython caches
+        # single-phase extension modules per (name, path) — a re-import
+        # from the SAME path would return the stale cached module even
+        # after a successful rebuild — so the retry loads the fresh
+        # artifact from a versioned copy at a new path.
         try:
+            import shutil
             import sysconfig
 
             subprocess.run(
@@ -127,6 +133,11 @@ def load_extension(name: str, min_version: int = 0,
                     path, NATIVE_DIR),
                  f"PY_INC={sysconfig.get_paths()['include']}"],
                 check=True, capture_output=True, timeout=120)
+            retry_dir = os.path.join(BUILD_DIR, "abi_retry")
+            os.makedirs(retry_dir, exist_ok=True)
+            fresh = os.path.join(retry_dir, os.path.basename(path))
+            shutil.copy2(path, fresh)
+            path = fresh
             return _import()
         except Exception as e:  # pragma: no cover - toolchain missing
             log.warning("cannot import extension %s: %s", path, e)

@@ -6,6 +6,7 @@ eleveldb occupies in the reference (``vmq_lvldb_store.erl:316-358``)."""
 from __future__ import annotations
 
 import ctypes
+import os
 import threading
 from typing import Iterator, List, Optional, Tuple
 
@@ -15,48 +16,83 @@ _lib = None
 _lib_checked = False
 
 
+def _bind(lib):
+    """Declare every symbol's signature. Raises AttributeError when the
+    loaded artifact predates a symbol (stale build dir) — the caller
+    rebuilds once and retries rather than crashing the first KVStore
+    construction mid-broker-boot."""
+    lib.kv_open.restype = ctypes.c_void_p
+    lib.kv_open.argtypes = [ctypes.c_char_p]
+    lib.kv_close.argtypes = [ctypes.c_void_p]
+    lib.kv_put.restype = ctypes.c_int
+    lib.kv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                   ctypes.c_uint32, ctypes.c_char_p,
+                   ctypes.c_uint32]
+    lib.kv_put_batch.restype = ctypes.c_int
+    lib.kv_put_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint32)]
+    lib.kv_get.restype = ctypes.c_int
+    lib.kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                   ctypes.c_uint32,
+                   ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                   ctypes.POINTER(ctypes.c_uint32)]
+    lib.kv_delete.restype = ctypes.c_int
+    lib.kv_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                      ctypes.c_uint32]
+    lib.kv_scan.restype = ctypes.c_long
+    lib.kv_scan.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                    ctypes.c_uint32,
+                    ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                    ctypes.POINTER(ctypes.c_uint64)]
+    lib.kv_scan_keys.restype = ctypes.c_long
+    lib.kv_scan_keys.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                         ctypes.c_uint32,
+                         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                         ctypes.POINTER(ctypes.c_uint64)]
+    lib.kv_count.restype = ctypes.c_uint64
+    lib.kv_count.argtypes = [ctypes.c_void_p]
+    lib.kv_garbage_bytes.restype = ctypes.c_uint64
+    lib.kv_garbage_bytes.argtypes = [ctypes.c_void_p]
+    lib.kv_sync.restype = ctypes.c_int
+    lib.kv_sync.argtypes = [ctypes.c_void_p]
+    lib.kv_compact.restype = ctypes.c_int
+    lib.kv_compact.argtypes = [ctypes.c_void_p]
+    lib.kv_free.argtypes = [ctypes.c_void_p]
+
+
 def _get_lib():
     global _lib, _lib_checked
     if not _lib_checked:
         _lib_checked = True
         lib = load_library("libvmq_kvstore.so")
         if lib is not None:
-            lib.kv_open.restype = ctypes.c_void_p
-            lib.kv_open.argtypes = [ctypes.c_char_p]
-            lib.kv_close.argtypes = [ctypes.c_void_p]
-            lib.kv_put.restype = ctypes.c_int
-            lib.kv_put.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                   ctypes.c_uint32, ctypes.c_char_p,
-                                   ctypes.c_uint32]
-            lib.kv_get.restype = ctypes.c_int
-            lib.kv_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                   ctypes.c_uint32,
-                                   ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
-                                   ctypes.POINTER(ctypes.c_uint32)]
-            lib.kv_delete.restype = ctypes.c_int
-            lib.kv_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                      ctypes.c_uint32]
-            lib.kv_scan.restype = ctypes.c_long
-            lib.kv_scan.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                    ctypes.c_uint32,
-                                    ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
-                                    ctypes.POINTER(ctypes.c_uint64)]
-            lib.kv_scan_keys.restype = ctypes.c_long
-            lib.kv_scan_keys.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
-                                         ctypes.c_uint32,
-                                         ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
-                                         ctypes.POINTER(ctypes.c_uint64)]
-            lib.kv_count.restype = ctypes.c_uint64
-            lib.kv_count.argtypes = [ctypes.c_void_p]
-            lib.kv_garbage_bytes.restype = ctypes.c_uint64
-            lib.kv_garbage_bytes.argtypes = [ctypes.c_void_p]
-            lib.kv_sync.restype = ctypes.c_int
-            lib.kv_sync.argtypes = [ctypes.c_void_p]
-            lib.kv_compact.restype = ctypes.c_int
-            lib.kv_compact.argtypes = [ctypes.c_void_p]
-            lib.kv_free.argtypes = [ctypes.c_void_p]
+            try:
+                _bind(lib)
+            except AttributeError:
+                # stale prebuilt .so missing a newer symbol: rebuild for
+                # this checkout and reload once, else fall back to the
+                # pure-Python store
+                lib = _rebuild_and_reload()
         _lib = lib
     return _lib
+
+
+def _rebuild_and_reload():
+    import subprocess
+
+    from . import BUILD_DIR, NATIVE_DIR
+
+    try:
+        subprocess.run(["make", "-C", NATIVE_DIR, "-B",
+                        "build/libvmq_kvstore.so"],
+                       check=True, capture_output=True, timeout=120)
+        lib = ctypes.CDLL(os.path.join(BUILD_DIR, "libvmq_kvstore.so"))
+        _bind(lib)
+        return lib
+    except Exception:
+        return None
 
 
 def available() -> bool:
@@ -87,6 +123,24 @@ class KVStore:
     def put(self, key: bytes, value: bytes) -> None:
         if self._lib.kv_put(self._h, key, len(key), value, len(value)) != 0:
             raise KVError("put failed")
+        self._maybe_compact()
+
+    def put_many(self, pairs) -> None:
+        """Write N records under ONE native lock acquisition — the
+        offline path's 3-record message write (payload/ref/idx) and
+        fanout bursts amortise the per-call overhead (the reference's
+        one-gen_server-call-per-write, vmq_lvldb_store.erl:339-358)."""
+        pairs = list(pairs)
+        if not pairs:
+            return
+        n = len(pairs)
+        keys = b"".join(k for k, _ in pairs)
+        vals = b"".join(v for _, v in pairs)
+        klens = (ctypes.c_uint32 * n)(*(len(k) for k, _ in pairs))
+        vlens = (ctypes.c_uint32 * n)(*(len(v) for _, v in pairs))
+        if self._lib.kv_put_batch(self._h, n, keys, klens,
+                                  vals, vlens) != 0:
+            raise KVError("put_batch failed")
         self._maybe_compact()
 
     def get(self, key: bytes) -> Optional[bytes]:

@@ -27,14 +27,24 @@ ACK_CTORS = {PUBACK: Puback, PUBREC: Pubrec, PUBREL: Pubrel,
 FALLBACK = object()
 
 
-def load_native():
-    """The codec extension, version-checked, or None."""
-    try:
-        from ..native import load_extension
+_cached = False
+_native = None
 
-        return load_extension("_vmq_codec", min_version=REQUIRED_VERSION)
-    except Exception:  # pragma: no cover - import cycle / bad install
-        return None
+
+def load_native():
+    """The codec extension, version-checked, or None — memoised so the
+    two codec modules share one load (and at most one rebuild attempt)."""
+    global _cached, _native
+    if not _cached:
+        _cached = True
+        try:
+            from ..native import load_extension
+
+            _native = load_extension("_vmq_codec",
+                                     min_version=REQUIRED_VERSION)
+        except Exception:  # pragma: no cover - import cycle / bad install
+            _native = None
+    return _native
 
 
 def parse_native(C, data, max_size: int, v5: bool):

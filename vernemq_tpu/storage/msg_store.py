@@ -199,14 +199,27 @@ class NativeMsgStore(MsgStore):
     def write(self, sid: SubscriberId, msg: Msg) -> None:
         with self._lock:
             ref = msg.msg_ref
-            if ref not in self._refcount:
-                self._kv.put(b"m\x00" + ref, self._enc(msg))
-                self._refcount[ref] = 0
-            self._refcount[ref] += 1
+            # the 2-3 records of one message write go down in a single
+            # batched append (one native lock acquisition) — the analog
+            # of the reference's one gen_server call covering the whole
+            # 3-key write (vmq_lvldb_store.erl:339-358)
+            batch = []
+            first = ref not in self._refcount
+            if first:
+                batch.append((b"m\x00" + ref, self._enc(msg)))
             sk = self._sid_key(sid)
             seq = self._seq.next()
-            self._kv.put(b"r\x00" + sk + ref, b"")
-            self._kv.put(b"i\x00" + sk + seq.to_bytes(8, "big"), ref)
+            batch.append((b"r\x00" + sk + ref, b""))
+            batch.append((b"i\x00" + sk + seq.to_bytes(8, "big"), ref))
+            # durable records FIRST: if the append fails (disk full), the
+            # in-memory maps are untouched and a retry of the same
+            # msg_ref still writes the payload record — mutating
+            # _refcount first would make a retried first-delivery skip
+            # the m-record forever (silent loss after restart)
+            self._kv.put_many(batch)
+            if first:
+                self._refcount[ref] = 0
+            self._refcount[ref] += 1
             self._seqs.setdefault(sid, {}).setdefault(ref, []).append(seq)
 
     def read_all(self, sid: SubscriberId) -> List[Msg]:
