@@ -167,9 +167,11 @@ void kv_close(Store* s) {
   delete s;
 }
 
-int kv_put(Store* s, const uint8_t* key, uint32_t klen, const uint8_t* val,
-           uint32_t vlen) {
-  std::lock_guard<std::mutex> g(s->mu);
+// overwrite-accounting + append + index update for ONE record; the
+// caller holds s->mu (kv_put takes it per record, kv_put_batch once for
+// the whole batch)
+static int put_one_locked(Store* s, const uint8_t* key, uint32_t klen,
+                          const uint8_t* val, uint32_t vlen) {
   std::string k((const char*)key, klen);
   uint64_t voff;
   auto it = s->index.find(k);
@@ -181,6 +183,12 @@ int kv_put(Store* s, const uint8_t* key, uint32_t klen, const uint8_t* val,
   s->index[k] = Entry{voff, vlen};
   s->live += k.size() + vlen;
   return 0;
+}
+
+int kv_put(Store* s, const uint8_t* key, uint32_t klen, const uint8_t* val,
+           uint32_t vlen) {
+  std::lock_guard<std::mutex> g(s->mu);
+  return put_one_locked(s, key, klen, val, vlen);
 }
 
 // Batched put: N records under ONE lock acquisition (the offline write
@@ -199,17 +207,8 @@ int kv_put_batch(Store* s, uint32_t n, const uint8_t* keys,
   const uint8_t* kp = keys;
   const uint8_t* vp = vals;
   for (uint32_t i = 0; i < n; ++i) {
-    std::string k((const char*)kp, klens[i]);
+    if (put_one_locked(s, kp, klens[i], vp, vlens[i]) != 0) return -1;
     kp += klens[i];
-    uint64_t voff;
-    auto it = s->index.find(k);
-    if (it != s->index.end()) {
-      s->garbage += HDR + k.size() + it->second.vlen;
-      s->live -= k.size() + it->second.vlen;
-    }
-    if (!s->append_record(OP_PUT, k, vp, vlens[i], &voff)) return -1;
-    s->index[k] = Entry{voff, vlens[i]};
-    s->live += k.size() + vlens[i];
     vp += vlens[i];
   }
   return 0;

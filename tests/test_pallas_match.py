@@ -154,3 +154,61 @@ def test_pallas_parity_vs_xla_kernel():
     assert not mp_._pallas_broken
     for topic, rp, rx in zip(topics, gp, gx):
         assert norm(rp) == norm(rx), topic
+
+
+@pytest.mark.asyncio
+async def test_broker_tpu_view_pallas_bucketed(tmp_path):
+    """End-to-end through the broker: a bucketed-scale subscription table
+    served by the TPU reg view with the Pallas probe kernel, over real
+    MQTT — registration via the registry bootstrap (6k filters would be
+    slow to SUBSCRIBE one by one), then live publishes through the
+    batch collector's device path."""
+    from vernemq_tpu.broker import reg as regmod
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    old_probe = regmod._accel_probe_result
+    regmod._accel_probe_result = True  # CPU backend stands in for tests
+    broker = server = sub = pub = None
+    try:
+        broker, server = await start_broker(
+            Config(systree_enabled=False, allow_anonymous=True,
+                   default_reg_view="tpu", tpu_use_pallas=True,
+                   tpu_initial_capacity=8192,  # pre-sized: bucketed layout
+                   tpu_host_batch_threshold=0, tpu_batch_window_us=500),
+            port=0)
+        from vernemq_tpu.protocol.types import SubOpts
+
+        rng = random.Random(31)
+        # bucketed-scale corpus straight through the registry (the same
+        # subscribe path a session uses; events feed both trie and the
+        # device table)
+        for i in range(5000):
+            f = rand_filter(rng)
+            broker.registry.subscribe(("", f"bulk{i}"),
+                                      [(list(f), SubOpts(qos=0))])
+        sub = MQTTClient(server.host, server.port, client_id="live-sub")
+        await sub.connect()
+        await sub.subscribe("w1/w2/#", qos=0)
+        pub = MQTTClient(server.host, server.port, client_id="live-pub")
+        await pub.connect()
+        await pub.publish("w1/w2/w3", b"via-pallas", qos=0)
+        m = await sub.recv(10.0)
+        assert m.payload == b"via-pallas"
+        view = broker.registry.reg_view("tpu")
+        matcher = view.matcher("")
+        assert matcher.use_pallas and not matcher._pallas_broken
+        assert matcher.table.bucketed  # the windowed (pallas) path ran
+        assert matcher.match_batches >= 1
+    finally:
+        # teardown in finally: a failing assert must not leak the
+        # server/clients into subsequent event-loop tests
+        for c in (sub, pub):
+            if c is not None:
+                await c.close()
+        if broker is not None:
+            await broker.stop()
+        if server is not None:
+            await server.stop()
+        regmod._accel_probe_result = old_probe

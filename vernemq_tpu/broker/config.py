@@ -60,6 +60,11 @@ DEFAULTS: Dict[str, Any] = {
     "tpu_max_fanout": 256,
     # flat result-buffer slots per pub, batch-averaged (C = Bpad * this)
     "tpu_flat_avg": 128,
+    # pre-size the device table for a known subscriber scale: growth
+    # rebuilds (repartition + full re-upload) happen at doublings, so an
+    # operator expecting 1M subscriptions boots with the bucketed layout
+    # already in place instead of rebuilding through the ladder
+    "tpu_initial_capacity": 1024,
     # scripting: SQL function wrapping the password in the bundled
     # mysql auth-script query — password | md5 | sha1 | sha256
     # (vmq_diversity_mysql.erl:119-129 hash_method)

@@ -176,6 +176,7 @@ class Registry:
                 self, max_fanout=self.broker.config.tpu_max_fanout,
                 flat_avg=self.broker.config.tpu_flat_avg,
                 use_pallas=self.broker.config.tpu_use_pallas,
+                initial_capacity=self.broker.config.tpu_initial_capacity,
             )
         if view is None:
             raise KeyError(f"unknown reg view {name!r}")
@@ -202,7 +203,9 @@ class Registry:
                     self.reg_views["tpu"] = TpuRegView(
                         self, max_fanout=self.broker.config.tpu_max_fanout,
                         flat_avg=self.broker.config.tpu_flat_avg,
-                        use_pallas=self.broker.config.tpu_use_pallas)
+                        use_pallas=self.broker.config.tpu_use_pallas,
+                        initial_capacity=self.broker.config
+                        .tpu_initial_capacity)
                     log.warning("accelerator recovered; TPU reg view "
                                 "re-enabled")
                     return

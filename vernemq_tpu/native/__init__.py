@@ -125,7 +125,6 @@ def load_extension(name: str, min_version: int = 0,
         # after a successful rebuild — so the retry loads the fresh
         # artifact from a versioned copy at a new path.
         try:
-            import shutil
             import sysconfig
 
             subprocess.run(
@@ -133,15 +132,32 @@ def load_extension(name: str, min_version: int = 0,
                     path, NATIVE_DIR),
                  f"PY_INC={sysconfig.get_paths()['include']}"],
                 check=True, capture_output=True, timeout=120)
-            retry_dir = os.path.join(BUILD_DIR, "abi_retry")
-            os.makedirs(retry_dir, exist_ok=True)
-            fresh = os.path.join(retry_dir, os.path.basename(path))
-            shutil.copy2(path, fresh)
-            path = fresh
+            path = fresh_artifact_copy(path)
             return _import()
         except Exception as e:  # pragma: no cover - toolchain missing
             log.warning("cannot import extension %s: %s", path, e)
             return None
+
+
+def fresh_artifact_copy(path: str) -> str:
+    """Copy a rebuilt native artifact to a UNIQUE new path and return it.
+
+    Two aliasing hazards make reloading from the original path wrong:
+    dlopen dedups by dev/inode (a re-link in place hands back the stale
+    handle — ctypes never dlcloses), and overwriting a fixed retry path
+    would truncate an inode another live process has mmapped (its
+    not-yet-faulted code pages would re-fault from mid-rewrite bytes).
+    A pid+mtime-uniquified filename sidesteps both."""
+    import shutil
+
+    retry_dir = os.path.join(BUILD_DIR, "abi_retry")
+    os.makedirs(retry_dir, exist_ok=True)
+    base = os.path.basename(path)
+    tag = f"{os.getpid()}_{int(os.stat(path).st_mtime_ns)}"
+    fresh = os.path.join(retry_dir, f"{tag}_{base}")
+    if not os.path.exists(fresh):
+        shutil.copy2(path, fresh)
+    return fresh
 
 
 def passwd_tool_path() -> str:

@@ -82,13 +82,17 @@ def _get_lib():
 def _rebuild_and_reload():
     import subprocess
 
-    from . import BUILD_DIR, NATIVE_DIR
+    from . import BUILD_DIR, NATIVE_DIR, fresh_artifact_copy
 
     try:
         subprocess.run(["make", "-C", NATIVE_DIR, "-B",
                         "build/libvmq_kvstore.so"],
                        check=True, capture_output=True, timeout=120)
-        lib = ctypes.CDLL(os.path.join(BUILD_DIR, "libvmq_kvstore.so"))
+        # dlopen dedups by inode and the Makefile relinks in place, so a
+        # same-path CDLL would hand back the STALE handle — load the
+        # rebuilt artifact from a unique copy instead
+        lib = ctypes.CDLL(fresh_artifact_copy(
+            os.path.join(BUILD_DIR, "libvmq_kvstore.so")))
         _bind(lib)
         return lib
     except Exception:
