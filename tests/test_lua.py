@@ -156,6 +156,56 @@ def test_lua_runaway_guard():
         rt.execute("while true do end")
 
 
+def test_lua_step_budget_is_per_invocation():
+    # a long-lived hook runtime must not accumulate steps across calls:
+    # the budget is per top-level execute()/call(), so thousands of
+    # small calls all succeed under a small budget
+    rt = LuaRuntime(max_steps=10_000)
+    rt.execute(
+        "function f() local s = 0 for i = 1, 100 do s = s + i end "
+        "return s end")
+    f = rt.get_global("f")
+    for _ in range(1000):
+        assert rt.call(f, [])[0] == 5050
+    # ... but a single runaway invocation is still caught
+    with pytest.raises(LuaError, match="exceeded"):
+        rt.execute("while true do end")
+    # and the failed run doesn't poison the next one
+    assert rt.call(f, [])[0] == 5050
+
+
+def test_lua_nested_callback_shares_outer_budget():
+    # a Lua callback re-entering the runtime (gsub repl) must not get a
+    # fresh budget: nested entries share the outer invocation's steps
+    rt = LuaRuntime(max_steps=5_000)
+    with pytest.raises(LuaError, match="exceeded"):
+        rt.execute("""
+            s = string.gsub("aaaaaaaaaa", "a", function(c)
+                local x = 0
+                for i = 1, 1000 do x = x + i end
+                return c
+            end)
+        """)
+
+
+def test_lua_step_error_reports_line():
+    rt = LuaRuntime(max_steps=100)
+    with pytest.raises(LuaError, match=r"line 3"):
+        rt.execute("local x = 1\nwhile true do\n  x = x + 1\nend")
+
+
+def test_lua_unsupported_pattern_items_fail_loudly():
+    rt = LuaRuntime()
+    # %b balanced match and () position captures have no regex
+    # translation — they must raise, not silently mis-match
+    for pat in ("%b()", "()%a+"):
+        rt.set_global("p", pat)
+        rt.execute('ok, err = pcall(function() '
+                   'return string.find("x(y)z", p) end)')
+        assert rt.get_global("ok") is False
+        assert "unsupported pattern" in rt.get_global("err")
+
+
 def test_lua_python_roundtrip():
     t = to_lua({"a": 1, "list": [1, "two", {"x": True}], "n": None})
     assert isinstance(t, LuaTable)

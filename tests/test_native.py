@@ -148,6 +148,31 @@ def test_metrics_native_backend():
     assert m.value("custom_metric") == 3
 
 
+def test_metrics_dead_thread_buffers_swept():
+    import threading
+
+    from vernemq_tpu.broker.metrics import Metrics
+
+    m = Metrics(native=True)
+    assert m._native is not None
+
+    def worker():
+        # fewer than _FLUSH_OPS increments: counts stay buffered when
+        # the thread dies
+        m.incr("queue_message_in", 2)
+
+    threads = [threading.Thread(target=worker) for _ in range(20)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # a read folds dead-thread residuals into the native block and
+    # drops the entries — the list must not grow with thread churn
+    assert m.value("queue_message_in") == 40
+    assert len(m._bufs) <= 1  # at most the reading thread's own buffer
+    assert m.value("queue_message_in") == 40  # folded exactly once
+
+
 # ------------------------------------------------------------- passwd tool
 
 def test_passwd_tool_roundtrip(tmp_path):

@@ -102,8 +102,11 @@ class Metrics:
         # these (dict.get is GIL-atomic) on top of the native block, so
         # another thread's buffered increments are visible immediately —
         # buffering bounds ctypes-call frequency, not read freshness,
-        # and nothing is lost if a pool thread goes idle
-        self._bufs: List[Dict[int, int]] = []
+        # and nothing is lost if a pool thread goes idle. Entries carry a
+        # weakref to their owner thread so reads can sweep buffers of
+        # dead threads (fold residuals into the native block once) —
+        # otherwise executor churn grows the list without bound.
+        self._bufs: List[Tuple[object, Dict[int, int]]] = []
         self._bufs_lock = threading.Lock()
         if native:
             try:
@@ -124,10 +127,14 @@ class Metrics:
         tl = self._tl
         buf = getattr(tl, "buf", None)
         if buf is None:
+            import threading
+            import weakref
+
             buf = tl.buf = {}
             tl.ops = 0
             with self._bufs_lock:
-                self._bufs.append(buf)
+                self._bufs.append(
+                    (weakref.ref(threading.current_thread()), buf))
         buf[idx] = buf.get(idx, 0) + n
         tl.ops += 1
         if tl.ops >= self._FLUSH_OPS:
@@ -145,15 +152,49 @@ class Metrics:
             buf.clear()
         tl.ops = 0
 
-    def _pending(self, idx: int) -> int:
-        """Sum of all threads' buffered (not yet natively flushed)
-        increments for one counter — per-key dict.get is GIL-atomic, so
-        this reads other threads' live buffers without locks. A racing
-        flush could briefly double- or under-count by one buffer's worth
-        (< _FLUSH_OPS); monotonic-exact totals land at the next read."""
+    def _swept_pending(
+        self,
+    ) -> Tuple[List[Dict[int, int]], Dict[int, int]]:
+        """Snapshot live threads' buffers, sweeping dead-thread entries
+        (bounds _bufs under executor/thread churn). A dead thread can no
+        longer mutate its buffer, so its residual counts are folded into
+        the native block exactly once AND returned — callers took their
+        native reading before this call, so they must add the residuals
+        themselves to see them this read; later reads get them from the
+        native block. Per-key dict.get on live buffers is GIL-atomic, so
+        other threads' buffers are read without locks; a racing flush
+        could briefly double- or under-count by one buffer's worth
+        (< _FLUSH_OPS) — monotonic-exact totals land at the next read."""
+        live: List[Dict[int, int]] = []
+        residual: Dict[int, int] = {}
         with self._bufs_lock:
-            bufs = list(self._bufs)
-        return sum(b.get(idx, 0) for b in bufs)
+            kept = []
+            for wr, buf in self._bufs:
+                t = wr()
+                if t is not None and t.is_alive():
+                    kept.append((wr, buf))
+                    live.append(buf)
+                else:
+                    for idx, n in list(buf.items()):
+                        residual[idx] = residual.get(idx, 0) + n
+                    buf.clear()
+            self._bufs = kept
+            # fold under the lock: once the entries are gone from
+            # _bufs, a concurrent reader can only see the residuals via
+            # the native block — folding outside the lock would open a
+            # window where a scrape reads a non-monotonic dip
+            if residual:
+                native_incr = self._native.incr
+                for idx, n in residual.items():
+                    native_incr(idx, n)
+        return live, residual
+
+    def _pending(self, idx: int) -> int:
+        """Buffered (not yet natively flushed) increments for one counter
+        that a native reading taken BEFORE this call does not include:
+        live threads' buffers plus just-folded dead-thread residuals."""
+        live, residual = self._swept_pending()
+        return sum(b.get(idx, 0) for b in live) + residual.get(idx, 0)
 
     def value(self, name: str) -> int:
         idx = self._native_idx.get(name)
@@ -189,14 +230,22 @@ class Metrics:
     def drop_rate_state(self, key: object) -> None:
         self._rate_state.pop(key, None)
 
+    def _native_totals(self) -> Dict[str, int]:
+        """Native block snapshot plus every thread's buffered counts —
+        one sweep for the whole scrape (snapshot is taken first, so
+        just-folded dead-thread residuals are added explicitly)."""
+        self._flush_own()
+        snap = self._native.snapshot()
+        live, residual = self._swept_pending()
+        for name, idx in self._native_idx.items():
+            snap[name] += (sum(b.get(idx, 0) for b in live)
+                           + residual.get(idx, 0))
+        return snap
+
     def all_metrics(self) -> Dict[str, float]:
         out: Dict[str, float] = dict(self._counters)
         if self._native is not None:
-            self._flush_own()
-            snap = self._native.snapshot()
-            for name, idx in self._native_idx.items():
-                snap[name] += self._pending(idx)
-            out.update(snap)
+            out.update(self._native_totals())
         for provider in self._gauge_providers:
             out.update(provider())
         return out
@@ -209,11 +258,7 @@ class Metrics:
             gauges.update(provider())
         counters = dict(self._counters)
         if self._native is not None:
-            self._flush_own()
-            snap = self._native.snapshot()
-            for name, idx in self._native_idx.items():
-                snap[name] += self._pending(idx)
-            counters.update(snap)
+            counters.update(self._native_totals())
         for name, val in sorted(counters.items()):
             desc = self._descriptions.get(name, name)
             lines.append(f"# HELP {name} {desc}")

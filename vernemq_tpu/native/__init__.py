@@ -155,6 +155,22 @@ def fresh_artifact_copy(path: str) -> str:
     base = os.path.basename(path)
     tag = f"{os.getpid()}_{int(os.stat(path).st_mtime_ns)}"
     fresh = os.path.join(retry_dir, f"{tag}_{base}")
+    # prune stale copies from dead pids before adding another — repeated
+    # ABI churn would otherwise leak .so files indefinitely (a live pid's
+    # copy may still be mmapped and must survive)
+    for old in os.listdir(retry_dir):
+        if not old.endswith(f"_{base}") or old == os.path.basename(fresh):
+            continue
+        try:
+            pid = int(old.split("_", 1)[0])
+            os.kill(pid, 0)  # raises if the owning process is gone
+        except (ValueError, ProcessLookupError):
+            try:
+                os.unlink(os.path.join(retry_dir, old))
+            except OSError:
+                pass
+        except PermissionError:
+            pass  # pid alive under another uid — keep its copy
     if not os.path.exists(fresh):
         shutil.copy2(path, fresh)
     return fresh

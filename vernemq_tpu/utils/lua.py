@@ -422,7 +422,9 @@ class _Parser:
                 # 5.1: break must end the block; tolerate trailing ';'
                 self.accept(";")
                 return stats
-            stats.append(self.statement())
+            # wrap with the source line so runtime errors (step budget,
+            # runaway loops) can point at real code, not "line 0"
+            stats.append(("@", t.line, self.statement()))
 
     def statement(self):
         t = self.peek()
@@ -722,12 +724,24 @@ class LuaRuntime:
         self.chunk_loader = chunk_loader  # for require()
         self._loaded: Dict[str, Any] = {}
         self._steps = 0
+        # Re-entrancy depth of execute()/call().  The step budget is
+        # per top-level invocation, not per runtime lifetime: a hook
+        # runtime lives for the broker's lifetime and would otherwise
+        # exhaust max_steps cumulatively and deny every later call.
+        # Nested entries (a Lua callback passed back into call() from a
+        # host function, e.g. a gsub repl) share the outer invocation's
+        # budget, so a script can't launder steps through callbacks.
+        self._depth = 0
+        self._line = 0  # source line of the statement being executed
         self.max_steps = max_steps  # runaway-script guard
         self._install_stdlib()
 
     # ------------------------------------------------------------- public
 
     def execute(self, src: str, chunkname: str = "script"):
+        if self._depth == 0:
+            self._steps = 0
+        self._depth += 1
         try:
             toks = _lex(src, chunkname)
             ast = _Parser(toks, chunkname).parse_chunk()
@@ -749,11 +763,16 @@ class LuaRuntime:
             # traceback survives on __cause__
             raise LuaError(f"{chunkname}: internal error: "
                            f"{type(e).__name__}: {e}") from e
+        finally:
+            self._depth -= 1
         return []
 
     def call(self, fn, args: List[Any]) -> List[Any]:
         """Call a Lua (or Python) function value with a Python arg list,
         returning the full result list."""
+        if self._depth == 0:
+            self._steps = 0
+        self._depth += 1
         try:
             return self._call(fn, list(args), 0)
         except RecursionError:
@@ -763,6 +782,8 @@ class LuaRuntime:
         except Exception as e:  # same escape barrier as execute()
             raise LuaError(f"internal error: {type(e).__name__}: {e}") \
                 from e
+        finally:
+            self._depth -= 1
 
     def get_global(self, name: str):
         return self.globals.get(name)
@@ -784,10 +805,14 @@ class LuaRuntime:
             for i, p in enumerate(fn.params):
                 env.vars[p] = args[i] if i < len(args) else None
             varargs = args[len(fn.params):] if fn.is_vararg else []
+            caller_line = self._line  # restore after: loop ticks at the
+            # call site must report the caller's line, not the callee's
             try:
                 self._exec_block(fn.body, env, varargs)
             except _Return as r:
                 return r.values
+            finally:
+                self._line = caller_line
             return []
         if isinstance(fn, LuaTable):
             mt = fn.metatable
@@ -862,8 +887,11 @@ class LuaRuntime:
             self._exec_stat(st, env, varargs)
 
     def _exec_stat(self, st, env, varargs):
+        if st[0] == "@":  # line-annotated wrapper from the parser
+            self._line = st[1]
+            st = st[2]
         op = st[0]
-        self._tick(0)
+        self._tick(self._line)
         if op == "exprstat":
             self._eval_multi(st[1], env, varargs)
         elif op == "assign":
@@ -903,7 +931,7 @@ class LuaRuntime:
         elif op == "while":
             _, cond, body = st
             while _truthy(self._eval(cond, env, varargs)):
-                self._tick(0)
+                self._tick(self._line)
                 try:
                     self._exec_block(body, _Env(env), varargs)
                 except _Break:
@@ -911,7 +939,7 @@ class LuaRuntime:
         elif op == "repeat":
             _, body, cond = st
             while True:
-                self._tick(0)
+                self._tick(self._line)
                 scope = _Env(env)
                 try:
                     self._exec_block(body, scope, varargs)
@@ -928,7 +956,7 @@ class LuaRuntime:
             if step == 0:
                 raise LuaError("'for' step is zero")
             while (step > 0 and i <= stop) or (step < 0 and i >= stop):
-                self._tick(0)
+                self._tick(self._line)
                 scope = _Env(env)
                 scope.vars[name] = i
                 try:
@@ -941,7 +969,7 @@ class LuaRuntime:
             vals = self._eval_explist(exps, env, varargs, 3)
             f, s, ctl = vals[0], vals[1], vals[2]
             while True:
-                self._tick(0)
+                self._tick(self._line)
                 rs = self._call(f, [s, ctl], 0)
                 if not rs or rs[0] is None:
                     break
@@ -1495,6 +1523,11 @@ def _lua_pat_to_re(pat: str) -> str:
                 out.append(_CLASS_MAP[e])
             elif e.isdigit():
                 out.append("\\" + e)  # back-reference
+            elif e in ("b", "f"):
+                # %bxy balanced match / %f frontier have no regex
+                # translation — fail loudly rather than silently match
+                # a literal (decline-don't-guess)
+                raise LuaError(f"unsupported pattern item %{e}")
             else:
                 out.append(_re.escape(e))
             i += 1
@@ -1529,7 +1562,11 @@ def _lua_pat_to_re(pat: str) -> str:
             out.append("[" + ("^" if neg else "") + "".join(setbuf) + "]")
             i = j + 1
         elif c == "(":
-            # () position capture unsupported; plain captures pass through
+            if i + 1 < n and pat[i + 1] == ")":
+                # () position captures return an index, which a regex
+                # group can't express — fail loudly, don't return ""
+                raise LuaError("unsupported pattern item () "
+                               "(position capture)")
             out.append("(")
             i += 1
         elif c == ")":
