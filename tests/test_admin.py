@@ -285,3 +285,67 @@ async def test_webhooks_cli_register_show_deregister(broker):
     assert reg.run(b, ["webhooks", "show"])["table"] == []
     with pytest.raises(CommandError):
         reg.run(b, ["webhooks", "register", "hook=nope", "endpoint=x"])
+
+
+@pytest.mark.asyncio
+async def test_ql_order_by_and_new_tables(broker):
+    """ORDER BY (multi-field, ASC/DESC) + queues/messages row sources
+    (vmq_ql_query.erl:333-337 order_by_key; vmq_info.erl:34-81)."""
+    b, _, _ = broker
+    names = ["zeta", "alpha", "mid"]
+    clients = [await connected(broker, n) for n in names]
+    rows = ql.query(b, "SELECT client_id FROM sessions ORDER BY client_id")
+    assert [r["client_id"] for r in rows] == ["alpha", "mid", "zeta"]
+    rows = ql.query(
+        b, "SELECT client_id FROM sessions ORDER BY client_id DESC LIMIT 2")
+    assert [r["client_id"] for r in rows] == ["zeta", "mid"]
+    # ORDER BY a non-selected field still sorts (reference pulls order
+    # fields into the required set, vmq_ql_query.erl:176-178)
+    rows = ql.query(
+        b, "SELECT is_online FROM sessions ORDER BY client_id")
+    assert len(rows) == 3 and "client_id" not in rows[0]
+
+    # queues table
+    rows = ql.query(b, "SELECT client_id, statename, num_sessions "
+                       "FROM queues ORDER BY client_id")
+    assert [r["client_id"] for r in rows] == ["alpha", "mid", "zeta"]
+    assert all(r["statename"] == "online" and r["num_sessions"] == 1
+               for r in rows)
+
+    # messages table: offline QoS1 backlog rows (persistent session)
+    await clients[0].disconnect()
+    clients[0] = await connected(broker, "zeta", clean_start=False)
+    await clients[0].subscribe("qm/#", qos=1)
+    await clients[0].disconnect()  # zeta offline, persistent
+    pub = await connected(broker, "qm-pub")
+    await pub.publish("qm/a", b"m1", qos=1)
+    await pub.publish("qm/b", b"m2", qos=1)
+    import asyncio as _a
+    await _a.sleep(0.1)
+    rows = ql.query(b, "SELECT routing_key, msg_qos, payload FROM messages "
+                       "WHERE client_id='zeta' ORDER BY routing_key")
+    assert [(r["routing_key"], r["payload"]) for r in rows] == [
+        ("qm/a", "m1"), ("qm/b", "m2")]
+    assert all(r["msg_qos"] == 1 for r in rows)
+    # mixed-type order keys must not TypeError (None user vs str)
+    ql.query(b, "SELECT client_id FROM sessions ORDER BY user, client_id")
+    await pub.disconnect()
+    for c in clients[1:]:
+        await c.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_session_show_order_by_and_ql_command(broker):
+    b, _, _ = broker
+    reg = register_core_commands(CommandRegistry())
+    for n in ("bb", "aa", "cc"):
+        await connected(broker, n)
+    res = reg.run(b, ["session", "show", "order_by=client_id",
+                      "--client_id"])
+    assert [r["client_id"] for r in res["table"]] == ["aa", "bb", "cc"]
+    res = reg.run(b, ["ql", "query",
+                      "q=SELECT client_id FROM queues "
+                      "ORDER BY client_id DESC LIMIT 2"])
+    assert [r["client_id"] for r in res["table"]] == ["cc", "bb"]
+    with pytest.raises(CommandError):
+        reg.run(b, ["ql", "query", "q=SELECT FROM"])

@@ -500,13 +500,13 @@ async def test_migration_zero_loss_mid_drain():
         orig = a.broker.cluster.remote_enqueue
         raced = []
 
-        async def racing_enqueue(node, sid, msgs):
+        async def racing_enqueue(node, sid, msgs, **kw):
             if not raced:
                 raced.append(True)
                 assert q.state == "drain"
                 q.enqueue(Msg(topic=("z", "race"), payload=b"mid-drain",
                               qos=1, mountpoint=""))
-            return await orig(node, sid, msgs)
+            return await orig(node, sid, msgs, **kw)
 
         a.broker.cluster.remote_enqueue = racing_enqueue
         c2 = await connected(b, "zmig", clean_start=False)

@@ -239,16 +239,18 @@ async def test_remote_delete_does_not_resurrect():
     assert s1.get("p", "k") is None
 
 
-def test_persisted_tombstones_reload_and_collect(tmp_path):
+@pytest.mark.parametrize("backend", ["kvstore", "bucketed"])
+def test_persisted_tombstones_reload_and_collect(tmp_path, backend):
     """Tombstones reloaded from disk keep their dot-key-map entries, so
-    watermark GC can still collect them after a restart."""
-    s1 = SWCMetadata("n1", persist_dir=str(tmp_path))
+    watermark GC can still collect them after a restart. Runs on both
+    swc_db backends (the vmq_swc_db.erl engine seam)."""
+    s1 = SWCMetadata("n1", persist_dir=str(tmp_path), db_backend=backend)
     s1.set_peers(["n2"])  # a peer → deletes leave tombstones
     s1.put("p", "k", 1)
     s1.delete("p", "k")
     assert s1.stats()["swc_tombstone_count"] >= 1
     s1.close()
-    s2 = SWCMetadata("n1", persist_dir=str(tmp_path))
+    s2 = SWCMetadata("n1", persist_dir=str(tmp_path), db_backend=backend)
     s2.set_peers(["n2"])
     assert s2.get("p", "k") is None
     # the reloaded dot-key-map still answers sync_missing with delete
@@ -267,7 +269,7 @@ def test_persisted_tombstones_reload_and_collect(tmp_path):
     assert s2.stats()["swc_object_count"] == 0
     s2.close()
     # and the collection survives another reload
-    s3 = SWCMetadata("n1", persist_dir=str(tmp_path))
+    s3 = SWCMetadata("n1", persist_dir=str(tmp_path), db_backend=backend)
     assert s3.stats()["metadata_entries"] == 0
     s3.close()
 
@@ -333,3 +335,35 @@ async def test_swc_partition_heals_via_exchange():
         await sub.close()
     finally:
         await stop_cluster(nodes)
+
+
+def test_swc_db_backend_seam(tmp_path):
+    """Backend selection + unknown-name rejection + bucketed layout
+    actually shards files (cluster/swc_db.py, vmq_swc_db.erl seam)."""
+    import os
+
+    import pytest as _pt
+
+    from vernemq_tpu.cluster.swc_db import open_backend
+
+    b = open_backend("bucketed", str(tmp_path / "b"))
+    for i in range(64):
+        b.put(b"k%d" % i, b"v%d" % i)
+    assert len(b.scan(b"")) == 64
+    assert sorted(b.scan_keys(b"k1"))[0] == b"k1"
+    b.delete(b"k1")
+    assert len(b.scan(b"")) == 63
+    b.close()
+    files = os.listdir(tmp_path / "b")
+    assert sum(1 for f in files if f.endswith(".kv")) >= 2  # sharded
+    with _pt.raises(ValueError, match="unknown swc_db_backend"):
+        open_backend("leveldb-classic", str(tmp_path / "x"))
+
+
+def test_swc_backend_conf_knob():
+    from vernemq_tpu.broker.conf import parse_conf
+
+    assert parse_conf("vmq_swc.db_backend = leveldb") == {
+        "swc_db_backend": "kvstore"}
+    assert parse_conf("swc_db_backend = bucketed") == {
+        "swc_db_backend": "bucketed"}

@@ -155,7 +155,11 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin webhooks show")
     reg.register(["session", "show"], _session_show,
                  "vmq-admin session show [--limit=N] [client_id=X] "
-                 "[--<field>...]")
+                 "[order_by=f1,f2] [--<field>...]")
+    reg.register(["ql", "query"], _ql_query,
+                 "vmq-admin ql query q='SELECT f FROM sessions|queues|"
+                 "subscriptions|messages|retain [WHERE ...] "
+                 "[ORDER BY f [DESC]] [LIMIT n]'")
     reg.register(["queue", "show"], _queue_show,
                  "vmq-admin queue show [--limit=N]")
     reg.register(["subscription", "show"], _subscription_show,
@@ -367,22 +371,44 @@ def _webhooks_show(broker, flags):
 
 
 def _session_show(broker, flags):
-    # vmq_ql-backed in the reference (vmq_info.erl); lazily built rows here
-    from .ql import session_rows
+    # vmq_ql-backed in the reference (vmq_info.erl); shares ql.run_query
+    from .ql import run_query
 
     limit = int(flags.pop("limit", 100))
+    order_raw = flags.pop("order_by", flags.pop("order-by", None))
+    # order_by=f1,f2:desc — same engine (and DESC support) as `ql query`
+    order_by = None
+    if order_raw is not None:
+        order_by = []
+        for part in str(order_raw).split(","):
+            field, _, direction = part.strip().partition(":")
+            order_by.append((field, -1 if direction.lower() == "desc"
+                             else 1))
     # bare --field flags select columns; key=value pairs filter rows
     bare = flags.pop("_bare", [])
     fields = [k for k in bare if k in _SESSION_FIELDS] or list(_SESSION_FIELDS)
     where = {k: v for k, v in flags.items() if v is not BARE}
-    rows = []
-    for row in session_rows(broker):
-        if any(not _loose_eq(row.get(k), v) for k, v in where.items()):
-            continue
-        rows.append({k: row.get(k) for k in fields})
-        if len(rows) >= limit:
-            break
-    return {"table": rows}
+
+    def match(row):
+        return all(_loose_eq(row.get(k), v) for k, v in where.items())
+
+    return {"table": run_query(broker, "sessions", fields, match,
+                               order_by, limit)}
+
+
+def _ql_query(broker, flags):
+    """vmq-admin ql query q='SELECT ... FROM ...' — the raw vmq_ql
+    surface (vmq_ql_query_mgr fold_query)."""
+    from .ql import QLError
+    from .ql import query as ql_query
+
+    q = flags.get("q") or flags.get("query")
+    if not q or q is BARE:
+        raise CommandError("usage: ql query q='SELECT ... FROM sessions'")
+    try:
+        return {"table": ql_query(broker, str(q))}
+    except QLError as e:
+        raise CommandError(f"ql: {e}") from None
 
 
 def _queue_show(broker, flags):

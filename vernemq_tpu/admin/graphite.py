@@ -24,19 +24,32 @@ class GraphiteReporter:
     async def _run(self) -> None:
         cfg = self.broker.config
         writer: Optional[asyncio.StreamWriter] = None
+        # connect/reconnect pacing follows the graphite_*_timeout knobs
+        # (vmq_graphite.erl connect_timeout / reconnect backoff)
+        connect_timeout = float(cfg.get("graphite_connect_timeout", 5.0))
+        reconnect_wait = float(cfg.get("graphite_reconnect_timeout", 10.0))
+        if cfg.graphite_interval <= 0:
+            return  # 0 = disabled (reference schema graphite_interval)
         while True:
             await asyncio.sleep(cfg.graphite_interval)
             if writer is None:
                 try:
                     _, writer = await asyncio.wait_for(
                         asyncio.open_connection(cfg.graphite_host,
-                                                cfg.graphite_port), 5.0)
+                                                cfg.graphite_port),
+                        connect_timeout)
                 except (OSError, asyncio.TimeoutError) as e:
                     log.debug("graphite connect failed: %s", e)
+                    await asyncio.sleep(
+                        max(0.0, reconnect_wait - cfg.graphite_interval))
                     continue
             prefix = cfg.graphite_prefix
             if prefix and not prefix.endswith("."):
                 prefix += "."
+            # hosted-graphite API key is the leading path segment
+            api_key = cfg.get("graphite_api_key", "")
+            if api_key:
+                prefix = f"{api_key}.{prefix}"
             node = self.broker.node_name
             now = int(time.time())
             lines = [

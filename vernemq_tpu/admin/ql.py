@@ -61,10 +61,54 @@ def retain_rows(broker) -> Iterator[Dict[str, Any]]:
                "payload_size": len(rm.payload), "qos": rm.qos}
 
 
+def queue_rows(broker) -> Iterator[Dict[str, Any]]:
+    """Queue-level rows without the session join (the reference's
+    ``queues`` table over queue_base, vmq_info.erl:34-50)."""
+    for sid, queue in list(broker.registry.queues.items()):
+        mountpoint, client_id = sid
+        yield {
+            "client_id": client_id,
+            "mountpoint": mountpoint,
+            "node": broker.node_name,
+            "statename": queue.state,
+            "queue_size": len(queue.offline),
+            "offline_messages": len(queue.offline),
+            "online_messages": sum(
+                len(getattr(s, "inflight", ())) for s in queue.sessions),
+            "deliver_mode": queue.opts.deliver_mode,
+            "is_offline": queue.state == "offline",
+            "is_online": queue.state != "offline",
+            "num_sessions": len(queue.sessions),
+            "clean_session": queue.opts.clean_session,
+            "started_at": queue.created,
+        }
+
+
+def message_rows(broker) -> Iterator[Dict[str, Any]]:
+    """Offline message rows (the reference's ``message_refs`` +
+    ``messages`` tables, vmq_info.erl:69-81)."""
+    for sid, queue in list(broker.registry.queues.items()):
+        mountpoint, client_id = sid
+        for msg in list(queue.offline):
+            yield {
+                "client_id": client_id,
+                "mountpoint": mountpoint,
+                "node": broker.node_name,
+                "msg_ref": msg.msg_ref.hex(),
+                "msg_qos": msg.qos,
+                "routing_key": "/".join(msg.topic),
+                "dup": msg.dup,
+                "payload": msg.payload.decode("latin1"),
+                "payload_size": len(msg.payload),
+            }
+
+
 TABLES: Dict[str, Callable[[Any], Iterator[Dict[str, Any]]]] = {
     "sessions": session_rows,
     "subscriptions": subscription_rows,
     "retain": retain_rows,
+    "queues": queue_rows,
+    "messages": message_rows,
 }
 
 
@@ -72,7 +116,7 @@ TABLES: Dict[str, Callable[[Any], Iterator[Dict[str, Any]]]] = {
 
 _TOKEN = re.compile(r"""
     \s*(?:
-      (?P<kw>SELECT|FROM|WHERE|LIMIT|AND|OR|NOT)\b
+      (?P<kw>SELECT|FROM|WHERE|ORDER\s+BY|ASC|DESC|LIMIT|AND|OR|NOT)\b
     | (?P<op><=|>=|!=|=|<|>)
     | (?P<num>-?\d+(?:\.\d+)?)
     | (?P<str>"[^"]*"|'[^']*')
@@ -98,7 +142,7 @@ def _tokenize(text: str) -> List[tuple]:
             v = m.group(kind)
             if v is not None:
                 if kind == "kw":
-                    v = v.upper()
+                    v = re.sub(r"\s+", " ", v).upper()
                 if kind == "str":
                     v = v[1:-1]
                 if kind == "num":
@@ -221,10 +265,27 @@ def parse(text: str) -> Dict[str, Any]:
     if kind != "word":
         raise QLError("expected table name")
     where: Optional[Callable[[Dict], bool]] = None
+    order_by: List[tuple] = []
     limit = None
     if p.peek() == ("kw", "WHERE"):
         p.next()
         where = p.expr()
+    if p.peek() == ("kw", "ORDER BY"):
+        # field list with per-field ASC/DESC (vmq_ql_query.erl:333-337
+        # orders by the field-value tuple; DESC is a superset)
+        p.next()
+        while True:
+            kind, f = p.next()
+            if kind not in ("word", "str"):
+                raise QLError(f"bad ORDER BY field: {f!r}")
+            direction = 1
+            if p.peek() in (("kw", "ASC"), ("kw", "DESC")):
+                direction = -1 if p.next()[1] == "DESC" else 1
+            order_by.append((str(f), direction))
+            if p.peek() == ("punc", ","):
+                p.next()
+                continue
+            break
     if p.peek() == ("kw", "LIMIT"):
         p.next()
         kind, limit = p.next()
@@ -233,26 +294,71 @@ def parse(text: str) -> Dict[str, Any]:
     if p.peek() != (None, None):
         raise QLError(f"trailing tokens: {p.peek()[1]!r}")
     return {"fields": fields, "table": str(table).lower(), "where": where,
+            "order_by": order_by,
             "limit": int(limit) if limit is not None else None}
+
+
+def _sort_key(v: Any) -> tuple:
+    """Total order over heterogeneous row values (None < bool < number
+    < str < other) so ORDER BY never TypeErrors on mixed columns."""
+    if v is None:
+        return (0, 0)
+    if isinstance(v, bool):
+        return (1, int(v))
+    if isinstance(v, (int, float)):
+        return (2, float(v))
+    if isinstance(v, str):
+        return (3, v)
+    return (4, str(v))
+
+
+def run_query(broker, table: str, fields: Optional[List[str]] = None,
+              where: Optional[Callable[[Dict], bool]] = None,
+              order_by: Optional[List[tuple]] = None,
+              limit: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Filter/sort/project rows from one table — the shared engine
+    behind :func:`query` and the admin commands (``session show``).
+    ``order_by`` is ``[(field, direction)]`` with direction 1/-1; order
+    fields are pulled from the full row, so sorting works even when
+    they're not selected."""
+    init = TABLES.get(table)
+    if init is None:
+        raise QLError(f"unknown table {table!r}; "
+                      f"tables: {', '.join(sorted(TABLES))}")
+    order_by = order_by or []
+    out: List[Dict[str, Any]] = []
+    for row in init(broker):
+        # with ORDER BY every matching row must be seen before the cut
+        if not order_by and limit is not None and len(out) >= limit:
+            break
+        if where is not None and not where(row):
+            continue
+        if fields:
+            proj = {f: row.get(f) for f in fields}
+            if order_by:
+                proj["__sort__"] = tuple(row.get(f) for f, _ in order_by)
+            out.append(proj)
+        else:
+            out.append(dict(row))
+    if order_by:
+        # per-field direction: stable multi-pass sort, last key first
+        for idx, (field, direction) in reversed(list(enumerate(order_by))):
+            if fields:
+                out.sort(key=lambda r, i=idx: _sort_key(r["__sort__"][i]),
+                         reverse=direction < 0)
+            else:
+                out.sort(key=lambda r, f=field: _sort_key(r.get(f)),
+                         reverse=direction < 0)
+        for r in out:
+            r.pop("__sort__", None)
+        if limit is not None:
+            out = out[:limit]
+    return out
 
 
 def query(broker, text: str) -> List[Dict[str, Any]]:
     """Run a QL query against live broker state (fold_query equivalent,
     vmq_ql_query_mgr)."""
     q = parse(text)
-    init = TABLES.get(q["table"])
-    if init is None:
-        raise QLError(f"unknown table {q['table']!r}; "
-                      f"tables: {', '.join(sorted(TABLES))}")
-    out: List[Dict[str, Any]] = []
-    limit = q["limit"]
-    for row in init(broker):
-        if limit is not None and len(out) >= limit:
-            break
-        if q["where"] is not None and not q["where"](row):
-            continue
-        if q["fields"]:
-            out.append({f: row.get(f) for f in q["fields"]})
-        else:
-            out.append(dict(row))
-    return out
+    return run_query(broker, q["table"], q["fields"], q["where"],
+                     q["order_by"], q["limit"])

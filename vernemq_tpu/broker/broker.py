@@ -39,6 +39,7 @@ class Broker:
     def __init__(self, config: Optional[Config] = None, node_name: str = "node1"):
         self.config = config or Config()
         self.node_name = node_name
+        self._resolve_base_dirs()
         self.metrics = Metrics()
         self.hooks = HookRegistry()
         from ..plugins import PluginManager
@@ -57,7 +58,8 @@ class Broker:
             self.metadata = SWCMetadata(
                 node_name, persist_dir=persist_dir,
                 n_groups=self.config.get("swc_replication_groups", 8),
-                sync_interval=self.config.get("swc_sync_interval", 2.0))
+                sync_interval=self.config.get("swc_sync_interval", 2.0),
+                db_backend=self.config.get("swc_db_backend", "kvstore"))
         else:
             from ..cluster.metadata import MetadataStore
 
@@ -187,6 +189,11 @@ class Broker:
             await session.takeover_close()
         backlog = queue.start_drain()
         step = self.config.max_msgs_per_drain_step
+        # retry/settle delay between drain steps (vmq_server.schema
+        # max_drain_time, ms): the reference re-arms drain_start after
+        # DrainTimeout on a failed step (vmq_queue.erl:365-368); the ack
+        # timeout itself stays remote_enqueue_timeout
+        drain_retry_delay = self.config.get("max_drain_time", 500) / 1000.0
         max_retries = self.config.get("migrate_drain_retries", 60)
         state = self.migrations.setdefault(
             sid, {"target": new_node, "retries": 0, "state": "draining"})
@@ -245,7 +252,7 @@ class Broker:
                           "%d msgs restored to the local offline queue",
                           sid, new_node, max_retries, len(backlog))
                 return
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(drain_retry_delay)
             rec = self.registry.db.read(sid)
             if rec is None or rec.node == self.node_name:
                 # moved back / cleaned up: restore what's left locally
@@ -397,15 +404,42 @@ class Broker:
             )
         return self._collector
 
+    def _resolve_base_dirs(self) -> None:
+        """Honor the setup.data_dir / setup.log_dir release knobs
+        (vmq_server.schema setup.* tree): relative storage paths resolve
+        under data_dir, a bare log filename under log_dir."""
+        import os as _os
+
+        data_dir = self.config.get("data_dir", "")
+        if data_dir:
+            for key in ("message_store_dir", "metadata_dir"):
+                path = self.config.get(key, "")
+                if path and not _os.path.isabs(path):
+                    self.config.set(
+                        key,
+                        _os.path.normpath(_os.path.join(data_dir, path)))
+        log_dir = self.config.get("log_dir", "")
+        log_file = self.config.get("log_file", "")
+        if log_dir and log_file and not _os.path.isabs(log_file):
+            self.config.set("log_file", _os.path.join(log_dir, log_file))
+
     async def start_systree(self) -> None:
         """$SYS tree publisher (vmq_systree.erl): periodic internal publish
-        of all metrics to $SYS/<node>/... topics."""
+        of all metrics to $SYS/<node>/... topics. Mountpoint, QoS and
+        retain flag follow the systree_* knobs (vmq_server.schema
+        systree_mountpoint/qos/retain)."""
         interval = self.config.systree_interval
+        if interval <= 0:
+            return  # 0 = disabled (reference schema systree_interval)
+        mountpoint = self.config.get("systree_mountpoint", "")
+        qos = min(max(int(self.config.get("systree_qos", 0)), 0), 2)
+        retain = bool(self.config.get("systree_retain", False))
         while True:
             await asyncio.sleep(interval)
             for name, value in self.metrics.all_metrics().items():
                 topic = ("$SYS", self.node_name, *name.split("_"))
-                msg = Msg(topic=topic, payload=str(value).encode(), qos=0)
+                msg = Msg(topic=topic, payload=str(value).encode(),
+                          qos=qos, retain=retain, mountpoint=mountpoint)
                 try:
                     self.registry.publish(msg)
                 except RuntimeError:

@@ -1,20 +1,25 @@
 """``vernemq.conf``-style configuration file loader.
 
 The reference translates a flat ``key = value`` file through cuttlefish
-schemas (``apps/vmq_server/priv/vmq_server.schema``, 217 mappings) into app
-envs. This loader keeps the same operator surface — the same knob names,
-``on``/``off`` flags, ``listener.<kind>.<name>`` tree, ``plugins.<name>``
-switches — mapped onto :class:`~vernemq_tpu.broker.config.Config` without
-the schema-compiler machinery: values are coerced to the type of the
-matching ``DEFAULTS`` entry.
+schemas (``apps/vmq_server/priv/vmq_server.schema``, 217 mappings) into
+app envs. This loader keeps the same operator surface — the same knob
+names, ``on``/``off`` flags, the full ``listener.*`` tree (global, kind
+and per-name option scopes), ``plugins.<name>`` switches, duration
+strings (``1w``), millisecond-typed intervals — mapped onto
+:class:`~vernemq_tpu.broker.config.Config` without the schema-compiler
+machinery. The mapping classification (aliases, unit conversions,
+deliberate gaps, compat no-ops) lives in
+:mod:`vernemq_tpu.broker.schema`; every documented reference conf line
+either works or errors with a reason.
 
 Grammar (one setting per line)::
 
     # comment                     (also '%%' like the reference's erlang-isms)
     allow_anonymous = off
-    listener.tcp.default = 127.0.0.1:1883
-    listener.tcp.default.proxy_protocol = on
-    listener.ssl.default = 0.0.0.0:8883
+    listener.max_connections = 10000          # global default
+    listener.tcp.proxy_protocol = on          # kind-level default
+    listener.tcp.default = 127.0.0.1:1883     # instance address
+    listener.tcp.default.allowed_protocol_versions = 3,4,5
     listener.ssl.default.certfile = /etc/ssl/cert.pem
     plugins.vmq_passwd = on
     vmq_passwd.password_file = /etc/vmq.passwd
@@ -26,15 +31,17 @@ Listener kinds follow ``vmq_ranch_config.erl:224-227``: ``tcp``/``ssl``
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Tuple
+import logging
+from typing import Any, Dict, List, Optional, Tuple
 
+from . import schema
 from .config import DEFAULTS, Config
 
-# conf-file listener kind -> ListenerManager kind
-LISTENER_KINDS = {
-    "tcp": "mqtt", "ssl": "mqtts", "ws": "ws", "wss": "wss",
-    "http": "http", "https": "https", "vmq": "vmq", "vmqs": "vmqs",
-}
+log = logging.getLogger(__name__)
+
+# conf-file listener kind -> ListenerManager kind (single source:
+# schema.INTERNAL_KINDS, shared with the key classifier)
+LISTENER_KINDS = schema.INTERNAL_KINDS
 
 # plugin-opt spellings from the reference schemas -> our enable() kwargs
 _PLUGIN_OPT_ALIASES = {
@@ -47,11 +54,6 @@ _PLUGIN_OPT_ALIASES = {
 _METADATA_IMPLS = {"vmq_plumtree": "lww", "vmq_swc": "swc",
                    "lww": "lww", "swc": "swc"}
 
-# reference vernemq.conf spellings -> our DEFAULTS names
-_KEY_ALIASES = {
-    "message_size_limit": "max_message_size",  # vmq_server.schema:62
-}
-
 
 class ConfError(ValueError):
     def __init__(self, lineno: int, line: str, why: str):
@@ -59,9 +61,23 @@ class ConfError(ValueError):
         self.lineno = lineno
 
 
+def _strip_listish(raw: str) -> str:
+    """The reference writes list values as erlang lists
+    (``[vmq_metrics_http, vmq_status_http]``); tolerate the brackets."""
+    s = raw.strip()
+    if s.startswith("[") and s.endswith("]"):
+        s = s[1:-1]
+    return s
+
+
 def _coerce(key: str, raw: str, lineno: int, line: str) -> Any:
     """Coerce ``raw`` to the type of ``DEFAULTS[key]`` (cuttlefish's
-    datatype step)."""
+    datatype step), honoring the schema layer's unit conversions."""
+    if key in schema.DURATION_KEYS:
+        try:
+            return schema.parse_duration(raw)
+        except ValueError as e:
+            raise ConfError(lineno, line, str(e)) from None
     proto = DEFAULTS[key]
     if isinstance(proto, bool):
         low = raw.lower()
@@ -74,14 +90,31 @@ def _coerce(key: str, raw: str, lineno: int, line: str) -> Any:
         try:
             return int(raw)
         except ValueError:
-            raise ConfError(lineno, line, f"expected integer for {key}") from None
+            raise ConfError(lineno, line,
+                            f"expected integer for {key}") from None
     if isinstance(proto, float):
         try:
             return float(raw)
         except ValueError:
-            raise ConfError(lineno, line, f"expected number for {key}") from None
+            raise ConfError(lineno, line,
+                            f"expected number for {key}") from None
     if isinstance(proto, list):
-        return [p.strip() for p in raw.split(",") if p.strip()]
+        items = [p.strip() for p in _strip_listish(raw).split(",")
+                 if p.strip()]
+        if key == "http_modules":
+            items = [schema.HTTP_MODULE_ALIASES.get(m, m) for m in items]
+        elif key == "reg_views":
+            out = []
+            for m in items:
+                v = schema.REG_VIEW_ALIASES.get(m)
+                if v is None:
+                    raise ConfError(
+                        lineno, line,
+                        f"unknown reg view {m!r} (valid: "
+                        f"{', '.join(sorted(schema.REG_VIEW_ALIASES))})")
+                out.append(v)
+            items = out
+        return items
     return raw
 
 
@@ -95,11 +128,29 @@ def _host_port(raw: str, lineno: int, line: str) -> Tuple[str, int]:
         raise ConfError(lineno, line, "bad port") from None
 
 
+def _listener_opt_value(opt: str, value: str) -> Any:
+    if opt == "allowed_protocol_versions":
+        return [int(v) for v in _strip_listish(value).split(",")
+                if v.strip()]
+    if opt in schema.INT_LISTENER_OPTS:
+        return int(value)  # ValueError -> ConfError in the caller
+    if value.lower() in ("on", "true"):
+        return True
+    if value.lower() in ("off", "false"):
+        return False
+    try:
+        return int(value)
+    except ValueError:
+        return value
+
+
 def parse_conf(text: str) -> Dict[str, Any]:
     """Parse conf text into Config kwargs (including the ``listeners`` and
     ``plugins`` structured keys)."""
     settings: Dict[str, Any] = {}
     listeners: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    global_opts: Dict[str, Any] = {}
+    kind_opts: Dict[str, Dict[str, Any]] = {}
     plugins: Dict[str, Dict[str, Any]] = {}
     plugin_opts: Dict[str, Dict[str, Any]] = {}
 
@@ -126,31 +177,54 @@ def parse_conf(text: str) -> Dict[str, Any]:
             value = value.split(" #", 1)[0].strip()
 
         if key.startswith("listener."):
-            parts = key.split(".")
-            if len(parts) < 3 or parts[1] not in LISTENER_KINDS:
+            try:
+                scope, kind, name, opt = schema.classify_listener_key(key)
+            except KeyError as e:
+                raise ConfError(lineno, line, e.args[0]) from None
+            try:
+                if scope == "global-opt":
+                    global_opts[opt] = _listener_opt_value(opt, value)
+                elif scope == "kind-opt":
+                    kind_opts.setdefault(kind, {})[opt] = \
+                        _listener_opt_value(opt, value)
+                elif scope == "addr":
+                    ent = listeners.setdefault((kind, name), {"opts": {}})
+                    ent["addr"], ent["port"] = _host_port(value, lineno,
+                                                          line)
+                else:  # name-opt
+                    ent = listeners.setdefault((kind, name), {"opts": {}})
+                    ent["opts"][opt] = _listener_opt_value(opt, value)
+            except ConfError:
+                raise
+            except ValueError:
                 raise ConfError(lineno, line,
-                                f"unknown listener kind {parts[1] if len(parts) > 1 else '?'}")
-            kind, name = parts[1], parts[2]
-            ent = listeners.setdefault((kind, name), {"opts": {}})
-            if len(parts) == 3:
-                ent["addr"], ent["port"] = _host_port(value, lineno, line)
-            else:
-                opt = ".".join(parts[3:])
-                ov: Any = value
-                if value.lower() in ("on", "true"):
-                    ov = True
-                elif value.lower() in ("off", "false"):
-                    ov = False
-                else:
-                    try:
-                        ov = int(value)
-                    except ValueError:
-                        pass
-                ent["opts"][opt] = ov
+                                f"bad value for listener option {opt}") \
+                    from None
             continue
 
         if key.startswith("plugins."):
-            name = key.split(".", 1)[1]
+            rest = key.split(".", 1)[1]
+            if "." in rest:
+                # plugins.<name>.path / plugins.<name>.priority
+                # (vmq_plugin.schema tree): external-plugin load options
+                name, popt = rest.split(".", 1)
+                if popt not in ("path", "priority"):
+                    raise ConfError(lineno, line,
+                                    f"unknown plugin option {popt!r} "
+                                    "(valid: path, priority)")
+                pv: Any = value
+                if popt == "priority":
+                    try:
+                        pv = int(value)
+                    except ValueError:
+                        raise ConfError(lineno, line,
+                                        "expected integer priority") \
+                            from None
+                plugin_opts.setdefault(name, {})[popt] = pv
+                if name in plugins:
+                    plugins[name] = plugin_opts[name]
+                continue
+            name = rest
             low = value.lower()
             if low in ("on", "true"):
                 plugins[name] = plugin_opts.setdefault(name, {})
@@ -161,7 +235,8 @@ def parse_conf(text: str) -> Dict[str, Any]:
             continue
 
         head = key.split(".", 1)[0]
-        if head.startswith("vmq_") and head not in DEFAULTS:
+        if (head.startswith("vmq_") and head not in DEFAULTS
+                and key not in schema.FLAT_ALIASES):
             # plugin option tree (vmq_passwd.password_file = ...)
             if head not in declared_plugins:
                 raise ConfError(lineno, line,
@@ -172,6 +247,17 @@ def parse_conf(text: str) -> Dict[str, Any]:
             plugin_opts.setdefault(head, {})[opt] = value
             if head in plugins:
                 plugins[head] = plugin_opts[head]
+            continue
+
+        if key == "vmq_swc.db_backend" or key == "swc_db_backend":
+            # reference engine names map onto the default native engine;
+            # kvstore/bucketed select ours explicitly (cluster/swc_db.py)
+            val = {"leveldb": "kvstore", "rocksdb": "kvstore",
+                   "leveled": "kvstore", "kvstore": "kvstore",
+                   "bucketed": "bucketed"}.get(value)
+            if val is None:
+                raise ConfError(lineno, line, "unknown swc db backend")
+            settings["swc_db_backend"] = val
             continue
 
         if key == "metadata_plugin":
@@ -187,12 +273,39 @@ def parse_conf(text: str) -> Dict[str, Any]:
             raise ConfError(lineno, line,
                             f"'{key}' is not settable directly; use "
                             f"{'plugins.<name> = on' if key == 'plugins' else 'listener.<kind>.<name> = ip:port'}")
-        key = _KEY_ALIASES.get(key, key)
+        gap = schema.GAPS.get(key)
+        if gap is not None:
+            raise ConfError(lineno, line, f"deliberate gap: {gap}")
+        key = schema.FLAT_ALIASES.get(key, key)
         if key not in DEFAULTS:
             raise ConfError(lineno, line, f"unknown config key {key}")
-        settings[key] = _coerce(key, value, lineno, line)
+        if key in schema.COMPAT_NOOPS:
+            log.info("conf: %s accepted for compatibility: %s",
+                     key, schema.COMPAT_NOOPS[key])
+        coerced = _coerce(key, value, lineno, line)
+        if key in schema.MS_TO_SECONDS:
+            # reference datatype is milliseconds; internal knob is
+            # seconds. 0 stays 0 (= disabled in the reference schema);
+            # any non-zero value rounds to at least 1s
+            if isinstance(DEFAULTS[key], float):
+                coerced = coerced / 1000.0
+            elif coerced <= 0:
+                coerced = 0
+            else:
+                coerced = max(1, int(round(coerced / 1000.0)))
+        settings[key] = coerced
 
-    if listeners:
+    if (global_opts or kind_opts) and not listeners:
+        # option defaults with no listener address line are legal
+        # cuttlefish (they set app envs), but here nothing will consume
+        # them — warn loudly instead of leaving the operator's cap inert
+        orphan = list(global_opts) + [k for d in kind_opts.values()
+                                      for k in d]
+        log.warning("conf: listener option default(s) %s given but no "
+                    "listener address line (listener.<kind>.<name> = "
+                    "ip:port) — they apply to no listener",
+                    ", ".join(sorted(set(orphan))))
+    if listeners or global_opts or kind_opts:
         for (kind, name), ent in listeners.items():
             if "port" not in ent:
                 # opts-only listener = typo'd name or missing address line;
@@ -202,8 +315,10 @@ def parse_conf(text: str) -> Dict[str, Any]:
                     "listener has options but no address line")
         settings["listeners"] = [
             {"kind": LISTENER_KINDS[kind], "name": name,
-             "addr": ent.get("addr", "127.0.0.1"),
-             "port": ent["port"], "opts": ent["opts"]}
+             "addr": ent.get("addr", "127.0.0.1"), "port": ent["port"],
+             # option precedence: instance > kind default > global default
+             "opts": {**global_opts, **kind_opts.get(kind, {}),
+                      **ent["opts"]}}
             for (kind, name), ent in listeners.items()
         ]
     if plugins:

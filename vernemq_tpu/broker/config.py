@@ -45,8 +45,14 @@ DEFAULTS: Dict[str, Any] = {
     # cluster (vmq_cluster_node.erl buffering; vmq_queue drain batching)
     "outgoing_clustering_buffer_size": 10_000_000,  # bytes
     "max_msgs_per_drain_step": 100,
-    # bounded migration-drain retry (1s apart) before the backlog is
-    # restored locally and the migration is marked failed
+    "max_drain_time": 500,  # ms cap per migration drain step
+    "remote_enqueue_timeout": 5000,  # ms ack timeout for remote enqueues
+    # compat no-op (see schema.COMPAT_NOOPS): queues are dict-sharded
+    "queue_sup_sup_children": 50,
+    # reg views started at boot; entries from schema.REG_VIEW_ALIASES
+    "reg_views": ["trie"],
+    # bounded migration-drain retry (max_drain_time apart) before the
+    # backlog is restored locally and the migration is marked failed
     "migrate_drain_retries": 60,
     # v5
     "topic_alias_max_client": 0,
@@ -79,11 +85,19 @@ DEFAULTS: Dict[str, Any] = {
     # systree / metrics
     "systree_enabled": True,
     "systree_interval": 20,
+    "systree_mountpoint": "",
+    "systree_qos": 0,
+    "systree_retain": False,
+    "systree_reg_view": "",  # compat no-op (schema.COMPAT_NOOPS)
     "graphite_enabled": False,
     "graphite_host": "localhost",
     "graphite_port": 2003,
     "graphite_interval": 20,
     "graphite_prefix": "",
+    "graphite_api_key": "",  # hosted-graphite key, prepended to the path
+    "graphite_connect_timeout": 5.0,   # seconds
+    "graphite_reconnect_timeout": 10.0,  # seconds between retries
+    "graphite_include_labels": False,  # compat no-op (unlabeled metrics)
     # http endpoints (vmq_http_config.erl http_modules)
     "http_enabled": False,
     "http_host": "127.0.0.1",
@@ -114,6 +128,27 @@ DEFAULTS: Dict[str, Any] = {
     "crl_refresh_interval": 60.0,  # seconds (vmq_crl_srv schema knob)
     "swc_replication_groups": 8,  # reference runs 10 (vmq_swc_plugin.erl:36-44)
     "swc_sync_interval": 2.0,  # seconds between AE rounds (sync_interval)
+    # storage engine behind the vmq_swc_db seam (cluster/swc_db.py):
+    # kvstore (one native engine) | bucketed (N engines by key hash) —
+    # the reference's leveldb/rocksdb/leveled choice (vmq_swc_db.erl)
+    "swc_db_backend": "kvstore",
+    # plumtree EBT safety valves (plumtree.* schema tree): cap on
+    # announced-but-unreceived ids awaiting GRAFT, and the backlog size
+    # past which new IHAVE announcements are dropped (digest AE repairs)
+    "plumtree_outstanding_limit": 10_000,
+    "plumtree_drop_ihave_threshold": 0,  # 0 = never drop
+    # shared-subscription delivery on remote-ack timeout: queue retry
+    # gives requeue semantics either way (schema.COMPAT_NOOPS)
+    "shared_subscription_timeout_action": "ignore",
+    # raw tcp listen options string (reference erlang proplist); nodelay
+    # is parsed and applied, the rest is accepted for compatibility
+    "tcp_listen_options":
+        "[{nodelay, true}, {linger, {true, 0}}, {send_timeout, 30000}, "
+        "{send_timeout_close, true}]",
+    # release-layout base directories (setup.* schema tree): when set,
+    # relative message_store_dir/metadata_dir/log_file resolve under them
+    "data_dir": "",
+    "log_dir": "",
     # logging sinks (the lager console/file/syslog triple of the
     # reference's release config; syslog uses the OS socket via the
     # stdlib handler — the reference's C port driver seat)

@@ -55,7 +55,11 @@ class ListenerManager:
                 proxy_protocol=bool(opts.get("proxy_protocol")),
                 use_identity_as_username=bool(
                     opts.get("use_identity_as_username")),
-                mountpoint=str(opts.get("mountpoint", "")))
+                mountpoint=str(opts.get("mountpoint", "")),
+                allowed_protocol_versions=opts.get(
+                    "allowed_protocol_versions"),
+                max_connections=int(opts.get("max_connections", 0) or 0),
+                reuse_port=bool(opts.get("reuse_port")))
             await server.start()
             port = server.port
         elif kind in ("ws", "wss"):
@@ -66,7 +70,11 @@ class ListenerManager:
                 max_frame_size=int(opts.get("max_frame_size", 0) or 0),
                 use_identity_as_username=bool(
                     opts.get("use_identity_as_username")),
-                mountpoint=str(opts.get("mountpoint", "")))
+                mountpoint=str(opts.get("mountpoint", "")),
+                allowed_protocol_versions=opts.get(
+                    "allowed_protocol_versions"),
+                max_connections=int(opts.get("max_connections", 0) or 0),
+                reuse_port=bool(opts.get("reuse_port")))
             await server.start()
             port = server.port
         elif kind in ("http", "https"):

@@ -65,6 +65,29 @@ class StreamTransport(Transport):
             pass
 
 
+def parse_nodelay_option(raw: str) -> Optional[bool]:
+    """Extract the ``nodelay`` flag from the tcp_listen_options knob
+    (vmq_server.schema:1454, an erlang proplist string). ``nodelay`` is
+    the option that matters for publish latency; the rest of the
+    proplist is accepted for compatibility (asyncio owns send
+    timeouts/linger). Returns None when the option is absent."""
+    if "nodelay" not in raw:
+        return None
+    return "{nodelay,true}" in raw.replace(" ", "")
+
+
+def _apply_nodelay(writer: asyncio.StreamWriter, want: bool) -> None:
+    sock = writer.get_extra_info("socket")
+    if sock is not None:
+        import socket as _socket
+
+        try:
+            sock.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY,
+                            1 if want else 0)
+        except OSError:
+            pass
+
+
 def sniff_proto_ver(body: bytes) -> int:
     """Read the protocol level out of a CONNECT body without committing to a
     codec (vmq_mqtt_pre_init.erl:44-70)."""
@@ -83,6 +106,7 @@ async def mqtt_connection(
     initial: bytes = b"",
     preauth_user: Optional[str] = None,
     mountpoint: str = "",
+    allowed_protocol_versions: Optional[Tuple[int, ...]] = None,
 ) -> None:
     """The per-connection MQTT byte loop, transport-agnostic: ``read_chunk``
     is an awaitable returning the next bytes (b"" on EOF), ``transport``
@@ -111,6 +135,16 @@ async def mqtt_connection(
         if ptype != 1:  # must be CONNECT
             return
         proto_ver = sniff_proto_ver(body)
+        if (allowed_protocol_versions is not None
+                and proto_ver not in allowed_protocol_versions):
+            # per-listener version gate (listener.*.allowed_protocol_versions,
+            # vmq_server.schema): refuse like an unknown level
+            if proto_ver == PROTO_5:
+                transport.write(b"\x20\x03\x00\x84\x00")  # v5 rc=0x84
+            else:
+                transport.write(b"\x20\x02\x00\x01")  # v4 rc=1
+            metrics.incr("mqtt_connect_error")
+            return
         if proto_ver == PROTO_5:
             codec = codec_v5
         elif proto_ver in (3, 4):
@@ -178,7 +212,10 @@ class MQTTServer:
                  max_frame_size: int = 0, ssl_context=None,
                  proxy_protocol: bool = False,
                  use_identity_as_username: bool = False,
-                 mountpoint: str = ""):
+                 mountpoint: str = "",
+                 allowed_protocol_versions=None,
+                 max_connections: int = 0,
+                 reuse_port: bool = False):
         self.broker = broker
         self.host = host
         self.port = port
@@ -187,11 +224,25 @@ class MQTTServer:
         self.proxy_protocol = proxy_protocol
         self.use_identity_as_username = use_identity_as_username
         self.mountpoint = mountpoint
+        self.allowed_protocol_versions = (
+            tuple(allowed_protocol_versions)
+            if allowed_protocol_versions else None)
+        self.max_connections = int(max_connections or 0)
+        self.connection_count = 0
+        # SO_REUSEPORT lets N worker processes share one listen port with
+        # kernel-level accept balancing (the multi-process scale-out path,
+        # broker/workers.py — the vmq_ranch all-schedulers seat)
+        self.reuse_port = reuse_port
+        # parsed once at listener construction — the accept path only
+        # applies the cached flag
+        self._nodelay = parse_nodelay_option(
+            str(broker.config.get("tcp_listen_options", "") or ""))
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._handle_conn, self.host, self.port, ssl=self.ssl_context
+            self._handle_conn, self.host, self.port, ssl=self.ssl_context,
+            reuse_port=self.reuse_port or None,
         )
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
@@ -205,7 +256,25 @@ class MQTTServer:
     async def _handle_conn(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        if (self.max_connections
+                and self.connection_count >= self.max_connections):
+            # listener connection cap (listener.*.max_connections): refuse
+            # at accept like ranch's max_connections
+            self.broker.metrics.incr("socket_error")
+            writer.close()
+            return
+        self.connection_count += 1
+        try:
+            await self._handle_conn_inner(reader, writer)
+        finally:
+            self.connection_count -= 1
+
+    async def _handle_conn_inner(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
         peer = writer.get_extra_info("peername") or ("", 0)
+        if self._nodelay is not None:
+            _apply_nodelay(writer, self._nodelay)
         initial = b""
         preauth: Optional[str] = None
         if self.proxy_protocol:
@@ -240,7 +309,8 @@ class MQTTServer:
             await mqtt_connection(
                 self.broker, lambda: reader.read(65536), transport, peer,
                 self.max_frame_size, initial=initial, preauth_user=preauth,
-                mountpoint=self.mountpoint)
+                mountpoint=self.mountpoint,
+                allowed_protocol_versions=self.allowed_protocol_versions)
         finally:
             try:
                 await writer.wait_closed()
@@ -253,18 +323,21 @@ async def start_broker(
     node_name: str = "node1",
     cluster_listen: Optional[Tuple[str, int]] = None,
     join: Optional[Tuple[str, int]] = None,
+    reuse_port: bool = False,
 ) -> Tuple[Broker, MQTTServer]:
     """Boot a broker with one MQTT listener (vmq_test_utils:setup-style
     convenience; port=0 picks a random free port). ``cluster_listen``
     additionally starts the inter-node channel listener (the reference's
     ``vmq`` listener type, vmq_ranch_config.erl:224-227); ``join`` dials a
-    seed node."""
+    seed node. ``reuse_port`` lets worker processes share the MQTT port
+    (broker/workers.py)."""
     broker = Broker(config, node_name=node_name)
     await broker.start()
     from .listeners import ListenerManager
 
     manager = ListenerManager(broker)
-    server = await manager.start_listener("mqtt", host, port)
+    server = await manager.start_listener(
+        "mqtt", host, port, {"reuse_port": reuse_port} if reuse_port else None)
     if cluster_listen is not None:
         from ..cluster import Cluster
 

@@ -240,7 +240,9 @@ class WebSocketServer:
 
     def __init__(self, broker, host: str = "127.0.0.1", port: int = 8080,
                  ssl_context=None, max_frame_size: int = 0,
-                 use_identity_as_username: bool = False, mountpoint: str = ""):
+                 use_identity_as_username: bool = False, mountpoint: str = "",
+                 allowed_protocol_versions=None, max_connections: int = 0,
+                 reuse_port: bool = False):
         self.broker = broker
         self.host = host
         self.port = port
@@ -248,11 +250,18 @@ class WebSocketServer:
         self.max_frame_size = max_frame_size
         self.use_identity_as_username = use_identity_as_username
         self.mountpoint = mountpoint
+        self.allowed_protocol_versions = (
+            tuple(allowed_protocol_versions)
+            if allowed_protocol_versions else None)
+        self.max_connections = int(max_connections or 0)
+        self.connection_count = 0
+        self.reuse_port = reuse_port
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._handle, self.host, self.port, ssl=self.ssl_context)
+            self._handle, self.host, self.port, ssl=self.ssl_context,
+            reuse_port=self.reuse_port or None)
         if self.port == 0:
             self.port = self._server.sockets[0].getsockname()[1]
         self.broker._servers.append(self._server)
@@ -264,6 +273,20 @@ class WebSocketServer:
 
     async def _handle(self, reader: asyncio.StreamReader,
                       writer: asyncio.StreamWriter) -> None:
+        if (self.max_connections
+                and self.connection_count >= self.max_connections):
+            # listener connection cap, same contract as MQTTServer
+            self.broker.metrics.incr("socket_error")
+            writer.close()
+            return
+        self.connection_count += 1
+        try:
+            await self._handle_inner(reader, writer)
+        finally:
+            self.connection_count -= 1
+
+    async def _handle_inner(self, reader: asyncio.StreamReader,
+                            writer: asyncio.StreamWriter) -> None:
         from .server import MAX_FRAME_SIZE, mqtt_connection
 
         peer = writer.get_extra_info("peername") or ("", 0)
@@ -291,7 +314,8 @@ class WebSocketServer:
             await mqtt_connection(
                 self.broker, ws.read_message, transport, peer,
                 self.max_frame_size or MAX_FRAME_SIZE,
-                preauth_user=preauth, mountpoint=self.mountpoint)
+                preauth_user=preauth, mountpoint=self.mountpoint,
+                allowed_protocol_versions=self.allowed_protocol_versions)
         finally:
             try:
                 writer.close()

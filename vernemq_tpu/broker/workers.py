@@ -1,0 +1,196 @@
+"""Multi-process host scale-out: N broker workers sharing one MQTT port.
+
+The reference runs one lightweight Erlang process per socket scheduled
+across all BEAM schedulers (``vmq_ranch.erl:41-43``) — per-connection
+parallelism inside one OS process. A GIL-bound asyncio broker can't do
+that, so the same capability is delivered the OS way: **N worker
+processes**, each a full broker (sessions, queues, matcher, storage
+views), accepting on ONE shared MQTT port via ``SO_REUSEPORT`` (the
+kernel balances accepts), and meshed over the existing cluster-node
+machinery on loopback — a worker IS a lightweight local node, so
+cross-worker delivery, subscriber replication, session takeover and
+shared subscriptions all reuse the cluster data/metadata plane
+(``cluster/``), exactly as they work between real nodes.
+
+Usage::
+
+    python -m vernemq_tpu.broker.workers --workers 4 --port 1883 \
+        [--conf vernemq.conf] [--allow-anonymous]
+
+or programmatically :class:`WorkerGroup` (used by ``tools/loadtest.py
+--workers N``).
+
+The parent supervises: a dead worker is relaunched with its same
+identity (worker index, cluster port), mirroring the restart discipline
+of ``broker/supervisor.py`` one level up.
+"""
+
+from __future__ import annotations
+
+import argparse
+import multiprocessing as mp
+import os
+import signal
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+#: cluster channel of worker i listens on loopback at base + i
+DEFAULT_CLUSTER_BASE = 44100
+
+
+def _run_worker(idx: int, n_workers: int, host: str, port: int,
+                cluster_base: int, overrides: Dict[str, Any],
+                conf_path: Optional[str]) -> None:
+    """Worker-process entry point (spawn-safe, top-level)."""
+    import asyncio
+
+    async def amain() -> None:
+        from .config import Config
+        from .server import start_broker
+
+        if conf_path:
+            from .conf import load_conf_file
+
+            cfg = load_conf_file(conf_path)
+            for k, v in overrides.items():
+                cfg.set(k, v)
+            # conf-declared listeners must not EADDRINUSE across the
+            # group: MQTT/WS listeners join the SO_REUSEPORT set on
+            # every worker; singleton kinds (admin HTTP, explicit
+            # cluster listeners) run on worker 0 only
+            shared_kinds = ("mqtt", "mqtts", "ws", "wss")
+            rewritten = []
+            for ent in cfg.get("listeners", []):
+                if ent["kind"] in shared_kinds:
+                    ent = {**ent,
+                           "opts": {**ent.get("opts", {}),
+                                    "reuse_port": True}}
+                elif idx > 0:
+                    continue
+                rewritten.append(ent)
+            cfg.set("listeners", rewritten)
+        else:
+            cfg = Config(**overrides)
+        if idx > 0 and cfg.get("http_enabled", False):
+            # the admin HTTP endpoint is a fixed-port singleton
+            cfg.set("http_enabled", False)
+        broker, server = await start_broker(
+            cfg, host=host, port=port,
+            node_name=f"worker{idx}",
+            cluster_listen=("127.0.0.1", cluster_base + idx),
+            join=("127.0.0.1", cluster_base) if idx > 0 else None,
+            reuse_port=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(sig, stop.set)
+        await stop.wait()
+        await broker.stop()
+        await server.stop()
+
+    asyncio.run(amain())
+
+
+class WorkerGroup:
+    """Spawn + supervise N broker worker processes on one shared port."""
+
+    def __init__(self, n_workers: int, host: str = "127.0.0.1",
+                 port: int = 1883,
+                 cluster_base: int = DEFAULT_CLUSTER_BASE,
+                 conf_path: Optional[str] = None,
+                 **config_overrides: Any):
+        if n_workers < 1:
+            raise ValueError("need at least one worker")
+        self.n_workers = n_workers
+        self.host = host
+        self.port = port
+        self.cluster_base = cluster_base
+        self.conf_path = conf_path
+        self.overrides = config_overrides
+        self._ctx = mp.get_context("spawn")
+        self._procs: List[Any] = []
+        self._stopping = False
+
+    def _spawn(self, idx: int):
+        p = self._ctx.Process(
+            target=_run_worker,
+            args=(idx, self.n_workers, self.host, self.port,
+                  self.cluster_base, self.overrides, self.conf_path),
+            name=f"vmq-worker{idx}", daemon=True)
+        p.start()
+        return p
+
+    def start(self) -> None:
+        # worker 0 is the cluster seed: it must be listening before the
+        # rest dial in, so stagger it first
+        self._procs = [self._spawn(0)]
+        time.sleep(0.3)
+        for i in range(1, self.n_workers):
+            self._procs.append(self._spawn(i))
+
+    def poll_restart(self) -> int:
+        """Supervision tick: relaunch dead workers with their identity.
+        Returns the number restarted."""
+        if self._stopping:
+            return 0
+        restarted = 0
+        for i, p in enumerate(self._procs):
+            if not p.is_alive():
+                self._procs[i] = self._spawn(i)
+                restarted += 1
+        return restarted
+
+    def alive_count(self) -> int:
+        return sum(1 for p in self._procs if p.is_alive())
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stopping = True
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        deadline = time.time() + timeout
+        for p in self._procs:
+            p.join(max(0.1, deadline - time.time()))
+            if p.is_alive():
+                p.kill()
+                p.join(1.0)
+        self._procs = []
+
+
+def main(argv: Optional[List[str]] = None) -> None:  # pragma: no cover
+    ap = argparse.ArgumentParser(
+        description="vernemq_tpu multi-process broker")
+    ap.add_argument("--workers", type=int,
+                    default=max(2, (os.cpu_count() or 2) // 2))
+    ap.add_argument("--host", default="0.0.0.0")
+    ap.add_argument("--port", type=int, default=1883)
+    ap.add_argument("--cluster-base", type=int,
+                    default=DEFAULT_CLUSTER_BASE)
+    ap.add_argument("--conf", default=None)
+    ap.add_argument("--allow-anonymous", action="store_true")
+    args = ap.parse_args(argv)
+    overrides: Dict[str, Any] = {}
+    if args.allow_anonymous:
+        overrides["allow_anonymous"] = True
+    group = WorkerGroup(args.workers, args.host, args.port,
+                        cluster_base=args.cluster_base,
+                        conf_path=args.conf, **overrides)
+    group.start()
+    print(f"started {args.workers} workers on {args.host}:{args.port}",
+          file=sys.stderr, flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+            n = group.poll_restart()
+            if n:
+                print(f"restarted {n} dead worker(s)", file=sys.stderr,
+                      flush=True)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        group.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

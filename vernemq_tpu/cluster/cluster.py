@@ -62,7 +62,12 @@ class Cluster:
         else:  # LWW backend: plumtree broadcast tree + digest AE
             from .plumtree import Plumtree
 
-            self.plumtree = Plumtree(self.node_name, self._pt_send)
+            self.plumtree = Plumtree(
+                self.node_name, self._pt_send,
+                outstanding_limit=broker.config.get(
+                    "plumtree_outstanding_limit", 10_000),
+                drop_ihave_threshold=broker.config.get(
+                    "plumtree_drop_ihave_threshold", 0))
             self.metadata.broadcast = self._broadcast_meta
         broker.cluster = self
         broker.registry.remote_publish = self.publish
@@ -341,10 +346,14 @@ class Cluster:
                                            False)))
 
     async def remote_enqueue(self, node: str, sid, msgs: List[Any],
-                             timeout: float = 10.0) -> bool:
+                             timeout: Optional[float] = None) -> bool:
         """Acked remote enqueue with backpressure — the migration/drain path
         (vmq_cluster:remote_enqueue/3, blocking with timeout
-        vmq_cluster_node.erl:67-83)."""
+        vmq_cluster_node.erl:67-83). Default timeout comes from the
+        remote_enqueue_timeout knob (ms, vmq_server.schema:300)."""
+        if timeout is None:
+            timeout = self.broker.config.get(
+                "remote_enqueue_timeout", 5000) / 1000.0
         w = self._writers.get(node)
         if w is None:
             raise ConnectionError(f"no channel to {node}")

@@ -43,12 +43,22 @@ class Plumtree:
     def __init__(self, node_name: str,
                  send: Callable[[str, bytes, Any], bool],
                  eager_fanout: int = 4, ihave_timeout: float = 1.0,
-                 cache_ttl: float = 60.0):
+                 cache_ttl: float = 60.0,
+                 outstanding_limit: int = 10_000,
+                 drop_ihave_threshold: int = 0):
         self.node_name = node_name
         self._send = send
         self.eager_fanout = eager_fanout
         self.ihave_timeout = ihave_timeout
         self.cache_ttl = cache_ttl
+        # safety valves (plumtree.outstanding_limit /
+        # plumtree.drop_i_have_threshold schema knobs): cap on
+        # announced-but-unreceived ids awaiting a GRAFT (beyond it, new
+        # announcements are ignored and digest AE repairs), and a backlog
+        # size past which outgoing IHAVEs are suppressed (0 = never)
+        self.outstanding_limit = outstanding_limit
+        self.drop_ihave_threshold = drop_ihave_threshold
+        self.ihave_dropped = 0
         self.eager: Set[str] = set()
         self.lazy: Set[str] = set()
         self._seq = 0
@@ -94,6 +104,12 @@ class Plumtree:
         for p in list(self.eager):
             if p != skip:
                 self._send(p, b"mtg", body)
+        if (self.drop_ihave_threshold
+                and len(self._pending) >= self.drop_ihave_threshold):
+            # backlog valve: suppress announcements while grafts are
+            # piled up — peers converge via the digest AE catch-all
+            self.ihave_dropped += 1
+            return
         ih = (list(mid),)
         for p in list(self.lazy):
             if p != skip:
@@ -136,6 +152,11 @@ class Plumtree:
         if pend is not None:
             if origin not in pend[1]:
                 pend[1].append(origin)
+            return
+        if (self.outstanding_limit
+                and len(self._pending) >= self.outstanding_limit):
+            # graft-storm valve: stop arming timers, let digest AE repair
+            self.ihave_dropped += 1
             return
         self._arm_graft_timer(mid, [origin])
 

@@ -212,7 +212,8 @@ class SWCMetadata:
 
     def __init__(self, node_name: str, persist_dir: Optional[str] = None,
                  n_groups: int = DEFAULT_GROUPS,
-                 sync_interval: float = 2.0):
+                 sync_interval: float = 2.0,
+                 db_backend: str = "kvstore"):
         self.node_name = node_name
         self.n_groups = n_groups
         self.sync_interval = sync_interval
@@ -223,9 +224,11 @@ class SWCMetadata:
         self._exchange_tasks: set = set()
         self._exchange_lock: Optional[asyncio.Lock] = None
         self.exchanges_done = 0
+        # storage behind the vmq_swc_db seam (cluster/swc_db.py):
+        # backend selected by the swc_db_backend knob, None = memory-only
         self._kv = None
         if persist_dir is not None:
-            self._open_kv(persist_dir)
+            self._open_kv(persist_dir, db_backend)
 
     # -------------------------------------------------------- wiring points
 
@@ -433,17 +436,23 @@ class SWCMetadata:
 
     # ----------------------------------------------------------- persistence
 
-    def _open_kv(self, persist_dir: str) -> None:
-        import os
+    def _open_kv(self, persist_dir: str, db_backend: str = "kvstore") -> None:
+        from ..native.kvstore import KVError
+        from .swc_db import open_backend
 
-        from ..native.kvstore import KVError, KVStore
-
+        self._kv = open_backend(db_backend, persist_dir)
+        if self._kv is None:
+            return
         try:
-            os.makedirs(persist_dir, exist_ok=True)
-            self._kv = KVStore(os.path.join(persist_dir, "metadata-swc.kv"))
             self._load_persisted()
         except (KVError, OSError) as e:
+            # corrupt on-disk state must degrade to memory-only (the
+            # pre-seam posture), not fail broker boot
             log.warning("swc metadata persistence unavailable: %s", e)
+            try:
+                self._kv.close()
+            except Exception:
+                pass
             self._kv = None
 
     def _load_persisted(self) -> None:
