@@ -40,7 +40,8 @@ def _now_ns() -> int:
 
 async def _run_clients(host: str, port: int, sub_ids, pub_ids, secs: float,
                        qos: int, window: int, payload_len: int,
-                       latency: bool, tag: str):
+                       latency: bool, tag: str, rate: float = 0.0,
+                       lat_skip_secs: float = 0.0):
     """Drive one shard of subscribers+publishers; returns
     (sent, failed, received, elapsed, lat_samples_ns)."""
     from vernemq_tpu.client import MQTTClient
@@ -48,6 +49,9 @@ async def _run_clients(host: str, port: int, sub_ids, pub_ids, secs: float,
     received = 0
     lat_ns = []
     done = asyncio.Event()
+    # samples before this cutoff are warmup (first-compile windows on a
+    # cold backend) and excluded from the latency report
+    lat_from = time.perf_counter() + lat_skip_secs
 
     async def subscriber(i: int) -> None:
         nonlocal received
@@ -61,7 +65,8 @@ async def _run_clients(host: str, port: int, sub_ids, pub_ids, secs: float,
                 continue
             if f is not None:
                 received += 1
-                if latency and f.payload[:3] == _LAT_MAGIC:
+                if latency and f.payload[:3] == _LAT_MAGIC \
+                        and time.perf_counter() >= lat_from:
                     t0 = struct.unpack(">Q", f.payload[3:11])[0]
                     lat_ns.append(_now_ns() - t0)
         await c.disconnect()
@@ -83,7 +88,16 @@ async def _run_clients(host: str, port: int, sub_ids, pub_ids, secs: float,
                 nonlocal failed
                 failed += 1  # acked count excludes this one
 
+        interval = (1.0 / rate) if rate > 0 else 0.0
+        next_at = time.perf_counter()
         while not done.is_set():
+            if interval:
+                # paced publishing: measures broker-ADDED latency, not
+                # self-inflicted queueing from an uncapped firehose
+                now = time.perf_counter()
+                if now < next_at:
+                    await asyncio.sleep(next_at - now)
+                next_at += interval
             payload = base_payload
             if latency and j % _SAMPLE_EVERY == 0:
                 stamp = _LAT_MAGIC + struct.pack(">Q", _now_ns())
@@ -123,10 +137,12 @@ async def _run_clients(host: str, port: int, sub_ids, pub_ids, secs: float,
 
 
 def _client_proc(host, port, sub_ids, pub_ids, secs, qos, window,
-                 payload_len, latency, tag, out_q):
+                 payload_len, latency, tag, out_q, rate=0.0,
+                 lat_skip_secs=0.0):
     """Spawn-safe client-shard entry point."""
     res = asyncio.run(_run_clients(host, port, sub_ids, pub_ids, secs,
-                                   qos, window, payload_len, latency, tag))
+                                   qos, window, payload_len, latency, tag,
+                                   rate, lat_skip_secs))
     out_q.put(res)
 
 
@@ -157,6 +173,12 @@ async def _main_inproc(args) -> None:
     if args.view == "tpu":
         import jax  # noqa: F401  (matcher path needs a backend)
 
+        if args.jax_platform:
+            # this image's jax IGNORES the JAX_PLATFORMS env var; only
+            # the config API works. Forcing cpu keeps --view tpu usable
+            # when the accelerator tunnel is down.
+            jax.config.update("jax_platforms", args.jax_platform)
+
     from vernemq_tpu.broker.config import Config
     from vernemq_tpu.broker.server import start_broker
 
@@ -166,7 +188,18 @@ async def _main_inproc(args) -> None:
         port=0)
     sent, failed, received, elapsed, lat = await _run_clients(
         server.host, server.port, range(args.subs), range(args.pubs),
-        args.secs, args.qos, args.window, args.payload, args.latency, "")
+        args.secs, args.qos, args.window, args.payload, args.latency, "",
+        args.rate, args.lat_skip_secs)
+    if args.view == "tpu" and getattr(b, "_collector", None) is not None:
+        col = b._collector
+        mb = sum(m.match_batches
+                 for m in getattr(col.view, "_matchers", {}).values())
+        mp_ = sum(m.match_publishes
+                  for m in getattr(col.view, "_matchers", {}).values())
+        print(f"collector: host_hybrid_pubs={col.host_hybrid_pubs} "
+              f"device_batches={mb} device_pubs={mp_} "
+              f"merges={col.saturated_merges} "
+              f"shed={col.overload_host_pubs}", flush=True)
     await b.stop()
     await server.stop()
     _report(args.view, args.qos, sent, failed, received, elapsed, lat,
@@ -174,7 +207,14 @@ async def _main_inproc(args) -> None:
 
 
 def _main_workers(args) -> None:
+    import os
+
     from vernemq_tpu.broker.workers import WorkerGroup
+
+    if args.jax_platform:
+        # worker processes and their probe subprocesses read this env
+        # var (workers translate it via jax.config at boot)
+        os.environ["JAX_PLATFORMS"] = args.jax_platform
 
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -209,7 +249,8 @@ def _main_workers(args) -> None:
                 target=_client_proc,
                 args=("127.0.0.1", port, sub_ids, pub_ids, args.secs,
                       args.qos, args.window, args.payload, args.latency,
-                      f"p{p}-", out_q)))
+                      f"p{p}-", out_q, args.rate,
+                      args.lat_skip_secs)))
         for p in procs:
             p.start()
         totals = [0, 0, 0, 0.0]
@@ -263,6 +304,16 @@ def main() -> None:
     ap.add_argument("--cluster-base", type=int, default=45600)
     ap.add_argument("--latency", action="store_true",
                     help="sample end-to-end delivery latency")
+    ap.add_argument("--jax-platform", default=None,
+                    help="force the JAX backend for --view tpu (e.g. "
+                         "cpu); jax.config only — env vars are ignored "
+                         "by this image's jax")
+    ap.add_argument("--lat-skip-secs", type=float, default=0.0,
+                    help="exclude latency samples from the first N "
+                         "seconds (cold-backend compile warmup)")
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="paced publishes/sec per publisher (0 = "
+                         "uncapped firehose)")
     args = ap.parse_args()
     if args.workers:
         _main_workers(args)

@@ -1,0 +1,202 @@
+"""Scale & soak: many concurrent connections + sustained QoS1 traffic,
+with memory/latency stability sampling (VERDICT r3 item 6).
+
+Opens a ladder of persistent connections (idle keepalive holders), runs
+paced QoS1 traffic through a subscriber pool for the soak duration, and
+samples broker RSS + delivery latency every ``--sample-every`` seconds.
+Prints one JSON line per sample and a final summary line; non-flat RSS
+growth or latency drift across samples is the failure signal.
+
+  python tools/soak.py [--conns 2000] [--subs 100] [--pubs 8]
+      [--minutes 10] [--rate 50] [--sample-every 10]
+"""
+import argparse
+import asyncio
+import json
+import os
+import struct
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+_LAT_MAGIC = b"SK1"
+
+
+def _rss_mb(pid: int) -> float:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return 0.0
+
+
+async def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--conns", type=int, default=2000,
+                    help="idle persistent connections held open")
+    ap.add_argument("--subs", type=int, default=100)
+    ap.add_argument("--pubs", type=int, default=8)
+    ap.add_argument("--minutes", type=float, default=10.0)
+    ap.add_argument("--rate", type=float, default=50.0,
+                    help="publishes/sec per publisher (paced)")
+    ap.add_argument("--sample-every", type=float, default=10.0)
+    ap.add_argument("--qos", type=int, default=1)
+    ap.add_argument("--connect", default=None, metavar="HOST:PORT",
+                    help="target an external broker (own process = own "
+                         "fd budget; pass --broker-pid to sample its "
+                         "RSS) instead of booting one in-process")
+    ap.add_argument("--broker-pid", type=int, default=0,
+                    help="pid whose RSS to sample with --connect")
+    args = ap.parse_args()
+
+    from vernemq_tpu.client import MQTTClient
+
+    b = server = None
+    if args.connect:
+        host, _, port_s = args.connect.rpartition(":")
+        port = int(port_s)
+        pid = args.broker_pid or os.getpid()
+    else:
+        from vernemq_tpu.broker.config import Config
+        from vernemq_tpu.broker.server import start_broker
+
+        b, server = await start_broker(
+            Config(systree_enabled=False, allow_anonymous=True,
+                   sysmon_enabled=False),
+            port=0)
+        host, port = server.host, server.port
+        pid = os.getpid()
+
+    # ---- connection ladder -------------------------------------------
+    idle = []
+    t0 = time.perf_counter()
+    failed_conns = 0
+    for i in range(args.conns):
+        c = MQTTClient(host, port, f"soak-idle{i}", keepalive=120)
+        try:
+            ack = await c.connect(timeout=10.0)
+            if ack.rc == 0:
+                idle.append(c)
+            else:
+                failed_conns += 1
+        except Exception:
+            failed_conns += 1
+        if i and i % 500 == 0:
+            print(json.dumps({"event": "ladder", "conns": len(idle),
+                              "rss_mb": round(_rss_mb(pid), 1),
+                              "t_s": round(time.perf_counter() - t0, 1)}),
+                  flush=True)
+    print(json.dumps({"event": "ladder_done", "conns": len(idle),
+                      "failed": failed_conns,
+                      "rss_mb": round(_rss_mb(pid), 1),
+                      "t_s": round(time.perf_counter() - t0, 1)}),
+          flush=True)
+
+    # ---- sustained traffic -------------------------------------------
+    done = asyncio.Event()
+    received = 0
+    lat_window = []  # ns, cleared each sample
+
+    async def subscriber(i: int) -> None:
+        nonlocal received
+        c = MQTTClient(host, port, f"soak-sub{i}")
+        await c.connect()
+        await c.subscribe(f"soak/{i % 16}/+", qos=args.qos)
+        while not done.is_set():
+            try:
+                f = await c.recv(0.5)
+            except Exception:
+                continue
+            if f is not None:
+                received += 1
+                if f.payload[:3] == _LAT_MAGIC:
+                    t_pub = struct.unpack(">Q", f.payload[3:11])[0]
+                    lat_window.append(time.monotonic_ns() - t_pub)
+        await c.disconnect()
+
+    sent = 0
+    failed = 0
+
+    async def publisher(i: int) -> None:
+        nonlocal sent, failed
+        c = MQTTClient(host, port, f"soak-pub{i}")
+        await c.connect()
+        interval = 1.0 / args.rate if args.rate > 0 else 0.0
+        nxt = time.perf_counter()
+        j = 0
+        while not done.is_set():
+            if interval:
+                now = time.perf_counter()
+                if now < nxt:
+                    await asyncio.sleep(nxt - now)
+                nxt += interval
+            payload = _LAT_MAGIC + struct.pack(">Q", time.monotonic_ns()) \
+                + b"x" * 53
+            try:
+                await c.publish(f"soak/{j % 16}/m{i}", payload,
+                                qos=args.qos)
+                sent += 1
+            except Exception:
+                failed += 1
+            j += 1
+        await c.disconnect()
+
+    subs = [asyncio.create_task(subscriber(i)) for i in range(args.subs)]
+    await asyncio.sleep(1.0)
+    pubs = [asyncio.create_task(publisher(i)) for i in range(args.pubs)]
+
+    deadline = time.perf_counter() + args.minutes * 60.0
+    samples = []
+    while time.perf_counter() < deadline:
+        await asyncio.sleep(args.sample_every)
+        lat = sorted(lat_window)
+        lat_window.clear()
+        p50 = lat[len(lat) // 2] / 1e6 if lat else 0.0
+        p99 = lat[min(len(lat) - 1, int(0.99 * len(lat)))] / 1e6 \
+            if lat else 0.0
+        sample = {"event": "sample",
+                  "t_s": round(time.perf_counter() - t0, 1),
+                  "rss_mb": round(_rss_mb(pid), 1),
+                  "sent": sent, "received": received, "failed": failed,
+                  "lat_ms_p50": round(p50, 2), "lat_ms_p99": round(p99, 2),
+                  "n_lat": len(lat)}
+        samples.append(sample)
+        print(json.dumps(sample), flush=True)
+    done.set()
+    await asyncio.gather(*pubs, *subs, return_exceptions=True)
+    for c in idle:
+        try:
+            await c.disconnect()
+        except Exception:
+            pass
+    if b is not None:
+        await b.stop()
+        await server.stop()
+
+    rss = [s["rss_mb"] for s in samples]
+    p99s = [s["lat_ms_p99"] for s in samples if s["n_lat"]]
+    half = max(1, len(p99s) // 2)
+    summary = {
+        "event": "summary",
+        "conns": len(idle), "failed_conns": failed_conns,
+        "minutes": args.minutes, "sent": sent, "received": received,
+        "pub_failures": failed,
+        "rss_mb_first": rss[0] if rss else 0,
+        "rss_mb_last": rss[-1] if rss else 0,
+        "rss_growth_pct": round(100 * (rss[-1] - rss[0]) /
+                                max(rss[0], 1), 1) if rss else 0,
+        "lat_p99_first_half_ms": round(sum(p99s[:half]) / half, 2)
+        if p99s else 0,
+        "lat_p99_second_half_ms": round(sum(p99s[half:]) /
+                                        max(1, len(p99s) - half), 2)
+        if p99s else 0,
+    }
+    print(json.dumps(summary), flush=True)
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -75,6 +75,31 @@ class TrieRegView:
 _accel_probe_result: Optional[bool] = None
 
 
+def _probe_is_risky() -> bool:
+    """True when touching the JAX backend might HANG (the axon/TPU
+    tunnel holds a process-wide lock through a wedged init). A local
+    backend forced via env or jax.config (cpu — the test and
+    --jax-platform paths) cannot hang, so the subprocess probe and its
+    trie-serving window are skipped entirely and reg_view("tpu") is
+    deterministic."""
+    import os
+    import sys as _sys
+
+    plats = os.environ.get("JAX_PLATFORMS", "")
+    jm = _sys.modules.get("jax")
+    if jm is not None:
+        try:
+            cfg = jm.config.jax_platforms
+            if cfg:
+                plats = cfg
+        except Exception:
+            pass
+    if not plats:
+        return True  # default platform resolution may pick the tunnel
+    return any(p.strip() in ("", "axon", "tpu")
+               for p in plats.split(","))
+
+
 def _probe_accelerator(timeout: float = 60.0) -> bool:
     """True iff the default JAX backend initialises and executes. Runs in
     a SUBPROCESS with a hard timeout: a wedged accelerator tunnel hangs
@@ -119,6 +144,7 @@ class Registry:
         self.db.subscribe_db_events(self._on_subs_event)
         self.queues: Dict[SubscriberId, SubscriberQueue] = {}
         self.reg_views: Dict[str, Any] = {"trie": TrieRegView(self)}
+        self._accel_probe_task: Optional[Any] = None
         # remote plain subscriptions collapse to one node-pointer trie row
         # per (mountpoint, filter, node), refcounted
         # (vmq_reg_trie.erl:503-520 remote-subs handling)
@@ -156,31 +182,78 @@ class Registry:
         name = name or self.broker.config.default_reg_view
         view = self.reg_views.get(name)
         if view is None and name == "tpu":
-            if not _probe_accelerator():
+            global _accel_probe_result
+            if _accel_probe_result is None and not _probe_is_risky():
+                # a local backend (forced cpu) cannot hang: build the
+                # view directly — no probe window, deterministic for
+                # tests and --jax-platform runs
+                _accel_probe_result = True
+            if _accel_probe_result is None:
                 # a wedged accelerator tunnel HANGS jax backend init
-                # (holding a process-wide lock), which would freeze the
-                # whole broker at the first publish — degrade loudly to
-                # the host trie instead (the reg-view seam is exactly the
-                # place the reference lets deployments pick a view) and
-                # keep re-probing so the engine comes back without a
-                # broker restart
+                # (holding a process-wide lock). The probe subprocess
+                # itself burns its full timeout when the tunnel is
+                # wedged, so it must NEVER run on the event loop (it
+                # would freeze every session for the duration): kick it
+                # off on an executor thread and serve the host trie
+                # until the verdict is in.
+                self._start_accel_probe()
+                return self.reg_views["trie"]
+            if _accel_probe_result is False:
+                # degrade loudly to the host trie (the reg-view seam is
+                # exactly the place the reference lets deployments pick
+                # a view) and keep re-probing so the engine comes back
+                # without a broker restart
                 log.error("accelerator backend unavailable/hung; "
                           "default_reg_view=tpu falling back to the host "
                           "trie view (will re-probe)")
                 self.reg_views["tpu"] = self.reg_views["trie"]
                 self._arm_accel_recovery()
                 return self.reg_views["trie"]
-            from ..models.tpu_matcher import TpuRegView
-
-            view = self.reg_views["tpu"] = TpuRegView(
-                self, max_fanout=self.broker.config.tpu_max_fanout,
-                flat_avg=self.broker.config.tpu_flat_avg,
-                use_pallas=self.broker.config.tpu_use_pallas,
-                initial_capacity=self.broker.config.tpu_initial_capacity,
-            )
+            view = self.reg_views["tpu"] = self._make_tpu_view()
         if view is None:
             raise KeyError(f"unknown reg view {name!r}")
         return view
+
+    def _make_tpu_view(self):
+        from ..models.tpu_matcher import TpuRegView
+
+        return TpuRegView(
+            self, max_fanout=self.broker.config.tpu_max_fanout,
+            flat_avg=self.broker.config.tpu_flat_avg,
+            use_pallas=self.broker.config.tpu_use_pallas,
+            initial_capacity=self.broker.config.tpu_initial_capacity,
+        )
+
+    def _start_accel_probe(self) -> None:
+        """Run the accelerator probe off-loop, once; on the verdict the
+        next reg_view("tpu") call takes the real path."""
+        if self._accel_probe_task is not None:
+            return
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            # no loop (unit tests poking reg_view directly): probe
+            # synchronously — nothing to block
+            _probe_accelerator()
+            return
+        fut = loop.run_in_executor(None, _probe_accelerator)
+        self._accel_probe_task = fut
+
+        def _done(f) -> None:
+            ok = False
+            try:
+                ok = bool(f.result())
+            except Exception:
+                pass
+            if not ok:
+                # force the cached verdict so reg_view takes the loud
+                # fallback + recovery path on its next call
+                global _accel_probe_result
+                _accel_probe_result = False
+            log.info("accelerator probe finished: %s",
+                     "available" if ok else "unavailable")
+
+        fut.add_done_callback(_done)
 
     def _arm_accel_recovery(self, interval: float = 60.0) -> None:
         """Supervised re-probe loop: when the accelerator comes back, swap
@@ -741,10 +814,14 @@ class Registry:
                     out.get("tpu_match_publishes", 0) + m.match_publishes
                 out["tpu_host_fallbacks"] = \
                     out.get("tpu_host_fallbacks", 0) + m.host_fallbacks
+                out["tpu_warmup_batches"] = \
+                    out.get("tpu_warmup_batches", 0) + m.warmup_batches
         col = getattr(self.broker, "_collector", None)
         if col is not None:
             # small flushes served host-side by hybrid dispatch
             out["tpu_hybrid_host_pubs"] = col.host_hybrid_pubs
+            out["tpu_overload_shed_pubs"] = col.overload_host_pubs
+            out["tpu_saturated_merges"] = col.saturated_merges
         return out
 
     def fold_subscriptions(self, mountpoint: str = ""):

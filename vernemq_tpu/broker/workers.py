@@ -46,6 +46,16 @@ def _run_worker(idx: int, n_workers: int, host: str, port: int,
     import asyncio
 
     async def amain() -> None:
+        import os
+
+        plats = os.environ.get("JAX_PLATFORMS")
+        if plats and plats != "axon":
+            # this image's jax ignores the env var; translate it so the
+            # worker's in-process backend matches the probe's verdict
+            import jax
+
+            jax.config.update("jax_platforms", plats)
+
         from .config import Config
         from .server import start_broker
 

@@ -219,6 +219,10 @@ class TpuMatcher:
         self._bucketed = False
         self.match_batches = 0
         self.match_publishes = 0
+        # warm_ladder's dummy traffic counts separately so operator
+        # gauges and the loadtest collector line reflect REAL publishes
+        self.warmup_batches = 0
+        self.warmup_publishes = 0
         self.host_fallbacks = 0  # pubs served by exact host match
         # encode cache: hot topics (zipf streams) skip per-word interner
         # lookups; invalidated when the interner or bucket layout changes
@@ -385,7 +389,24 @@ class TpuMatcher:
         gb = sel[:, L + 3].copy()
         return pw, pl, pd, pb, gb
 
-    def match_batch(self, topics: Sequence[Sequence[str]]) -> List[List[Row]]:
+    def warm_ladder(self, max_batch: int = 4096) -> int:
+        """Pre-compile the Bpad ladder: run one dummy match at every
+        pow2 batch size up to ``max_batch`` so live traffic never pays a
+        first-compile stall (tens of seconds per shape on a cold
+        backend; measured as the whole p99 in broker-level runs).
+        Returns the number of shapes compiled. Safe to call from an
+        executor thread — match_batch takes the lock per call."""
+        done = 0
+        b = 1
+        while b <= max_batch:
+            topics = [("warmup", "ladder", str(i)) for i in range(b)]
+            self.match_batch(topics, _warmup=True)
+            done += 1
+            b *= 2
+        return done
+
+    def match_batch(self, topics: Sequence[Sequence[str]],
+                    _warmup: bool = False) -> List[List[Row]]:
         """Match a batch of publish topics; returns per-topic entry rows
         (the per-publish fold results)."""
         if not topics:
@@ -403,8 +424,12 @@ class TpuMatcher:
             else:
                 pw, pl, pd = self.encode_batch(topics)
             self._inflight += 1  # sync() must not donate our buffers away
-        self.match_batches += 1
-        self.match_publishes += len(topics)
+        if _warmup:
+            self.warmup_batches += 1
+            self.warmup_publishes += len(topics)
+        else:
+            self.match_batches += 1
+            self.match_publishes += len(topics)
         try:
             if bucketed:
                 idx_rows, need_host = self._match_windowed(
@@ -606,6 +631,14 @@ class TpuRegView:
                 for fw, key, opts in self.registry.fold_subscriptions(mountpoint):
                     m.table.add(list(fw), key, opts)
             self._matchers[mountpoint] = m
+            # pre-compile the batch-shape ladder in the background so
+            # live flushes never block on a first compile (match_batch
+            # locks per call, so warmup interleaves with real batches)
+            try:
+                loop = asyncio.get_running_loop()
+                loop.run_in_executor(None, m.warm_ladder)
+            except RuntimeError:
+                pass  # no loop (sync/unit-test use): compile on demand
         return m
 
     # delta feed from the registry
@@ -636,6 +669,11 @@ class BatchCollector:
     Equivalent host-side role to the NIF batching layer in the north-star
     design (BASELINE.json)."""
 
+    #: device calls allowed in flight at once: two slots double-buffer
+    #: the pipeline (batch N+1's host encode overlaps batch N's device
+    #: compute — the executor thread encodes while the device runs)
+    MAX_INFLIGHT = 2
+
     def __init__(self, view: TpuRegView, window_us: int = 200,
                  max_batch: int = 4096, host_threshold: int = 8):
         self.view = view
@@ -649,12 +687,66 @@ class BatchCollector:
         # Batches above the threshold amortise the device call.
         self.host_threshold = host_threshold
         self.host_hybrid_pubs = 0
+        self.saturated_merges = 0  # flushes deferred into a later batch
+        self.overload_host_pubs = 0  # shed to the host trie at overload
         self._pending: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
         self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self._inflight = 0
+        # submission-order release queue: a future's caller sees its
+        # result only after every EARLIER submission settled, so
+        # publish_nowait's routing callbacks fire in submission order —
+        # the per-publisher ordering contract (reg.py publish_nowait)
+        # holds even with two device batches racing in the pipeline or
+        # results coming from the host shed path
+        import collections as _collections
+
+        self._order: "_collections.deque" = _collections.deque()
+
+    def _enqueue_fut(self, loop) -> asyncio.Future:
+        fut = loop.create_future()
+        fut._vmq_ready = False  # type: ignore[attr-defined]
+        fut._vmq_res = None  # type: ignore[attr-defined]
+        fut._vmq_exc = None  # type: ignore[attr-defined]
+        self._order.append(fut)
+        return fut
+
+    def _settle(self, fut, res=None, exc=None) -> None:
+        """Record a future's result and release the head run of settled
+        futures in submission order."""
+        fut._vmq_ready = True
+        fut._vmq_res = res
+        fut._vmq_exc = exc
+        order = self._order
+        while order and order[0]._vmq_ready:
+            f = order.popleft()
+            if f.done():  # cancelled by the caller
+                continue
+            if f._vmq_exc is not None:
+                f.set_exception(f._vmq_exc)
+            else:
+                f.set_result(f._vmq_res)
+            f._vmq_res = f._vmq_exc = None
 
     def submit(self, mountpoint: str, topic: Sequence[str]) -> asyncio.Future:
         loop = asyncio.get_event_loop()
-        fut = loop.create_future()
+        fut = self._enqueue_fut(loop)
+        if (self._inflight >= self.MAX_INFLIGHT
+                and len(self._pending) >= self.max_batch):
+            # overload: both pipeline slots busy AND a full batch already
+            # waiting — arrival rate exceeds device service rate. Match on
+            # the exact host trie NOW instead of queueing unboundedly
+            # (the trie is the correctness oracle, so results are
+            # identical); the result still RELEASES in submission order
+            # via _settle, so shedding never reorders deliveries.
+            reg = getattr(self.view, "registry", None)
+            if reg is not None:
+                self.overload_host_pubs += 1
+                try:
+                    self._settle(fut,
+                                 res=reg.trie(mountpoint).match(list(topic)))
+                except Exception as e:
+                    self._settle(fut, exc=e)
+                return fut
         self._pending.append((mountpoint, tuple(topic), fut))
         if len(self._pending) >= self.max_batch:
             if self._flush_handle is not None:
@@ -667,22 +759,51 @@ class BatchCollector:
 
     def _flush(self) -> None:
         self._flush_handle = None
-        pending, self._pending = self._pending, []
-        if not pending:
+        if not self._pending:
             return
-        if len(pending) <= self.host_threshold:
-            reg = getattr(self.view, "registry", None)
-            if reg is not None:
-                self.host_hybrid_pubs += len(pending)
-                for mp, topic, fut in pending:
-                    if fut.done():
-                        continue
-                    try:
-                        fut.set_result(reg.trie(mp).match(list(topic)))
-                    except Exception as e:
-                        fut.set_exception(e)
-                return
-        asyncio.get_event_loop().create_task(self._flush_async(pending))
+        reg = getattr(self.view, "registry", None)
+        if len(self._pending) <= self.host_threshold and reg is not None:
+            pending, self._pending = self._pending, []
+            self.host_hybrid_pubs += len(pending)
+            for mp, topic, fut in pending:
+                try:
+                    self._settle(fut, res=reg.trie(mp).match(list(topic)))
+                except Exception as e:
+                    self._settle(fut, exc=e)
+            return
+        if self._inflight >= self.MAX_INFLIGHT:
+            # both slots busy: DON'T queue a third task — leave the
+            # items pending so late arrivals coalesce into one bigger
+            # batch (self-batching backpressure: queueing depth stays
+            # bounded at 2 batches + one accumulating, so worst-case
+            # service latency is ~2 batch times, not an unbounded
+            # executor queue). _on_done flushes the moment a slot frees.
+            self.saturated_merges += 1
+            return
+        pending, self._pending = self._pending[:self.max_batch], \
+            self._pending[self.max_batch:]
+        self._inflight += 1
+        task = asyncio.get_event_loop().create_task(
+            self._flush_async(pending))
+        task.add_done_callback(self._on_done)
+
+    def _on_done(self, task) -> None:
+        self._inflight -= 1
+        if task.cancelled():
+            return
+        exc = task.exception()
+        if exc is not None:  # futures already got the error; log path
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "batch flush task failed: %s", exc)
+        if self._pending:
+            # back-to-back dispatch keeps the device busy: the waiting
+            # batch goes out now instead of waiting out another window
+            if self._flush_handle is not None:
+                self._flush_handle.cancel()
+                self._flush_handle = None
+            self._flush()
 
     async def _flush_async(self, pending) -> None:
         """Run the device call off-loop (executor thread): a jit compile for
@@ -702,11 +823,9 @@ class BatchCollector:
                 results = await loop.run_in_executor(
                     None, self.view.fold_batch, mp, topics
                 )
-            except Exception as e:  # resolve futures with the error
+            except Exception as e:  # settle futures with the error
                 for _, fut in items:
-                    if not fut.done():
-                        fut.set_exception(e)
+                    self._settle(fut, exc=e)
                 continue
             for (_, fut), rows in zip(items, results):
-                if not fut.done():
-                    fut.set_result(rows)
+                self._settle(fut, res=rows)
