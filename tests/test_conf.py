@@ -42,7 +42,7 @@ def test_parse_listener_tree():
         listener.ssl.ext = 0.0.0.0:8883
         listener.ssl.ext.certfile = /tmp/cert.pem
         listener.ws.default = 127.0.0.1:8080
-        listener.vmq.clustering = 0.0.0.0:44053
+        listener.vmq.clustering = 0.0.0.0:24053
         """
     )
     listeners = {(l["kind"], l["name"]): l for l in s["listeners"]}

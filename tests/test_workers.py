@@ -8,7 +8,10 @@ over real TCP: cross-worker delivery, supervision restart, and clean
 shutdown.
 
 NOTE: spawn-based workers boot in ~5-10s (full package import per
-process); kept to one group per test module.
+process); the first two tests share one module-scoped group, the
+conf-file test boots its own (it needs different boot config). All
+fixed ports stay BELOW the kernel ephemeral range (32768+) so client
+sockets under load can't steal them.
 """
 
 import asyncio
@@ -43,7 +46,7 @@ def _wait_ready(port: int, timeout: float = 45.0) -> bool:
 @pytest.fixture(scope="module")
 def group():
     port = _free_port()
-    g = WorkerGroup(2, "127.0.0.1", port, cluster_base=46100,
+    g = WorkerGroup(2, "127.0.0.1", port, cluster_base=26100,
                     allow_anonymous=True, systree_enabled=False)
     g.start()
     assert _wait_ready(port), "workers never became reachable"
@@ -97,3 +100,51 @@ async def test_worker_restart_supervision(group):
     while time.time() < deadline and group.alive_count() < 2:
         time.sleep(0.25)
     assert group.alive_count() == 2
+
+
+@pytest.mark.asyncio
+async def test_workers_from_conf_file(tmp_path):
+    """Conf-declared MQTT listeners join the SO_REUSEPORT set on every
+    worker (no EADDRINUSE crash loop); singleton HTTP stays on worker 0;
+    cross-worker delivery works through the conf listener."""
+    import urllib.request
+
+    mqtt_port = _free_port()
+    http_port = _free_port()
+    conf = tmp_path / "vernemq.conf"
+    conf.write_text(
+        f"""
+        allow_anonymous = on
+        systree_enabled = off
+        listener.tcp.default = 127.0.0.1:{mqtt_port}
+        http_enabled = on
+        http_port = {http_port}
+        """
+    )
+    g = WorkerGroup(2, "127.0.0.1", _free_port(), cluster_base=26300,
+                    conf_path=str(conf))
+    g.start()
+    try:
+        assert _wait_ready(mqtt_port), "conf listener never came up"
+        time.sleep(2.0)
+        assert g.alive_count() == 2  # no EADDRINUSE crash loop
+        sub = MQTTClient("127.0.0.1", mqtt_port, "cw-sub")
+        await sub.connect()
+        await sub.subscribe("cw/#", qos=0)
+        await asyncio.sleep(1.0)
+        pub = MQTTClient("127.0.0.1", mqtt_port, "cw-pub")
+        await pub.connect()
+        await pub.publish("cw/t", b"conf-route", qos=0)
+        f = await sub.recv(5.0)
+        assert f is not None and f.payload == b"conf-route"
+        await sub.disconnect()
+        await pub.disconnect()
+        # the singleton admin endpoint answers (worker 0 only)
+        def _health():
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/health", timeout=5).status
+        status = await asyncio.get_event_loop().run_in_executor(
+            None, _health)
+        assert status == 200
+    finally:
+        g.stop()

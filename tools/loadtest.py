@@ -301,7 +301,7 @@ def main() -> None:
                          "processes (0 = in-process single broker)")
     ap.add_argument("--client-procs", type=int, default=0,
                     help="client shard processes (default: = workers)")
-    ap.add_argument("--cluster-base", type=int, default=45600)
+    ap.add_argument("--cluster-base", type=int, default=25600)
     ap.add_argument("--latency", action="store_true",
                     help="sample end-to-end delivery latency")
     ap.add_argument("--jax-platform", default=None,

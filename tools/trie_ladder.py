@@ -1,0 +1,81 @@
+"""Host-trie microbench ladder — the reference's trie bench suite shape
+(``vmq_reg_trie_bench_SUITE.erl:97-214``: insert / single-lookup /
+fanout-lookup / delete wall time at 1k, 2k, ... subscriptions).
+
+Runs the same ladder against ``models/trie.py`` (the host oracle that
+backs every broker when the device view is off/degraded) and prints one
+JSON line per rung.
+
+  python tools/trie_ladder.py [--max 1048576] [--lookups 20000]
+"""
+import argparse
+import json
+import random
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def run_rung(n: int, lookups: int, rng: random.Random) -> dict:
+    from vernemq_tpu.models.trie import SubscriptionTrie
+
+    t = SubscriptionTrie()
+    # reference shape: 3-level topics, a mix of exact and wildcard
+    # filters (the SUITE inserts {client, topic} rows of both kinds)
+    filters = []
+    for i in range(n):
+        a, b = i % 251, (i // 251) % 97
+        kind = i % 10
+        if kind == 0:
+            f = [f"lvl{a}", "+", f"leaf{i % 1009}"]
+        elif kind == 1:
+            f = [f"lvl{a}", f"mid{b}", "#"]
+        else:
+            f = [f"lvl{a}", f"mid{b}", f"leaf{i % 1009}"]
+        filters.append((f, i))
+    t0 = time.perf_counter()
+    for f, key in filters:
+        t.add(f, key, None)
+    insert_s = time.perf_counter() - t0
+
+    topics = [[f"lvl{rng.randrange(251)}", f"mid{rng.randrange(97)}",
+               f"leaf{rng.randrange(1009)}"] for _ in range(lookups)]
+    t0 = time.perf_counter()
+    matched = 0
+    for tp in topics:
+        matched += len(t.match(tp))
+    lookup_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    for f, key in filters:
+        t.remove(f, key)
+    delete_s = time.perf_counter() - t0
+
+    return {
+        "subs": n,
+        "insert_s": round(insert_s, 3),
+        "inserts_per_sec": round(n / insert_s),
+        "lookup_us_avg": round(1e6 * lookup_s / lookups, 2),
+        "lookups_per_sec": round(lookups / lookup_s),
+        "avg_fanout": round(matched / lookups, 2),
+        "delete_s": round(delete_s, 3),
+        "deletes_per_sec": round(n / delete_s),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--max", type=int, default=1 << 20)
+    ap.add_argument("--lookups", type=int, default=20_000)
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+    rng = random.Random(args.seed)
+    n = 1024
+    while n <= args.max:
+        print(json.dumps(run_rung(n, args.lookups, rng)), flush=True)
+        n *= 2
+
+
+if __name__ == "__main__":
+    main()

@@ -97,7 +97,7 @@ def main(argv: List[str] | None = None) -> int:
               "  vmq-admin node status\n"
               "  vmq-admin session show --limit=10\n"
               "  vmq-admin metrics show\n"
-              "  vmq-admin cluster join discovery-node=host:44053\n"
+              "  vmq-admin cluster join discovery-node=host:24053\n"
               "  vmq-admin api-key create\n")
         return 0
 

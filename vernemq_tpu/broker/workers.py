@@ -35,8 +35,8 @@ import sys
 import time
 from typing import Any, Dict, List, Optional
 
-#: cluster channel of worker i listens on loopback at base + i
-DEFAULT_CLUSTER_BASE = 44100
+#: cluster channel of worker i listens on loopback at base + i (kept BELOW the kernel ephemeral port range 32768+, or client sockets collide with it under load)
+DEFAULT_CLUSTER_BASE = 24100
 
 
 def _run_worker(idx: int, n_workers: int, host: str, port: int,
