@@ -170,3 +170,61 @@ async def test_collector_overload_sheds_to_host_trie():
     assert order == sorted(order), "futures released out of order"
     assert rows[-1][0][0] == "host-row"  # tail was host-shed
     assert view.max_active <= BatchCollector.MAX_INFLIGHT
+
+
+@pytest.mark.asyncio
+async def test_per_publisher_order_preserved_under_slow_device():
+    """Broker-level FIFO: one publisher streams QoS0 publishes through
+    the batched device view (nowait path) while device batches are
+    artificially slow and racing in the two pipeline slots; the
+    subscriber must see every message in publish order."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    b, s = await start_broker(
+        Config(systree_enabled=False, allow_anonymous=True,
+               default_reg_view="tpu", sysmon_enabled=False,
+               tpu_batch_window_us=2000, tpu_host_batch_threshold=2),
+        port=0)
+    try:
+        view = b.registry.reg_view("tpu")
+        assert hasattr(view, "fold_batch")  # real device view (cpu)
+        m = view.matcher("")
+        orig = m.match_batch
+        calls = []
+
+        def slow_match(topics, _warmup=False):
+            if not _warmup:
+                calls.append(len(topics))
+                # VARIABLE latency: odd-numbered batches are much slower
+                # than even ones, so with both pipeline slots racing, a
+                # newer batch finishes BEFORE an older one — exactly the
+                # reorder window the FIFO release must absorb
+                time.sleep(0.08 if len(calls) % 2 else 0.005)
+            return orig(topics, _warmup=_warmup)
+
+        m.match_batch = slow_match
+        sub = MQTTClient(s.host, s.port, "ord-sub")
+        await sub.connect()
+        await sub.subscribe("ord/#", qos=0)
+        await asyncio.sleep(0.2)
+        pub = MQTTClient(s.host, s.port, "ord-pub")
+        await pub.connect()
+        n = 120
+        for i in range(n):
+            await pub.publish("ord/t", b"%04d" % i, qos=0)
+            if i % 10 == 0:
+                await asyncio.sleep(0.005)  # spread across batch windows
+        got = []
+        for _ in range(n):
+            f = await sub.recv(10.0)
+            assert f is not None
+            got.append(int(f.payload))
+        assert got == list(range(n)), (
+            f"reordered: first bad at {next(i for i, (a, b2) in enumerate(zip(got, range(n))) if a != b2)}")
+        await sub.disconnect()
+        await pub.disconnect()
+    finally:
+        await b.stop()
+        await s.stop()
