@@ -365,3 +365,82 @@ async def test_backlog_survives_session_detach():
     finally:
         await b.stop()
         await s.stop()
+
+
+# --------------------------------------------- batched QoS0 fanout (r4)
+
+
+@pytest.mark.asyncio
+async def test_fanout_fast_path_mixed_recipients():
+    """The shared-frame QoS0 fanout must deliver identically across its
+    eligible class (lone online v4 sessions) and the per-recipient
+    queue path (v5 sessions, QoS1 subs getting a QoS0 publish)."""
+    b, s = await boot()
+    try:
+        v4a, _ = await connected(s, "ff-v4a")
+        v4b, _ = await connected(s, "ff-v4b")
+        v5, _ = await connected(s, "ff-v5", proto_ver=5)
+        q1, _ = await connected(s, "ff-q1")
+        await v4a.subscribe("ff/t", qos=0)
+        await v4b.subscribe("ff/t", qos=0)
+        await v5.subscribe("ff/t", qos=0)
+        await q1.subscribe("ff/t", qos=1)  # delivered qos = min(1,0) = 0
+        pub, _ = await connected(s, "ff-pub")
+        await pub.publish("ff/t", b"mix", qos=0)
+        for c in (v4a, v4b, v5, q1):
+            f = await c.recv(5.0)
+            assert f is not None and f.payload == b"mix" and f.qos == 0
+        assert b.metrics.value("mqtt_publish_sent") >= 4
+        for c in (v4a, v4b, v5, q1, pub):
+            await c.disconnect()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_fanout_fast_path_retained_and_rap():
+    """retain-as-published across the fanout split: rap=True sees
+    retain=True on a retained publish, rap=False gets retain=False (the
+    per-recipient transform path)."""
+    b, s = await boot()
+    try:
+        rap, _ = await connected(s, "ff-rap", proto_ver=5)
+        await rap.subscribe("ff/r", opts=SubOpts(qos=0, rap=True))
+        plain, _ = await connected(s, "ff-plain")
+        await plain.subscribe("ff/r", qos=0)
+        pub, _ = await connected(s, "ff-pub2")
+        await pub.publish("ff/r", b"ret", qos=0, retain=True)
+        f_rap = await rap.recv(5.0)
+        f_plain = await plain.recv(5.0)
+        assert f_rap is not None and f_rap.retain is True
+        assert f_plain is not None and f_plain.retain is False
+        for c in (rap, plain, pub):
+            await c.disconnect()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_fanout_fast_path_fires_on_deliver_hooks():
+    b, s = await boot()
+    try:
+        seen = []
+        b.hooks.register("on_deliver",
+                         lambda user, sid, topic, payload:
+                         seen.append((sid, topic)))
+        s1, _ = await connected(s, "ffh-1")
+        s2, _ = await connected(s, "ffh-2")
+        await s1.subscribe("ffh/t", qos=0)
+        await s2.subscribe("ffh/t", qos=0)
+        pub, _ = await connected(s, "ffh-pub")
+        await pub.publish("ffh/t", b"hk", qos=0)
+        assert (await s1.recv(5.0)).payload == b"hk"
+        assert (await s2.recv(5.0)).payload == b"hk"
+        assert {sid for sid, _ in seen} >= {("", "ffh-1"), ("", "ffh-2")}
+        for c in (s1, s2, pub):
+            await c.disconnect()
+    finally:
+        await b.stop()
+        await s.stop()

@@ -56,3 +56,23 @@ class Msg:
             properties=self.properties,
             expires_at=self.expires_at,
         )
+
+
+def wire_v4_qos0(msg: "Msg") -> bytes:
+    """The v4 QoS0 PUBLISH wire frame for ``msg``, cached on the Msg:
+    identical for every v4 QoS0 recipient (no packet id, no props, no
+    per-session state), so fanout serialises once. Shared by the
+    session send path and the registry's batched fanout — ONE
+    serialisation site, one cache slot."""
+    data = getattr(msg, "_wire_v4_q0", None)
+    if data is None:
+        from ..protocol import codec_v4
+        from ..protocol import topic as T
+        from ..protocol.types import Publish
+
+        frame = Publish(topic=T.unword(list(msg.topic)),
+                        payload=msg.payload, qos=0, retain=msg.retain,
+                        dup=False, packet_id=None, properties={})
+        data = codec_v4.serialise(frame)
+        msg._wire_v4_q0 = data
+    return data

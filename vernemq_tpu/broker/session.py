@@ -620,14 +620,9 @@ class Session:
             # of the serialise cost on the delivery path (the analog of
             # the reference serialising in vmq_mqtt_fsm once per frame,
             # but across recipients)
-            data = getattr(msg, "_wire_v4_q0", None)
-            if data is None:
-                frame = Publish(topic=T.unword(list(msg.topic)),
-                                payload=msg.payload, qos=0,
-                                retain=msg.retain, dup=False,
-                                packet_id=None, properties={})
-                data = self.codec.serialise(frame)
-                msg._wire_v4_q0 = data
+            from .message import wire_v4_qos0
+
+            data = wire_v4_qos0(msg)
             self.transport.write(data)
             m = self.broker.metrics
             m.incr("bytes_sent", len(data))
