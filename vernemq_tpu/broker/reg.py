@@ -145,6 +145,7 @@ class Registry:
         self.queues: Dict[SubscriberId, SubscriberQueue] = {}
         self.reg_views: Dict[str, Any] = {"trie": TrieRegView(self)}
         self._accel_probe_task: Optional[Any] = None
+        self.fanout_fast_pubs = 0
         # remote plain subscriptions collapse to one node-pointer trie row
         # per (mountpoint, filter, node), refcounted
         # (vmq_reg_trie.erl:503-520 remote-subs handling)
@@ -800,6 +801,7 @@ class Registry:
             sess.transport.write(data)
             delivered += 1
         if delivered:
+            self.fanout_fast_pubs += 1
             m = self.broker.metrics
             m.incr("queue_message_in", delivered)
             m.incr("queue_message_out", delivered)
@@ -852,6 +854,9 @@ class Registry:
             "router_subscriptions": total,
             "router_memory": mem,
             "queue_processes": len(self.queues),
+            # publishes whose whole local fanout took the shared-frame
+            # QoS0 fast path (vs the per-recipient queue path)
+            "router_fanout_fast_pubs": self.fanout_fast_pubs,
         }
         # device-matcher gauges when the TPU reg view is live (the
         # router_subscriptions/router_memory pair extended with the HBM
