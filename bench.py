@@ -183,6 +183,8 @@ class WindowedBench:
         m = self.m
         args, statics, _, _, _ = prep
         F_t, t1 = m._operands
+        if self.variant == "packed":
+            return K.call_packed(F_t, t1, m._meta, args, statics)
         head = (F_t, t1, m._dev_arrays[1], m._dev_arrays[2],
                 m._dev_arrays[3], m._dev_arrays[4])
         if self.variant == "rows":
@@ -197,6 +199,8 @@ class WindowedBench:
         return K.match_extract_windowed_flat(*head, *args, **statics)
 
     def run(self, iters, warmup=6, measure_resolve=True):
+        from vernemq_tpu.ops import match_kernel as K
+
         topics_batches = [zipf_topics(self.rng, self.pools, self.batch)
                           for _ in range(min(iters, 8))]
         # warmup: compile + first-run executable warm (first executions on
@@ -209,6 +213,12 @@ class WindowedBench:
 
         def pull(out):
             # the production round trip: every result array to host
+            if self.variant == "packed":
+                o = np.asarray(out)          # ONE transfer
+                Bpad = (o.size // (self.m.flat_avg + 3))
+                _, _, total, ovf = K.unpack_flat_result(
+                    o, Bpad, Bpad * self.m.flat_avg)
+                return int(total.sum(dtype=np.int64)), int(ovf.sum())
             if self.variant == "rows":
                 np.asarray(out[0])
                 total = np.asarray(out[1])
@@ -345,6 +355,10 @@ def main() -> int:
     ap.add_argument("--max-fanout", type=int, default=256)
     ap.add_argument("--levels", type=int, default=8)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--variant", default="packed",
+                    choices=["packed", "flat", "rows", "pallas"],
+                    help="windowed-kernel transport/merge variant "
+                    "(packed = production default: single-vector I/O)")
     ap.add_argument("--configs", default="1,2,3,4,5",
                     help="which BASELINE configs to run (3 = headline)")
     ap.add_argument("--platform", default=None,
@@ -403,7 +417,8 @@ def main() -> int:
                        [rng.choice(l0), rng.choice(l1), rng.choice(l2)],
                        i, None)
             wb2 = WindowedBench(jax, t2, (l0, l1, l2), rng,
-                                min(args.batch, 2048), args.max_fanout)
+                                min(args.batch, 2048), args.max_fanout,
+                                variant=args.variant)
             r2 = wb2.run(max(8, args.iters // 2), measure_resolve=False)
             return {k: round(v, 3) if isinstance(v, float) else v
                     for k, v in r2.items() if v is not None}
@@ -423,7 +438,7 @@ def main() -> int:
         build_s = time.perf_counter() - t0
         note(f"[bench] corpus built in {build_s:.1f}s")
         wb = WindowedBench(jax, table, pools, rng, args.batch,
-                           args.max_fanout)
+                           args.max_fanout, variant=args.variant)
         note(f"[bench] upload {wb.upload_s:.1f}s; running config 3...")
         headline = wb.run(args.iters)
         headline["build_s"] = round(build_s, 2)
@@ -444,7 +459,8 @@ def main() -> int:
         pools5 = build_corpus(rng, n5, t5)
         build5 = time.perf_counter() - t0
         wb5 = WindowedBench(jax, t5, pools5, rng,
-                            min(args.batch, 2048), args.max_fanout)
+                            min(args.batch, 2048), args.max_fanout,
+                            variant=args.variant)
         r5 = wb5.run(max(6, args.iters // 4), measure_resolve=False)
         # delta streaming: steady-state subscribe/unsubscribe applied as
         # device scatters between batches (BASELINE config 5; multi-node

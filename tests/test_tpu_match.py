@@ -706,3 +706,59 @@ def test_rows_variant_matches_flat_kernel():
         a = sorted(flat[pre[i]:pre[i] + total[i]])
         b = sorted(rows[i, :rtotal[i]])
         assert a == b, (i, topics[i])
+
+def test_packed_variant_matches_flat_kernel():
+    """match_extract_windowed_flat_packed (single-vector transport) parses
+    back to exactly the unpacked kernel's (flat, pre, total, overflow) —
+    guards the flat_pack_args/unpack layout against drift."""
+    import numpy as np
+
+    from vernemq_tpu.ops import match_kernel as K
+
+    rng = random.Random(22)
+    m = _bucketed_matcher(max_fanout=64)
+    for i in range(10000):
+        m.table.add(corpus_filter(rng), i, None)
+    topics = [(f"r{rng.randrange(16)}", f"d{rng.randrange(40)}",
+               f"m{rng.randrange(16)}") for _ in range(64)]
+    with m.lock:
+        m.sync()
+    pw, pl, pd, pb, gb = m._encode_batch_ex(topics)
+    S = int(m._dev_arrays[0].shape[0])
+    args, statics, left = m._flat_prep(
+        m._reg_start, m._reg_end, m._glob_pad, m._ops_bits, S,
+        pw, pl, pd, pb, gb, len(topics))
+    head = (m._operands[0], m._operands[1], m._dev_arrays[1],
+            m._dev_arrays[2], m._dev_arrays[3], m._dev_arrays[4])
+    flat, pre, total, ovf = (np.asarray(x) for x in
+                             K.match_extract_windowed_flat(
+                                 *head, *args, **statics))
+    Bpad = args[0].shape[0]
+    out = np.asarray(K.call_packed(
+        m._operands[0], m._operands[1], m._meta, args, statics))
+    C = statics["C"]
+    assert out.shape == (C + 3 * Bpad,)
+    pflat, ppre, ptotal, povf = K.unpack_flat_result(out, Bpad, C)
+    np.testing.assert_array_equal(pflat, flat)
+    np.testing.assert_array_equal(ppre, pre)
+    np.testing.assert_array_equal(ptotal, total)
+    np.testing.assert_array_equal(povf, ovf)
+
+
+def test_packed_io_off_parity():
+    """packed_io=False (the unpacked per-array transport) still serves
+    match_batch with oracle parity — the knob must stay a pure transport
+    choice with zero semantic effect."""
+    rng = random.Random(23)
+    m = TpuMatcher(max_levels=8, initial_capacity=16384, packed_io=False)
+    assert m.table.bucketed and m._meta is None
+    trie = SubscriptionTrie()
+    for i in range(8000):
+        f = corpus_filter(rng)
+        m.table.add(f, i, None)
+        trie.add(list(f), i, None)
+    topics = [(f"r{rng.randrange(16)}", f"d{rng.randrange(40)}",
+               f"m{rng.randrange(16)}") for _ in range(100)]
+    for topic, rows in zip(topics, m.match_batch(topics)):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+    assert m._meta is None

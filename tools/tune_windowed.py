@@ -47,6 +47,9 @@ def main():
     if "--pallas" in argv:  # fused Pallas tile matcher (probe phases)
         argv.remove("--pallas")
         variant = "pallas"
+    if "--packed" in argv:  # single-vector I/O transport (production)
+        argv.remove("--packed")
+        variant = "packed"
     tps = _axis(argv, "tp", [128, 256])
     bs = _axis(argv, "b", [2048, 4096, 8192])
     fms = _axis(argv, "fm", [2])

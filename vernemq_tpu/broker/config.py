@@ -79,6 +79,11 @@ DEFAULTS: Dict[str, Any] = {
     # off by default until the on-chip A/B (tools/tune_windowed.py
     # --pallas) shows a win — self-disables if Mosaic lowering fails
     "tpu_use_pallas": False,
+    # packed transport for the windowed kernel: ONE int32 upload vector
+    # and ONE result vector per batch instead of 12 args + 4 pulls —
+    # per-argument dispatch latency dominates on tunnel-attached
+    # accelerators (tools/probe_tunnel.py)
+    "tpu_packed_io": True,
     # flushes this small are matched on the host trie instead of paying a
     # device round trip (hybrid dispatch, SURVEY.md §7.2); 0 disables
     "tpu_host_batch_threshold": 8,

@@ -222,6 +222,7 @@ class Registry:
             self, max_fanout=self.broker.config.tpu_max_fanout,
             flat_avg=self.broker.config.tpu_flat_avg,
             use_pallas=self.broker.config.tpu_use_pallas,
+            packed_io=self.broker.config.tpu_packed_io,
             initial_capacity=self.broker.config.tpu_initial_capacity,
         )
 
@@ -278,6 +279,7 @@ class Registry:
                         self, max_fanout=self.broker.config.tpu_max_fanout,
                         flat_avg=self.broker.config.tpu_flat_avg,
                         use_pallas=self.broker.config.tpu_use_pallas,
+                        packed_io=self.broker.config.tpu_packed_io,
                         initial_capacity=self.broker.config
                         .tpu_initial_capacity)
                     log.warning("accelerator recovered; TPU reg view "

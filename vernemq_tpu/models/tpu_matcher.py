@@ -191,7 +191,7 @@ def prepare_windows(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
 class TpuMatcher:
     def __init__(self, max_levels: int = 16, initial_capacity: int = 1024,
                  max_fanout: int = 256, device=None, flat_avg: int = 128,
-                 use_pallas: bool = False):
+                 use_pallas: bool = False, packed_io: bool = True):
         import threading
 
         import jax
@@ -204,6 +204,12 @@ class TpuMatcher:
         # attached runtime (the XLA kernel is the always-works fallback)
         self.use_pallas = use_pallas
         self._pallas_broken = False
+        # packed transport: ship all per-batch host args as ONE int32
+        # vector and pull all results as ONE int32 vector — on the
+        # tunnel-attached runtime each argument/output costs fixed
+        # latency (probe_tunnel.py), so 12-in/4-out costs ~3x 2-in/1-out
+        self.packed_io = packed_io
+        self._meta = None  # int32 [S] pack_meta word per slot
         # flat-compaction capacity per pub AVERAGED over the batch (the
         # [C = Bpad*flat_avg] device result buffer); a batch whose total
         # fanout exceeds it degrades per-pub to the host path, it never
@@ -262,6 +268,8 @@ class TpuMatcher:
                                  bits)
                 if bits else None
             )
+            self._meta = (K.pack_meta(*self._dev_arrays[1:5])
+                          if self.packed_io else None)
             self._ops_bits = bits
             self._reg_start = t.reg_start.copy()
             self._reg_end = (t.reg_start + t.reg_cap).copy()
@@ -301,6 +309,9 @@ class TpuMatcher:
         slots_dev = self._jax.device_put(slots, self.device)
         w_dev = self._jax.device_put(t.words[slots], self.device)
         e_dev = self._jax.device_put(t.eff_len[slots], self.device)
+        hh_dev = self._jax.device_put(t.has_hash[slots], self.device)
+        fw_dev = self._jax.device_put(t.first_wild[slots], self.device)
+        ac_dev = self._jax.device_put(t.active[slots], self.device)
         # donating scatter updates in place (a 128-slot delta at 5M subs
         # otherwise copies ~500MB of HBM, ~300ms measured); fall back to
         # the copying variant while a dispatched match still holds refs
@@ -309,14 +320,19 @@ class TpuMatcher:
                      else K.apply_delta_operands_copy)
         self._dev_arrays = delta(
             sw, el, hh, fw, ac, slots_dev, w_dev, e_dev,
-            self._jax.device_put(t.has_hash[slots], self.device),
-            self._jax.device_put(t.first_wild[slots], self.device),
-            self._jax.device_put(t.active[slots], self.device),
+            hh_dev, fw_dev, ac_dev,
         )
         if self._operands is not None:
             self._operands = delta_ops(
                 *self._operands, slots_dev, w_dev, e_dev,
                 id_bits=self._ops_bits)
+        if self.packed_io and self._meta is not None:
+            # O(dirty) scatter of the packed word — same donate-vs-copy
+            # discipline as the base arrays
+            dm = (K.apply_delta_meta if self._inflight == 0
+                  else K.apply_delta_meta_copy)
+            self._meta = dm(self._meta, slots_dev, e_dev, hh_dev, fw_dev,
+                            ac_dev)
         # region geometry may have moved WITHOUT a resize (bucket
         # relocation into the spare tail) — refresh the window view
         self._reg_start = t.reg_start.copy()
@@ -415,6 +431,7 @@ class TpuMatcher:
             self.sync()
             dev_arrays = self._dev_arrays
             operands = self._operands
+            meta = self._meta
             snapshot = self._entries_snapshot
             bucketed = self._bucketed and operands is not None
             if bucketed:
@@ -433,8 +450,8 @@ class TpuMatcher:
         try:
             if bucketed:
                 idx_rows, need_host = self._match_windowed(
-                    dev_arrays, operands, reg_start, reg_end, glob_pad,
-                    bits, pw, pl, pd, pb, gb, len(topics))
+                    dev_arrays, operands, meta, reg_start, reg_end,
+                    glob_pad, bits, pw, pl, pd, pb, gb, len(topics))
             else:
                 chunk = 1024 if pw.shape[0] > 1024 else 0  # lax.map serialises
                 # full-scan fallback: MXU matmul path needs byte-splittable
@@ -538,8 +555,8 @@ class TpuMatcher:
                        C=Bpad * self.flat_avg)
         return args, statics, set(leftovers) | set(left2)
 
-    def _match_windowed(self, dev_arrays, operands, reg_start, reg_end,
-                        glob_pad, bits, pw, pl, pd, pb, gb, n):
+    def _match_windowed(self, dev_arrays, operands, meta, reg_start,
+                        reg_end, glob_pad, bits, pw, pl, pd, pb, gb, n):
         """Run the windowed device path (the production kernel, flat
         variant): a dense pass over region 0 plus probe-A (level-0
         bucket) and probe-B (level-1 g-bucket) window tiles, compacted
@@ -556,9 +573,9 @@ class TpuMatcher:
             reg_start, reg_end, glob_pad, bits, S, pw, pl, pd, pb, gb, n,
             align=2048 if pallas else 0)
         F_t, t1 = operands
-        table_args = (F_t, t1, dev_arrays[1], dev_arrays[2], dev_arrays[3],
-                      dev_arrays[4])
         if pallas:
+            table_args = (F_t, t1, dev_arrays[1], dev_arrays[2],
+                          dev_arrays[3], dev_arrays[4])
             from ..ops import pallas_match as P
             try:
                 flat, pre, total, overflow = \
@@ -573,7 +590,22 @@ class TpuMatcher:
                 self._pallas_broken = True
                 flat, pre, total, overflow = K.match_extract_windowed_flat(
                     *table_args, *args, **statics)
+        elif self.packed_io and meta is not None:
+            # single-upload / single-pull transport (see pack_meta /
+            # flat_pack_args): one int32 vector each way instead of 12
+            # uploads + 4 pulls — per-argument tunnel latency dominates
+            # the per-batch wall otherwise
+            out = np.asarray(K.call_packed(F_t, t1, meta, args, statics))
+            flat, pre, total, overflow = K.unpack_flat_result(
+                out, args[0].shape[0], statics["C"])
+            need_host = overflow[:n].copy()
+            for i in left:
+                need_host[i] = True
+            idx_rows = [flat[pre[i]:pre[i] + total[i]] for i in range(n)]
+            return idx_rows, need_host
         else:
+            table_args = (F_t, t1, dev_arrays[1], dev_arrays[2],
+                          dev_arrays[3], dev_arrays[4])
             flat, pre, total, overflow = K.match_extract_windowed_flat(
                 *table_args, *args, **statics)
         flat = np.asarray(flat)
@@ -609,12 +641,14 @@ class TpuRegView:
 
     def __init__(self, registry, max_levels: int = 16,
                  initial_capacity: int = 1024, max_fanout: int = 256,
-                 flat_avg: int = 128, use_pallas: bool = False):
+                 flat_avg: int = 128, use_pallas: bool = False,
+                 packed_io: bool = True):
         self.registry = registry
         self._matchers: Dict[str, TpuMatcher] = {}
         self._mk = lambda: TpuMatcher(max_levels, initial_capacity,
                                       max_fanout, flat_avg=flat_avg,
-                                      use_pallas=use_pallas)
+                                      use_pallas=use_pallas,
+                                      packed_io=packed_io)
 
     def matcher(self, mountpoint: str = "") -> TpuMatcher:
         """Get/create the mountpoint's matcher. Warm-load MUST run on the

@@ -675,6 +675,20 @@ def match_extract_windowed_flat(
     capacity exhausted or a part clipped at k — exact host fallback, the
     same escape hatch as the padded path's count>k contract).
     """
+    return _windowed_flat_core(
+        F_t, t1, sub_eff_len, has_hash, first_wild, active,
+        pub_words, pub_len, pub_dollar, n_real, t_sel, t_start,
+        t2_sel, t2_start, a_tile, a_pos, b_tile, b_pos,
+        id_bits=id_bits, k=k, glob_pad=glob_pad, seg_max=seg_max,
+        seg2_max=seg2_max, gc=gc, C=C)
+
+
+def _windowed_flat_core(F_t, t1, sub_eff_len, has_hash, first_wild, active,
+                        pub_words, pub_len, pub_dollar, n_real,
+                        t_sel, t_start, t2_sel, t2_start,
+                        a_tile, a_pos, b_tile, b_pos, *,
+                        id_bits, k, glob_pad, seg_max, seg2_max, gc, C):
+    """Shared body of the flat windowed kernels (plain and packed-I/O)."""
     B = pub_words.shape[0]
     real = jnp.arange(B, dtype=jnp.int32) < n_real
 
@@ -705,6 +719,140 @@ def match_extract_windowed_flat(
     # entire raw fanout and cascade spurious capacity overflows (= slow
     # exact host scans) across the rest of the batch.
     return _flat_combine(real, k, C, g, a, b)
+
+
+@jax.jit
+def pack_meta(sub_eff_len, has_hash, first_wild, active):
+    """Fuse the four per-slot metadata arrays into ONE int32 [S] word
+    (eff_len in bits 0-15, has_hash/first_wild/active at bits 16-18).
+    Built once per table sync; the packed-I/O kernel takes this single
+    device-resident argument instead of four — on the tunnel runtime
+    every argument costs ~3-5ms of dispatch latency per call."""
+    return _pack_meta_vals(sub_eff_len, has_hash, first_wild, active)
+
+
+def flat_pack_args(args) -> "np.ndarray":
+    """Host side of the packed transport: concatenate every per-batch
+    host argument of :func:`match_extract_windowed_flat` into ONE int32
+    vector (uploaded as a single transfer; the tunnel charges ~fixed
+    latency *per argument*, so 12 small uploads cost far more than one
+    medium one). Layout must mirror the unpacking in
+    :func:`match_extract_windowed_flat_packed`."""
+    import numpy as np
+
+    (pw, pl, pd, n_real, t_sel, t_start, t2_sel, t2_start,
+     a_tile, a_pos, b_tile, b_pos) = args
+    return np.concatenate([
+        np.ascontiguousarray(pw, dtype=np.int32).ravel(),
+        np.asarray(pl, dtype=np.int32).ravel(),
+        np.asarray(pd, dtype=np.int32).ravel(),
+        np.asarray([n_real], dtype=np.int32),
+        np.ascontiguousarray(t_sel, dtype=np.int32).ravel(),
+        np.asarray(t_start, dtype=np.int32).ravel(),
+        np.ascontiguousarray(t2_sel, dtype=np.int32).ravel(),
+        np.asarray(t2_start, dtype=np.int32).ravel(),
+        np.asarray(a_tile, dtype=np.int32).ravel(),
+        np.asarray(a_pos, dtype=np.int32).ravel(),
+        np.asarray(b_tile, dtype=np.int32).ravel(),
+        np.asarray(b_pos, dtype=np.int32).ravel(),
+    ])
+
+
+def _pack_meta_vals(el, hh, fw, ac):
+    return (el.astype(jnp.int32)
+            | (hh.astype(jnp.int32) << 16)
+            | (fw.astype(jnp.int32) << 17)
+            | (ac.astype(jnp.int32) << 18))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_delta_meta(meta, slots, el, hh, fw, ac):
+    """O(dirty) scatter of the pack_meta word for changed slots —
+    mirrors apply_delta's donate/scatter design so a delta sync never
+    rebuilds (or reallocates) the full [S] meta buffer."""
+    return meta.at[slots].set(_pack_meta_vals(el, hh, fw, ac))
+
+
+@jax.jit
+def apply_delta_meta_copy(meta, slots, el, hh, fw, ac):
+    """Non-donating variant for when an in-flight match holds ``meta``."""
+    return meta.at[slots].set(_pack_meta_vals(el, hh, fw, ac))
+
+
+def call_packed(F_t, t1, meta, args, statics):
+    """The one call shape for the packed transport: derives the static
+    geometry from the arg shapes, packs the host args, invokes the
+    kernel. Production, bench and tests all go through here so the
+    flat_pack_args layout and the kernel's shape contract cannot
+    drift apart."""
+    B, L = args[0].shape
+    T, TP = args[4].shape
+    T2 = args[6].shape[0]
+    return match_extract_windowed_flat_packed(
+        F_t, t1, meta, flat_pack_args(args),
+        B=B, L=L, T=T, TP=TP, T2=T2, **statics)
+
+
+def unpack_flat_result(out, B: int, C: int):
+    """Decode :func:`match_extract_windowed_flat_packed`'s single result
+    vector ``[C + 3B]`` into ``(flat [C], pre [B], total [B],
+    overflow [B] bool)`` — the one place that knows the packed layout.
+    ``B`` is the PADDED batch (args[0].shape[0]), not the real pub
+    count."""
+    return (out[:C], out[C:C + B], out[C + B:C + 2 * B],
+            out[C + 2 * B:C + 3 * B].astype(bool))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("B", "L", "T", "TP", "T2", "id_bits",
+                                    "k", "glob_pad", "seg_max", "seg2_max",
+                                    "gc", "C"))
+def match_extract_windowed_flat_packed(
+    F_t: jax.Array,          # bf16 [K, S] coded operands (build_operands)
+    t1: jax.Array,           # f32 [S]
+    meta: jax.Array,         # int32 [S] pack_meta word
+    packed: jax.Array,       # int32 [·] flat_pack_args transport vector
+    *,
+    B: int, L: int, T: int, TP: int, T2: int,
+    id_bits: int, k: int, glob_pad: int, seg_max: int, seg2_max: int,
+    gc: int, C: int,
+) -> jax.Array:
+    """Packed-I/O variant of :func:`match_extract_windowed_flat` for
+    tunnel-attached accelerators: 4 call arguments instead of 18, ONE
+    host→device transfer (the ``packed`` vector) and ONE device→host
+    transfer (the concatenated int32 result) per batch. On a runtime
+    where each argument and each output pull pays ~3-65ms of latency
+    (probe_tunnel.py numbers) this converts 4 result round trips + 12
+    argument uploads into 1 + 1.
+
+    Returns one int32 ``[C + 3B]`` vector: ``flat = out[:C]``,
+    ``pre = out[C:C+B]``, ``total = out[C+B:C+2B]``,
+    ``overflow = out[C+2B:].astype(bool)`` — same contract as the
+    unpacked kernel's four arrays.
+    """
+    eff = meta & 0xFFFF
+    hh = ((meta >> 16) & 1).astype(bool)
+    fw = ((meta >> 17) & 1).astype(bool)
+    act = ((meta >> 18) & 1).astype(bool)
+    o = 0
+    pw = packed[o:o + B * L].reshape(B, L); o += B * L
+    pl = packed[o:o + B]; o += B
+    pd = packed[o:o + B].astype(bool); o += B
+    n_real = packed[o]; o += 1
+    t_sel = packed[o:o + T * TP].reshape(T, TP); o += T * TP
+    t_start = packed[o:o + T]; o += T
+    t2_sel = packed[o:o + T2 * TP].reshape(T2, TP); o += T2 * TP
+    t2_start = packed[o:o + T2]; o += T2
+    a_tile = packed[o:o + B]; o += B
+    a_pos = packed[o:o + B]; o += B
+    b_tile = packed[o:o + B]; o += B
+    b_pos = packed[o:o + B]; o += B
+    flat, pre, total, overflow = _windowed_flat_core(
+        F_t, t1, eff, hh, fw, act, pw, pl, pd, n_real,
+        t_sel, t_start, t2_sel, t2_start, a_tile, a_pos, b_tile, b_pos,
+        id_bits=id_bits, k=k, glob_pad=glob_pad, seg_max=seg_max,
+        seg2_max=seg2_max, gc=gc, C=C)
+    return jnp.concatenate([flat, pre, total, overflow.astype(jnp.int32)])
 
 
 @functools.partial(jax.jit,
