@@ -306,33 +306,47 @@ class TpuMatcher:
             snap[s] = t.entries[s]
         self._entries_snapshot = snap
         sw, el, hh, fw, ac = self._dev_arrays
-        slots_dev = self._jax.device_put(slots, self.device)
-        w_dev = self._jax.device_put(t.words[slots], self.device)
-        e_dev = self._jax.device_put(t.eff_len[slots], self.device)
-        hh_dev = self._jax.device_put(t.has_hash[slots], self.device)
-        fw_dev = self._jax.device_put(t.first_wild[slots], self.device)
-        ac_dev = self._jax.device_put(t.active[slots], self.device)
-        # donating scatter updates in place (a 128-slot delta at 5M subs
+        # donating scatters update in place (a 128-slot delta at 5M subs
         # otherwise copies ~500MB of HBM, ~300ms measured); fall back to
-        # the copying variant while a dispatched match still holds refs
-        delta = K.apply_delta if self._inflight == 0 else K.apply_delta_copy
-        delta_ops = (K.apply_delta_operands if self._inflight == 0
-                     else K.apply_delta_operands_copy)
-        self._dev_arrays = delta(
-            sw, el, hh, fw, ac, slots_dev, w_dev, e_dev,
-            hh_dev, fw_dev, ac_dev,
-        )
-        if self._operands is not None:
-            self._operands = delta_ops(
-                *self._operands, slots_dev, w_dev, e_dev,
-                id_bits=self._ops_bits)
-        if self.packed_io and self._meta is not None:
-            # O(dirty) scatter of the packed word — same donate-vs-copy
-            # discipline as the base arrays
-            dm = (K.apply_delta_meta if self._inflight == 0
-                  else K.apply_delta_meta_copy)
-            self._meta = dm(self._meta, slots_dev, e_dev, hh_dev, fw_dev,
-                            ac_dev)
+        # the copying variants while a dispatched match still holds refs
+        donate = self._inflight == 0
+        if self._meta is not None and self._operands is not None:
+            # fused transport: ONE packed upload + ONE call updates base
+            # arrays, coded operands and the meta word together — the
+            # unfused path's 6 uploads + 2 dispatches cost ~600ms/delta
+            # of pure transfer latency on the tunnel runtime
+            packed = K.delta_pack_args(
+                slots, t.words[slots], t.eff_len[slots],
+                t.has_hash[slots], t.first_wild[slots], t.active[slots])
+            fused = (K.apply_delta_fused if donate
+                     else K.apply_delta_fused_copy)
+            self._dev_arrays, self._operands, self._meta = fused(
+                sw, el, hh, fw, ac, *self._operands, self._meta,
+                self._jax.device_put(packed, self.device),
+                D=len(slots), L=t.words.shape[1], id_bits=self._ops_bits)
+        else:
+            slots_dev = self._jax.device_put(slots, self.device)
+            w_dev = self._jax.device_put(t.words[slots], self.device)
+            e_dev = self._jax.device_put(t.eff_len[slots], self.device)
+            hh_dev = self._jax.device_put(t.has_hash[slots], self.device)
+            fw_dev = self._jax.device_put(t.first_wild[slots], self.device)
+            ac_dev = self._jax.device_put(t.active[slots], self.device)
+            delta = K.apply_delta if donate else K.apply_delta_copy
+            delta_ops = (K.apply_delta_operands if donate
+                         else K.apply_delta_operands_copy)
+            self._dev_arrays = delta(
+                sw, el, hh, fw, ac, slots_dev, w_dev, e_dev,
+                hh_dev, fw_dev, ac_dev,
+            )
+            if self._operands is not None:
+                self._operands = delta_ops(
+                    *self._operands, slots_dev, w_dev, e_dev,
+                    id_bits=self._ops_bits)
+            if self.packed_io and self._meta is not None:
+                dm = (K.apply_delta_meta if donate
+                      else K.apply_delta_meta_copy)
+                self._meta = dm(self._meta, slots_dev, e_dev, hh_dev,
+                                fw_dev, ac_dev)
         # region geometry may have moved WITHOUT a resize (bucket
         # relocation into the spare tail) — refresh the window view
         self._reg_start = t.reg_start.copy()

@@ -1028,3 +1028,62 @@ def apply_delta(
 apply_delta_copy = jax.jit(apply_delta.__wrapped__)
 apply_delta_operands_copy = jax.jit(apply_delta_operands.__wrapped__,
                                     static_argnames=("id_bits",))
+
+
+def delta_pack_args(slots, words, eff, hh, fw, ac):
+    """Host side of the fused delta transport: slots + all per-slot delta
+    fields as ONE int32 vector ``[D*(L+5)]``. The unfused path uploads
+    six arrays and dispatches two jit calls per delta sync — on the
+    tunnel runtime that is ~600ms of per-transfer latency for a
+    128-slot delta (BENCH_r04 config 5 delta_apply_ms_p50); one vector
+    + one call collapses it to a single round trip."""
+    import numpy as np
+
+    return np.concatenate([
+        np.asarray(slots, dtype=np.int32).ravel(),
+        np.ascontiguousarray(words, dtype=np.int32).ravel(),
+        np.asarray(eff, dtype=np.int32).ravel(),
+        np.asarray(hh, dtype=np.int32).ravel(),
+        np.asarray(fw, dtype=np.int32).ravel(),
+        np.asarray(ac, dtype=np.int32).ravel(),
+    ])
+
+
+@functools.partial(jax.jit, static_argnames=("D", "L", "id_bits"),
+                   donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def apply_delta_fused(
+    sub_words, sub_eff_len, has_hash, first_wild, active,  # table [S,·]
+    F_t, t1,                                               # coded operands
+    meta,                                                  # pack_meta [S]
+    packed,                                                # delta_pack_args
+    *, D: int, L: int, id_bits: int,
+):
+    """ONE scatter call updating every device-resident structure (base
+    table arrays, coded F/t1 operands, packed meta word) from one packed
+    delta vector. All eight state arrays are DONATED — same in-place
+    contract as :func:`apply_delta`; callers reassign from the return.
+
+    Returns ``((sub_words, eff, hh, fw, ac), (F_t, t1), meta)``.
+    """
+    o = 0
+    slots = packed[o:o + D]; o += D
+    w = packed[o:o + D * L].reshape(D, L); o += D * L
+    e = packed[o:o + D]; o += D
+    nh = packed[o:o + D].astype(bool); o += D
+    nf = packed[o:o + D].astype(bool); o += D
+    na = packed[o:o + D].astype(bool)
+    sub_words = sub_words.at[slots].set(w)
+    sub_eff_len = sub_eff_len.at[slots].set(e)
+    has_hash = has_hash.at[slots].set(nh)
+    first_wild = first_wild.at[slots].set(nf)
+    active = active.at[slots].set(na)
+    F_d, t1_d = build_operands(w, e, id_bits)
+    F_t = F_t.at[:, slots].set(F_d)
+    t1 = t1.at[slots].set(t1_d)
+    meta = meta.at[slots].set(_pack_meta_vals(e, nh, nf, na))
+    return ((sub_words, sub_eff_len, has_hash, first_wild, active),
+            (F_t, t1), meta)
+
+
+apply_delta_fused_copy = jax.jit(apply_delta_fused.__wrapped__,
+                                 static_argnames=("D", "L", "id_bits"))
