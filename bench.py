@@ -475,8 +475,29 @@ def main() -> int:
             t1 = time.perf_counter()
             with wb5.m.lock:
                 wb5.m.sync()
-            jax.block_until_ready(wb5.m._dev_arrays)
+            # honest sync: block_until_ready returns before execution
+            # finishes on the tunnel runtime — only a host transfer
+            # proves the scatter landed (1-element pull ≈ 1 RTT)
+            np.asarray(wb5.m._dev_arrays[1][:1])
             lat.append(time.perf_counter() - t1)
+        # pipelined steady state: back-to-back deltas, one honest sync
+        # at the end — the per-delta cost when churn batches overlap
+        # (the synced number above charges a full RTT to every delta).
+        # Host-side table.add time stays OUTSIDE the clock so this is
+        # directly comparable to the synced loop's sync-only timing.
+        pipelined_s = 0.0
+        for i in range(20, 40):
+            with wb5.m.lock:
+                for j in range(100):
+                    t5.add([rng.choice(l0), rng.choice(l1), f"new{i}-{j}"],
+                           10_000_000 + i * 1000 + j, None)
+            t1 = time.perf_counter()
+            with wb5.m.lock:
+                wb5.m.sync()
+            pipelined_s += time.perf_counter() - t1
+        t1 = time.perf_counter()
+        np.asarray(wb5.m._dev_arrays[1][:1])
+        pipelined_ms = (pipelined_s + time.perf_counter() - t1) / 20 * 1e3
         # subscribe -> first-matchable-publish latency (VERDICT r3 item
         # 4): wall time from table.add of a FRESH filter until a match
         # of its topic returns the new subscriber — covers delta encode
@@ -506,6 +527,7 @@ def main() -> int:
             "upload_s": r5["upload_s"],
             "delta_apply_ms_p50": round(1e3 * float(np.percentile(lat, 50)), 3),
             "delta_apply_ms_p99": round(1e3 * float(np.percentile(lat, 99)), 3),
+            "delta_apply_ms_pipelined": round(pipelined_ms, 3),
             "sub_to_matchable_ms_p50": round(
                 1e3 * float(np.percentile(s2m, 50)), 3),
             "sub_to_matchable_ms_max": round(1e3 * max(s2m), 3),

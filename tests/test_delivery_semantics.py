@@ -444,3 +444,59 @@ async def test_fanout_fast_path_fires_on_deliver_hooks():
     finally:
         await b.stop()
         await s.stop()
+
+
+def test_wire_v4_qos_pid_patch_parity():
+    """wire_v4_qos's patched template is byte-identical to a fresh codec
+    serialise for every pid — across remaining-length varint boundaries
+    (127/128, 16383/16384), qos 1 and 2, retain on/off."""
+    from vernemq_tpu.broker.message import Msg, wire_v4_qos
+    from vernemq_tpu.protocol import codec_v4
+    from vernemq_tpu.protocol.types import Publish
+
+    cases = []
+    for qos in (1, 2):
+        for retain in (False, True):
+            # rl = paylen + 8 for topic a/b4: 119/120 and 16375/16376
+            # cross the 1->2 and 2->3 byte varint boundaries
+            for paylen in (0, 1, 100, 119, 120, 16375, 16376,
+                           70000):
+                cases.append((qos, retain, paylen))
+    for qos, retain, paylen in cases:
+        msg = Msg(topic=("a", "b4"), payload=b"x" * paylen, qos=qos,
+                  retain=retain)
+        for pid in (1, 2, 255, 256, 0x1234, 65535):
+            got = wire_v4_qos(msg, pid)
+            want = codec_v4.serialise(Publish(
+                topic="a/b4", payload=msg.payload, qos=qos, retain=retain,
+                dup=False, packet_id=pid, properties={}))
+            assert got == want, (qos, retain, paylen, pid)
+
+
+@pytest.mark.asyncio
+async def test_qos1_fanout_distinct_pids_and_ack():
+    """QoS1 fanout through the patched-template fast path: every
+    recipient gets its own packet id, acks clear the broker's
+    waiting-acks, and payload/topic/retain survive intact."""
+    b, s = await boot()
+    try:
+        subs = []
+        for i in range(6):
+            c, _ = await connected(s, f"q1p-{i}")
+            await c.subscribe("q1p/t", qos=1)
+            subs.append(c)
+        pub, _ = await connected(s, "q1p-pub")
+        for n in range(20):
+            await pub.publish("q1p/t", f"m{n}".encode(), qos=1)
+        for c in subs:
+            got = [await c.recv(5.0) for _ in range(20)]
+            assert [f.payload for f in got] == \
+                [f"m{n}".encode() for n in range(20)]
+            assert all(f.qos == 1 and f.packet_id for f in got)
+            assert all(not f.retain for f in got)
+        for c in subs:
+            await c.disconnect()
+        await pub.disconnect()
+    finally:
+        await b.stop()
+        await s.stop()

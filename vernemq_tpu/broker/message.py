@@ -58,6 +58,44 @@ class Msg:
         )
 
 
+def wire_v4_qos(msg: "Msg", pid: int) -> bytes:
+    """The v4 QoS>0 PUBLISH wire frame for ``msg`` with ``pid`` patched
+    in: across recipients the frame differs ONLY in the 2-byte packet id
+    (v4 has no per-session properties; dup retries bypass this), so
+    serialise once per Msg and copy+patch per recipient instead of
+    re-running the codec — the QoS1/2 analog of :func:`wire_v4_qos0`."""
+    tpl = getattr(msg, "_wire_v4_tpl", None)
+    if tpl is None:
+        from ..protocol import codec_v4
+        from ..protocol import topic as T
+        from ..protocol.types import Publish
+
+        topic_str = T.unword(list(msg.topic))
+        frame = Publish(topic=topic_str, payload=msg.payload, qos=msg.qos,
+                        retain=msg.retain, dup=False, packet_id=pid,
+                        properties={})
+        data = codec_v4.serialise(frame)
+        # build the template only from the SECOND recipient on: a
+        # fanout-1 message would pay the bytearray+patch copies for
+        # nothing and retain a second full frame copy while it sits in
+        # waiting_acks/offline queues
+        if getattr(msg, "_wire_v4_seen", False):
+            # packet id offset: 1 type byte + remaining-length varint +
+            # 2-byte topic length + topic bytes
+            topic_b = topic_str.encode("utf-8")
+            rl = 2 + len(topic_b) + 2 + len(msg.payload)
+            vl = (1 if rl < 128 else 2 if rl < 16384 else
+                  3 if rl < 2097152 else 4)
+            msg._wire_v4_tpl = (bytearray(data), 1 + vl + 2 + len(topic_b))
+        else:
+            msg._wire_v4_seen = True
+        return data
+    buf, off = tpl
+    buf[off] = (pid >> 8) & 0xFF
+    buf[off + 1] = pid & 0xFF
+    return bytes(buf)
+
+
 def wire_v4_qos0(msg: "Msg") -> bytes:
     """The v4 QoS0 PUBLISH wire frame for ``msg``, cached on the Msg:
     identical for every v4 QoS0 recipient (no packet id, no props, no

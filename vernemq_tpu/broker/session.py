@@ -628,6 +628,19 @@ class Session:
             m.incr("bytes_sent", len(data))
             m.incr("mqtt_publish_sent")
             return
+        if (pid is not None and not dup and self.proto_ver != PROTO_5
+                and self.broker.tracer is None and not self.closed):
+            # QoS1/2 v4 fanout fast path: same frame per recipient except
+            # the 2-byte packet id — patch a cached template instead of
+            # re-serialising (wire_v4_qos)
+            from .message import wire_v4_qos
+
+            data = wire_v4_qos(msg, pid)
+            self.transport.write(data)
+            m = self.broker.metrics
+            m.incr("bytes_sent", len(data))
+            m.incr("mqtt_publish_sent")
+            return
         props = dict(msg.properties)
         topic_str = T.unword(list(msg.topic))
         if self.proto_ver == PROTO_5:
