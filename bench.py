@@ -198,6 +198,49 @@ class WindowedBench:
                 *head, *args, **statics, interpret=P._use_interpret())
         return K.match_extract_windowed_flat(*head, *args, **statics)
 
+    def run_kernel_only(self, n_stack=8, reps=6):
+        """Device-resident kernel throughput: stage ``n_stack`` packed
+        batches in HBM, run them inside ONE executable (match_packed_scan)
+        ``reps`` times, pull only a checksum. Measures what the chip
+        sustains with zero per-batch transport — the number the tunnel
+        hides. Packed variant only."""
+        import jax as _jax
+
+        from vernemq_tpu.ops import match_kernel as K
+
+        assert self.variant == "packed"
+        m = self.m
+        F_t, t1 = m._operands
+        preps = [self._prep(zipf_topics(self.rng, self.pools, self.batch))
+                 for _ in range(n_stack)]
+        statics = preps[0][1]
+        vecs = np.stack([K.flat_pack_args(p[0]) for p in preps])
+        stack = _jax.device_put(vecs, m.device)
+        B, L = preps[0][0][0].shape
+        T, TP = preps[0][0][4].shape
+        T2 = preps[0][0][6].shape[0]
+        total_matches = None
+        run1 = lambda: K.match_packed_scan(
+            F_t, t1, m._meta, stack, B=B, L=L, T=T, TP=TP, T2=T2,
+            **statics)
+        for _ in range(3):  # compile + executable warm
+            chk, tot = run1()
+            total_matches = int(np.asarray(tot))
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            chk, tot = run1()
+        np.asarray(chk)  # honest sync: one scalar pull after the clock
+        np.asarray(tot)
+        elapsed = time.perf_counter() - t0
+        batches = n_stack * reps
+        return {
+            "kernel_batch_ms": round(elapsed / batches * 1e3, 3),
+            "kernel_matches_per_sec": round(
+                total_matches * reps / elapsed),
+            "kernel_publishes_per_sec": round(self.batch * batches / elapsed),
+            "staged_batches": n_stack,
+        }
+
     def run(self, iters, warmup=6, measure_resolve=True):
         from vernemq_tpu.ops import match_kernel as K
 
@@ -363,6 +406,9 @@ def main() -> int:
                     help="which BASELINE configs to run (3 = headline)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
+    ap.add_argument("--kernel-only", action="store_true",
+                    help="also run the device-resident kernel throughput "
+                    "probe on CPU (always runs on an accelerator)")
     args = ap.parse_args()
 
     if args.platform:
@@ -442,6 +488,16 @@ def main() -> int:
         note(f"[bench] upload {wb.upload_s:.1f}s; running config 3...")
         headline = wb.run(args.iters)
         headline["build_s"] = round(build_s, 2)
+        if args.variant == "packed" and (args.kernel_only
+                                         or platform != "cpu"):
+            # device-resident kernel throughput: what the chip sustains
+            # vs what the transport allows (the tunnel ceiling is
+            # matches/s <= bandwidth / 4B of result ids)
+            try:
+                headline.update(wb.run_kernel_only())
+            except Exception as e:
+                note(f"[bench] kernel-only probe failed: "
+                     f"{type(e).__name__}: {e}")
         configs["3_mixed_1m_zipf"] = {
             k: round(v, 3) if isinstance(v, float) else v
             for k, v in headline.items() if v is not None}

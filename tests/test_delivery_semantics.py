@@ -486,14 +486,20 @@ async def test_qos1_fanout_distinct_pids_and_ack():
             await c.subscribe("q1p/t", qos=1)
             subs.append(c)
         pub, _ = await connected(s, "q1p-pub")
-        for n in range(20):
+        # 25 > the max_inflight window (20): delivery of the tail REQUIRES
+        # pubacks to clear waiting_acks and pump pending
+        for n in range(25):
             await pub.publish("q1p/t", f"m{n}".encode(), qos=1)
         for c in subs:
-            got = [await c.recv(5.0) for _ in range(20)]
+            got = [await c.recv(5.0) for _ in range(25)]
             assert [f.payload for f in got] == \
-                [f"m{n}".encode() for n in range(20)]
+                [f"m{n}".encode() for n in range(25)]
             assert all(f.qos == 1 and f.packet_id for f in got)
             assert all(not f.retain for f in got)
+        await asyncio.sleep(0.3)  # let the trailing pubacks land
+        for sid, sess in list(b.sessions.items()):
+            if sid[1].startswith("q1p-"):
+                assert not sess.waiting_acks, sid
         for c in subs:
             await c.disconnect()
         await pub.disconnect()

@@ -762,3 +762,46 @@ def test_packed_io_off_parity():
     for topic, rows in zip(topics, m.match_batch(topics)):
         assert norm(rows) == norm(trie.match(list(topic))), topic
     assert m._meta is None
+
+
+def test_packed_scan_totals_match_individual_calls():
+    """match_packed_scan (device-resident throughput probe) sums the same
+    match totals as individual packed calls over the same staged
+    batches — the probe must measure real matching, not a degenerate
+    graph."""
+    import numpy as np
+
+    from vernemq_tpu.ops import match_kernel as K
+
+    rng = random.Random(31)
+    m = _bucketed_matcher(max_fanout=64)
+    for i in range(8000):
+        m.table.add(corpus_filter(rng), i, None)
+    with m.lock:
+        m.sync()
+    S = int(m._dev_arrays[0].shape[0])
+    stacks, want_tot = [], 0
+    statics = None
+    geom = None
+    for b in range(3):
+        topics = [(f"r{rng.randrange(16)}", f"d{rng.randrange(40)}",
+                   f"m{rng.randrange(16)}") for _ in range(64)]
+        pw, pl, pd, pb, gb = m._encode_batch_ex(topics)
+        args, statics, left = m._flat_prep(
+            m._reg_start, m._reg_end, m._glob_pad, m._ops_bits, S,
+            pw, pl, pd, pb, gb, len(topics))
+        assert not left
+        out = np.asarray(K.call_packed(
+            m._operands[0], m._operands[1], m._meta, args, statics))
+        Bp = args[0].shape[0]
+        _, _, total, _ = K.unpack_flat_result(out, Bp, statics["C"])
+        want_tot += int(total.sum())
+        geom = dict(B=Bp, L=args[0].shape[1], T=args[4].shape[0],
+                    TP=args[4].shape[1], T2=args[6].shape[0])
+        stacks.append(K.flat_pack_args(args))
+    import jax
+
+    stack = jax.device_put(np.stack(stacks), m.device)
+    chk, tot = K.match_packed_scan(
+        m._operands[0], m._operands[1], m._meta, stack, **geom, **statics)
+    assert int(np.asarray(tot)) == want_tot

@@ -80,13 +80,11 @@ def wire_v4_qos(msg: "Msg", pid: int) -> bytes:
         # nothing and retain a second full frame copy while it sits in
         # waiting_acks/offline queues
         if getattr(msg, "_wire_v4_seen", False):
-            # packet id offset: 1 type byte + remaining-length varint +
-            # 2-byte topic length + topic bytes
-            topic_b = topic_str.encode("utf-8")
-            rl = 2 + len(topic_b) + 2 + len(msg.payload)
-            vl = (1 if rl < 128 else 2 if rl < 16384 else
-                  3 if rl < 2097152 else 4)
-            msg._wire_v4_tpl = (bytearray(data), 1 + vl + 2 + len(topic_b))
+            # the 2-byte packet id immediately precedes the payload in a
+            # v4 PUBLISH — derive the offset from the serialised bytes
+            # so it can never disagree with the codec
+            msg._wire_v4_tpl = (bytearray(data),
+                                len(data) - len(msg.payload) - 2)
         else:
             msg._wire_v4_seen = True
         return data

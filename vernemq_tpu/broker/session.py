@@ -610,32 +610,20 @@ class Session:
         self.broker.hooks_fire_all(
             "on_deliver", self.username, self.sid, msg.topic, msg.payload
         )
-        if (pid is None and msg.qos == 0 and not dup
-                and self.proto_ver != PROTO_5
+        if (not dup and self.proto_ver != PROTO_5
+                and (pid is not None or msg.qos == 0)
                 and self.broker.tracer is None and not self.closed):
-            # QoS0 v4 fanout fast path: the wire frame is identical for
-            # every v4 QoS0 recipient of this Msg (no packet id, no
-            # props, no per-session alias state), so serialise once and
-            # cache the bytes on the Msg — at fanout 50 this removes 98%
-            # of the serialise cost on the delivery path (the analog of
-            # the reference serialising in vmq_mqtt_fsm once per frame,
-            # but across recipients)
-            from .message import wire_v4_qos0
+            # v4 fanout fast path: across recipients the frame is
+            # identical (QoS0: no packet id, no props, no per-session
+            # alias state) or differs only in the 2-byte packet id
+            # (QoS1/2) — serialise once per Msg and reuse/patch the
+            # cached bytes instead of re-running the codec per recipient
+            # (the analog of the reference serialising in vmq_mqtt_fsm
+            # once per frame, but across recipients)
+            from .message import wire_v4_qos, wire_v4_qos0
 
-            data = wire_v4_qos0(msg)
-            self.transport.write(data)
-            m = self.broker.metrics
-            m.incr("bytes_sent", len(data))
-            m.incr("mqtt_publish_sent")
-            return
-        if (pid is not None and not dup and self.proto_ver != PROTO_5
-                and self.broker.tracer is None and not self.closed):
-            # QoS1/2 v4 fanout fast path: same frame per recipient except
-            # the 2-byte packet id — patch a cached template instead of
-            # re-serialising (wire_v4_qos)
-            from .message import wire_v4_qos
-
-            data = wire_v4_qos(msg, pid)
+            data = (wire_v4_qos0(msg) if pid is None
+                    else wire_v4_qos(msg, pid))
             self.transport.write(data)
             m = self.broker.metrics
             m.incr("bytes_sent", len(data))

@@ -830,6 +830,15 @@ def match_extract_windowed_flat_packed(
     ``overflow = out[C+2B:].astype(bool)`` — same contract as the
     unpacked kernel's four arrays.
     """
+    return _packed_core(F_t, t1, meta, packed, B=B, L=L, T=T, TP=TP,
+                        T2=T2, id_bits=id_bits, k=k, glob_pad=glob_pad,
+                        seg_max=seg_max, seg2_max=seg2_max, gc=gc, C=C)
+
+
+def _packed_core(F_t, t1, meta, packed, *, B, L, T, TP, T2, id_bits, k,
+                 glob_pad, seg_max, seg2_max, gc, C):
+    """Unpack + match + repack (shared by the jitted packed entry point
+    and the device-resident throughput scan)."""
     eff = meta & 0xFFFF
     hh = ((meta >> 16) & 1).astype(bool)
     fw = ((meta >> 17) & 1).astype(bool)
@@ -853,6 +862,38 @@ def match_extract_windowed_flat_packed(
         id_bits=id_bits, k=k, glob_pad=glob_pad, seg_max=seg_max,
         seg2_max=seg2_max, gc=gc, C=C)
     return jnp.concatenate([flat, pre, total, overflow.astype(jnp.int32)])
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("B", "L", "T", "TP", "T2", "id_bits",
+                                    "k", "glob_pad", "seg_max", "seg2_max",
+                                    "gc", "C"))
+def match_packed_scan(
+    F_t, t1, meta,
+    packed_stack,            # int32 [N, P] staged transport vectors
+    *,
+    B: int, L: int, T: int, TP: int, T2: int,
+    id_bits: int, k: int, glob_pad: int, seg_max: int, seg2_max: int,
+    gc: int, C: int,
+):
+    """Device-resident throughput probe: run the packed windowed kernel
+    over a stack of pre-staged arg vectors inside ONE executable
+    (``lax.scan`` serialises the steps) and return a checksum + summed
+    match totals, so zero per-batch host<->device traffic and no
+    dead-code elimination. This isolates what the chip's kernel
+    sustains from what the attached transport allows — on a
+    tunnel-attached accelerator the two differ by orders of
+    magnitude."""
+    def step(acc, p):
+        out = _packed_core(F_t, t1, meta, p, B=B, L=L, T=T, TP=TP, T2=T2,
+                           id_bits=id_bits, k=k, glob_pad=glob_pad,
+                           seg_max=seg_max, seg2_max=seg2_max, gc=gc, C=C)
+        chk, tot = acc
+        return (chk + out[:C].sum(), tot + out[C + B:C + 2 * B].sum()), None
+
+    (chk, tot), _ = lax.scan(step, (jnp.int32(0), jnp.int32(0)),
+                             packed_stack)
+    return chk, tot
 
 
 @functools.partial(jax.jit,
