@@ -185,6 +185,8 @@ class WindowedBench:
         F_t, t1 = m._operands
         if self.variant == "packed":
             return K.call_packed(F_t, t1, m._meta, args, statics)
+        if self.variant == "packed_rows":
+            return K.call_packed_rows(F_t, t1, m._meta, args, statics)
         head = (F_t, t1, m._dev_arrays[1], m._dev_arrays[2],
                 m._dev_arrays[3], m._dev_arrays[4])
         if self.variant == "rows":
@@ -261,6 +263,12 @@ class WindowedBench:
                 Bpad = (o.size // (self.m.flat_avg + 3))
                 _, _, total, ovf = K.unpack_flat_result(
                     o, Bpad, Bpad * self.m.flat_avg)
+                return int(total.sum(dtype=np.int64)), int(ovf.sum())
+            if self.variant == "packed_rows":
+                o = np.asarray(out)          # ONE transfer
+                Bpad = (o.size // (self.m.flat_avg + 2))
+                _, total, ovf = K.unpack_rows_result(
+                    o, Bpad, self.m.flat_avg)
                 return int(total.sum(dtype=np.int64)), int(ovf.sum())
             if self.variant == "rows":
                 np.asarray(out[0])
@@ -399,7 +407,8 @@ def main() -> int:
     ap.add_argument("--levels", type=int, default=8)
     ap.add_argument("--seed", type=int, default=42)
     ap.add_argument("--variant", default="packed",
-                    choices=["packed", "flat", "rows", "pallas"],
+                    choices=["packed", "packed_rows", "flat", "rows",
+                             "pallas"],
                     help="windowed-kernel transport/merge variant "
                     "(packed = production default: single-vector I/O)")
     ap.add_argument("--configs", default="1,2,3,4,5",

@@ -805,3 +805,43 @@ def test_packed_scan_totals_match_individual_calls():
     chk, tot = K.match_packed_scan(
         m._operands[0], m._operands[1], m._meta, stack, **geom, **statics)
     assert int(np.asarray(tot)) == want_tot
+
+
+def test_packed_rows_variant_matches_flat_kernel():
+    """match_extract_windowed_rows_packed returns the same per-pub slot
+    sets as the flat kernel (same contract as the unpacked rows A/B)."""
+    import numpy as np
+
+    from vernemq_tpu.ops import match_kernel as K
+
+    rng = random.Random(33)
+    m = _bucketed_matcher(max_fanout=64)
+    for i in range(10000):
+        m.table.add(corpus_filter(rng), i, None)
+    topics = [(f"r{rng.randrange(16)}", f"d{rng.randrange(40)}",
+               f"m{rng.randrange(16)}") for _ in range(64)]
+    with m.lock:
+        m.sync()
+    pw, pl, pd, pb, gb = m._encode_batch_ex(topics)
+    S = int(m._dev_arrays[0].shape[0])
+    args, statics, left = m._flat_prep(
+        m._reg_start, m._reg_end, m._glob_pad, m._ops_bits, S,
+        pw, pl, pd, pb, gb, len(topics))
+    assert not left
+    head = (m._operands[0], m._operands[1], m._dev_arrays[1],
+            m._dev_arrays[2], m._dev_arrays[3], m._dev_arrays[4])
+    flat, pre, total, ovf = (np.asarray(x) for x in
+                             K.match_extract_windowed_flat(
+                                 *head, *args, **statics))
+    Bpad = args[0].shape[0]
+    out = np.asarray(K.call_packed_rows(
+        m._operands[0], m._operands[1], m._meta, args, statics))
+    kf = statics["C"] // Bpad
+    rows, rtotal, rovf = K.unpack_rows_result(out, Bpad, kf)
+    np.testing.assert_array_equal(total[:64], rtotal[:64])
+    np.testing.assert_array_equal(ovf[:64], rovf[:64])
+    for i in range(64):
+        if ovf[i]:
+            continue
+        assert sorted(flat[pre[i]:pre[i] + total[i]]) == \
+            sorted(rows[i, :rtotal[i]]), (i, topics[i])

@@ -50,6 +50,9 @@ def main():
     if "--packed" in argv:  # single-vector I/O transport (production)
         argv.remove("--packed")
         variant = "packed"
+    if "--packed-rows" in argv:  # single-vector I/O over the rows kernel
+        argv.remove("--packed-rows")
+        variant = "packed_rows"
     tps = _axis(argv, "tp", [128, 256])
     bs = _axis(argv, "b", [2048, 4096, 8192])
     fms = _axis(argv, "fm", [2])

@@ -737,7 +737,7 @@ def flat_pack_args(args) -> "np.ndarray":
     vector (uploaded as a single transfer; the tunnel charges ~fixed
     latency *per argument*, so 12 small uploads cost far more than one
     medium one). Layout must mirror the unpacking in
-    :func:`match_extract_windowed_flat_packed`."""
+    :func:`_unpack_transport` (the single device-side decoder)."""
     import numpy as np
 
     (pw, pl, pd, n_real, t_sel, t_start, t2_sel, t2_start,
@@ -803,6 +803,55 @@ def unpack_flat_result(out, B: int, C: int):
             out[C + 2 * B:C + 3 * B].astype(bool))
 
 
+def unpack_rows_result(out, B: int, kf: int):
+    """Decode :func:`match_extract_windowed_rows_packed`'s result vector
+    ``[B*kf + 2B]`` into ``(rows [B, kf], total [B], overflow [B]
+    bool)``."""
+    R = B * kf
+    return (out[:R].reshape(B, kf), out[R:R + B],
+            out[R + B:R + 2 * B].astype(bool))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("B", "L", "T", "TP", "T2", "id_bits",
+                                    "k", "glob_pad", "seg_max", "seg2_max",
+                                    "gc", "kf"))
+def match_extract_windowed_rows_packed(
+    F_t: jax.Array, t1: jax.Array,
+    meta: jax.Array,         # int32 [S] pack_meta word
+    packed: jax.Array,       # int32 [·] flat_pack_args transport vector
+    *,
+    B: int, L: int, T: int, TP: int, T2: int,
+    id_bits: int, k: int, glob_pad: int, seg_max: int, seg2_max: int,
+    gc: int, kf: int,
+) -> jax.Array:
+    """Packed-I/O transport over the gather-merge rows kernel
+    (:func:`match_extract_windowed_rows`): same single-vector in/out as
+    the packed flat kernel but with NO device scatter — the on-chip A/B
+    candidate for hardware where the flat buffer's scatters dominate.
+    Returns one int32 ``[B*kf + 2B]`` vector (see
+    :func:`unpack_rows_result`)."""
+    rows, total, overflow = _windowed_rows_core(
+        F_t, t1, *_unpack_transport(meta, packed, B, L, T, TP, T2),
+        id_bits=id_bits, k=k, glob_pad=glob_pad, seg_max=seg_max,
+        seg2_max=seg2_max, gc=gc, kf=kf)
+    return jnp.concatenate([rows.reshape(-1), total.astype(jnp.int32),
+                            overflow.astype(jnp.int32)])
+
+
+def call_packed_rows(F_t, t1, meta, args, statics):
+    """Rows-kernel analog of :func:`call_packed` (statics carry ``C``;
+    converted to the per-pub cap ``kf`` the rows kernel takes)."""
+    B, L = args[0].shape
+    T, TP = args[4].shape
+    T2 = args[6].shape[0]
+    st = dict(statics)
+    st["kf"] = st.pop("C") // B
+    return match_extract_windowed_rows_packed(
+        F_t, t1, meta, flat_pack_args(args),
+        B=B, L=L, T=T, TP=TP, T2=T2, **st)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("B", "L", "T", "TP", "T2", "id_bits",
                                     "k", "glob_pad", "seg_max", "seg2_max",
@@ -835,10 +884,11 @@ def match_extract_windowed_flat_packed(
                         seg_max=seg_max, seg2_max=seg2_max, gc=gc, C=C)
 
 
-def _packed_core(F_t, t1, meta, packed, *, B, L, T, TP, T2, id_bits, k,
-                 glob_pad, seg_max, seg2_max, gc, C):
-    """Unpack + match + repack (shared by the jitted packed entry point
-    and the device-resident throughput scan)."""
+def _unpack_transport(meta, packed, B, L, T, TP, T2):
+    """THE decoder of the flat_pack_args layout + pack_meta word — the
+    single counterpart to the host-side packers; every packed kernel
+    entry point goes through here so the layout cannot drift between
+    variants. Returns the 18-arg tail of the unpacked kernels."""
     eff = meta & 0xFFFF
     hh = ((meta >> 16) & 1).astype(bool)
     fw = ((meta >> 17) & 1).astype(bool)
@@ -856,9 +906,16 @@ def _packed_core(F_t, t1, meta, packed, *, B, L, T, TP, T2, id_bits, k,
     a_pos = packed[o:o + B]; o += B
     b_tile = packed[o:o + B]; o += B
     b_pos = packed[o:o + B]; o += B
+    return (eff, hh, fw, act, pw, pl, pd, n_real, t_sel, t_start,
+            t2_sel, t2_start, a_tile, a_pos, b_tile, b_pos)
+
+
+def _packed_core(F_t, t1, meta, packed, *, B, L, T, TP, T2, id_bits, k,
+                 glob_pad, seg_max, seg2_max, gc, C):
+    """Unpack + match + repack (shared by the jitted packed entry point
+    and the device-resident throughput scan)."""
     flat, pre, total, overflow = _windowed_flat_core(
-        F_t, t1, eff, hh, fw, act, pw, pl, pd, n_real,
-        t_sel, t_start, t2_sel, t2_start, a_tile, a_pos, b_tile, b_pos,
+        F_t, t1, *_unpack_transport(meta, packed, B, L, T, TP, T2),
         id_bits=id_bits, k=k, glob_pad=glob_pad, seg_max=seg_max,
         seg2_max=seg2_max, gc=gc, C=C)
     return jnp.concatenate([flat, pre, total, overflow.astype(jnp.int32)])
@@ -923,6 +980,19 @@ def match_extract_windowed_rows(
     publish i's matched slots are ``rows[i, :total[i]]`` unless
     ``overflow[i]`` (total > kf, or a part clipped at k).
     """
+    return _windowed_rows_core(
+        F_t, t1, sub_eff_len, has_hash, first_wild, active,
+        pub_words, pub_len, pub_dollar, n_real, t_sel, t_start,
+        t2_sel, t2_start, a_tile, a_pos, b_tile, b_pos,
+        id_bits=id_bits, k=k, glob_pad=glob_pad, seg_max=seg_max,
+        seg2_max=seg2_max, gc=gc, kf=kf)
+
+
+def _windowed_rows_core(F_t, t1, sub_eff_len, has_hash, first_wild,
+                        active, pub_words, pub_len, pub_dollar, n_real,
+                        t_sel, t_start, t2_sel, t2_start,
+                        a_tile, a_pos, b_tile, b_pos, *,
+                        id_bits, k, glob_pad, seg_max, seg2_max, gc, kf):
     B = pub_words.shape[0]
     real = jnp.arange(B, dtype=jnp.int32) < n_real
 
