@@ -1,6 +1,12 @@
 #!/bin/bash
-# Probe the axon tunnel every 5 min; when it answers, fire the bench and
-# tuning sweeps once, recording everything under /tmp/tpu_watch/.
+# Probe the axon tunnel every 5 min; when it answers, fire the r4 packed
+# bench + sweeps once, recording everything under /tmp/tpu_watch/.
+#
+# Order matters: the full bench (with the device-resident kernel-only
+# probe) first — it is the headline artifact — then the packed batch-size
+# ladder. The Pallas sweep is deliberately ABSENT: its Mosaic
+# remote-compile crashed the compile server twice (HTTP 500) and wedged
+# the tunnel for the rest of the session; do not auto-fire it.
 set -u
 OUT=/tmp/tpu_watch
 mkdir -p "$OUT"
@@ -13,18 +19,23 @@ assert ds and ds[0].platform != "cpu", ds
 EOF
   then
     date > "$OUT/recovered_at"
-    echo "tunnel recovered, running bench" >> "$OUT/log"
-    timeout 1800 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
+    echo "tunnel recovered, running packed bench" >> "$OUT/log"
+    timeout 2400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
     echo "bench rc=$?" >> "$OUT/log"
-    timeout 1200 python tools/tune_windowed.py 1000000 --tp 256 --b 4096 --fm 2 --fa 128 \
-      > "$OUT/tune_flat.txt" 2>&1
-    echo "tune_flat rc=$?" >> "$OUT/log"
-    timeout 1200 python tools/tune_windowed.py 1000000 --tp 256 --b 4096 --fm 2 --fa 128 --rows \
-      > "$OUT/tune_rows.txt" 2>&1
-    echo "tune_rows rc=$?" >> "$OUT/log"
-    timeout 1200 python tools/tune_windowed.py 1000000 --tp 256 --b 4096 --fm 2 --fa 128 --pallas \
-      > "$OUT/tune_pallas.txt" 2>&1
-    echo "tune_pallas rc=$?" >> "$OUT/log"
+    # known-good geometry first (packed_rows B=4096 has never been
+    # measured on chip); the wedge-prone big-B points go last, each in
+    # its OWN invocation so a hung compile RPC at one B (which the
+    # per-config try/except cannot catch) only costs that B's timeout.
+    timeout 900 python tools/tune_windowed.py 1000000 --packed-rows \
+      --tp 256 --b 4096 --fm 2 --fa 128 \
+      > "$OUT/tune_packed_rows.txt" 2>&1
+    echo "tune_packed_rows rc=$?" >> "$OUT/log"
+    for B in 8192 16384; do
+      timeout 900 python tools/tune_windowed.py 1000000 --packed \
+        --tp 256 --b "$B" --fm 2 --fa 128 \
+        > "$OUT/tune_packed_b$B.txt" 2>&1
+      echo "tune_packed_b$B rc=$?" >> "$OUT/log"
+    done
     touch "$OUT/DONE"
     exit 0
   fi
