@@ -1,0 +1,242 @@
+"""Hot code upgrade (broker/updo.py — vmq_updo.erl analog).
+
+The property under test is the BEAM code-swap effect: after
+``updo.run()``, *live* references created before the upgrade — bound
+methods on existing instances, directly-held function objects —
+execute the new code, while live mutable state survives.
+"""
+
+import sys
+import textwrap
+
+import pytest
+
+from vernemq_tpu.broker import updo
+
+PKG = "updo_demo_mod"
+
+V1 = """
+VERSION = "v1"
+REGISTRY = {}
+
+def greet():
+    return "hello-v1"
+
+def doomed():
+    return "doomed"
+
+def add(a, b=1):
+    return a + b
+
+class Session:
+    LIMIT = 10
+
+    def state(self):
+        return "v1"
+
+    def only_old(self):
+        return "only-old"
+"""
+
+V2 = """
+VERSION = "v2"
+REGISTRY = {}
+
+def greet():
+    return "hello-v2"
+
+def add(a, b=5):
+    return a + b
+
+def fresh():
+    return "fresh"
+
+class Session:
+    LIMIT = 99
+
+    def state(self):
+        return "v2"
+
+    def newly_added(self):
+        return "new-method"
+
+def __updo__(old_ns):
+    # code_change analog: migrate the live registry's schema
+    for k in list(REGISTRY):
+        REGISTRY[k] = ("migrated", REGISTRY[k])
+"""
+
+
+@pytest.fixture
+def demo(tmp_path, monkeypatch):
+    src = tmp_path / f"{PKG}.py"
+    src.write_text(textwrap.dedent(V1))
+    monkeypatch.syspath_prepend(str(tmp_path))
+    monkeypatch.setattr(updo, "PREFIXES", updo.PREFIXES + (PKG,))
+    mod = __import__(PKG)
+    updo.baseline()
+    try:
+        yield mod, src
+    finally:
+        sys.modules.pop(PKG, None)
+        updo._loaded_digests.pop(PKG, None)
+
+
+def _upgrade(src, code):
+    src.write_text(textwrap.dedent(code))
+    return updo.run()
+
+
+def test_diff_and_dry_run(demo):
+    mod, src = demo
+    assert updo.diff() == []
+    src.write_text(textwrap.dedent(V2))
+    assert updo.diff() == [PKG]
+    plan = updo.run(dry_run=True)
+    assert plan["changed"] == [PKG] and plan["upgraded"] == []
+    # dry run acted on nothing
+    assert mod.greet() == "hello-v1"
+
+
+def test_live_function_reference_runs_new_code(demo):
+    mod, src = demo
+    held = mod.greet          # reference captured before the upgrade
+    rep = _upgrade(src, V2)
+    assert rep["upgraded"] == [PKG] and not rep["failed"]
+    assert held() == "hello-v2"
+    assert mod.greet is held  # old object stayed canonical
+
+
+def test_live_instance_and_bound_method(demo):
+    mod, src = demo
+    sess = mod.Session()      # live "process" from before the upgrade
+    bound = sess.state
+    _upgrade(src, V2)
+    assert sess.state() == "v2"
+    assert bound() == "v2"
+    assert sess.newly_added() == "new-method"   # new method available
+    assert type(sess).LIMIT == 99               # class constant adopted
+    assert not hasattr(sess, "only_old")        # removed method dropped
+    assert isinstance(sess, mod.Session)        # identity preserved
+
+
+def test_defaults_swap(demo):
+    mod, src = demo
+    add = mod.add
+    assert add(1) == 2
+    _upgrade(src, V2)
+    assert add(1) == 6
+
+
+def test_state_preserved_and_migrated(demo):
+    mod, src = demo
+    mod.REGISTRY["c1"] = "online"   # live mutable state
+    _upgrade(src, V2)
+    assert mod.VERSION == "v2"      # immutable constant: new code wins
+    # mutable registry survived AND went through the __updo__ hook
+    assert mod.REGISTRY == {"c1": ("migrated", "online")}
+
+
+def test_removed_function_reported_but_alive(demo):
+    mod, src = demo
+    doomed = mod.doomed
+    rep = _upgrade(src, V2)
+    assert rep["removed"] == {PKG: ["doomed"]}
+    assert doomed() == "doomed"     # old refs keep the old code
+    assert not hasattr(mod, "doomed")
+    assert mod.fresh() == "fresh"   # new top-level name exported
+
+
+def test_broken_new_version_leaves_old_active(demo):
+    mod, src = demo
+    rep = _upgrade(src, "def greet(:\n")   # syntax error
+    assert PKG in rep["failed"]
+    assert mod.greet() == "hello-v1"       # untouched
+    # once fixed, the upgrade goes through
+    rep = _upgrade(src, V2)
+    assert rep["upgraded"] == [PKG]
+    assert mod.greet() == "hello-v2"
+
+
+def test_baseline_covers_broker_modules():
+    import vernemq_tpu.broker.broker  # noqa: F401  (load the tree)
+    import vernemq_tpu.broker.session  # noqa: F401
+
+    n = updo.baseline()
+    assert n > 20  # the broker's own tree is tracked
+    assert updo.diff() == []  # working tree == loaded code
+
+
+def test_kind_change_adopts_new_binding(demo):
+    mod, src = demo
+    # v1 exports an imported helper under `resolve` and a constant F;
+    # v2 turns both into local defs — the new bindings must win
+    src2 = V2 + textwrap.dedent("""
+        def resolve():
+            return "local"
+        def F():
+            return "was-a-constant"
+    """)
+    v1b = V1 + "\nfrom os.path import basename as resolve\nF = 5\n"
+    src.write_text(textwrap.dedent(v1b))
+    updo.run()  # load v1b as current
+    assert mod.resolve("/a/b") == "b" and mod.F == 5
+    rep = _upgrade(src, src2)
+    assert not rep["failed"]
+    assert mod.resolve() == "local"
+    assert mod.F() == "was-a-constant"
+
+
+def test_new_class_sees_live_module_state(demo):
+    mod, src = demo
+    mod.REGISTRY["c9"] = 1
+    _upgrade(src, V2 + textwrap.dedent("""
+        class Tracker:
+            def snap(self):
+                return sorted(REGISTRY)
+    """))
+    # methods of a class ADDED by the upgrade must read the live
+    # namespace, not the scratch module they were compiled in
+    assert mod.Tracker().snap() == ["c9"]
+
+
+def test_patch_failure_keeps_module_dirty(demo):
+    mod, src = demo
+    # v1's greet is a plain function; v2 makes it a closure (freevars
+    # change) — unswappable, so the module must stay retryable
+    src.write_text(textwrap.dedent("""
+        VERSION = "v2"
+        REGISTRY = {}
+        def _mk():
+            secret = "inner"
+            def greet():
+                return secret
+            return greet
+        greet = _mk()
+        def doomed():
+            return "doomed"
+        def add(a, b=1):
+            return a + b
+        class Session:
+            LIMIT = 10
+            def state(self):
+                return "v1"
+            def only_old(self):
+                return "only-old"
+    """))
+    rep = updo.run()
+    assert PKG in rep["failed"] and PKG not in rep["upgraded"]
+    assert mod.greet() == "hello-v1"   # old code still active
+    assert updo.diff() == [PKG]        # still dirty: retry possible
+    rep = _upgrade(src, V2)            # fixed source goes through
+    assert rep["upgraded"] == [PKG] and not rep["failed"]
+    assert mod.greet() == "hello-v2"
+
+
+def test_new_function_sees_live_module_state(demo):
+    mod, src = demo
+    mod.REGISTRY["c2"] = "x"
+    _upgrade(src, V2 + "\ndef peek():\n    return sorted(REGISTRY)\n")
+    # a function ADDED by the upgrade must read the live namespace,
+    # not the scratch module it was compiled in
+    assert mod.peek() == ["c2"]

@@ -180,6 +180,10 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
     reg.register(["churney", "report"], _churney_report,
                  "vmq-admin churney report")
     reg.register(["churney", "stop"], _churney_stop, "vmq-admin churney stop")
+    reg.register(["updo", "diff"], _updo_diff,
+                 "vmq-admin updo diff  (changed-on-disk modules)")
+    reg.register(["updo", "run"], _updo_run,
+                 "vmq-admin updo run [dry=true]  (hot code upgrade)")
     reg.register(["script", "show"], _script_show,
                  "vmq-admin script show")
     reg.register(["script", "reload"], _script_reload,
@@ -541,6 +545,34 @@ def _script_show(broker, flags):
     if plugin is None:
         return {"table": []}
     return {"table": plugin.show()}
+
+
+def _updo_diff(broker, flags):
+    """vmq-admin updo diff (vmq_updo:dry_run/0 — the changed set)."""
+    from ..broker import updo
+
+    changed = updo.diff()
+    if not changed:
+        return "no modules changed on disk"
+    return "\n".join(changed)
+
+
+def _updo_run(broker, flags):
+    """vmq-admin updo run [dry=true] (vmq_updo:run/0)."""
+    from ..broker import updo
+
+    dry = str(flags.get("dry", "")).lower() in ("true", "1", "on", "yes")
+    rep = updo.run(dry_run=dry)
+    lines = [("plan (dry run):" if dry else "upgraded:")]
+    lines += [f"  {m}" for m in (rep["changed"] if dry
+                                 else rep["upgraded"])] or ["  (none)"]
+    for mod, errs in rep["failed"].items():
+        lines.append(f"FAILED {mod}:")
+        lines += [f"  {e}" for e in errs]
+    for mod, names in rep["removed"].items():
+        lines.append(f"removed in {mod}: {', '.join(names)} "
+                     "(live references keep the old code)")
+    return "\n".join(lines)
 
 
 def _script_reload(broker, flags):

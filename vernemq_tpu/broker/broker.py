@@ -566,6 +566,13 @@ class Broker:
         self.crl_refresher = CrlRefresher(
             self, interval=self.config.get("crl_refresh_interval", 60.0))
         self.crl_refresher.start()
+        # hot-upgrade baseline LAST, after every boot-time lazy import,
+        # so `vmq-admin updo diff` is relative to what this boot loaded
+        # (vmq_updo.erl:60-71 diffs loaded vsn vs on-disk beam); modules
+        # imported even later are adopted on first diff() sight
+        from . import updo
+
+        updo.baseline()
 
     async def stop(self) -> None:
         for t in self._bg_tasks:
