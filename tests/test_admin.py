@@ -338,8 +338,10 @@ async def test_ql_order_by_and_new_tables(broker):
 async def test_session_show_order_by_and_ql_command(broker):
     b, _, _ = broker
     reg = register_core_commands(CommandRegistry())
-    for n in ("bb", "aa", "cc"):
-        await connected(broker, n)
+    # hold the client refs: the loop only weak-refs their recv tasks, so
+    # a GC pass mid-test would otherwise collect the clients and close
+    # the very sessions the queries below list
+    clients = [await connected(broker, n) for n in ("bb", "aa", "cc")]
     res = reg.run(b, ["session", "show", "order_by=client_id",
                       "--client_id"])
     assert [r["client_id"] for r in res["table"]] == ["aa", "bb", "cc"]

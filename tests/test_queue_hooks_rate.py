@@ -1,0 +1,135 @@
+"""Queue lifecycle hooks + per-session publish throttling, broker-level.
+
+Reference analogs: ``vmq_queue_hooks_SUITE`` (suites register themselves
+as module plugins and assert hook cardinality around queue lifecycle)
+and ``vmq_rate_limiter_SUITE`` (max_message_rate throttles the reader
+loop instead of killing the session, vmq_mqtt_fsm.erl:243-262).
+"""
+
+import asyncio
+
+import pytest
+
+from vernemq_tpu.broker.config import Config
+from vernemq_tpu.broker.server import start_broker
+from vernemq_tpu.client import MQTTClient
+
+
+async def boot(**cfg):
+    cfg.setdefault("systree_enabled", False)
+    cfg.setdefault("allow_anonymous", True)
+    return await start_broker(Config(**cfg), port=0)
+
+
+async def connected(server, client_id, **kw):
+    c = MQTTClient(server.host, server.port, client_id=client_id, **kw)
+    ack = await c.connect()
+    assert ack.rc == 0
+    return c
+
+
+class HookLog:
+    """The module-plugin pattern of the reference suites: the test
+    registers itself for lifecycle hooks and records invocations."""
+
+    def __init__(self, broker, *names):
+        self.calls = []
+        for name in names:
+            broker.hooks.register(
+                name, (lambda n: lambda *a: self.calls.append((n, a)))(name))
+
+    def count(self, name):
+        return sum(1 for n, _ in self.calls if n == name)
+
+
+@pytest.mark.asyncio
+async def test_wakeup_offline_gone_lifecycle():
+    b, server = await boot()
+    hl = HookLog(b, "on_client_wakeup", "on_client_offline",
+                 "on_client_gone")
+    # persistent session: offline on disconnect, NOT gone
+    c = await connected(server, "hk1", clean_start=False)
+    assert hl.count("on_client_wakeup") == 1
+    await c.subscribe("h/t", qos=1)
+    await c.disconnect()
+    await asyncio.sleep(0.05)
+    assert hl.count("on_client_offline") == 1
+    assert hl.count("on_client_gone") == 0
+    # reconnect wakes the same queue up again
+    c = await connected(server, "hk1", clean_start=False)
+    assert hl.count("on_client_wakeup") == 2
+    await c.disconnect()
+    await asyncio.sleep(0.05)
+    # clean session: queue dies -> gone, no offline
+    c = await connected(server, "hk2", clean_start=True)
+    await c.disconnect()
+    await asyncio.sleep(0.05)
+    assert hl.count("on_client_gone") >= 1
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_offline_message_hook_and_redelivery():
+    b, server = await boot()
+    hl = HookLog(b, "on_offline_message")
+    sub = await connected(server, "off1", clean_start=False)
+    await sub.subscribe("o/t", qos=1)
+    await sub.disconnect()
+    await asyncio.sleep(0.05)
+
+    pub = await connected(server, "pub1")
+    for i in range(3):
+        await pub.publish("o/t", b"m%d" % i, qos=1)
+    await asyncio.sleep(0.1)
+    assert hl.count("on_offline_message") == 3
+
+    # the queued messages replay on reconnect, in order
+    sub = await connected(server, "off1", clean_start=False)
+    assert sub.connack.session_present is True
+    got = [await asyncio.wait_for(sub.messages.get(), 5) for _ in range(3)]
+    assert [m.payload for m in got] == [b"m0", b"m1", b"m2"]
+    await sub.disconnect()
+    await pub.disconnect()
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_offline_drop_hook_on_overflow():
+    b, server = await boot(max_offline_messages=2)
+    hl = HookLog(b, "on_message_drop")
+    sub = await connected(server, "ovr1", clean_start=False)
+    await sub.subscribe("v/t", qos=1)
+    await sub.disconnect()
+    await asyncio.sleep(0.05)
+    pub = await connected(server, "pub2")
+    for i in range(5):
+        await pub.publish("v/t", b"x%d" % i, qos=1)
+    await asyncio.sleep(0.1)
+    assert hl.count("on_message_drop") == 3  # 5 queued into a cap of 2
+    await pub.disconnect()
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_max_message_rate_throttles_not_kills():
+    b, server = await boot(max_message_rate=5)
+    sub = await connected(server, "rsub")
+    await sub.subscribe("r/t", qos=0)
+    pub = await connected(server, "rpub")
+    t0 = asyncio.get_event_loop().time()
+    for i in range(7):  # 2 over the 5/s budget
+        await pub.publish("r/t", b"p%d" % i, qos=1)
+    elapsed = asyncio.get_event_loop().time() - t0
+    # the 6th publish hit the 1s reader-pause; the session survived and
+    # EVERY message was still delivered (throttle, not disconnect)
+    assert elapsed >= 1.0
+    got = [await asyncio.wait_for(sub.messages.get(), 5) for _ in range(7)]
+    assert [m.payload for m in got] == [b"p%d" % i for i in range(7)]
+    assert b.metrics.value("mqtt_publish_throttled") >= 1
+    await pub.disconnect()
+    await sub.disconnect()
+    await b.stop()
+    await server.stop()

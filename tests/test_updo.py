@@ -233,6 +233,98 @@ def test_patch_failure_keeps_module_dirty(demo):
     assert mod.greet() == "hello-v2"
 
 
+def test_immutable_to_mutable_adopts_new_container(demo):
+    mod, src = demo
+    src.write_text(textwrap.dedent(V1 + "\nCONN = None\n"))
+    updo.run()
+    assert mod.CONN is None
+    # v2 initialises the container the new code mutates
+    rep = _upgrade(src, V2 + "\nCONN = {}\ndef put(k, v):\n"
+                   "    CONN[k] = v\n    return CONN\n")
+    assert not rep["failed"]
+    assert mod.CONN == {}
+    assert mod.put("a", 1) == {"a": 1}
+
+
+def test_class_attribute_state_preserved(demo):
+    mod, src = demo
+    src.write_text(textwrap.dedent(
+        V1 + "\nclass Tracker:\n    waiters = {}\n"))
+    updo.run()
+    mod.Tracker.waiters["w1"] = "pending"   # live class-level state
+    rep = _upgrade(src, V2 + "\nclass Tracker:\n    waiters = {}\n"
+                   "    def count(self):\n        return len(self.waiters)\n")
+    assert not rep["failed"]
+    assert mod.Tracker.waiters == {"w1": "pending"}  # state survived
+    assert mod.Tracker().count() == 1                # new method live
+
+
+def test_base_class_swap_heap_to_heap(demo):
+    mod, src = demo
+    src.write_text(textwrap.dedent(V1) + textwrap.dedent("""
+        class AuthA:
+            def can(self):
+                return 'A'
+        class Gate(AuthA):
+            pass
+    """))
+    rep = updo.run()
+    assert not rep["failed"], rep["failed"]
+    g = mod.Gate()
+    assert g.can() == "A"
+    # v2 re-parents Gate onto AuthB; the live instance must follow
+    rep = _upgrade(src, V2 + textwrap.dedent("""
+        class AuthA:
+            def can(self):
+                return 'A'
+        class AuthB:
+            def can(self):
+                return 'B'
+        class Gate(AuthB):
+            pass
+    """))
+    assert not rep["failed"], rep["failed"]
+    assert g.can() == "B"
+    assert isinstance(g, mod.AuthB)
+
+
+def test_base_class_over_object_is_reported(demo):
+    mod, src = demo
+    sess = mod.Session()
+    # CPython cannot re-parent a class whose only base is `object`
+    # (deallocator mismatch) — the upgrade must REPORT that, keep the
+    # module dirty, and leave the old class working
+    rep = _upgrade(src, V2 + textwrap.dedent("""
+        class Auth:
+            def can(self):
+                return 'yes'
+        class Session(Auth):
+            def state(self):
+                return "v2"
+    """))
+    assert PKG in rep["failed"]
+    assert any("base classes changed" in f for f in rep["failed"][PKG])
+    assert sess.state() in ("v1", "v2")  # still callable either way
+    assert updo.diff() == [PKG]          # retryable
+
+
+def test_added_module_closure_reported(demo):
+    mod, src = demo
+    rep = _upgrade(src, V2 + textwrap.dedent("""
+        def _mk():
+            n = [0]
+            def bump():
+                n[0] += 1
+                return n[0]
+            return bump
+        bump = _mk()
+    """))
+    # a new closure cannot be re-homed onto live globals: module must
+    # land in failed (retryable), not silently read scratch state
+    assert PKG in rep["failed"]
+    assert any("closure" in f for f in rep["failed"][PKG])
+
+
 def test_new_function_sees_live_module_state(demo):
     mod, src = demo
     mod.REGISTRY["c2"] = "x"

@@ -36,6 +36,12 @@ EOF
         > "$OUT/tune_packed_b$B.txt" 2>&1
       echo "tune_packed_b$B rc=$?" >> "$OUT/log"
     done
+    # result bytes scale with flat_avg (Bpad*(fa+3) words/batch): a
+    # tighter fa is the cheapest download cut IF overflow stays ~0
+    timeout 900 python tools/tune_windowed.py 1000000 --packed \
+      --tp 256 --b 8192 --fm 2 --fa 96 \
+      > "$OUT/tune_packed_fa96.txt" 2>&1
+    echo "tune_packed_fa96 rc=$?" >> "$OUT/log"
     touch "$OUT/DONE"
     exit 0
   fi

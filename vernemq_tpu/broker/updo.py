@@ -60,6 +60,8 @@ PREFIXES: tuple = ("vernemq_tpu",)
 _IMMUTABLE = (int, float, complex, bool, str, bytes, tuple, frozenset,
               type(None))
 
+_MISSING = object()  # distinguishes "absent in v1" from "was None"
+
 # module name -> digest of the source that produced the loaded code
 _loaded_digests: dict[str, str] = {}
 
@@ -150,7 +152,8 @@ def _unwrap(obj: Any) -> Any:
     return obj
 
 
-def _rebind(obj: Any, live_globals: dict, scratch_globals: dict) -> Any:
+def _rebind(obj: Any, live_globals: dict, scratch_globals: dict,
+            failures: list[str] | None = None, where: str = "?") -> Any:
     """Re-home an object defined during the scratch exec onto the LIVE
     module's globals.  Without this, newly-added functions (and the
     methods of newly-added classes) would read and write the scratch
@@ -158,22 +161,28 @@ def _rebind(obj: Any, live_globals: dict, scratch_globals: dict) -> Any:
     ``__globals__`` IS the scratch dict are touched: functions imported
     from other modules keep their own namespaces.  (Patched old
     functions don't need this: their ``__globals__`` is already the
-    live dict and only ``__code__`` is swapped.)"""
+    live dict and only ``__code__`` is swapped.)  A scratch-global
+    CLOSURE cannot be re-homed (its cells would be lost) — it is kept
+    as-is but recorded in ``failures`` so the module lands in the
+    failed/retryable set instead of reading invisible state silently.
+    """
     if isinstance(obj, staticmethod):
         return staticmethod(_rebind(obj.__func__, live_globals,
-                                    scratch_globals))
+                                    scratch_globals, failures, where))
     if isinstance(obj, classmethod):
         return classmethod(_rebind(obj.__func__, live_globals,
-                                   scratch_globals))
+                                   scratch_globals, failures, where))
     if isinstance(obj, property):
-        return property(*(f and _rebind(f, live_globals, scratch_globals)
+        return property(*(f and _rebind(f, live_globals, scratch_globals,
+                                        failures, where)
                           for f in (obj.fget, obj.fset, obj.fdel)),
                         doc=obj.__doc__)
     if isinstance(obj, type):
         # a class born in the scratch exec is a fresh object — safe to
         # fix up in place: every scratch-global method gets re-homed
         for attr, val in list(vars(obj).items()):
-            fixed = _rebind(val, live_globals, scratch_globals)
+            fixed = _rebind(val, live_globals, scratch_globals,
+                            failures, f"{where}.{attr}")
             if fixed is not val:
                 try:
                     setattr(obj, attr, fixed)
@@ -181,9 +190,14 @@ def _rebind(obj: Any, live_globals: dict, scratch_globals: dict) -> Any:
                     pass
         return obj
     if not isinstance(obj, types.FunctionType) \
-            or obj.__globals__ is not scratch_globals \
-            or obj.__closure__ is not None:
-        return obj  # closures must keep their cells; data passes through
+            or obj.__globals__ is not scratch_globals:
+        return obj  # data and foreign functions pass through
+    if obj.__closure__ is not None:
+        if failures is not None:
+            failures.append(
+                f"{where}: new closure-bearing function cannot be "
+                f"re-homed onto the live module globals")
+        return obj
     fn = types.FunctionType(obj.__code__, live_globals, obj.__name__,
                             obj.__defaults__, obj.__closure__)
     fn.__kwdefaults__ = obj.__kwdefaults__
@@ -195,9 +209,18 @@ def _rebind(obj: Any, live_globals: dict, scratch_globals: dict) -> Any:
     return fn
 
 
+def _is_mutable_data(v: Any) -> bool:
+    """Live-state heuristic: plain data that can be mutated in place
+    (registries, caches) — the process/ETS analog the graft preserves."""
+    return not isinstance(v, (types.FunctionType, type, staticmethod,
+                              classmethod, property)) \
+        and not isinstance(v, _IMMUTABLE)
+
+
 def _patch_class(old: type, new: type, failures: list[str],
                  where: str, live_globals: dict,
-                 scratch_globals: dict) -> None:
+                 scratch_globals: dict,
+                 alias: dict[int, Any] | None = None) -> None:
     for attr, new_val in list(vars(new).items()):
         if attr in ("__dict__", "__weakref__"):
             continue
@@ -208,12 +231,18 @@ def _patch_class(old: type, new: type, failures: list[str],
             _patch_function(of, nf, failures, f"{where}.{attr}")
         elif isinstance(new_val, type) and isinstance(old_val, type):
             _patch_class(old_val, new_val, failures, f"{where}.{attr}",
-                         live_globals, scratch_globals)
+                         live_globals, scratch_globals, alias)
+        elif attr in vars(old) and _is_mutable_data(old_val) \
+                and _is_mutable_data(new_val):
+            # class-level live state (e.g. a class-attribute registry)
+            # is preserved, same rule as module-level data
+            pass
         else:
             # new methods, properties, descriptors, constants
             try:
                 setattr(old, attr,
-                        _rebind(new_val, live_globals, scratch_globals))
+                        _rebind(new_val, live_globals, scratch_globals,
+                                failures, f"{where}.{attr}"))
             except (AttributeError, TypeError) as e:
                 failures.append(f"{where}.{attr}: {e}")
     for attr in set(vars(old)) - set(vars(new)):
@@ -223,6 +252,17 @@ def _patch_class(old: type, new: type, failures: list[str],
             delattr(old, attr)
         except (AttributeError, TypeError):
             pass
+    # base-class changes: map scratch-born bases to their live
+    # counterparts and swap __bases__; CPython refuses incompatible
+    # layouts — that refusal is reported, not guessed around
+    new_bases = tuple((alias or {}).get(id(b), b) for b in new.__bases__)
+    if old.__bases__ != new_bases:
+        try:
+            old.__bases__ = new_bases
+        except TypeError as e:
+            failures.append(f"{where}: base classes changed "
+                            f"({old.__bases__} -> {new_bases}) and cannot "
+                            f"be swapped live: {e}")
 
 
 def _exec_fresh(mod: types.ModuleType) -> types.ModuleType:
@@ -247,19 +287,24 @@ def _upgrade_module(name: str, report: dict) -> None:
         report["failed"][name] = [f"load: {type(e).__name__}: {e}"]
         return
 
-    def _kind(v: Any) -> str:
-        if isinstance(v, types.FunctionType):
-            return "func"
-        if isinstance(v, type):
-            return "class"
-        return "data"
-
     failures: list[str] = []
     scratch = vars(fresh)
+    # scratch object -> live counterpart, for every same-module pair the
+    # graft will patch in place; lets base-class swaps resolve a scratch
+    # base (class B(A)) to the LIVE patched A
+    alias: dict[int, Any] = {
+        id(nv): ov
+        for attr, nv in scratch.items()
+        if not attr.startswith("__")
+        for ov in (old_ns.get(attr),)
+        if isinstance(ov, (types.FunctionType, type))
+        and isinstance(nv, (types.FunctionType, type))
+        and getattr(ov, "__module__", None) == name
+    }
     for attr, new_val in scratch.items():
         if attr.startswith("__") and attr != "__updo__":
             continue
-        old_val = old_ns.get(attr)
+        old_val = old_ns.get(attr, _MISSING)
         if new_val is old_val:
             continue  # e.g. an imported live sibling module/object
         if isinstance(old_val, types.FunctionType) \
@@ -270,17 +315,20 @@ def _upgrade_module(name: str, report: dict) -> None:
         elif isinstance(old_val, type) and isinstance(new_val, type) \
                 and old_val.__module__ == name:
             _patch_class(old_val, new_val, failures, f"{name}.{attr}",
-                         vars(mod), scratch)
-        elif attr in old_ns and _kind(old_val) == _kind(new_val) == "data" \
-                and not isinstance(new_val, _IMMUTABLE):
-            # mutable module state (registries, caches) is preserved
+                         vars(mod), scratch, alias)
+        elif attr in old_ns \
+                and _is_mutable_data(old_val) and _is_mutable_data(new_val):
+            # mutable module state (registries, caches) is preserved;
+            # an immutable old value (CONN = None -> CONN = {}) is NOT
+            # live state and adopts the new initialiser below
             pass
         else:
             # everything else is the new version's code/constants: new
             # names, changed immutables, and KIND changes (imported
             # helper -> local def, constant -> function, ...) all adopt
             # the new binding
-            setattr(mod, attr, _rebind(new_val, vars(mod), scratch))
+            setattr(mod, attr, _rebind(new_val, vars(mod), scratch,
+                                       failures, f"{name}.{attr}"))
 
     removed = []
     for attr, old_val in old_ns.items():
@@ -297,7 +345,8 @@ def _upgrade_module(name: str, report: dict) -> None:
     hook = vars(fresh).get("__updo__")
     if callable(hook):
         try:
-            _rebind(hook, vars(mod), scratch)(old_ns)
+            _rebind(hook, vars(mod), scratch,
+                    failures, f"{name}.__updo__")(old_ns)
         except Exception as e:
             failures.append(f"{name}.__updo__: {type(e).__name__}: {e}")
 
@@ -326,5 +375,9 @@ def run(dry_run: bool = False) -> dict:
         return report
     for name in changed:
         _upgrade_module(name, report)
-        log.info("updo: upgraded %s", name)
+        if name in report["failed"]:
+            log.warning("updo: %s NOT fully applied: %s", name,
+                        "; ".join(report["failed"][name]))
+        else:
+            log.info("updo: upgraded %s", name)
     return report
