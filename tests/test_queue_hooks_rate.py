@@ -113,6 +113,99 @@ async def test_offline_drop_hook_on_overflow():
     await server.stop()
 
 
+class RawV5:
+    """Raw-socket v5 client (the packet.erl pattern): full control over
+    the QoS2 handshake, so flow-control credits can be held open."""
+
+    def __init__(self, host, port):
+        self.host, self.port = host, port
+        self.buf = b""
+
+    async def connect(self, client_id):
+        from vernemq_tpu.protocol import codec_v5
+        from vernemq_tpu.protocol.types import Connect
+
+        self.r, self.w = await asyncio.open_connection(self.host, self.port)
+        self.w.write(codec_v5.serialise(Connect(
+            proto_ver=5, client_id=client_id, clean_start=True,
+            keepalive=60)))
+        await self.w.drain()
+        return await self.recv()
+
+    async def send(self, frame):
+        from vernemq_tpu.protocol import codec_v5
+
+        self.w.write(codec_v5.serialise(frame))
+        await self.w.drain()
+
+    async def recv(self, timeout=5.0):
+        from vernemq_tpu.protocol import codec_v5
+
+        while True:
+            frame, self.buf = codec_v5.parse(self.buf)
+            if frame is not None:
+                return frame
+            data = await asyncio.wait_for(self.r.read(4096), timeout)
+            if not data:
+                return None  # peer closed
+            self.buf += data
+
+
+@pytest.mark.asyncio
+async def test_v5_receive_maximum_enforced():
+    """MQTT5 incoming flow control (vmq_mqtt5_fsm.erl:1215-1218): each
+    un-PUBRELed QoS2 publish holds a receive credit; one past the
+    broker's announced receive_maximum is DISCONNECT 0x93."""
+    from vernemq_tpu.protocol.types import (
+        RC_RECEIVE_MAX_EXCEEDED, Disconnect, Publish, Pubrec,
+    )
+
+    b, server = await boot(receive_max_broker=3)
+    c = RawV5(server.host, server.port)
+    ack = await c.connect("fc1")
+    assert ack.properties.get("receive_maximum") == 3
+    for pid in (1, 2, 3):
+        await c.send(Publish(topic="f/t", payload=b"x", qos=2,
+                             packet_id=pid, properties={}))
+        rec = await c.recv()
+        assert isinstance(rec, Pubrec) and rec.packet_id == pid
+    # a RETRANSMITTED pid holds its existing credit: not an error
+    await c.send(Publish(topic="f/t", payload=b"x", qos=2, dup=True,
+                         packet_id=2, properties={}))
+    assert isinstance(await c.recv(), Pubrec)
+    # the 4th distinct credit is one too many
+    await c.send(Publish(topic="f/t", payload=b"x", qos=2,
+                         packet_id=4, properties={}))
+    disc = await c.recv()
+    assert isinstance(disc, Disconnect)
+    assert disc.reason_code == RC_RECEIVE_MAX_EXCEEDED
+    assert await c.recv() is None  # connection closed
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_v5_receive_credit_released_by_pubrel():
+    from vernemq_tpu.protocol.types import Pubcomp, Publish, Pubrec, Pubrel
+
+    b, server = await boot(receive_max_broker=2)
+    c = RawV5(server.host, server.port)
+    await c.connect("fc2")
+    for pid in (1, 2):
+        await c.send(Publish(topic="f/t", payload=b"x", qos=2,
+                             packet_id=pid, properties={}))
+        assert isinstance(await c.recv(), Pubrec)
+    # releasing one credit makes room for the next publish
+    await c.send(Pubrel(packet_id=1))
+    assert isinstance(await c.recv(), Pubcomp)
+    await c.send(Publish(topic="f/t", payload=b"x", qos=2,
+                         packet_id=3, properties={}))
+    rec = await c.recv()
+    assert isinstance(rec, Pubrec) and rec.packet_id == 3
+    await b.stop()
+    await server.stop()
+
+
 @pytest.mark.asyncio
 async def test_max_message_rate_throttles_not_kills():
     b, server = await boot(max_message_rate=5)

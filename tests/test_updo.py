@@ -308,6 +308,54 @@ def test_base_class_over_object_is_reported(demo):
     assert updo.diff() == [PKG]          # retryable
 
 
+def test_new_subclass_reparented_onto_live_base(demo):
+    mod, src = demo
+    mod.REGISTRY["pre"] = 1
+    # v2 adds a subclass of the EXISTING Session class
+    rep = _upgrade(src, V2 + textwrap.dedent("""
+        class AuditedSession(Session):
+            def audit(self):
+                REGISTRY["audited"] = True
+                return self.state()
+    """))
+    assert not rep["failed"], rep["failed"]
+    a = mod.AuditedSession()
+    assert isinstance(a, mod.Session)          # live base, not scratch
+    assert mod.AuditedSession.__bases__ == (mod.Session,)
+    assert a.audit() == "v2"                   # inherited NEW code
+    assert mod.REGISTRY.get("audited") is True  # wrote LIVE state
+
+
+def test_function_to_class_kind_change_with_subclass(demo):
+    mod, src = demo
+    src.write_text(textwrap.dedent(V1) + textwrap.dedent("""
+        def Auth():
+            return "fn"
+        class Base:
+            pass
+        class Gate(Base):
+            pass
+    """))
+    rep = updo.run()
+    assert not rep["failed"]
+    # v2: Auth becomes a class and Gate re-parents onto it. The alias
+    # map must NOT pair new-class-Auth with old-function-Auth (kind
+    # mismatch), so the base swap resolves to the freshly-adopted class
+    rep = _upgrade(src, V2 + textwrap.dedent("""
+        class Auth:
+            def can(self):
+                return "cls"
+        class Base:
+            pass
+        class Gate(Auth):
+            pass
+    """))
+    assert not rep["failed"], rep["failed"]
+    assert isinstance(mod.Auth, type)
+    assert mod.Gate().can() == "cls"
+    assert mod.Gate.__bases__ == (mod.Auth,)
+
+
 def test_added_module_closure_reported(demo):
     mod, src = demo
     rep = _upgrade(src, V2 + textwrap.dedent("""

@@ -42,6 +42,7 @@ from ..protocol.types import (
     RC_PACKET_ID_NOT_FOUND,
     RC_SESSION_TAKEN_OVER,
     RC_SUCCESS,
+    RC_RECEIVE_MAX_EXCEEDED,
     RC_TOPIC_ALIAS_INVALID,
     RC_UNSPECIFIED_ERROR,
     Auth,
@@ -432,6 +433,20 @@ class Session:
             # sysmon load shedding: slow every producer while overloaded
             self.broker.metrics.incr("mqtt_publish_throttled")
             await asyncio.sleep(0.1)
+        # incoming flow control: QoS2 publishes hold a receive credit
+        # until their PUBREL (awaiting_rel IS fc_receive_cnt); at the
+        # announced receive_maximum the next QoS>0 publish is a protocol
+        # error (vmq_mqtt5_fsm.erl:1215-1218 fc_incr_cnt -> error ->
+        # recv_max_exceeded). A retransmitted QoS2 pid already holding a
+        # credit does not count twice.
+        if (self.proto_ver == PROTO_5 and f.qos > 0
+                and cfg.receive_max_broker
+                and len(self.awaiting_rel) >= cfg.receive_max_broker
+                and not (f.qos == 2
+                         and f.packet_id in self.awaiting_rel)):
+            self.broker.metrics.incr("mqtt_publish_error")
+            await self._disconnect_v5(RC_RECEIVE_MAX_EXCEEDED)
+            return
         # v5 topic alias resolution (vmq_mqtt5_fsm.erl:90-93)
         topic_str = f.topic
         words: Optional[Tuple[str, ...]] = None

@@ -153,7 +153,8 @@ def _unwrap(obj: Any) -> Any:
 
 
 def _rebind(obj: Any, live_globals: dict, scratch_globals: dict,
-            failures: list[str] | None = None, where: str = "?") -> Any:
+            failures: list[str] | None = None, where: str = "?",
+            alias: dict[int, Any] | None = None) -> Any:
     """Re-home an object defined during the scratch exec onto the LIVE
     module's globals.  Without this, newly-added functions (and the
     methods of newly-added classes) would read and write the scratch
@@ -168,26 +169,40 @@ def _rebind(obj: Any, live_globals: dict, scratch_globals: dict,
     """
     if isinstance(obj, staticmethod):
         return staticmethod(_rebind(obj.__func__, live_globals,
-                                    scratch_globals, failures, where))
+                                    scratch_globals, failures, where,
+                                    alias))
     if isinstance(obj, classmethod):
         return classmethod(_rebind(obj.__func__, live_globals,
-                                   scratch_globals, failures, where))
+                                   scratch_globals, failures, where,
+                                   alias))
     if isinstance(obj, property):
         return property(*(f and _rebind(f, live_globals, scratch_globals,
-                                        failures, where)
+                                        failures, where, alias)
                           for f in (obj.fget, obj.fset, obj.fdel)),
                         doc=obj.__doc__)
     if isinstance(obj, type):
         # a class born in the scratch exec is a fresh object — safe to
-        # fix up in place: every scratch-global method gets re-homed
+        # fix up in place: every scratch-global method gets re-homed,
+        # and bases pointing at scratch counterparts of LIVE classes
+        # (class New(Existing)) re-parent onto the live ones
         for attr, val in list(vars(obj).items()):
             fixed = _rebind(val, live_globals, scratch_globals,
-                            failures, f"{where}.{attr}")
+                            failures, f"{where}.{attr}", alias)
             if fixed is not val:
                 try:
                     setattr(obj, attr, fixed)
                 except (AttributeError, TypeError):
                     pass
+        if alias:
+            new_bases = tuple(alias.get(id(b), b) for b in obj.__bases__)
+            if new_bases != obj.__bases__:
+                try:
+                    obj.__bases__ = new_bases
+                except TypeError as e:
+                    if failures is not None:
+                        failures.append(
+                            f"{where}: new class inherits a live class "
+                            f"but cannot be re-parented onto it: {e}")
         return obj
     if not isinstance(obj, types.FunctionType) \
             or obj.__globals__ is not scratch_globals:
@@ -242,7 +257,7 @@ def _patch_class(old: type, new: type, failures: list[str],
             try:
                 setattr(old, attr,
                         _rebind(new_val, live_globals, scratch_globals,
-                                failures, f"{where}.{attr}"))
+                                failures, f"{where}.{attr}", alias))
             except (AttributeError, TypeError) as e:
                 failures.append(f"{where}.{attr}: {e}")
     for attr in set(vars(old)) - set(vars(new)):
@@ -297,9 +312,12 @@ def _upgrade_module(name: str, report: dict) -> None:
         for attr, nv in scratch.items()
         if not attr.startswith("__")
         for ov in (old_ns.get(attr),)
-        if isinstance(ov, (types.FunctionType, type))
-        and isinstance(nv, (types.FunctionType, type))
-        and getattr(ov, "__module__", None) == name
+        # kinds must MATCH: a function->class (or reverse) change is an
+        # adoption, not an in-place patch pair
+        if (isinstance(ov, type) and isinstance(nv, type))
+        or (isinstance(ov, types.FunctionType)
+            and isinstance(nv, types.FunctionType))
+        if getattr(ov, "__module__", None) == name
     }
     for attr, new_val in scratch.items():
         if attr.startswith("__") and attr != "__updo__":
@@ -328,7 +346,7 @@ def _upgrade_module(name: str, report: dict) -> None:
             # helper -> local def, constant -> function, ...) all adopt
             # the new binding
             setattr(mod, attr, _rebind(new_val, vars(mod), scratch,
-                                       failures, f"{name}.{attr}"))
+                                       failures, f"{name}.{attr}", alias))
 
     removed = []
     for attr, old_val in old_ns.items():
