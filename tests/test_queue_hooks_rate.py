@@ -207,6 +207,127 @@ async def test_v5_receive_credit_released_by_pubrel():
 
 
 @pytest.mark.asyncio
+async def test_v5_inbound_packet_too_large_disconnects():
+    from vernemq_tpu.protocol.types import (
+        RC_PACKET_TOO_LARGE, Disconnect, Publish,
+    )
+
+    b, server = await boot(max_message_size=100)
+    c = RawV5(server.host, server.port)
+    ack = await c.connect("big1")
+    assert ack.properties.get("maximum_packet_size") == 100  # announced
+    await c.send(Publish(topic="b/t", payload=b"y" * 500, qos=1,
+                         packet_id=1, properties={}))
+    disc = await c.recv()
+    assert isinstance(disc, Disconnect)
+    assert disc.reason_code == RC_PACKET_TOO_LARGE
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_v5_maximum_packet_size_drops_oversize():
+    """MQTT5 3.1.2.11.4: the broker must not send a packet larger than
+    the client's maximum_packet_size — oversize deliveries are DROPPED
+    (vmq_mqtt5_fsm.erl:1422-1427 on_message_drop), never truncated and
+    never an error; small deliveries flow on."""
+    b, server = await boot()
+    drops = []
+    b.hooks.register("on_message_drop",
+                     lambda sid, msg, reason: drops.append((sid, reason)))
+    sub = MQTTClient(server.host, server.port, client_id="tiny",
+                     proto_ver=5, properties={"maximum_packet_size": 64})
+    assert (await sub.connect()).rc == 0
+    await sub.subscribe("m/t", qos=1)
+    pub = await connected(server, "bigpub")
+    await pub.publish("m/t", b"small", qos=1)
+    m = await asyncio.wait_for(sub.messages.get(), 5)
+    assert m.payload == b"small"
+    await pub.publish("m/t", b"x" * 500, qos=1)   # > 64B frame
+    await pub.publish("m/t", b"after", qos=1)
+    m = await asyncio.wait_for(sub.messages.get(), 5)
+    assert m.payload == b"after"                   # big one never arrived
+    assert [r for _, r in drops] == ["max_packet_size_exceeded"]
+    assert drops[0][0] == ("", "tiny")
+    await pub.disconnect()
+    await sub.disconnect()
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_v5_packet_cap_honoured_with_alias_allocation():
+    """The size check must measure the frame the send path will build —
+    INCLUDING a topic alias it is about to allocate (the
+    alias-establishing frame carries full topic + 3-byte property, so
+    it is the LARGEST frame on that topic)."""
+    from vernemq_tpu.protocol import codec_v5
+    from vernemq_tpu.protocol.types import Connect, Publish
+
+    b, server = await boot()
+    cap = 80
+    c = RawV5(server.host, server.port)
+    from vernemq_tpu.protocol.types import Subscribe, SubOpts
+
+    c.r, c.w = await asyncio.open_connection(server.host, server.port)
+    c.w.write(codec_v5.serialise(Connect(
+        proto_ver=5, client_id="aliassub", clean_start=True, keepalive=60,
+        properties={"maximum_packet_size": cap, "topic_alias_maximum": 5})))
+    await c.w.drain()
+    await c.recv()  # CONNACK
+    await c.send(Subscribe(packet_id=1,
+                           topics=[("a/verylongtopicname", SubOpts(qos=0))],
+                           properties={}))
+    await c.recv()  # SUBACK
+    pub = await connected(server, "aliaspub")
+    for n in range(30, 75, 4):  # straddles the cap
+        await pub.publish("a/verylongtopicname", b"p" * n, qos=0)
+    await pub.publish("a/verylongtopicname", b"END", qos=0)
+    seen = []
+    while True:
+        f = await c.recv()
+        assert isinstance(f, Publish)
+        wire_len = len(codec_v5.serialise(f))
+        assert wire_len <= cap, (wire_len, len(f.payload))
+        seen.append(f.payload)
+        if f.payload == b"END":
+            break
+    assert b"p" * 30 in seen          # small ones made it
+    assert b"p" * 74 not in seen      # oversize ones dropped, not sent
+
+    # the sharp edge: a FIRST publish on a fresh topic sized so the
+    # bare frame fits the cap but the alias-ESTABLISHING frame (full
+    # topic + 3-byte alias property) does not — it must be dropped,
+    # not sent oversize (the pre-fix code under-measured exactly this)
+    topic2 = "b/otherlongtopicname"
+    n = 1
+    while len(codec_v5.serialise(Publish(
+            topic=topic2, payload=b"q" * (n + 1), qos=0,
+            properties={}))) <= cap:
+        n += 1
+    # bare frame with n bytes fits (== cap or just under); +3B alias
+    # property pushes it over
+    bare = len(codec_v5.serialise(Publish(topic=topic2, payload=b"q" * n,
+                                          qos=0, properties={})))
+    assert bare <= cap < bare + 3
+    await c.send(Subscribe(packet_id=2,
+                           topics=[(topic2, SubOpts(qos=0))],
+                           properties={}))
+    await c.recv()  # SUBACK
+    await pub.publish(topic2, b"q" * n, qos=0)
+    await pub.publish(topic2, b"END2", qos=0)
+    while True:
+        f = await c.recv()
+        assert len(codec_v5.serialise(f)) <= cap
+        assert f.payload != b"q" * n  # the borderline frame was dropped
+        if f.payload == b"END2":
+            break
+    await pub.disconnect()
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_max_message_rate_throttles_not_kills():
     b, server = await boot(max_message_rate=5)
     sub = await connected(server, "rsub")

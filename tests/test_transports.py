@@ -123,6 +123,34 @@ async def test_ws_connect_publish_subscribe(broker):
 
 
 @pytest.mark.asyncio
+async def test_ws_honours_broker_frame_cap(event_loop):
+    """Transport parity: the max_message_size total-frame cap must bind
+    on WebSocket listeners exactly as on TCP (same fallback chain), and
+    a v5 WS client gets the same CONNACK announcement + 0x95."""
+    from vernemq_tpu.protocol import codec_v5
+    from vernemq_tpu.protocol.types import Disconnect, RC_PACKET_TOO_LARGE
+
+    b, server = await start_broker(
+        Config(systree_enabled=False, allow_anonymous=True,
+               max_message_size=128), port=0)
+    ws_server = await b.listeners.start_listener("ws", "127.0.0.1", 0)
+    c = WsTestClient("127.0.0.1", ws_server.port)
+    await c.connect()
+    c.send_mqtt(Connect(proto_ver=5, client_id="wscap"), codec=codec_v5)
+    ack = await asyncio.wait_for(c.recv_mqtt(codec=codec_v5), 5)
+    assert isinstance(ack, Connack) and ack.rc == 0
+    assert ack.properties.get("maximum_packet_size") == 128
+    c.send_mqtt(Publish(topic="w/t", payload=b"z" * 500, qos=0,
+                        properties={}), codec=codec_v5)
+    disc = await asyncio.wait_for(c.recv_mqtt(codec=codec_v5), 5)
+    assert isinstance(disc, Disconnect)
+    assert disc.reason_code == RC_PACKET_TOO_LARGE
+    c.writer.close()
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_ws_ping_pong_and_fragmentation(broker):
     b, _ = broker
     ws_server = await b.listeners.start_listener("ws", "127.0.0.1", 0)

@@ -30,6 +30,7 @@ DEFAULTS: Dict[str, Any] = {
     "retry_interval": 20,
     "max_message_rate": 0,  # msgs/sec per session; 0 = unlimited
     "max_message_size": 0,  # bytes; 0 = unlimited
+    "m5_max_packet_size": 0,  # broker->v5-client frame cap; 0 = client's say
     "max_last_will_delay": 0,  # v5 will-delay cap, seconds
     "receive_max_broker": 10,
     "receive_max_client": 65535,

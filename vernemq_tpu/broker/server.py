@@ -158,6 +158,12 @@ async def mqtt_connection(
             connect_frame.username = preauth_user
         session = Session(broker, transport, proto_ver, peer=peer,
                           mountpoint=mountpoint)
+        if max_frame_size and max_frame_size < MAX_FRAME_SIZE:
+            # the cap THIS listener actually parses with — what the
+            # CONNACK maximum_packet_size must announce (a later config
+            # change or per-listener override must not let the two lie
+            # apart)
+            session.max_frame_in = max_frame_size
         ok = await session.handle_connect(connect_frame)
         if not ok and not session._pending_connect:
             return
@@ -167,7 +173,18 @@ async def mqtt_connection(
         while not session.closed:
             view = memoryview(buf)
             while True:
-                frame, view = codec.parse(view, max_frame_size)
+                try:
+                    frame, view = codec.parse(view, max_frame_size)
+                except ParseError as e:
+                    if (e.reason == "frame_too_large"
+                            and session.proto_ver == PROTO_5
+                            and not session.closed):
+                        # tell a v5 client WHY before dropping the
+                        # socket (MQTT5 3.2.2.3.6 / DISCONNECT 0x95)
+                        from ..protocol.types import RC_PACKET_TOO_LARGE
+
+                        await session._disconnect_v5(RC_PACKET_TOO_LARGE)
+                    raise
                 if frame is None:
                     break
                 await session.handle_frame(frame)
@@ -219,7 +236,12 @@ class MQTTServer:
         self.broker = broker
         self.host = host
         self.port = port
-        self.max_frame_size = max_frame_size or MAX_FRAME_SIZE
+        # per-listener override, else the broker-wide max_message_size
+        # (the reference's semantic: vmq_parser.erl enforces it as a
+        # TOTAL-frame cap on every packet type, not just PUBLISH payloads)
+        self.max_frame_size = (max_frame_size
+                               or broker.config.get("max_message_size", 0)
+                               or MAX_FRAME_SIZE)
         self.ssl_context = ssl_context
         self.proxy_protocol = proxy_protocol
         self.use_identity_as_username = use_identity_as_username

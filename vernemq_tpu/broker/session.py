@@ -42,6 +42,7 @@ from ..protocol.types import (
     RC_PACKET_ID_NOT_FOUND,
     RC_SESSION_TAKEN_OVER,
     RC_SUCCESS,
+    RC_PACKET_TOO_LARGE,
     RC_RECEIVE_MAX_EXCEEDED,
     RC_TOPIC_ALIAS_INVALID,
     RC_UNSPECIFIED_ERROR,
@@ -117,6 +118,8 @@ class Session:
         self.topic_alias_out: Dict[Tuple[str, ...], int] = {}
         self.topic_alias_max_out = 0  # client's limit for broker→client aliases
         self.receive_max_out = 65535  # client's receive maximum (broker→client inflight cap)
+        self.max_packet_out = 0  # client's maximum_packet_size; 0 = unlimited
+        self.max_frame_in = 0    # the listener's enforced inbound frame cap
         self.request_problem_info = True
         self.auth_method: Optional[str] = None
         self._in_enhanced_auth = False
@@ -180,6 +183,14 @@ class Session:
                 self.topic_alias_max_out = min(self.topic_alias_max_out,
                                                cfg.topic_alias_max_broker)
             self.receive_max_out = f.properties.get("receive_maximum", 65535)
+            # client's packet-size ceiling for broker->client frames
+            # (vmq_mqtt5_fsm.erl:159-161 maybe_get_maximum_packet_size,
+            # min'd with the broker's own configured cap)
+            self.max_packet_out = f.properties.get("maximum_packet_size", 0)
+            cfg_mps = cfg.get("m5_max_packet_size", 0)
+            if cfg_mps:
+                self.max_packet_out = (min(self.max_packet_out, cfg_mps)
+                                       if self.max_packet_out else cfg_mps)
             self.request_problem_info = bool(f.properties.get("request_problem_information", 1))
             self.auth_method = f.properties.get("authentication_method")
 
@@ -306,6 +317,12 @@ class Session:
                 props["receive_maximum"] = cfg.receive_max_broker
             if cfg.topic_alias_max_client:
                 props["topic_alias_maximum"] = cfg.topic_alias_max_client
+            if self.max_frame_in:
+                # announce the inbound frame ceiling the listener is
+                # ACTUALLY parsing with (MQTT5 3.2.2.3.6) — not the live
+                # config value, which can drift from the listener's
+                # snapshot (runtime config set, per-listener override)
+                props["maximum_packet_size"] = self.max_frame_in
             if cfg.max_session_expiry_interval and self.session_expiry != \
                     (self._pending_connect or f).properties.get("session_expiry_interval", 0):
                 props["session_expiry_interval"] = self.session_expiry
@@ -420,7 +437,12 @@ class Session:
         cfg = self.broker.config
         if cfg.max_message_size and len(f.payload) > cfg.max_message_size:
             self.broker.metrics.incr("mqtt_invalid_msg_size_error")
-            await self.close("message_too_large")
+            if self.proto_ver == PROTO_5:
+                # tell a v5 client WHY before dropping the socket
+                # (MQTT5 3.2.2.3.6 / DISCONNECT 0x95)
+                await self._disconnect_v5(RC_PACKET_TOO_LARGE)
+            else:
+                await self.close("message_too_large")
             return
         if not self.broker.metrics.check_rate(self.sid, cfg.max_message_rate):
             # the reference THROTTLES rather than kills the session: the
@@ -606,6 +628,19 @@ class Session:
         if msg.expires_at is not None and msg.expires_at < time.monotonic():
             self.broker.metrics.incr("queue_message_expired")
             return True  # consumed (expired), not a drop by us
+        # only capped clients (maximum_packet_size announced, or
+        # m5_max_packet_size configured) pay this extra build+serialise;
+        # everyone else short-circuits on max_packet_out == 0
+        if self.max_packet_out and self._oversize_v5(msg):
+            # the client's maximum_packet_size forbids this frame: drop
+            # it (never truncate, never error the session) with the same
+            # hook the reference fires (vmq_mqtt5_fsm.erl:1422-1427);
+            # checked BEFORE packet-id allocation so nothing leaks into
+            # waiting_acks
+            self.broker.metrics.incr("queue_message_drop")
+            self.broker.hooks_fire_all("on_message_drop", self.sid, msg,
+                                       "max_packet_size_exceeded")
+            return True
         if msg.qos == 0:
             self._send_publish(msg, None)
             return True
@@ -620,6 +655,51 @@ class Session:
                 return False
             self.pending.append(msg)
         return True
+
+    def _build_v5_publish(self, msg: Msg, pid: Optional[int],
+                          dup: bool = False, commit: bool = True) -> Publish:
+        """The ONE place the broker->client v5 PUBLISH frame is shaped:
+        remaining message expiry (MQTT5 3.3.2.3.3) and outbound topic
+        alias (vmq_mqtt5_fsm.erl topic_aliases out).  With
+        ``commit=False`` an alias the send path WOULD allocate is
+        simulated (same 3-byte property, placeholder id) without
+        mutating alias state — so the size check below measures exactly
+        the frame that will go on the wire."""
+        props = dict(msg.properties)
+        if msg.expires_at is not None:
+            props["message_expiry_interval"] = max(
+                0, int(msg.expires_at - time.monotonic()))
+        topic_str = T.unword(list(msg.topic))
+        if self.topic_alias_max_out:
+            alias = self.topic_alias_out.get(msg.topic)
+            if alias is not None:
+                topic_str = ""
+                props["topic_alias"] = alias
+            elif len(self.topic_alias_out) < self.topic_alias_max_out:
+                alias = len(self.topic_alias_out) + 1
+                if commit:
+                    self.topic_alias_out[msg.topic] = alias
+                # the alias-establishing frame carries BOTH the full
+                # topic and the alias property
+                props["topic_alias"] = alias
+        return Publish(topic=topic_str, payload=msg.payload, qos=msg.qos,
+                       retain=msg.retain, dup=dup, packet_id=pid,
+                       properties=props)
+
+    def _oversize_v5(self, msg: Msg) -> bool:
+        """Would this delivery exceed the client's maximum_packet_size?
+        Measures the exact frame the send path would build, including
+        an alias allocation it would make — the analog of
+        maybe_reduce_packet_size serialising to check
+        (vmq_mqtt5_fsm.erl:297-315; we carry no reason-string/user-props
+        on PUBLISH, so there is nothing to strip first)."""
+        if self.proto_ver != PROTO_5:
+            return False
+        from ..protocol import codec_v5
+
+        frame = self._build_v5_publish(msg, 1 if msg.qos else None,
+                                       commit=False)
+        return len(codec_v5.serialise(frame)) > self.max_packet_out
 
     def _send_publish(self, msg: Msg, pid: Optional[int], dup: bool = False) -> None:
         self.broker.hooks_fire_all(
@@ -644,29 +724,14 @@ class Session:
             m.incr("bytes_sent", len(data))
             m.incr("mqtt_publish_sent")
             return
-        props = dict(msg.properties)
-        topic_str = T.unword(list(msg.topic))
         if self.proto_ver == PROTO_5:
-            # remaining message expiry (MQTT5 3.3.2.3.3)
-            if msg.expires_at is not None:
-                remaining = max(0, int(msg.expires_at - time.monotonic()))
-                props["message_expiry_interval"] = remaining
-            # outbound topic alias (vmq_mqtt5_fsm.erl topic_aliases out)
-            if self.topic_alias_max_out:
-                alias = self.topic_alias_out.get(msg.topic)
-                if alias is not None:
-                    topic_str = ""
-                    props["topic_alias"] = alias
-                elif len(self.topic_alias_out) < self.topic_alias_max_out:
-                    alias = len(self.topic_alias_out) + 1
-                    self.topic_alias_out[msg.topic] = alias
-                    props["topic_alias"] = alias
+            frame = self._build_v5_publish(msg, pid, dup)
         else:
-            props = {}
-        frame = Publish(
-            topic=topic_str, payload=msg.payload, qos=msg.qos,
-            retain=msg.retain, dup=dup, packet_id=pid, properties=props,
-        )
+            frame = Publish(
+                topic=T.unword(list(msg.topic)), payload=msg.payload,
+                qos=msg.qos, retain=msg.retain, dup=dup, packet_id=pid,
+                properties={},
+            )
         self.send(frame)
         self.broker.metrics.incr("mqtt_publish_sent")
 

@@ -313,7 +313,12 @@ class WebSocketServer:
             # connection loop alongside MQTT parse errors
             await mqtt_connection(
                 self.broker, ws.read_message, transport, peer,
-                self.max_frame_size or MAX_FRAME_SIZE,
+                # same fallback chain as MQTTServer: per-listener
+                # override, else the broker-wide max_message_size
+                # total-frame cap, else unlimited
+                (self.max_frame_size
+                 or self.broker.config.get("max_message_size", 0)
+                 or MAX_FRAME_SIZE),
                 preauth_user=preauth, mountpoint=self.mountpoint,
                 allowed_protocol_versions=self.allowed_protocol_versions)
         finally:
