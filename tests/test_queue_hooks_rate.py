@@ -297,8 +297,10 @@ async def test_v5_packet_cap_honoured_with_alias_allocation():
 
     # the sharp edge: a FIRST publish on a fresh topic sized so the
     # bare frame fits the cap but the alias-ESTABLISHING frame (full
-    # topic + 3-byte alias property) does not — it must be dropped,
-    # not sent oversize (the pre-fix code under-measured exactly this)
+    # topic + 3-byte alias property) does not. The broker must deliver
+    # it BARE (skip the alias allocation) — neither send it oversize
+    # (the under-measuring bug) nor drop a legal message (the
+    # always-simulate-alias bug)
     topic2 = "b/otherlongtopicname"
     n = 1
     while len(codec_v5.serialise(Publish(
@@ -316,12 +318,16 @@ async def test_v5_packet_cap_honoured_with_alias_allocation():
     await c.recv()  # SUBACK
     await pub.publish(topic2, b"q" * n, qos=0)
     await pub.publish(topic2, b"END2", qos=0)
+    seen2 = []
     while True:
         f = await c.recv()
         assert len(codec_v5.serialise(f)) <= cap
-        assert f.payload != b"q" * n  # the borderline frame was dropped
+        seen2.append(f)
         if f.payload == b"END2":
             break
+    borderline = [f for f in seen2 if f.payload == b"q" * n]
+    assert len(borderline) == 1                      # delivered, not lost
+    assert "topic_alias" not in borderline[0].properties  # sent bare
     await pub.disconnect()
     await b.stop()
     await server.stop()

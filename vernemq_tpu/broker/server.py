@@ -15,7 +15,12 @@ import logging
 from typing import Optional, Tuple
 
 from ..protocol import codec_v4, codec_v5, wire
-from ..protocol.types import PROTO_5, Connect, ParseError
+from ..protocol.types import (
+    PROTO_5,
+    RC_PACKET_TOO_LARGE,
+    Connect,
+    ParseError,
+)
 from .broker import Broker
 from .session import Session, Transport
 from .websocket import WsError
@@ -176,14 +181,17 @@ async def mqtt_connection(
                 try:
                     frame, view = codec.parse(view, max_frame_size)
                 except ParseError as e:
-                    if (e.reason == "frame_too_large"
-                            and session.proto_ver == PROTO_5
-                            and not session.closed):
-                        # tell a v5 client WHY before dropping the
-                        # socket (MQTT5 3.2.2.3.6 / DISCONNECT 0x95)
-                        from ..protocol.types import RC_PACKET_TOO_LARGE
-
-                        await session._disconnect_v5(RC_PACKET_TOO_LARGE)
+                    if e.reason == "frame_too_large":
+                        # the metric monitoring keys on, now that the
+                        # parser (not the session payload check) is the
+                        # enforcement point
+                        metrics.incr("mqtt_invalid_msg_size_error")
+                        if session.proto_ver == PROTO_5 \
+                                and not session.closed:
+                            # tell a v5 client WHY before dropping the
+                            # socket (MQTT5 3.2.2.3.6 / DISCONNECT 0x95)
+                            await session._disconnect_v5(
+                                RC_PACKET_TOO_LARGE)
                     raise
                 if frame is None:
                     break
@@ -237,8 +245,10 @@ class MQTTServer:
         self.host = host
         self.port = port
         # per-listener override, else the broker-wide max_message_size
-        # (the reference's semantic: vmq_parser.erl enforces it as a
-        # TOTAL-frame cap on every packet type, not just PUBLISH payloads)
+        # (the reference's semantic: vmq_parser.erl enforces it on every
+        # packet type as a REMAINING-LENGTH cap — total accepted bytes
+        # are at most cap + 5B of fixed header, the lenient direction
+        # the spec allows relative to the announced value)
         self.max_frame_size = (max_frame_size
                                or broker.config.get("max_message_size", 0)
                                or MAX_FRAME_SIZE)

@@ -42,7 +42,6 @@ from ..protocol.types import (
     RC_PACKET_ID_NOT_FOUND,
     RC_SESSION_TAKEN_OVER,
     RC_SUCCESS,
-    RC_PACKET_TOO_LARGE,
     RC_RECEIVE_MAX_EXCEEDED,
     RC_TOPIC_ALIAS_INVALID,
     RC_UNSPECIFIED_ERROR,
@@ -318,10 +317,13 @@ class Session:
             if cfg.topic_alias_max_client:
                 props["topic_alias_maximum"] = cfg.topic_alias_max_client
             if self.max_frame_in:
-                # announce the inbound frame ceiling the listener is
-                # ACTUALLY parsing with (MQTT5 3.2.2.3.6) — not the live
-                # config value, which can drift from the listener's
-                # snapshot (runtime config set, per-listener override)
+                # announce the inbound ceiling the listener is ACTUALLY
+                # parsing with (MQTT5 3.2.2.3.6) — not the live config
+                # value, which can drift from the listener's snapshot
+                # (runtime config set, per-listener override). The
+                # parser caps remaining length, so total accepted bytes
+                # run up to ~5B over: the lenient direction — nothing
+                # the broker promised to accept is ever rejected
                 props["maximum_packet_size"] = self.max_frame_in
             if cfg.max_session_expiry_interval and self.session_expiry != \
                     (self._pending_connect or f).properties.get("session_expiry_interval", 0):
@@ -435,15 +437,10 @@ class Session:
 
     async def _handle_publish(self, f: Publish) -> None:
         cfg = self.broker.config
-        if cfg.max_message_size and len(f.payload) > cfg.max_message_size:
-            self.broker.metrics.incr("mqtt_invalid_msg_size_error")
-            if self.proto_ver == PROTO_5:
-                # tell a v5 client WHY before dropping the socket
-                # (MQTT5 3.2.2.3.6 / DISCONNECT 0x95)
-                await self._disconnect_v5(RC_PACKET_TOO_LARGE)
-            else:
-                await self.close("message_too_large")
-            return
+        # NOTE max_message_size is enforced at the PARSER as a frame cap
+        # for every packet type (vmq_parser.erl semantics; server.py
+        # steady-state loop incrs mqtt_invalid_msg_size_error and sends
+        # v5 DISCONNECT 0x95) — an oversize PUBLISH never reaches here
         if not self.broker.metrics.check_rate(self.sid, cfg.max_message_rate):
             # the reference THROTTLES rather than kills the session: the
             # socket loop pauses reads for ~1s (vmq_mqtt_fsm.erl:243-262 →
@@ -629,27 +626,29 @@ class Session:
             self.broker.metrics.incr("queue_message_expired")
             return True  # consumed (expired), not a drop by us
         # only capped clients (maximum_packet_size announced, or
-        # m5_max_packet_size configured) pay this extra build+serialise;
-        # everyone else short-circuits on max_packet_out == 0
-        if self.max_packet_out and self._oversize_v5(msg):
-            # the client's maximum_packet_size forbids this frame: drop
-            # it (never truncate, never error the session) with the same
-            # hook the reference fires (vmq_mqtt5_fsm.erl:1422-1427);
-            # checked BEFORE packet-id allocation so nothing leaks into
-            # waiting_acks
+        # m5_max_packet_size configured) pay the extra build+serialise
+        # inside _plan_v5_delivery; everyone else short-circuits
+        plan = self._plan_v5_delivery(msg) if self.max_packet_out else "fits"
+        if plan == "drop":
+            # the client's maximum_packet_size forbids this frame even
+            # without an alias: drop it (never truncate, never error the
+            # session) with the same hook the reference fires
+            # (vmq_mqtt5_fsm.erl:1422-1427); checked BEFORE packet-id
+            # allocation so nothing leaks into waiting_acks
             self.broker.metrics.incr("queue_message_drop")
             self.broker.hooks_fire_all("on_message_drop", self.sid, msg,
                                        "max_packet_size_exceeded")
             return True
+        allow_alias = plan == "fits"
         if msg.qos == 0:
-            self._send_publish(msg, None)
+            self._send_publish(msg, None, allow_alias=allow_alias)
             return True
         window = min(self.broker.config.max_inflight_messages, self.receive_max_out)
         if len(self.waiting_acks) < window:
             pid = self._next_packet_id()
             self.waiting_acks[pid] = ["puback" if msg.qos == 1 else "pubrec",
                                       msg, time.monotonic(), False]
-            self._send_publish(msg, pid)
+            self._send_publish(msg, pid, allow_alias=allow_alias)
         else:
             if len(self.pending) >= self.broker.config.max_online_messages:
                 return False
@@ -657,14 +656,16 @@ class Session:
         return True
 
     def _build_v5_publish(self, msg: Msg, pid: Optional[int],
-                          dup: bool = False, commit: bool = True) -> Publish:
+                          dup: bool = False, commit: bool = True,
+                          allow_alias: bool = True) -> Publish:
         """The ONE place the broker->client v5 PUBLISH frame is shaped:
         remaining message expiry (MQTT5 3.3.2.3.3) and outbound topic
         alias (vmq_mqtt5_fsm.erl topic_aliases out).  With
         ``commit=False`` an alias the send path WOULD allocate is
         simulated (same 3-byte property, placeholder id) without
-        mutating alias state — so the size check below measures exactly
-        the frame that will go on the wire."""
+        mutating alias state; ``allow_alias=False`` skips the
+        allocation entirely (an established alias is still used — it
+        only shrinks the frame)."""
         props = dict(msg.properties)
         if msg.expires_at is not None:
             props["message_expiry_interval"] = max(
@@ -675,7 +676,8 @@ class Session:
             if alias is not None:
                 topic_str = ""
                 props["topic_alias"] = alias
-            elif len(self.topic_alias_out) < self.topic_alias_max_out:
+            elif allow_alias \
+                    and len(self.topic_alias_out) < self.topic_alias_max_out:
                 alias = len(self.topic_alias_out) + 1
                 if commit:
                     self.topic_alias_out[msg.topic] = alias
@@ -686,22 +688,35 @@ class Session:
                        retain=msg.retain, dup=dup, packet_id=pid,
                        properties=props)
 
-    def _oversize_v5(self, msg: Msg) -> bool:
-        """Would this delivery exceed the client's maximum_packet_size?
-        Measures the exact frame the send path would build, including
-        an alias allocation it would make — the analog of
-        maybe_reduce_packet_size serialising to check
+    def _plan_v5_delivery(self, msg: Msg) -> str:
+        """How does this delivery fit the client's maximum_packet_size?
+        Measures the exact frame the send path would build — the analog
+        of maybe_reduce_packet_size serialising to check
         (vmq_mqtt5_fsm.erl:297-315; we carry no reason-string/user-props
-        on PUBLISH, so there is nothing to strip first)."""
+        on PUBLISH, so the only thing strippable is the alias property):
+
+        - ``"fits"``  — full frame (alias allocation included) fits;
+        - ``"bare"``  — only the alias-ESTABLISHING overhead (full topic
+          + 3-byte property) pushes it over: deliver without allocating
+          the alias rather than lose a legal message;
+        - ``"drop"``  — exceeds the cap even without an alias.
+        """
         if self.proto_ver != PROTO_5:
-            return False
-        from ..protocol import codec_v5
+            return "fits"
+        pid = 1 if msg.qos else None
+        frame = self._build_v5_publish(msg, pid, commit=False)
+        if len(codec_v5.serialise(frame)) <= self.max_packet_out:
+            return "fits"
+        if "topic_alias" in frame.properties and frame.topic:
+            # the over-measure came from the would-be allocation
+            bare = self._build_v5_publish(msg, pid, commit=False,
+                                          allow_alias=False)
+            if len(codec_v5.serialise(bare)) <= self.max_packet_out:
+                return "bare"
+        return "drop"
 
-        frame = self._build_v5_publish(msg, 1 if msg.qos else None,
-                                       commit=False)
-        return len(codec_v5.serialise(frame)) > self.max_packet_out
-
-    def _send_publish(self, msg: Msg, pid: Optional[int], dup: bool = False) -> None:
+    def _send_publish(self, msg: Msg, pid: Optional[int], dup: bool = False,
+                      allow_alias: bool = True) -> None:
         self.broker.hooks_fire_all(
             "on_deliver", self.username, self.sid, msg.topic, msg.payload
         )
@@ -725,7 +740,8 @@ class Session:
             m.incr("mqtt_publish_sent")
             return
         if self.proto_ver == PROTO_5:
-            frame = self._build_v5_publish(msg, pid, dup)
+            frame = self._build_v5_publish(msg, pid, dup,
+                                           allow_alias=allow_alias)
         else:
             frame = Publish(
                 topic=T.unword(list(msg.topic)), payload=msg.payload,
@@ -749,10 +765,19 @@ class Session:
             if msg.expires_at is not None and msg.expires_at < time.monotonic():
                 self.broker.metrics.incr("queue_message_expired")
                 continue
+            # re-plan against the cap: alias state may have moved while
+            # the message waited in pending
+            plan = (self._plan_v5_delivery(msg) if self.max_packet_out
+                    else "fits")
+            if plan == "drop":
+                self.broker.metrics.incr("queue_message_drop")
+                self.broker.hooks_fire_all("on_message_drop", self.sid,
+                                           msg, "max_packet_size_exceeded")
+                continue
             pid = self._next_packet_id()
             self.waiting_acks[pid] = ["puback" if msg.qos == 1 else "pubrec",
                                       msg, time.monotonic(), False]
-            self._send_publish(msg, pid)
+            self._send_publish(msg, pid, allow_alias=plan == "fits")
         # session window freed and nothing pending here: pull messages the
         # queue parked under backpressure (notify→active transition)
         if (not self.pending and self.queue is not None
