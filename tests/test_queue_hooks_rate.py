@@ -334,6 +334,75 @@ async def test_v5_packet_cap_honoured_with_alias_allocation():
 
 
 @pytest.mark.asyncio
+async def test_v5_retry_keeps_bare_plan():
+    """A QoS1 delivery sent BARE (alias allocation would breach the
+    client's maximum_packet_size) must stay within the cap on DUP
+    retransmit too — the retry re-plans instead of regrowing an alias."""
+    from vernemq_tpu.protocol import codec_v5
+    from vernemq_tpu.protocol.types import (
+        Connect, Publish, Subscribe, SubOpts,
+    )
+
+    b, server = await boot(retry_interval=1)
+    cap = 80
+    topic = "b/otherlongtopicname"
+    n = 1
+    while len(codec_v5.serialise(Publish(
+            topic=topic, payload=b"q" * (n + 1), qos=1, packet_id=1,
+            properties={}))) <= cap:
+        n += 1
+    c = RawV5(server.host, server.port)
+    c.r, c.w = await asyncio.open_connection(server.host, server.port)
+    c.w.write(codec_v5.serialise(Connect(
+        proto_ver=5, client_id="retrybare", clean_start=True, keepalive=60,
+        properties={"maximum_packet_size": cap, "topic_alias_maximum": 5})))
+    await c.w.drain()
+    await c.recv()  # CONNACK
+    await c.send(Subscribe(packet_id=1, topics=[(topic, SubOpts(qos=1))],
+                           properties={}))
+    await c.recv()  # SUBACK
+    pub = await connected(server, "retrypub")
+    await pub.publish(topic, b"q" * n, qos=1)
+    frames = []
+    for _ in range(2):  # original + one DUP retry (we never PUBACK)
+        f = await c.recv(timeout=5)
+        assert isinstance(f, Publish) and f.payload == b"q" * n
+        assert len(codec_v5.serialise(f)) <= cap
+        assert "topic_alias" not in f.properties
+        frames.append(f)
+    assert frames[1].dup
+    await pub.disconnect()
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_retained_replay_carries_remaining_expiry():
+    """MQTT5 3.3.2.3.3: a retained message replayed on subscribe must
+    carry the REMAINING expiry interval, not the one it was stored with
+    (vmq_reg.erl retained replay + update_expiry_interval)."""
+    b, server = await boot()
+    pub = MQTTClient(server.host, server.port, client_id="rx-pub",
+                     proto_ver=5)
+    await pub.connect()
+    await pub.publish("rx/t", b"keep", qos=1, retain=True,
+                      properties={"message_expiry_interval": 100})
+    await asyncio.sleep(1.1)
+    sub = MQTTClient(server.host, server.port, client_id="rx-sub",
+                     proto_ver=5)
+    await sub.connect()
+    await sub.subscribe("rx/t", qos=1)
+    m = await asyncio.wait_for(sub.messages.get(), 5)
+    assert m.payload == b"keep" and m.retain
+    remaining = m.properties.get("message_expiry_interval")
+    assert remaining is not None and remaining <= 99, remaining
+    await pub.disconnect()
+    await sub.disconnect()
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_max_message_rate_throttles_not_kills():
     b, server = await boot(max_message_rate=5)
     sub = await connected(server, "rsub")

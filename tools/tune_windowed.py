@@ -94,6 +94,20 @@ def main():
                              f"sync_p50={r['synced_batch_ms_p50']:.1f} "
                              f"left={r['leftover_pubs']} "
                              f"ovf={r['overflow_pubs']}")
+                        if variant == "packed":
+                            # device-resident rate at this geometry: the
+                            # chip's own ceiling, minus the tunnel
+                            try:
+                                k = wb.run_kernel_only()
+                                note(f"{tag} KERNEL-ONLY: "
+                                     f"{k['kernel_matches_per_sec']/1e6:.2f}M"
+                                     f" matches/s "
+                                     f"batch={k['kernel_batch_ms']:.2f}ms "
+                                     f"{k['kernel_publishes_per_sec']/1e3:.0f}"
+                                     f"k pubs/s")
+                            except Exception as e:
+                                note(f"{tag} KERNEL-ONLY FAILED: "
+                                     f"{type(e).__name__}: {str(e)[:120]}")
                         if best is None or r["matches_per_sec"] > best[0]:
                             best = (r["matches_per_sec"], tag)
                     except Exception as e:

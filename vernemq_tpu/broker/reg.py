@@ -582,13 +582,24 @@ class Registry:
         for topic, rmsg in self.broker.retain.match_filter(sid[0], filter_words):
             if rmsg.expiry_ts is not None and rmsg.expiry_ts < now:
                 continue
+            props = dict(rmsg.properties)
+            expires_at = None
+            if rmsg.expiry_ts is not None:
+                # MQTT5 3.3.2.3.3: the replayed message carries the
+                # REMAINING expiry, not the interval it was stored with
+                # (re-stamped from expires_at by the send path); the
+                # stored wall-clock deadline converts to the session's
+                # monotonic domain here
+                expires_at = time.monotonic() + (rmsg.expiry_ts - now)
+                props.pop("message_expiry_interval", None)
             msg = Msg(
                 topic=topic,
                 payload=rmsg.payload,
                 qos=min(opts.qos, rmsg.qos),
                 retain=True,
                 mountpoint=sid[0],
-                properties=dict(rmsg.properties),
+                properties=props,
+                expires_at=expires_at,
             )
             queue.enqueue(msg)
 

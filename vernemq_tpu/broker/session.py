@@ -982,7 +982,14 @@ class Session:
                 entry[2] = now
                 entry[3] = True
                 if kind in ("puback", "pubrec"):
-                    self._send_publish(msg, pid, dup=True)
+                    # re-plan against the client's packet cap: the frame
+                    # the original send skipped an alias allocation for
+                    # must not regrow one on retry (an in-flight message
+                    # is never dropped here — worst case it goes bare)
+                    plan = (self._plan_v5_delivery(msg)
+                            if self.max_packet_out else "fits")
+                    self._send_publish(msg, pid, dup=True,
+                                       allow_alias=plan == "fits")
                 else:  # pubcomp: retransmit PUBREL
                     self.send(Pubrel(packet_id=pid))
 
