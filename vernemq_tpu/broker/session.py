@@ -627,8 +627,8 @@ class Session:
             return True  # consumed (expired), not a drop by us
         # only capped clients (maximum_packet_size announced, or
         # m5_max_packet_size configured) pay the extra build+serialise
-        # inside _plan_v5_delivery; everyone else short-circuits
-        plan = self._plan_v5_delivery(msg) if self.max_packet_out else "fits"
+        # inside _plan_v5_delivery; everyone else short-circuits inside
+        plan = self._plan_v5_delivery(msg)
         if plan == "drop":
             # the client's maximum_packet_size forbids this frame even
             # without an alias: drop it (never truncate, never error the
@@ -701,7 +701,7 @@ class Session:
           the alias rather than lose a legal message;
         - ``"drop"``  — exceeds the cap even without an alias.
         """
-        if self.proto_ver != PROTO_5:
+        if self.proto_ver != PROTO_5 or not self.max_packet_out:
             return "fits"
         pid = 1 if msg.qos else None
         frame = self._build_v5_publish(msg, pid, commit=False)
@@ -767,8 +767,7 @@ class Session:
                 continue
             # re-plan against the cap: alias state may have moved while
             # the message waited in pending
-            plan = (self._plan_v5_delivery(msg) if self.max_packet_out
-                    else "fits")
+            plan = self._plan_v5_delivery(msg)
             if plan == "drop":
                 self.broker.metrics.incr("queue_message_drop")
                 self.broker.hooks_fire_all("on_message_drop", self.sid,
@@ -984,10 +983,12 @@ class Session:
                 if kind in ("puback", "pubrec"):
                     # re-plan against the client's packet cap: the frame
                     # the original send skipped an alias allocation for
-                    # must not regrow one on retry (an in-flight message
-                    # is never dropped here — worst case it goes bare)
-                    plan = (self._plan_v5_delivery(msg)
-                            if self.max_packet_out else "fits")
+                    # must not regrow one on retry. An in-flight message
+                    # is never dropped here — "drop" is unreachable
+                    # within a connection (nothing a frame is built from
+                    # can grow between send and retry), so worst case it
+                    # goes bare
+                    plan = self._plan_v5_delivery(msg)
                     self._send_publish(msg, pid, dup=True,
                                        allow_alias=plan == "fits")
                 else:  # pubcomp: retransmit PUBREL
