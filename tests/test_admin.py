@@ -351,3 +351,141 @@ async def test_session_show_order_by_and_ql_command(broker):
     assert [r["client_id"] for r in res["table"]] == ["cc", "bb"]
     with pytest.raises(CommandError):
         reg.run(b, ["ql", "query", "q=SELECT FROM"])
+
+
+@pytest.mark.asyncio
+async def test_listener_stop_restart_delete_cycle(broker):
+    """vmq-admin listener stop / restart / delete (vmq_ranch_config's
+    suspend / resume / remove split)."""
+    import asyncio as _a
+
+    b, _, _ = broker
+    from vernemq_tpu.broker.listeners import ListenerManager
+
+    lm = b.listeners or ListenerManager(b)
+    srv = await lm.start_listener("mqtt", "127.0.0.1", 0)
+    port = srv.port
+    reg = register_core_commands(CommandRegistry())
+
+    async def can_connect():
+        try:
+            c = MQTTClient("127.0.0.1", port, client_id="lc1")
+            await c.connect(timeout=1.0)
+            await c.disconnect()
+            return True
+        except (ConnectionError, OSError, _a.TimeoutError):
+            return False
+
+    assert await can_connect()
+    reg.run(b, ["listener", "stop", "address=127.0.0.1", f"port={port}"])
+    await _a.sleep(0.1)
+    assert not await can_connect()
+    # stopped, not gone: still listed, restartable with retained opts
+    rows = reg.run(b, ["listener", "show"])["table"]
+    mine = [r for r in rows if r["port"] == port]
+    assert mine and mine[0]["status"] == "stopped"
+    reg.run(b, ["listener", "restart", "address=127.0.0.1", f"port={port}"])
+    await _a.sleep(0.2)
+    assert await can_connect()
+    reg.run(b, ["listener", "delete", "address=127.0.0.1", f"port={port}"])
+    await _a.sleep(0.1)
+    assert not await can_connect()
+    assert not [r for r in reg.run(b, ["listener", "show"])["table"]
+                if r["port"] == port]
+
+
+def test_config_reset(event_loop):
+    from vernemq_tpu.broker.broker import Broker
+
+    b = Broker(Config(systree_enabled=False, allow_anonymous=True))
+    reg = register_core_commands(CommandRegistry())
+    b.config.set("max_inflight_messages", 5)
+    assert b.config.max_inflight_messages == 5
+    reg.run(b, ["config", "reset", "key=max_inflight_messages"])
+    from vernemq_tpu.broker.config import DEFAULTS
+
+    assert b.config.max_inflight_messages == \
+        DEFAULTS["max_inflight_messages"]
+    with pytest.raises(CommandError):
+        reg.run(b, ["config", "reset", "key=not_a_knob"])
+    # multi-key via bare names (key=K key=K2 would collapse in a dict)
+    b.config.set("max_inflight_messages", 7)
+    b.config.set("retry_interval", 99)
+    reg.run(b, ["config", "reset", "max_inflight_messages",
+                "retry_interval"])
+    assert b.config.max_inflight_messages == \
+        DEFAULTS["max_inflight_messages"]
+    assert b.config.retry_interval == DEFAULTS["retry_interval"]
+    # an unknown key anywhere means NO partial application
+    b.config.set("retry_interval", 99)
+    with pytest.raises(CommandError):
+        reg.run(b, ["config", "reset", "retry_interval", "nope"])
+    assert b.config.retry_interval == 99
+    # resetting a mutable-valued key must not alias module DEFAULTS
+    reg.run(b, ["config", "reset", "key=http_modules"])
+    assert b.config.get("http_modules") is not DEFAULTS["http_modules"]
+
+
+@pytest.mark.asyncio
+async def test_script_load_unload_cycle(broker, tmp_path):
+    """vmq-admin script load/unload: hooks take effect on load into a
+    LIVE plugin and are retracted on unload."""
+    b, server, _ = broker
+    deny = tmp_path / "deny.py"
+    deny.write_text(
+        "def auth_on_register(peer, sid, user, password, clean):\n"
+        "    return ('error', 'denied-by-script')\n")
+    b.plugins.enable("vmq_diversity", scripts=[])
+    reg = register_core_commands(CommandRegistry())
+    reg.run(b, ["script", "load", f"path={deny}"])
+    assert str(deny) in {r["script"] for r in
+                         reg.run(b, ["script", "show"])["table"]}
+    c = MQTTClient(server.host, server.port, client_id="deny-me")
+    ack = await c.connect()
+    assert ack.rc != 0  # the freshly loaded hook rejects
+    reg.run(b, ["script", "unload", f"path={deny}"])
+    c2 = MQTTClient(server.host, server.port, client_id="deny-me")
+    ack2 = await c2.connect()
+    assert ack2.rc == 0  # hook retracted
+    await c2.disconnect()
+    with pytest.raises(CommandError):
+        reg.run(b, ["script", "unload", f"path={deny}"])
+
+
+@pytest.mark.asyncio
+async def test_node_upgrade_alias_and_webhooks_cache(broker):
+    b, _, _ = broker
+    reg = register_core_commands(CommandRegistry())
+    out = reg.run(b, ["node", "upgrade", "dry=true"])
+    assert "plan" in out
+    with pytest.raises(CommandError):
+        reg.run(b, ["node", "start"])
+    b.plugins.enable("vmq_webhooks")
+    res = reg.run(b, ["webhooks", "cache"])["table"][0]
+    assert set(res) == {"hits", "misses", "entries"}
+
+
+@pytest.mark.asyncio
+async def test_node_stop_graceful():
+    """vmq-admin node stop: sessions see the shutdown, listeners close,
+    and a second stop (the launcher's cleanup) is harmless."""
+    import asyncio as _a
+
+    b, server = await start_broker(
+        Config(systree_enabled=False, allow_anonymous=True), port=0)
+    c = MQTTClient(server.host, server.port, client_id="bye")
+    await c.connect()
+    reg = register_core_commands(CommandRegistry())
+    out = reg.run(b, ["node", "stop"])
+    assert "stopping" in out
+    await _a.sleep(0.3)
+    assert not b.sessions  # drained
+    try:
+        c2 = MQTTClient(server.host, server.port, client_id="late")
+        await c2.connect(timeout=1.0)
+        connected_after = True
+    except (ConnectionError, OSError, _a.TimeoutError):
+        connected_after = False
+    assert not connected_after  # listeners are down too
+    await b.stop()        # idempotent double-stop
+    await server.stop()

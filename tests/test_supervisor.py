@@ -90,8 +90,13 @@ async def test_deliberate_stop_not_resurrected():
         (addr, port) = next(iter(mgr._listeners))
         mgr.stop_listener(addr, port)
         await asyncio.sleep(2.5)  # > watchdog interval
-        assert (addr, port) not in mgr._listeners
+        # admin-stopped: record retained (restartable) with no server,
+        # and the watchdog must NOT have resurrected it
+        assert mgr._listeners[(addr, port)]["server"] is None
         assert b.metrics.value("supervisor_restarts") == 0
+        # delete forgets it entirely
+        mgr.delete_listener(addr, port)
+        assert (addr, port) not in mgr._listeners
     finally:
         await b.stop()
         await s.stop()

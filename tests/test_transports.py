@@ -352,6 +352,10 @@ async def test_listener_show_and_stop(broker):
     kinds = {r["type"] for r in rows}
     assert "mqtt" in kinds and "ws" in kinds
     lm.stop_listener("127.0.0.1", ws_server.port)
+    # stopped keeps the (restartable) record; delete forgets it
+    mine = [r for r in lm.show() if r["port"] == ws_server.port]
+    assert mine and mine[0]["status"] == "stopped"
+    lm.delete_listener("127.0.0.1", ws_server.port)
     assert all(r["port"] != ws_server.port for r in lm.show())
 
 

@@ -203,6 +203,23 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "[--mqtt|--mqtts|--ws|--wss|--http]")
     reg.register(["listener", "stop"], _listener_stop,
                  "vmq-admin listener stop address=A port=P")
+    reg.register(["listener", "restart"], _listener_restart,
+                 "vmq-admin listener restart address=A port=P")
+    reg.register(["listener", "delete"], _listener_delete,
+                 "vmq-admin listener delete address=A port=P")
+    reg.register(["config", "reset"], _config_reset,
+                 "vmq-admin config reset key=K [key=K2 ...]")
+    reg.register(["node", "stop"], _node_stop,
+                 "vmq-admin node stop  (graceful broker shutdown)")
+    reg.register(["node", "start"], _node_start, "vmq-admin node start")
+    reg.register(["node", "upgrade"], _node_upgrade,
+                 "vmq-admin node upgrade [dry=true]  (alias of updo run)")
+    reg.register(["script", "load"], _script_load,
+                 "vmq-admin script load path=/path/to/script")
+    reg.register(["script", "unload"], _script_unload,
+                 "vmq-admin script unload path=/path/to/script")
+    reg.register(["webhooks", "cache"], _webhooks_cache,
+                 "vmq-admin webhooks cache  (stats; resets after show)")
     reg.register(["api-key", "create"], _api_key_create,
                  "vmq-admin api-key create")
     reg.register(["api-key", "show"], _api_key_show, "vmq-admin api-key show")
@@ -668,6 +685,138 @@ def _listener_stop(broker, flags):
     port = int(flags.get("port", 0))
     lm.stop_listener(addr, port)
     return f"listener {addr}:{port} stopping"
+
+
+def _listener_restart(broker, flags):
+    """vmq-admin listener restart: stop-and-start with retained opts."""
+    import asyncio
+
+    lm = _listener_manager(broker)
+    addr = str(flags.get("address", "127.0.0.1"))
+    port = int(flags.get("port", 0))
+    if (addr, port) not in lm._listeners:
+        raise CommandError(f"no listener on {addr}:{port}")
+    task = asyncio.get_event_loop().create_task(
+        lm.restart_listener(addr, port))
+    lm.track_start_task(task)
+    return f"listener {addr}:{port} restarting"
+
+
+def _listener_delete(broker, flags):
+    """vmq-admin listener delete: stop and forget the listener."""
+    lm = _listener_manager(broker)
+    addr = str(flags.get("address", "127.0.0.1"))
+    port = int(flags.get("port", 0))
+    try:
+        lm.delete_listener(addr, port)
+    except KeyError as e:
+        raise CommandError(str(e)) from None
+    return f"listener {addr}:{port} deleted"
+
+
+def _config_reset(broker, flags):
+    """vmq-admin config reset key=K: back to the compiled default."""
+    import copy
+
+    from ..broker.config import DEFAULTS
+
+    # both spellings work: `config reset key=K` and `config reset K1 K2`
+    # (the flags dict collapses repeated key=..., so multi-key uses the
+    # bare form, which _parse_flags records in order under "_bare")
+    keys = list(flags.pop("_bare", []))
+    for k, v in flags.items():
+        if v is BARE:
+            continue  # already in the bare list
+        if k == "key":
+            keys.append(v)
+        else:
+            raise CommandError(f"unexpected flag {k}={v!r}; usage: "
+                               "config reset key=K | config reset K1 K2")
+    if not keys:
+        raise CommandError("config reset needs key=K or bare key names")
+    for k in keys:
+        if k not in DEFAULTS:
+            raise CommandError(f"unknown config key: {k}")
+    for k in keys:  # validate-all-then-apply: no partial resets
+        # deep copy: DEFAULTS holds mutable values (lists/dicts) and the
+        # live config must never alias the process-wide default objects
+        broker.config.set(k, copy.deepcopy(DEFAULTS[k]))
+    return f"{len(keys)} config value(s) reset to defaults"
+
+
+def _node_stop(broker, flags):
+    """vmq-admin node stop: graceful shutdown of this broker node —
+    sessions closed through their lifecycle hooks, listeners down,
+    state flushed (the vmq-admin node stop / vernemq stop path)."""
+    import asyncio
+
+    # broker.stop() owns the ordering: sessions first (lifecycle hooks
+    # fire), then plugins, then listeners — stopping listeners first
+    # would deadlock on wait_closed behind the still-open sessions
+    task = asyncio.get_event_loop().create_task(broker.stop())
+
+    def _done(t: "asyncio.Task") -> None:
+        if not t.cancelled() and t.exception() is not None:
+            import logging
+
+            logging.getLogger("vernemq_tpu.admin").error(
+                "node stop failed mid-shutdown", exc_info=t.exception())
+
+    task.add_done_callback(_done)
+    return "draining sessions and stopping the node"
+
+
+def _node_start(broker, flags):
+    raise CommandError(
+        "this admin channel lives inside a running broker; use the "
+        "service launcher (python -m vernemq_tpu ...) to start one")
+
+
+def _node_upgrade(broker, flags):
+    """vmq-admin node upgrade: the hot-code-upgrade entry (vmq_updo:run
+    behind the reference's upgrade command) — alias of `updo run`."""
+    return _updo_run(broker, flags)
+
+
+def _script_load(broker, flags):
+    plugin = broker.plugins.get("vmq_diversity")
+    if plugin is None:
+        raise CommandError("vmq_diversity plugin not enabled")
+    path = flags.get("path")
+    if not isinstance(path, str):
+        raise CommandError("path=/path/to/script required")
+    try:
+        plugin.load_script(path)
+    except Exception as e:
+        raise CommandError(f"load failed: {e}") from e
+    return f"script {path} loaded"
+
+
+def _script_unload(broker, flags):
+    plugin = broker.plugins.get("vmq_diversity")
+    if plugin is None:
+        raise CommandError("vmq_diversity plugin not enabled")
+    path = flags.get("path")
+    if not isinstance(path, str):
+        raise CommandError("path=/path/to/script required")
+    if path not in plugin.scripts:
+        raise CommandError(f"no such script {path!r}")
+    plugin.unload_script(path)
+    return f"script {path} unloaded"
+
+
+def _webhooks_cache(broker, flags):
+    """vmq-admin webhooks cache: hit/miss/entry stats, reset after show
+    (vmq_webhooks_cli cache_stats_cmd + reset_stats)."""
+    wh = broker.plugins.get("vmq_webhooks")
+    if wh is None:
+        raise CommandError("vmq_webhooks plugin not enabled")
+    cache = wh.cache
+    row = {"hits": cache.hits, "misses": cache.misses,
+           "entries": len(cache._data)}
+    cache.hits = 0
+    cache.misses = 0
+    return {"table": [row]}
 
 
 # --- api keys: stored in replicated metadata (mgmt API auth) ---------------

@@ -109,19 +109,49 @@ class ListenerManager:
         return list(self._listeners.values())
 
     def stop_listener(self, addr: str, port: int) -> None:
-        entry = self._listeners.pop((addr, port), None)
+        """Stop accepting on a listener but KEEP its configuration so
+        ``restart`` can bring it back (vmq_ranch_config suspend/resume
+        split between listener stop and listener delete)."""
+        entry = self._listeners.get((addr, port))
         if entry is None:
             raise KeyError(f"no listener on {addr}:{port}")
         server = entry["server"]
-        stop = getattr(server, "stop", None)
+        entry["server"] = None  # stopped; opts/kind retained for restart
+        stop = getattr(server, "stop", None) if server is not None else None
         if stop is not None:
             task = asyncio.get_event_loop().create_task(stop())
             self._start_tasks.append(task)
 
+    def delete_listener(self, addr: str, port: int) -> None:
+        """Stop (if running) and forget the listener entirely."""
+        if (addr, port) in self._listeners:
+            self.stop_listener(addr, port)
+        self._listeners.pop((addr, port), None)
+
+    async def restart_listener(self, addr: str, port: int):
+        """Stop-and-start with the retained kind/opts (listener restart).
+        A fixed port is required: a port-0 listener's bound port is its
+        identity, and rebinding 0 would mint a different one."""
+        entry = self._listeners.get((addr, port))
+        if entry is None:
+            raise KeyError(f"no listener on {addr}:{port}")
+        if entry["server"] is not None:
+            server = entry["server"]
+            entry["server"] = None
+            stop = getattr(server, "stop", None)
+            if stop is not None:
+                await stop()
+        # the record stays until the new server is up: a failed start
+        # (moved cert, stolen port) must leave the listener stopped and
+        # RESTARTABLE, never erase its configuration. start_listener
+        # overwrites the record on success.
+        return await self.start_listener(entry["kind"], addr, port,
+                                         entry["opts"])
+
     async def stop_all(self) -> None:
         for addr, port in list(self._listeners):
             try:
-                self.stop_listener(addr, port)
+                self.delete_listener(addr, port)
             except KeyError:
                 pass
         for t in self._start_tasks:
@@ -149,6 +179,7 @@ class ListenerManager:
             rows.append({
                 "type": entry["kind"], "address": addr, "port": port,
                 "mountpoint": entry["opts"].get("mountpoint", ""),
-                "status": "running",
+                "status": ("running" if entry["server"] is not None
+                           else "stopped"),
             })
         return rows

@@ -208,9 +208,13 @@ class ScriptingPlugin:
         self.broker = broker
         self.cache = AclCache()
         self.scripts: Dict[str, Script] = {}
+        self._registered: List[Tuple[str, Any]] = []
+        # per-script hook registrations so `script unload` can retract
+        # exactly one script's handlers (vmq_diversity_cli unload)
+        self._script_hooks: Dict[str, List[Tuple[str, Any]]] = {}
+        self._hookreg = None  # set by register(); None until enabled
         for path in (scripts or broker.config.get("diversity_scripts", [])):
             self.load_script(path)
-        self._registered: List[Tuple[str, Any]] = []
 
     # ------------------------------------------------------------- scripts
 
@@ -218,6 +222,10 @@ class ScriptingPlugin:
         """Engine by extension: ``.lua`` runs on the in-tree Lua
         interpreter (utils/lua.py via lua_bridge — the reference's
         native script language), anything else as a Python script."""
+        if path in self.scripts and self._script_hooks.get(path):
+            # re-load of a live path: retract the old script's handlers
+            # first or every hook would fire twice (once per generation)
+            self.unload_script(path)
         if path.endswith(".lua"):
             from .lua_bridge import LuaScript
 
@@ -225,11 +233,25 @@ class ScriptingPlugin:
         else:
             s = Script(path, self)
         self.scripts[path] = s
+        if self._hookreg is not None:
+            # loaded into a LIVE plugin (vmq-admin script load): its
+            # hooks must take effect now, not at the next enable
+            self._register_script_hooks(self._hookreg, s)
         return s
 
     def reload_script(self, path: str) -> None:
         """vmq-admin script reload path=... (vmq_diversity_cli)."""
         self.scripts[path].load()
+
+    def unload_script(self, path: str) -> None:
+        """vmq-admin script unload path=...: retract this script's hook
+        handlers and forget it (vmq_diversity_cli unload)."""
+        self.scripts.pop(path)
+        for name, fn in self._script_hooks.pop(path, []):
+            if self._hookreg is not None:
+                self._hookreg.unregister(name, fn)
+            if (name, fn) in self._registered:
+                self._registered.remove((name, fn))
 
     # ----------------------------------------------------------- hook glue
 
@@ -253,11 +275,17 @@ class ScriptingPlugin:
         # vmq_diversity_cache clears on client-gone)
         hooks.register("on_client_gone", self._on_client_gone)
         self._registered.append(("on_client_gone", self._on_client_gone))
+        self._hookreg = hooks
         for script in self.scripts.values():
-            for name in script.hooks:
-                wrapped = self._wrap(script, name)
-                hooks.register(name, wrapped)
-                self._registered.append((name, wrapped))
+            self._register_script_hooks(hooks, script)
+
+    def _register_script_hooks(self, hooks, script) -> None:
+        regs = self._script_hooks.setdefault(script.path, [])
+        for name in script.hooks:
+            wrapped = self._wrap(script, name)
+            hooks.register(name, wrapped)
+            self._registered.append((name, wrapped))
+            regs.append((name, wrapped))
 
     def unregister(self, hooks) -> None:
         for name, fn in self._registered:
