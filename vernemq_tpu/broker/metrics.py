@@ -53,6 +53,8 @@ COUNTERS: List[Tuple[str, str]] = [
     ("mqtt_subscribe_auth_error", "Unauthorized SUBSCRIBE attempts."),
     ("mqtt_unsubscribe_error", "Failed UNSUBSCRIBE attempts."),
     ("mqtt_invalid_msg_size_error", "Oversized messages dropped."),
+    ("mqtt_publish_throttled",
+     "PUBLISHes paused by max_message_rate / overload shedding."),
     ("queue_setup", "The number of queue processes created."),
     ("queue_teardown", "The number of queue processes terminated."),
     ("queue_message_in", "Messages enqueued."),
