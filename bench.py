@@ -142,6 +142,11 @@ class WindowedBench:
         self.variant = variant  # "flat" (scatter buffer) | "rows" (gather)
         self.m = TpuMatcher(max_levels=table.L, initial_capacity=16,
                             max_fanout=max_fanout, flat_avg=flat_avg)
+        # the bench times raw sync/delta costs with direct sync() calls;
+        # a surprise async rebuild would turn those into RebuildInProgress
+        # (production serves the trie through that window — covered by
+        # tests, not timed here)
+        self.m.async_rebuild = False
         self.m.table = table
         table.resized = True  # force first full upload for this matcher
         t0 = time.perf_counter()

@@ -891,12 +891,16 @@ class Registry:
                     out.get("tpu_host_fallbacks", 0) + m.host_fallbacks
                 out["tpu_warmup_batches"] = \
                     out.get("tpu_warmup_batches", 0) + m.warmup_batches
+                out["tpu_async_rebuilds"] = \
+                    out.get("tpu_async_rebuilds", 0) + m.rebuilds_async
         col = getattr(self.broker, "_collector", None)
         if col is not None:
             # small flushes served host-side by hybrid dispatch
             out["tpu_hybrid_host_pubs"] = col.host_hybrid_pubs
             out["tpu_overload_shed_pubs"] = col.overload_host_pubs
             out["tpu_saturated_merges"] = col.saturated_merges
+            # pubs the trie served while the device table rebuilt
+            out["tpu_rebuild_shed_pubs"] = col.rebuild_host_pubs
         return out
 
     def fold_subscriptions(self, mountpoint: str = ""):

@@ -188,6 +188,19 @@ def prepare_windows(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
     return t_pw, t_pl, t_pd, t_start, tile_of, pos_of, leftovers
 
 
+class RebuildInProgress(Exception):
+    """The device table is re-uploading after a capacity change.
+
+    Raised by ``sync``/``match_batch`` instead of stalling the caller
+    behind a full re-upload (seconds at millions of subscriptions over
+    a host link). Callers serve the publish from the host trie — the
+    correctness oracle maintained from the same subscriber-db events —
+    so the publish pipeline keeps flowing while the new table builds in
+    the background (the reference's trie applies events synchronously,
+    vmq_reg_trie.erl:198-210; the stall this removes has no analog
+    there)."""
+
+
 class TpuMatcher:
     def __init__(self, max_levels: int = 16, initial_capacity: int = 1024,
                  max_fanout: int = 256, device=None, flat_avg: int = 128,
@@ -242,6 +255,118 @@ class TpuMatcher:
         # lock, used after release): while > 0, sync() must not DONATE the
         # buffers to a delta scatter or the in-flight call's args die
         self._inflight = 0
+        # non-blocking growth: a capacity rebuild at scale re-uploads the
+        # whole table (seconds at millions of subs — the 28.6s
+        # sub_to_matchable_max outlier in the r3 config-5 run was exactly
+        # this stall). With async_rebuild the re-upload runs on a worker
+        # thread while callers shed to the host trie (RebuildInProgress),
+        # so the publish pipeline never stops. The FIRST build stays
+        # synchronous (there is no old state to serve). Default OFF for
+        # bare matchers (kernel tests/bench time the inline path);
+        # TpuRegView — the production seat, where a trie stands by —
+        # turns it on.
+        self.async_rebuild = False
+        self._rebuild_thread: Optional[threading.Thread] = None
+        self._rebuild_barrier: Optional[threading.Event] = None  # tests
+        self.rebuilds_async = 0
+
+    # ------------------------------------------------------- full (re)build
+
+    def _snapshot_host_locked(self, copy: bool = True,
+                              clear: bool = True) -> dict:
+        """Consistent host-side snapshot of everything a full device
+        build needs. ``copy=True`` (the background path) materialises
+        copies because the live arrays keep mutating after the lock is
+        released; the inline first-build path passes the live refs.
+        ``clear`` consumes ``resized``/``dirty`` at snapshot time so
+        mutations AFTER it re-mark in the (unchanged-by-them) layout —
+        the async path needs that; the inline path clears only after a
+        SUCCESSFUL install so a failed build stays retryable."""
+        t = self.table
+        c = (lambda a: a.copy()) if copy else (lambda a: a)
+        entries = np.empty(len(t.entries), dtype=object)
+        # numpy object array: resolve-side fancy indexing is ~2.5x
+        # faster than per-slot list indexing (measured 120ms -> 49ms
+        # per 4096x61 batch)
+        entries[:] = t.entries
+        state = {
+            "words": c(t.words), "eff_len": c(t.eff_len),
+            "has_hash": c(t.has_hash), "first_wild": c(t.first_wild),
+            "active": c(t.active), "bits": t.id_bits,
+            "reg_start": t.reg_start.copy(),
+            "reg_end": (t.reg_start + t.reg_cap).copy(),
+            "glob_pad": int(t.reg_cap[0]),
+            "gb_end": t.gb_end if t.bucketed else int(t.reg_cap[0]),
+            "ng": t.NG, "bucketed": t.bucketed, "entries": entries,
+        }
+        if clear:
+            t.resized = False
+            t.dirty.clear()
+        return state
+
+    def _build_device(self, state: dict) -> tuple:
+        """Device-side half of a full build (no lock held): upload the
+        snapshot and derive the coded operands + packed meta."""
+        put = lambda a: self._jax.device_put(a, self.device)
+        dev = (put(state["words"]), put(state["eff_len"]),
+               put(state["has_hash"]), put(state["first_wild"]),
+               put(state["active"]))
+        # derived coded operands (F/t1) live device-side next to the
+        # base arrays; id_bits growth (interner crossing a byte plane)
+        # forces this full rebuild path too
+        operands = (K.build_operands(dev[0], dev[1], state["bits"])
+                    if state["bits"] else None)
+        meta = K.pack_meta(*dev[1:5]) if self.packed_io else None
+        return dev, operands, meta
+
+    def _install_built(self, built: tuple, state: dict) -> None:
+        """Publish a finished build as the serving state (lock held)."""
+        self._dev_arrays, self._operands, self._meta = built
+        self._ops_bits = state["bits"]
+        self._reg_start = state["reg_start"]
+        self._reg_end = state["reg_end"]
+        self._glob_pad = state["glob_pad"]
+        self._gb_end = state["gb_end"]
+        self._ng = state["ng"]
+        self._bucketed = state["bucketed"]
+        self._entries_snapshot = state["entries"]
+
+    def _spawn_rebuild_locked(self) -> None:
+        """Kick the background rebuild (lock held). The thread builds
+        from a snapshot; at install time, if the layout moved AGAIN
+        (another resize while uploading), the stale build is discarded
+        and a fresh snapshot goes around — installing it would let
+        live-layout encodings hit an older device layout."""
+        import threading
+
+        state = self._snapshot_host_locked(copy=True)
+        self.rebuilds_async += 1
+
+        def _run() -> None:
+            try:
+                built = self._build_device(state)
+            except Exception:
+                import logging
+
+                logging.getLogger(__name__).exception(
+                    "background table rebuild failed; will retry from "
+                    "the next sync")
+                return  # sync() reaps the dead thread and re-arms resized
+            barrier = self._rebuild_barrier
+            if barrier is not None:
+                barrier.wait()
+            with self.lock:
+                t = self.table
+                if t.resized or t.id_bits != state["bits"]:
+                    self._spawn_rebuild_locked()
+                    return
+                self._install_built(built, state)
+                self._rebuild_thread = None
+
+        th = threading.Thread(target=_run, name="tpu-table-rebuild",
+                              daemon=True)
+        self._rebuild_thread = th
+        th.start()
 
     # ------------------------------------------------------------ delta sync
 
@@ -254,37 +379,27 @@ class TpuMatcher:
         ``self.lock``."""
         t = self.table
         bits = t.id_bits
+        if self._rebuild_thread is not None:
+            if self._rebuild_thread.is_alive():
+                raise RebuildInProgress
+            # crashed worker: the snapshot consumed `resized`, so re-arm
+            # it — falling through to the delta path would scatter
+            # grown-region slots out of bounds against the OLD arrays
+            # (silently dropped) and serve wrong fanout forever
+            self._rebuild_thread = None
+            t.resized = True
         if self._dev_arrays is None or t.resized or bits != self._ops_bits:
-            put = lambda a: self._jax.device_put(a, self.device)
-            self._dev_arrays = (
-                put(t.words), put(t.eff_len), put(t.has_hash),
-                put(t.first_wild), put(t.active),
-            )
-            # derived coded operands (F/t1) live device-side next to the
-            # base arrays; id_bits growth (interner crossing a byte plane)
-            # forces this full rebuild path too
-            self._operands = (
-                K.build_operands(self._dev_arrays[0], self._dev_arrays[1],
-                                 bits)
-                if bits else None
-            )
-            self._meta = (K.pack_meta(*self._dev_arrays[1:5])
-                          if self.packed_io else None)
-            self._ops_bits = bits
-            self._reg_start = t.reg_start.copy()
-            self._reg_end = (t.reg_start + t.reg_cap).copy()
-            self._glob_pad = int(t.reg_cap[0])
-            self._gb_end = t.gb_end if t.bucketed else int(t.reg_cap[0])
-            self._ng = t.NG
-            self._bucketed = t.bucketed
+            if self._dev_arrays is not None and self.async_rebuild:
+                # non-blocking growth: snapshot host state NOW (the live
+                # arrays keep mutating) and upload on a worker thread;
+                # callers shed to the host trie until the install
+                self._spawn_rebuild_locked()
+                raise RebuildInProgress
+            # clear-after-success: a failed inline build must retry
+            state = self._snapshot_host_locked(copy=False, clear=False)
+            self._install_built(self._build_device(state), state)
             t.resized = False
             t.dirty.clear()
-            # numpy object array: resolve-side fancy indexing is ~2.5x
-            # faster than per-slot list indexing (measured 120ms -> 49ms
-            # per 4096x61 batch)
-            snap = np.empty(len(t.entries), dtype=object)
-            snap[:] = t.entries
-            self._entries_snapshot = snap
             return
         if not t.dirty:
             return
@@ -430,7 +545,10 @@ class TpuMatcher:
         b = 1
         while b <= max_batch:
             topics = [("warmup", "ladder", str(i)) for i in range(b)]
-            self.match_batch(topics, _warmup=True)
+            try:
+                self.match_batch(topics, _warmup=True)
+            except RebuildInProgress:
+                return done  # table rebuilding: warm the rest on demand
             done += 1
             b *= 2
         return done
@@ -659,10 +777,18 @@ class TpuRegView:
                  packed_io: bool = True):
         self.registry = registry
         self._matchers: Dict[str, TpuMatcher] = {}
-        self._mk = lambda: TpuMatcher(max_levels, initial_capacity,
-                                      max_fanout, flat_avg=flat_avg,
-                                      use_pallas=use_pallas,
-                                      packed_io=packed_io)
+
+        def _mk() -> TpuMatcher:
+            m = TpuMatcher(max_levels, initial_capacity, max_fanout,
+                           flat_avg=flat_avg, use_pallas=use_pallas,
+                           packed_io=packed_io)
+            # production seat: growth rebuilds run in the background
+            # while the registry's trie serves (fold / _flush_async
+            # catch RebuildInProgress)
+            m.async_rebuild = True
+            return m
+
+        self._mk = _mk
 
     def matcher(self, mountpoint: str = "") -> TpuMatcher:
         """Get/create the mountpoint's matcher. Warm-load MUST run on the
@@ -702,8 +828,12 @@ class TpuRegView:
 
     def fold(self, mountpoint: str, topic: Sequence[str]) -> List[Row]:
         """Synchronous single-topic fold — drop-in replacement for the trie
-        view (a batch of one; the BatchCollector path amortises)."""
-        return self.matcher(mountpoint).match_batch([tuple(topic)])[0]
+        view (a batch of one; the BatchCollector path amortises). During
+        a background table rebuild the host trie answers instead."""
+        try:
+            return self.matcher(mountpoint).match_batch([tuple(topic)])[0]
+        except RebuildInProgress:
+            return self.registry.trie(mountpoint).match(list(topic))
 
     def fold_batch(self, mountpoint: str, topics: Sequence[Sequence[str]]):
         return self.matcher(mountpoint).match_batch(topics)
@@ -737,6 +867,7 @@ class BatchCollector:
         self.host_hybrid_pubs = 0
         self.saturated_merges = 0  # flushes deferred into a later batch
         self.overload_host_pubs = 0  # shed to the host trie at overload
+        self.rebuild_host_pubs = 0  # served by the trie during a rebuild
         self._pending: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         self._inflight = 0
@@ -775,6 +906,21 @@ class BatchCollector:
                 f.set_result(f._vmq_res)
             f._vmq_res = f._vmq_exc = None
 
+    def _settle_via_trie(self, mp: str, topic, fut,
+                         fallback_exc: Optional[BaseException] = None) -> None:
+        """Serve one publish from the host trie (the correctness oracle)
+        and settle its future; without a registry the original cause —
+        not a misleading AttributeError — reaches the caller."""
+        reg = getattr(self.view, "registry", None)
+        if reg is None:
+            self._settle(fut, exc=fallback_exc
+                         or RuntimeError("no registry for trie fallback"))
+            return
+        try:
+            self._settle(fut, res=reg.trie(mp).match(list(topic)))
+        except Exception as e:
+            self._settle(fut, exc=e)
+
     def submit(self, mountpoint: str, topic: Sequence[str]) -> asyncio.Future:
         loop = asyncio.get_event_loop()
         fut = self._enqueue_fut(loop)
@@ -786,14 +932,9 @@ class BatchCollector:
             # (the trie is the correctness oracle, so results are
             # identical); the result still RELEASES in submission order
             # via _settle, so shedding never reorders deliveries.
-            reg = getattr(self.view, "registry", None)
-            if reg is not None:
+            if getattr(self.view, "registry", None) is not None:
                 self.overload_host_pubs += 1
-                try:
-                    self._settle(fut,
-                                 res=reg.trie(mountpoint).match(list(topic)))
-                except Exception as e:
-                    self._settle(fut, exc=e)
+                self._settle_via_trie(mountpoint, topic, fut)
                 return fut
         self._pending.append((mountpoint, tuple(topic), fut))
         if len(self._pending) >= self.max_batch:
@@ -814,10 +955,7 @@ class BatchCollector:
             pending, self._pending = self._pending, []
             self.host_hybrid_pubs += len(pending)
             for mp, topic, fut in pending:
-                try:
-                    self._settle(fut, res=reg.trie(mp).match(list(topic)))
-                except Exception as e:
-                    self._settle(fut, exc=e)
+                self._settle_via_trie(mp, topic, fut)
             return
         if self._inflight >= self.MAX_INFLIGHT:
             # both slots busy: DON'T queue a third task — leave the
@@ -871,6 +1009,20 @@ class BatchCollector:
                 results = await loop.run_in_executor(
                     None, self.view.fold_batch, mp, topics
                 )
+            except RebuildInProgress as rb:
+                # the device table is re-uploading after growth: serve
+                # this batch from the host trie (identical results) so
+                # the publish pipeline keeps flowing through the
+                # rebuild. Trie reads must stay loop-side (mutation is
+                # loop-side), so chunk the batch with yields — a full
+                # 4096-pub flush of sub-ms matches must not stall every
+                # session's IO for its whole duration.
+                self.rebuild_host_pubs += len(items)
+                for i, (t_, fut) in enumerate(items):
+                    self._settle_via_trie(mp, t_, fut, fallback_exc=rb)
+                    if (i + 1) % 64 == 0:
+                        await asyncio.sleep(0)
+                continue
             except Exception as e:  # settle futures with the error
                 for _, fut in items:
                     self._settle(fut, exc=e)
