@@ -19,23 +19,27 @@ assert ds and ds[0].platform != "cpu", ds
 EOF
   then
     date > "$OUT/recovered_at"
-    echo "tunnel recovered, running packed bench" >> "$OUT/log"
+    echo "tunnel recovered" >> "$OUT/log"
+    # recovery windows can be SHORT (r3 saw one 25-min window all
+    # round): grab the single most valuable quick number first — the
+    # packed B=8192 point (one compile + 20 iters, ~3-5 min; its
+    # compile wedged last time, so it also probes server health, and
+    # it now reports the device-resident kernel-only rate too) —
+    # before committing ~25 min to the full bench ladder.
+    timeout 900 python tools/tune_windowed.py 1000000 --packed \
+      --tp 256 --b 8192 --fm 2 --fa 128 \
+      > "$OUT/tune_packed_b8192.txt" 2>&1
+    echo "tune_packed_b8192 rc=$?" >> "$OUT/log"
     timeout 2400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
     echo "bench rc=$?" >> "$OUT/log"
-    # known-good geometry first (packed_rows B=4096 has never been
-    # measured on chip); the wedge-prone big-B points go last, each in
-    # its OWN invocation so a hung compile RPC at one B (which the
-    # per-config try/except cannot catch) only costs that B's timeout.
+    timeout 900 python tools/tune_windowed.py 1000000 --packed \
+      --tp 256 --b 16384 --fm 2 --fa 128 \
+      > "$OUT/tune_packed_b16384.txt" 2>&1
+    echo "tune_packed_b16384 rc=$?" >> "$OUT/log"
     timeout 900 python tools/tune_windowed.py 1000000 --packed-rows \
       --tp 256 --b 4096 --fm 2 --fa 128 \
       > "$OUT/tune_packed_rows.txt" 2>&1
     echo "tune_packed_rows rc=$?" >> "$OUT/log"
-    for B in 8192 16384; do
-      timeout 900 python tools/tune_windowed.py 1000000 --packed \
-        --tp 256 --b "$B" --fm 2 --fa 128 \
-        > "$OUT/tune_packed_b$B.txt" 2>&1
-      echo "tune_packed_b$B rc=$?" >> "$OUT/log"
-    done
     # result bytes scale with flat_avg (Bpad*(fa+3) words/batch): a
     # tighter fa is the cheapest download cut IF overflow stays ~0
     timeout 900 python tools/tune_windowed.py 1000000 --packed \
