@@ -161,6 +161,57 @@ async def test_garbage_before_connect_and_half_connects():
 
 
 @pytest.mark.asyncio
+async def test_cluster_listener_survives_garbage():
+    """The inter-node channel is an attack/misconfig surface too: raw
+    garbage and a truncated handshake on the cluster port must cost
+    only that socket, with MQTT service and a later legitimate join
+    unaffected (the framed codec's reject path, cluster/com.py)."""
+    from vernemq_tpu.cluster import Cluster
+
+    def _name(broker, name):
+        broker.node_name = name
+        broker.metadata.node_name = name
+        broker.registry.node_name = name
+        broker.registry.db.node_name = name
+
+    b, server = await boot()
+    _name(b, "robust1")
+    cluster = Cluster(b, "127.0.0.1", 0)
+    await cluster.start()
+    try:
+        for blob in (b"\xff" * 64, os.urandom(512),
+                     b"GET / HTTP/1.1\r\n\r\n"):
+            r, w = await asyncio.open_connection("127.0.0.1",
+                                                 cluster.listen_port)
+            w.write(blob)
+            await w.drain()
+            w.close()
+        await asyncio.sleep(0.2)
+        await control_roundtrip(server, b"after-cluster-garbage")
+        # the channel still accepts a real peer afterwards
+        b2, server2 = await boot()
+        _name(b2, "robust2")
+        c2 = Cluster(b2, "127.0.0.1", 0)
+        await c2.start()
+        try:
+            c2.join("127.0.0.1", cluster.listen_port)
+            for _ in range(100):
+                if len(cluster.members()) == 2 and len(c2.members()) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(cluster.members()) == 2
+            assert len(c2.members()) == 2
+        finally:
+            await c2.stop()
+            await b2.stop()
+            await server2.stop()
+    finally:
+        await cluster.stop()
+        await b.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_unsolicited_acks_are_harmless():
     """PUBACK/PUBREC/PUBCOMP for unknown ids are ignored; PUBREL gets
     PUBCOMP 0x92 (packet id not found) — and the session stays up."""

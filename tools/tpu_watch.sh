@@ -2,11 +2,14 @@
 # Probe the axon tunnel every 5 min; when it answers, fire the r4 packed
 # bench + sweeps once, recording everything under /tmp/tpu_watch/.
 #
-# Order matters: the full bench (with the device-resident kernel-only
-# probe) first — it is the headline artifact — then the packed batch-size
-# ladder. The Pallas sweep is deliberately ABSENT: its Mosaic
-# remote-compile crashed the compile server twice (HTTP 500) and wedged
-# the tunnel for the rest of the session; do not auto-fire it.
+# Order: quick packed B=8192 point (most valuable single number + a
+# compile-server health probe), then the full bench (the headline
+# artifact, with the kernel-only probe), then the never-yet-measured
+# packed_rows point BEFORE the remaining wedge-prone big-B/fa points —
+# a hung compile at one of those must not cost the unmeasured data.
+# The Pallas sweep is deliberately ABSENT: its Mosaic remote-compile
+# crashed the compile server twice (HTTP 500) and wedged the tunnel for
+# the rest of the session; do not auto-fire it.
 set -u
 OUT=/tmp/tpu_watch
 mkdir -p "$OUT"
@@ -32,14 +35,14 @@ EOF
     echo "tune_packed_b8192 rc=$?" >> "$OUT/log"
     timeout 2400 python bench.py > "$OUT/bench.json" 2> "$OUT/bench.err"
     echo "bench rc=$?" >> "$OUT/log"
-    timeout 900 python tools/tune_windowed.py 1000000 --packed \
-      --tp 256 --b 16384 --fm 2 --fa 128 \
-      > "$OUT/tune_packed_b16384.txt" 2>&1
-    echo "tune_packed_b16384 rc=$?" >> "$OUT/log"
     timeout 900 python tools/tune_windowed.py 1000000 --packed-rows \
       --tp 256 --b 4096 --fm 2 --fa 128 \
       > "$OUT/tune_packed_rows.txt" 2>&1
     echo "tune_packed_rows rc=$?" >> "$OUT/log"
+    timeout 900 python tools/tune_windowed.py 1000000 --packed \
+      --tp 256 --b 16384 --fm 2 --fa 128 \
+      > "$OUT/tune_packed_b16384.txt" 2>&1
+    echo "tune_packed_b16384 rc=$?" >> "$OUT/log"
     # result bytes scale with flat_avg (Bpad*(fa+3) words/batch): a
     # tighter fa is the cheapest download cut IF overflow stays ~0
     timeout 900 python tools/tune_windowed.py 1000000 --packed \
