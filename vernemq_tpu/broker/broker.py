@@ -598,6 +598,12 @@ class Broker:
         for s in list(self.sessions.values()):
             await s.close("broker_shutdown", send_will=False)
         await self.plugins.stop_all()
+        if self.cluster is not None:
+            # the inter-node channel goes down after sessions/plugins
+            # (migration + lifecycle hooks may still need it) and before
+            # listeners; idempotent when the cluster was started as a
+            # `vmq` listener (stop_all covers that handle too)
+            await self.cluster.stop()
         if self.listeners is not None:
             await self.listeners.stop_all()
         for server in self._servers:
