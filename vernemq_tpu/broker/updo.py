@@ -41,6 +41,12 @@ cells) land in ``failed`` with the old code left active — mirroring
 the release handler refusing a bad instruction rather than
 half-applying it.  Native extensions (the ``.so`` codec/kvstore) need
 a restart, like NIFs.
+
+Scope note: like the reference's ``vmq-admin`` (which acts on the node
+it talks to), an upgrade applies to the PROCESS serving the command.
+In multi-process workers mode (broker/workers.py) run ``updo run``
+against each worker's admin endpoint — or restart workers one at a
+time, which the supervisor already handles.
 """
 
 from __future__ import annotations
