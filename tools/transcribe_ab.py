@@ -1,12 +1,12 @@
 """Turn /tmp/tpu_watch outputs (bench.json + tune_*.txt sweeps) into the
 README's on-chip A/B markdown table.
 
-The recovery watch (`tools/tpu_watch.sh`) runs `bench.py` and three
-`tune_windowed.py` sweeps (XLA scatter-flat, XLA gather-rows `--rows`,
-Pallas `--pallas`) the moment the accelerator tunnel answers. This
-script parses those artifacts and prints the markdown block to paste
-into README "Benchmarks" (VERDICT r3 item 1's A/B table), plus the
-headline comparison against the best verified prior number.
+The recovery watch (`tools/tpu_watch.sh`) runs the packed-transport
+sweeps (B=8192/16384, fa=96, packed_rows) and `bench.py` the moment
+the accelerator tunnel answers. This script parses those artifacts and
+prints the markdown block to paste into README "Benchmarks", including
+the device-resident KERNEL-ONLY rate per geometry and the headline
+comparison against the best verified prior number.
 
   python tools/transcribe_ab.py [--dir /tmp/tpu_watch]
 """
@@ -20,6 +20,9 @@ ROW = re.compile(
     r"TP=(?P<tp>\d+) FM=(?P<fm>\d+) B=(?P<b>\d+) FA=(?P<fa>\d+) "
     r"V=(?P<v>\S+): (?P<mps>[\d.]+)M matches/s "
     r"(?P<pps>[\d.]+)k pubs/s batch=(?P<batch>[\d.]+)ms")
+KROW = re.compile(
+    r"V=\S+ KERNEL-ONLY: (?P<kmps>[\d.]+)M matches/s "
+    r"batch=(?P<kbatch>[\d.]+)ms")
 BEST = re.compile(r"BEST: (?P<tag>.+?) (?P<mps>[\d.]+)M matches/s")
 
 
@@ -31,6 +34,10 @@ def parse_sweep(path):
         m = ROW.search(line)
         if m:
             rows.append(m.groupdict())
+            continue
+        k = KROW.search(line)
+        if k and rows:
+            rows[-1].update(k.groupdict())  # attach to its geometry row
         b = BEST.search(line)
         if b:
             best = b.groupdict()
@@ -45,12 +52,14 @@ def main():
     args = ap.parse_args()
 
     sweeps = {
-        "XLA scatter-flat (production)": parse_sweep(
-            os.path.join(args.dir, "tune_flat.txt")),
-        "XLA gather-rows (--rows)": parse_sweep(
-            os.path.join(args.dir, "tune_rows.txt")),
-        "Pallas fused tiles (--pallas)": parse_sweep(
-            os.path.join(args.dir, "tune_pallas.txt")),
+        "packed B=8192": parse_sweep(
+            os.path.join(args.dir, "tune_packed_b8192.txt")),
+        "packed B=16384": parse_sweep(
+            os.path.join(args.dir, "tune_packed_b16384.txt")),
+        "packed B=8192 fa=96": parse_sweep(
+            os.path.join(args.dir, "tune_packed_fa96.txt")),
+        "packed_rows B=4096": parse_sweep(
+            os.path.join(args.dir, "tune_packed_rows.txt")),
     }
     bench_path = os.path.join(args.dir, "bench.json")
     bench = None
@@ -64,18 +73,21 @@ def main():
             pass
 
     print("### On-chip kernel A/B (1M subs, tools/tune_windowed.py)\n")
-    print("| variant | best config | matches/s | batch ms |")
-    print("|---|---|---|---|")
+    print("| variant | best config | matches/s | batch ms "
+          "| kernel-only matches/s | kernel-only batch ms |")
+    print("|---|---|---|---|---|---|")
     any_rows = False
     for name, sweep in sweeps.items():
         if not sweep or not sweep["rows"]:
-            print(f"| {name} | (sweep missing/failed) | — | — |")
+            print(f"| {name} | (sweep missing/failed) | — | — | — | — |")
             continue
         any_rows = True
         top = max(sweep["rows"], key=lambda r: float(r["mps"]))
+        km = (f"{float(top['kmps']):.2f}M" if "kmps" in top else "—")
+        kb = (f"{float(top['kbatch']):.1f}" if "kbatch" in top else "—")
         print(f"| {name} | TP={top['tp']} B={top['b']} FM={top['fm']} "
               f"FA={top['fa']} | {float(top['mps']):.2f}M | "
-              f"{float(top['batch']):.1f} |")
+              f"{float(top['batch']):.1f} | {km} | {kb} |")
     print()
     if bench is not None:
         v = bench.get("value", 0)
@@ -85,6 +97,12 @@ def main():
               f"{bench.get('platform_fallback')}) — "
               f"{v / (args.prior * 1e6):.2f}x the best verified prior "
               f"({args.prior}M, r2).")
+        if "kernel_matches_per_sec" in bench:
+            k = bench["kernel_matches_per_sec"]
+            print(f"device-resident kernel rate: **{k:,} matches/s** "
+                  f"(vs_baseline_kernel="
+                  f"{bench.get('vs_baseline_kernel')}) — the chip's own "
+                  f"ceiling with zero per-batch transport.")
     if not any_rows and bench is None:
         print("No artifacts found — has the recovery watch fired? "
               f"(dir: {args.dir})", file=sys.stderr)
