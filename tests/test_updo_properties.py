@@ -1,0 +1,113 @@
+"""Property test for the hot-upgrade graft (broker/updo.py).
+
+For random (v1, v2) module pairs drawn from the graftable subset
+(top-level functions, classes with plain methods, immutable constants,
+mutable registries; names added, removed, retyped between versions),
+after ``updo.run()`` the LIVE module must be behaviourally identical to
+a fresh exec of v2 — while same-kind survivors keep object identity
+(the property that makes live references pick up new code).
+"""
+
+import random
+import sys
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from vernemq_tpu.broker import updo
+
+PKG = "updo_prop_mod"
+
+NAMES = ["alpha", "beta", "gamma", "delta"]
+KINDS = ["func", "cls", "const", "reg", "absent"]
+
+
+def render(spec: dict) -> str:
+    lines = ["REG_SENTINEL = {}"]
+    for name, (kind, val) in spec.items():
+        if kind == "func":
+            lines.append(f"def {name}():\n    return {val!r}")
+        elif kind == "cls":
+            lines.append(
+                f"class {name}:\n"
+                f"    TAG = {val!r}\n"
+                f"    def get(self):\n        return {val!r}")
+        elif kind == "const":
+            lines.append(f"{name} = {val!r}")
+        elif kind == "reg":
+            lines.append(f"{name} = {{'init': {val!r}}}")
+    return "\n".join(lines) + "\n"
+
+
+spec_strategy = st.fixed_dictionaries({
+    n: st.tuples(st.sampled_from(KINDS), st.integers(0, 9))
+    for n in NAMES
+})
+
+
+@settings(max_examples=40, deadline=None)
+@given(v1=spec_strategy, v2=spec_strategy)
+def test_graft_matches_fresh_exec(tmp_path_factory, v1, v2):
+    tmp = tmp_path_factory.mktemp("updo_prop")
+    src = tmp / f"{PKG}.py"
+    src.write_text(render(v1))
+    sys.path.insert(0, str(tmp))
+    old_prefixes = updo.PREFIXES
+    updo.PREFIXES = updo.PREFIXES + (PKG,)
+    try:
+        sys.modules.pop(PKG, None)
+        mod = __import__(PKG)
+        updo.baseline()
+        held = {}   # same-kind survivors must keep identity
+        held_v1 = {}  # every v1 func/cls: removed ones must keep v1 code
+        for n, (kind, val) in v1.items():
+            if kind in ("func", "cls"):
+                held_v1[n] = (kind, val, getattr(mod, n))
+                if v2.get(n, ("absent",))[0] == kind:
+                    held[n] = getattr(mod, n)
+
+        src.write_text(render(v2))
+        rep = updo.run()
+        assert not rep["failed"], rep["failed"]
+
+        # oracle: a fresh, independent exec of v2
+        oracle: dict = {"__name__": "oracle"}
+        exec(compile(render(v2), "<oracle>", "exec"), oracle)
+
+        for n, (kind, val) in v2.items():
+            if kind == "absent":
+                assert not hasattr(mod, n)
+                continue
+            live = getattr(mod, n)
+            if kind == "func":
+                assert live() == oracle[n]()
+                if n in held:
+                    assert live is held[n]
+            elif kind == "cls":
+                assert live().get() == oracle[n]().get()
+                assert live.TAG == oracle[n].TAG
+                if n in held:
+                    assert live is held[n]
+                    assert isinstance(held[n](), live)
+            elif kind == "const":
+                assert live == oracle[n]
+            elif kind == "reg":
+                if v1.get(n, ("absent",))[0] == "reg":
+                    # live mutable state preserved from v1
+                    assert live == {"init": v1[n][1]}
+                else:
+                    assert live == oracle[n]
+        # held references to names REMOVED in v2 keep running V1 code
+        for n, (kind, val, obj) in held_v1.items():
+            if v2.get(n, ("absent",))[0] != "absent":
+                continue
+            if kind == "func":
+                assert obj() == val
+            else:
+                assert obj().get() == val and obj.TAG == val
+    finally:
+        sys.modules.pop(PKG, None)
+        updo._loaded_digests.pop(PKG, None)
+        updo.PREFIXES = old_prefixes
+        sys.path.remove(str(tmp))
