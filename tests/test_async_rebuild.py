@@ -175,6 +175,55 @@ def test_deltas_after_install_apply():
 
 
 @pytest.mark.asyncio
+async def test_busy_matcher_lock_sheds_within_bound():
+    """A long matcher-lock hold (first-compile of a new shape, slow
+    backend batch) must not head-block the pipeline: past
+    tpu_lock_busy_shed_ms the flush serves from the trie."""
+    import time
+
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    b, server = await start_broker(
+        Config(systree_enabled=False, allow_anonymous=True,
+               default_reg_view="tpu", tpu_host_batch_threshold=0,
+               tpu_lock_busy_shed_ms=150), port=0)
+    try:
+        sub = MQTTClient(server.host, server.port, client_id="bz-sub")
+        await sub.connect()
+        await sub.subscribe("bz/t", qos=0)
+        pub = MQTTClient(server.host, server.port, client_id="bz-pub")
+        await pub.connect()
+        await pub.publish("bz/t", b"warm", qos=0)
+        assert (await asyncio.wait_for(sub.messages.get(), 10)).payload \
+            == b"warm"
+        matcher = b.registry.reg_view("tpu").matcher("")
+        matcher.lock.acquire()  # simulate a multi-second hold
+        try:
+            t0 = time.perf_counter()
+            for i in range(3):
+                await pub.publish("bz/t", b"b%d" % i, qos=0)
+                m = await asyncio.wait_for(sub.messages.get(), 10)
+                assert m.payload == b"b%d" % i
+            elapsed = time.perf_counter() - t0
+            # 3 deliveries, each bounded ~150ms + trie time, not the hold
+            assert elapsed < 5.0, elapsed
+            assert b.batch_collector().busy_host_pubs >= 3
+            assert matcher.busy_sheds >= 1
+        finally:
+            matcher.lock.release()
+        await pub.publish("bz/t", b"freed", qos=0)
+        assert (await asyncio.wait_for(sub.messages.get(), 10)).payload \
+            == b"freed"
+        await pub.disconnect()
+        await sub.disconnect()
+    finally:
+        await b.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_broker_keeps_delivering_through_rebuild():
     """Broker-level: with default_reg_view=tpu, publishes keep being
     delivered while the device table rebuilds (collector sheds to the

@@ -25,7 +25,7 @@ class _SlowView:
     def matcher(self, mp):
         return None
 
-    def fold_batch(self, mp, topics):
+    def fold_batch(self, mp, topics, lock_timeout=None):
         self.active += 1
         self.max_active = max(self.max_active, self.active)
         time.sleep(self.device_ms / 1000.0)
@@ -77,7 +77,7 @@ async def test_collector_back_to_back_dispatch():
 @pytest.mark.asyncio
 async def test_collector_device_error_resolves_futures():
     class _Boom(_SlowView):
-        def fold_batch(self, mp, topics):
+        def fold_batch(self, mp, topics, lock_timeout=None):
             raise RuntimeError("device on fire")
 
     col = BatchCollector(_Boom(), window_us=100, max_batch=8,
@@ -185,7 +185,10 @@ async def test_per_publisher_order_preserved_under_slow_device():
     b, s = await start_broker(
         Config(systree_enabled=False, allow_anonymous=True,
                default_reg_view="tpu", sysmon_enabled=False,
-               tpu_batch_window_us=2000, tpu_host_batch_threshold=2),
+               tpu_batch_window_us=2000, tpu_host_batch_threshold=2,
+               # the point of this test is racing REAL device batches in
+               # both slots; the busy/cold-shape shed would divert them
+               tpu_lock_busy_shed_ms=0),
         port=0)
     try:
         view = b.registry.reg_view("tpu")
@@ -194,7 +197,8 @@ async def test_per_publisher_order_preserved_under_slow_device():
         orig = m.match_batch
         calls = []
 
-        def slow_match(topics, _warmup=False):
+        def slow_match(topics, _warmup=False, lock_timeout=None,
+                       require_warm=False):
             if not _warmup:
                 calls.append(len(topics))
                 # VARIABLE latency: odd-numbered batches are much slower

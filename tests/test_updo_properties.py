@@ -8,10 +8,8 @@ a fresh exec of v2 — while same-kind survivors keep object identity
 (the property that makes live references pick up new code).
 """
 
-import random
 import sys
 
-import pytest
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

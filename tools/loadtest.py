@@ -199,7 +199,9 @@ async def _main_inproc(args) -> None:
         print(f"collector: host_hybrid_pubs={col.host_hybrid_pubs} "
               f"device_batches={mb} device_pubs={mp_} "
               f"merges={col.saturated_merges} "
-              f"shed={col.overload_host_pubs}", flush=True)
+              f"shed={col.overload_host_pubs} "
+              f"busy_shed={col.busy_host_pubs} "
+              f"rebuild_shed={col.rebuild_host_pubs}", flush=True)
     await b.stop()
     await server.stop()
     _report(args.view, args.qos, sent, failed, received, elapsed, lat,

@@ -401,6 +401,7 @@ class Broker:
                 self.registry.reg_view("tpu"),
                 window_us=self.config.tpu_batch_window_us,
                 host_threshold=self.config.tpu_host_batch_threshold,
+                lock_busy_shed_ms=self.config.tpu_lock_busy_shed_ms,
             )
         return self._collector
 

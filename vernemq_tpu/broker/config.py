@@ -88,6 +88,9 @@ DEFAULTS: Dict[str, Any] = {
     # flushes this small are matched on the host trie instead of paying a
     # device round trip (hybrid dispatch, SURVEY.md §7.2); 0 disables
     "tpu_host_batch_threshold": 8,
+    # device flush waits at most this long for the matcher lock before
+    # the whole flush serves from the host trie (0 = unbounded wait)
+    "tpu_lock_busy_shed_ms": 500,
     # systree / metrics
     "systree_enabled": True,
     "systree_interval": 20,

@@ -901,6 +901,8 @@ class Registry:
             out["tpu_saturated_merges"] = col.saturated_merges
             # pubs the trie served while the device table rebuilt
             out["tpu_rebuild_shed_pubs"] = col.rebuild_host_pubs
+            # pubs the trie served past the matcher-lock busy bound
+            out["tpu_busy_shed_pubs"] = col.busy_host_pubs
         return out
 
     def fold_subscriptions(self, mountpoint: str = ""):

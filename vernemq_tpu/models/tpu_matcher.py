@@ -188,6 +188,21 @@ def prepare_windows(pw: np.ndarray, pl: np.ndarray, pd: np.ndarray,
     return t_pw, t_pl, t_pd, t_start, tile_of, pos_of, leftovers
 
 
+class MatcherBusy(Exception):
+    """The matcher can't take this batch promptly.
+
+    Raised by ``match_batch`` when the lock did not free within the
+    caller's bound (``cold=False``) or when the batch's compile
+    signature has never executed (``cold=True`` — a first XLA compile
+    takes tens of seconds): the collector serves the flush from the
+    host trie instead, bounding worst-case publish latency at roughly
+    the bound, and kicks ``ensure_warm`` only for the cold case."""
+
+    def __init__(self, cold: bool = False):
+        super().__init__("cold signature" if cold else "lock busy")
+        self.cold = cold
+
+
 class RebuildInProgress(Exception):
     """The device table is re-uploading after a capacity change.
 
@@ -269,6 +284,16 @@ class TpuMatcher:
         self._rebuild_thread: Optional[threading.Thread] = None
         self._rebuild_barrier: Optional[threading.Event] = None  # tests
         self.rebuilds_async = 0
+        self.busy_sheds = 0  # match_batch lock-timeout / cold-shape sheds
+        # compile-signature warmth: a (arg-shapes, statics) signature is
+        # warm once one execution completed. require_warm callers (the
+        # collector) never dispatch live traffic into a COLD signature —
+        # a first XLA compile takes tens of seconds and would head-block
+        # the release queue for its whole duration; the trie serves while
+        # ensure_warm compiles the shape in the background.
+        self._warm_sigs: set = set()
+        self._warming: set = set()
+        self.warm_failures = 0  # background shape compiles that died
 
     # ------------------------------------------------------- full (re)build
 
@@ -319,8 +344,44 @@ class TpuMatcher:
         meta = K.pack_meta(*dev[1:5]) if self.packed_io else None
         return dev, operands, meta
 
+    def ensure_warm(self, n: int) -> None:
+        """Compile the pow2-padded batch shape for ``n`` publishes on a
+        background thread (idempotent per shape). The collector calls
+        this when a cold signature sheds, so the next flush of this size
+        finds the executable ready."""
+        import threading
+
+        Bpad = self._pad_batch(n)
+        if Bpad in self._warming:
+            return
+        self._warming.add(Bpad)
+
+        def _w() -> None:
+            try:
+                topics = [("warmup", "ladder", str(i)) for i in range(Bpad)]
+                self.match_batch(topics, _warmup=True)
+            except RebuildInProgress:
+                pass  # table rebuilding — retried on the next shed
+            except Exception:
+                # a shape that cannot compile pins its traffic on the
+                # trie forever; that must be diagnosable, not silent
+                self.warm_failures += 1
+                import logging
+
+                logging.getLogger("vernemq_tpu.matcher").exception(
+                    "background warm-up of batch shape %d failed "
+                    "(traffic of this size keeps serving via the host "
+                    "trie; will retry on the next cold shed)", Bpad)
+            finally:
+                self._warming.discard(Bpad)
+
+        threading.Thread(target=_w, name=f"tpu-warm-{Bpad}",
+                         daemon=True).start()
+
     def _install_built(self, built: tuple, state: dict) -> None:
         """Publish a finished build as the serving state (lock held)."""
+        # new table geometry → every compiled signature is stale
+        self._warm_sigs.clear()
         self._dev_arrays, self._operands, self._meta = built
         self._ops_bits = state["bits"]
         self._reg_start = state["reg_start"]
@@ -554,12 +615,24 @@ class TpuMatcher:
         return done
 
     def match_batch(self, topics: Sequence[Sequence[str]],
-                    _warmup: bool = False) -> List[List[Row]]:
+                    _warmup: bool = False,
+                    lock_timeout: Optional[float] = None,
+                    require_warm: bool = False) -> List[List[Row]]:
         """Match a batch of publish topics; returns per-topic entry rows
-        (the per-publish fold results)."""
+        (the per-publish fold results). ``lock_timeout`` bounds the wait
+        for the matcher lock (seconds): past it, MatcherBusy — the
+        caller serves the batch host-side instead of head-blocking
+        behind a long hold. ``require_warm`` additionally refuses a COLD
+        compile signature (MatcherBusy) so a first-compile can never
+        stall live traffic; ``ensure_warm`` compiles it off to the side."""
         if not topics:
             return []
-        with self.lock:
+        if lock_timeout is None:
+            self.lock.acquire()
+        elif not self.lock.acquire(timeout=lock_timeout):
+            self.busy_sheds += 1
+            raise MatcherBusy(cold=False)
+        try:
             self.sync()
             dev_arrays = self._dev_arrays
             operands = self._operands
@@ -573,6 +646,8 @@ class TpuMatcher:
             else:
                 pw, pl, pd = self.encode_batch(topics)
             self._inflight += 1  # sync() must not donate our buffers away
+        finally:
+            self.lock.release()
         if _warmup:
             self.warmup_batches += 1
             self.warmup_publishes += len(topics)
@@ -583,7 +658,8 @@ class TpuMatcher:
             if bucketed:
                 idx_rows, need_host = self._match_windowed(
                     dev_arrays, operands, meta, reg_start, reg_end,
-                    glob_pad, bits, pw, pl, pd, pb, gb, len(topics))
+                    glob_pad, bits, pw, pl, pd, pb, gb, len(topics),
+                    require_warm=require_warm)
             else:
                 chunk = 1024 if pw.shape[0] > 1024 else 0  # lax.map serialises
                 # full-scan fallback: MXU matmul path needs byte-splittable
@@ -594,6 +670,11 @@ class TpuMatcher:
                 fast = (len(self.table.interner)
                         < (1 << 24) - K.FIRST_WORD_ID - 1
                         and S % 2048 == 0 and S >= 2048)
+                sig = ("simple", pw.shape, int(S), fast, chunk,
+                       self.max_fanout)
+                if require_warm and sig not in self._warm_sigs:
+                    self.busy_sheds += 1
+                    raise MatcherBusy(cold=True)
                 matcher = K.match_extract_mxu if fast else K.match_extract
                 idx, valid, count = matcher(
                     *dev_arrays, pw, pl, pd, k=self.max_fanout, chunk=chunk
@@ -603,6 +684,7 @@ class TpuMatcher:
                 counts = np.asarray(count)
                 idx_rows = [idx[i][valid[i]] for i in range(len(topics))]
                 need_host = counts[:len(topics)] > self.max_fanout
+                self._warm_sigs.add(sig)
         finally:
             with self.lock:
                 self._inflight -= 1
@@ -688,7 +770,8 @@ class TpuMatcher:
         return args, statics, set(leftovers) | set(left2)
 
     def _match_windowed(self, dev_arrays, operands, meta, reg_start,
-                        reg_end, glob_pad, bits, pw, pl, pd, pb, gb, n):
+                        reg_end, glob_pad, bits, pw, pl, pd, pb, gb, n,
+                        require_warm: bool = False):
         """Run the windowed device path (the production kernel, flat
         variant): a dense pass over region 0 plus probe-A (level-0
         bucket) and probe-B (level-1 g-bucket) window tiles, compacted
@@ -704,6 +787,16 @@ class TpuMatcher:
         args, statics, left = self._flat_prep(
             reg_start, reg_end, glob_pad, bits, S, pw, pl, pd, pb, gb, n,
             align=2048 if pallas else 0)
+        # the full compile signature of this dispatch: arg shapes +
+        # static kwargs (+ S via statics / shapes). Window geometry
+        # depends on table CONTENT (amax), so a delta can mint new
+        # signatures — the warm gate must see exactly what jit sees.
+        sig = (tuple(a.shape for a in args),
+               tuple(sorted(statics.items())), pallas,
+               bool(self.packed_io and meta is not None))
+        if require_warm and sig not in self._warm_sigs:
+            self.busy_sheds += 1
+            raise MatcherBusy(cold=True)
         F_t, t1 = operands
         if pallas:
             table_args = (F_t, t1, dev_arrays[1], dev_arrays[2],
@@ -720,6 +813,11 @@ class TpuMatcher:
                     "pallas tile matcher failed to lower; falling back to "
                     "the XLA windowed kernel permanently")
                 self._pallas_broken = True
+                # this one-off executable runs with the 2048-aligned
+                # (pallas-path) arg shapes; future dispatches compute
+                # pallas=False/align=0 and will never hit this signature
+                # again — recording it as warm would be a lie
+                sig = None
                 flat, pre, total, overflow = K.match_extract_windowed_flat(
                     *table_args, *args, **statics)
         elif self.packed_io and meta is not None:
@@ -734,6 +832,8 @@ class TpuMatcher:
             for i in left:
                 need_host[i] = True
             idx_rows = [flat[pre[i]:pre[i] + total[i]] for i in range(n)]
+            if sig is not None:
+                self._warm_sigs.add(sig)
             return idx_rows, need_host
         else:
             table_args = (F_t, t1, dev_arrays[1], dev_arrays[2],
@@ -748,6 +848,8 @@ class TpuMatcher:
             need_host[i] = True
         # per-pub results are VIEWS into flat — no per-pub copies
         idx_rows = [flat[pre[i]:pre[i] + total[i]] for i in range(n)]
+        if sig is not None:
+            self._warm_sigs.add(sig)
         return idx_rows, need_host
 
     def _host_match(self, topic: Sequence[str], snapshot=None) -> List[Row]:
@@ -835,8 +937,11 @@ class TpuRegView:
         except RebuildInProgress:
             return self.registry.trie(mountpoint).match(list(topic))
 
-    def fold_batch(self, mountpoint: str, topics: Sequence[Sequence[str]]):
-        return self.matcher(mountpoint).match_batch(topics)
+    def fold_batch(self, mountpoint: str, topics: Sequence[Sequence[str]],
+                   lock_timeout: Optional[float] = None):
+        return self.matcher(mountpoint).match_batch(
+            topics, lock_timeout=lock_timeout,
+            require_warm=lock_timeout is not None)
 
 
 class BatchCollector:
@@ -853,10 +958,16 @@ class BatchCollector:
     MAX_INFLIGHT = 2
 
     def __init__(self, view: TpuRegView, window_us: int = 200,
-                 max_batch: int = 4096, host_threshold: int = 8):
+                 max_batch: int = 4096, host_threshold: int = 8,
+                 lock_busy_shed_ms: int = 500):
         self.view = view
         self.window = window_us / 1e6
         self.max_batch = max_batch
+        # bounded head-of-line blocking: a device flush waits at most
+        # this long for the matcher lock (a first-compile of a new batch
+        # shape can hold it for tens of seconds) before the whole flush
+        # serves from the host trie. 0 disables (unbounded wait).
+        self.lock_busy_shed_ms = lock_busy_shed_ms
         # hybrid dispatch (SURVEY.md §7.2): a flush this small is served
         # by the host trie ON the event loop — sub-ms exact match, no
         # device round trip, no executor hop. The trie is maintained from
@@ -868,6 +979,7 @@ class BatchCollector:
         self.saturated_merges = 0  # flushes deferred into a later batch
         self.overload_host_pubs = 0  # shed to the host trie at overload
         self.rebuild_host_pubs = 0  # served by the trie during a rebuild
+        self.busy_host_pubs = 0  # served by the trie past the lock bound
         self._pending: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         self._inflight = 0
@@ -1005,19 +1117,36 @@ class BatchCollector:
         for mp, items in by_mp.items():
             topics = [t for t, _ in items]
             self.view.matcher(mp)  # warm-load on the loop thread (see matcher())
+            lock_to = (self.lock_busy_shed_ms / 1e3
+                       if self.lock_busy_shed_ms else None)
             try:
                 results = await loop.run_in_executor(
-                    None, self.view.fold_batch, mp, topics
+                    None, self.view.fold_batch, mp, topics, lock_to
                 )
-            except RebuildInProgress as rb:
-                # the device table is re-uploading after growth: serve
-                # this batch from the host trie (identical results) so
-                # the publish pipeline keeps flowing through the
-                # rebuild. Trie reads must stay loop-side (mutation is
-                # loop-side), so chunk the batch with yields — a full
-                # 4096-pub flush of sub-ms matches must not stall every
-                # session's IO for its whole duration.
-                self.rebuild_host_pubs += len(items)
+            except (RebuildInProgress, MatcherBusy) as rb:
+                # the device can't take this batch promptly — table
+                # re-uploading after growth, or the matcher lock held
+                # past the busy bound (first-compile of a new shape) —
+                # so serve it from the host trie (identical results):
+                # the publish pipeline keeps flowing and worst-case
+                # latency stays ~the bound, not the hold. Trie reads
+                # must stay loop-side (mutation is loop-side), so chunk
+                # the batch with yields — a full 4096-pub flush of
+                # sub-ms matches must not stall every session's IO for
+                # its whole duration.
+                if isinstance(rb, MatcherBusy):
+                    self.busy_host_pubs += len(items)
+                    if rb.cold:
+                        # compile this batch shape off to the side so
+                        # the next flush of this size serves on-device
+                        # (lock-timeout sheds skip this: their shape is
+                        # typically warm already — a redundant warm
+                        # would steal device time while congested)
+                        m = self.view.matcher(mp)
+                        if m is not None and hasattr(m, "ensure_warm"):
+                            m.ensure_warm(len(items))
+                else:
+                    self.rebuild_host_pubs += len(items)
                 for i, (t_, fut) in enumerate(items):
                     self._settle_via_trie(mp, t_, fut, fallback_exc=rb)
                     if (i + 1) % 64 == 0:
