@@ -377,6 +377,80 @@ async def test_v5_retry_keeps_bare_plan():
 
 
 @pytest.mark.asyncio
+async def test_v5_packet_cap_property_random_cases():
+    """Randomized conformance sweep of the outbound cap planner: for
+    random (cap, topic length, alias budget, payload sizes), EVERY
+    frame the broker emits fits the subscriber's maximum_packet_size,
+    and every message whose bare frame fits IS delivered (lossless)."""
+    import random as _r
+
+    from vernemq_tpu.protocol import codec_v5
+    from vernemq_tpu.protocol.types import Connect, Publish, Subscribe, SubOpts
+
+    rng = _r.Random(77)
+    b, server = await boot()
+    pub = await connected(server, "prop-pub")
+    for case in range(8):
+        cap = rng.randrange(48, 160)
+        tlen = rng.randrange(3, 30)
+        alias_max = rng.choice([0, 0, 3])
+        topic = "p/" + "t" * tlen + str(case)
+        c = RawV5(server.host, server.port)
+        c.r, c.w = await asyncio.open_connection(server.host, server.port)
+        props = {"maximum_packet_size": cap}
+        if alias_max:
+            props["topic_alias_maximum"] = alias_max
+        c.w.write(codec_v5.serialise(Connect(
+            proto_ver=5, client_id=f"prop{case}", clean_start=True,
+            keepalive=60, properties=props)))
+        await c.w.drain()
+        await c.recv()  # CONNACK
+        await c.send(Subscribe(packet_id=1,
+                               topics=[(topic, SubOpts(qos=0))],
+                               properties={}))
+        await c.recv()  # SUBACK
+        sizes = [rng.randrange(0, cap + 40) for _ in range(10)]
+        expect = []
+        alias_up = False  # oracle mirrors the broker's alias state
+        for i, n in enumerate(sizes):
+            payload = bytes([65 + (i % 26)]) * n
+
+            def L(t, props):
+                return len(codec_v5.serialise(Publish(
+                    topic=t, payload=payload, qos=0, properties=props)))
+
+            bare = L(topic, {})
+            if not alias_max:
+                deliver = bare <= cap
+            elif alias_up:
+                # established alias compresses the frame: deliverable
+                # whenever the aliased form fits
+                deliver = L("", {"topic_alias": 1}) <= cap
+            elif L(topic, {"topic_alias": 1}) <= cap:
+                deliver = True   # alias-establishing frame fits
+                alias_up = True
+            else:
+                deliver = bare <= cap  # bare plan, no establishment
+            if deliver:
+                expect.append(payload)
+            await pub.publish(topic, payload, qos=0)
+        await pub.publish(topic, b"~FIN~", qos=0)
+        got = []
+        while True:
+            f = await c.recv(timeout=5)
+            assert len(codec_v5.serialise(f)) <= cap, (case, cap)
+            if f.payload == b"~FIN~":
+                break
+            got.append(f.payload)
+        assert got == expect, (case, cap, [len(g) for g in got],
+                               [len(e) for e in expect])
+        c.w.close()
+    await pub.disconnect()
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_retained_replay_carries_remaining_expiry():
     """MQTT5 3.3.2.3.3: a retained message replayed on subscribe must
     carry the REMAINING expiry interval, not the one it was stored with
