@@ -21,6 +21,14 @@ ds = jax.devices()
 assert ds and ds[0].platform != "cpu", ds
 EOF
   then
+    if ps -eo args | grep -E "^python( .*)? bench\.py" | grep -vq grep; then
+      # the round-end driver (or another session) is already benching
+      # the chip — two bench processes would contend and pollute both
+      echo "bench already running elsewhere; standing down" >> "$OUT/log"
+      date >> "$OUT/probe_failures"
+      sleep 300
+      continue
+    fi
     date > "$OUT/recovered_at"
     echo "tunnel recovered" >> "$OUT/log"
     # recovery windows can be SHORT (r3 saw one 25-min window all
