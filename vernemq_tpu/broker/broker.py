@@ -119,6 +119,17 @@ class Broker:
             "retain_messages": "Retained messages.",
             "active_sessions": "Currently connected sessions.",
             "uptime_seconds": "Broker uptime.",
+            "tpu_hybrid_host_pubs": "Small flushes served by the host "
+                                    "trie (hybrid dispatch).",
+            "tpu_overload_shed_pubs": "Publishes shed to the trie at "
+                                      "collector overload.",
+            "tpu_rebuild_shed_pubs": "Publishes the trie served during "
+                                     "a device table rebuild.",
+            "tpu_busy_shed_pubs": "Publishes the trie served past the "
+                                  "matcher-lock/cold-compile bound.",
+            "tpu_saturated_merges": "Flushes merged into a later batch "
+                                    "(both pipeline slots busy).",
+            "tpu_async_rebuilds": "Background device-table rebuilds.",
         })
 
     # ------------------------------------------------------------ plumbing
