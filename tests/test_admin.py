@@ -394,6 +394,47 @@ async def test_listener_stop_restart_delete_cycle(broker):
                 if r["port"] == port]
 
 
+@pytest.mark.asyncio
+async def test_vmq_listener_restart_revives_cluster(broker):
+    """Restarting the `vmq` cluster listener must bring the inter-node
+    channel back (Cluster.stop detaches broker.cluster so start_listener
+    doesn't refuse with 'already running'), and the replacement cluster
+    must actually route: a peer joined before the restart can still
+    deliver a cross-node publish after it."""
+    import asyncio as _a
+
+    b, _, _ = broker
+    from vernemq_tpu.broker.listeners import ListenerManager
+
+    lm = b.listeners or ListenerManager(b)
+    cluster = await lm.start_listener("vmq", "127.0.0.1", 0)
+    port = cluster.listen_port
+    assert b.cluster is cluster
+    await lm.restart_listener("127.0.0.1", port)
+    # a NEW cluster object is live on the SAME port; the old one detached
+    assert b.cluster is not None and b.cluster is not cluster
+    assert b.cluster.listen_port == port
+    assert b.registry.remote_publish == b.cluster.publish
+    # the retained record must reflect the replacement, and a second
+    # restart must keep working (the old bug wedged on the first)
+    await lm.restart_listener("127.0.0.1", port)
+    assert b.cluster.listen_port == port
+    rows = lm.show()
+    mine = [r for r in rows if r["port"] == port]
+    assert mine and mine[0]["status"] == "running"
+    # the LWW broadcast hook must follow the LIVE cluster, not the dead one
+    assert b.metadata.broadcast == b.cluster._broadcast_meta
+    # suspend/resume split: stop (sync, schedules the detach) then start
+    # must work too — start_listener waits out the pending stop task
+    lm.stop_listener("127.0.0.1", port)
+    await lm.start_listener("vmq", "127.0.0.1", port)
+    assert b.cluster is not None and b.cluster.listen_port == port
+    lm.delete_listener("127.0.0.1", port)
+    await _a.sleep(0.05)
+    assert b.cluster is None
+    assert b.metadata.broadcast is None
+
+
 def test_config_reset(event_loop):
     from vernemq_tpu.broker.broker import Broker
 

@@ -321,6 +321,109 @@ async def test_queue_migration_on_reconnect():
 
 
 @pytest.mark.asyncio
+async def test_cluster_channel_restart_rebuilds_writers():
+    """A restarted cluster channel (vmq listener restart) must rebuild
+    its outbound writers from the EXISTING member table — member-change
+    events fired long ago — and keep routing both directions. Covers the
+    replay in Cluster.start plus the stop() detach discipline."""
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        sub = await connected(b, "rs-sub")
+        await sub.subscribe("r/+", qos=1)
+        await wait_until(
+            lambda: len(a.broker.registry.trie("").match(["r", "x"])) == 1)
+        # restart node b's cluster channel in place (same port)
+        old = b.cluster
+        port = old.listen_port
+        await old.stop()
+        assert b.broker.cluster is None  # detached, restartable
+        fresh = Cluster(b.broker, "127.0.0.1", port)
+        await fresh.start()
+        b.cluster = fresh
+        # writers rebuilt from the member table on BOTH sides
+        await wait_until(lambda: dict(fresh.status()).get("node0") is True)
+        await wait_until(lambda: dict(a.cluster.status()).get("node1") is True)
+        # a NEW registration on a (reg_sync may coordinate via b) + publish
+        pub = await connected(a, "rs-pub")
+        await pub.publish("r/x", b"post-restart", qos=1)
+        msg = await sub.recv()
+        assert msg.payload == b"post-restart"
+        await sub.disconnect()
+        await pub.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_stopped_channel_keeps_cap_gate_and_counts_drops():
+    """A bare channel stop (vmq listener stop, no restart) must NOT flip
+    a still-clustered node to standalone: the is_ready gate stays down
+    and skipped remote forwards are counted, not silent."""
+    nodes = await make_cluster(2, allow_register_during_netsplit=True,
+                               allow_publish_during_netsplit=True)
+    try:
+        a, b = nodes
+        sub = await connected(a, "cap-sub")
+        await sub.subscribe("c/+", qos=0)
+        await wait_until(
+            lambda: len(b.broker.registry.trie("").match(["c", "x"])) == 1)
+        await b.cluster.stop()
+        assert b.broker.cluster is None
+        # still a joined member, no channel: NOT ready (CAP gates engage;
+        # without the allow_* flags above, registration would be rc=3)
+        assert b.broker.cluster_ready() is False
+        # a's view of node1 goes down too (channel dropped)
+        await wait_until(lambda: dict(a.cluster.status()).get("node1") is False)
+        # publish on b toward a's remote pointer: dropped WITH accounting
+        before = b.broker.metrics.value("cluster_publish_no_channel")
+        pub = await connected(b, "cap-pub")
+        await pub.publish("c/x", b"lost", qos=0)
+        await wait_until(lambda: b.broker.metrics.value(
+            "cluster_publish_no_channel") == before + 1)
+        await pub.disconnect()
+        await sub.disconnect()
+        b.cluster = None  # stop_cluster: already stopped
+    finally:
+        await stop_cluster([a])
+        await b.broker.stop()
+        await b.server.stop()
+
+
+@pytest.mark.asyncio
+async def test_failed_cluster_start_detaches_and_is_retryable():
+    """A vmq listener start that fails to bind must leave the broker
+    restartable (detach the half-built cluster), not wedged on
+    'cluster listener already running'."""
+    import socket
+
+    from vernemq_tpu.broker.listeners import ListenerManager
+
+    config = Config(systree_enabled=False, allow_anonymous=True)
+    from vernemq_tpu.broker.server import start_broker
+
+    broker, server = await start_broker(config, port=0, node_name="fx")
+    hog = socket.socket()
+    hog.bind(("127.0.0.1", 0))
+    hog.listen(1)
+    stolen_port = hog.getsockname()[1]
+    lm = ListenerManager(broker)
+    try:
+        with pytest.raises(OSError):
+            await lm.start_listener("vmq", "127.0.0.1", stolen_port)
+        assert broker.cluster is None  # detached, not wedged
+        assert broker.metadata.broadcast is None
+        # retry on a free port succeeds
+        cluster = await lm.start_listener("vmq", "127.0.0.1", 0)
+        assert broker.cluster is cluster
+    finally:
+        hog.close()
+        await lm.stop_all()
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_cluster_leave():
     nodes = await make_cluster(3)
     try:

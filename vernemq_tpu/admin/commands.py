@@ -183,7 +183,9 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
     reg.register(["updo", "diff"], _updo_diff,
                  "vmq-admin updo diff  (changed-on-disk modules)")
     reg.register(["updo", "run"], _updo_run,
-                 "vmq-admin updo run [dry=true]  (hot code upgrade)")
+                 "vmq-admin updo run [dry=true]  (hot code upgrade; "
+                 "re-executes changed modules' top level — top levels "
+                 "must be side-effect-free)")
     reg.register(["script", "show"], _script_show,
                  "vmq-admin script show")
     reg.register(["script", "reload"], _script_reload,

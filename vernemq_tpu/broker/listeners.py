@@ -87,11 +87,25 @@ class ListenerManager:
             await server.start()
             port = server.port
         else:  # vmq / vmqs — the cluster data-plane listener
+            if self.broker.cluster is not None:
+                # stop_listener schedules Cluster.stop() as a task; a
+                # stop-then-start sequence must wait for that detach
+                # instead of refusing against the half-stopped cluster
+                pending = [t for t in self._start_tasks if not t.done()]
+                if pending:
+                    await asyncio.gather(*pending, return_exceptions=True)
             if self.broker.cluster is None:
                 from ..cluster import Cluster
 
                 cluster = Cluster(self.broker, addr, port)
-                await cluster.start()
+                try:
+                    await cluster.start()
+                except BaseException:
+                    # __init__ attached broker.cluster/metadata hooks; a
+                    # failed bind (stolen port, moved cert) must detach or
+                    # every later start hits 'already running' forever
+                    await cluster.stop()
+                    raise
                 server = cluster
                 port = cluster.listen_port
             else:
@@ -120,7 +134,7 @@ class ListenerManager:
         stop = getattr(server, "stop", None) if server is not None else None
         if stop is not None:
             task = asyncio.get_event_loop().create_task(stop())
-            self._start_tasks.append(task)
+            self._track(task)
 
     def delete_listener(self, addr: str, port: int) -> None:
         """Stop (if running) and forget the listener entirely."""
@@ -161,6 +175,12 @@ class ListenerManager:
                 pass
         self._start_tasks.clear()
 
+    def _track(self, task: asyncio.Task) -> None:
+        """Retain a pending stop/start task (pruning finished ones — a
+        long-lived broker restarts listeners indefinitely)."""
+        self._start_tasks = [t for t in self._start_tasks if not t.done()]
+        self._start_tasks.append(task)
+
     def track_start_task(self, task: asyncio.Task) -> None:
         """Keep a handle on listener starts launched from sync command
         context so failures surface in logs."""
@@ -169,7 +189,7 @@ class ListenerManager:
                 log.error("listener start failed", exc_info=t.exception())
 
         task.add_done_callback(_done)
-        self._start_tasks.append(task)
+        self._track(task)
 
     # ---------------------------------------------------------------- admin
 

@@ -714,14 +714,18 @@ class Registry:
                 groups.setdefault(group, []).append((sid, opts))
                 continue
             if isinstance(key, str):  # remote node pointer
-                if (origin_local and self.remote_publish is not None
-                        and key not in forwarded_nodes):
+                if origin_local and key not in forwarded_nodes:
                     # overlapping filters yield multiple pointer rows to the
                     # same node; the receiving node re-folds its own view, so
                     # exactly one frame goes out (vmq_reg.erl:346-353)
                     forwarded_nodes.add(key)
-                    self.remote_publish(key, msg)
-                    self.broker.metrics.incr("router_matches_remote")
+                    if self.remote_publish is not None:
+                        self.remote_publish(key, msg)
+                        self.broker.metrics.incr("router_matches_remote")
+                    else:
+                        # cluster channel stopped/detached: the forward is
+                        # dropped VISIBLY (same counter as a down writer)
+                        self.broker.metrics.incr("cluster_publish_no_channel")
                 continue
             sid = key
             if opts.no_local and sid == from_sid:

@@ -119,6 +119,7 @@ class Session:
         self.receive_max_out = 65535  # client's receive maximum (broker→client inflight cap)
         self.max_packet_out = 0  # client's maximum_packet_size; 0 = unlimited
         self.max_frame_in = 0    # the listener's enforced inbound frame cap
+        self._recv_max_announced = 0  # receive_maximum sent in OUR CONNACK
         self.request_problem_info = True
         self.auth_method: Optional[str] = None
         self._in_enhanced_auth = False
@@ -314,6 +315,11 @@ class Session:
                 props["assigned_client_identifier"] = self._assigned_client_id
             if cfg.receive_max_broker:
                 props["receive_maximum"] = cfg.receive_max_broker
+                # enforce what THIS session announced, not the live cfg:
+                # a runtime `config set receive_max_broker` must not turn
+                # compliant in-flight clients into 0x93 disconnects (same
+                # announced-vs-enforced discipline as max_frame_in above)
+                self._recv_max_announced = cfg.receive_max_broker
             if cfg.topic_alias_max_client:
                 props["topic_alias_maximum"] = cfg.topic_alias_max_client
             if self.max_frame_in:
@@ -459,8 +465,8 @@ class Session:
         # recv_max_exceeded). A retransmitted QoS2 pid already holding a
         # credit does not count twice.
         if (self.proto_ver == PROTO_5 and f.qos > 0
-                and cfg.receive_max_broker
-                and len(self.awaiting_rel) >= cfg.receive_max_broker
+                and self._recv_max_announced
+                and len(self.awaiting_rel) >= self._recv_max_announced
                 and not (f.qos == 2
                          and f.packet_id in self.awaiting_rel)):
             self.broker.metrics.incr("mqtt_publish_error")

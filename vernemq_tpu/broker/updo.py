@@ -47,6 +47,17 @@ it talks to), an upgrade applies to the PROCESS serving the command.
 In multi-process workers mode (broker/workers.py) run ``updo run``
 against each worker's admin endpoint — or restart workers one at a
 time, which the supervisor already handles.
+
+Top-level side-effect constraint: ``run()`` RE-EXECUTES each changed
+module's top-level code (with live siblings visible in ``sys.modules``)
+to obtain the new definitions.  Module top-levels must therefore be
+side-effect-free beyond defining names — a top-level that registers
+hooks/metrics, starts threads, or mutates an imported live registry
+would do so a SECOND time against live broker state on every
+``updo run``.  This is the same contract the BEAM imposes (module
+loading runs no user code; registrations happen in ``start`` callbacks)
+— put such effects in an init function or guard them with an
+idempotence check, and use ``__updo__`` for upgrade-time migrations.
 """
 
 from __future__ import annotations
@@ -248,7 +259,12 @@ def _patch_class(old: type, new: type, failures: list[str],
         old_val = vars(old).get(attr)
         nf, of = _unwrap(new_val), _unwrap(old_val)
         if isinstance(nf, types.FunctionType) \
-                and isinstance(of, types.FunctionType):
+                and isinstance(of, types.FunctionType) \
+                and type(new_val) is type(old_val):
+            # in-place __code__ graft only when the wrapper kind matches:
+            # a @classmethod -> plain-method (or the reverse) change must
+            # adopt the NEW descriptor, or the grafted code runs with the
+            # wrong first-argument binding (cls where it expects self)
             _patch_function(of, nf, failures, f"{where}.{attr}")
         elif isinstance(new_val, type) and isinstance(old_val, type):
             _patch_class(old_val, new_val, failures, f"{where}.{attr}",

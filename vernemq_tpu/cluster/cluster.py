@@ -85,6 +85,13 @@ class Cluster:
             "state": "joined",
             "joined_at": time.time(),
         })
+        # writers are normally created by member-change events; members
+        # ALREADY in the table (listener restart, warm boot from persisted
+        # metadata) fire none — replay them or the restarted channel has
+        # no outbound writers and peers' acked ops at us time out
+        for node, rec in self.metadata.fold(MEMBERS):
+            if node != self.node_name and rec:
+                self._on_member_change(node, None, rec, self.node_name)
         if hasattr(self.metadata, "start_ae"):
             self._sync_metadata_peers()
             self.metadata.start_ae()
@@ -98,6 +105,31 @@ class Cluster:
         if self._server is not None:
             self._server.close()
         self._com.close_all()  # peers must see the channels drop
+        self._bootstrap.clear()
+        # Detach from the broker so the vmq listener can be RESTARTED:
+        # start_listener refuses while broker.cluster is set, and the
+        # registry must stop forwarding into dead writers. The metadata
+        # store outlives us (broker.metadata), so drop EVERY hook wiring
+        # it to this cluster — the member-change handler, the LWW
+        # broadcast fn, the SWC transport ref — or a later restart would
+        # feed two clusters (and local puts would flood dead writers).
+        if hasattr(self.metadata, "unsubscribe"):
+            self.metadata.unsubscribe(MEMBERS, self._on_member_change)
+        if getattr(self.metadata, "broadcast", None) == self._broadcast_meta:
+            self.metadata.broadcast = None
+        if getattr(self.metadata, "cluster", None) is self:
+            self.metadata.cluster = None
+        if self.broker.cluster is self:
+            # a node that is STILL a joined member but has no channel must
+            # not report ready (the is_ready gate this object was serving
+            # falls back to broker._cluster_ready once we detach) — a bare
+            # `listener stop` keeps the CAP gates engaged exactly as the
+            # attached-but-down channel did; a genuinely standalone node
+            # (no other joined members) stays ready
+            self.broker._cluster_ready = not self.members(include_self=False)
+            self.broker.cluster = None
+            self.broker.registry.remote_publish = None
+            self.broker.registry.remote_enqueue_nowait = None
 
     def join(self, seed_host: str, seed_port: int) -> None:
         """Join via a seed node (vmq_peer_service:join): a bootstrap

@@ -182,6 +182,12 @@ class MetadataStore:
         caches skip re-applying their own local writes."""
         self._subscribers.setdefault(prefix, []).append(fn)
 
+    def unsubscribe(self, prefix: str,
+                    fn: Callable[[Any, Any, Any, str], None]) -> None:
+        fns = self._subscribers.get(prefix)
+        if fns and fn in fns:
+            fns.remove(fn)
+
     # ----------------------------------------------------------- replication
 
     def _newer(self, a: Entry, b: Optional[Entry]) -> bool:

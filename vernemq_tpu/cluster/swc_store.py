@@ -309,6 +309,12 @@ class SWCMetadata:
                   fn: Callable[[Any, Any, Any, str], None]) -> None:
         self._subscribers.setdefault(prefix, []).append(fn)
 
+    def unsubscribe(self, prefix: str,
+                    fn: Callable[[Any, Any, Any, str], None]) -> None:
+        fns = self._subscribers.get(prefix)
+        if fns and fn in fns:
+            fns.remove(fn)
+
     def stats(self) -> Dict[str, int]:
         return {
             "metadata_entries": sum(len(g.objects) for g in self.groups),
