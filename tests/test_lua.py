@@ -1461,3 +1461,76 @@ end)
     rt.execute("ok2, err2 = pcall(function() return string.sub() end)")
     assert rt.get_global("ok2") is False
     assert "host function error" in str(rt.get_global("err2"))
+
+
+def test_lua_auth_hooks_overlap(tmp_path):
+    """VERDICT r4 item 8 'done' bar: N parallel Lua auth hooks truly
+    OVERLAP end-to-end — distinct pooled interpreter states
+    (LuaScript num_states) driving distinct pooled datastore sockets
+    (ClientPool) — proven by a fake redis that only answers once K GETs
+    are simultaneously in flight. A single shared Lua state or a single
+    shared socket would deadlock the barrier and fail the test."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from vernemq_tpu.plugins.scripting import ScriptingPlugin
+
+    K = 3
+    barrier = threading.Barrier(K, timeout=15)
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+
+    def handle(conn):
+        f = conn.makefile("rb")
+        while True:
+            line = f.readline().strip()
+            if not line:
+                return
+            n = int(line[1:])
+            args = []
+            for _ in range(n):
+                ln = f.readline().strip()
+                args.append(f.read(int(ln[1:]) + 2)[:-2])
+            if args[0].upper() == b"GET":
+                barrier.wait()  # released only with K GETs in flight
+                conn.sendall(b"$2\r\nok\r\n")
+            else:
+                conn.sendall(b"+OK\r\n")
+
+    def serve():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=handle, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=serve, daemon=True).start()
+    port = srv.getsockname()[1]
+
+    path = tmp_path / "ovl.lua"
+    path.write_text("""
+pool = "ovl"
+redis.ensure_pool({ pool_id = pool, host = "127.0.0.1", port = %d,
+                    size = %d })
+function auth_on_register(reg)
+    res = redis.cmd(pool, "get gate")
+    if res == "ok" then return true end
+    return false
+end
+hooks = { auth_on_register = auth_on_register }
+""" % (port, K))
+    plugin = ScriptingPlugin(_FakeBroker(), scripts=[str(path)])
+    s = plugin.scripts[str(path)]
+    assert s.num_states >= K
+    hook = s.hooks["auth_on_register"]
+    peer = ("10.0.0.1", 1883)
+    try:
+        with ThreadPoolExecutor(K) as ex:
+            futs = [ex.submit(hook, peer, ("", f"c{i}"), "u", "p", True)
+                    for i in range(K)]
+            res = [f.result(timeout=20) for f in futs]
+        assert res == ["ok"] * K
+    finally:
+        srv.close()

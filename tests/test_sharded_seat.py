@@ -1,0 +1,195 @@
+"""ShardedTpuMatcher — the multi-device seat behind the reg-view seam
+(VERDICT r4 item 5): TpuMatcher's production discipline (lock, snapshot
+resolution, async rebuild shed, cold-compile gate) over the shard_map
+windowed kernel, on the virtual 8-device CPU mesh."""
+
+import asyncio
+import random
+
+import pytest
+
+from vernemq_tpu.models.trie import SubscriptionTrie
+from vernemq_tpu.parallel.mesh import make_mesh
+from vernemq_tpu.parallel.sharded_match import ShardedTpuMatcher
+from vernemq_tpu.models.tpu_matcher import MatcherBusy, RebuildInProgress
+
+from tests.test_tpu_match import norm
+
+
+def corpus(seed, n_filters, l0n=32, l1n=64, l2n=16):
+    rng = random.Random(seed)
+    l0 = [f"r{i}" for i in range(l0n)]
+    l1 = [f"d{i}" for i in range(l1n)]
+    l2 = [f"m{i}" for i in range(l2n)]
+    filters = []
+    for i in range(n_filters):
+        r = rng.random()
+        w = [rng.choice(l0), rng.choice(l1), rng.choice(l2)]
+        if r < 0.6:
+            f = w
+        elif r < 0.8:
+            f = [w[0], "+", w[2]]
+        elif r < 0.9:
+            f = ["+", w[1], w[2]]
+        else:
+            f = [w[0], w[1], "#"]
+        filters.append((f, i))
+    return filters, (l0, l1, l2), rng
+
+
+def topics_for(rng, pools, n):
+    l0, l1, l2 = pools
+    return [(rng.choice(l0), rng.choice(l1), rng.choice(l2))
+            for _ in range(n)]
+
+
+def seat_with(filters, mesh, **kw):
+    m = ShardedTpuMatcher(mesh, max_levels=8, **kw)
+    trie = SubscriptionTrie()
+    with m.lock:
+        for f, key in filters:
+            m.table.add(list(f), key, None)
+    for f, key in filters:
+        trie.add(list(f), key, None)
+    return m, trie
+
+
+@pytest.mark.parametrize("batch_axis", [1, 2])
+def test_seat_parity_20k(batch_axis):
+    filters, pools, rng = corpus(7, 20_000)
+    mesh = make_mesh(batch=batch_axis)
+    m, trie = seat_with(filters, mesh, max_fanout=128)
+    topics = topics_for(rng, pools, 100)
+    got = m.match_batch(topics)
+    assert m.match_batches == 1 and m.match_publishes == 100
+    for topic, rows in zip(topics, got):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_seat_delta_stream_parity():
+    """Subscribe/unsubscribe churn between batches rides the sharded
+    delta scatter (no full rebuild) and stays parity-exact."""
+    filters, pools, rng = corpus(11, 10_000)
+    mesh = make_mesh(batch=2)
+    m, trie = seat_with(filters, mesh, max_fanout=128)
+    m.match_batch(topics_for(rng, pools, 16))  # first full build
+    assert not m.table.resized
+    l0, l1, l2 = pools
+    for round_i in range(3):
+        base = 1_000_000 + round_i * 1000
+        with m.lock:
+            for j in range(50):
+                f = [rng.choice(l0), rng.choice(l1), rng.choice(l2)]
+                m.table.add(f, base + j, None)
+                trie.add(list(f), base + j, None)
+            removed = 0
+            for e in list(m.table.entries):
+                if e is None:
+                    continue
+                if removed >= 25:
+                    break
+                if rng.random() < 0.01:
+                    m.table.remove(list(e[0]), e[1])
+                    trie.remove(list(e[0]), e[1])
+                    removed += 1
+        assert not m.table.resized  # still the delta path
+        topics = topics_for(rng, pools, 32)
+        got = m.match_batch(topics)
+        for topic, rows in zip(topics, got):
+            assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_seat_cold_gate_and_busy_shed():
+    """require_warm refuses a cold compile signature (MatcherBusy) and
+    accepts it after one execution warmed the shape; a held lock past
+    lock_timeout sheds instead of head-blocking."""
+    filters, pools, rng = corpus(13, 5_000)
+    mesh = make_mesh(batch=1)
+    m, trie = seat_with(filters, mesh, max_fanout=64)
+    topics = topics_for(rng, pools, 8)
+    with pytest.raises(MatcherBusy) as ei:
+        m.match_batch(topics, lock_timeout=1.0, require_warm=True)
+    assert ei.value.cold
+    m.match_batch(topics)  # warms the shape
+    got = m.match_batch(topics, lock_timeout=1.0, require_warm=True)
+    for topic, rows in zip(topics, got):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+    # busy shed: someone holds the matcher lock
+    m.lock.acquire()
+    try:
+        with pytest.raises(MatcherBusy) as ei:
+            m.match_batch(topics, lock_timeout=0.05, require_warm=True)
+        assert not ei.value.cold
+    finally:
+        m.lock.release()
+
+
+def test_seat_async_rebuild_sheds_then_installs():
+    """A growth rebuild with async_rebuild on sheds (RebuildInProgress)
+    instead of stalling, and the background install restores service with
+    parity — the single-chip production discipline on the mesh."""
+    filters, pools, rng = corpus(17, 5_000)
+    mesh = make_mesh(batch=2)
+    m, trie = seat_with(filters, mesh, max_fanout=64)
+    m.match_batch(topics_for(rng, pools, 8))
+    m.async_rebuild = True
+    with m.lock:
+        m.table.resized = True  # simulate a capacity change
+    with pytest.raises(RebuildInProgress):
+        m.match_batch(topics_for(rng, pools, 8))
+    deadline = 60
+    topics = topics_for(rng, pools, 16)
+    while True:
+        try:
+            got = m.match_batch(topics)
+            break
+        except RebuildInProgress:
+            deadline -= 1
+            assert deadline > 0, "rebuild never installed"
+            import time
+
+            time.sleep(0.5)
+    assert m.rebuilds_async >= 1
+    for topic, rows in zip(topics, got):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+@pytest.mark.asyncio
+async def test_broker_serves_through_sharded_view():
+    """The 'done' bar of VERDICT item 5: a broker configured with
+    default_reg_view=tpu and a tpu_mesh boots, an MQTT subscribe/publish
+    round-trips through it, and the serving matcher IS the sharded seat
+    running on the 8-device mesh."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    cfg = Config(systree_enabled=False, allow_anonymous=True,
+                 default_reg_view="tpu", tpu_mesh="2x4")
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        view = broker.registry.reg_view("tpu")
+        sub = MQTTClient("127.0.0.1", server.port, client_id="shs")
+        assert (await sub.connect()).rc == 0
+        await sub.subscribe("sh/+/t", qos=1)
+        m = view.matcher("")
+        assert isinstance(m, ShardedTpuMatcher)
+        assert m.mesh.shape == {"batch": 2, "sub": 4}
+        pub = MQTTClient("127.0.0.1", server.port, client_id="shp")
+        assert (await pub.connect()).rc == 0
+        await pub.publish("sh/1/t", b"via-mesh", qos=1)
+        msg = await sub.recv()
+        assert msg.payload == b"via-mesh"
+        # the synchronous fold path answers from the device table
+        rows = view.fold("", ["sh", "1", "t"])
+        assert len(rows) == 1 and rows[0][1] == ("", "shs")
+        assert m.match_batches >= 1
+        # delta stream: unsubscribe reaches the device table
+        await sub.unsubscribe("sh/+/t")
+        rows = view.fold("", ["sh", "1", "t"])
+        assert rows == []
+        await sub.disconnect()
+        await pub.disconnect()
+    finally:
+        await broker.stop()
+        await server.stop()

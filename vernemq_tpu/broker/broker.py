@@ -117,6 +117,8 @@ class Broker:
             "router_memory": "Approximate routing table memory (bytes).",
             "queue_processes": "Live subscriber queues.",
             "retain_messages": "Retained messages.",
+            "retain_memory": "Approximate bytes used for storing "
+                             "retained messages.",
             "active_sessions": "Currently connected sessions.",
             "uptime_seconds": "Broker uptime.",
             "tpu_hybrid_host_pubs": "Small flushes served by the host "
@@ -137,6 +139,7 @@ class Broker:
     def _gauges(self) -> Dict[str, float]:
         out = dict(self.registry.stats())
         out["retain_messages"] = len(self.retain)
+        out["retain_memory"] = self.retain.memory()
         out["active_sessions"] = len(self.sessions)
         out["uptime_seconds"] = time.time() - self._started
         return out

@@ -76,6 +76,10 @@ DEFAULTS: Dict[str, Any] = {
     # mysql auth-script query — password | md5 | sha1 | sha256
     # (vmq_diversity_mysql.erl:119-129 hash_method)
     "mysql_password_hash_method": "password",
+    # Lua interpreter states per script (the balancing pool of
+    # vmq_diversity_script_sup_sup.erl): concurrent auth hooks each
+    # check a state out instead of serialising on one interpreter
+    "diversity_num_states": 4,
     # fused Pallas tile matcher for the probe phases (ops/pallas_match.py);
     # off by default until the on-chip A/B (tools/tune_windowed.py
     # --pallas) shows a win — self-disables if Mosaic lowering fails
@@ -88,6 +92,12 @@ DEFAULTS: Dict[str, Any] = {
     # flushes this small are matched on the host trie instead of paying a
     # device round trip (hybrid dispatch, SURVEY.md §7.2); 0 disables
     "tpu_host_batch_threshold": 8,
+    # multi-device serving mesh "BxS" (batch x sub axes, e.g. "1x8") or
+    # "S" (sub-only) — when set, the tpu reg view shards the subscription
+    # table over the 'sub' axis and the publish batch over 'batch'
+    # (SURVEY §5.7: the per-node trie replica sharded across chips,
+    # vmq_reg_trie.erl:503-520). Empty = single-device matcher.
+    "tpu_mesh": "",
     # device flush waits at most this long for the matcher lock before
     # the whole flush serves from the host trie (0 = unbounded wait)
     "tpu_lock_busy_shed_ms": 500,

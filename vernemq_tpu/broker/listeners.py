@@ -90,8 +90,13 @@ class ListenerManager:
             if self.broker.cluster is not None:
                 # stop_listener schedules Cluster.stop() as a task; a
                 # stop-then-start sequence must wait for that detach
-                # instead of refusing against the half-stopped cluster
-                pending = [t for t in self._start_tasks if not t.done()]
+                # instead of refusing against the half-stopped cluster.
+                # Never gather OURSELVES: an admin `listener start` runs
+                # inside a tracked task, and awaiting it here would
+                # deadlock the listener manager permanently.
+                cur = asyncio.current_task()
+                pending = [t for t in self._start_tasks
+                           if not t.done() and t is not cur]
                 if pending:
                     await asyncio.gather(*pending, return_exceptions=True)
             if self.broker.cluster is None:

@@ -26,6 +26,23 @@ COUNTERS: List[Tuple[str, str]] = [
     ("bytes_sent", "The total number of bytes sent."),
     ("mqtt_connect_received", "The number of CONNECT packets received."),
     ("mqtt_connack_sent", "The number of CONNACK packets sent."),
+    # v4 per-return-code CONNACK counters (vmq_metrics.erl:655-660)
+    ("mqtt_connack_accepted_sent",
+     "The number of times a connection has been accepted."),
+    ("mqtt_connack_unacceptable_protocol_sent",
+     "The number of times the broker could not support the requested "
+     "protocol."),
+    ("mqtt_connack_identifier_rejected_sent",
+     "The number of times a client was rejected due to an unacceptable "
+     "identifier."),
+    ("mqtt_connack_server_unavailable_sent",
+     "The number of times a client was rejected due to the broker being "
+     "unavailable."),
+    ("mqtt_connack_bad_credentials_sent",
+     "The number of times a client sent bad credentials."),
+    ("mqtt_connack_not_authorized_sent",
+     "The number of times a client was rejected due to insufficient "
+     "authorization."),
     ("mqtt_publish_received", "The number of PUBLISH packets received."),
     ("mqtt_publish_sent", "The number of PUBLISH packets sent."),
     ("mqtt_puback_received", "The number of PUBACK packets received."),
@@ -53,6 +70,12 @@ COUNTERS: List[Tuple[str, str]] = [
     ("mqtt_subscribe_auth_error", "Unauthorized SUBSCRIBE attempts."),
     ("mqtt_unsubscribe_error", "Failed UNSUBSCRIBE attempts."),
     ("mqtt_invalid_msg_size_error", "Oversized messages dropped."),
+    ("mqtt_puback_invalid_error",
+     "The number of unexpected PUBACK messages received."),
+    ("mqtt_pubrec_invalid_error",
+     "The number of unexpected PUBREC messages received."),
+    ("mqtt_pubcomp_invalid_error",
+     "The number of unexpected PUBCOMP messages received."),
     ("mqtt_publish_throttled",
      "PUBLISHes paused by max_message_rate / overload shedding."),
     ("queue_setup", "The number of queue processes created."),
@@ -92,6 +115,12 @@ class Metrics:
 
         self._counters: Dict[str, int] = {name: 0 for name, _ in COUNTERS}
         self._descriptions: Dict[str, str] = dict(COUNTERS)
+        # labeled series, keyed (family, (("label","value"),...)) — the
+        # reference's per-reason-code counter families
+        # (vmq_metrics.erl:787-915: mqtt_connack_sent / mqtt_disconnect_*
+        # by reason_code). Event-rate mutation only (CONNACK/DISCONNECT),
+        # so a plain dict is fine.
+        self._labeled: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], int] = {}
         self._gauge_providers: List[Callable[[], Dict[str, float]]] = []
         self._gauge_desc: Dict[str, str] = {}
         self._rate_state: Dict[object, Tuple[float, int]] = {}
@@ -141,6 +170,13 @@ class Metrics:
         tl.ops += 1
         if tl.ops >= self._FLUSH_OPS:
             self._flush_own()
+
+    def incr_labeled(self, name: str, n: int = 1, **labels: str) -> None:
+        """Count into a labeled series (per-reason-code families). The
+        flat family counter is incremented separately by the caller where
+        the reference keeps both (e.g. mqtt_connack_sent)."""
+        key = (name, tuple(sorted(labels.items())))
+        self._labeled[key] = self._labeled.get(key, 0) + n
 
     def _flush_own(self) -> None:
         """Drain this thread's buffered increments into the native block
@@ -248,12 +284,18 @@ class Metrics:
         out: Dict[str, float] = dict(self._counters)
         if self._native is not None:
             out.update(self._native_totals())
+        for (name, labels), val in self._labeled.items():
+            lbl = ",".join(f'{k}="{v}"' for k, v in labels)
+            out[f"{name}{{{lbl}}}"] = val
         for provider in self._gauge_providers:
             out.update(provider())
         return out
 
     def prometheus_text(self, node: str = "local") -> str:
-        """Prometheus exposition format (vmq_metrics_http.erl:42-84)."""
+        """Prometheus exposition format (vmq_metrics_http.erl:42-84).
+        Labeled series join their flat family under ONE HELP/TYPE header
+        (exposition-format requirement: one metadata block per family,
+        samples contiguous)."""
         lines: List[str] = []
         gauges: Dict[str, float] = {}
         for provider in self._gauge_providers:
@@ -261,11 +303,18 @@ class Metrics:
         counters = dict(self._counters)
         if self._native is not None:
             counters.update(self._native_totals())
-        for name, val in sorted(counters.items()):
+        labeled: Dict[str, List[Tuple[str, int]]] = {}
+        for (name, labels), val in sorted(self._labeled.items()):
+            lbl = "".join(f',{k}="{v}"' for k, v in labels)
+            labeled.setdefault(name, []).append((lbl, val))
+        for name in sorted(set(counters) | set(labeled)):
             desc = self._descriptions.get(name, name)
             lines.append(f"# HELP {name} {desc}")
             lines.append(f"# TYPE {name} counter")
-            lines.append(f'{name}{{node="{node}"}} {val}')
+            if name in counters:
+                lines.append(f'{name}{{node="{node}"}} {counters[name]}')
+            for lbl, val in labeled.get(name, ()):
+                lines.append(f'{name}{{node="{node}"{lbl}}} {val}')
         for name, val in sorted(gauges.items()):
             desc = self._gauge_desc.get(name, name)
             lines.append(f"# HELP {name} {desc}")

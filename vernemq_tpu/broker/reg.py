@@ -224,7 +224,40 @@ class Registry:
             use_pallas=self.broker.config.tpu_use_pallas,
             packed_io=self.broker.config.tpu_packed_io,
             initial_capacity=self.broker.config.tpu_initial_capacity,
+            mesh=self._mesh_from_config(),
         )
+
+    def _mesh_from_config(self):
+        """Build the serving mesh from the ``tpu_mesh`` knob ("BxS" or
+        "S"); None (single-device matcher) when unset or unsatisfiable —
+        a config asking for more devices than exist degrades LOUDLY to
+        the single-chip path rather than refusing to boot."""
+        spec = str(self.broker.config.get("tpu_mesh", "") or "").strip()
+        if not spec:
+            return None
+        try:
+            if "x" in spec:
+                b_s = spec.lower().split("x")
+                batch, sub = int(b_s[0]), int(b_s[1])
+            else:
+                batch, sub = 1, int(spec)
+            import jax
+
+            from ..parallel.mesh import make_mesh
+
+            need = batch * sub
+            devs = jax.devices()
+            if len(devs) < need:
+                log.error(
+                    "tpu_mesh=%s wants %d devices but only %d present; "
+                    "serving on the single-device matcher", spec, need,
+                    len(devs))
+                return None
+            return make_mesh(devs[:need], batch=batch)
+        except Exception:
+            log.exception("invalid tpu_mesh %r; serving on the "
+                          "single-device matcher", spec)
+            return None
 
     def _start_accel_probe(self) -> None:
         """Run the accelerator probe off-loop, once; on the verdict the
@@ -273,15 +306,7 @@ class Registry:
                 _accel_probe_result = None  # bypass the cache
                 ok = await loop.run_in_executor(None, _probe_accelerator)
                 if ok:
-                    from ..models.tpu_matcher import TpuRegView
-
-                    self.reg_views["tpu"] = TpuRegView(
-                        self, max_fanout=self.broker.config.tpu_max_fanout,
-                        flat_avg=self.broker.config.tpu_flat_avg,
-                        use_pallas=self.broker.config.tpu_use_pallas,
-                        packed_io=self.broker.config.tpu_packed_io,
-                        initial_capacity=self.broker.config
-                        .tpu_initial_capacity)
+                    self.reg_views["tpu"] = self._make_tpu_view()
                     log.warning("accelerator recovered; TPU reg view "
                                 "re-enabled")
                     return

@@ -29,6 +29,7 @@ class RetainStore:
     def __init__(self, on_dirty: Optional[Callable[[str, Tuple[str, ...], Any], None]] = None):
         self._roots: Dict[str, _RNode] = {}  # per-mountpoint retain trees
         self._count = 0
+        self._bytes = 0  # approximate payload+topic bytes (retain_memory)
         # write-behind hook: called with (mountpoint, topic, value|None) on
         # every mutation so the metadata store persists + replicates deltas
         # (vmq_retain_srv dirty table + metadata events,
@@ -37,6 +38,21 @@ class RetainStore:
 
     def __len__(self) -> int:
         return self._count
+
+    def memory(self) -> int:
+        """Approximate bytes held by retained messages (the reference's
+        ``retain_memory`` gauge — there ETS words, here payload + topic
+        bytes + a fixed per-entry overhead)."""
+        return self._bytes
+
+    @staticmethod
+    def _vsize(topic: Sequence[str], value: Any) -> int:
+        payload = getattr(value, "payload", value)
+        try:
+            p = len(payload)
+        except TypeError:
+            p = 64
+        return 64 + sum(len(w) + 8 for w in topic) + p
 
     def insert(self, mountpoint: str, topic: Sequence[str], value: Any) -> None:
         """Store/replace the retained message for a topic
@@ -51,7 +67,10 @@ class RetainStore:
             node = node.children.setdefault(w, _RNode())
         if node.value is None:
             self._count += 1
+        else:
+            self._bytes -= self._vsize(topic, node.value)
         node.value = value
+        self._bytes += self._vsize(topic, value)
 
     def delete(self, mountpoint: str, topic: Sequence[str]) -> bool:
         """Remove retained message (empty retained payload deletes,
@@ -83,6 +102,7 @@ class RetainStore:
             node = nxt
         if node.value is None:
             return False
+        self._bytes -= self._vsize(topic, node.value)
         node.value = None
         self._count -= 1
         for parent, w in reversed(path):

@@ -64,6 +64,7 @@ from ..protocol.types import (
     Unsuback,
     Unsubscribe,
     Will,
+    reason_name,
 )
 from .message import Msg, SubscriberId
 from .plugins import HookError
@@ -140,6 +141,12 @@ class Session:
         m = _IN_METRIC.get(type(frame))
         if m:
             self.broker.metrics.incr(m)
+        if type(frame) is Disconnect and self.proto_ver == PROTO_5:
+            # per-reason family (vmq_metrics mqtt5_disconnect_recv_def)
+            self.broker.metrics.incr_labeled(
+                "mqtt_disconnect_received", mqtt_version="5",
+                reason_code=reason_name(frame.reason_code,
+                                        zero="normal_disconnect"))
 
     # ---------------------------------------------------------- CONNECT
 
@@ -201,6 +208,7 @@ class Session:
                 # with 0x8C, not silently ignored (MQTT5 4.12)
                 self.broker.metrics.incr("mqtt_connect_error")
                 self.send(Connack(session_present=False, rc=0x8C))
+                self._count_connack(0x8C)
                 await self.close("bad_authentication_method")
                 return False
             self._pending_connect = f
@@ -342,7 +350,7 @@ class Session:
                 if getattr(self, "_auth_success_data", None):
                     props["authentication_data"] = self._auth_success_data
         self.send(Connack(session_present=session_present, rc=0, properties=props))
-        self.broker.metrics.incr("mqtt_connack_sent")
+        self._count_connack(0)
         # attach AFTER the CONNACK so offline-backlog flush serialises behind
         # it on the wire (the reference's queue wakeup happens post-CONNACK)
         self.queue.add_session(self, self._queue_deliver)
@@ -364,9 +372,10 @@ class Session:
                 # re-auth on an established session: DISCONNECT, never a
                 # second CONNACK (MQTT5 4.12.1)
                 self.send(Disconnect(reason_code=0x8C))
-                self.broker.metrics.incr("mqtt_disconnect_sent")
+                self._count_disconnect_sent(0x8C)
             else:
                 self.send(Connack(session_present=False, rc=0x8C))
+                self._count_connack(0x8C)
             await self.close("bad_authentication_method")
             return "error"
         if isinstance(res, tuple):
@@ -384,11 +393,44 @@ class Session:
         self._enhanced_done = True
         return "ok"
 
+    #: v4 CONNACK return code → per-reason counter (vmq_metrics.erl:655-660)
+    _V4_CONNACK_COUNTER = {
+        0: "mqtt_connack_accepted_sent",
+        1: "mqtt_connack_unacceptable_protocol_sent",
+        2: "mqtt_connack_identifier_rejected_sent",
+        3: "mqtt_connack_server_unavailable_sent",
+        4: "mqtt_connack_bad_credentials_sent",
+        5: "mqtt_connack_not_authorized_sent",
+    }
+    #: and the reference's v4 return_code label strings (m4_connack_labels)
+    _V4_CONNACK_LABEL = {
+        0: "success", 1: "unsupported_protocol_version",
+        2: "client_identifier_not_valid", 3: "server_unavailable",
+        4: "bad_username_or_password", 5: "not_authorized",
+    }
+
+    def _count_connack(self, rc: int) -> None:
+        """Flat family counter + per-reason accounting for one CONNACK
+        (the reference keeps both: the v4 per-reason counters AND the
+        reason-labeled family, vmq_metrics.erl:655-660 + :787-813)."""
+        m = self.broker.metrics
+        m.incr("mqtt_connack_sent")
+        if self.proto_ver == PROTO_5:
+            m.incr_labeled("mqtt_connack_sent", mqtt_version="5",
+                           reason_code=reason_name(rc))
+        else:
+            flat = self._V4_CONNACK_COUNTER.get(rc)
+            if flat:
+                m.incr(flat)
+            m.incr_labeled("mqtt_connack_sent", mqtt_version="4",
+                           return_code=self._V4_CONNACK_LABEL.get(
+                               rc, f"rc_{rc}"))
+
     async def _connack_fail(self, v4_rc: int, v5_rc: int) -> None:
         self.broker.metrics.incr("mqtt_connect_error")
         rc = v5_rc if self.proto_ver == PROTO_5 else v4_rc
         self.send(Connack(session_present=False, rc=rc))
-        self.broker.metrics.incr("mqtt_connack_sent")
+        self._count_connack(rc)
         await self.close("connack_fail", send_will=False)
 
     # ------------------------------------------------------- frame dispatch
@@ -794,6 +836,8 @@ class Session:
         if entry and entry[0] == "puback":
             del self.waiting_acks[f.packet_id]
             self._pump_pending()
+        else:  # ack for nothing we sent (vmq_metrics *_invalid_error)
+            self.broker.metrics.incr("mqtt_puback_invalid_error")
 
     def _handle_pubrec(self, f: Pubrec) -> None:
         entry = self.waiting_acks.get(f.packet_id)
@@ -806,12 +850,18 @@ class Session:
             entry[2] = time.monotonic()
             self.send(Pubrel(packet_id=f.packet_id))
             self.broker.metrics.incr("mqtt_pubrel_sent")
+        elif not (entry and entry[0] == "pubcomp"):
+            # a DUP PUBREC while we await PUBCOMP is legal retransmission;
+            # anything else is unexpected
+            self.broker.metrics.incr("mqtt_pubrec_invalid_error")
 
     def _handle_pubcomp(self, f: Pubcomp) -> None:
         entry = self.waiting_acks.get(f.packet_id)
         if entry and entry[0] == "pubcomp":
             del self.waiting_acks[f.packet_id]
             self._pump_pending()
+        else:
+            self.broker.metrics.incr("mqtt_pubcomp_invalid_error")
 
     # ----------------------------------------------------------- SUBSCRIBE
 
@@ -1037,14 +1087,21 @@ class Session:
         """Kicked by a newer session with the same client id."""
         if self.proto_ver == PROTO_5:
             self.send(Disconnect(reason_code=RC_SESSION_TAKEN_OVER))
-            self.broker.metrics.incr("mqtt_disconnect_sent")
+            self._count_disconnect_sent(RC_SESSION_TAKEN_OVER)
         suppress = self.broker.config.suppress_lwt_on_session_takeover
         await self.close("session_taken_over", send_will=not suppress)
+
+    def _count_disconnect_sent(self, rc: int) -> None:
+        m = self.broker.metrics
+        m.incr("mqtt_disconnect_sent")
+        m.incr_labeled("mqtt_disconnect_sent", mqtt_version="5",
+                       reason_code=reason_name(rc,
+                                               zero="normal_disconnect"))
 
     async def _disconnect_v5(self, rc: int) -> None:
         if self.proto_ver == PROTO_5:
             self.send(Disconnect(reason_code=rc))
-            self.broker.metrics.incr("mqtt_disconnect_sent")
+            self._count_disconnect_sent(rc)
         await self.close(f"disconnect_rc_{rc:#x}")
 
     def info(self) -> Dict[str, Any]:

@@ -869,21 +869,34 @@ class TpuMatcher:
 
 class TpuRegView:
     """Reg-view adapter over per-mountpoint TpuMatchers. Non-default
-    mountpoints share the same machinery (one table each)."""
+    mountpoints share the same machinery (one table each). With a
+    ``mesh`` (the ``tpu_mesh`` config knob) each mountpoint gets a
+    :class:`parallel.sharded_match.ShardedTpuMatcher` instead — the
+    serving path then matches across every device of the mesh with the
+    same delta stream, rebuild shed and fallback discipline."""
 
     name = "tpu"
 
     def __init__(self, registry, max_levels: int = 16,
                  initial_capacity: int = 1024, max_fanout: int = 256,
                  flat_avg: int = 128, use_pallas: bool = False,
-                 packed_io: bool = True):
+                 packed_io: bool = True, mesh=None):
         self.registry = registry
+        self.mesh = mesh
         self._matchers: Dict[str, TpuMatcher] = {}
 
         def _mk() -> TpuMatcher:
-            m = TpuMatcher(max_levels, initial_capacity, max_fanout,
-                           flat_avg=flat_avg, use_pallas=use_pallas,
-                           packed_io=packed_io)
+            if mesh is not None:
+                from ..parallel.sharded_match import ShardedTpuMatcher
+
+                m: TpuMatcher = ShardedTpuMatcher(
+                    mesh, max_levels=max_levels,
+                    initial_capacity=initial_capacity,
+                    max_fanout=max_fanout, flat_avg=flat_avg)
+            else:
+                m = TpuMatcher(max_levels, initial_capacity, max_fanout,
+                               flat_avg=flat_avg, use_pallas=use_pallas,
+                               packed_io=packed_io)
             # production seat: growth rebuilds run in the background
             # while the registry's trie serves (fold / _flush_async
             # catch RebuildInProgress)

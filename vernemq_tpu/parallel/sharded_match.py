@@ -388,14 +388,20 @@ class ShardedWindowedMatcher:
         t.resized = False
         t.dirty.clear()
 
-    def _sync_delta(self) -> None:
+    def _sync_delta(self, donate: bool = True) -> None:
         """Scatter dirty slots into the sharded device arrays (GSPMD
         handles the sharded .at[].set under jit) — the delta path that
-        keeps churn from re-uploading the whole table."""
+        keeps churn from re-uploading the whole table. ``donate=False``
+        while a dispatched match still holds the buffers (the seat's
+        in-flight guard): the donating scatter would delete the arrays
+        under the in-flight call."""
         import numpy as np
 
-        from ..ops.match_kernel import apply_delta_operands
+        from ..ops.match_kernel import (apply_delta_operands,
+                                        apply_delta_operands_copy)
 
+        delta_ops = apply_delta_operands if donate \
+            else apply_delta_operands_copy
         t = self.table
         slots = np.fromiter(t.dirty, dtype=np.int32)
         t.dirty.clear()
@@ -413,13 +419,13 @@ class ShardedWindowedMatcher:
         hh = hh.at[slots].set(t.has_hash[slots])
         fw = fw.at[slots].set(t.first_wild[slots])
         act = act.at[slots].set(t.active[slots])
-        F_t, t1 = apply_delta_operands(F_t, t1, slots, d_words, d_eff,
-                                       self._bits)
+        F_t, t1 = delta_ops(F_t, t1, slots, d_words, d_eff,
+                            id_bits=self._bits)
         gsel = slots < self._glob
         if gsel.any():
             gs = slots[gsel]
-            Fg, t1g = apply_delta_operands(Fg, t1g, gs, t.words[gs],
-                                           t.eff_len[gs], self._bits)
+            Fg, t1g = delta_ops(Fg, t1g, gs, t.words[gs],
+                                t.eff_len[gs], id_bits=self._bits)
             effg = effg.at[gs].set(t.eff_len[gs])
             hhg = hhg.at[gs].set(t.has_hash[gs])
             fwg = fwg.at[gs].set(t.first_wild[gs])
@@ -427,27 +433,38 @@ class ShardedWindowedMatcher:
         self._dev = (F_t, t1, eff, hh, fw, act,
                      Fg, t1g, effg, hhg, fwg, actg)
 
-    def _fn_for(self, Bpad: int, T: int, seg_max: int, gc: int, Cl: int):
+    def _fn_for(self, Bpad: int, T: int, seg_max: int, gc: int, Cl: int,
+                glob: Optional[int] = None, S: Optional[int] = None,
+                bits: Optional[int] = None):
         # _glob (the dense width) and _S (hence Sl) are baked into the
         # compiled fn as Python constants — a rebuild can move them while
-        # leaving the other dims unchanged, so they must key the cache
-        key = (Bpad, T, seg_max, gc, Cl, self._glob, self._S)
+        # leaving the other dims unchanged, so they must key the cache.
+        # Callers racing a background rebuild pass the glob/S/bits their
+        # prep snapshot was taken against.
+        glob = self._glob if glob is None else glob
+        S = self._S if S is None else S
+        bits = self._bits if bits is None else bits
+        # bits keys the cache too: an id_bits-only rebuild (interner
+        # crossing a byte plane, no resize) changes the coded-operand
+        # decode width baked into the compiled fn
+        key = (Bpad, T, seg_max, gc, Cl, glob, S, bits)
         fn = self._fns.get(key)
         if fn is None:
             fn = build_sharded_windowed(
-                self.mesh, id_bits=self._bits, k=self.max_fanout,
-                glob_pad=self._glob, seg_max=seg_max, gc=gc, T=T,
-                Sl=self._S // self.nsub, Cl=Cl,
+                self.mesh, id_bits=bits, k=self.max_fanout,
+                glob_pad=glob, seg_max=seg_max, gc=gc, T=T,
+                Sl=S // self.nsub, Cl=Cl,
                 with_total=self.with_total)
             self._fns[key] = fn
         return fn
 
-    def match_batch(self, topics):
+    def _prep(self, topics):
+        """Host-side prep of one batch against the CURRENT table/window
+        state (callers needing consistency run this under their lock):
+        encode, per-shard pub assignment, window tiles. Returns everything
+        :meth:`_dispatch` and result resolution need."""
         import numpy as np
 
-        if not topics:
-            return []
-        self.sync()
         n = len(topics)
         S, glob, nsub = self._S, self._glob, self.nsub
         nb = self.nb
@@ -514,11 +531,33 @@ class ShardedWindowedMatcher:
                 a_pos[sel[placed]] = pof[placed]
                 for li in left:
                     leftovers.add(int(sel[li]))
-        fn = self._fn_for(Bpad, T, seg_max, gc, Cl)
-        res = fn(*self._dev, pw, pl, pd, real,
-                 t_sel, t_start, a_tile, a_pos, shard_of)
-        flat, pre, cnt, ovf = (np.asarray(x) for x in res[:4])
-        # flat [nb, nsub, Cl]; pre/cnt/ovf [nb, nsub, Bl]
+        return {
+            "geom": (Bpad, T, seg_max, gc, Cl),
+            "glob": glob, "S": S, "bits": self._bits, "Bl": Bl,
+            "dev": self._dev, "leftovers": leftovers,
+            "args": (pw, pl, pd, real, t_sel, t_start, a_tile, a_pos,
+                     shard_of),
+        }
+
+    def _dispatch(self, p):
+        """Run the device half of a prepped batch. Returns np arrays
+        (flat [nb, nsub, Cl]; pre/cnt/ovf [nb, nsub, Bl])."""
+        import numpy as np
+
+        fn = self._fn_for(*p["geom"], glob=p["glob"], S=p["S"],
+                          bits=p["bits"])
+        res = fn(*p["dev"], *p["args"])
+        return tuple(np.asarray(x) for x in res[:4])
+
+    def match_batch(self, topics):
+        import numpy as np
+
+        if not topics:
+            return []
+        self.sync()
+        p = self._prep(topics)
+        flat, pre, cnt, ovf = self._dispatch(p)
+        nsub, Bl, leftovers = self.nsub, p["Bl"], p["leftovers"]
         out = []
         for i, topic in enumerate(topics):
             r, j = divmod(i, Bl)
@@ -535,3 +574,209 @@ class ShardedWindowedMatcher:
 
     def _host_match(self, topic):
         return host_match(self.table, topic)
+
+
+# ---------------------------------------------------------------------------
+# The production seat: TpuMatcher-compatible adapter over the sharded kernel
+# ---------------------------------------------------------------------------
+
+from ..models.tpu_matcher import MatcherBusy, RebuildInProgress, TpuMatcher
+
+
+class ShardedTpuMatcher(TpuMatcher):
+    """Multi-device seat behind the reg-view seam (SURVEY §5.7: the trie
+    replica sharded across cores, ``vmq_reg_trie.erl:503-520`` recast as
+    row slices on a ('batch', 'sub') mesh).
+
+    Inherits TpuMatcher's production discipline — the mutation lock,
+    entries-snapshot resolution, async growth rebuilds with
+    RebuildInProgress shedding, compile-signature warmth (MatcherBusy on
+    cold shapes), warm_ladder/ensure_warm — and swaps the device half for
+    :class:`ShardedWindowedMatcher`'s shard_map kernel. ``TpuRegView``
+    builds this instead of a single-chip matcher when a ``tpu_mesh`` is
+    configured, so the broker's serving path (BatchCollector included)
+    matches on every device of the mesh with the same delta stream and
+    fallback story as the single-chip path."""
+
+    def __init__(self, mesh: Mesh, max_levels: int = 16,
+                 initial_capacity: int = 1024, max_fanout: int = 128,
+                 flat_avg: int = 128, **_ignored):
+        nsub = mesh.shape["sub"]
+        # every 'sub' shard needs >= 4096 rows (window-geometry floor) and
+        # S must divide over the axis: pre-size the table accordingly —
+        # growth doubles, so the invariant holds for life
+        cap = max(initial_capacity, 4096 * nsub, 32768)
+        super().__init__(max_levels=max_levels, initial_capacity=cap,
+                         max_fanout=max_fanout, flat_avg=flat_avg,
+                         packed_io=False, use_pallas=False)
+        self.mesh = mesh
+        self._swm = ShardedWindowedMatcher(
+            self.table, mesh, max_fanout=max_fanout, flat_avg=flat_avg)
+
+    # ------------------------------------------------------------- building
+
+    def _build_device(self, state: dict) -> tuple:
+        """Sharded device build from a host snapshot (no lock held): the
+        coded operands column-sharded over 'sub', the dense g-zone
+        replicated — the sharded mirror of ShardedWindowedMatcher.sync's
+        full-build path, but from a pinned snapshot so the async-rebuild
+        machinery can run it on a worker thread."""
+        import numpy as np
+
+        if not (state["bucketed"] and state["bits"]):
+            raise ValueError("sharded windowed matcher needs a bucketed "
+                             "table with MXU-codable ids")
+        words, eff = state["words"], state["eff_len"]
+        S = words.shape[0]
+        nsub = self.mesh.shape["sub"]
+        if S % nsub != 0 or S // nsub < 4096:
+            raise ValueError(
+                f"table of {S} rows cannot shard over a {nsub}-way 'sub' "
+                f"axis (needs S % {nsub} == 0 and >= 4096 rows/shard)")
+        F_t, t1 = self._jax.jit(
+            build_operands, static_argnames=("id_bits",))(
+                words, eff, id_bits=state["bits"])
+        F_t = np.asarray(F_t)
+        t1 = np.asarray(t1)
+        glob = state["gb_end"]
+        mesh = self.mesh
+        sF = NamedSharding(mesh, P(None, "sub"))
+        s1 = NamedSharding(mesh, P("sub"))
+        rep2 = NamedSharding(mesh, P(None, None))
+        rep1 = NamedSharding(mesh, P(None))
+        put = jax.device_put
+        dev = (
+            put(F_t, sF), put(t1, s1),
+            put(eff, s1), put(state["has_hash"], s1),
+            put(state["first_wild"], s1), put(state["active"], s1),
+            put(F_t[:, :glob], rep2), put(t1[:glob], rep1),
+            put(eff[:glob], rep1), put(state["has_hash"][:glob], rep1),
+            put(state["first_wild"][:glob], rep1),
+            put(state["active"][:glob], rep1),
+        )
+        return (dev, S, glob)
+
+    def _install_built(self, built: tuple, state: dict) -> None:
+        dev, S, glob = built
+        self._warm_sigs.clear()
+        sw = self._swm
+        sw._dev = dev
+        sw._S = S
+        sw._glob = glob
+        sw._bits = state["bits"]
+        sw._reg_start = state["reg_start"]
+        sw._reg_end = state["reg_end"]
+        # the base-class bookkeeping the shared machinery reads
+        self._dev_arrays = dev
+        self._operands = None
+        self._meta = None
+        self._ops_bits = state["bits"]
+        self._reg_start = state["reg_start"]
+        self._reg_end = state["reg_end"]
+        self._glob_pad = state["glob_pad"]
+        self._gb_end = state["gb_end"]
+        self._ng = state["ng"]
+        self._bucketed = state["bucketed"]
+        self._entries_snapshot = state["entries"]
+
+    # ----------------------------------------------------------------- sync
+
+    def sync(self) -> None:
+        """Full sharded rebuild on growth (async when enabled, with the
+        same RebuildInProgress shed as the single-chip seat), sharded
+        delta scatter otherwise. Callers hold ``self.lock``."""
+        t = self.table
+        if self._rebuild_thread is not None:
+            if self._rebuild_thread.is_alive():
+                raise RebuildInProgress
+            self._rebuild_thread = None
+            t.resized = True  # crashed worker consumed the flag: re-arm
+        if self._dev_arrays is None or t.resized \
+                or t.id_bits != self._ops_bits:
+            if self._dev_arrays is not None and self.async_rebuild:
+                self._spawn_rebuild_locked()
+                raise RebuildInProgress
+            state = self._snapshot_host_locked(copy=False, clear=False)
+            self._install_built(self._build_device(state), state)
+            t.resized = False
+            t.dirty.clear()
+            return
+        sw = self._swm
+        if t.dirty:
+            # copy-on-write entries snapshot: in-flight resolutions keep
+            # the state their device call actually matched
+            snap = self._entries_snapshot.copy()
+            for s in t.dirty:
+                snap[s] = t.entries[s]
+            self._entries_snapshot = snap
+            # donation only while NO dispatched match holds the arrays —
+            # the donating scatter deletes its inputs (base-class
+            # in-flight guard, tpu_matcher.sync)
+            sw._sync_delta(donate=self._inflight == 0)
+            self._dev_arrays = sw._dev
+        # bucket relocation (spare tail) moves regions without a resize
+        self._reg_start = sw._reg_start = t.reg_start.copy()
+        self._reg_end = sw._reg_end = (t.reg_start + t.reg_cap).copy()
+
+    # ---------------------------------------------------------------- match
+
+    def match_batch(self, topics, _warmup: bool = False,
+                    lock_timeout=None, require_warm: bool = False):
+        import numpy as np
+
+        if not topics:
+            return []
+        if lock_timeout is None:
+            self.lock.acquire()
+        elif not self.lock.acquire(timeout=lock_timeout):
+            self.busy_sheds += 1
+            raise MatcherBusy(cold=False)
+        try:
+            self.sync()
+            sw = self._swm
+            snapshot = self._entries_snapshot
+            p = sw._prep(topics)  # consistent table view under the lock
+            sig = ("sharded",) + p["geom"] + (p["glob"], p["S"])
+            if require_warm and sig not in self._warm_sigs:
+                self.busy_sheds += 1
+                raise MatcherBusy(cold=True)
+            self._inflight += 1
+        finally:
+            self.lock.release()
+        if _warmup:
+            self.warmup_batches += 1
+            self.warmup_publishes += len(topics)
+        else:
+            self.match_batches += 1
+            self.match_publishes += len(topics)
+        try:
+            flat, pre, cnt, ovf = sw._dispatch(p)
+            self._warm_sigs.add(sig)
+        finally:
+            with self.lock:
+                self._inflight -= 1
+        nsub, Bl, leftovers = sw.nsub, p["Bl"], p["leftovers"]
+        out = []
+        for i, topic in enumerate(topics):
+            r, j = divmod(i, Bl)
+            if i in leftovers or ovf[r, :, j].any():
+                self.host_fallbacks += 1
+                out.append(self._host_match(topic, snapshot))
+                continue
+            parts = [flat[r, s, pre[r, s, j]:pre[r, s, j] + cnt[r, s, j]]
+                     for s in range(nsub)]
+            rows = [e for e in snapshot[np.concatenate(parts)]
+                    if e is not None]
+            with self.lock:
+                if len(self.table.overflow):
+                    rows = rows + self.table.overflow.match(list(topic))
+            out.append(rows)
+        return out
+
+    def _pad_batch(self, n: int) -> int:
+        # mirror _prep's Bpad ladder (divisible by the 'batch' axis) so
+        # ensure_warm's dedup key matches the shape actually compiled
+        b = 8 * self.mesh.shape["batch"]
+        while b < n:
+            b *= 2
+        return b

@@ -27,10 +27,13 @@ each connector speaks the wire protocol directly over a TCP socket:
 With that, every datastore the reference bundles a driver for is
 covered by a built-in wire client.
 
-Pools are deliberately tiny: one socket per pool guarded by a lock
-(hooks run on executor threads), reconnect-on-error. The reference's
-poolboy concurrency can be layered later; correctness and the script
-API shape come first.
+Each `*Pool` name above is a single lazily-connecting client (socket +
+lock, reconnect-on-error); the registry wraps every one in a
+:class:`ClientPool` of ``size`` independently-connected clients (the
+poolboy seat, default 5 — ``ensure_pool{size=...}``), so concurrent
+auth hooks run against distinct sockets instead of serialising on one
+connection. See test_lua.py::test_client_pool_concurrent_checkout and
+test_lua_auth_hooks_overlap for the proof.
 """
 
 from __future__ import annotations

@@ -59,14 +59,36 @@ def _topic_str(words) -> str:
 
 
 class LuaScript:
-    """One loaded Lua script state (mirrors ``scripting.Script``)."""
+    """One loaded Lua script (mirrors ``scripting.Script``) backed by a
+    POOL of interpreter states.
 
-    def __init__(self, path: str, plugin) -> None:
+    The reference runs ``num_states`` luerl states per script behind a
+    balancing supervisor (``vmq_diversity_script_sup_sup.erl``) because
+    auth hooks block on datastores; one shared state would serialise
+    every concurrent hook (and the interpreter's step/depth accounting
+    is per-state). Same here: each state executes the script once (pool
+    declarations are idempotent by pool_id) and hook calls check a free
+    state out, run, and return it. ``self.runtime`` stays the first
+    state for introspection (script reload marker checks etc.); the
+    per-script ``kv`` store and the ACL cache are plugin-level objects
+    shared across states, like the reference's ets tables."""
+
+    def __init__(self, path: str, plugin, num_states: Optional[int] = None) -> None:
+        import queue
+
         self.path = path
         self.plugin = plugin
         self.kv: Dict[str, Dict[Any, Any]] = {}
         self.hooks: Dict[str, Callable] = {}
         self.runtime: Optional[LuaRuntime] = None
+        if num_states is None:
+            cfg = getattr(plugin.broker, "config", None)
+            try:
+                num_states = int(cfg.get("diversity_num_states", 4))
+            except (TypeError, ValueError, AttributeError):
+                num_states = 4
+        self.num_states = max(1, int(num_states))
+        self._free: "queue.Queue" = queue.Queue()
         self.load()
 
     # ------------------------------------------------------------- loading
@@ -89,18 +111,28 @@ class LuaScript:
         return None
 
     def load(self) -> None:
-        rt = LuaRuntime(chunk_loader=self._chunk_loader)
-        self._install_modules(rt)
+        import queue
+
         with open(self.path) as f:
             src = f.read()
-        rt.execute(src, os.path.basename(self.path))
-        self.runtime = rt
-        self.hooks = self._collect_hooks(rt)
+        states = []
+        for _ in range(self.num_states):
+            rt = LuaRuntime(chunk_loader=self._chunk_loader)
+            self._install_modules(rt)
+            rt.execute(src, os.path.basename(self.path))
+            states.append((rt, self._collect_raw(rt)))
+        self.runtime = states[0][0]
+        self._free = queue.Queue()
+        for s in states:
+            self._free.put(s)
+        self.hooks = {name: self._make_hook(name)
+                      for name in states[0][1]}
 
-    def _collect_hooks(self, rt: LuaRuntime) -> Dict[str, Callable]:
+    def _collect_raw(self, rt: LuaRuntime) -> Dict[str, Any]:
         """The ``hooks = {...}`` global names what registers (the
         reference contract); scripts without it fall back to global
-        functions named after hooks."""
+        functions named after hooks. Returns this STATE's lua function
+        objects — each pooled state has its own."""
         found: Dict[str, Any] = {}
         hooks_tbl = rt.get_global("hooks")
         if isinstance(hooks_tbl, LuaTable):
@@ -113,18 +145,26 @@ class LuaScript:
                 fn = rt.get_global(name)
                 if callable(fn):
                     found[name] = fn
-        return {name: self._make_hook(name, fn)
-                for name, fn in found.items()}
+        return found
 
     # -------------------------------------------------- hook arg conversion
 
-    def _make_hook(self, name: str, lua_fn) -> Callable:
-        rt = self.runtime
-
+    def _make_hook(self, name: str) -> Callable:
         def hook(*args):
             lua_args = _convert_args(name, args)
+            # check a state out of the pool (balancing-supervisor seat):
+            # blocks when every state is busy — bounded by the executor's
+            # worker count, so no timeout needed. Pin THIS generation's
+            # queue: a reload mid-call rebinds self._free, and returning
+            # an old state into the new pool would serve stale script
+            # code forever — the old queue just gets collected instead.
+            free = self._free
+            rt, raw = free.get()
             try:
-                res = self.runtime.call(lua_fn, lua_args)
+                fn = raw.get(name)
+                if fn is None:  # hook absent in this generation (reload)
+                    return "next"
+                res = rt.call(fn, lua_args)
             except LuaError as e:
                 # exc_info surfaces the chained host-function traceback
                 # (LuaError.__cause__) when the fault is broker-side, not
@@ -132,6 +172,8 @@ class LuaScript:
                 log.error("lua script %s hook %s: %s", self.path, name,
                           e.value, exc_info=e.__cause__ is not None)
                 raise
+            finally:
+                free.put((rt, raw))
             return _convert_result(name, res)
 
         hook.__name__ = f"lua:{name}"

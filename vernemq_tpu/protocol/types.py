@@ -93,6 +93,42 @@ RC_MAX_CONNECT_TIME = 0xA0
 RC_SUBSCRIPTION_IDS_NOT_SUPPORTED = 0xA1
 RC_WILDCARD_SUBS_NOT_SUPPORTED = 0xA2
 
+# textual reason names for metric labels (the reference's rcn_to_str,
+# vmq_metrics.erl:727-729 — atom names of vmq_types_mqtt5.hrl). 0x00 is
+# context-dependent (success vs normal_disconnect); callers of
+# reason_name pick via the `zero` argument.
+_RC_NAMES = {
+    0x01: "granted_qos1", 0x02: "granted_qos2",
+    0x04: "disconnect_with_will_msg", 0x10: "no_matching_subscribers",
+    0x11: "no_subscription_existed", 0x18: "continue_authentication",
+    0x19: "reauthenticate", 0x80: "unspecified_error",
+    0x81: "malformed_packet", 0x82: "protocol_error",
+    0x83: "impl_specific_error", 0x84: "unsupported_protocol_version",
+    0x85: "client_identifier_not_valid", 0x86: "bad_username_or_password",
+    0x87: "not_authorized", 0x88: "server_unavailable",
+    0x89: "server_busy", 0x8A: "banned", 0x8B: "server_shutting_down",
+    0x8C: "bad_authentication_method", 0x8D: "keep_alive_timeout",
+    0x8E: "session_taken_over", 0x8F: "topic_filter_invalid",
+    0x90: "topic_name_invalid", 0x91: "packet_id_in_use",
+    0x92: "packet_id_not_found", 0x93: "receive_max_exceeded",
+    0x94: "topic_alias_invalid", 0x95: "packet_too_large",
+    0x96: "message_rate_too_high", 0x97: "quota_exceeded",
+    0x98: "administrative_action", 0x99: "payload_format_invalid",
+    0x9A: "retain_not_supported", 0x9B: "qos_not_supported",
+    0x9C: "use_another_server", 0x9D: "server_moved",
+    0x9E: "shared_subs_not_supported", 0x9F: "connection_rate_exceeded",
+    0xA0: "max_connect_time", 0xA1: "subscription_ids_not_supported",
+    0xA2: "wildcard_subs_not_supported",
+}
+
+
+def reason_name(rc: int, zero: str = "success") -> str:
+    """Label string for a v5 reason code (rcn_to_str analog)."""
+    if rc == 0:
+        return zero
+    return _RC_NAMES.get(rc, f"rc_0x{rc:02x}")
+
+
 # v5 properties: dict keyed by these names (reference uses #{p_<name> => V}
 # maps, vmq_parser_mqtt5.erl property section). ``user_property`` is a list of
 # (key, value) pairs; ``subscription_identifier`` a list of ints in PUBLISH.
