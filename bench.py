@@ -120,6 +120,36 @@ def zipf_topics(rng: random.Random, pools, n: int):
     return [(pick(l0), pick(l1), pick(l2)) for _ in range(n)]
 
 
+def host_trie_like_for_like(table, pools, seed: int, n_probe: int = 5000):
+    """Single-core host-trie numbers on the SAME corpus and probe
+    distribution as the device run (VERDICT r4 item 2: the device must
+    beat THIS, like-for-like — vmq_reg_trie_bench_SUITE.erl:97-214 is
+    the reference-side analog). Separate rng so the device run's
+    topic stream is untouched."""
+    from vernemq_tpu.models.trie import SubscriptionTrie
+
+    rng = random.Random(seed)
+    trie = SubscriptionTrie()
+    t0 = time.perf_counter()
+    for e in table.entries:
+        if e is not None:
+            trie.add(list(e[0]), e[1], e[2])
+    build_s = time.perf_counter() - t0
+    probes = [list(t) for t in zipf_topics(rng, pools, n_probe)]
+    # warm one pass (branch caches, interned strings)
+    for t in probes[:200]:
+        trie.match(t)
+    t0 = time.perf_counter()
+    total = 0
+    for t in probes:
+        total += len(trie.match(t))
+    dt = time.perf_counter() - t0
+    return {"trie_pubs_per_sec": round(n_probe / dt),
+            "trie_matches_per_sec": round(total / dt),
+            "trie_avg_fanout": round(total / n_probe, 2),
+            "trie_build_s": round(build_s, 1)}
+
+
 # ----------------------------------------------------- device-path driver
 
 class WindowedBench:
@@ -480,6 +510,12 @@ def main() -> int:
                                 min(args.batch, 2048), args.max_fanout,
                                 variant=args.variant)
             r2 = wb2.run(max(8, args.iters // 2), measure_resolve=False)
+            try:
+                r2.update(host_trie_like_for_like(t2, (l0, l1, l2),
+                                                  args.seed + 101))
+            except Exception as e:
+                note(f"[bench] cfg2 trie baseline failed: "
+                     f"{type(e).__name__}: {e}")
             return {k: round(v, 3) if isinstance(v, float) else v
                     for k, v in r2.items() if v is not None}
 
@@ -502,6 +538,11 @@ def main() -> int:
         note(f"[bench] upload {wb.upload_s:.1f}s; running config 3...")
         headline = wb.run(args.iters)
         headline["build_s"] = round(build_s, 2)
+        try:
+            headline.update(host_trie_like_for_like(table, pools,
+                                                    args.seed + 103))
+        except Exception as e:
+            note(f"[bench] trie baseline failed: {type(e).__name__}: {e}")
         if args.variant == "packed" and (args.kernel_only
                                          or platform != "cpu"):
             # device-resident kernel throughput: what the chip sustains
@@ -588,6 +629,13 @@ def main() -> int:
             else:
                 raise RuntimeError("probe sub never became matchable")
             s2m.append(time.perf_counter() - t1)
+        trie5 = {}
+        try:
+            trie5 = host_trie_like_for_like(t5, pools5, args.seed + 105,
+                                            n_probe=3000)
+        except Exception as e:
+            note(f"[bench] cfg5 trie baseline failed: "
+                 f"{type(e).__name__}: {e}")
         return {
             "subs": n5,
             "matches_per_sec": round(r5["matches_per_sec"]),
@@ -595,6 +643,7 @@ def main() -> int:
             "batch_ms": round(r5["batch_ms"], 3),
             "build_s": round(build5, 2),
             "upload_s": r5["upload_s"],
+            **trie5,
             "delta_apply_ms_p50": round(1e3 * float(np.percentile(lat, 50)), 3),
             "delta_apply_ms_p99": round(1e3 * float(np.percentile(lat, 99)), 3),
             "delta_apply_ms_pipelined": round(pipelined_ms, 3),

@@ -83,6 +83,34 @@ async def test_cross_worker_delivery(group):
 
 
 @pytest.mark.asyncio
+async def test_worker_direct_ports():
+    """Per-worker direct ports (direct_base+idx) address ONE worker —
+    the seam tools/worker_efficiency.py uses to pin placement — and a
+    cross-worker publish through two pinned clients delivers."""
+    port = _free_port()
+    g = WorkerGroup(2, "127.0.0.1", port, cluster_base=26500,
+                    direct_base=26510, allow_anonymous=True,
+                    systree_enabled=False)
+    g.start()
+    try:
+        assert _wait_ready(26510) and _wait_ready(26511)
+        time.sleep(1.0)  # mesh formation
+        sub = MQTTClient("127.0.0.1", 26510, "dp-sub")  # worker 0
+        await sub.connect()
+        await sub.subscribe("dp/#", qos=1)
+        await asyncio.sleep(0.8)  # replication to worker 1
+        pub = MQTTClient("127.0.0.1", 26511, "dp-pub")  # worker 1
+        await pub.connect()
+        await pub.publish("dp/t", b"pinned", qos=1)
+        f = await sub.recv(5.0)
+        assert f is not None and f.payload == b"pinned"
+        await sub.disconnect()
+        await pub.disconnect()
+    finally:
+        g.stop()
+
+
+@pytest.mark.asyncio
 async def test_worker_restart_supervision(group):
     """A killed worker is relaunched by poll_restart and the port stays
     serviceable throughout (the surviving worker keeps accepting)."""

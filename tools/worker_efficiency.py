@@ -1,0 +1,248 @@
+"""Cross-worker fanout efficiency measurement (VERDICT r4 item 6).
+
+Pins subscribers and publishers to SPECIFIC workers via the per-worker
+direct ports (WorkerGroup(direct_base=...)) and measures deliveries/s
+for three placements:
+
+  local1  — 1 worker, subs+pubs on it (baseline T1)
+  local2  — 2 workers, subs+pubs both pinned to worker0 (group overhead
+            without the hop; worker1 idle)
+  cross2  — 2 workers, subs on worker0, pubs on worker1 (100% of
+            deliveries take the cross-worker hop)
+
+plus a microbenchmark of the hop's ingredients (cluster codec encode /
+decode of a representative publish frame, loopback TCP round trip).
+
+The cores→throughput model these numbers validate (README workers
+section): with per-delivery local CPU cost L and hop cost H, a k-core
+k-worker deployment with cross fraction f (uniform placement: (k-1)/k)
+delivers per-worker efficiency e = L / (L + f*H) and total throughput
+k * e * T1. On THIS 1-core container all processes share one core, so
+cross2/local1 directly measures L/(L+H) — the hop-cost ratio c = H/L
+falls out of it and must agree with the codec+RTT microbenchmark.
+
+Usage: python tools/worker_efficiency.py [--secs 20] [--subs 16]
+            [--pubs 4] [--qos 1] [--window 32] [--json out.json]
+"""
+import argparse
+import json
+import multiprocessing as mp
+import os
+import socket
+import struct
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from tools.loadtest import _client_proc  # noqa: E402
+
+
+def run_scenario(n_workers: int, sub_worker: int, pub_worker: int,
+                 secs: float, n_subs: int, n_pubs: int, qos: int,
+                 window: int) -> dict:
+    from vernemq_tpu.broker.workers import WorkerGroup
+
+    direct_base = 24300
+    group = WorkerGroup(n_workers, port=24290, cluster_base=24270,
+                        direct_base=direct_base, allow_anonymous=True,
+                        systree_enabled=False)
+    group.start()
+    # poll the direct ports: spawn workers can take 5-10s to boot (full
+    # package import per process) — a fixed sleep aborts slow boots
+    deadline = time.time() + 60
+    for w in range(n_workers):
+        while time.time() < deadline:
+            try:
+                socket.create_connection(
+                    ("127.0.0.1", direct_base + w), 0.5).close()
+                break
+            except OSError:
+                time.sleep(0.25)
+        else:
+            group.stop()
+            raise SystemExit(f"worker {w} never came up")
+    time.sleep(1.0)  # mesh formation after the last listener is up
+    try:
+        ctx = mp.get_context("spawn")
+        out_q = ctx.Queue()
+        sub_port = direct_base + sub_worker
+        pub_port = direct_base + pub_worker
+        # subscribers in one shard process, publishers in another, so
+        # the harness is not the GIL bottleneck it measures around
+        ps = ctx.Process(target=_client_proc, args=(
+            "127.0.0.1", sub_port, list(range(n_subs)), [], secs + 2.0,
+            qos, window, 64, False, "s", out_q))
+        pp = ctx.Process(target=_client_proc, args=(
+            "127.0.0.1", pub_port, [], list(range(n_pubs)), secs,
+            qos, window, 64, False, "p", out_q))
+        ps.start()
+        time.sleep(1.0)  # subscriptions in place (and replicated)
+        pp.start()
+        try:
+            res = [out_q.get(timeout=secs + 120) for _ in range(2)]
+        except Exception:
+            # a shard crashed before reporting (mesh not up, connect
+            # refused): kill the survivor so the tool exits instead of
+            # hanging on a non-daemon child
+            for p in (ps, pp):
+                if p.is_alive():
+                    p.terminate()
+            raise SystemExit("client shard died before reporting — "
+                             "rerun (mesh may not have formed in time)")
+        ps.join(30)
+        pp.join(30)
+        sent = sum(r[0] for r in res)
+        failed = sum(r[1] for r in res)
+        received = sum(r[2] for r in res)
+        # rate over the PUBLISHING window only: the sub shard runs
+        # secs+2.0 to drain, and dividing by its padded elapsed would
+        # understate every rate by the padding share
+        pub_elapsed = next((r[3] for r in res if r[0] > 0),
+                           max(r[3] for r in res))
+        return {"deliveries_per_s": received / pub_elapsed,
+                "acked_pubs_per_s": (sent - failed) / pub_elapsed,
+                "received": received, "sent": sent, "failed": failed,
+                "elapsed_s": pub_elapsed}
+    finally:
+        group.stop()
+
+
+def micro_hop() -> dict:
+    """Per-message cost of the cross-worker hop's ingredients."""
+    from vernemq_tpu.broker.message import Msg
+    from vernemq_tpu.cluster.codec import decode, encode
+    from vernemq_tpu.cluster.node import frame, msg_to_term, term_to_msg
+
+    msg = Msg(topic=("lt", "3", "mX0"), payload=b"x" * 64, qos=1,
+              retain=False, mountpoint="", msg_ref=b"r" * 16,
+              properties={})
+    N = 20_000
+    t0 = time.perf_counter()
+    for _ in range(N):
+        frame(b"msg", msg_to_term(msg))
+    enc_us = (time.perf_counter() - t0) / N * 1e6
+    wire = encode(msg_to_term(msg))
+    t0 = time.perf_counter()
+    for _ in range(N):
+        term_to_msg(decode(wire))
+    dec_us = (time.perf_counter() - t0) / N * 1e6
+
+    # loopback TCP round trip (64B echo), amortised over a pipeline of 1
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    import threading
+
+    def echo():
+        conn, _ = srv.accept()
+        while True:
+            b = conn.recv(4096)
+            if not b:
+                return
+            conn.sendall(b)
+
+    threading.Thread(target=echo, daemon=True).start()
+    c = socket.create_connection(srv.getsockname())
+    c.sendall(b"w" * 64)
+    c.recv(4096)  # warm
+    N2 = 2_000
+    t0 = time.perf_counter()
+    for _ in range(N2):
+        c.sendall(b"w" * 64)
+        c.recv(4096)
+    rtt_us = (time.perf_counter() - t0) / N2 * 1e6
+    c.close()
+    srv.close()
+    return {"encode_us": enc_us, "decode_us": dec_us,
+            "loopback_rtt_us": rtt_us}
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--secs", type=float, default=20.0)
+    ap.add_argument("--subs", type=int, default=16)
+    ap.add_argument("--pubs", type=int, default=4)
+    ap.add_argument("--qos", type=int, default=1)
+    ap.add_argument("--window", type=int, default=32)
+    ap.add_argument("--trials", type=int, default=1,
+                    help="interleaved rounds (each runs all scenarios "
+                         "back to back); MEDIANS are reported — "
+                         "absolute throughput drifts over minutes, so "
+                         "only within-round ratios are comparable")
+    ap.add_argument("--json", default=None)
+    args = ap.parse_args()
+
+    print("micro: hop ingredient costs ...", flush=True)
+    micro = micro_hop()
+    print(f"  cluster-codec encode {micro['encode_us']:.1f}us  "
+          f"decode {micro['decode_us']:.1f}us  "
+          f"loopback RTT {micro['loopback_rtt_us']:.1f}us", flush=True)
+
+    # INTERLEAVED rounds: this box's absolute throughput drifts ±30%
+    # over minutes, so cross-scenario ratios are only meaningful within
+    # one round (scenarios back to back). The hop-cost ratio is the
+    # median of per-round local/cross ratios; absolute columns report
+    # per-scenario medians.
+    layout = {"local1": (1, 0, 0), "local2": (2, 0, 0),
+              "cross2": (2, 0, 1)}
+    runs: dict = {n: [] for n in layout}
+    round_c = []
+    round_g = []
+    for t in range(args.trials):
+        for name, (nw, sw, pw) in layout.items():
+            r = run_scenario(nw, sw, pw, args.secs, args.subs,
+                             args.pubs, args.qos, args.window)
+            runs[name].append(r)
+            print(f"round {t} {name}: deliveries/s="
+                  f"{r['deliveries_per_s']:.0f}", flush=True)
+        t1r = runs["local1"][-1]["deliveries_per_s"]
+        t2lr = runs["local2"][-1]["deliveries_per_s"]
+        t2xr = runs["cross2"][-1]["deliveries_per_s"]
+        if min(t1r, t2lr, t2xr) <= 0:
+            print(f"round {t}: a scenario delivered nothing — round "
+                  f"excluded from the ratio medians", flush=True)
+            continue
+        round_c.append(t1r / t2xr - 1.0)
+        round_g.append(t1r / t2lr - 1.0)
+        print(f"round {t}: c={round_c[-1]:.3f} group={round_g[-1]:.3f}",
+              flush=True)
+
+    def med(vals):
+        s = sorted(vals)
+        return s[len(s) // 2]
+
+    scenarios = {}
+    for name, rs in runs.items():
+        m = med([r["deliveries_per_s"] for r in rs])
+        scenarios[name] = {
+            "deliveries_per_s_median": round(m),
+            "rounds": [round(r["deliveries_per_s"]) for r in rs],
+        }
+    if not round_c:
+        print(json.dumps({"error": "no complete round", "runs": {
+            k: [round(r["deliveries_per_s"]) for r in v]
+            for k, v in runs.items()}}))
+        raise SystemExit(1)
+    # 1-core identity: cross2/local1 = L/(L+H)  =>  c = H/L
+    c = med(round_c)
+    model = {
+        "hop_cost_ratio_c": c,
+        "hop_cost_ratio_rounds": [round(x, 3) for x in round_c],
+        "group_overhead_ratio": med(round_g),
+        # k-core uniform placement: e(k) = 1 / (1 + c*(k-1)/k)
+        "per_worker_efficiency": {
+            str(k): 1.0 / (1.0 + c * (k - 1) / k) for k in (2, 4, 8)
+        },
+    }
+    out = {"micro": micro, "scenarios": scenarios, "model": model,
+           "config": vars(args), "nproc": 1}
+    print(json.dumps(out))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,7 +41,8 @@ DEFAULT_CLUSTER_BASE = 24100
 
 def _run_worker(idx: int, n_workers: int, host: str, port: int,
                 cluster_base: int, overrides: Dict[str, Any],
-                conf_path: Optional[str]) -> None:
+                conf_path: Optional[str],
+                direct_base: Optional[int] = None) -> None:
     """Worker-process entry point (spawn-safe, top-level)."""
     import asyncio
 
@@ -91,6 +92,18 @@ def _run_worker(idx: int, n_workers: int, host: str, port: int,
             cluster_listen=("127.0.0.1", cluster_base + idx),
             join=("127.0.0.1", cluster_base) if idx > 0 else None,
             reuse_port=True)
+        if direct_base:
+            # per-worker direct MQTT port (base + idx): lets operators
+            # and the efficiency harness address ONE worker instead of
+            # taking the kernel's SO_REUSEPORT pick — the analog of
+            # dialing a specific node of a cluster. Through the
+            # ListenerManager so it shows in `listener show` and stops
+            # with the broker like every other listener.
+            from .listeners import ListenerManager
+
+            lm = broker.listeners or ListenerManager(broker)
+            await lm.start_listener("mqtt", "127.0.0.1",
+                                    direct_base + idx)
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGTERM, signal.SIGINT):
@@ -109,6 +122,7 @@ class WorkerGroup:
                  port: int = 1883,
                  cluster_base: int = DEFAULT_CLUSTER_BASE,
                  conf_path: Optional[str] = None,
+                 direct_base: Optional[int] = None,
                  **config_overrides: Any):
         if n_workers < 1:
             raise ValueError("need at least one worker")
@@ -117,6 +131,7 @@ class WorkerGroup:
         self.port = port
         self.cluster_base = cluster_base
         self.conf_path = conf_path
+        self.direct_base = direct_base
         self.overrides = config_overrides
         self._ctx = mp.get_context("spawn")
         self._procs: List[Any] = []
@@ -126,7 +141,8 @@ class WorkerGroup:
         p = self._ctx.Process(
             target=_run_worker,
             args=(idx, self.n_workers, self.host, self.port,
-                  self.cluster_base, self.overrides, self.conf_path),
+                  self.cluster_base, self.overrides, self.conf_path,
+                  self.direct_base),
             name=f"vmq-worker{idx}", daemon=True)
         p.start()
         return p
@@ -177,6 +193,9 @@ def main(argv: Optional[List[str]] = None) -> None:  # pragma: no cover
     ap.add_argument("--port", type=int, default=1883)
     ap.add_argument("--cluster-base", type=int,
                     default=DEFAULT_CLUSTER_BASE)
+    ap.add_argument("--direct-base", type=int, default=None,
+                    help="also open a per-worker MQTT port at "
+                         "direct_base+idx (address ONE worker)")
     ap.add_argument("--conf", default=None)
     ap.add_argument("--allow-anonymous", action="store_true")
     args = ap.parse_args(argv)
@@ -185,7 +204,8 @@ def main(argv: Optional[List[str]] = None) -> None:  # pragma: no cover
         overrides["allow_anonymous"] = True
     group = WorkerGroup(args.workers, args.host, args.port,
                         cluster_base=args.cluster_base,
-                        conf_path=args.conf, **overrides)
+                        conf_path=args.conf,
+                        direct_base=args.direct_base, **overrides)
     group.start()
     print(f"started {args.workers} workers on {args.host}:{args.port}",
           file=sys.stderr, flush=True)
