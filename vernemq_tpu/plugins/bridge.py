@@ -5,8 +5,9 @@ Plays the role of ``vmq_bridge`` (``apps/vmq_bridge/src/vmq_bridge.erl``):
 per-bridge topic rules ``(pattern, direction in|out|both, qos,
 local_prefix, remote_prefix)`` with prefix rewriting
 (``vmq_bridge.erl:143-170,178-224``), a reconnecting MQTT client
-(``gen_mqtt_client`` role played by ``vernemq_tpu.client.MQTTClient``) with
-restart backoff (``restart_timeout``), and registration on the local broker
+(``vernemq_tpu.client.ReconnectingClient`` — the ``gen_mqtt_client``
+behaviour surface) with restart backoff (``restart_timeout``), and
+registration on the local broker
 through the plugin-subscriber seam — the reference acquires local
 publish/subscribe functions via ``vmq_reg:direct_plugin_exports``
 (``vmq_bridge_sup`` RegistryMFA); here the bridge owns a plugin queue on
@@ -90,16 +91,13 @@ class Bridge:
         self.proto_ver = proto_ver
         self.ssl_context = ssl_context
         self.sid = ("", self.client_id)
-        self._client = None
-        self._task: Optional[asyncio.Task] = None
+        self._rc = None  # ReconnectingClient (the gen_mqtt_client seat)
         self._pump: Optional[asyncio.Task] = None
-        self._connected = asyncio.Event()
         self._out: deque = deque()
         self._max_out = max_outgoing_buffered
         self._out_wakeup = asyncio.Event()
         self._imported: "OrderedDict[bytes, None]" = OrderedDict()
         self.out_dropped = 0
-        self.connected_since: Optional[float] = None
 
     # ---------------------------------------------------------------- local
 
@@ -139,73 +137,52 @@ class Bridge:
     # --------------------------------------------------------------- remote
 
     def start(self) -> None:
+        """Link through :class:`~vernemq_tpu.client.ReconnectingClient` —
+        the gen_mqtt_client behaviour surface (connect/backoff/
+        resubscribe/keepalive) the reference's bridge rides on
+        (vmq_bridge.erl:123-137 init_client + reconnect_timeout)."""
+        from ..client import ReconnectingClient
+
         loop = asyncio.get_event_loop()
-        self._task = loop.create_task(self._run())
+        self._rc = ReconnectingClient(
+            self.host, self.port,
+            reconnect_timeout=self.restart_timeout,
+            subscriptions={"/".join(r.pattern): SubOpts(qos=r.qos)
+                           for r in self.rules if r.inbound},
+            on_connect=self._on_link_up,
+            on_disconnect=self._on_link_down,
+            on_connect_error=lambda rc: self._on_link_down(
+                ConnectionError(f"remote CONNACK rc={rc}")),
+            on_publish=self._import_remote,
+            client_id=self.client_id, proto_ver=self.proto_ver,
+            clean_start=self.cleansession, username=self.username,
+            password=self.password, keepalive=self.keepalive,
+            ssl_context=self.ssl_context)
+        self._rc.start()
         self._pump = loop.create_task(self._pump_out())
 
     async def stop(self) -> None:
-        tasks = [t for t in (self._task, self._pump) if t is not None]
-        for t in tasks:
-            t.cancel()
-        for t in tasks:
+        if self._pump is not None:
+            self._pump.cancel()
             try:
-                await t
+                await self._pump
             except (asyncio.CancelledError, Exception):
                 pass
-        if self._client is not None:
-            try:
-                await self._client.close()
-            except Exception:
-                pass
+        if self._rc is not None:
+            await self._rc.stop()
         self.detach_local()
 
-    async def _run(self) -> None:
-        """Connect-subscribe-consume loop with restart backoff
-        (init_client + reconnect_timeout, vmq_bridge.erl:123-137,260)."""
-        from ..client import MQTTClient
+    # --------------------------------------------------------- link events
 
-        while True:
-            client = MQTTClient(
-                self.host, self.port, client_id=self.client_id,
-                proto_ver=self.proto_ver, clean_start=self.cleansession,
-                username=self.username, password=self.password,
-                keepalive=self.keepalive, ssl_context=self.ssl_context)
-            try:
-                ack = await client.connect()
-                if getattr(ack, "rc", 1) != 0:
-                    raise ConnectionError(f"remote CONNACK rc={ack.rc}")
-                self._client = client
-                self.connected_since = asyncio.get_event_loop().time()
-                in_topics = ["/".join(r.pattern)
-                             for r in self.rules if r.inbound]
-                for r in self.rules:
-                    if r.inbound:
-                        await client.subscribe("/".join(r.pattern), qos=r.qos)
-                if in_topics:
-                    log.info("bridge %s subscribed remotely to %s",
-                             self.name, in_topics)
-                self._connected.set()
-                self.broker.metrics.incr("bridge_connected")
-                while True:
-                    frame = await client.messages.get()
-                    if frame is None:
-                        raise ConnectionError("remote channel closed")
-                    if frame.__class__.__name__ != "Publish":
-                        continue
-                    self._import_remote(frame)
-            except asyncio.CancelledError:
-                raise
-            except Exception as e:
-                log.info("bridge %s link down: %s", self.name, e)
-            finally:
-                self._connected.clear()
-                self.connected_since = None
-                self._client = None
-                try:
-                    await client.close()
-                except Exception:
-                    pass
-            await asyncio.sleep(self.restart_timeout)
+    def _on_link_up(self, session_present: bool) -> None:
+        self.broker.metrics.incr("bridge_connected")
+        in_topics = ["/".join(r.pattern) for r in self.rules if r.inbound]
+        if in_topics:
+            log.info("bridge %s subscribed remotely to %s",
+                     self.name, in_topics)
+
+    def _on_link_down(self, exc: BaseException) -> None:
+        log.info("bridge %s link down: %s", self.name, exc)
 
     def _import_remote(self, frame) -> None:
         """Remote publish → local publish with the local prefix
@@ -233,8 +210,8 @@ class Bridge:
             if not self._out:
                 self._out_wakeup.clear()
                 await self._out_wakeup.wait()
-            await self._connected.wait()
-            client = self._client
+            await self._rc.connected.wait()
+            client = self._rc.client if self._rc is not None else None
             if client is None:
                 continue
             rule, msg = self._out.popleft()
@@ -260,7 +237,8 @@ class Bridge:
         return {
             "name": self.name,
             "endpoint": f"{self.host}:{self.port}",
-            "connected": self._connected.is_set(),
+            "connected": (self._rc is not None
+                          and self._rc.connected.is_set()),
             "buffered_out": len(self._out),
             "dropped_out": self.out_dropped,
             "rules": [f"{'/'.join(r.pattern)} {r.direction} {r.qos}"
