@@ -687,6 +687,11 @@ def main() -> int:
         result["note"] = (
             "CPU smoke run (accelerator unreachable or forced): "
             "reduced scale, not comparable to TPU numbers")
+    # analytical chip ceiling at the headline geometry (ROOFLINE.md /
+    # tools/roofline.py): travels with every artifact so a fallback run
+    # still records what the formulation supports
+    result["roofline"] = ("chip ceiling 35M-327M matches/s @1M subs "
+                          "B=4096 (647MB+146GFLOP/batch; ROOFLINE.md)")
     if headline is not None:
         result.update({
             "publishes_per_sec": round(headline["publishes_per_sec"]),

@@ -1,0 +1,141 @@
+"""Churn soak for the multi-device seat (ShardedTpuMatcher): sustained
+subscribe/unsubscribe + batched matching on the virtual CPU mesh, with
+continuous host-trie parity checks — the BASELINE config-5 delta-stream
+discipline applied to the seat the broker serves through when
+``tpu_mesh`` is set.
+
+Usage: python tools/seat_churn.py [--secs 240] [--subs 20000]
+           [--mesh 2x4] [--batch 64] [--churn 50]
+Prints one JSON line: rounds, publishes matched, parity failures (must
+be 0), match latency percentiles (round 0 reported separately as
+compile_ms — it is the XLA compile + full device build) and
+RebuildInProgress sheds (the seat runs with the production
+async_rebuild posture).
+"""
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--secs", type=float, default=240.0)
+    ap.add_argument("--subs", type=int, default=20_000)
+    ap.add_argument("--mesh", default="2x4")
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--churn", type=int, default=50,
+                    help="adds+removes per round")
+    ap.add_argument("--seed", type=int, default=7)
+    args = ap.parse_args()
+
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = "--xla_force_host_platform_device_count=8"
+    if "xla_force_host_platform_device_count" in flags:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
+                       want, flags)
+    else:
+        flags = (flags + " " + want).strip()
+    os.environ["XLA_FLAGS"] = flags
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from vernemq_tpu.models.trie import SubscriptionTrie
+    from vernemq_tpu.parallel.mesh import make_mesh
+    from vernemq_tpu.parallel.sharded_match import ShardedTpuMatcher
+
+    b, s = (int(x) for x in args.mesh.split("x"))
+    mesh = make_mesh(jax.devices()[:b * s], batch=b)
+    seat = ShardedTpuMatcher(mesh, max_levels=8, max_fanout=128)
+    seat.async_rebuild = True  # production posture: growth sheds, not stalls
+    trie = SubscriptionTrie()
+    rng = random.Random(args.seed)
+    l0 = [f"r{i}" for i in range(32)]
+    l1 = [f"d{i}" for i in range(64)]
+    l2 = [f"m{i}" for i in range(16)]
+
+    def rand_filter():
+        r = rng.random()
+        w = [rng.choice(l0), rng.choice(l1), rng.choice(l2)]
+        if r < 0.6:
+            return w
+        if r < 0.8:
+            return [w[0], "+", w[2]]
+        if r < 0.9:
+            return ["+", w[1], w[2]]
+        return [w[0], w[1], "#"]
+
+    live = {}
+    with seat.lock:
+        for i in range(args.subs):
+            f = rand_filter()
+            seat.table.add(list(f), i, None)
+            trie.add(list(f), i, None)
+            live[i] = f
+    next_key = args.subs
+
+    from vernemq_tpu.models.tpu_matcher import RebuildInProgress
+
+    t_end = time.time() + args.secs
+    rounds = pubs = fails = sheds = 0
+    match_ms = []
+    compile_ms = []
+    while time.time() < t_end:
+        # churn: add + remove args.churn subscriptions
+        with seat.lock:
+            for _ in range(args.churn):
+                f = rand_filter()
+                seat.table.add(list(f), next_key, None)
+                trie.add(list(f), next_key, None)
+                live[next_key] = f
+                next_key += 1
+            for k in rng.sample(sorted(live), args.churn):
+                f = live.pop(k)
+                seat.table.remove(list(f), k)
+                trie.remove(list(f), k)
+        topics = [(rng.choice(l0), rng.choice(l1), rng.choice(l2))
+                  for _ in range(args.batch)]
+        t0 = time.perf_counter()
+        try:
+            res = seat.match_batch(topics)  # sync() applies the delta
+        except RebuildInProgress:
+            # production shed: the trie would serve; here we just wait
+            # for the background install and count the shed
+            sheds += 1
+            time.sleep(0.2)
+            continue
+        dt = time.perf_counter() - t0
+        (compile_ms if rounds == 0 else match_ms).append(dt * 1e3)
+        for t, rows in zip(topics, res):
+            got = sorted(k for _, k, _ in rows)
+            want = sorted(k for _, k, _ in trie.match(list(t)))
+            if got != want:
+                fails += 1
+        pubs += len(topics)
+        rounds += 1
+    out = {
+        "rounds": rounds, "publishes": pubs, "parity_failures": fails,
+        "resident_subs": len(live), "churn_per_round": 2 * args.churn,
+        "match_ms_p50": round(float(np.percentile(match_ms, 50)), 1)
+        if match_ms else None,
+        "match_ms_p99": round(float(np.percentile(match_ms, 99)), 1)
+        if match_ms else None,
+        "compile_ms": round(compile_ms[0], 1) if compile_ms else None,
+        "mesh": args.mesh, "host_fallback_pubs": seat.host_fallbacks,
+        "rebuild_sheds": sheds, "async_rebuilds": seat.rebuilds_async,
+    }
+    print(json.dumps(out))
+    sys.exit(1 if fails else 0)
+
+
+if __name__ == "__main__":
+    main()
