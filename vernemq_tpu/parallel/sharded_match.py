@@ -462,29 +462,48 @@ class ShardedWindowedMatcher:
         """Host-side prep of one batch against the CURRENT table/window
         state (callers needing consistency run this under their lock):
         encode, per-shard pub assignment, window tiles. Returns everything
-        :meth:`_dispatch` and result resolution need."""
+        :meth:`_dispatch` and result resolution need. (The seat encodes
+        through TpuMatcher's cached encoder and calls
+        :meth:`_prep_encoded` directly.)"""
         import numpy as np
 
         n = len(topics)
-        S, glob, nsub = self._S, self._glob, self.nsub
         nb = self.nb
-        Sl = S // nsub
         # batch padding: divisible by the batch axis and pow2-laddered
         Bpad = nb
         while Bpad < n:
             Bpad *= 2
         Bpad = max(Bpad, 8 * nb)
-        Bl = Bpad // nb  # local pub slice per batch row
         L = self.table.L
-        pw = np.full((Bpad, L), np.int32(-2), dtype=np.int32)
+        # pad rows use PAD_ID like the seat's cached encoder, so dryrun
+        # and production feed the kernel identical pad bytes (pads are
+        # masked by `real` either way)
+        from ..ops.match_kernel import PAD_ID
+
+        pw = np.full((Bpad, L), np.int32(PAD_ID), dtype=np.int32)
         pl = np.zeros(Bpad, dtype=np.int32)
         pd = np.zeros(Bpad, dtype=bool)
-        real = np.zeros(Bpad, dtype=bool)
-        real[:n] = True
         pb = np.zeros(n, dtype=np.int32)
         for i, topic in enumerate(topics):
             row, ln, dollar, bucket, _gb = self.table.encode_topic_ex(topic)
             pw[i], pl[i], pd[i], pb[i] = row, ln, dollar, bucket
+        return self._prep_encoded(pw, pl, pd, pb, n)
+
+    def _prep_encoded(self, pw, pl, pd, pb, n: int):
+        """Window/tile prep for an ALREADY-ENCODED padded batch (pw
+        [Bpad, L]; pb holds the n real publishes' buckets). Bpad must be
+        pow2-laddered and divisible by the 'batch' axis."""
+        import numpy as np
+
+        S, glob, nsub = self._S, self._glob, self.nsub
+        nb = self.nb
+        Sl = S // nsub
+        Bpad = pw.shape[0]
+        assert Bpad % nb == 0, \
+            f"Bpad {Bpad} not divisible by the batch axis {nb}"
+        Bl = Bpad // nb  # local pub slice per batch row
+        real = np.zeros(Bpad, dtype=bool)
+        real[:n] = True
         # per-shard pub assignment by bucket-row ownership (pads: -1)
         shard_of = np.full(Bpad, -1, dtype=np.int32)
         shard_of[:n] = np.minimum(self._reg_start[pb] // Sl, nsub - 1)
@@ -735,7 +754,10 @@ class ShardedTpuMatcher(TpuMatcher):
             self.sync()
             sw = self._swm
             snapshot = self._entries_snapshot
-            p = sw._prep(topics)  # consistent table view under the lock
+            # cached encoder (hot zipf topics skip per-word interning)
+            # + window prep, on a consistent table view under the lock
+            pw, pl, pd, pb, _gb = self._encode_batch_ex(topics)
+            p = sw._prep_encoded(pw, pl, pd, pb, len(topics))
             sig = ("sharded",) + p["geom"] + (p["glob"], p["S"])
             if require_warm and sig not in self._warm_sigs:
                 self.busy_sheds += 1
