@@ -4,6 +4,7 @@ updates, overflow/truncation fallbacks, and the broker wired to the tpu
 reg view end-to-end. Runs on the CPU backend (conftest forces 8 virtual
 devices)."""
 
+import asyncio
 import random
 
 import pytest
@@ -141,9 +142,19 @@ async def test_broker_e2e_with_tpu_reg_view(event_loop):
             await pub.publish(f"tpu/{i}/x", f"m{i}".encode(), qos=1)
         got = sorted([(await sub.recv()).payload for _ in range(5)])
         assert got == [f"m{i}".encode() for i in range(5)]
-        # matched via the device path (hybrid dispatch disabled above)
+        # matched via the device path (hybrid dispatch disabled above).
+        # Cold-shape/busy windows shed single publishes to the trie by
+        # design (a loaded box stretches those windows), so keep
+        # publishing until the device has served some — delivery
+        # correctness was already asserted above either way.
         view = b.registry.reg_view("tpu")
-        assert view.matcher("").match_publishes >= 5
+        m = view.matcher("")
+        for i in range(5, 60):
+            if m.match_publishes >= 5:
+                break
+            await pub.publish(f"tpu/{i % 9}/x", b"warm", qos=0)
+            await asyncio.sleep(0.05)
+        assert m.match_publishes >= 5, (m.match_publishes, m.busy_sheds)
         await sub.disconnect()
         await pub.disconnect()
     finally:
@@ -805,6 +816,41 @@ def test_packed_scan_totals_match_individual_calls():
     chk, tot = K.match_packed_scan(
         m._operands[0], m._operands[1], m._meta, stack, **geom, **statics)
     assert int(np.asarray(tot)) == want_tot
+
+
+def test_packed_stack_results_match_individual_calls():
+    """call_packed_stack (stacked transport: N batches per executable,
+    ONE result pull) returns byte-identical result vectors to N separate
+    packed calls — the tunnel-regime throughput mode loses nothing."""
+    import numpy as np
+
+    from vernemq_tpu.ops import match_kernel as K
+
+    rng = random.Random(37)
+    m = _bucketed_matcher(max_fanout=64)
+    for i in range(8000):
+        m.table.add(corpus_filter(rng), i, None)
+    with m.lock:
+        m.sync()
+    S = int(m._dev_arrays[0].shape[0])
+    preps, singles = [], []
+    statics = None
+    for b in range(3):
+        topics = [(f"r{rng.randrange(16)}", f"d{rng.randrange(40)}",
+                   f"m{rng.randrange(16)}") for _ in range(64)]
+        pw, pl, pd, pb, gb = m._encode_batch_ex(topics)
+        args, statics, left = m._flat_prep(
+            m._reg_start, m._reg_end, m._glob_pad, m._ops_bits, S,
+            pw, pl, pd, pb, gb, len(topics))
+        assert not left
+        preps.append(args)
+        singles.append(np.asarray(K.call_packed(
+            m._operands[0], m._operands[1], m._meta, args, statics)))
+    stacked = np.asarray(K.call_packed_stack(
+        m._operands[0], m._operands[1], m._meta, preps, statics))
+    assert stacked.shape == (3,) + singles[0].shape
+    for i, single in enumerate(singles):
+        np.testing.assert_array_equal(stacked[i], single)
 
 
 def test_packed_rows_variant_matches_flat_kernel():

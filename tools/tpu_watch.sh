@@ -57,6 +57,12 @@ EOF
       --tp 256 --b 8192 --fm 2 --fa 96 \
       > "$OUT/tune_packed_fa96.txt" 2>&1
     echo "tune_packed_fa96 rc=$?" >> "$OUT/log"
+    # stacked transport (r5): N batches per executable + ONE result
+    # pull — amortises the 2 per-dispatch RTTs (ROOFLINE.md predicts
+    # ~2x end-to-end through this tunnel)
+    timeout 1200 python bench.py --configs 3 --variant packed_stack \
+      --stack 8 > "$OUT/bench_stacked.json" 2> "$OUT/bench_stacked.err"
+    echo "bench_stacked rc=$?" >> "$OUT/log"
     touch "$OUT/DONE"
     exit 0
   fi

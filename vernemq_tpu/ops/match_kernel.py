@@ -33,6 +33,7 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 PAD_ID = 0
@@ -738,8 +739,6 @@ def flat_pack_args(args) -> "np.ndarray":
     latency *per argument*, so 12 small uploads cost far more than one
     medium one). Layout must mirror the unpacking in
     :func:`_unpack_transport` (the single device-side decoder)."""
-    import numpy as np
-
     (pw, pl, pd, n_real, t_sel, t_start, t2_sel, t2_start,
      a_tile, a_pos, b_tile, b_pos) = args
     return np.concatenate([
@@ -779,18 +778,23 @@ def apply_delta_meta_copy(meta, slots, el, hh, fw, ac):
     return meta.at[slots].set(_pack_meta_vals(el, hh, fw, ac))
 
 
+def _packed_geometry(args) -> dict:
+    """Static shape geometry of one packed batch, derived from the arg
+    shapes — the ONE place every call_* helper reads the contract."""
+    B, L = args[0].shape
+    T, TP = args[4].shape
+    return dict(B=B, L=L, T=T, TP=TP, T2=args[6].shape[0])
+
+
 def call_packed(F_t, t1, meta, args, statics):
     """The one call shape for the packed transport: derives the static
     geometry from the arg shapes, packs the host args, invokes the
     kernel. Production, bench and tests all go through here so the
     flat_pack_args layout and the kernel's shape contract cannot
     drift apart."""
-    B, L = args[0].shape
-    T, TP = args[4].shape
-    T2 = args[6].shape[0]
     return match_extract_windowed_flat_packed(
         F_t, t1, meta, flat_pack_args(args),
-        B=B, L=L, T=T, TP=TP, T2=T2, **statics)
+        **_packed_geometry(args), **statics)
 
 
 def unpack_flat_result(out, B: int, C: int):
@@ -842,14 +846,11 @@ def match_extract_windowed_rows_packed(
 def call_packed_rows(F_t, t1, meta, args, statics):
     """Rows-kernel analog of :func:`call_packed` (statics carry ``C``;
     converted to the per-pub cap ``kf`` the rows kernel takes)."""
-    B, L = args[0].shape
-    T, TP = args[4].shape
-    T2 = args[6].shape[0]
+    geom = _packed_geometry(args)
     st = dict(statics)
-    st["kf"] = st.pop("C") // B
+    st["kf"] = st.pop("C") // geom["B"]
     return match_extract_windowed_rows_packed(
-        F_t, t1, meta, flat_pack_args(args),
-        B=B, L=L, T=T, TP=TP, T2=T2, **st)
+        F_t, t1, meta, flat_pack_args(args), **geom, **st)
 
 
 @functools.partial(jax.jit,
@@ -951,6 +952,45 @@ def match_packed_scan(
     (chk, tot), _ = lax.scan(step, (jnp.int32(0), jnp.int32(0)),
                              packed_stack)
     return chk, tot
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("B", "L", "T", "TP", "T2", "id_bits",
+                                    "k", "glob_pad", "seg_max", "seg2_max",
+                                    "gc", "C"))
+def match_packed_scan_results(
+    F_t, t1, meta,
+    packed_stack,            # int32 [N, P] staged transport vectors
+    *,
+    B: int, L: int, T: int, TP: int, T2: int,
+    id_bits: int, k: int, glob_pad: int, seg_max: int, seg2_max: int,
+    gc: int, C: int,
+):
+    """Stacked transport: run N packed batches inside ONE executable and
+    return ALL their result vectors ``[N, C + 3B]`` for ONE host pull —
+    the production-honest sibling of :func:`match_packed_scan` (which
+    reduces to a checksum). On a latency-dominated link this amortises
+    the two per-dispatch round trips over N batches; the bytes moved are
+    the same as N separate packed calls, so it trades per-batch latency
+    (N windows' worth) for dispatch-overhead amortisation — the
+    throughput mode of the tunnel regime (ROOFLINE.md)."""
+    def step(_, p):
+        out = _packed_core(F_t, t1, meta, p, B=B, L=L, T=T, TP=TP, T2=T2,
+                           id_bits=id_bits, k=k, glob_pad=glob_pad,
+                           seg_max=seg_max, seg2_max=seg2_max, gc=gc, C=C)
+        return None, out
+
+    _, outs = lax.scan(step, None, packed_stack)
+    return outs
+
+
+def call_packed_stack(F_t, t1, meta, preps, statics):
+    """Stack the packed arg vectors of ``preps`` (each the trailing-args
+    tuple of one batch, same geometry) and run them as ONE executable.
+    Returns the ``[N, C + 3B]`` stacked result device array."""
+    vecs = np.stack([flat_pack_args(a) for a in preps])
+    return match_packed_scan_results(
+        F_t, t1, meta, vecs, **_packed_geometry(preps[0]), **statics)
 
 
 @functools.partial(jax.jit,
