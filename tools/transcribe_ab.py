@@ -26,6 +26,20 @@ KROW = re.compile(
 BEST = re.compile(r"BEST: (?P<tag>.+?) (?P<mps>[\d.]+)M matches/s")
 
 
+def load_last_json(path):
+    """Last JSON line of an artifact file (bench prints one JSON line;
+    stderr noise may precede it)."""
+    if not os.path.exists(path):
+        return None
+    try:
+        for line in reversed(open(path).read().splitlines()):
+            if line.startswith("{"):
+                return json.loads(line)
+    except (ValueError, OSError):
+        pass
+    return None
+
+
 def parse_sweep(path):
     if not os.path.exists(path):
         return None
@@ -61,16 +75,7 @@ def main():
         "packed_rows B=4096": parse_sweep(
             os.path.join(args.dir, "tune_packed_rows.txt")),
     }
-    bench_path = os.path.join(args.dir, "bench.json")
-    bench = None
-    if os.path.exists(bench_path):
-        try:
-            for line in reversed(open(bench_path).read().splitlines()):
-                if line.startswith("{"):
-                    bench = json.loads(line)
-                    break
-        except (ValueError, OSError):
-            pass
+    bench = load_last_json(os.path.join(args.dir, "bench.json"))
 
     print("### On-chip kernel A/B (1M subs, tools/tune_windowed.py)\n")
     print("| variant | best config | matches/s | batch ms "
@@ -103,7 +108,19 @@ def main():
                   f"(vs_baseline_kernel="
                   f"{bench.get('vs_baseline_kernel')}) — the chip's own "
                   f"ceiling with zero per-batch transport.")
-    if not any_rows and bench is None:
+    # stacked-transport point (r5: N batches/executable, ONE result pull)
+    stacked = load_last_json(os.path.join(args.dir, "bench_stacked.json"))
+    if stacked is not None:
+        c3 = stacked.get("configs", {}).get("3_mixed_1m_zipf", {})
+        if "n_stack" in c3:
+            print(f"stacked transport (--variant packed_stack, "
+                  f"N={c3['n_stack']}): "
+                  f"**{round(c3.get('matches_per_sec', 0)):,} matches/s** "
+                  f"({round(c3.get('publishes_per_sec', 0)):,} pubs/s, "
+                  f"batch {c3.get('batch_ms')}ms, group "
+                  f"{c3.get('group_ms')}ms) — per-dispatch RTTs "
+                  f"amortised over the group.")
+    if not any_rows and bench is None and stacked is None:
         print("No artifacts found — has the recovery watch fired? "
               f"(dir: {args.dir})", file=sys.stderr)
         return 1
