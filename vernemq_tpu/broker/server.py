@@ -394,6 +394,9 @@ def main() -> None:  # pragma: no cover
     parser.add_argument("--reg-view", default=None, choices=["trie", "tpu"],
                         help="subscription matcher (the default_reg_view "
                              "seam); overrides --conf when given")
+    parser.add_argument("--tpu-mesh", default=None, metavar="BxS",
+                        help="serve matching on a device mesh (e.g. 2x4: "
+                             "batch x sub axes; implies --reg-view tpu)")
     parser.add_argument("--jax-platform", default=None,
                         help="force the JAX backend (e.g. cpu); note this "
                              "image's jax ignores the JAX_PLATFORMS env var — "
@@ -424,6 +427,12 @@ def main() -> None:  # pragma: no cover
         cfg = Config.from_file(args.conf) if args.conf else Config()
         if args.reg_view:
             cfg.set("default_reg_view", args.reg_view)
+        if args.tpu_mesh:
+            if args.reg_view == "trie":
+                parser.error("--tpu-mesh requires the tpu reg view; "
+                             "drop --reg-view trie")
+            cfg.set("tpu_mesh", args.tpu_mesh)
+            cfg.set("default_reg_view", "tpu")
         if args.allow_anonymous:
             cfg.set("allow_anonymous", True)
         if args.http_port is not None:
