@@ -193,3 +193,79 @@ async def test_broker_serves_through_sharded_view():
     finally:
         await broker.stop()
         await server.stop()
+
+
+def test_cli_tpu_mesh_flag_boots_and_serves():
+    """`python -m vernemq_tpu.broker.server --tpu-mesh 2x4` boots a
+    broker serving on the mesh (the operator entry point for multi-
+    device matching) and a real client round-trips through it; the
+    contradictory flag pair errors out."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import time
+
+    import re
+    import tempfile
+
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    # contradiction: refused at argparse level (no --jax-platform: the
+    # error path must not pay a jax import)
+    r = subprocess.run(
+        [sys.executable, "-m", "vernemq_tpu.broker.server",
+         "--reg-view", "trie", "--tpu-mesh", "2x4"],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode != 0 and "--tpu-mesh requires" in r.stderr
+
+    # ephemeral port (repo convention): parse the bound port from the
+    # CLI's own "listening on" line; stderr to a file (an unread PIPE
+    # can deadlock the child once the buffer fills)
+    errf = tempfile.NamedTemporaryFile(suffix=".err", delete=False)
+    outf = tempfile.NamedTemporaryFile(suffix=".out", delete=False)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "vernemq_tpu.broker.server",
+         "--port", "0", "--allow-anonymous",
+         "--tpu-mesh", "2x4", "--jax-platform", "cpu"],
+        env=env, stdout=outf, stderr=errf)
+    try:
+        deadline = time.time() + 90
+        port = None
+        while time.time() < deadline:
+            m = re.search(rb"listening on [\d.]+:(\d+)",
+                          open(outf.name, "rb").read())
+            if m:
+                port = int(m.group(1))
+                break
+            assert p.poll() is None, open(errf.name).read()[-500:]
+            time.sleep(0.3)
+        assert port, ("CLI broker never came up",
+                      open(errf.name).read()[-500:])
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                socket.create_connection(("127.0.0.1", port), 0.5).close()
+                break
+            except OSError:
+                time.sleep(0.2)
+        else:
+            raise AssertionError("bound port never accepted")
+
+        async def drive():
+            from vernemq_tpu.client import MQTTClient
+
+            s = MQTTClient("127.0.0.1", port, client_id="cli-s")
+            assert (await s.connect()).rc == 0
+            await s.subscribe("cli/+", qos=1)
+            pub = MQTTClient("127.0.0.1", port, client_id="cli-p")
+            assert (await pub.connect()).rc == 0
+            await pub.publish("cli/x", b"mesh-cli", qos=1)
+            assert (await s.recv()).payload == b"mesh-cli"
+            await s.disconnect()
+            await pub.disconnect()
+
+        asyncio.run(drive())
+    finally:
+        p.terminate()
+        p.wait(10)
