@@ -213,3 +213,89 @@ def test_windowed_sharded_overflow_and_clip_fall_back_exact():
     for topic, rows in zip(topics, got):
         want = sorted((k for _, k, _ in trie.match(list(topic))), key=repr)
         assert sorted((k for _, k, _ in rows), key=repr) == want, topic
+
+
+@pytest.mark.parametrize("batch_axis", [1, 2])
+def test_windowed_sharded_merged_output_parity(batch_axis):
+    """merge=True (results merged across 'sub' ON DEVICE via all_gather,
+    one host buffer per batch row — the seat's production posture) must
+    return exactly the unmerged path's rows, trie-checked."""
+    table, trie, pools, rng = build_bucketed(23, 30_000, 1 << 15)
+    mesh = make_mesh(batch=batch_axis)
+    m = ShardedWindowedMatcher(table, mesh, max_fanout=128, merge=True)
+    topics = topics_for(rng, pools, 96)
+    got = m.match_batch(topics)
+    for topic, rows in zip(topics, got):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+    # churn keeps parity through the merged layout too
+    l0, l1, l2 = pools
+    for j in range(120):
+        f = [rng.choice(l0), rng.choice(l1), rng.choice(l2)]
+        table.add(f, 2_000_000 + j, None)
+        trie.add(list(f), 2_000_000 + j, None)
+    got = m.match_batch(topics[:32])
+    for topic, rows in zip(topics[:32], got):
+        assert norm(rows) == norm(trie.match(list(topic))), topic
+
+
+def test_merged_output_survives_per_shard_cnt_over_k():
+    """A shard whose dense-chunk matches plus probe-tile matches for ONE
+    publish total more than k (each component <= k, so nothing clips)
+    stores up to 2k entries in its per-shard range; the on-device merge
+    must copy the full 2k window or the tail silently vanishes with no
+    overflow flag (the exact bug the r5 review reproduced). The topic is
+    chosen host-side so its bucket shard, its g-bucket's dense-column
+    shard, and tiling (non-leftover) all line up — asserted, so the test
+    cannot silently degrade into the host-fallback path."""
+    import numpy as np
+
+    table, trie, pools, rng = build_bucketed(31, 20_000, 1 << 15)
+    mesh = make_mesh(batch=1)
+    nsub = mesh.shape["sub"]
+    k = 8
+    m = ShardedWindowedMatcher(table, mesh, max_fanout=k, merge=True)
+    m.sync()
+    Sl = m._S // nsub
+    GW = m._glob // nsub
+    # host-side candidate scan: colocated bucket/g-bucket pair
+    cands = []
+    for a in range(400):
+        w0, w1, w2 = f"qx{a}", f"qy{a}", f"qz{a}"
+        _, _, _, bucket, gb = table.encode_topic_ex((w0, w1, w2))
+        sb = min(int(m._reg_start[bucket]) // Sl, nsub - 1)
+        sg = min(int(m._reg_start[gb]) // GW, nsub - 1)
+        if sb == sg:
+            cands.append((w0, w1, w2))
+    assert cands, "no colocated candidates"
+    hit = None
+    for (w0, w1, w2) in cands[:20]:
+        key = hash((w0, w1, w2)) & 0xffff
+        for i in range(6):   # probe side: exact, one bucket
+            table.add([w0, w1, w2], 5_000_000 + key * 100 + i, None)
+            trie.add([w0, w1, w2], 5_000_000 + key * 100 + i, None)
+        for i in range(6):   # dense side: wildcard-first, one g-bucket
+            table.add(["+", w1, w2], 6_000_000 + key * 100 + i, None)
+            trie.add(["+", w1, w2], 6_000_000 + key * 100 + i, None)
+        m.sync()
+        p = m._prep([(w0, w1, w2)])
+        if 0 in p["leftovers"]:
+            continue  # untiled pub would host-fallback: pick another
+        # engagement check on an UNMERGED twin over the same table:
+        # ONE shard must carry > k entries for this pub (each phase
+        # component <= k, so nothing clipped) — only then does the
+        # merge copy window past k actually matter
+        m2 = ShardedWindowedMatcher(table, mesh, max_fanout=k,
+                                    merge=False)
+        m2.sync()
+        p2 = m2._prep([(w0, w1, w2)])
+        flat2, pre2, cnt2, ovf2 = m2._dispatch(p2)
+        if ovf2[0, :, 0].any():
+            continue  # clipped: host fallback, not the merge path
+        if int(cnt2[0, :, 0].max()) > k:
+            hit = (w0, w1, w2)
+            break
+    assert hit, "no tiled colocated >k candidate engaged the merge window"
+    rows = m.match_batch([hit])[0]
+    want = trie.match(list(hit))
+    assert norm(rows) == norm(want), (len(rows), len(want))
+    assert len(want) >= 12

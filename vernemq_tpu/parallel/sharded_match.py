@@ -176,7 +176,8 @@ from ..ops.match_kernel import (
 
 def build_sharded_windowed(mesh: Mesh, *, id_bits: int, k: int,
                            glob_pad: int, seg_max: int, gc: int, T: int,
-                           Sl: int, Cl: int, with_total: bool = False):
+                           Sl: int, Cl: int, with_total: bool = False,
+                           merge: bool = False):
     """The flat windowed production matcher under shard_map on a
     ('batch', 'sub') mesh — the multi-chip form of
     :func:`ops.match_kernel.match_extract_windowed_flat`.
@@ -292,8 +293,41 @@ def build_sharded_windowed(mesh: Mesh, *, id_bits: int, k: int,
         flat = scat(flat, pre + gcnt, aidx, avalid, acnt)
         ovf = ((pre + cnt > Cl) | clip) & real
 
-        outs = (flat[None, None], pre[None, None].astype(jnp.int32),
-                cnt[None, None].astype(jnp.int32), ovf[None, None])
+        if merge:
+            # merge across the 'sub' axis ON DEVICE (all_gather rides
+            # ICI): every device of a batch row materialises the full
+            # per-pub result ranges and the host pulls ONE [Cl] buffer
+            # per batch row instead of nsub of them — the collective
+            # costs ICI bandwidth (nsub x Cl gathered) to cut the
+            # host<->device pull by nsub x, the right trade everywhere
+            # ICI >> host link (SURVEY §5.8).
+            g_flat = lax.all_gather(flat, "sub")          # [nsub, Cl]
+            g_pre = lax.all_gather(pre, "sub")            # [nsub, Bl]
+            g_cnt = lax.all_gather(cnt, "sub")
+            g_ovf = lax.all_gather(ovf, "sub")
+            before = jnp.cumsum(g_cnt, axis=0) - g_cnt    # [nsub, Bl]
+            mcnt = g_cnt.sum(axis=0)                      # [Bl]
+            mpre = jnp.cumsum(mcnt) - mcnt
+            mflat = jnp.zeros((Cl,), jnp.int32)
+            nsub_ = g_flat.shape[0]
+            # per-shard per-pub cnt = gcnt + acnt can reach 2k (dense
+            # chunk + probe tile each contribute up to k) — the copy
+            # window must span 2k or the tail entries silently vanish
+            jk2 = jnp.arange(2 * k, dtype=jnp.int32)[None, :]
+            for s_i in range(nsub_):
+                src = g_pre[s_i][:, None] + jk2           # [Bl, 2k]
+                vals = jnp.take(g_flat[s_i],
+                                jnp.minimum(src, Cl - 1))
+                pos = (mpre + before[s_i])[:, None] + jk2
+                ok = (jk2 < g_cnt[s_i][:, None]) & real[:, None]
+                mflat = mflat.at[jnp.where(ok, pos, Cl)].set(
+                    vals, mode="drop")
+            movf = (g_ovf.any(axis=0) | (mpre + mcnt > Cl)) & real
+            outs = (mflat[None], mpre[None].astype(jnp.int32),
+                    mcnt[None].astype(jnp.int32), movf[None])
+        else:
+            outs = (flat[None, None], pre[None, None].astype(jnp.int32),
+                    cnt[None, None].astype(jnp.int32), ovf[None, None])
         if with_total:
             # ICI collective: cluster-wide match total (dryrun exercises
             # it; production skips the per-batch collective latency)
@@ -301,6 +335,7 @@ def build_sharded_windowed(mesh: Mesh, *, id_bits: int, k: int,
             outs = outs + (total,)
         return outs
 
+    res_spec = (P("batch", None) if merge else P("batch", "sub", None))
     fn = shard_map(
         local,
         mesh=mesh,
@@ -311,10 +346,7 @@ def build_sharded_windowed(mesh: Mesh, *, id_bits: int, k: int,
             P("batch", "sub", None, None), P("batch", "sub", None),
             P("batch"), P("batch"), P("batch"),
         ),
-        out_specs=(
-            P("batch", "sub", None), P("batch", "sub", None),
-            P("batch", "sub", None), P("batch", "sub", None),
-        ) + ((P(),) if with_total else ()),
+        out_specs=(res_spec,) * 4 + ((P(),) if with_total else ()),
         check_vma=False,
     )
     return jax.jit(fn)
@@ -328,7 +360,8 @@ class ShardedWindowedMatcher:
     their shard's tile slots) fall back to exact host matching."""
 
     def __init__(self, table, mesh: Mesh, max_fanout: int = 128,
-                 with_total: bool = False, flat_avg: int = 128):
+                 with_total: bool = False, flat_avg: int = 128,
+                 merge: bool = False):
         self.table = table
         self.mesh = mesh
         self.nsub = mesh.shape["sub"]
@@ -336,6 +369,10 @@ class ShardedWindowedMatcher:
         self.max_fanout = max_fanout
         self.with_total = with_total
         self.flat_avg = flat_avg
+        #: merge results across 'sub' on device (ICI all_gather): host
+        #: pulls ONE buffer per batch row instead of nsub — production
+        #: posture for real pods; off by default for back-compat
+        self.merge = merge
         self._dev = None
         self._fns = {}
         self._geom = None
@@ -447,14 +484,14 @@ class ShardedWindowedMatcher:
         # bits keys the cache too: an id_bits-only rebuild (interner
         # crossing a byte plane, no resize) changes the coded-operand
         # decode width baked into the compiled fn
-        key = (Bpad, T, seg_max, gc, Cl, glob, S, bits)
+        key = (Bpad, T, seg_max, gc, Cl, glob, S, bits, self.merge)
         fn = self._fns.get(key)
         if fn is None:
             fn = build_sharded_windowed(
                 self.mesh, id_bits=bits, k=self.max_fanout,
                 glob_pad=glob, seg_max=seg_max, gc=gc, T=T,
                 Sl=S // self.nsub, Cl=Cl,
-                with_total=self.with_total)
+                with_total=self.with_total, merge=self.merge)
             self._fns[key] = fn
         return fn
 
@@ -559,8 +596,11 @@ class ShardedWindowedMatcher:
         }
 
     def _dispatch(self, p):
-        """Run the device half of a prepped batch. Returns np arrays
-        (flat [nb, nsub, Cl]; pre/cnt/ovf [nb, nsub, Bl])."""
+        """Run the device half of a prepped batch. Returns np arrays —
+        layout depends on ``self.merge``: unmerged flat [nb, nsub, Cl],
+        pre/cnt/ovf [nb, nsub, Bl]; merged flat [nb, Cl], pre/cnt/ovf
+        [nb, Bl]. Consumers must go through :meth:`slots_for` /
+        :meth:`_overflowed`, which encapsulate the layout."""
         import numpy as np
 
         fn = self._fn_for(*p["geom"], glob=p["glob"], S=p["S"],
@@ -568,24 +608,36 @@ class ShardedWindowedMatcher:
         res = fn(*p["dev"], *p["args"])
         return tuple(np.asarray(x) for x in res[:4])
 
-    def match_batch(self, topics):
+    def slots_for(self, i, flat, pre, cnt, Bl):
+        """Device-result slot ids for publish ``i`` under the configured
+        result layout (merged: ONE contiguous range per pub; unmerged:
+        one range per 'sub' shard)."""
         import numpy as np
 
+        r, j = divmod(i, Bl)
+        if self.merge:
+            return flat[r, pre[r, j]:pre[r, j] + cnt[r, j]]
+        return np.concatenate(
+            [flat[r, s, pre[r, s, j]:pre[r, s, j] + cnt[r, s, j]]
+             for s in range(self.nsub)])
+
+    def _overflowed(self, i, ovf, Bl):
+        r, j = divmod(i, Bl)
+        return bool(ovf[r, j] if self.merge else ovf[r, :, j].any())
+
+    def match_batch(self, topics):
         if not topics:
             return []
         self.sync()
         p = self._prep(topics)
         flat, pre, cnt, ovf = self._dispatch(p)
-        nsub, Bl, leftovers = self.nsub, p["Bl"], p["leftovers"]
+        Bl, leftovers = p["Bl"], p["leftovers"]
         out = []
         for i, topic in enumerate(topics):
-            r, j = divmod(i, Bl)
-            if i in leftovers or ovf[r, :, j].any():
+            if i in leftovers or self._overflowed(i, ovf, Bl):
                 out.append(self._host_match(topic))
                 continue
-            parts = [flat[r, s, pre[r, s, j]:pre[r, s, j] + cnt[r, s, j]]
-                     for s in range(nsub)]
-            rows = self.table.resolve(np.concatenate(parts))
+            rows = self.table.resolve(self.slots_for(i, flat, pre, cnt, Bl))
             if len(self.table.overflow):
                 rows = rows + self.table.overflow.match(list(topic))
             out.append(rows)
@@ -629,8 +681,12 @@ class ShardedTpuMatcher(TpuMatcher):
                          max_fanout=max_fanout, flat_avg=flat_avg,
                          packed_io=False, use_pallas=False)
         self.mesh = mesh
+        # merge=True: the production posture — results merged across the
+        # 'sub' axis on device (ICI all_gather), so the host pulls ONE
+        # buffer per batch row instead of nsub of them
         self._swm = ShardedWindowedMatcher(
-            self.table, mesh, max_fanout=max_fanout, flat_avg=flat_avg)
+            self.table, mesh, max_fanout=max_fanout, flat_avg=flat_avg,
+            merge=True)
 
     # ------------------------------------------------------------- building
 
@@ -777,17 +833,15 @@ class ShardedTpuMatcher(TpuMatcher):
         finally:
             with self.lock:
                 self._inflight -= 1
-        nsub, Bl, leftovers = sw.nsub, p["Bl"], p["leftovers"]
+        Bl, leftovers = p["Bl"], p["leftovers"]
         out = []
         for i, topic in enumerate(topics):
-            r, j = divmod(i, Bl)
-            if i in leftovers or ovf[r, :, j].any():
+            if i in leftovers or sw._overflowed(i, ovf, Bl):
                 self.host_fallbacks += 1
                 out.append(self._host_match(topic, snapshot))
                 continue
-            parts = [flat[r, s, pre[r, s, j]:pre[r, s, j] + cnt[r, s, j]]
-                     for s in range(nsub)]
-            rows = [e for e in snapshot[np.concatenate(parts)]
+            rows = [e for e in
+                    snapshot[sw.slots_for(i, flat, pre, cnt, Bl)]
                     if e is not None]
             with self.lock:
                 if len(self.table.overflow):
