@@ -448,6 +448,61 @@ class WindowedBench:
         }
 
 
+def match_many_probe(wb: "WindowedBench", ks=(1, 2, 4, 8, 16), reps=2,
+                     probe_batch=None):
+    """Kernel-resident multi-batch dispatch probe — the amortization
+    number the round-5 VERDICT says was never measured. For each K in
+    ``ks``: prep K same-geometry publish batches, stage them as ONE
+    stacked transport block and run all K inside ONE scanned executable
+    with donated staging (``K.call_match_many``), timing the full synced
+    round trip W(K). Fitting W(K) = dispatch + K·batch_cost (least
+    squares over the ladder) splits the fixed per-dispatch overhead
+    (transport RTTs + executable launch — what the tunnel regime pays
+    per call) from the per-batch kernel cost; ``amortized_dispatch_ms[K]
+    = dispatch/K`` is the ROOFLINE.md amortization model, measured.
+
+    ``probe_batch`` overrides the per-batch publish count (smoke runs
+    use a smaller batch so the ladder stays fast); geometry is still the
+    exact production prep for that batch size."""
+    import time as _time
+
+    from vernemq_tpu.ops import match_kernel as K
+
+    m = wb.m
+    F_t, t1 = m._operands
+    n = probe_batch or wb.batch
+    walls = {}
+    for k in ks:
+        full = [wb._prep(zipf_topics(wb.rng, wb.pools, n))
+                for _ in range(k)]
+        preps = [f[0] for f in full]
+        statics = full[0][1]
+        # compile + executable warm (scan length is part of the shape)
+        np.asarray(K.call_match_many(F_t, t1, m._meta, preps, statics))
+        best = float("inf")
+        for _ in range(max(1, reps)):
+            t0 = _time.perf_counter()
+            out = K.call_match_many(F_t, t1, m._meta, preps, statics)
+            np.asarray(out)  # honest sync: every result byte to host
+            best = min(best, _time.perf_counter() - t0)
+        walls[k] = best * 1e3
+    # least-squares fit W(K) = a + b*K (ms): a = per-dispatch overhead
+    xs = np.asarray(list(ks), dtype=np.float64)
+    ys = np.asarray([walls[k] for k in ks], dtype=np.float64)
+    A = np.vstack([np.ones_like(xs), xs]).T
+    (a, b), *_ = np.linalg.lstsq(A, ys, rcond=None)
+    a = max(float(a), 0.0)
+    return {
+        "ks": list(ks),
+        "probe_batch": n,
+        "super_batch_ms": {str(k): round(walls[k], 3) for k in ks},
+        "per_batch_ms": {str(k): round(walls[k] / k, 3) for k in ks},
+        "dispatch_ms_fit": round(a, 3),
+        "kernel_batch_ms_fit": round(float(b), 3),
+        "amortized_dispatch_ms": {str(k): round(a / k, 4) for k in ks},
+    }
+
+
 # ------------------------------------------------------------- the ladder
 
 def config1_host_trie(rng):
@@ -644,6 +699,20 @@ def main() -> int:
             except Exception as e:
                 note(f"[bench] kernel-only probe failed: "
                      f"{type(e).__name__}: {e}")
+        if kernel_variant == "packed":
+            # K-batch dispatch-amortization ladder (match_many): the
+            # trajectory metric for the multi-batch pipeline — dispatch
+            # overhead per batch must fall ~1/K
+            try:
+                headline["match_many_probe"] = match_many_probe(
+                    wb, reps=1 if smoke else 2,
+                    probe_batch=min(args.batch, 256) if smoke
+                    else args.batch)
+                note(f"[bench] match_many probe "
+                     f"{headline['match_many_probe']}")
+            except Exception as e:
+                note(f"[bench] match_many probe failed: "
+                     f"{type(e).__name__}: {e}")
         configs["3_mixed_1m_zipf"] = {
             k: round(v, 3) if isinstance(v, float) else v
             for k, v in headline.items() if v is not None}
@@ -808,6 +877,13 @@ def main() -> int:
             result["vs_baseline_kernel"] = round(
                 headline["kernel_matches_per_sec"] / TARGET_MATCHES_PER_SEC,
                 4)
+        if "match_many_probe" in headline:
+            # dispatch amortization headline: per-batch dispatch
+            # overhead at K=1 vs K=8 windows per device call — the
+            # trajectory number for the multi-batch pipeline
+            amort = headline["match_many_probe"]["amortized_dispatch_ms"]
+            result["amortized_dispatch_ms"] = {
+                "K1": amort.get("1"), "K8": amort.get("8")}
     print(json.dumps(result))
     return 0
 

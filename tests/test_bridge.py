@@ -153,7 +153,13 @@ async def test_bridge_buffers_while_down_and_reconnects():
         await wait_until(lambda: br.info()["connected"])
         # sever the link: stop accepting and kill the bridge's live session
         # (a graceful rs.stop() would block on wait_closed while the bridge
-        # connection is alive — this simulates a crashed remote instead)
+        # connection is alive — this simulates a crashed remote instead).
+        # Drop the listener record FIRST or rb's supervisor watchdog
+        # resurrects the listener and re-occupies the port (it won the
+        # race under full-suite load: "listener died; restarting" in the
+        # captured log, and the manual rebind below then never bound).
+        if rb.listeners is not None:
+            rb.listeners._listeners.pop((rs.host, rs.port), None)
         rs._server.close()
         for s in list(rb.sessions.values()):
             await s.close("remote_crash", send_will=False)
@@ -169,7 +175,14 @@ async def test_bridge_buffers_while_down_and_reconnects():
         from vernemq_tpu.broker.server import MQTTServer
 
         rs2 = MQTTServer(rb, rs.host, rs.port)
-        await rs2.start()
+        for _ in range(50):
+            try:
+                await rs2.start()
+                break
+            except OSError:  # port not released yet under suite load
+                await asyncio.sleep(0.1)
+        else:
+            raise AssertionError(f"port {rs.port} never came free")
         sub = await connected(rs2, "remote-sub")
         await sub.subscribe("buf/#", qos=1)
         await wait_until(lambda: br.info()["connected"], timeout=10.0)

@@ -3,6 +3,7 @@ test approach (gen_* generators + parse, ``vmq_parser.erl:7``) plus
 hypothesis property round-trips and incremental-feed ("more") behavior."""
 
 import pytest
+pytest.importorskip("hypothesis")  # not in the image: skip, don't error
 from hypothesis import given, settings, strategies as st
 
 from vernemq_tpu.protocol import codec_v4 as v4

@@ -45,6 +45,11 @@ def reference_metric_names():
     """Prometheus names from every m(type, labels, id, NAME, desc) entry —
     including the per-reason families, whose m() spans lines. The name is
     the 4th argument (vmq_metrics.erl m/5)."""
+    if not REF.exists():
+        import pytest
+
+        pytest.skip("reference checkout not present on this image "
+                    f"({REF})")
     text = REF.read_text()
     pat = re.compile(
         r"m\(\s*(counter|gauge)\s*,\s*\[[^\]]*\]\s*,\s*"

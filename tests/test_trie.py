@@ -4,6 +4,7 @@
 on every (corpus, publish) pair."""
 
 import pytest
+pytest.importorskip("hypothesis")  # not in the image: skip, don't error
 from hypothesis import given, settings, strategies as st
 
 from vernemq_tpu.models.trie import SubscriptionTrie

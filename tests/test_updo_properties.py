@@ -10,6 +10,9 @@ a fresh exec of v2 — while same-kind survivors keep object identity
 
 import sys
 
+import pytest
+
+pytest.importorskip("hypothesis")  # not in the image: skip, don't error
 from hypothesis import given, settings
 from hypothesis import strategies as st
 

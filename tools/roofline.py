@@ -36,6 +36,44 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
+def run_probe(args) -> None:
+    """The MEASURED half of the amortization story: run the
+    kernel-resident multi-batch probe (bench.match_many_probe — K
+    batches per scanned executable, donated staging) standalone, at
+    smoke scale on CPU or full scale on an accelerator. This is the
+    empirical companion to the analytic model below: dispatch cost
+    amortizes as dispatch/K + kernel_cost per batch."""
+    import random as _random
+
+    from bench import WindowedBench, build_corpus, init_backend, \
+        match_many_probe
+    from vernemq_tpu.models.tpu_table import SubscriptionTable
+
+    jax_mod, devices, fallback = init_backend()
+    platform = devices[0].platform
+    smoke = platform == "cpu"
+    subs = min(args.subs, 100_000) if smoke else args.subs
+    batch = args.probe_batch or (min(args.batch, 256) if smoke
+                                 else args.batch)
+    rng = _random.Random(args.seed)
+    table = SubscriptionTable(
+        max_levels=args.levels,
+        initial_capacity=1 << (subs - 1).bit_length())
+    t0 = time.perf_counter()
+    pools = build_corpus(rng, subs, table)
+    print(f"# corpus built in {time.perf_counter()-t0:.0f}s",
+          file=sys.stderr, flush=True)
+    wb = WindowedBench(jax_mod, table, pools, rng, batch,
+                       variant="packed")
+    ks = tuple(int(x) for x in args.probe_ks.split(",") if x.strip())
+    out = match_many_probe(wb, ks=ks, reps=args.probe_reps,
+                           probe_batch=batch)
+    out.update({"mode": "measured_match_many_probe",
+                "platform": platform, "platform_fallback": fallback,
+                "subs": subs, "batch": batch})
+    print(json.dumps(out, indent=1))
+
+
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--subs", type=int, default=1_000_000)
@@ -51,7 +89,19 @@ def main() -> None:
     ap.add_argument("--intermediate-factor", type=float, default=0.0,
                     help="fraction of the [pubs, seg] f32 mismatch "
                          "blocks charged to HBM (0 = fully fused)")
+    ap.add_argument("--probe", action="store_true",
+                    help="RUN the kernel-resident match_many dispatch-"
+                         "amortization probe (K-batch ladder, measured) "
+                         "instead of the analytic model; smoke-scales "
+                         "on CPU")
+    ap.add_argument("--probe-ks", default="1,2,4,8,16")
+    ap.add_argument("--probe-reps", type=int, default=2)
+    ap.add_argument("--probe-batch", type=int, default=None)
     args = ap.parse_args()
+
+    if args.probe:
+        run_probe(args)
+        return
 
     import jax
 

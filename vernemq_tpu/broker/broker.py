@@ -416,6 +416,7 @@ class Broker:
                 window_us=self.config.tpu_batch_window_us,
                 host_threshold=self.config.tpu_host_batch_threshold,
                 lock_busy_shed_ms=self.config.tpu_lock_busy_shed_ms,
+                super_batch_k=self.config.tpu_super_batch_k,
             )
         return self._collector
 

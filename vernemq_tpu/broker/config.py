@@ -101,6 +101,10 @@ DEFAULTS: Dict[str, Any] = {
     # device flush waits at most this long for the matcher lock before
     # the whole flush serves from the host trie (0 = unbounded wait)
     "tpu_lock_busy_shed_ms": 500,
+    # under load, up to this many full batch windows coalesce into ONE
+    # device dispatch (match_many super-batches: K round trips -> 1,
+    # the continuous-batching posture); 1 disables
+    "tpu_super_batch_k": 8,
     # systree / metrics
     "systree_enabled": True,
     "systree_interval": 20,

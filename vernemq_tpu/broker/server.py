@@ -128,14 +128,24 @@ async def mqtt_connection(
     try:
         # ---- pre-init: wait for CONNECT, pick protocol ----------------
         first = wire.split_frame(buf, max_frame_size) if buf else None
-        async with asyncio.timeout(CONNECT_TIMEOUT):
-            while first is None:
+
+        async def _read_connect():
+            # wait_for (not asyncio.timeout) — the latter is 3.11+ and
+            # this must run on the image's 3.10
+            nonlocal buf
+            f = first
+            while f is None:
                 chunk = await read_chunk()
                 if not chunk:
-                    return
+                    return None
                 metrics.incr("bytes_received", len(chunk))
                 buf += chunk
-                first = wire.split_frame(buf, max_frame_size)
+                f = wire.split_frame(buf, max_frame_size)
+            return f
+
+        first = await asyncio.wait_for(_read_connect(), CONNECT_TIMEOUT)
+        if first is None:
+            return
         ptype, flags, body, rest = first
         if ptype != 1:  # must be CONNECT
             return

@@ -164,9 +164,11 @@ class Cluster:
         stuck = {sid: m for sid, m in self.broker.migrations.items()
                  if m["state"] in ("draining", "failed")}
         if stuck:
+            detail = ", ".join(
+                f"{s[0]}/{s[1]}:{m['state']}" for s, m in stuck.items())
             raise RuntimeError(
                 f"leave aborted: {len(stuck)} queue migration(s) incomplete "
-                f"({', '.join(f'{s[0]}/{s[1]}:{m['state']}' for s, m in stuck.items())})")
+                f"({detail})")
         self.leave(self.node_name)
         return moved
 

@@ -41,6 +41,23 @@ def _pow2ceil(n: int) -> int:
     return 1 << max(n - 1, 1).bit_length()
 
 
+def _pad_pub_block(pw, pl, pd, Bpad: int):
+    """Grow an encoded publish block to a larger padded batch size (the
+    super-batch path pads every member batch to ONE common Bpad so all K
+    share a compile signature)."""
+    cur = pw.shape[0]
+    if cur == Bpad:
+        return pw, pl, pd
+    from ..ops.match_kernel import PAD_ID
+
+    extra = Bpad - cur
+    pw = np.concatenate(
+        [pw, np.full((extra, pw.shape[1]), np.int32(PAD_ID), np.int32)])
+    pl = np.concatenate([pl, np.zeros(extra, np.int32)])
+    pd = np.concatenate([pd, np.zeros(extra, bool)])
+    return pw, pl, pd
+
+
 def window_params(S: int, glob_pad: int, bucket_max: int, Bpad: int,
                   zone: Optional[int] = None, align: int = 0):
     """Static kernel geometry for a padded batch: tile count T (fixed per
@@ -258,6 +275,7 @@ class TpuMatcher:
         self.warmup_batches = 0
         self.warmup_publishes = 0
         self.host_fallbacks = 0  # pubs served by exact host match
+        self.super_dispatches = 0  # fused K-batch match_many dispatches
         # encode cache: hot topics (zipf streams) skip per-word interner
         # lookups; invalidated when the interner or bucket layout changes
         # (a cached UNKNOWN word may since have been interned)
@@ -500,6 +518,21 @@ class TpuMatcher:
                 sw, el, hh, fw, ac, *self._operands, self._meta,
                 self._jax.device_put(packed, self.device),
                 D=len(slots), L=t.words.shape[1], id_bits=self._ops_bits)
+        elif self._operands is not None:
+            # packed_io=False but coded operands present: same ONE-upload
+            # ONE-fused-scatter flush as the meta path — the unfused
+            # fallback used to ship six arrays and dispatch three
+            # scatters per delta (each a separate executable launch and,
+            # on the tunnel runtime, a separate round trip)
+            packed = K.delta_pack_args(
+                slots, t.words[slots], t.eff_len[slots],
+                t.has_hash[slots], t.first_wild[slots], t.active[slots])
+            fusedn = (K.apply_delta_fused_nometa if donate
+                      else K.apply_delta_fused_nometa_copy)
+            self._dev_arrays, self._operands = fusedn(
+                sw, el, hh, fw, ac, *self._operands,
+                self._jax.device_put(packed, self.device),
+                D=len(slots), L=t.words.shape[1], id_bits=self._ops_bits)
         else:
             slots_dev = self._jax.device_put(slots, self.device)
             w_dev = self._jax.device_put(t.words[slots], self.device)
@@ -508,16 +541,10 @@ class TpuMatcher:
             fw_dev = self._jax.device_put(t.first_wild[slots], self.device)
             ac_dev = self._jax.device_put(t.active[slots], self.device)
             delta = K.apply_delta if donate else K.apply_delta_copy
-            delta_ops = (K.apply_delta_operands if donate
-                         else K.apply_delta_operands_copy)
             self._dev_arrays = delta(
                 sw, el, hh, fw, ac, slots_dev, w_dev, e_dev,
                 hh_dev, fw_dev, ac_dev,
             )
-            if self._operands is not None:
-                self._operands = delta_ops(
-                    *self._operands, slots_dev, w_dev, e_dev,
-                    id_bits=self._ops_bits)
             if self.packed_io and self._meta is not None:
                 dm = (K.apply_delta_meta if donate
                       else K.apply_delta_meta_copy)
@@ -688,6 +715,14 @@ class TpuMatcher:
         finally:
             with self.lock:
                 self._inflight -= 1
+        return self._resolve_rows(topics, idx_rows, need_host, snapshot)
+
+    def _resolve_rows(self, topics, idx_rows, need_host,
+                      snapshot) -> List[List[Row]]:
+        """Host-side result resolution shared by match_batch and
+        match_many: device slot ids -> entry rows via the pinned
+        snapshot, with the exact host fallback for pubs the device could
+        not serve."""
         out: List[List[Row]] = []
         for i, topic in enumerate(topics):
             if need_host[i]:
@@ -706,6 +741,153 @@ class TpuMatcher:
                     rows = rows + self.table.overflow.match(list(topic))
             out.append(rows)
         return out
+
+    def match_many(self, batches: Sequence[Sequence[Sequence[str]]],
+                   _warmup: bool = False,
+                   lock_timeout: Optional[float] = None,
+                   require_warm: bool = False) -> List[List[List[Row]]]:
+        """Match K publish batches in ONE device dispatch (the
+        kernel-resident multi-batch pipeline): every batch is encoded and
+        window-prepped against one consistent table snapshot, padded to a
+        COMMON Bpad, staged as one stacked transport block and run K
+        times on device via ``lax.scan`` (ops.match_kernel.match_many) —
+        K round trips become one. Results are per batch, bit-identical
+        to K independent :meth:`match_batch` calls at the same Bpad.
+
+        Falls back to sequential match_batch calls when the fused path
+        is unavailable (unbucketed table, packed_io off, or K == 1).
+        ``lock_timeout``/``require_warm`` follow match_batch's contract.
+        """
+        batches = [list(b) for b in batches]
+        if not batches:
+            return []
+        if lock_timeout is None:
+            self.lock.acquire()
+        elif not self.lock.acquire(timeout=lock_timeout):
+            self.busy_sheds += 1
+            raise MatcherBusy(cold=False)
+        fast = False
+        try:
+            self.sync()
+            operands = self._operands
+            meta = self._meta
+            snapshot = self._entries_snapshot
+            dev_arrays = self._dev_arrays
+            fast = (len(batches) > 1 and self._bucketed
+                    and operands is not None
+                    and self.packed_io and meta is not None)
+            if fast:
+                reg_start, reg_end = self._reg_start, self._reg_end
+                glob_pad, bits = self._glob_pad, self._ops_bits
+                S = int(dev_arrays[0].shape[0])
+                Bpad = max(self._pad_batch(len(b)) for b in batches)
+                # only the encode (table interner access) needs the
+                # lock; the heavy window prep (_flat_prep) runs on the
+                # pinned snapshot args AFTER release, like match_batch
+                encoded = []
+                for topics in batches:
+                    pw, pl, pd, pb, gb = self._encode_batch_ex(topics)
+                    pw, pl, pd = _pad_pub_block(pw, pl, pd, Bpad)
+                    encoded.append((pw, pl, pd, pb, gb))
+                self._inflight += 1
+        finally:
+            self.lock.release()
+        if not fast:
+            return [self.match_batch(topics, _warmup=_warmup,
+                                     lock_timeout=lock_timeout,
+                                     require_warm=require_warm)
+                    for topics in batches]
+        n_pubs = sum(len(b) for b in batches)
+        if _warmup:
+            self.warmup_batches += len(batches)
+            self.warmup_publishes += n_pubs
+        else:
+            self.match_batches += len(batches)
+            self.match_publishes += n_pubs
+        try:
+            preps: List[tuple] = []
+            lefts: List[set] = []
+            statics = None
+            for topics, (pw, pl, pd, pb, gb) in zip(batches, encoded):
+                args, statics, left = self._flat_prep(
+                    reg_start, reg_end, glob_pad, bits, S,
+                    pw, pl, pd, pb, gb, len(topics))
+                preps.append(args)
+                lefts.append(left)
+            sig = ("many", len(batches),
+                   tuple(a.shape for a in preps[0]),
+                   tuple(sorted(statics.items())))
+            if require_warm and sig not in self._warm_sigs:
+                self.busy_sheds += 1
+                raise MatcherBusy(cold=True)
+            F_t, t1 = operands
+            out = K.call_match_many(F_t, t1, meta, preps, statics,
+                                    device=self.device)
+            results = K.unpack_many_results(out, Bpad, statics["C"])
+            self._warm_sigs.add(sig)
+            if not _warmup:
+                self.super_dispatches += 1
+        finally:
+            with self.lock:
+                self._inflight -= 1
+        outs: List[List[List[Row]]] = []
+        for topics, (flat, pre, total, overflow), left in zip(
+                batches, results, lefts):
+            n = len(topics)
+            need_host = overflow[:n].copy()
+            for i in left:
+                need_host[i] = True
+            idx_rows = [flat[pre[i]:pre[i] + total[i]] for i in range(n)]
+            outs.append(self._resolve_rows(topics, idx_rows, need_host,
+                                           snapshot))
+        return outs
+
+    @property
+    def supports_match_many(self) -> bool:
+        """Whether the fused K-batch dispatch path is available
+        (bucketed table layout + codable ids + packed transport — table
+        state, not device state: match_many syncs before dispatch, so a
+        not-yet-built table still qualifies). The collector gates
+        super-batching on this so an unbucketed or unpacked matcher is
+        never fed K windows it would only serialize — that would deepen
+        the overload queue with zero amortization."""
+        t = self.table
+        return bool(self.packed_io and t.bucketed and t.id_bits)
+
+    def ensure_warm_many(self, n_batches: int, n: int) -> None:
+        """Background-compile the K-batch super-dispatch signature for
+        ``n_batches`` windows of ``n`` publishes (idempotent per shape) —
+        the match_many analog of :meth:`ensure_warm`, kicked by the
+        collector when a cold super-batch sheds."""
+        import threading
+
+        key = ("many", n_batches, self._pad_batch(n))
+        if key in self._warming:
+            return
+        self._warming.add(key)
+
+        def _w() -> None:
+            try:
+                Bpad = self._pad_batch(n)
+                batches = [
+                    [("warmup", "ladder", str(i)) for i in range(Bpad)]
+                    for _ in range(n_batches)]
+                self.match_many(batches, _warmup=True)
+            except RebuildInProgress:
+                pass  # table rebuilding — retried on the next shed
+            except Exception:
+                self.warm_failures += 1
+                import logging
+
+                logging.getLogger("vernemq_tpu.matcher").exception(
+                    "background warm-up of %d-batch super-dispatch "
+                    "(batch %d) failed; super-batches of this shape keep "
+                    "serving via the host trie", n_batches, Bpad)
+            finally:
+                self._warming.discard(key)
+
+        threading.Thread(target=_w, name=f"tpu-warm-many-{n_batches}",
+                         daemon=True).start()
 
     def _geometry(self, S, glob_pad, reg_start, reg_end, Bpad, align=0):
         """Static kernel geometry for both probes at this batch size."""
@@ -956,6 +1138,25 @@ class TpuRegView:
             topics, lock_timeout=lock_timeout,
             require_warm=lock_timeout is not None)
 
+    def fold_many(self, mountpoint: str,
+                  batches: Sequence[Sequence[Sequence[str]]],
+                  lock_timeout: Optional[float] = None):
+        """K-window super-batch fold: all of ``batches`` ride ONE device
+        dispatch (TpuMatcher.match_many). Returns one result list per
+        batch, in order."""
+        return self.matcher(mountpoint).match_many(
+            batches, lock_timeout=lock_timeout,
+            require_warm=lock_timeout is not None)
+
+    def supports_many(self, mountpoint: str = "") -> bool:
+        """Whether this mountpoint's matcher can amortize a K-window
+        super-batch into one dispatch RIGHT NOW (the collector's gate).
+        False while the matcher is uncreated — the first flush warms it
+        through the normal path."""
+        m = self._matchers.get(mountpoint)
+        return bool(m is not None
+                    and getattr(m, "supports_match_many", False))
+
 
 class BatchCollector:
     """Coalesce concurrent publishes into one device call.
@@ -972,10 +1173,18 @@ class BatchCollector:
 
     def __init__(self, view: TpuRegView, window_us: int = 200,
                  max_batch: int = 4096, host_threshold: int = 8,
-                 lock_busy_shed_ms: int = 500):
+                 lock_busy_shed_ms: int = 500, super_batch_k: int = 8):
         self.view = view
         self.window = window_us / 1e6
         self.max_batch = max_batch
+        # under load (more than one full window already queued) up to
+        # this many max_batch windows coalesce into ONE device dispatch
+        # (TpuMatcher.match_many — K round trips become one; the
+        # continuous-batching posture of Orca/vLLM applied to the match
+        # pipeline). 1 disables super-batching.
+        self.super_batch_k = max(1, super_batch_k)
+        self.super_batches = 0      # fused multi-window dispatches
+        self.super_batch_pubs = 0   # pubs that rode a super-batch
         # bounded head-of-line blocking: a device flush waits at most
         # this long for the matcher lock (a first-compile of a new batch
         # shape can hold it for tens of seconds) before the whole flush
@@ -1005,6 +1214,22 @@ class BatchCollector:
         import collections as _collections
 
         self._order: "_collections.deque" = _collections.deque()
+
+    def _many_capable(self, mountpoint: str) -> bool:
+        """Can this mountpoint's flushes amortize as super-batches RIGHT
+        NOW? Gated on the matcher's actual fused-path availability (not
+        just the fold_many seam existing): feeding K windows to a
+        matcher that would serialize them deepens the overload queue
+        and the head-of-line wait for zero amortization."""
+        if self.super_batch_k <= 1 or not hasattr(self.view, "fold_many"):
+            return False
+        probe = getattr(self.view, "supports_many", None)
+        if probe is None:
+            return True  # simple stand-in views: seam presence is the gate
+        try:
+            return bool(probe(mountpoint))
+        except Exception:
+            return False
 
     def _enqueue_fut(self, loop) -> asyncio.Future:
         fut = loop.create_future()
@@ -1050,13 +1275,21 @@ class BatchCollector:
         loop = asyncio.get_event_loop()
         fut = self._enqueue_fut(loop)
         if (self._inflight >= self.MAX_INFLIGHT
-                and len(self._pending) >= self.max_batch):
-            # overload: both pipeline slots busy AND a full batch already
-            # waiting — arrival rate exceeds device service rate. Match on
-            # the exact host trie NOW instead of queueing unboundedly
-            # (the trie is the correctness oracle, so results are
-            # identical); the result still RELEASES in submission order
-            # via _settle, so shedding never reorders deliveries.
+                and len(self._pending) >= self.max_batch
+                and len(self._pending) >= self.max_batch * (
+                    self.super_batch_k
+                    if self._many_capable(mountpoint) else 1)):
+            # overload: both pipeline slots busy AND a full super-batch
+            # already waiting — arrival rate exceeds device service
+            # rate even with K windows per dispatch. Match on the exact
+            # host trie NOW instead of queueing unboundedly (the trie
+            # is the correctness oracle, so results are identical); the
+            # result still RELEASES in submission order via _settle, so
+            # shedding never reorders deliveries. The shed bound is
+            # super_batch_k windows (not one): queued pubs below it
+            # coalesce into one K-window dispatch when a slot frees —
+            # shedding earlier would starve the amortization path the
+            # device needs to catch back up.
             if getattr(self.view, "registry", None) is not None:
                 self.overload_host_pubs += 1
                 self._settle_via_trie(mountpoint, topic, fut)
@@ -1091,8 +1324,16 @@ class BatchCollector:
             # executor queue). _on_done flushes the moment a slot frees.
             self.saturated_merges += 1
             return
-        pending, self._pending = self._pending[:self.max_batch], \
-            self._pending[self.max_batch:]
+        take = self.max_batch
+        if (len(self._pending) > self.max_batch
+                and self._many_capable(self._pending[0][0])):
+            # load signal: more than one full window is already queued —
+            # ship up to super_batch_k windows as ONE device dispatch
+            # instead of serializing one dispatch per window
+            take = min(len(self._pending),
+                       self.max_batch * self.super_batch_k)
+        pending, self._pending = self._pending[:take], \
+            self._pending[take:]
         self._inflight += 1
         task = asyncio.get_event_loop().create_task(
             self._flush_async(pending))
@@ -1132,10 +1373,26 @@ class BatchCollector:
             self.view.matcher(mp)  # warm-load on the loop thread (see matcher())
             lock_to = (self.lock_busy_shed_ms / 1e3
                        if self.lock_busy_shed_ms else None)
+            # super-batch: more than one window's worth of pubs in this
+            # flush rides ONE device dispatch (fold_many -> match_many)
+            chunks = ([topics[i:i + self.max_batch]
+                       for i in range(0, len(topics), self.max_batch)]
+                      if len(topics) > self.max_batch
+                      and self._many_capable(mp) else None)
             try:
-                results = await loop.run_in_executor(
-                    None, self.view.fold_batch, mp, topics, lock_to
-                )
+                if chunks:
+                    nested = await loop.run_in_executor(
+                        None, self.view.fold_many, mp, chunks, lock_to
+                    )
+                    results = [rows for batch in nested for rows in batch]
+                    # counted only on success: a shed/failed super-batch
+                    # served elsewhere must not read as a fused dispatch
+                    self.super_batches += 1
+                    self.super_batch_pubs += len(topics)
+                else:
+                    results = await loop.run_in_executor(
+                        None, self.view.fold_batch, mp, topics, lock_to
+                    )
             except (RebuildInProgress, MatcherBusy) as rb:
                 # the device can't take this batch promptly — table
                 # re-uploading after growth, or the matcher lock held
@@ -1156,7 +1413,11 @@ class BatchCollector:
                         # typically warm already — a redundant warm
                         # would steal device time while congested)
                         m = self.view.matcher(mp)
-                        if m is not None and hasattr(m, "ensure_warm"):
+                        if (chunks and m is not None
+                                and hasattr(m, "ensure_warm_many")):
+                            m.ensure_warm_many(len(chunks),
+                                               self.max_batch)
+                        elif m is not None and hasattr(m, "ensure_warm"):
                             m.ensure_warm(len(items))
                 else:
                     self.rebuild_host_pubs += len(items)

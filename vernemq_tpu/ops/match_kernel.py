@@ -954,11 +954,7 @@ def match_packed_scan(
     return chk, tot
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("B", "L", "T", "TP", "T2", "id_bits",
-                                    "k", "glob_pad", "seg_max", "seg2_max",
-                                    "gc", "C"))
-def match_packed_scan_results(
+def _match_many_body(
     F_t, t1, meta,
     packed_stack,            # int32 [N, P] staged transport vectors
     *,
@@ -966,14 +962,6 @@ def match_packed_scan_results(
     id_bits: int, k: int, glob_pad: int, seg_max: int, seg2_max: int,
     gc: int, C: int,
 ):
-    """Stacked transport: run N packed batches inside ONE executable and
-    return ALL their result vectors ``[N, C + 3B]`` for ONE host pull —
-    the production-honest sibling of :func:`match_packed_scan` (which
-    reduces to a checksum). On a latency-dominated link this amortises
-    the two per-dispatch round trips over N batches; the bytes moved are
-    the same as N separate packed calls, so it trades per-batch latency
-    (N windows' worth) for dispatch-overhead amortisation — the
-    throughput mode of the tunnel regime (ROOFLINE.md)."""
     def step(_, p):
         out = _packed_core(F_t, t1, meta, p, B=B, L=L, T=T, TP=TP, T2=T2,
                            id_bits=id_bits, k=k, glob_pad=glob_pad,
@@ -984,6 +972,35 @@ def match_packed_scan_results(
     return outs
 
 
+#: Stacked transport: run N packed batches inside ONE executable and
+#: return ALL their result vectors ``[N, C + 3B]`` for ONE host pull —
+#: the production-honest sibling of :func:`match_packed_scan` (which
+#: reduces to a checksum). On a latency-dominated link this amortises
+#: the two per-dispatch round trips over N batches; the bytes moved are
+#: the same as N separate packed calls, so it trades per-batch latency
+#: (N windows' worth) for dispatch-overhead amortisation — the
+#: throughput mode of the tunnel regime (ROOFLINE.md).
+match_packed_scan_results = functools.partial(
+    jax.jit,
+    static_argnames=("B", "L", "T", "TP", "T2", "id_bits", "k",
+                     "glob_pad", "seg_max", "seg2_max", "gc", "C"),
+)(_match_many_body)
+
+
+#: The production multi-batch entry point: same scanned executable as
+#: :func:`match_packed_scan_results`, but the staging block is DONATED —
+#: the matcher re-stages a fresh super-batch every dispatch, so keeping
+#: the previous stack alive only doubles HBM footprint; donation lets
+#: XLA reuse the staging allocation across dispatches. No host sync
+#: happens between the K scan iterations: K round trips become 1.
+match_many = functools.partial(
+    jax.jit,
+    static_argnames=("B", "L", "T", "TP", "T2", "id_bits", "k",
+                     "glob_pad", "seg_max", "seg2_max", "gc", "C"),
+    donate_argnums=(3,),
+)(_match_many_body)
+
+
 def call_packed_stack(F_t, t1, meta, preps, statics):
     """Stack the packed arg vectors of ``preps`` (each the trailing-args
     tuple of one batch, same geometry) and run them as ONE executable.
@@ -991,6 +1008,37 @@ def call_packed_stack(F_t, t1, meta, preps, statics):
     vecs = np.stack([flat_pack_args(a) for a in preps])
     return match_packed_scan_results(
         F_t, t1, meta, vecs, **_packed_geometry(preps[0]), **statics)
+
+
+def call_match_many(F_t, t1, meta, preps, statics, device=None):
+    """Super-batch dispatch (the tentpole path of the K-batch pipeline):
+    pack each prepped batch's host args, stack them into ONE staging
+    block, upload it as ONE transfer and run all K batches inside ONE
+    executable via :func:`match_many` (donated staging, scan on device,
+    zero host syncs between batches). ``device`` pins the staging upload
+    (double-buffering callers stage batch k+1 while batch k runs).
+    Returns the ``[K, C + 3B]`` stacked device result — decode with
+    :func:`unpack_many_results`."""
+    import warnings
+
+    vecs = np.stack([flat_pack_args(a) for a in preps])
+    if device is not None:
+        vecs = jax.device_put(vecs, device)
+    with warnings.catch_warnings():
+        # the staging block rarely aliases an output shape, so XLA warns
+        # the donation was "not usable" at compile time; donation is a
+        # free-at-dispatch hint here, not an aliasing requirement
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        return match_many(
+            F_t, t1, meta, vecs, **_packed_geometry(preps[0]), **statics)
+
+
+def unpack_many_results(out, B: int, C: int):
+    """Decode :func:`match_many`'s stacked ``[K, C + 3B]`` result into K
+    ``(flat, pre, total, overflow)`` tuples with ONE host pull."""
+    o = np.asarray(out)
+    return [unpack_flat_result(o[i], B, C) for i in range(o.shape[0])]
 
 
 @functools.partial(jax.jit,
@@ -1238,3 +1286,100 @@ def apply_delta_fused(
 
 apply_delta_fused_copy = jax.jit(apply_delta_fused.__wrapped__,
                                  static_argnames=("D", "L", "id_bits"))
+
+
+@functools.partial(jax.jit, static_argnames=("D", "L", "id_bits"),
+                   donate_argnums=(0, 1, 2, 3, 4, 5, 6))
+def apply_delta_fused_nometa(
+    sub_words, sub_eff_len, has_hash, first_wild, active,  # table [S,·]
+    F_t, t1,                                               # coded operands
+    packed,                                                # delta_pack_args
+    *, D: int, L: int, id_bits: int,
+):
+    """:func:`apply_delta_fused` for matchers running packed_io=False
+    (no pack_meta word): the unpacked transport used to ship SIX arrays
+    and dispatch up to three scatter calls per delta flush — this keeps
+    the delta path at ONE upload + ONE fused scatter there too (the
+    BENCH_r05 delta_apply_ms_p99 cut: every extra per-flush dispatch is
+    a separate executable launch, and on the tunnel runtime a separate
+    round trip). Same donation contract as :func:`apply_delta_fused`.
+
+    Returns ``((sub_words, eff, hh, fw, ac), (F_t, t1))``.
+    """
+    o = 0
+    slots = packed[o:o + D]; o += D
+    w = packed[o:o + D * L].reshape(D, L); o += D * L
+    e = packed[o:o + D]; o += D
+    nh = packed[o:o + D].astype(bool); o += D
+    nf = packed[o:o + D].astype(bool); o += D
+    na = packed[o:o + D].astype(bool)
+    sub_words = sub_words.at[slots].set(w)
+    sub_eff_len = sub_eff_len.at[slots].set(e)
+    has_hash = has_hash.at[slots].set(nh)
+    first_wild = first_wild.at[slots].set(nf)
+    active = active.at[slots].set(na)
+    F_d, t1_d = build_operands(w, e, id_bits)
+    F_t = F_t.at[:, slots].set(F_d)
+    t1 = t1.at[slots].set(t1_d)
+    return ((sub_words, sub_eff_len, has_hash, first_wild, active),
+            (F_t, t1))
+
+
+apply_delta_fused_nometa_copy = jax.jit(
+    apply_delta_fused_nometa.__wrapped__,
+    static_argnames=("D", "L", "id_bits"))
+
+
+@functools.partial(jax.jit, static_argnames=("D", "L", "id_bits", "glob"),
+                   donate_argnums=tuple(range(12)))
+def apply_delta_windowed_fused(
+    F_t, t1, eff, hh, fw, act,          # 'sub'-sharded full-table arrays
+    Fg, t1g, effg, hhg, fwg, actg,      # replicated dense g-zone mirrors
+    packed,                             # delta_pack_args vector
+    *, D: int, L: int, id_bits: int, glob: int,
+):
+    """ONE fused scatter updating the sharded windowed matcher's whole
+    device state (full-table operands + the replicated dense-zone
+    mirrors) from one packed delta vector. The eager path this replaces
+    dispatched up to TEN separate scatters per flush (four metadata
+    arrays, the operand pair, and the same again for the g-zone) and
+    minted a fresh compile signature per dirty-in-zone COUNT via its
+    data-dependent ``slots[gsel]`` slice — the delta_apply_ms_p99 long
+    pole. Here the g-zone mirror is updated shape-stably: slots outside
+    the zone are routed to the out-of-range index ``glob`` and dropped
+    by the scatter (``mode="drop"``), so one compile per Dpad rung
+    serves every flush.
+
+    All twelve state arrays are DONATED (callers reassign from the
+    return, same contract as :func:`apply_delta`); use the ``_copy``
+    variant while a dispatched match still holds them.
+
+    Returns the twelve arrays in input order.
+    """
+    o = 0
+    slots = packed[o:o + D]; o += D
+    w = packed[o:o + D * L].reshape(D, L); o += D * L
+    e = packed[o:o + D]; o += D
+    nh = packed[o:o + D].astype(bool); o += D
+    nf = packed[o:o + D].astype(bool); o += D
+    na = packed[o:o + D].astype(bool)
+    F_d, t1_d = build_operands(w, e, id_bits)
+    F_t = F_t.at[:, slots].set(F_d)
+    t1 = t1.at[slots].set(t1_d)
+    eff = eff.at[slots].set(e)
+    hh = hh.at[slots].set(nh)
+    fw = fw.at[slots].set(nf)
+    act = act.at[slots].set(na)
+    gs = jnp.where(slots < glob, slots, glob)  # OOB → dropped below
+    Fg = Fg.at[:, gs].set(F_d, mode="drop")
+    t1g = t1g.at[gs].set(t1_d, mode="drop")
+    effg = effg.at[gs].set(e, mode="drop")
+    hhg = hhg.at[gs].set(nh, mode="drop")
+    fwg = fwg.at[gs].set(nf, mode="drop")
+    actg = actg.at[gs].set(na, mode="drop")
+    return (F_t, t1, eff, hh, fw, act, Fg, t1g, effg, hhg, fwg, actg)
+
+
+apply_delta_windowed_fused_copy = jax.jit(
+    apply_delta_windowed_fused.__wrapped__,
+    static_argnames=("D", "L", "id_bits", "glob"))

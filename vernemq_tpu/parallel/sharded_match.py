@@ -21,7 +21,22 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.5: top-level export, replication check named check_vma
+    from jax import shard_map as _shard_map
+    _SM_CHECK_KW = "check_vma"
+except ImportError:  # jax 0.4.x: experimental module, check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _SM_CHECK_KW = "check_rep"
+
+
+def shard_map(f, **kw):
+    """Version-tolerant shard_map: maps the ``check_vma`` kwarg to this
+    jax build's name for it (``check_rep`` before 0.5) so the kernels
+    compile on both the image's 0.4.x and newer runtimes."""
+    if "check_vma" in kw and _SM_CHECK_KW != "check_vma":
+        kw[_SM_CHECK_KW] = kw.pop("check_vma")
+    return _shard_map(f, **kw)
 
 from ..ops.match_kernel import extract_indices, match_mask_unrolled
 
@@ -164,7 +179,8 @@ def host_match(table, topic):
 # v3: the windowed production kernel under shard_map
 # ---------------------------------------------------------------------------
 
-from ..models.tpu_matcher import TILE_PUBS, _pow2ceil, prepare_windows
+from ..models.tpu_matcher import (TILE_PUBS, _pad_pub_block, _pow2ceil,
+                                  prepare_windows)
 from ..ops.match_kernel import (
     _epilogue,
     _pack_mask,
@@ -426,19 +442,23 @@ class ShardedWindowedMatcher:
         t.dirty.clear()
 
     def _sync_delta(self, donate: bool = True) -> None:
-        """Scatter dirty slots into the sharded device arrays (GSPMD
-        handles the sharded .at[].set under jit) — the delta path that
-        keeps churn from re-uploading the whole table. ``donate=False``
-        while a dispatched match still holds the buffers (the seat's
-        in-flight guard): the donating scatter would delete the arrays
-        under the in-flight call."""
+        """Scatter dirty slots into the sharded device arrays — ONE
+        packed upload + ONE fused jit scatter per flush
+        (``apply_delta_windowed_fused``: full-table operands, metadata
+        arrays and the replicated g-zone mirrors updated together,
+        GSPMD resolving the sharded .at[].set). The per-array eager
+        path this replaces dispatched up to ten scatters per flush and
+        recompiled on every distinct dirty-in-zone count — the
+        delta_apply_ms_p99 long pole. ``donate=False`` while a
+        dispatched match still holds the buffers (the seat's in-flight
+        guard): the donating scatter would delete the arrays under the
+        in-flight call."""
         import numpy as np
 
-        from ..ops.match_kernel import (apply_delta_operands,
-                                        apply_delta_operands_copy)
+        from ..ops.match_kernel import (apply_delta_windowed_fused,
+                                        apply_delta_windowed_fused_copy,
+                                        delta_pack_args)
 
-        delta_ops = apply_delta_operands if donate \
-            else apply_delta_operands_copy
         t = self.table
         slots = np.fromiter(t.dirty, dtype=np.int32)
         t.dirty.clear()
@@ -448,27 +468,14 @@ class ShardedWindowedMatcher:
         if Dpad != len(slots):
             slots = np.concatenate(
                 [slots, np.full(Dpad - len(slots), slots[-1], np.int32)])
-        (F_t, t1, eff, hh, fw, act,
-         Fg, t1g, effg, hhg, fwg, actg) = self._dev
-        d_words = t.words[slots]
-        d_eff = t.eff_len[slots]
-        eff = eff.at[slots].set(d_eff)
-        hh = hh.at[slots].set(t.has_hash[slots])
-        fw = fw.at[slots].set(t.first_wild[slots])
-        act = act.at[slots].set(t.active[slots])
-        F_t, t1 = delta_ops(F_t, t1, slots, d_words, d_eff,
-                            id_bits=self._bits)
-        gsel = slots < self._glob
-        if gsel.any():
-            gs = slots[gsel]
-            Fg, t1g = delta_ops(Fg, t1g, gs, t.words[gs],
-                                t.eff_len[gs], id_bits=self._bits)
-            effg = effg.at[gs].set(t.eff_len[gs])
-            hhg = hhg.at[gs].set(t.has_hash[gs])
-            fwg = fwg.at[gs].set(t.first_wild[gs])
-            actg = actg.at[gs].set(t.active[gs])
-        self._dev = (F_t, t1, eff, hh, fw, act,
-                     Fg, t1g, effg, hhg, fwg, actg)
+        packed = delta_pack_args(
+            slots, t.words[slots], t.eff_len[slots], t.has_hash[slots],
+            t.first_wild[slots], t.active[slots])
+        fused = (apply_delta_windowed_fused if donate
+                 else apply_delta_windowed_fused_copy)
+        self._dev = tuple(fused(
+            *self._dev, packed, D=len(slots), L=t.words.shape[1],
+            id_bits=self._bits, glob=self._glob))
 
     def _fn_for(self, Bpad: int, T: int, seg_max: int, gc: int, Cl: int,
                 glob: Optional[int] = None, S: Optional[int] = None,
@@ -526,13 +533,27 @@ class ShardedWindowedMatcher:
             pw[i], pl[i], pd[i], pb[i] = row, ln, dollar, bucket
         return self._prep_encoded(pw, pl, pd, pb, n)
 
-    def _prep_encoded(self, pw, pl, pd, pb, n: int):
+    def _pin_state(self) -> dict:
+        """Pin every live field the window prep reads, under the
+        caller's lock — so the heavy per-batch prep itself can run
+        AFTER release against a consistent view (the K-batch path preps
+        K batches; holding the lock K× prep time would push concurrent
+        flushes past their lock_busy_shed bound)."""
+        return {"S": self._S, "glob": self._glob, "bits": self._bits,
+                "dev": self._dev, "reg_start": self._reg_start,
+                "reg_end": self._reg_end, "ng": self.table.NG}
+
+    def _prep_encoded(self, pw, pl, pd, pb, n: int, pinned=None):
         """Window/tile prep for an ALREADY-ENCODED padded batch (pw
         [Bpad, L]; pb holds the n real publishes' buckets). Bpad must be
-        pow2-laddered and divisible by the 'batch' axis."""
+        pow2-laddered and divisible by the 'batch' axis. ``pinned`` (a
+        :meth:`_pin_state` snapshot) lets callers run this outside
+        their lock; without it the live state is read directly (then
+        run under the lock)."""
         import numpy as np
 
-        S, glob, nsub = self._S, self._glob, self.nsub
+        st = pinned or self._pin_state()
+        S, glob, nsub = st["S"], st["glob"], self.nsub
         nb = self.nb
         Sl = S // nsub
         Bpad = pw.shape[0]
@@ -543,14 +564,15 @@ class ShardedWindowedMatcher:
         real[:n] = True
         # per-shard pub assignment by bucket-row ownership (pads: -1)
         shard_of = np.full(Bpad, -1, dtype=np.int32)
-        shard_of[:n] = np.minimum(self._reg_start[pb] // Sl, nsub - 1)
+        reg_start, reg_end = st["reg_start"], st["reg_end"]
+        shard_of[:n] = np.minimum(reg_start[pb] // Sl, nsub - 1)
         slot_tiles = max(1, -(-Bl // TILE_PUBS))
         # level-0 buckets only: the g-zone (regions 1..NG) is matched
         # densely here and must not inflate the window size
-        ng = self.table.NG
-        bucket_max = (int((self._reg_end[1 + ng:]
-                           - self._reg_start[1 + ng:]).max())
-                      if len(self._reg_start) > 1 + ng else 0)
+        ng = st["ng"]
+        bucket_max = (int((reg_end[1 + ng:]
+                           - reg_start[1 + ng:]).max())
+                      if len(reg_start) > 1 + ng else 0)
         # window must divide into 2048 blocks (packed extraction) and fit
         # the shard slice; Sl itself may not be 2048-aligned
         sl_cap = Sl - Sl % 2048
@@ -576,7 +598,7 @@ class ShardedWindowedMatcher:
                 sel = lo + mine
                 (tsc, tss, tof, pof, left) = prepare_windows(
                     pw[sel], pl[sel], pd[sel], pb[sel],
-                    len(mine), self._reg_start, self._reg_end, S, T,
+                    len(mine), reg_start, reg_end, S, T,
                     seg_max, row_lo=s * Sl, row_hi=(s + 1) * Sl,
                     emit="sel")
                 # map compact-space selectors back to row-local indices
@@ -589,11 +611,27 @@ class ShardedWindowedMatcher:
                     leftovers.add(int(sel[li]))
         return {
             "geom": (Bpad, T, seg_max, gc, Cl),
-            "glob": glob, "S": S, "bits": self._bits, "Bl": Bl,
-            "dev": self._dev, "leftovers": leftovers,
+            "glob": glob, "S": S, "bits": st["bits"], "Bl": Bl,
+            "dev": st["dev"], "leftovers": leftovers,
             "args": (pw, pl, pd, real, t_sel, t_start, a_tile, a_pos,
                      shard_of),
         }
+
+    def _dispatch_device(self, p):
+        """Launch the device half of a prepped batch WITHOUT pulling the
+        results — jax dispatch is async, so a caller can launch several
+        prepped batches back to back (upload/compute overlapped in the
+        device queue) and only then pull: the seat's pipelined
+        match_many path."""
+        fn = self._fn_for(*p["geom"], glob=p["glob"], S=p["S"],
+                          bits=p["bits"])
+        return fn(*p["dev"], *p["args"])
+
+    @staticmethod
+    def _pull(res):
+        import numpy as np
+
+        return tuple(np.asarray(x) for x in res[:4])
 
     def _dispatch(self, p):
         """Run the device half of a prepped batch. Returns np arrays —
@@ -601,12 +639,7 @@ class ShardedWindowedMatcher:
         pre/cnt/ovf [nb, nsub, Bl]; merged flat [nb, Cl], pre/cnt/ovf
         [nb, Bl]. Consumers must go through :meth:`slots_for` /
         :meth:`_overflowed`, which encapsulate the layout."""
-        import numpy as np
-
-        fn = self._fn_for(*p["geom"], glob=p["glob"], S=p["S"],
-                          bits=p["bits"])
-        res = fn(*p["dev"], *p["args"])
-        return tuple(np.asarray(x) for x in res[:4])
+        return self._pull(self._dispatch_device(p))
 
     def slots_for(self, i, flat, pre, cnt, Bl):
         """Device-result slot ids for publish ``i`` under the configured
@@ -828,11 +861,18 @@ class ShardedTpuMatcher(TpuMatcher):
             self.match_batches += 1
             self.match_publishes += len(topics)
         try:
-            flat, pre, cnt, ovf = sw._dispatch(p)
+            pulled = sw._dispatch(p)
             self._warm_sigs.add(sig)
         finally:
             with self.lock:
                 self._inflight -= 1
+        return self._resolve_sharded(topics, p, pulled, snapshot)
+
+    def _resolve_sharded(self, topics, p, pulled, snapshot):
+        """Result resolution for one pulled sharded batch (shared by
+        match_batch and the pipelined match_many)."""
+        sw = self._swm
+        flat, pre, cnt, ovf = pulled
         Bl, leftovers = p["Bl"], p["leftovers"]
         out = []
         for i, topic in enumerate(topics):
@@ -848,6 +888,80 @@ class ShardedTpuMatcher(TpuMatcher):
                     rows = rows + self.table.overflow.match(list(topic))
             out.append(rows)
         return out
+
+    @property
+    def supports_match_many(self) -> bool:
+        """The sharded seat pipelines any bucketed table (launch-all-
+        then-pull) — no packed transport requirement."""
+        t = self.table
+        return bool(t.bucketed and t.id_bits)
+
+    def match_many(self, batches, _warmup: bool = False,
+                   lock_timeout=None, require_warm: bool = False):
+        """The sharded seat's multi-batch pipeline: all K batches are
+        encoded and window-prepped against ONE consistent table snapshot
+        (one lock hold, one sync), then every batch is LAUNCHED before
+        any result is pulled — jax's async dispatch overlaps the K
+        uploads and shard_map executions in the device queue, so the
+        host pays one pipeline fill instead of K serialized round
+        trips. Results per batch match K independent match_batch
+        calls."""
+        import numpy as np
+
+        batches = [list(b) for b in batches]
+        if not batches:
+            return []
+        if lock_timeout is None:
+            self.lock.acquire()
+        elif not self.lock.acquire(timeout=lock_timeout):
+            self.busy_sheds += 1
+            raise MatcherBusy(cold=False)
+        try:
+            self.sync()
+            sw = self._swm
+            snapshot = self._entries_snapshot
+            # common Bpad: all K share one compile signature
+            Bpad = max(self._pad_batch(len(b)) for b in batches)
+            # only the encode (table interner) needs the lock; the heavy
+            # window prep runs on the pinned state AFTER release, like
+            # the base matcher — holding the lock K× prep time would
+            # push concurrent flushes past their lock_busy_shed bound
+            encoded = []
+            for topics in batches:
+                pw, pl, pd, pb, _gb = self._encode_batch_ex(topics)
+                pw, pl, pd = _pad_pub_block(pw, pl, pd, Bpad)
+                encoded.append((pw, pl, pd, pb))
+            pinned = sw._pin_state()
+            self._inflight += 1
+        finally:
+            self.lock.release()
+        n_pubs = sum(len(b) for b in batches)
+        if _warmup:
+            self.warmup_batches += len(batches)
+            self.warmup_publishes += n_pubs
+        else:
+            self.match_batches += len(batches)
+            self.match_publishes += n_pubs
+        try:
+            preps = [sw._prep_encoded(pw, pl, pd, pb, len(topics),
+                                      pinned=pinned)
+                     for topics, (pw, pl, pd, pb) in zip(batches, encoded)]
+            sig = (("sharded-many", len(batches)) + preps[0]["geom"]
+                   + (preps[0]["glob"], preps[0]["S"]))
+            if require_warm and sig not in self._warm_sigs:
+                self.busy_sheds += 1
+                raise MatcherBusy(cold=True)
+            # launch ALL batches, then pull — the pipelined dispatch
+            refs = [sw._dispatch_device(p) for p in preps]
+            pulled = [sw._pull(r) for r in refs]
+            self._warm_sigs.add(sig)
+            if not _warmup:
+                self.super_dispatches += 1
+        finally:
+            with self.lock:
+                self._inflight -= 1
+        return [self._resolve_sharded(topics, p, pl_, snapshot)
+                for topics, p, pl_ in zip(batches, preps, pulled)]
 
     def _pad_batch(self, n: int) -> int:
         # mirror _prep's Bpad ladder (divisible by the 'batch' axis) so
