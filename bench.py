@@ -568,6 +568,104 @@ def config4_shared_retained(jax, rng, table, pools, batch, bench_stats):
     }
 
 
+def config6_fault_storm(jax_mod, rng, n_subs, batch, smoke):
+    """Robustness config: publish service through a device outage.
+
+    Three phases against one bucketed matcher + an exact trie oracle:
+    healthy (device path), storm (persistent injected device-dispatch
+    faults — the breaker opens and every batch serves from the host
+    trie, parity-checked), recovery (faults cleared — time until the
+    half-open probe closes the breaker and the device path serves
+    again). Reports per-publish p50/p99 in each mode and the
+    recovery time; `parity_ok` asserts ZERO wrong fanouts while
+    degraded."""
+    from vernemq_tpu.models.tpu_matcher import DeviceDegraded, TpuMatcher
+    from vernemq_tpu.models.trie import SubscriptionTrie
+    from vernemq_tpu.robustness import faults
+    from vernemq_tpu.robustness.breaker import CircuitBreaker
+
+    n = min(n_subs, 50_000) if smoke else min(n_subs, 500_000)
+    m = TpuMatcher(max_levels=8,
+                   initial_capacity=1 << (n - 1).bit_length())
+    m.breaker = CircuitBreaker(failure_threshold=3, backoff_initial=0.05,
+                               backoff_max=0.4)
+    trie = SubscriptionTrie()
+    for i in range(n):
+        f = [f"r{i % 64}", f"d{i % 257}",
+             "+" if i % 11 == 0 else f"m{i % 31}"]
+        m.table.add(f, i, None)
+        trie.add(list(f), i, None)
+
+    def mk_topics(b):
+        return [(f"r{rng.randrange(64)}", f"d{rng.randrange(257)}",
+                 f"m{rng.randrange(31)}") for _ in range(b)]
+
+    def norm(rows):
+        return sorted((tuple(f), k) for f, k, _ in rows)
+
+    b = min(batch, 256)
+    iters = 8 if smoke else 30
+    m.match_batch(mk_topics(b))  # build + warm the shape
+
+    def run_phase(check_parity=False):
+        lats = []
+        bad = 0
+        for _ in range(iters):
+            topics = mk_topics(b)
+            t0 = time.perf_counter()
+            try:
+                got = m.match_batch(topics)
+            except DeviceDegraded:
+                # the production degraded path: exact host-trie service
+                got = [trie.match(list(t)) for t in topics]
+            lats.append((time.perf_counter() - t0) / b)
+            if check_parity:
+                for t, rows in zip(topics, got):
+                    if norm(rows) != norm(trie.match(list(t))):
+                        bad += 1
+        lats.sort()
+        return lats, bad
+
+    healthy, _ = run_phase()
+    faults.install(faults.FaultPlan(
+        [faults.FaultRule("device.*", kind="error")], seed=1))
+    degraded, bad = run_phase(check_parity=True)
+    storm_state = m.breaker.state_name
+
+    faults.clear()
+    t0 = time.perf_counter()
+    recovery_s = None
+    deadline = t0 + 30.0
+    while time.perf_counter() < deadline:
+        try:
+            m.match_batch(mk_topics(b))
+        except DeviceDegraded:
+            pass
+        if m.breaker.state_name == "closed":
+            recovery_s = time.perf_counter() - t0
+            break
+        time.sleep(0.02)
+    post, _ = run_phase()
+
+    def pct(lats, q):
+        return round(lats[min(len(lats) - 1, int(q * len(lats)))] * 1e6, 2)
+
+    return {
+        "subs": n, "batch": b,
+        "healthy_publish_us_p50": pct(healthy, 0.50),
+        "healthy_publish_us_p99": pct(healthy, 0.99),
+        "degraded_publish_us_p50": pct(degraded, 0.50),
+        "degraded_publish_us_p99": pct(degraded, 0.99),
+        "post_recovery_publish_us_p99": pct(post, 0.99),
+        "breaker_state_during_storm": storm_state,
+        "device_failures": m.device_failures,
+        "degraded_sheds": m.degraded_sheds,
+        "parity_ok": bad == 0,
+        "device_recovery_s": (round(recovery_s, 3)
+                              if recovery_s is not None else None),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--subs", type=int, default=1_000_000)
@@ -586,8 +684,10 @@ def main() -> int:
     ap.add_argument("--stack", type=int, default=8,
                     help="batches per executable for --variant "
                     "packed_stack")
-    ap.add_argument("--configs", default="1,2,3,4,5",
-                    help="which BASELINE configs to run (3 = headline)")
+    ap.add_argument("--configs", default="1,2,3,4,5,6",
+                    help="which BASELINE configs to run (3 = headline; "
+                    "6 = fault-storm robustness: publish p99 while the "
+                    "device path is down + breaker recovery time)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
     ap.add_argument("--kernel-only", action="store_true",
@@ -814,6 +914,10 @@ def main() -> int:
 
     if "5" in want:
         guarded("5_delta_stream_5m", _cfg5)
+
+    if "6" in want:
+        guarded("6_fault_storm", lambda: config6_fault_storm(
+            jax, rng, args.subs, args.batch, smoke))
 
     if headline is not None:
         value = headline["matches_per_sec"]

@@ -1,0 +1,298 @@
+"""Minimal ``hypothesis`` stand-in so the property tests run on images
+without the real package (ROADMAP open item: eight test modules used to
+fail/skip collection).
+
+:func:`install` is a no-op when real hypothesis imports; otherwise it
+registers fake ``hypothesis`` / ``hypothesis.strategies`` modules in
+``sys.modules`` implementing the subset this repo's tests use: ``given``
+/ ``settings`` / ``assume`` and the strategies ``sampled_from, lists,
+one_of, booleans, integers, text, binary, tuples, just,
+fixed_dictionaries, composite, data`` plus ``.filter``/``.map``.
+
+Draws are pseudo-random but **deterministic**: the stream is seeded from
+the test function's qualified name and the example index (stable across
+processes — no ``hash()`` randomization), so a failure reproduces on
+re-run. No shrinking: the failing example prints as-is.
+
+``max_examples`` is honored up to a cap (default 25, env
+``HYPOTHESIS_SHIM_MAX_EXAMPLES``) so the 200-300-example suites stay
+inside the tier-1 time budget; with real hypothesis installed the full
+counts run.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+import os
+import random
+import sys
+import types
+import zlib
+from typing import Any, Callable, Dict, Optional, Sequence
+
+_CAP = int(os.environ.get("HYPOTHESIS_SHIM_MAX_EXAMPLES", "25"))
+
+
+class Unsatisfiable(Exception):
+    """A .filter() predicate rejected every candidate."""
+
+
+class _Strategy:
+    def do_draw(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+    def filter(self, pred: Callable[[Any], bool]) -> "_Strategy":
+        return _Filtered(self, pred)
+
+    def map(self, fn: Callable[[Any], Any]) -> "_Strategy":
+        return _Mapped(self, fn)
+
+
+class _Filtered(_Strategy):
+    def __init__(self, base: _Strategy, pred):
+        self.base, self.pred = base, pred
+
+    def do_draw(self, rng):
+        for _ in range(200):
+            v = self.base.do_draw(rng)
+            if self.pred(v):
+                return v
+        raise Unsatisfiable(f"filter rejected 200 draws from {self.base}")
+
+
+class _Mapped(_Strategy):
+    def __init__(self, base: _Strategy, fn):
+        self.base, self.fn = base, fn
+
+    def do_draw(self, rng):
+        return self.fn(self.base.do_draw(rng))
+
+
+class _Lambda(_Strategy):
+    def __init__(self, draw_fn, name="strategy"):
+        self._draw, self._name = draw_fn, name
+
+    def do_draw(self, rng):
+        return self._draw(rng)
+
+    def __repr__(self):
+        return f"<{self._name}>"
+
+
+def _size(rng, min_size, max_size, default_span=10):
+    hi = max_size if max_size is not None else min_size + default_span
+    return rng.randint(min_size, max(min_size, hi))
+
+
+def sampled_from(seq) -> _Strategy:
+    seq = list(seq)
+    return _Lambda(lambda rng: seq[rng.randrange(len(seq))], "sampled_from")
+
+
+def booleans() -> _Strategy:
+    return _Lambda(lambda rng: rng.random() < 0.5, "booleans")
+
+
+def just(value) -> _Strategy:
+    return _Lambda(lambda rng: value, "just")
+
+
+def integers(min_value: Optional[int] = None,
+             max_value: Optional[int] = None) -> _Strategy:
+    lo = -(1 << 31) if min_value is None else min_value
+    hi = (1 << 31) if max_value is None else max_value
+    return _Lambda(lambda rng: rng.randint(lo, hi), "integers")
+
+
+_DEFAULT_ALPHABET = ("abcdefghijklmnopqrstuvwxyz"
+                     "ABC012 _-/#+$.\téΩ中")
+
+
+def text(alphabet: Optional[str] = None, *, min_size: int = 0,
+         max_size: Optional[int] = None) -> _Strategy:
+    chars = list(alphabet if alphabet is not None else _DEFAULT_ALPHABET)
+
+    def draw(rng):
+        n = _size(rng, min_size, max_size, 20)
+        return "".join(chars[rng.randrange(len(chars))] for _ in range(n))
+
+    return _Lambda(draw, "text")
+
+
+def binary(*, min_size: int = 0,
+           max_size: Optional[int] = None) -> _Strategy:
+    def draw(rng):
+        n = _size(rng, min_size, max_size, 20)
+        return bytes(rng.randrange(256) for _ in range(n))
+
+    return _Lambda(draw, "binary")
+
+
+def lists(elements: _Strategy, *, min_size: int = 0,
+          max_size: Optional[int] = None, unique: bool = False) -> _Strategy:
+    def draw(rng):
+        n = _size(rng, min_size, max_size, 10)
+        out = [elements.do_draw(rng) for _ in range(n)]
+        if unique:
+            seen, uniq = set(), []
+            for v in out:
+                if v not in seen:
+                    seen.add(v)
+                    uniq.append(v)
+            out = uniq
+        return out
+
+    return _Lambda(draw, "lists")
+
+
+def one_of(*strategies) -> _Strategy:
+    if len(strategies) == 1 and isinstance(strategies[0], (list, tuple)):
+        strategies = tuple(strategies[0])
+    return _Lambda(
+        lambda rng: strategies[rng.randrange(len(strategies))].do_draw(rng),
+        "one_of")
+
+
+def tuples(*strategies) -> _Strategy:
+    return _Lambda(
+        lambda rng: tuple(s.do_draw(rng) for s in strategies), "tuples")
+
+
+def fixed_dictionaries(mapping: Dict[Any, _Strategy]) -> _Strategy:
+    items = list(mapping.items())
+    return _Lambda(
+        lambda rng: {k: s.do_draw(rng) for k, s in items},
+        "fixed_dictionaries")
+
+
+def composite(fn):
+    """``@st.composite`` — the wrapped function receives ``draw``."""
+
+    def builder(*args, **kwargs):
+        def draw_one(rng):
+            return fn(lambda s: s.do_draw(rng), *args, **kwargs)
+
+        return _Lambda(draw_one, f"composite:{fn.__name__}")
+
+    return builder
+
+
+class _DataObject:
+    def __init__(self, rng):
+        self._rng = rng
+
+    def draw(self, strategy, label=None):
+        return strategy.do_draw(self._rng)
+
+
+def data() -> _Strategy:
+    return _Lambda(lambda rng: _DataObject(rng), "data")
+
+
+# ------------------------------------------------------------ given/settings
+
+class _Settings:
+    def __init__(self, max_examples: int = 100, **_ignored):
+        self.max_examples = max_examples
+
+    def __call__(self, fn):
+        fn._hyp_settings = self
+        return fn
+
+
+def settings(max_examples: int = 100, **kwargs):
+    return _Settings(max_examples=max_examples, **kwargs)
+
+
+def assume(condition) -> bool:
+    """Real hypothesis retries the example; the shim treats a failed
+    assumption as a (cheap) no-op pass of this example."""
+    if not condition:
+        raise _AssumptionFailed
+    return True
+
+
+class _AssumptionFailed(Exception):
+    pass
+
+
+def given(*garg_strategies, **gkw_strategies):
+    def decorate(fn):
+        base_settings = getattr(fn, "_hyp_settings", None)
+        sig = inspect.signature(fn)
+        params = list(sig.parameters.values())
+        # positional strategies bind to the RIGHTMOST params (hypothesis
+        # semantics); anything left of them — pytest fixtures like
+        # tmp_path_factory — stays in the exposed signature
+        if garg_strategies:
+            fixture_params = params[:len(params) - len(garg_strategies)]
+        else:
+            fixture_params = [p for p in params
+                              if p.name not in gkw_strategies]
+        seed_base = zlib.crc32(
+            f"{fn.__module__}.{fn.__qualname__}".encode())
+
+        @functools.wraps(fn)
+        def wrapper(*fixture_args, **fixture_kwargs):
+            st_obj = (getattr(wrapper, "_hyp_settings", None)
+                      or base_settings or _Settings())
+            n = min(st_obj.max_examples, _CAP)
+            for i in range(max(1, n)):
+                rng = random.Random(f"{seed_base}:{i}")
+                drawn = [s.do_draw(rng) for s in garg_strategies]
+                kw = {k: s.do_draw(rng)
+                      for k, s in gkw_strategies.items()}
+                try:
+                    fn(*fixture_args, *drawn, **fixture_kwargs, **kw)
+                except _AssumptionFailed:
+                    continue
+                except Unsatisfiable:
+                    continue
+                except Exception:
+                    print(f"shim-hypothesis falsifying example "
+                          f"(#{i}): args={drawn!r} kwargs={kw!r}",
+                          file=sys.stderr)
+                    raise
+
+        wrapper.__signature__ = sig.replace(parameters=fixture_params)
+        wrapper.is_hypothesis_test = True
+        return wrapper
+
+    return decorate
+
+
+class HealthCheck:
+    """Attribute sink: ``suppress_health_check=[HealthCheck.x]``."""
+
+    def __getattr__(self, name):
+        return name
+
+
+def install() -> bool:
+    """Register the shim under ``hypothesis`` unless the real package is
+    importable. Returns True when the shim is active."""
+    if "hypothesis" in sys.modules:
+        return getattr(sys.modules["hypothesis"], "_IS_SHIM", False)
+    try:
+        import hypothesis  # noqa: F401 — the real one wins
+
+        return False
+    except ImportError:
+        pass
+    mod = types.ModuleType("hypothesis")
+    mod._IS_SHIM = True
+    mod.given = given
+    mod.settings = settings
+    mod.assume = assume
+    mod.HealthCheck = HealthCheck()
+    mod.Unsatisfiable = Unsatisfiable
+    st_mod = types.ModuleType("hypothesis.strategies")
+    for name in ("sampled_from", "booleans", "just", "integers", "text",
+                 "binary", "lists", "one_of", "tuples",
+                 "fixed_dictionaries", "composite", "data"):
+        setattr(st_mod, name, globals()[name])
+    mod.strategies = st_mod
+    sys.modules["hypothesis"] = mod
+    sys.modules["hypothesis.strategies"] = st_mod
+    return True

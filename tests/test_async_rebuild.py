@@ -328,3 +328,34 @@ async def test_broker_keeps_delivering_through_rebuild():
     finally:
         await b.stop()
         await server.stop()
+
+
+def test_delta_warm_ladder_pre_compiles_production_shapes():
+    """warm_delta_ladder's throwaway zero-array compiles must land in
+    the SAME executable cache the production delta path uses — a real
+    post-warm delta may not trigger a compile (the
+    sub_to_matchable_ms_max tail this warm exists to remove)."""
+    import vernemq_tpu.ops.match_kernel as K
+
+    rng = random.Random(17)
+    m = TpuMatcher(max_levels=8, initial_capacity=16384)
+    trie = SubscriptionTrie()
+    fill(m, trie, 3000, "w", rng)
+    check_device(m, trie, [("r1", "d1", "w1")])  # first build
+    before = K.apply_delta_fused._cache_size()
+    before_copy = K.apply_delta_fused_copy._cache_size()
+    assert m.warm_delta_ladder(16) == 4  # Dpad 2,4,8,16
+    assert m.delta_shapes_warmed == 4
+    # >= not ==: the jit cache is process-global and another test's
+    # leaked background warm can land a compile concurrently
+    assert K.apply_delta_fused._cache_size() >= before + 4
+    # the COPYING variant (selected while a match is in flight — the
+    # common case under traffic) must be warmed too
+    assert K.apply_delta_fused_copy._cache_size() >= before_copy + 4
+    # THE assertion: a real 1-slot delta (Dpad=2) after the warm must
+    # HIT the warmed executable, not mint a new one
+    after_warm = K.apply_delta_fused._cache_size()
+    fill(m, trie, 1, "zz", rng)
+    check_device(m, trie, [("r1", "d1", "zz0")])
+    assert K.apply_delta_fused._cache_size() == after_warm, \
+        "production delta recompiled despite the warm"

@@ -112,7 +112,11 @@ def partition(a: Node, b: Node):
 def heal(a: Node, b: Node):
     for x, y in ((a, b), (b, a)):
         w = x.cluster._writers.get(y.broker.node_name)
-        w.addr = w._real_addr
+        # a late join/member-change event may have REPLACED the severed
+        # writer (addr mismatch → rebuild) with one already pointing at
+        # the real address; that writer has no _real_addr marker and
+        # needs no healing
+        w.addr = getattr(w, "_real_addr", w.addr)
 
 
 async def connected(node: Node, client_id, **kw):

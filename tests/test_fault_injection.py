@@ -1,0 +1,496 @@
+"""Fault-injection harness + device-path circuit breaker tests (the
+robustness tentpole): deterministic injection sequences, breaker state
+machine, matcher degradation to the exact host trie with ZERO dropped or
+wrong fanouts, and end-to-end broker recovery without a restart."""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from vernemq_tpu.models.trie import SubscriptionTrie
+from vernemq_tpu.models.tpu_matcher import DeviceDegraded, TpuMatcher
+from vernemq_tpu.robustness import faults
+from vernemq_tpu.robustness.breaker import CircuitBreaker
+from vernemq_tpu.robustness.faults import FaultPlan, FaultRule, InjectedFault
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plan():
+    """The fault registry is process-global: never leak a plan across
+    tests (a leaked persistent-error rule would fail the whole suite)."""
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def norm(rows):
+    return sorted((tuple(f), k) for f, k, _ in rows)
+
+
+def build_matcher(n_subs=3000, cap=16384, threshold=2, backoff=0.05):
+    """Bucketed matcher + trie oracle fed identical corpora, with a
+    fast-recovery breaker for tests."""
+    rng = random.Random(7)
+    m = TpuMatcher(max_levels=8, initial_capacity=cap)
+    m.breaker = CircuitBreaker(failure_threshold=threshold,
+                               backoff_initial=backoff, backoff_max=backoff,
+                               jitter=0.0)
+    trie = SubscriptionTrie()
+    for i in range(n_subs):
+        f = [f"r{i % 16}", f"d{i % 40}", rng.choice(["+", f"m{i % 16}"])]
+        m.table.add(f, i, None)
+        trie.add(list(f), i, None)
+    return m, trie
+
+
+def topics_for(rng, n=16):
+    return [(f"r{rng.randrange(16)}", f"d{rng.randrange(40)}",
+             f"m{rng.randrange(16)}") for _ in range(n)]
+
+
+# ------------------------------------------------------------- determinism
+
+def test_identical_seeds_produce_identical_sequences():
+    """The acceptance property: replaying the same seed yields the same
+    injection decisions at every point, independent of how hits on
+    OTHER points interleave between runs."""
+    def run(seed, interleave):
+        plan = FaultPlan([FaultRule("device.dispatch", probability=0.5),
+                          FaultRule("cluster.recv", probability=0.3)],
+                         seed=seed)
+        seq = []
+        for i in range(64):
+            if interleave and i % 3 == 0:  # extra foreign-point hits
+                plan.decide("store.write")
+            for point in ("device.dispatch", "cluster.recv"):
+                d = plan.decide(point)
+                seq.append((point, d[0] if d else None))
+        return seq
+
+    a = run(42, interleave=False)
+    b = run(42, interleave=True)
+    assert a == b, "same seed must replay the same per-point sequence"
+    c = run(43, interleave=False)
+    assert a != c, "different seed should produce a different sequence"
+
+
+def test_rule_after_count_and_latency():
+    plan = faults.install(FaultPlan([
+        FaultRule("p.err", kind="error", after=2, count=2),
+        FaultRule("p.lat", kind="latency", latency_ms=30.0),
+    ]))
+    # first two hits skipped (after=2), next two fire, then exhausted
+    fired = []
+    for _ in range(6):
+        try:
+            faults.inject("p.err")
+            fired.append(False)
+        except InjectedFault:
+            fired.append(True)
+    assert fired == [False, False, True, True, False, False]
+    assert plan.rules[0].fired == 2
+    t0 = time.perf_counter()
+    faults.inject("p.lat")
+    assert time.perf_counter() - t0 >= 0.025
+    assert plan.injected == 2 and plan.delayed == 1
+
+
+@pytest.mark.asyncio
+async def test_cluster_recv_async_injection():
+    faults.install(FaultPlan([
+        FaultRule("cluster.recv", kind="latency", latency_ms=20.0,
+                  count=1),
+        FaultRule("cluster.recv", kind="error", after=1),
+    ]))
+    t0 = time.perf_counter()
+    await faults.inject_async("cluster.recv")  # latency first
+    assert time.perf_counter() - t0 >= 0.015
+    with pytest.raises(InjectedFault):
+        await faults.inject_async("cluster.recv")
+
+
+# ---------------------------------------------------------------- breaker
+
+def test_breaker_state_machine():
+    clock = [0.0]
+    br = CircuitBreaker(failure_threshold=3, backoff_initial=1.0,
+                        backoff_max=4.0, jitter=0.0,
+                        clock=lambda: clock[0])
+    assert br.allow() and br.is_closed
+    br.record_failure()
+    br.record_failure()
+    assert br.is_closed  # below threshold
+    assert br.record_failure()  # third consecutive: OPEN edge
+    assert br.state_name == "open" and not br.allow()
+    clock[0] = 0.5
+    assert not br.allow()  # backoff not elapsed
+    clock[0] = 1.1
+    assert br.allow()  # the single half-open probe
+    assert not br.allow()  # probe slot taken
+    br.record_failure()  # failed probe: reopen, doubled backoff
+    assert br.state_name == "open"
+    clock[0] = 2.0
+    assert not br.allow()  # 2s backoff now: 1.1 + 2.0 > 2.0
+    clock[0] = 3.2
+    assert br.allow()
+    assert br.record_success()  # recovery edge
+    assert br.is_closed and br.closes == 1 and br.opens == 2
+    assert br.time_degraded() == pytest.approx(3.2, abs=1e-6)
+    # success resets the failure run AND the backoff ramp
+    br.record_failure()
+    br.record_failure()
+    assert br.is_closed
+
+
+def test_breaker_success_interrupts_failure_run():
+    br = CircuitBreaker(failure_threshold=3)
+    br.record_failure()
+    br.record_failure()
+    br.record_success()
+    br.record_failure()
+    br.record_failure()
+    assert br.is_closed  # never 3 consecutive
+
+
+def test_half_open_probe_abort_does_not_wedge():
+    """A granted half-open probe that exits WITHOUT a device verdict
+    (matcher lock busy) must hand the slot back: breaker returns to
+    open (same backoff) and a later probe can still recover — it must
+    never wedge in half_open with the probe slot leaked."""
+    import threading
+
+    from vernemq_tpu.models.tpu_matcher import MatcherBusy
+
+    m, trie = build_matcher(n_subs=500, threshold=1, backoff=0.05)
+    m.match_batch(topics_for(random.Random(0), 4))  # build + warm
+    faults.install(FaultPlan([FaultRule("device.dispatch", count=1)]))
+    with pytest.raises(DeviceDegraded):
+        m.match_batch(topics_for(random.Random(1), 4))
+    assert m.breaker.state_name == "open"
+    faults.clear()
+    time.sleep(0.08)  # past the backoff: next call wins the probe
+    held = threading.Event()
+    release = threading.Event()
+
+    def hold_lock():
+        with m.lock:
+            held.set()
+            release.wait(5.0)
+
+    t = threading.Thread(target=hold_lock)
+    t.start()
+    held.wait(5.0)
+    try:
+        with pytest.raises(MatcherBusy):
+            m.match_batch(topics_for(random.Random(2), 4),
+                          lock_timeout=0.01)
+    finally:
+        release.set()
+        t.join()
+    # probe handed back, not leaked
+    assert m.breaker.state_name == "open"
+    assert m.breaker.probe_aborts == 1
+    time.sleep(0.08)
+    got = m.match_batch(topics_for(random.Random(3), 4))  # real probe
+    assert m.breaker.state_name == "closed"
+    assert all(rows is not None for rows in got)
+
+
+@pytest.mark.asyncio
+async def test_boot_fault_plan_cleared_on_broker_stop():
+    """A plan installed from config must die with its broker — the
+    registry is process-global and other instances in the same process
+    must not inherit the faults."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+
+    b, s = await start_broker(
+        Config(allow_anonymous=True, systree_enabled=False,
+               fault_injection=[{"point": "store.write",
+                                 "kind": "error"}],
+               fault_injection_seed=3),
+        port=0, node_name="boot-plan")
+    assert faults.active() is not None and faults.active().seed == 3
+    await b.stop()
+    await s.stop()
+    assert faults.active() is None
+
+
+# ------------------------------------- matcher degradation + recovery
+
+def test_matcher_degrades_to_host_and_recovers():
+    """Persistent device faults: every batch still gets EXACT results
+    (host trie fallback on DeviceDegraded), the breaker opens (so the
+    device is no longer poked per batch), and after the fault clears the
+    half-open probe restores the device path — no rebuild, no restart."""
+    m, trie = build_matcher()
+    rng = random.Random(3)
+    m.match_batch(topics_for(rng))  # warm + first build, healthy
+
+    faults.install(FaultPlan([FaultRule("device.*", kind="error")]))
+    served = 0
+    for i in range(6):
+        topics = topics_for(rng)
+        try:
+            got = m.match_batch(topics)
+        except DeviceDegraded:
+            # degraded mode: the caller's exact host fallback — the
+            # production seat uses the registry trie; parity-check the
+            # matcher's own host path here
+            got = [m._host_match(t) for t in topics]
+        for t, rows in zip(topics, got):
+            assert norm(rows) == norm(trie.match(list(t))), t
+        served += len(topics)
+    assert served == 96  # zero dropped publishes
+    assert m.breaker.state_name == "open"
+    assert m.device_failures >= m.breaker.failure_threshold
+    assert m.degraded_sheds > 0  # later batches never touched the device
+
+    # fault clears; past the backoff the next real batch is the probe
+    faults.clear()
+    deadline = time.monotonic() + 5.0
+    while m.breaker.state_name != "closed":
+        time.sleep(0.06)
+        topics = topics_for(rng)
+        try:
+            got = m.match_batch(topics)
+            for t, rows in zip(topics, got):
+                assert norm(rows) == norm(trie.match(list(t))), t
+        except DeviceDegraded:
+            pass
+        assert time.monotonic() < deadline, "breaker never closed"
+    assert m.breaker.closes >= 1
+    # device path live again: a fresh batch matches exactly on-device
+    topics = topics_for(rng)
+    for t, rows in zip(topics, m.match_batch(topics)):
+        assert norm(rows) == norm(trie.match(list(t))), t
+
+
+def test_delta_upload_fault_forces_rebuild_and_stays_exact():
+    """A failed delta scatter must not leave the device serving stale
+    rows: the matcher re-arms a full rebuild and the next sync
+    re-converges."""
+    m, trie = build_matcher(threshold=99)  # keep the breaker closed
+    rng = random.Random(5)
+    m.match_batch(topics_for(rng))  # build
+    faults.install(FaultPlan([FaultRule("device.delta", count=1)]))
+    m.table.add(["r1", "d1", "mnew"], "new-key", None)
+    trie.add(["r1", "d1", "mnew"], "new-key", None)
+    with pytest.raises(DeviceDegraded):
+        m.match_batch([("r1", "d1", "m1")])
+    assert m.table.resized  # repair armed: full rebuild on next sync
+    got = m.match_batch([("r1", "d1", "mnew")])[0]
+    assert norm(got) == norm(trie.match(["r1", "d1", "mnew"]))
+
+
+def test_first_build_fault_is_retryable():
+    m, trie = build_matcher(n_subs=500, threshold=99)
+    faults.install(FaultPlan([FaultRule("device.rebuild", count=1)]))
+    with pytest.raises(DeviceDegraded):
+        m.match_batch([("r1", "d1", "m1")])
+    got = m.match_batch([("r1", "d1", "m1")])[0]  # retry succeeds
+    assert norm(got) == norm(trie.match(["r1", "d1", "m1"]))
+
+
+def test_no_breaker_propagates_raw_error():
+    m, _ = build_matcher(n_subs=200)
+    m.breaker = None
+    m.match_batch([("r1", "d1", "m1")])
+    faults.install(FaultPlan([FaultRule("device.dispatch")]))
+    with pytest.raises(InjectedFault):
+        m.match_batch([("r1", "d1", "m1")])
+
+
+# ----------------------------------------------------- broker end-to-end
+
+async def _drain(client, n, timeout=10.0):
+    return [await client.recv(timeout) for _ in range(n)]
+
+
+@pytest.mark.asyncio
+async def test_broker_serves_and_recovers_through_device_outage():
+    """Acceptance: with persistent device-dispatch faults the broker
+    serves EVERY publish via host-trie degraded mode; when the fault
+    clears the breaker closes and matching returns to the device path —
+    same process, no restart."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    b, s = await start_broker(
+        Config(allow_anonymous=True, systree_enabled=False,
+               default_reg_view="tpu", tpu_host_batch_threshold=0,
+               # unbounded lock wait => require_warm off: flushes
+               # dispatch into the device even while the background
+               # warm ladder is still compiling, so the injected
+               # dispatch faults are actually reached (with the busy
+               # shed on, cold flushes would serve from the trie
+               # without ever touching the device)
+               tpu_lock_busy_shed_ms=0,
+               tpu_breaker_failure_threshold=2,
+               tpu_breaker_backoff_initial_ms=50,
+               tpu_breaker_backoff_max_ms=50),
+        port=0, node_name="fault-node")
+    try:
+        sub = MQTTClient(s.host, s.port, client_id="sub")
+        await sub.connect()
+        await sub.subscribe("f/+/t", qos=0)
+        await sub.subscribe("f/#", qos=0)
+        pub = MQTTClient(s.host, s.port, client_id="pub")
+        await pub.connect()
+
+        # healthy baseline through the device path
+        await pub.publish("f/0/t", b"warm", qos=0)
+        got = await _drain(sub, 2)
+        assert {m.payload for m in got} == {b"warm"}
+
+        matcher = b.registry.reg_view("tpu").matcher("")
+        faults.install(FaultPlan([FaultRule("device.*", kind="error")]))
+        payloads = set()
+        for i in range(8):
+            # drain between publishes: each is its own flush, so the
+            # breaker sees consecutive dispatch failures (one coalesced
+            # batch would count once)
+            await pub.publish(f"f/{i}/t", b"deg%d" % i, qos=0)
+            payloads.update(m.payload for m in await _drain(sub, 2))
+            await asyncio.sleep(0.01)
+        # both filters match every publish: 16 deliveries, none dropped
+        assert sorted(payloads) == [b"deg%d" % i for i in range(8)]
+        assert matcher.breaker.state_name == "open"
+        col = b.batch_collector()
+        assert col.degraded_host_pubs > 0  # trie served the outage
+
+        # outage ends: publishes past the backoff probe the device and
+        # close the breaker — service continues throughout
+        faults.clear()
+        deadline = time.monotonic() + 8.0
+        seq = 0
+        while matcher.breaker.state_name != "closed":
+            assert time.monotonic() < deadline, "no recovery"
+            await pub.publish("f/r/t", b"rec%d" % seq, qos=0)
+            await _drain(sub, 2)
+            seq += 1
+            await asyncio.sleep(0.06)
+        before = matcher.match_batches
+        await pub.publish("f/9/t", b"post", qos=0)
+        got = await _drain(sub, 2)
+        assert {m.payload for m in got} == {b"post"}
+        assert matcher.match_batches > before  # device path serving again
+        # degraded-mode observability reached the metrics surface
+        stats = b.registry.stats()
+        assert stats["tpu_breaker_opens"] >= 1
+        assert stats["tpu_breaker_closes"] >= 1
+        assert stats["tpu_breaker_state"] == 0
+        assert stats["tpu_breaker_time_degraded_seconds"] > 0
+        await sub.close()
+        await pub.close()
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+@pytest.mark.asyncio
+async def test_store_write_fault_does_not_fail_enqueue():
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.message import Msg
+    from vernemq_tpu.broker.server import start_broker
+
+    b, s = await start_broker(Config(allow_anonymous=True,
+                                     systree_enabled=False),
+                              port=0, node_name="store-fault")
+    try:
+        faults.install(FaultPlan([FaultRule("store.write")]))
+        b.store_offline(("", "cid"),
+                        Msg(topic=("a",), payload=b"x", qos=1))
+        assert b.metrics.value("msg_store_write_errors") == 1
+        assert b.metrics.value("msg_store_ops_write") == 0
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+# ------------------------------------------------------- admin commands
+
+@pytest.mark.asyncio
+async def test_admin_fault_and_breaker_commands():
+    from vernemq_tpu.admin.commands import (CommandRegistry,
+                                            register_core_commands)
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+
+    reg = register_core_commands(CommandRegistry())
+    b, s = await start_broker(
+        Config(allow_anonymous=True, systree_enabled=False,
+               default_reg_view="tpu"),
+        port=0, node_name="admin-fault")
+    try:
+        assert reg.run(b, ["fault", "show"]) == "no fault plan installed"
+        reg.run(b, ["fault", "inject", "point=device.dispatch",
+                    "count=5", "seed=9"])
+        assert faults.active() is not None
+        assert faults.active().seed == 9
+        table = reg.run(b, ["fault", "show"])["table"]
+        assert any(r.get("point") == "device.dispatch" for r in table)
+        # breaker drill: trip forces degraded mode, reset restores
+        b.registry.reg_view("tpu").matcher("")
+        out = reg.run(b, ["breaker", "trip"])
+        assert "tripped 1" in out
+        rows = reg.run(b, ["breaker", "show"])["table"]
+        assert rows[0]["state"] == "forced_open"
+        # pinned: no backoff expiry or stray success may close it
+        m = b.registry.reg_view("tpu").matcher("")
+        assert not m.breaker.allow()
+        assert not m.breaker.record_success()
+        assert rows[0]["state"] == "forced_open"
+        reg.run(b, ["breaker", "reset"])
+        rows = reg.run(b, ["breaker", "show"])["table"]
+        assert rows[0]["state"] == "closed"
+        assert "cleared" in reg.run(b, ["fault", "clear"])
+        assert faults.active() is None
+    finally:
+        await b.stop()
+        await s.stop()
+
+
+# ------------------------------------------------------------ chaos soak
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_storm_parity_soak():
+    """Opt-in soak (-m chaos): random fault storms toggling on and off
+    for ~30s while continuously asserting exact-match parity against
+    the trie oracle."""
+    m, trie = build_matcher(n_subs=5000)
+    rng = random.Random(1234)
+    m.match_batch(topics_for(rng))
+    end = time.monotonic() + 30.0
+    storm = False
+    while time.monotonic() < end:
+        if rng.random() < 0.15:
+            storm = not storm
+            if storm:
+                faults.install(FaultPlan(
+                    [FaultRule("device.*", kind="error",
+                               probability=rng.choice([0.5, 1.0]))],
+                    seed=rng.randrange(1 << 16)))
+            else:
+                faults.clear()
+        topics = topics_for(rng, 32)
+        try:
+            got = m.match_batch(topics)
+        except DeviceDegraded:
+            got = [m._host_match(t) for t in topics]
+        for t, rows in zip(topics, got):
+            assert norm(rows) == norm(trie.match(list(t))), t
+    faults.clear()
+    # the matcher must be able to come back after the storm
+    deadline = time.monotonic() + 10.0
+    while m.breaker is not None and not m.breaker.is_closed:
+        assert time.monotonic() < deadline
+        time.sleep(0.06)
+        try:
+            m.match_batch(topics_for(rng))
+        except DeviceDegraded:
+            pass

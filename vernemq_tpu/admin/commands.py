@@ -227,6 +227,18 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
     reg.register(["api-key", "show"], _api_key_show, "vmq-admin api-key show")
     reg.register(["api-key", "delete"], _api_key_delete,
                  "vmq-admin api-key delete key=KEY")
+    reg.register(["fault", "show"], _fault_show, "vmq-admin fault show")
+    reg.register(["fault", "inject"], _fault_inject,
+                 "vmq-admin fault inject point=P [kind=error|latency|hang] "
+                 "[probability=1.0] [after=0] [count=-1] [latency-ms=0] "
+                 "[seed=0]")
+    reg.register(["fault", "clear"], _fault_clear, "vmq-admin fault clear")
+    reg.register(["breaker", "show"], _breaker_show,
+                 "vmq-admin breaker show")
+    reg.register(["breaker", "trip"], _breaker_trip,
+                 "vmq-admin breaker trip [mountpoint=]")
+    reg.register(["breaker", "reset"], _breaker_reset,
+                 "vmq-admin breaker reset [mountpoint=]")
     reg.register(["api-key", "add"], _api_key_add,
                  "vmq-admin api-key add key=KEY")
     return reg
@@ -854,3 +866,101 @@ def _api_key_delete(broker, flags):
 
 def valid_api_key(broker, key: str) -> bool:
     return broker.metadata.get(API_KEY_PREFIX, key) is not None
+
+
+# ------------------------------------------------- robustness (fault/breaker)
+
+def _fault_show(broker, flags):
+    """Active fault plan: rules, per-point hit counts, fired totals."""
+    from ..robustness import faults
+
+    plan = faults.active()
+    if plan is None:
+        return "no fault plan installed"
+    st = plan.status()
+    rows = [{"rule": i, **r} for i, r in enumerate(st["rules"])]
+    for point, hits in sorted(st["hits"].items()):
+        rows.append({"rule": "", "point": point, "hits": hits})
+    return {"table": rows}
+
+
+def _fault_inject(broker, flags):
+    """Add a rule to the live fault plan (creating one if none active).
+    ``seed=`` only takes effect when the call creates the plan — a live
+    plan's streams must not be re-seeded mid-run."""
+    from ..robustness import faults
+
+    point = flags.get("point")
+    if not isinstance(point, str):
+        raise CommandError("point=NAME required (e.g. device.dispatch)")
+    rule = faults.FaultRule(
+        point=point,
+        kind=str(flags.get("kind", "error")),
+        probability=float(flags.get("probability", 1.0)),
+        after=int(flags.get("after", 0)),
+        count=int(flags.get("count", -1)),
+        latency_ms=float(flags.get("latency_ms",
+                                   flags.get("latency-ms", 0.0)) or 0.0),
+    )
+    if rule.kind not in ("error", "latency", "hang"):
+        raise CommandError("kind must be error, latency or hang")
+    plan = faults.active()
+    if plan is None:
+        plan = faults.install(
+            faults.FaultPlan(seed=int(flags.get("seed", 0))))
+    plan.add_rule(rule)
+    return (f"rule added to plan (seed {plan.seed}): {rule.as_dict()}")
+
+
+def _fault_clear(broker, flags):
+    from ..robustness import faults
+
+    was = faults.active()
+    faults.clear()
+    return ("fault plan cleared" if was is not None
+            else "no fault plan was installed")
+
+
+def _tpu_view(broker):
+    view = broker.registry.reg_views.get("tpu")
+    if view is None or not hasattr(view, "breaker_status"):
+        raise CommandError("tpu reg view not active")
+    return view
+
+
+def _breaker_show(broker, flags):
+    rows = []
+    for mp, st in _tpu_view(broker).breaker_status().items():
+        if st is None:
+            rows.append({"mountpoint": mp, "state": "disabled"})
+        else:
+            rows.append({"mountpoint": mp, **st})
+    return {"table": rows or [{"mountpoint": "(none)",
+                               "state": "no matchers yet"}]}
+
+
+def _each_breaker(broker, flags):
+    view = _tpu_view(broker)
+    want = flags.get("mountpoint")
+    for mp, m in view._matchers.items():
+        if want is not None and mp != want:
+            continue
+        if m.breaker is not None:
+            yield mp, m.breaker
+
+
+def _breaker_trip(broker, flags):
+    """Force the breaker open (drill the degraded path in production)."""
+    n = 0
+    for _, br in _each_breaker(broker, flags):
+        br.trip()
+        n += 1
+    return f"tripped {n} breaker(s): matching serves from the host trie"
+
+
+def _breaker_reset(broker, flags):
+    n = 0
+    for _, br in _each_breaker(broker, flags):
+        br.reset()
+        n += 1
+    return f"reset {n} breaker(s)"

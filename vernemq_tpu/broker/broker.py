@@ -18,6 +18,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..protocol import topic as T
 from ..protocol.types import Will
+from ..robustness import faults
 from ..storage.msg_store import FileMsgStore, MemoryMsgStore, MsgStore
 from .config import Config
 from .message import Msg, SubscriberId
@@ -132,6 +133,31 @@ class Broker:
             "tpu_saturated_merges": "Flushes merged into a later batch "
                                     "(both pipeline slots busy).",
             "tpu_async_rebuilds": "Background device-table rebuilds.",
+            # degraded-mode observability (robustness tentpole): breaker
+            # state + fallback/fault counters, published to $SYS like
+            # every other metric by the systree reporter
+            "tpu_breaker_state": "Device circuit breaker state "
+                                 "(0 closed, 1 half-open, 2 open; worst "
+                                 "across mountpoints).",
+            "tpu_breaker_opens": "Breaker open transitions (device path "
+                                 "degraded to the host trie).",
+            "tpu_breaker_closes": "Breaker close transitions (device "
+                                  "path recovered).",
+            "tpu_breaker_time_degraded_seconds":
+                "Cumulative seconds the device path spent degraded.",
+            "tpu_device_failures": "Device dispatch/upload failures fed "
+                                   "to the breaker.",
+            "tpu_degraded_sheds": "Match calls refused while the "
+                                  "breaker was open.",
+            "tpu_degraded_host_pubs": "Publishes the host trie served "
+                                      "while the breaker was open.",
+            "tpu_delta_shapes_warmed": "Delta-scatter shapes "
+                                       "pre-compiled at startup.",
+            "fault_plan_active": "1 while a fault-injection plan is "
+                                 "installed.",
+            "faults_injected": "Faults raised by the active plan.",
+            "faults_delayed": "Latency/hang faults applied by the "
+                              "active plan.",
         })
 
     # ------------------------------------------------------------ plumbing
@@ -385,7 +411,22 @@ class Broker:
     # ------------------------------------------------------ offline storage
 
     def store_offline(self, sid: SubscriberId, msg: Msg) -> None:
-        self.msg_store.write(sid, msg)
+        try:
+            # loop-side synchronous seam: injected latency models a slow
+            # disk blocking the loop exactly like the real store would,
+            # but capped so a hang drill stays a stall, not an outage
+            faults.inject("store.write", max_delay_s=1.0)
+            self.msg_store.write(sid, msg)
+        except Exception:
+            # degraded, not fatal: the in-memory queue still holds the
+            # message, so live delivery is unaffected — only the
+            # crash-restart durability of THIS message is lost. A failed
+            # write must never fail the enqueue (the reference's store
+            # is likewise fire-and-forget from the queue's view).
+            self.metrics.incr("msg_store_write_errors")
+            log.exception("offline store write failed for %s "
+                          "(message kept in memory only)", sid)
+            return
         self.metrics.incr("msg_store_ops_write")
 
     def recover_offline(self, sid: SubscriberId, queue: SubscriberQueue) -> None:
@@ -532,10 +573,26 @@ class Broker:
         for key, value in self.metadata.fold("retain"):
             self.retain.apply_remote(key[0], tuple(key[1:]),
                                      self._retain_term(value))
+        # boot-time fault plan (robustness harness): deterministic
+        # injected faults per the fault_injection config — empty list =
+        # nothing installed, zero overhead
+        plan_spec = self.config.get("fault_injection", [])
+        if plan_spec:
+            self._boot_fault_plan = faults.install(
+                faults.FaultPlan.from_config(
+                    plan_spec,
+                    seed=self.config.get("fault_injection_seed", 0)))
+            log.warning("fault-injection plan ACTIVE at boot: %d rules, "
+                        "seed %s", len(plan_spec),
+                        self.config.get("fault_injection_seed", 0))
         # crash-restart supervision (vmq_server_sup one_for_one analog)
         from .supervisor import Supervisor
 
-        self.supervisor = Supervisor(self)
+        self.supervisor = Supervisor(
+            self,
+            max_restarts=self.config.get("supervisor_max_restarts", 20),
+            restart_window=self.config.get("supervisor_restart_window",
+                                           60.0))
         self.supervisor.watch_listeners()
         if self.config.systree_enabled:
             self.supervisor.spawn("systree", self.start_systree)
@@ -575,7 +632,9 @@ class Broker:
                 self,
                 lag_threshold=self.config.get("sysmon_lag_threshold", 0.25),
                 memory_high_watermark=self.config.get(
-                    "sysmon_memory_high_watermark", 0))
+                    "sysmon_memory_high_watermark", 0),
+                lag_exit_ratio=self.config.get("sysmon_lag_exit_ratio",
+                                               0.5))
             self.sysmon.start()
         from .sysmon import CrlRefresher
 
@@ -624,5 +683,17 @@ class Broker:
             await self.listeners.stop_all()
         for server in self._servers:
             server.close()
+        # wind down the tpu view's background warm threads (they hold no
+        # broker state, but must not keep compiling into a dead matcher)
+        tpu_view = self.registry.reg_views.get("tpu")
+        if tpu_view is not None and hasattr(tpu_view, "close"):
+            tpu_view.close()
+        # the fault registry is process-global: a plan THIS broker
+        # installed at boot must not keep injecting into other broker
+        # instances in the process (multi-node tests, embedding) — but
+        # leave a plan installed live via the admin surface alone
+        if (getattr(self, "_boot_fault_plan", None) is not None
+                and faults.active() is self._boot_fault_plan):
+            faults.clear()
         self.msg_store.close()
         self.metadata.close()

@@ -105,6 +105,32 @@ DEFAULTS: Dict[str, Any] = {
     # device dispatch (match_many super-batches: K round trips -> 1,
     # the continuous-batching posture); 1 disables
     "tpu_super_batch_k": 8,
+    # device-path circuit breaker (robustness/breaker.py): N consecutive
+    # dispatch failures open it — ALL matching serves from the exact
+    # host trie until a half-open probe (exponential backoff + jitter
+    # between attempts, bounded by the max) succeeds and the matcher
+    # re-warms. Disabled = raw device errors propagate to publishers.
+    "tpu_breaker_enabled": True,
+    "tpu_breaker_failure_threshold": 3,
+    "tpu_breaker_backoff_initial_ms": 200,
+    "tpu_breaker_backoff_max_ms": 10_000,
+    # pre-compile the delta-scatter shape ladder (Dpad 2..this) at
+    # matcher startup so the first post-subscribe flush pays a scatter,
+    # not a compile (the sub_to_matchable_ms_max tail); 0 disables
+    "tpu_delta_warm_max": 128,
+    # deterministic fault injection (robustness/faults.py): a list of
+    # rule dicts ({point, kind, probability, after, count, latency_ms})
+    # installed at boot; also live-toggleable via `vmq-admin fault ...`.
+    # Empty = no plan, zero overhead.
+    "fault_injection": [],
+    "fault_injection_seed": 0,
+    # supervisor restart budget: more than max_restarts CONSECUTIVE
+    # crashy restarts of one child escalates (listener teardown — the
+    # node fails health checks instead of crash-looping forever); a
+    # stint healthier than the current backoff, or longer than
+    # restart_window seconds, resets the count. 0 = unlimited.
+    "supervisor_max_restarts": 20,
+    "supervisor_restart_window": 60.0,
     # systree / metrics
     "systree_enabled": True,
     "systree_interval": 20,
@@ -148,6 +174,9 @@ DEFAULTS: Dict[str, Any] = {
     "sysmon_enabled": True,
     "sysmon_lag_threshold": 0.25,  # seconds of event-loop lag = long_schedule
     "sysmon_memory_high_watermark": 0,  # bytes RSS; 0 = off (large_heap)
+    # overload exits only after lag stays below threshold * this ratio
+    # for a full cooldown (hysteresis — no shed/unshed flap at the edge)
+    "sysmon_lag_exit_ratio": 0.5,
     "crl_refresh_interval": 60.0,  # seconds (vmq_crl_srv schema knob)
     "swc_replication_groups": 8,  # reference runs 10 (vmq_swc_plugin.erl:36-44)
     "swc_sync_interval": 2.0,  # seconds between AE rounds (sync_interval)

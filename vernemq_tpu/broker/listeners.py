@@ -38,6 +38,13 @@ class ListenerManager:
         kind = ALIASES.get(kind, kind)
         if kind not in KINDS:
             raise ValueError(f"unknown listener kind {kind!r}")
+        # fault-injection point: a simulated bind failure (EADDRINUSE,
+        # EMFILE, ...) — the watchdog's rebind-retry path is exercised
+        # by tests/test_restart_storm.py through this hook. Async
+        # variant: latency faults must not block the event loop.
+        from ..robustness import faults
+
+        await faults.inject_async("listener.bind")
         opts = dict(opts or {})
         ssl_context = None
         if kind in ("mqtts", "wss", "https", "vmqs"):

@@ -98,7 +98,18 @@ COUNTERS: List[Tuple[str, str]] = [
     ("tpu_match_publishes", "Publishes matched on the TPU path."),
     ("msg_store_ops_write", "Message store writes."),
     ("msg_store_ops_delete", "Message store deletes."),
+    ("msg_store_write_errors",
+     "Message store writes that failed (message kept in memory only)."),
     ("retain_messages_stored", "Retained messages persisted."),
+    # robustness (supervision tree analog + fault harness)
+    ("supervisor_restarts", "Supervised tasks restarted after a crash."),
+    ("supervisor_escalations",
+     "Supervised tasks abandoned after exceeding the restart budget "
+     "(listeners torn down)."),
+    ("sysmon_long_schedule",
+     "Event-loop lag events over the sysmon threshold."),
+    ("sysmon_large_heap",
+     "Forced GCs after crossing the memory high watermark."),
 ]
 
 

@@ -218,12 +218,21 @@ class Registry:
     def _make_tpu_view(self):
         from ..models.tpu_matcher import TpuRegView
 
+        cfg = self.broker.config
         return TpuRegView(
-            self, max_fanout=self.broker.config.tpu_max_fanout,
-            flat_avg=self.broker.config.tpu_flat_avg,
-            use_pallas=self.broker.config.tpu_use_pallas,
-            packed_io=self.broker.config.tpu_packed_io,
-            initial_capacity=self.broker.config.tpu_initial_capacity,
+            self, max_fanout=cfg.tpu_max_fanout,
+            flat_avg=cfg.tpu_flat_avg,
+            use_pallas=cfg.tpu_use_pallas,
+            packed_io=cfg.tpu_packed_io,
+            breaker_enabled=cfg.get("tpu_breaker_enabled", True),
+            breaker_failure_threshold=cfg.get(
+                "tpu_breaker_failure_threshold", 3),
+            breaker_backoff_initial=cfg.get(
+                "tpu_breaker_backoff_initial_ms", 200) / 1e3,
+            breaker_backoff_max=cfg.get(
+                "tpu_breaker_backoff_max_ms", 10_000) / 1e3,
+            delta_warm_max=cfg.get("tpu_delta_warm_max", 128),
+            initial_capacity=cfg.tpu_initial_capacity,
             mesh=self._mesh_from_config(),
         )
 
@@ -922,6 +931,27 @@ class Registry:
                     out.get("tpu_warmup_batches", 0) + m.warmup_batches
                 out["tpu_async_rebuilds"] = \
                     out.get("tpu_async_rebuilds", 0) + m.rebuilds_async
+                out["tpu_device_failures"] = \
+                    out.get("tpu_device_failures", 0) + m.device_failures
+                out["tpu_degraded_sheds"] = \
+                    out.get("tpu_degraded_sheds", 0) + m.degraded_sheds
+                out["tpu_delta_shapes_warmed"] = \
+                    out.get("tpu_delta_shapes_warmed", 0) \
+                    + m.delta_shapes_warmed
+                br = getattr(m, "breaker", None)
+                if br is not None:
+                    # state: worst across mountpoints (0 closed, 1
+                    # half-open, 2 open) — any open matcher means the
+                    # node is in degraded matching mode
+                    out["tpu_breaker_state"] = max(
+                        out.get("tpu_breaker_state", 0), br.state)
+                    out["tpu_breaker_opens"] = \
+                        out.get("tpu_breaker_opens", 0) + br.opens
+                    out["tpu_breaker_closes"] = \
+                        out.get("tpu_breaker_closes", 0) + br.closes
+                    out["tpu_breaker_time_degraded_seconds"] = round(
+                        out.get("tpu_breaker_time_degraded_seconds", 0.0)
+                        + br.time_degraded(), 3)
         col = getattr(self.broker, "_collector", None)
         if col is not None:
             # small flushes served host-side by hybrid dispatch
@@ -932,6 +962,12 @@ class Registry:
             out["tpu_rebuild_shed_pubs"] = col.rebuild_host_pubs
             # pubs the trie served past the matcher-lock busy bound
             out["tpu_busy_shed_pubs"] = col.busy_host_pubs
+            # pubs the trie served while the device breaker was open
+            out["tpu_degraded_host_pubs"] = col.degraded_host_pubs
+        # deterministic fault-injection harness (robustness/faults.py)
+        from ..robustness import faults as _faults
+
+        out.update(_faults.stats())
         return out
 
     def fold_subscriptions(self, mountpoint: str = ""):

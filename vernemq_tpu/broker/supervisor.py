@@ -19,15 +19,41 @@ log = logging.getLogger("vernemq_tpu.supervisor")
 
 
 class Supervisor:
-    """Restart-on-crash task supervision (one_for_one)."""
+    """Restart-on-crash task supervision (one_for_one).
+
+    Hardened against restart storms: backoff is exponential with jitter
+    and a hard cap (no thundering-herd restarts, no busy-spin when a
+    child crashes instantly every time), and a restart *budget* —
+    more than ``max_restarts`` CONSECUTIVE crashy restarts (a stint
+    healthier than the current backoff, or longer than
+    ``restart_window`` seconds, resets the count) — past which
+    supervision of that child ESCALATES instead of looping forever: the
+    child is abandoned, ``supervisor_escalations`` counts it, and the
+    broker's listeners are torn down so load balancers route around the
+    sick node (the OTP max-intensity analog: a supervisor that gives up
+    takes its subtree down rather than thrash). The budget is counted
+    in restarts, not wall-clock: exponential backoff spaces crashes
+    out, so a time window would never fill and escalation would be
+    unreachable."""
 
     def __init__(self, broker, backoff_initial: float = 0.5,
-                 backoff_max: float = 30.0):
+                 backoff_max: float = 30.0, jitter: float = 0.1,
+                 max_restarts: int = 0, restart_window: float = 60.0,
+                 rng=None):
+        import random
+
         self.broker = broker
         self.backoff_initial = backoff_initial
         self.backoff_max = backoff_max
+        self.jitter = jitter
+        # 0 = unlimited (no escalation) — the pre-hardening behavior
+        self.max_restarts = max_restarts
+        self.restart_window = restart_window
+        self._rng = rng or random.Random()
         self._tasks: Dict[str, asyncio.Task] = {}
         self.restarts: Dict[str, int] = {}
+        self.backoffs: Dict[str, float] = {}  # current per-child backoff
+        self.escalated: Dict[str, int] = {}   # children given up on
         self._stopped = False
 
     def spawn(self, name: str, factory: Callable[[], Awaitable[Any]]) -> None:
@@ -42,7 +68,10 @@ class Supervisor:
     async def _run(self, name: str,
                    factory: Callable[[], Awaitable[Any]]) -> None:
         backoff = self.backoff_initial
+        consecutive = 0
+        loop = asyncio.get_event_loop()
         while not self._stopped:
+            started = loop.time()
             try:
                 await factory()
                 return  # clean exit
@@ -53,10 +82,45 @@ class Supervisor:
                     return
                 self.restarts[name] = self.restarts.get(name, 0) + 1
                 self.broker.metrics.incr("supervisor_restarts")
+                # a healthy stint (longer than the current backoff, or
+                # past the restart window outright) resets the ramp AND
+                # the budget: only consecutive rapid crashes climb
+                # toward the cap / escalation
+                healthy_after = min(self.restart_window,
+                                    max(backoff, self.backoff_initial))
+                if loop.time() - started > healthy_after:
+                    backoff = self.backoff_initial
+                    consecutive = 0
+                consecutive += 1
+                if self.max_restarts and consecutive > self.max_restarts:
+                    await self._escalate(name)
+                    return
                 log.exception("supervised task %r crashed (restart #%d in "
                               "%.1fs)", name, self.restarts[name], backoff)
-                await asyncio.sleep(backoff)
+                # jittered sleep, capped: crash-looping children settle
+                # at backoff_max instead of busy-spinning, and several
+                # children felled by one cause don't restart in lockstep
+                await asyncio.sleep(
+                    backoff * (1.0 + self.jitter * self._rng.random()))
                 backoff = min(backoff * 2, self.backoff_max)
+                self.backoffs[name] = backoff
+
+    async def _escalate(self, name: str) -> None:
+        """The restart budget is spent: stop supervising ``name`` and
+        take the node out of rotation by tearing down its listeners —
+        a broker that cannot keep its children alive must fail its
+        health checks loudly, not limp with a dead subsystem."""
+        self.escalated[name] = self.escalated.get(name, 0) + 1
+        self.broker.metrics.incr("supervisor_escalations")
+        log.error("supervised task %r exceeded the restart budget "
+                  "(%d consecutive crashy restarts); escalating: tearing "
+                  "down listeners", name, self.max_restarts)
+        mgr = getattr(self.broker, "listeners", None)
+        if mgr is not None:
+            try:
+                await mgr.stop_all()
+            except Exception:
+                log.exception("listener teardown during escalation failed")
 
     def watch_listeners(self, interval: float = 1.0) -> None:
         """Listener watchdog: a listener whose asyncio server stopped

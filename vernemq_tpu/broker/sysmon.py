@@ -45,7 +45,8 @@ class Sysmon:
     def __init__(self, broker, interval: float = 1.0,
                  lag_threshold: float = 0.25,
                  memory_high_watermark: int = 0,
-                 overload_cooldown: float = 5.0):
+                 overload_cooldown: float = 5.0,
+                 lag_exit_ratio: float = 0.5):
         self.broker = broker
         self.interval = interval
         self.lag_threshold = lag_threshold
@@ -53,7 +54,14 @@ class Sysmon:
         # too unless configured)
         self.memory_high_watermark = memory_high_watermark
         self.overload_cooldown = overload_cooldown
+        # hysteresis: overload ENTERS at lag_threshold but only EXITS
+        # once lag stays below lag_threshold * lag_exit_ratio for a full
+        # cooldown — lag hovering at the boundary (the common overload
+        # shape: shedding lowers lag just below the threshold, which
+        # unsheds, which raises lag ...) must not flap the flag
+        self.lag_exit_ratio = lag_exit_ratio
         self.lag_events = 0
+        self.overload_extends = 0  # cooldowns re-armed by boundary lag
         self.gc_forced = 0
         self.last_lag = 0.0
         self.overloaded_until = 0.0
@@ -71,19 +79,32 @@ class Sysmon:
     def overloaded(self) -> bool:
         return time.monotonic() < self.overloaded_until
 
+    def observe_lag(self, lag: float) -> None:
+        """Fold one loop-lag sample into the overload state (split out
+        of the sampling loop so tests drive the hysteresis directly)."""
+        self.last_lag = lag
+        now = time.monotonic()
+        if lag > self.lag_threshold:
+            self.lag_events += 1
+            self.overloaded_until = now + self.overload_cooldown
+            self.broker.metrics.incr("sysmon_long_schedule")
+            log.warning("event loop lag %.3fs over threshold %.3fs — "
+                        "shedding load for %.1fs",
+                        lag, self.lag_threshold, self.overload_cooldown)
+        elif (self.overloaded
+              and lag > self.lag_threshold * self.lag_exit_ratio):
+            # boundary lag while shedding: keep the window armed (no
+            # log/metric spam — it's the same overload episode)
+            self.overload_extends += 1
+            self.overloaded_until = max(self.overloaded_until,
+                                        now + self.overload_cooldown)
+
     async def _run(self) -> None:
         while True:
             t0 = time.monotonic()
             await asyncio.sleep(self.interval)
             lag = time.monotonic() - t0 - self.interval
-            self.last_lag = lag
-            if lag > self.lag_threshold:
-                self.lag_events += 1
-                self.overloaded_until = time.monotonic() + self.overload_cooldown
-                self.broker.metrics.incr("sysmon_long_schedule")
-                log.warning("event loop lag %.3fs over threshold %.3fs — "
-                            "shedding load for %.1fs",
-                            lag, self.lag_threshold, self.overload_cooldown)
+            self.observe_lag(lag)
             if self.memory_high_watermark:
                 rss = rss_bytes()
                 if rss > self.memory_high_watermark:
@@ -95,6 +116,7 @@ class Sysmon:
         return {
             "last_loop_lag_s": round(self.last_lag, 4),
             "lag_events": self.lag_events,
+            "overload_extends": self.overload_extends,
             "gc_forced": self.gc_forced,
             "overloaded": self.overloaded,
             "rss_bytes": rss_bytes(),

@@ -17,6 +17,7 @@ import struct
 from typing import Optional
 
 from . import codec
+from ..robustness import faults
 from .node import term_to_msg
 
 log = logging.getLogger("vernemq_tpu.cluster")
@@ -52,6 +53,18 @@ class ClusterCom:
                 (length,) = struct.unpack(">I", hdr[8:12])
                 blob = await reader.readexactly(length)
                 self.cluster.metrics.incr("cluster_bytes_received", length)
+                try:
+                    # fault-injection point for the inter-node link:
+                    # `error` drops this batch (the partition/packet-loss
+                    # probe — AE repairs the gap), `latency` delays it
+                    # without blocking other connections
+                    await faults.inject_async("cluster.recv")
+                except faults.InjectedFault:
+                    self.cluster.metrics.incr("cluster_bytes_dropped",
+                                              length)
+                    log.warning("injected fault dropped a %d-byte "
+                                "cluster batch from %s", length, origin)
+                    continue
                 self._process(origin, blob)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass

@@ -27,6 +27,8 @@ from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..ops import match_kernel as K
+from ..robustness import faults
+from ..robustness.breaker import CircuitBreaker
 from .tpu_table import SubscriptionTable
 
 Row = Tuple[Tuple[str, ...], Hashable, Any]
@@ -220,6 +222,19 @@ class MatcherBusy(Exception):
         self.cold = cold
 
 
+class DeviceDegraded(Exception):
+    """The device match path is unavailable (circuit breaker open, or
+    this very dispatch just failed and tripped/fed the breaker).
+
+    Raised by ``match_batch``/``match_many`` instead of surfacing raw
+    device errors: callers serve the batch from the exact host trie —
+    the same correctness oracle the rebuild/busy sheds use — so a TPU
+    outage degrades to host-path latency, never to lost or wrong
+    fanouts. The breaker's half-open probe lets one real batch through
+    per backoff window; when it succeeds the matcher re-warms and the
+    device path resumes without a restart."""
+
+
 class RebuildInProgress(Exception):
     """The device table is re-uploading after a capacity change.
 
@@ -312,6 +327,27 @@ class TpuMatcher:
         self._warm_sigs: set = set()
         self._warming: set = set()
         self.warm_failures = 0  # background shape compiles that died
+        # device-path circuit breaker (robustness/breaker.py): N
+        # consecutive dispatch failures flip ALL matching to the host
+        # trie until a half-open probe succeeds. Always present — a raw
+        # device exception escaping the matcher would fail publishes —
+        # but reconfigurable (TpuRegView applies the tpu_breaker_*
+        # knobs; None disables and re-raises device errors verbatim).
+        self.breaker: Optional[CircuitBreaker] = CircuitBreaker()
+        self.device_failures = 0   # dispatch/upload errors fed to it
+        self.degraded_sheds = 0    # calls refused while open (host-served)
+        self.delta_shapes_warmed = 0  # pre-compiled scatter ladder rungs
+        # last real traffic shape, for the post-recovery re-warm
+        self._last_shape: Optional[tuple] = None
+        # set by close(): background warm loops check it between rungs
+        # so a stopped broker's threads wind down instead of compiling
+        # shapes into a dead matcher
+        self._closed = False
+
+    def close(self) -> None:
+        """Stop background warm work (broker shutdown / view teardown).
+        Idempotent; in-flight matches complete normally."""
+        self._closed = True
 
     # ------------------------------------------------------- full (re)build
 
@@ -350,6 +386,7 @@ class TpuMatcher:
     def _build_device(self, state: dict) -> tuple:
         """Device-side half of a full build (no lock held): upload the
         snapshot and derive the coded operands + packed meta."""
+        faults.inject("device.rebuild")
         put = lambda a: self._jax.device_put(a, self.device)
         dev = (put(state["words"]), put(state["eff_len"]),
                put(state["has_hash"]), put(state["first_wild"]),
@@ -378,8 +415,8 @@ class TpuMatcher:
             try:
                 topics = [("warmup", "ladder", str(i)) for i in range(Bpad)]
                 self.match_batch(topics, _warmup=True)
-            except RebuildInProgress:
-                pass  # table rebuilding — retried on the next shed
+            except (RebuildInProgress, DeviceDegraded):
+                pass  # table rebuilding / breaker open — retried later
             except Exception:
                 # a shape that cannot compile pins its traffic on the
                 # trie forever; that must be diagnosable, not silent
@@ -395,6 +432,75 @@ class TpuMatcher:
 
         threading.Thread(target=_w, name=f"tpu-warm-{Bpad}",
                          daemon=True).start()
+
+    # -------------------------------------------------- breaker discipline
+
+    def _breaker_gate(self, warmup: bool) -> bool:
+        """Refuse device work while the breaker is open (DeviceDegraded:
+        the caller serves from the host trie). Real traffic may win the
+        half-open probe slot; warmups never do — a dummy batch must not
+        consume the one probe per backoff window. Returns True when THIS
+        call holds the probe (the caller must hand it back via
+        ``probe_aborted`` if it exits without a device verdict)."""
+        br = self.breaker
+        if br is None:
+            return False
+        if warmup:
+            if not br.is_closed:
+                raise DeviceDegraded("breaker not closed; warmup refused")
+            return False
+        if not br.allow():
+            self.degraded_sheds += 1
+            raise DeviceDegraded("device circuit open")
+        return br.state_name == "half_open"
+
+    def _record_device_failure(self, exc: BaseException) -> None:
+        """Feed a device dispatch/upload failure to the breaker and
+        re-raise as DeviceDegraded (host trie serves this batch). With
+        no breaker installed the original error propagates verbatim."""
+        self.device_failures += 1
+        br = self.breaker
+        if br is None:
+            raise exc
+        import logging
+
+        if br.record_failure():
+            logging.getLogger("vernemq_tpu.matcher").error(
+                "device path OPENED after %d consecutive failures "
+                "(last: %s); all matching degrades to the host trie",
+                br.failure_threshold, exc)
+        raise DeviceDegraded(f"device dispatch failed: {exc!r}") from exc
+
+    def _record_device_success(self, warmup: bool = False) -> None:
+        br = self.breaker
+        if br is None:
+            return
+        if warmup and not br.is_closed:
+            # a warmup that entered dispatch BEFORE the outage landed
+            # can complete after the breaker opened; its stale success
+            # must not close the breaker — only a real traffic probe
+            # proves the device path is back
+            return
+        if br.record_success():
+            import logging
+
+            logging.getLogger("vernemq_tpu.matcher").warning(
+                "device path recovered (probe succeeded after %.1fs "
+                "degraded); re-warming and closing the breaker",
+                br.time_degraded())
+            self._rewarm_after_recovery()
+
+    def _rewarm_after_recovery(self) -> None:
+        """Background-compile the last live traffic shape after the
+        breaker closes, so the first post-recovery flushes of that size
+        find a warm signature instead of shedding cold."""
+        shape = self._last_shape
+        if shape is None:
+            return
+        if shape[0] == "many":
+            self.ensure_warm_many(shape[1], shape[2])
+        else:
+            self.ensure_warm(shape[1])
 
     def _install_built(self, built: tuple, state: dict) -> None:
         """Publish a finished build as the serving state (lock held)."""
@@ -499,6 +605,30 @@ class TpuMatcher:
         for s in slots:
             snap[s] = t.entries[s]
         self._entries_snapshot = snap
+        try:
+            self._apply_delta_device(slots)
+        except Exception:
+            # the dirty set is already consumed but the device scatter
+            # did not land: without repair the device table serves stale
+            # rows forever. Re-arm `resized` so the next sync takes the
+            # full-rebuild path (host and device re-converge), and let
+            # the error feed the caller's breaker.
+            t.resized = True
+            raise
+        # region geometry may have moved WITHOUT a resize (bucket
+        # relocation into the spare tail) — refresh the window view
+        self._reg_start = t.reg_start.copy()
+        self._reg_end = (t.reg_start + t.reg_cap).copy()
+
+    def _apply_delta_device(self, slots: np.ndarray) -> None:
+        """Device half of a delta sync: scatter the (padded) ``slots``
+        of the host table into the device arrays. Lock held; callers
+        come through :meth:`sync` only (:meth:`warm_delta_ladder`
+        deliberately bypasses this — it compiles the same kernels
+        against throwaway zero arrays, outside the lock and without
+        the fault hook)."""
+        faults.inject("device.delta")
+        t = self.table
         sw, el, hh, fw, ac = self._dev_arrays
         # donating scatters update in place (a 128-slot delta at 5M subs
         # otherwise copies ~500MB of HBM, ~300ms measured); fall back to
@@ -550,10 +680,80 @@ class TpuMatcher:
                       else K.apply_delta_meta_copy)
                 self._meta = dm(self._meta, slots_dev, e_dev, hh_dev,
                                 fw_dev, ac_dev)
-        # region geometry may have moved WITHOUT a resize (bucket
-        # relocation into the spare tail) — refresh the window view
-        self._reg_start = t.reg_start.copy()
-        self._reg_end = (t.reg_start + t.reg_cap).copy()
+
+    def warm_delta_ladder(self, max_delta: int = 128) -> int:
+        """Pre-compile the delta-scatter shape ladder (Dpad = 2..pow2 ≤
+        ``max_delta``) so the first post-subscribe flush after boot pays
+        a scatter, not a compile — the ``sub_to_matchable_ms_max`` tail
+        chaser (ROADMAP). Returns rungs compiled.
+
+        The lock is held only to snapshot the table GEOMETRY; every
+        compile runs against throwaway zero arrays of the live shapes
+        (jit caches key on shapes/dtypes/statics, so production deltas
+        hit the warmed executables) — holding the lock across a
+        multi-second first-compile would shed every live flush AND
+        block real delta syncs for the duration, the exact stall this
+        warm exists to remove."""
+        with self.lock:
+            try:
+                self.sync()  # first build, or bail during a rebuild
+            except RebuildInProgress:
+                return 0
+            if self._dev_arrays is None:
+                return 0
+            shapes = [(a.shape, np.dtype(a.dtype))
+                      for a in self._dev_arrays]
+            op_shapes = ([(a.shape, np.dtype(a.dtype))
+                          for a in self._operands]
+                         if self._operands is not None else None)
+            meta_shape = ((self._meta.shape, np.dtype(self._meta.dtype))
+                          if self._meta is not None else None)
+            bits = self._ops_bits
+            L = self.table.words.shape[1]
+        put = lambda a: self._jax.device_put(a, self.device)
+
+        def zeros(specs):
+            return tuple(put(np.zeros(sh, dt)) for sh, dt in specs)
+
+        done = 0
+        d = 2
+        while d <= max_delta:
+            if self._closed:
+                return done
+            slots = np.zeros(d, dtype=np.int32)
+            zw = np.zeros((d, L), np.int32)
+            zi = np.zeros(d, np.int32)
+            zb = np.zeros(d, dtype=bool)
+            # warm the donating AND the copying executables: production
+            # picks the *_copy variants whenever a dispatched match
+            # still holds the arrays (_inflight > 0) — under continuous
+            # traffic that is the COMMON case, and each variant is a
+            # separate jitted program
+            if op_shapes is not None:
+                packed = put(K.delta_pack_args(slots, zw, zi, zb, zb, zb))
+                if meta_shape is not None:
+                    for fn in (K.apply_delta_fused,
+                               K.apply_delta_fused_copy):
+                        fn(*zeros(shapes), *zeros(op_shapes),
+                           *zeros([meta_shape]), packed,
+                           D=d, L=L, id_bits=bits)
+                else:
+                    for fn in (K.apply_delta_fused_nometa,
+                               K.apply_delta_fused_nometa_copy):
+                        fn(*zeros(shapes), *zeros(op_shapes), packed,
+                           D=d, L=L, id_bits=bits)
+            else:
+                for fn in (K.apply_delta, K.apply_delta_copy):
+                    fn(*zeros(shapes), put(slots), put(zw),
+                       put(zi), put(zb), put(zb), put(zb))
+                if meta_shape is not None:
+                    for fn in (K.apply_delta_meta, K.apply_delta_meta_copy):
+                        fn(*zeros([meta_shape]), put(slots),
+                           put(zi), put(zb), put(zb), put(zb))
+            self.delta_shapes_warmed += 1
+            done += 1
+            d *= 2
+        return done
 
     # ---------------------------------------------------------------- match
 
@@ -632,11 +832,13 @@ class TpuMatcher:
         done = 0
         b = 1
         while b <= max_batch:
+            if self._closed:
+                return done
             topics = [("warmup", "ladder", str(i)) for i in range(b)]
             try:
                 self.match_batch(topics, _warmup=True)
-            except RebuildInProgress:
-                return done  # table rebuilding: warm the rest on demand
+            except (RebuildInProgress, DeviceDegraded):
+                return done  # rebuilding / breaker open: warm on demand
             done += 1
             b *= 2
         return done
@@ -654,13 +856,36 @@ class TpuMatcher:
         stall live traffic; ``ensure_warm`` compiles it off to the side."""
         if not topics:
             return []
+        probe = self._breaker_gate(_warmup)
+        try:
+            return self._match_batch_impl(topics, _warmup, lock_timeout,
+                                          require_warm)
+        except BaseException:
+            if probe:
+                # the granted half-open probe exited without a device
+                # verdict (lock busy / rebuild shed / cold shape, or
+                # any host-side error before dispatch): hand the slot
+                # back so the breaker can't wedge half-open — no-op
+                # when a recorded failure already re-opened it
+                self.breaker.probe_aborted()
+            raise
+
+    def _match_batch_impl(self, topics, _warmup, lock_timeout,
+                          require_warm) -> List[List[Row]]:
         if lock_timeout is None:
             self.lock.acquire()
         elif not self.lock.acquire(timeout=lock_timeout):
             self.busy_sheds += 1
             raise MatcherBusy(cold=False)
         try:
-            self.sync()
+            try:
+                self.sync()
+            except RebuildInProgress:
+                raise
+            except Exception as e:
+                # a failed upload (delta scatter / inline build) is a
+                # device failure: feed the breaker, serve host-side
+                self._record_device_failure(e)
             dev_arrays = self._dev_arrays
             operands = self._operands
             meta = self._meta
@@ -681,6 +906,7 @@ class TpuMatcher:
         else:
             self.match_batches += 1
             self.match_publishes += len(topics)
+            self._last_shape = ("batch", len(topics))
         try:
             if bucketed:
                 idx_rows, need_host = self._match_windowed(
@@ -702,6 +928,7 @@ class TpuMatcher:
                 if require_warm and sig not in self._warm_sigs:
                     self.busy_sheds += 1
                     raise MatcherBusy(cold=True)
+                faults.inject("device.dispatch")
                 matcher = K.match_extract_mxu if fast else K.match_extract
                 idx, valid, count = matcher(
                     *dev_arrays, pw, pl, pd, k=self.max_fanout, chunk=chunk
@@ -712,6 +939,12 @@ class TpuMatcher:
                 idx_rows = [idx[i][valid[i]] for i in range(len(topics))]
                 need_host = counts[:len(topics)] > self.max_fanout
                 self._warm_sigs.add(sig)
+        except MatcherBusy:
+            raise
+        except Exception as e:
+            self._record_device_failure(e)
+        else:
+            self._record_device_success(_warmup)
         finally:
             with self.lock:
                 self._inflight -= 1
@@ -758,6 +991,19 @@ class TpuMatcher:
         is unavailable (unbucketed table, packed_io off, or K == 1).
         ``lock_timeout``/``require_warm`` follow match_batch's contract.
         """
+        if not batches:
+            return []
+        probe = self._breaker_gate(_warmup)
+        try:
+            return self._match_many_impl(batches, _warmup, lock_timeout,
+                                         require_warm)
+        except BaseException:
+            if probe:
+                self.breaker.probe_aborted()  # see match_batch
+            raise
+
+    def _match_many_impl(self, batches, _warmup, lock_timeout,
+                         require_warm) -> List[List[List[Row]]]:
         batches = [list(b) for b in batches]
         if not batches:
             return []
@@ -768,7 +1014,12 @@ class TpuMatcher:
             raise MatcherBusy(cold=False)
         fast = False
         try:
-            self.sync()
+            try:
+                self.sync()
+            except RebuildInProgress:
+                raise
+            except Exception as e:
+                self._record_device_failure(e)
             operands = self._operands
             meta = self._meta
             snapshot = self._entries_snapshot
@@ -793,9 +1044,11 @@ class TpuMatcher:
         finally:
             self.lock.release()
         if not fast:
-            return [self.match_batch(topics, _warmup=_warmup,
-                                     lock_timeout=lock_timeout,
-                                     require_warm=require_warm)
+            # impl, not the public wrapper: passage through the breaker
+            # gate was already granted (re-entering could eat or be
+            # refused the half-open probe this call holds)
+            return [self._match_batch_impl(topics, _warmup, lock_timeout,
+                                           require_warm)
                     for topics in batches]
         n_pubs = sum(len(b) for b in batches)
         if _warmup:
@@ -804,6 +1057,8 @@ class TpuMatcher:
         else:
             self.match_batches += len(batches)
             self.match_publishes += n_pubs
+            self._last_shape = ("many", len(batches),
+                                max(len(b) for b in batches))
         try:
             preps: List[tuple] = []
             lefts: List[set] = []
@@ -827,6 +1082,12 @@ class TpuMatcher:
             self._warm_sigs.add(sig)
             if not _warmup:
                 self.super_dispatches += 1
+        except MatcherBusy:
+            raise
+        except Exception as e:
+            self._record_device_failure(e)
+        else:
+            self._record_device_success(_warmup)
         finally:
             with self.lock:
                 self._inflight -= 1
@@ -873,8 +1134,8 @@ class TpuMatcher:
                     [("warmup", "ladder", str(i)) for i in range(Bpad)]
                     for _ in range(n_batches)]
                 self.match_many(batches, _warmup=True)
-            except RebuildInProgress:
-                pass  # table rebuilding — retried on the next shed
+            except (RebuildInProgress, DeviceDegraded):
+                pass  # table rebuilding / breaker open — retried later
             except Exception:
                 self.warm_failures += 1
                 import logging
@@ -981,6 +1242,7 @@ class TpuMatcher:
             raise MatcherBusy(cold=True)
         F_t, t1 = operands
         if pallas:
+            faults.inject("device.dispatch")
             table_args = (F_t, t1, dev_arrays[1], dev_arrays[2],
                           dev_arrays[3], dev_arrays[4])
             from ..ops import pallas_match as P
@@ -1018,6 +1280,7 @@ class TpuMatcher:
                 self._warm_sigs.add(sig)
             return idx_rows, need_host
         else:
+            faults.inject("device.dispatch")
             table_args = (F_t, t1, dev_arrays[1], dev_arrays[2],
                           dev_arrays[3], dev_arrays[4])
             flat, pre, total, overflow = K.match_extract_windowed_flat(
@@ -1062,9 +1325,15 @@ class TpuRegView:
     def __init__(self, registry, max_levels: int = 16,
                  initial_capacity: int = 1024, max_fanout: int = 256,
                  flat_avg: int = 128, use_pallas: bool = False,
-                 packed_io: bool = True, mesh=None):
+                 packed_io: bool = True, mesh=None,
+                 breaker_enabled: bool = True,
+                 breaker_failure_threshold: int = 3,
+                 breaker_backoff_initial: float = 0.2,
+                 breaker_backoff_max: float = 10.0,
+                 delta_warm_max: int = 128):
         self.registry = registry
         self.mesh = mesh
+        self.delta_warm_max = delta_warm_max
         self._matchers: Dict[str, TpuMatcher] = {}
 
         def _mk() -> TpuMatcher:
@@ -1083,6 +1352,13 @@ class TpuRegView:
             # while the registry's trie serves (fold / _flush_async
             # catch RebuildInProgress)
             m.async_rebuild = True
+            # device-path breaker per the tpu_breaker_* knobs (the
+            # matcher ships a default breaker; this applies config)
+            m.breaker = (CircuitBreaker(
+                failure_threshold=breaker_failure_threshold,
+                backoff_initial=breaker_backoff_initial,
+                backoff_max=breaker_backoff_max)
+                if breaker_enabled else None)
             return m
 
         self._mk = _mk
@@ -1102,12 +1378,26 @@ class TpuRegView:
                 for fw, key, opts in self.registry.fold_subscriptions(mountpoint):
                     m.table.add(list(fw), key, opts)
             self._matchers[mountpoint] = m
-            # pre-compile the batch-shape ladder in the background so
-            # live flushes never block on a first compile (match_batch
-            # locks per call, so warmup interleaves with real batches)
+            # pre-compile the batch-shape ladder AND the delta-scatter
+            # shape ladder in the background so neither live flushes nor
+            # the first post-subscribe delta sync block on a first
+            # compile (match_batch locks per call, so warmup interleaves
+            # with real batches; the delta ladder chases the
+            # sub_to_matchable_ms_max tail)
+            def _warm_all() -> None:
+                m.warm_ladder()
+                try:
+                    m.warm_delta_ladder(self.delta_warm_max)
+                except Exception:
+                    import logging
+
+                    logging.getLogger("vernemq_tpu.matcher").exception(
+                        "delta-scatter shape pre-warm failed; first "
+                        "deltas of each size will pay their compile")
+
             try:
                 loop = asyncio.get_running_loop()
-                loop.run_in_executor(None, m.warm_ladder)
+                loop.run_in_executor(None, _warm_all)
             except RuntimeError:
                 pass  # no loop (sync/unit-test use): compile on demand
         return m
@@ -1126,10 +1416,11 @@ class TpuRegView:
     def fold(self, mountpoint: str, topic: Sequence[str]) -> List[Row]:
         """Synchronous single-topic fold — drop-in replacement for the trie
         view (a batch of one; the BatchCollector path amortises). During
-        a background table rebuild the host trie answers instead."""
+        a background table rebuild or a breaker-open degraded window the
+        host trie answers instead."""
         try:
             return self.matcher(mountpoint).match_batch([tuple(topic)])[0]
-        except RebuildInProgress:
+        except (RebuildInProgress, DeviceDegraded):
             return self.registry.trie(mountpoint).match(list(topic))
 
     def fold_batch(self, mountpoint: str, topics: Sequence[Sequence[str]],
@@ -1156,6 +1447,19 @@ class TpuRegView:
         m = self._matchers.get(mountpoint)
         return bool(m is not None
                     and getattr(m, "supports_match_many", False))
+
+    def breaker_status(self) -> Dict[str, Any]:
+        """Per-mountpoint device-breaker status (admin/metrics surface);
+        mountpoints whose breaker is disabled report None."""
+        return {mp or "(default)": (m.breaker.status()
+                                    if m.breaker is not None else None)
+                for mp, m in self._matchers.items()}
+
+    def close(self) -> None:
+        """Wind down background warm threads of every mountpoint's
+        matcher (broker shutdown)."""
+        for m in self._matchers.values():
+            m.close()
 
 
 class BatchCollector:
@@ -1202,6 +1506,7 @@ class BatchCollector:
         self.overload_host_pubs = 0  # shed to the host trie at overload
         self.rebuild_host_pubs = 0  # served by the trie during a rebuild
         self.busy_host_pubs = 0  # served by the trie past the lock bound
+        self.degraded_host_pubs = 0  # trie-served while the breaker is open
         self._pending: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         self._inflight = 0
@@ -1393,18 +1698,24 @@ class BatchCollector:
                     results = await loop.run_in_executor(
                         None, self.view.fold_batch, mp, topics, lock_to
                     )
-            except (RebuildInProgress, MatcherBusy) as rb:
+            except (RebuildInProgress, MatcherBusy, DeviceDegraded) as rb:
                 # the device can't take this batch promptly — table
-                # re-uploading after growth, or the matcher lock held
-                # past the busy bound (first-compile of a new shape) —
-                # so serve it from the host trie (identical results):
-                # the publish pipeline keeps flowing and worst-case
-                # latency stays ~the bound, not the hold. Trie reads
-                # must stay loop-side (mutation is loop-side), so chunk
-                # the batch with yields — a full 4096-pub flush of
-                # sub-ms matches must not stall every session's IO for
-                # its whole duration.
-                if isinstance(rb, MatcherBusy):
+                # re-uploading after growth, the matcher lock held past
+                # the busy bound (first-compile of a new shape), or the
+                # device circuit breaker open after repeated dispatch
+                # failures — so serve it from the host trie (identical
+                # results): the publish pipeline keeps flowing and
+                # worst-case latency stays ~the bound, not the hold or
+                # the outage. Trie reads must stay loop-side (mutation
+                # is loop-side), so chunk the batch with yields — a
+                # full 4096-pub flush of sub-ms matches must not stall
+                # every session's IO for its whole duration.
+                if isinstance(rb, DeviceDegraded):
+                    # degraded mode: the breaker's half-open probe (a
+                    # later real flush) brings the device back; no warm
+                    # kick — recovery re-warms on the close edge
+                    self.degraded_host_pubs += len(items)
+                elif isinstance(rb, MatcherBusy):
                     self.busy_host_pubs += len(items)
                     if rb.cold:
                         # compile this batch shape off to the side so

@@ -791,7 +791,11 @@ def call_packed(F_t, t1, meta, args, statics):
     geometry from the arg shapes, packs the host args, invokes the
     kernel. Production, bench and tests all go through here so the
     flat_pack_args layout and the kernel's shape contract cannot
-    drift apart."""
+    drift apart. (``device.dispatch`` fault-injection point: the
+    robustness harness exercises TPU dispatch failure here.)"""
+    from ..robustness import faults
+
+    faults.inject("device.dispatch")
     return match_extract_windowed_flat_packed(
         F_t, t1, meta, flat_pack_args(args),
         **_packed_geometry(args), **statics)
@@ -846,6 +850,9 @@ def match_extract_windowed_rows_packed(
 def call_packed_rows(F_t, t1, meta, args, statics):
     """Rows-kernel analog of :func:`call_packed` (statics carry ``C``;
     converted to the per-pub cap ``kf`` the rows kernel takes)."""
+    from ..robustness import faults
+
+    faults.inject("device.dispatch")
     geom = _packed_geometry(args)
     st = dict(statics)
     st["kf"] = st.pop("C") // geom["B"]
@@ -1005,6 +1012,9 @@ def call_packed_stack(F_t, t1, meta, preps, statics):
     """Stack the packed arg vectors of ``preps`` (each the trailing-args
     tuple of one batch, same geometry) and run them as ONE executable.
     Returns the ``[N, C + 3B]`` stacked result device array."""
+    from ..robustness import faults
+
+    faults.inject("device.dispatch")
     vecs = np.stack([flat_pack_args(a) for a in preps])
     return match_packed_scan_results(
         F_t, t1, meta, vecs, **_packed_geometry(preps[0]), **statics)
@@ -1021,6 +1031,9 @@ def call_match_many(F_t, t1, meta, preps, statics, device=None):
     :func:`unpack_many_results`."""
     import warnings
 
+    from ..robustness import faults
+
+    faults.inject("device.dispatch")
     vecs = np.stack([flat_pack_args(a) for a in preps])
     if device is not None:
         vecs = jax.device_put(vecs, device)

@@ -623,6 +623,7 @@ class ShardedWindowedMatcher:
         prepped batches back to back (upload/compute overlapped in the
         device queue) and only then pull: the seat's pipelined
         match_many path."""
+        faults.inject("device.dispatch")
         fn = self._fn_for(*p["geom"], glob=p["glob"], S=p["S"],
                           bits=p["bits"])
         return fn(*p["dev"], *p["args"])
@@ -685,6 +686,7 @@ class ShardedWindowedMatcher:
 # ---------------------------------------------------------------------------
 
 from ..models.tpu_matcher import MatcherBusy, RebuildInProgress, TpuMatcher
+from ..robustness import faults
 
 
 class ShardedTpuMatcher(TpuMatcher):
@@ -817,10 +819,18 @@ class ShardedTpuMatcher(TpuMatcher):
             for s in t.dirty:
                 snap[s] = t.entries[s]
             self._entries_snapshot = snap
-            # donation only while NO dispatched match holds the arrays —
-            # the donating scatter deletes its inputs (base-class
-            # in-flight guard, tpu_matcher.sync)
-            sw._sync_delta(donate=self._inflight == 0)
+            try:
+                faults.inject("device.delta")
+                # donation only while NO dispatched match holds the
+                # arrays — the donating scatter deletes its inputs
+                # (base-class in-flight guard, tpu_matcher.sync)
+                sw._sync_delta(donate=self._inflight == 0)
+            except Exception:
+                # scatter didn't land but the dirty set is consumed:
+                # force a full sharded rebuild so host and device
+                # re-converge (same repair as the single-chip seat)
+                t.resized = True
+                raise
             self._dev_arrays = sw._dev
         # bucket relocation (spare tail) moves regions without a resize
         self._reg_start = sw._reg_start = t.reg_start.copy()
@@ -828,19 +838,22 @@ class ShardedTpuMatcher(TpuMatcher):
 
     # ---------------------------------------------------------------- match
 
-    def match_batch(self, topics, _warmup: bool = False,
-                    lock_timeout=None, require_warm: bool = False):
+    def _match_batch_impl(self, topics, _warmup, lock_timeout,
+                          require_warm):
         import numpy as np
 
-        if not topics:
-            return []
         if lock_timeout is None:
             self.lock.acquire()
         elif not self.lock.acquire(timeout=lock_timeout):
             self.busy_sheds += 1
             raise MatcherBusy(cold=False)
         try:
-            self.sync()
+            try:
+                self.sync()
+            except RebuildInProgress:
+                raise
+            except Exception as e:
+                self._record_device_failure(e)
             sw = self._swm
             snapshot = self._entries_snapshot
             # cached encoder (hot zipf topics skip per-word interning)
@@ -860,9 +873,16 @@ class ShardedTpuMatcher(TpuMatcher):
         else:
             self.match_batches += 1
             self.match_publishes += len(topics)
+            self._last_shape = ("batch", len(topics))
         try:
             pulled = sw._dispatch(p)
             self._warm_sigs.add(sig)
+        except MatcherBusy:
+            raise
+        except Exception as e:
+            self._record_device_failure(e)
+        else:
+            self._record_device_success(_warmup)
         finally:
             with self.lock:
                 self._inflight -= 1
@@ -896,8 +916,8 @@ class ShardedTpuMatcher(TpuMatcher):
         t = self.table
         return bool(t.bucketed and t.id_bits)
 
-    def match_many(self, batches, _warmup: bool = False,
-                   lock_timeout=None, require_warm: bool = False):
+    def _match_many_impl(self, batches, _warmup, lock_timeout,
+                         require_warm):
         """The sharded seat's multi-batch pipeline: all K batches are
         encoded and window-prepped against ONE consistent table snapshot
         (one lock hold, one sync), then every batch is LAUNCHED before
@@ -917,7 +937,12 @@ class ShardedTpuMatcher(TpuMatcher):
             self.busy_sheds += 1
             raise MatcherBusy(cold=False)
         try:
-            self.sync()
+            try:
+                self.sync()
+            except RebuildInProgress:
+                raise
+            except Exception as e:
+                self._record_device_failure(e)
             sw = self._swm
             snapshot = self._entries_snapshot
             # common Bpad: all K share one compile signature
@@ -942,6 +967,8 @@ class ShardedTpuMatcher(TpuMatcher):
         else:
             self.match_batches += len(batches)
             self.match_publishes += n_pubs
+            self._last_shape = ("many", len(batches),
+                                max(len(b) for b in batches))
         try:
             preps = [sw._prep_encoded(pw, pl, pd, pb, len(topics),
                                       pinned=pinned)
@@ -957,6 +984,12 @@ class ShardedTpuMatcher(TpuMatcher):
             self._warm_sigs.add(sig)
             if not _warmup:
                 self.super_dispatches += 1
+        except MatcherBusy:
+            raise
+        except Exception as e:
+            self._record_device_failure(e)
+        else:
+            self._record_device_success(_warmup)
         finally:
             with self.lock:
                 self._inflight -= 1
@@ -970,3 +1003,9 @@ class ShardedTpuMatcher(TpuMatcher):
         while b < n:
             b *= 2
         return b
+
+    def warm_delta_ladder(self, max_delta: int = 128) -> int:
+        # the sharded delta scatter (_sync_delta) compiles per dirty
+        # count inside shard_map; pre-warming it needs real dirty state,
+        # so the sharded seat compiles delta shapes on demand
+        return 0

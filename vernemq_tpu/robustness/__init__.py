@@ -1,0 +1,20 @@
+"""Robustness subsystem: deterministic fault injection + circuit breaking.
+
+A production broker must stay up when the device path doesn't — TPU
+preemption, compile failures, rebuild stalls, dispatch timeouts. This
+package supplies the two halves of that story:
+
+- :mod:`faults` — a seedable :class:`~faults.FaultPlan` registry with
+  named injection points threaded through device dispatch, delta-scatter
+  uploads, background rebuilds, cluster channels, listener binds and
+  msg-store writes, so the failure paths can be *exercised on purpose*
+  (and reproduced: identical seeds yield identical injection sequences);
+- :mod:`breaker` — the :class:`~breaker.CircuitBreaker` the matchers put
+  around device dispatch: N consecutive failures open it, matching
+  serves from the exact host trie (degraded mode), a half-open probe
+  with exponential backoff + jitter brings the device path back.
+"""
+
+from . import faults  # noqa: F401
+from .breaker import CircuitBreaker  # noqa: F401
+from .faults import FaultPlan, FaultRule, InjectedFault  # noqa: F401

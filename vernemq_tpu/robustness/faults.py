@@ -1,0 +1,242 @@
+"""Deterministic fault injection for the broker's failure paths.
+
+One process-global :class:`FaultPlan` (installed via :func:`install`,
+config ``fault_injection``/``fault_injection_seed``, or live through
+``vmq-admin fault inject``) decides, at every named **injection point**,
+whether to fire a fault: raise :class:`InjectedFault`, add latency, or
+hang. Decisions are drawn from a per-point RNG stream seeded by
+``(seed, point)`` and indexed by that point's hit counter, so identical
+seeds reproduce identical injection sequences regardless of how hits on
+*different* points interleave — the property the determinism test in
+``tests/test_fault_injection.py`` asserts.
+
+Injection points in the tree (grep for ``faults.inject``):
+
+==================  =====================================================
+``device.dispatch``  TPU match dispatch (ops.match_kernel ``call_packed``
+                     / ``call_match_many`` and the matcher fallbacks)
+``device.delta``     delta-scatter upload of dirty table slots
+``device.rebuild``   full device-table (re)build, inline or background
+``cluster.recv``     inbound cluster data-plane frames (cluster/com.py)
+``store.write``      message-store writes (storage/msg_store.py)
+``listener.bind``    listener (re)bind (broker/listeners.py)
+==================  =====================================================
+
+The no-plan fast path is one module-global ``is None`` check, so the
+hooks cost nothing in production.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: faults longer than this are "hangs" capped to a bounded sleep — an
+#: injected hang must be escapable by the surrounding timeouts, not
+#: wedge the process forever
+HANG_CAP_S = 60.0
+
+
+class InjectedFault(RuntimeError):
+    """A fault fired by the active :class:`FaultPlan`."""
+
+    def __init__(self, point: str, rule_index: int, hit: int,
+                 message: str = ""):
+        super().__init__(
+            message or f"injected fault at {point} (rule {rule_index}, "
+                       f"hit {hit})")
+        self.point = point
+        self.rule_index = rule_index
+        self.hit = hit
+
+
+@dataclass
+class FaultRule:
+    """One injection rule: where, what, and how often.
+
+    ``point`` may be an exact injection-point name or an fnmatch glob
+    (``device.*``). ``after`` skips the first N hits of the point;
+    ``count`` bounds total firings (-1 = unlimited); ``probability``
+    gates each eligible hit on a draw from the point's seeded stream.
+    ``kind`` is ``error`` (raise), ``latency`` (sleep ``latency_ms``)
+    or ``hang`` (sleep ``latency_ms`` capped at :data:`HANG_CAP_S`,
+    default the cap)."""
+
+    point: str
+    kind: str = "error"
+    probability: float = 1.0
+    after: int = 0
+    count: int = -1
+    latency_ms: float = 0.0
+    message: str = ""
+    fired: int = field(default=0, compare=False)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {"point": self.point, "kind": self.kind,
+                "probability": self.probability, "after": self.after,
+                "count": self.count, "latency_ms": self.latency_ms,
+                "fired": self.fired}
+
+
+class FaultPlan:
+    """A seedable set of :class:`FaultRule`\\ s with per-point streams.
+
+    Thread-safe: injection points fire from executor threads (device
+    dispatch), the event loop (cluster frames) and background rebuild
+    workers concurrently."""
+
+    def __init__(self, rules: Sequence[FaultRule] = (), seed: int = 0):
+        self.seed = int(seed)
+        self.rules: List[FaultRule] = list(rules)
+        self.injected = 0       # faults raised
+        self.delayed = 0        # latency/hang faults applied
+        self._hits: Dict[str, int] = {}
+        self._rngs: Dict[str, random.Random] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_config(cls, spec: Sequence[Dict[str, Any]],
+                    seed: int = 0) -> "FaultPlan":
+        """Build a plan from the ``fault_injection`` config list (rule
+        dicts with the :class:`FaultRule` field names)."""
+        rules = []
+        for r in spec or ():
+            kw = {k.replace("-", "_"): v for k, v in dict(r).items()}
+            kw.pop("fired", None)
+            rules.append(FaultRule(**kw))
+        return cls(rules, seed=seed)
+
+    def add_rule(self, rule: FaultRule) -> None:
+        with self._lock:
+            self.rules.append(rule)
+
+    def _rng(self, point: str) -> random.Random:
+        rng = self._rngs.get(point)
+        if rng is None:
+            # string seeding hashes via sha512 — stable across processes
+            # (unlike hash() of str under PYTHONHASHSEED)
+            rng = self._rngs[point] = random.Random(
+                f"{self.seed}:{point}")
+        return rng
+
+    def decide(self, point: str) -> Optional[Tuple[str, float, int, int]]:
+        """Record one hit of ``point`` and return the fault to apply,
+        if any: ``(kind, latency_s, rule_index, hit)``. Pure bookkeeping
+        — callers apply the raise/sleep so async contexts can await the
+        delay instead of blocking the loop."""
+        with self._lock:
+            hit = self._hits.get(point, 0)
+            self._hits[point] = hit + 1
+            rng = self._rng(point)
+            # ONE draw per hit, consumed whether or not any rule wants
+            # it: the stream index stays aligned with the hit counter,
+            # so live rule edits never shift past decisions
+            draw = rng.random()
+            for i, r in enumerate(self.rules):
+                if r.point != point and not fnmatch.fnmatch(point, r.point):
+                    continue
+                if hit < r.after:
+                    continue
+                if 0 <= r.count <= r.fired:
+                    continue
+                if draw >= r.probability:
+                    continue
+                r.fired += 1
+                if r.kind == "error":
+                    self.injected += 1
+                else:
+                    self.delayed += 1
+                delay = (min(r.latency_ms / 1e3, HANG_CAP_S)
+                         if r.kind == "latency"
+                         else min(r.latency_ms / 1e3 or HANG_CAP_S,
+                                  HANG_CAP_S) if r.kind == "hang"
+                         else 0.0)
+                return (r.kind, delay, i, hit)
+        return None
+
+    def hits(self, point: str) -> int:
+        with self._lock:
+            return self._hits.get(point, 0)
+
+    def status(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"seed": self.seed, "injected": self.injected,
+                    "delayed": self.delayed,
+                    "hits": dict(self._hits),
+                    "rules": [r.as_dict() for r in self.rules]}
+
+
+# --------------------------------------------------------------- registry
+
+_active: Optional[FaultPlan] = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Make ``plan`` the process-wide active plan (replacing any)."""
+    global _active
+    _active = plan
+    return plan
+
+
+def clear() -> None:
+    """Deactivate fault injection (the hooks return to the free path)."""
+    global _active
+    _active = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _active
+
+
+def stats() -> Dict[str, float]:
+    """Gauge snapshot for the metrics/$SYS surface."""
+    p = _active
+    if p is None:
+        return {"fault_plan_active": 0.0, "faults_injected": 0.0,
+                "faults_delayed": 0.0}
+    return {"fault_plan_active": 1.0, "faults_injected": float(p.injected),
+            "faults_delayed": float(p.delayed)}
+
+
+def inject(point: str, max_delay_s: Optional[float] = None) -> None:
+    """Synchronous injection hook (executor threads / host prep paths):
+    raises :class:`InjectedFault` or sleeps per the active plan.
+    ``max_delay_s`` caps latency/hang faults at sites that execute on
+    the event-loop thread (a synchronous seam like the msg-store write
+    really does block the loop — the cap keeps a drill's stall bounded
+    instead of freezing every session for the full hang)."""
+    plan = _active
+    if plan is None:
+        return
+    decision = plan.decide(point)
+    if decision is None:
+        return
+    kind, delay, rule_index, hit = decision
+    if kind == "error":
+        raise InjectedFault(point, rule_index, hit,
+                            plan.rules[rule_index].message)
+    if max_delay_s is not None:
+        delay = min(delay, max_delay_s)
+    time.sleep(delay)
+
+
+async def inject_async(point: str) -> None:
+    """Event-loop-safe injection hook: latency/hang faults await instead
+    of blocking the loop (every session shares it)."""
+    plan = _active
+    if plan is None:
+        return
+    decision = plan.decide(point)
+    if decision is None:
+        return
+    kind, delay, rule_index, hit = decision
+    if kind == "error":
+        raise InjectedFault(point, rule_index, hit,
+                            plan.rules[rule_index].message)
+    import asyncio
+
+    await asyncio.sleep(delay)
