@@ -666,6 +666,138 @@ def config6_fault_storm(jax_mod, rng, n_subs, batch, smoke):
     }
 
 
+def config7_partition_storm(smoke):
+    """Robustness config: cross-node QoS1 delivery through a partition.
+
+    Two in-process brokers on the real framed cluster channel, a QoS 1
+    subscriber on node B, a publisher on node A. Phases: healthy
+    (publish→receive latency), storm (the inter-node link severed for
+    ``storm_s`` via the ``cluster.recv`` fault point under continued
+    publish load — QoS≥1 frames journal in the delivery spool), heal
+    (faults cleared — the spool replays). Reports the degraded publish
+    p99, post-heal replay throughput, and ``parity_ok``: every message
+    delivered, none twice (the dedup window's exactly-once check)."""
+    import asyncio
+    import tempfile
+
+    async def run():
+        from vernemq_tpu.broker.config import Config
+        from vernemq_tpu.broker.server import start_broker
+        from vernemq_tpu.client import MQTTClient
+        from vernemq_tpu.cluster import Cluster
+        from vernemq_tpu.robustness import faults
+
+        n_healthy = 50 if smoke else 200
+        n_storm = 100 if smoke else 500
+        storm_s = 1.5 if smoke else 5.0
+        tmp = tempfile.mkdtemp(prefix="vmq-spool-bench-")
+        nodes = []
+        for i in range(2):
+            cfg = Config(systree_enabled=False, allow_anonymous=True,
+                         allow_publish_during_netsplit=True,
+                         cluster_spool_dir=f"{tmp}/node{i}",
+                         cluster_spool_retransmit_ms=100,
+                         cluster_spool_ack_interval=20)
+            broker, server = await start_broker(cfg, port=0,
+                                                node_name=f"node{i}")
+            broker.node_name = broker.metadata.node_name = f"node{i}"
+            broker.registry.node_name = f"node{i}"
+            broker.registry.db.node_name = f"node{i}"
+            cluster = Cluster(broker, "127.0.0.1", 0)
+            await cluster.start()
+            nodes.append((broker, server, cluster))
+        a, b = nodes
+        b[2].join(a[2].listen_host, a[2].listen_port)
+        while not (len(a[2].members()) == 2 and a[2].is_ready()
+                   and b[2].is_ready()):
+            await asyncio.sleep(0.02)
+
+        sub = MQTTClient("127.0.0.1", b[1].port, client_id="storm-sub")
+        await sub.connect()
+        await sub.subscribe("storm/#", qos=1)
+        while len(a[0].registry.trie("").match(["storm", "x"])) != 1:
+            await asyncio.sleep(0.02)
+        pub = MQTTClient("127.0.0.1", a[1].port, client_id="storm-pub")
+        await pub.connect()
+
+        async def publish_n(n, start, lats):
+            for i in range(start, start + n):
+                t0 = time.perf_counter()
+                await pub.publish(f"storm/{i}", b"m%d" % i, qos=1)
+                lats.append(time.perf_counter() - t0)
+
+        healthy_lat, storm_lat = [], []
+        await publish_n(n_healthy, 0, healthy_lat)
+        for _ in range(n_healthy):
+            await sub.recv(5)
+
+        # storm: sever the inter-node data plane (inbound batches drop
+        # on both nodes — frames AND acks) while publishing continues
+        faults.install(faults.FaultPlan(
+            [faults.FaultRule("cluster.recv", kind="error")], seed=7))
+        storm_t0 = time.perf_counter()
+        await publish_n(n_storm, n_healthy, storm_lat)
+        while time.perf_counter() - storm_t0 < storm_s:
+            await asyncio.sleep(0.05)
+        spool_depth = a[0].metrics.all_metrics().get(
+            "cluster_spool_depth_frames", 0)
+
+        # heal: the retransmit watchdog replays the journaled backlog
+        faults.clear()
+        heal_t0 = time.perf_counter()
+        got = {}
+        while len(got) < n_storm and time.perf_counter() - heal_t0 < 30:
+            try:
+                m = await sub.recv(5)
+            except asyncio.TimeoutError:
+                break
+            got[m.payload] = got.get(m.payload, 0) + 1
+        drain_s = time.perf_counter() - heal_t0
+        # quiet-period drain: trailing duplicate deliveries still in
+        # flight must land in the dupe count or parity_ok lies
+        while True:
+            try:
+                m = await sub.recv(0.5)
+            except asyncio.TimeoutError:
+                break
+            got[m.payload] = got.get(m.payload, 0) + 1
+        replayed = a[0].metrics.value("cluster_spool_replayed")
+        deduped = b[0].metrics.value("cluster_spool_deduped")
+
+        await sub.disconnect()
+        await pub.disconnect()
+        for broker, server, cluster in nodes:
+            await cluster.stop()
+            await broker.stop()
+            await server.stop()
+
+        expect = {b"m%d" % i for i in range(n_healthy, n_healthy + n_storm)}
+        missing = len(expect - set(got))
+        dupes = sum(c - 1 for c in got.values())
+
+        def pct(lats, q):
+            lats = sorted(lats)
+            return round(lats[min(len(lats) - 1, int(q * len(lats)))] * 1e3,
+                         3)
+
+        return {
+            "storm_publishes": n_storm, "storm_s": storm_s,
+            "healthy_publish_ms_p50": pct(healthy_lat, 0.50),
+            "healthy_publish_ms_p99": pct(healthy_lat, 0.99),
+            "degraded_publish_ms_p50": pct(storm_lat, 0.50),
+            "degraded_publish_ms_p99": pct(storm_lat, 0.99),
+            "spool_depth_at_heal": int(spool_depth),
+            "replayed_frames": replayed,
+            "deduped_frames": deduped,
+            "replay_drain_s": round(drain_s, 3),
+            "replay_msgs_per_sec": round(len(got) / max(drain_s, 1e-9)),
+            "missing": missing, "duplicates": dupes,
+            "parity_ok": missing == 0 and dupes == 0,
+        }
+
+    return asyncio.run(run())
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--subs", type=int, default=1_000_000)
@@ -684,10 +816,13 @@ def main() -> int:
     ap.add_argument("--stack", type=int, default=8,
                     help="batches per executable for --variant "
                     "packed_stack")
-    ap.add_argument("--configs", default="1,2,3,4,5,6",
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7",
                     help="which BASELINE configs to run (3 = headline; "
                     "6 = fault-storm robustness: publish p99 while the "
-                    "device path is down + breaker recovery time)")
+                    "device path is down + breaker recovery time; "
+                    "7 = partition storm: two brokers, inter-node link "
+                    "severed under QoS1 load — spool replay throughput "
+                    "+ zero-loss parity)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
     ap.add_argument("--kernel-only", action="store_true",
@@ -918,6 +1053,10 @@ def main() -> int:
     if "6" in want:
         guarded("6_fault_storm", lambda: config6_fault_storm(
             jax, rng, args.subs, args.batch, smoke))
+
+    if "7" in want:
+        guarded("7_partition_storm",
+                lambda: config7_partition_storm(smoke))
 
     if headline is not None:
         value = headline["matches_per_sec"]

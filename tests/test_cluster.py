@@ -582,6 +582,70 @@ async def test_drain_retry_is_bounded_and_surfaced():
 
 
 @pytest.mark.asyncio
+async def test_leave_retargets_when_migration_target_dies():
+    """Graceful leave with a migration target dying mid-drain: the
+    failed queue is retried against the surviving peers (each tried at
+    most once) with progress visible via `vmq-admin cluster migrations`
+    — the leave neither wedges nor loses the queue."""
+    from vernemq_tpu.admin.commands import CommandRegistry, \
+        register_core_commands
+
+    nodes = await make_cluster(3)
+    try:
+        a, b, c = nodes
+        a.broker.config.set("migrate_drain_retries", 1)
+        a.broker.config.set("max_drain_time", 50)
+        for name in ("rt1", "rt2"):
+            cl = await connected(a, name, clean_start=False)
+            await cl.subscribe(f"rt/{name}/#", qos=1)
+            await cl.disconnect()
+        pub = await connected(b, "rt-pub")
+        for name in ("rt1", "rt2"):
+            for i in range(3):
+                await pub.publish(f"rt/{name}/{i}", b"m%d" % i, qos=1)
+        await wait_until(lambda: all(
+            (q := a.broker.registry.queues.get(("", n))) is not None
+            and len(q.offline) == 3 for n in ("rt1", "rt2")))
+
+        # node1's acked enqueue path dies mid-drain; snapshot the admin
+        # migrations view at the failure (partial progress is reported)
+        admin = register_core_commands(CommandRegistry())
+        seen = []
+        orig = a.broker.cluster.remote_enqueue
+
+        async def dying(node, sid, msgs, **kw):
+            if node == "node1":
+                seen.append(admin.run(a.broker, ["cluster", "migrations"]))
+                raise ConnectionError("target died mid-drain")
+            return await orig(node, sid, msgs, **kw)
+
+        a.broker.cluster.remote_enqueue = dying
+        moved = await a.cluster.leave_gracefully(timeout=30)
+        assert moved == 2
+        assert seen and any(r["target"] == "node1" and r["state"] in
+                            ("draining", "failed")
+                            for r in seen[0]["table"])
+        assert a.broker.metrics.value("queue_drain_failed") >= 1
+
+        # both queues survive on node2 (the only live target once node1's
+        # drain path died) with their full backlogs; node0 is empty
+        def settled():
+            for n in ("rt1", "rt2"):
+                rec = b.broker.registry.db.read(("", n))
+                if rec is None or rec.node != "node2":
+                    return False
+                q = c.broker.registry.queues.get(("", n))
+                if q is None or len(q.offline) != 3:
+                    return False
+            return (not a.broker.registry.queues
+                    and not a.broker.migrations)
+        await wait_until(settled)
+        await pub.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
 async def test_migration_zero_loss_mid_drain():
     """A QoS1 message racing into the queue DURING the drain follows the
     migration instead of being dropped (drain({enqueue,..}) inserts and

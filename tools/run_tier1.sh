@@ -2,8 +2,10 @@
 # Canonical tier-1 verification (the exact command ROADMAP.md specifies,
 # encapsulated so CI and humans run the same thing).
 #
-#   tools/run_tier1.sh            # tier-1: everything but -m slow
-#   tools/run_tier1.sh -m chaos   # extra args replace the marker filter
+#   tools/run_tier1.sh                 # tier-1: everything but -m slow
+#   tools/run_tier1.sh -m chaos        # your -m replaces the marker filter
+#   tools/run_tier1.sh -k spool -x     # other args pass through, tier-1
+#                                      # marker filter kept
 #
 # Exits with pytest's status; prints DOTS_PASSED=<n> for the driver.
 # Chaos/soak tests are opt-in: they carry BOTH the `chaos` and `slow`
@@ -15,7 +17,10 @@ cd "$(dirname "$0")/.."
 LOG=${TIER1_LOG:-/tmp/_t1.log}
 TIMEOUT=${TIER1_TIMEOUT:-870}
 if [ $# -gt 0 ]; then
-  EXTRA=("$@")
+  case " $* " in
+    *" -m "*|*" -m="*|*" --markers "*) EXTRA=("$@") ;;
+    *) EXTRA=(-m 'not slow' "$@") ;;
+  esac
 else
   EXTRA=(-m 'not slow')
 fi

@@ -143,6 +143,10 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin cluster fix-dead-queues [targets=n1,n2]")
     reg.register(["cluster", "migrations"], _cluster_migrations,
                  "vmq-admin cluster migrations")
+    reg.register(["cluster", "spool", "show"], _cluster_spool_show,
+                 "vmq-admin cluster spool show")
+    reg.register(["cluster", "spool", "flush"], _cluster_spool_flush,
+                 "vmq-admin cluster spool flush [node=NodeName]")
     reg.register(["session", "disconnect"], _session_disconnect,
                  "vmq-admin session disconnect client-id=CID "
                  "[mountpoint=] [cleanup=true]")
@@ -325,9 +329,40 @@ def _cluster_fix_dead_queues(broker, flags):
 def _cluster_migrations(broker, flags):
     rows = [{"subscriber": f"{sid[0]}/{sid[1]}", "target": m["target"],
              "pending": m["pending"], "retries": m["retries"],
+             "tried": ",".join(m.get("tried", [m["target"]])),
              "state": m["state"]}
             for sid, m in sorted(broker.migrations.items())]
     return {"table": rows}
+
+
+def _cluster_spool(broker):
+    cl = broker.cluster
+    if cl is None:
+        raise CommandError("clustering is not enabled on this node")
+    if cl.spool is None:
+        raise CommandError("the cluster spool is disabled "
+                           "(cluster_spool_enabled=false)")
+    return cl
+
+
+def _cluster_spool_show(broker, flags):
+    cl = _cluster_spool(broker)
+    rows = []
+    for r in cl.spool.peer_stats():
+        r["spool_capable"] = "spool" in cl._peer_caps.get(r["peer"], ())
+        rows.append(r)
+    if not rows:
+        return "spool empty (no QoS>=1 frames journaled)"
+    return {"table": rows}
+
+
+def _cluster_spool_flush(broker, flags):
+    cl = _cluster_spool(broker)
+    node = flags.get("node")
+    frames, nbytes = cl.spool.flush(node if isinstance(node, str) else None)
+    where = f" for {node}" if node else ""
+    return (f"flushed {frames} spooled frame(s) ({nbytes} bytes){where}; "
+            f"their cross-node delivery guarantee is waived")
 
 
 _SESSION_FIELDS = ("client_id", "mountpoint", "user", "peer_host", "peer_port",

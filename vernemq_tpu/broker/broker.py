@@ -69,8 +69,10 @@ class Broker:
         self.retain = RetainStore(on_dirty=self._retain_dirty)
         self.metadata.subscribe("retain", self._on_retain_event)
         self.registry = Registry(self)
+        fsync = bool(self.config.get("msg_store_fsync", False))
         if self.config.message_store == "file":
-            self.msg_store: MsgStore = FileMsgStore(self.config.message_store_dir)
+            self.msg_store: MsgStore = FileMsgStore(
+                self.config.message_store_dir, fsync=fsync)
         elif self.config.message_store == "native":
             from ..storage.msg_store import BucketedMsgStore, NativeMsgStore
 
@@ -86,14 +88,21 @@ class Broker:
                                 store_dir, n)
                     n = 1
                 # N engines hashed by msg-ref (vmq_lvldb_store_sup.erl:47-54)
-                self.msg_store = (BucketedMsgStore(store_dir, n) if n > 1
-                                  else NativeMsgStore(store_dir))
+                self.msg_store = (BucketedMsgStore(store_dir, n, fsync=fsync)
+                                  if n > 1
+                                  else NativeMsgStore(store_dir, fsync=fsync))
             except Exception as e:  # no toolchain → durable Python fallback
                 log.warning("native msg store unavailable (%s); "
                             "falling back to file store", e)
-                self.msg_store = FileMsgStore(self.config.message_store_dir)
+                self.msg_store = FileMsgStore(self.config.message_store_dir,
+                                              fsync=fsync)
         else:
             self.msg_store = MemoryMsgStore()
+        # corrupt records skipped by the file store's recovery scan are
+        # surfaced, not silent (the old behavior discarded the tail)
+        skipped = getattr(self.msg_store, "recover_skipped", 0)
+        if skipped:
+            self.metrics.incr("msg_store_recover_skipped", skipped)
         # live sessions: sid -> Session (the reference reaches sessions via
         # queue pids; a direct map is equivalent single-node)
         self.sessions: Dict[SubscriberId, Any] = {}
@@ -158,6 +167,18 @@ class Broker:
             "faults_injected": "Faults raised by the active plan.",
             "faults_delayed": "Latency/hang faults applied by the "
                               "active plan.",
+            # cluster delivery spool (cluster/spool.py): depth +
+            # outstanding-ack gauges, published to $SYS/Prometheus
+            "cluster_spool_depth_frames": "QoS>=1 cluster frames "
+                                          "journaled awaiting acks.",
+            "cluster_spool_depth_bytes": "Bytes journaled in the "
+                                         "cluster delivery spool.",
+            "cluster_spool_outstanding_acks": "Peers with spooled "
+                                              "frames awaiting a "
+                                              "cumulative ack.",
+            "cluster_spool_peers_blocked": "Peers whose spooled stream "
+                                           "is paused pending replay "
+                                           "resync.",
         })
 
     # ------------------------------------------------------------ plumbing
@@ -168,6 +189,9 @@ class Broker:
         out["retain_memory"] = self.retain.memory()
         out["active_sessions"] = len(self.sessions)
         out["uptime_seconds"] = time.time() - self._started
+        spool = getattr(self.cluster, "spool", None)
+        if spool is not None:
+            out.update(spool.stats())
         return out
 
     def cluster_ready(self) -> bool:
@@ -215,10 +239,15 @@ class Broker:
             return
         # register the migration BEFORE the task first runs: callers (the
         # graceful-leave wait loop) poll this map right after the record
-        # rewrite, and a not-yet-scheduled task must already count
+        # rewrite, and a not-yet-scheduled task must already count.
+        # Retarget bookkeeping (a leave retrying a dead target) survives
+        # the re-registration so each peer is tried at most once.
+        prev = self.migrations.get(sid) or {}
         self.migrations[sid] = {"target": new_node,
                                 "pending": len(queue.offline),
-                                "retries": 0, "state": "draining"}
+                                "retries": 0, "state": "draining",
+                                **{k: prev[k] for k in ("tried",)
+                                   if k in prev}}
         task = asyncio.get_event_loop().create_task(
             self._migrate_queue(sid, queue, new_node))
         self._bg_tasks.append(task)
@@ -469,7 +498,8 @@ class Broker:
 
         data_dir = self.config.get("data_dir", "")
         if data_dir:
-            for key in ("message_store_dir", "metadata_dir"):
+            for key in ("message_store_dir", "metadata_dir",
+                        "cluster_spool_dir"):
                 path = self.config.get(key, "")
                 if path and not _os.path.isabs(path):
                     self.config.set(

@@ -48,6 +48,21 @@ DEFAULTS: Dict[str, Any] = {
     "max_msgs_per_drain_step": 100,
     "max_drain_time": 500,  # ms cap per migration drain step
     "remote_enqueue_timeout": 5000,  # ms ack timeout for remote enqueues
+    # store-and-forward spool for QoS>=1 cluster frames (cluster/spool.py):
+    # journaled before the writer, seq-tagged on the wire (msq), deleted
+    # on cumulative acks, replayed on channel re-establishment — the
+    # cross-node delivery guarantee through partitions and peer restarts
+    "cluster_spool_enabled": True,
+    # journal directory; empty = in-memory journal (replay across
+    # partitions and buffer overflow, no crash durability); set a path
+    # (resolved under data_dir) for crash-restart replay from disk
+    "cluster_spool_dir": "",
+    "cluster_spool_max_bytes": 128 * 1024 * 1024,
+    # cumulative-ack pacing on the receiver (ms between acks per origin)
+    "cluster_spool_ack_interval": 50,
+    # ack watchdog: unacked frames older than this replay over the live
+    # channel (recovers in-channel loss where no reconnect fires replay)
+    "cluster_spool_retransmit_ms": 1000,
     # compat no-op (see schema.COMPAT_NOOPS): queues are dict-sharded
     "queue_sup_sup_children": 50,
     # reg views started at boot; entries from schema.REG_VIEW_ALIASES
@@ -156,6 +171,10 @@ DEFAULTS: Dict[str, Any] = {
     # storage
     "message_store": "memory",  # memory | file | native (C++ engine)
     "message_store_dir": "./data/msgstore",
+    # opt-in fsync per message-store write: the stores flush to the OS
+    # on every write either way; fsync makes each write power-loss
+    # durable at a large throughput cost (the reference's sync knob)
+    "msg_store_fsync": False,
     # engines hashed by msg-ref; reference runs 12 (vmq_lvldb_store_sup.erl)
     "msg_store_instances": 12,
     "metadata_dir": "./data/meta",

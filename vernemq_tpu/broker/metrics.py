@@ -90,6 +90,25 @@ COUNTERS: List[Tuple[str, str]] = [
     ("cluster_bytes_received", "Bytes received over cluster channels."),
     ("cluster_bytes_sent", "Bytes sent over cluster channels."),
     ("cluster_bytes_dropped", "Bytes dropped on cluster channels."),
+    ("cluster_frames_dropped", "Frames dropped on cluster channels."),
+    ("cluster_frames_shed_qos0",
+     "Buffered QoS0 cluster frames evicted to make room for QoS>=1 "
+     "traffic (also counted in cluster_frames_dropped)."),
+    ("cluster_spool_journaled",
+     "QoS>=1 cluster frames journaled to the delivery spool."),
+    ("cluster_spool_replayed",
+     "Spooled cluster frames replayed after reconnect/ack timeout."),
+    ("cluster_spool_deduped",
+     "Replayed cluster frames suppressed by the receiver dedup window."),
+    ("cluster_spool_acks_sent",
+     "Cumulative spool acks sent back to origin nodes."),
+    ("cluster_spool_overflow",
+     "Frames refused by the spool byte cap (sent best-effort instead)."),
+    ("cluster_spool_errors",
+     "Spool journal write failures (frame sent best-effort instead)."),
+    ("cluster_publish_drop",
+     "Remote publish forwards dropped (buffer full / spool refused "
+     "while the stream was paused)."),
     ("netsplit_detected", "Netsplits detected."),
     ("netsplit_resolved", "Netsplits resolved."),
     ("router_matches_local", "Subscriptions matched for local delivery."),
@@ -100,6 +119,8 @@ COUNTERS: List[Tuple[str, str]] = [
     ("msg_store_ops_delete", "Message store deletes."),
     ("msg_store_write_errors",
      "Message store writes that failed (message kept in memory only)."),
+    ("msg_store_recover_skipped",
+     "Corrupt message-store records skipped during recovery."),
     ("retain_messages_stored", "Retained messages persisted."),
     # robustness (supervision tree analog + fault harness)
     ("supervisor_restarts", "Supervised tasks restarted after a crash."),

@@ -751,11 +751,17 @@ class Registry:
                 if origin_local and key not in forwarded_nodes:
                     # overlapping filters yield multiple pointer rows to the
                     # same node; the receiving node re-folds its own view, so
-                    # exactly one frame goes out (vmq_reg.erl:346-353)
+                    # exactly one frame goes out (vmq_reg.erl:346-353).
+                    # The forward QoS-splits at the cluster layer: QoS 0
+                    # stays fire-and-forget (sheddable), QoS >= 1 rides
+                    # the durable spool (cluster/spool.py) when the peer
+                    # supports it — False back means dropped, visibly.
                     forwarded_nodes.add(key)
                     if self.remote_publish is not None:
-                        self.remote_publish(key, msg)
-                        self.broker.metrics.incr("router_matches_remote")
+                        if self.remote_publish(key, msg):
+                            self.broker.metrics.incr("router_matches_remote")
+                        else:
+                            self.broker.metrics.incr("cluster_publish_drop")
                     else:
                         # cluster channel stopped/detached: the forward is
                         # dropped VISIBLY (same counter as a down writer)

@@ -18,10 +18,11 @@ write, mirroring `vmq-admin cluster leave`).
 from __future__ import annotations
 
 import asyncio
+import collections
 import itertools
 import logging
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from .com import ClusterCom
 from .metadata import MetadataStore
@@ -30,6 +31,43 @@ from .node import NodeWriter, frame, msg_to_term
 log = logging.getLogger("vernemq_tpu.cluster")
 
 MEMBERS = "members"
+
+
+class _SpoolIn:
+    """Per-origin receive state for spooled (``msq``) frames.
+
+    ``cum`` is the cumulative-ack cursor: it advances only along
+    CONTIGUOUS sequences, anchored by the sender's ``msb`` stream-base
+    declaration (everything below the base is already acked sender-side).
+    Acking across a gap would make the sender trim frames the receiver
+    never saw — the one unrecoverable mistake. The cursor resets on
+    every inbound (re)connection: it must only cover what arrived over a
+    live stream, never a stale pre-partition watermark.
+
+    Frames at-or-below ``cum`` are duplicates by definition (only seen
+    frames advance it, and the base only covers sender-acked history);
+    frames ABOVE a gap dedup through the bounded ``(seq, msg_ref)``
+    window, which persists across connections and is keyed on the ref so
+    a sender whose sequence space restarted (fresh in-memory spool) is
+    never mistaken for a replay. The window bounds exactly-once for
+    above-gap QoS 2 frames to DEDUP_WINDOW frames per retransmit
+    interval — beyond it redelivery degrades to at-least-once."""
+
+    DEDUP_WINDOW = 8192
+
+    __slots__ = ("seen", "order", "cum", "acked_sent", "last_ack_t",
+                 "timer", "reack")
+
+    def __init__(self) -> None:
+        self.seen: Set[Tuple[int, bytes]] = set()
+        self.order: collections.deque = collections.deque()
+        self.cum = 0
+        self.acked_sent = 0
+        self.last_ack_t = 0.0
+        self.timer: Optional[asyncio.TimerHandle] = None
+        # a duplicate was seen: the origin is replaying because an ack
+        # was lost — re-ack even though cum did not advance
+        self.reack = False
 
 
 class Cluster:
@@ -51,6 +89,21 @@ class Cluster:
         self.netsplit_detected = 0
         self.netsplit_resolved = 0
         self._pending_swc: Dict[int, asyncio.Future] = {}
+        # store-and-forward spool for QoS>=1 data-plane frames
+        # (cluster/spool.py); peers advertise support via the hlo "caps"
+        # field so old peers keep the fire-and-forget framing
+        self.spool: Optional[Any] = None
+        if broker.config.get("cluster_spool_enabled", True):
+            from .spool import ClusterSpool
+
+            self.spool = ClusterSpool(
+                broker.config.get("cluster_spool_dir", ""),
+                max_bytes=broker.config.get("cluster_spool_max_bytes",
+                                            128 * 1024 * 1024),
+                metrics=self.metrics)
+        self._peer_caps: Dict[str, Set[str]] = {}
+        self._spool_in: Dict[str, _SpoolIn] = {}
+        self._spool_task: Optional[asyncio.Task] = None
         from .reg_sync import RegSync
 
         self.reg_sync = RegSync(self)
@@ -95,10 +148,20 @@ class Cluster:
         if hasattr(self.metadata, "start_ae"):
             self._sync_metadata_peers()
             self.metadata.start_ae()
+        if self.spool is not None:
+            self._spool_task = asyncio.get_event_loop().create_task(
+                self._spool_retransmit_loop())
 
     async def stop(self) -> None:
         if hasattr(self.metadata, "stop_ae"):
             self.metadata.stop_ae()
+        if self._spool_task is not None:
+            self._spool_task.cancel()
+            self._spool_task = None
+        for st in self._spool_in.values():
+            if st.timer is not None:
+                st.timer.cancel()
+                st.timer = None
         for w in list(self._writers.values()) + self._bootstrap:
             w.stop()
         self._writers.clear()
@@ -106,6 +169,10 @@ class Cluster:
             self._server.close()
         self._com.close_all()  # peers must see the channels drop
         self._bootstrap.clear()
+        if self.spool is not None:
+            # unacked frames stay journaled: a restarted cluster channel
+            # (or a new process over the same spool dir) replays them
+            self.spool.close()
         # Detach from the broker so the vmq listener can be RESTARTED:
         # start_listener refuses while broker.cluster is set, and the
         # registry must stop forwarding into dead writers. The metadata
@@ -201,12 +268,44 @@ class Cluster:
         loop = asyncio.get_event_loop()
         deadline = loop.time() + timeout
         while loop.time() < deadline:
+            # a migration whose target died mid-drain is retried against
+            # the surviving targets (each peer tried at most once per
+            # queue) instead of wedging the leave or stranding the queue;
+            # progress stays visible via `vmq-admin cluster migrations`
+            retargeted = self._retarget_failed_migrations(targets)
             live = [m for m in self.broker.migrations.values()
                     if m["state"] == "draining"]
-            if not live:
+            if not live and not retargeted:
                 break
             await asyncio.sleep(0.05)
         return moved
+
+    def _retarget_failed_migrations(self, targets: List[str]) -> bool:
+        reg = self.broker.registry
+        retargeted = False
+        for sid, m in list(self.broker.migrations.items()):
+            if m.get("state") != "failed":
+                continue
+            tried = m.setdefault("tried", [m["target"]])
+            alive = [t for t in targets
+                     if self._status.get(t) == "up" and t not in tried]
+            if not alive:
+                continue  # nothing left to try; leave reports it stuck
+            rec = reg.db.read(sid)
+            if rec is None:
+                self.broker.migrations.pop(sid, None)
+                continue
+            new_target = alive[0]
+            tried.append(new_target)
+            rec.node = new_target
+            reg.db.store(sid, rec)
+            # the record already pointed away from this node, so the
+            # change event won't re-fire the drain — start it directly
+            self.broker.on_subscriber_moved(sid, new_target)
+            log.warning("migration of %s retargeted %s -> %s after drain "
+                        "failure", sid, m["target"], new_target)
+            retargeted = True
+        return retargeted
 
     def fix_dead_queues(self, targets: Optional[List[str]] = None) -> int:
         """`vmq-admin cluster fix-dead-queues` (vmq_reg:fix_dead_queues,
@@ -254,17 +353,37 @@ class Cluster:
         return sorted(out)
 
     def member_info(self) -> Dict[str, Any]:
+        """hlo payload: identity, capabilities (spool negotiation — old
+        peers ignore unknown fields, we treat a missing "caps" as none),
+        and the writer drop totals, split frames/bytes."""
+        writers = list(self._writers.values()) + self._bootstrap
         return {"node": self.node_name,
-                "addr": [self.listen_host, self.listen_port]}
+                "addr": [self.listen_host, self.listen_port],
+                "caps": ["spool"] if self.spool is not None else [],
+                "frames_dropped": sum(w.dropped_frames for w in writers),
+                "bytes_dropped": sum(w.dropped_bytes for w in writers)}
 
     def on_hello(self, origin: str, info: Dict[str, Any]) -> None:
         """First contact from a node we may not know yet (bootstrap join):
-        record it so the full-mesh forms (the ORSWOT merge equivalent)."""
+        record it so the full-mesh forms (the ORSWOT merge equivalent).
+        Every hello also refreshes the peer's capability set; learning a
+        peer spools unblocks any journaled backlog for it."""
         node, addr = info.get("node"), info.get("addr")
         if node and node != self.node_name and \
                 self.metadata.get(MEMBERS, node) is None:
             self.metadata.put(MEMBERS, node, {
                 "addr": addr, "state": "joined", "joined_at": time.time()})
+        if node:
+            caps = set(info.get("caps") or ())
+            newly_spools = ("spool" in caps
+                            and "spool" not in self._peer_caps.get(node, ()))
+            self._peer_caps[node] = caps
+            if newly_spools:
+                # bootstrap case: our channel came up before we knew the
+                # peer spools, so the channel-up replay was skipped. On a
+                # routine reconnect the capability is already known and
+                # the channel-up hook replays — don't send it all twice.
+                self._maybe_replay_spool(node)
 
     def _sync_metadata_peers(self) -> None:
         """Keep the SWC replica groups' peer set in lock-step with cluster
@@ -303,6 +422,14 @@ class Cluster:
             self._status.pop(node, None)
             if self.plumtree is not None:
                 self.plumtree.peer_down(node)
+            # an ex-member's spooled backlog is undeliverable: discard it
+            # (queue migration owns the member-leave delivery story)
+            if self.spool is not None:
+                self.spool.flush(node)
+            self._peer_caps.pop(node, None)
+            st = self._spool_in.pop(node, None)
+            if st is not None and st.timer is not None:
+                st.timer.cancel()
             self.broker.registry.node_left(node)
 
     # -------------------------------------------------------- channel status
@@ -327,9 +454,21 @@ class Cluster:
         elif old == "down" and status == "up":
             self.netsplit_resolved += 1
             self.metrics.incr("netsplit_resolved")
+        if status == "up":
+            # partition healed / first contact: replay the journaled
+            # backlog AFTER the hlo/anti-entropy frames already queued by
+            # on_peer_connected (buffer order is send order)
+            self._maybe_replay_spool(node)
 
     def inbound_up(self, origin: str) -> None:
         self._inbound[origin] = self._inbound.get(origin, 0) + 1
+        st = self._spool_in.get(origin)
+        if st is not None:
+            # the sender's stream restarted: the cumulative ack may only
+            # cover frames seen on THIS connection (a restarted sender's
+            # sequence space can regress; the dedup window persists)
+            st.cum = 0
+            st.acked_sent = 0
 
     def inbound_down(self, origin: str) -> None:
         n = self._inbound.get(origin, 0) - 1
@@ -362,22 +501,179 @@ class Cluster:
         return self._writers.get(node)
 
     def publish(self, node: str, msg) -> bool:
-        """Data-plane publish forward (vmq_cluster:publish/2)."""
+        """Data-plane publish forward (vmq_cluster:publish/2). The QoS
+        split: QoS 0 keeps the reference's fire-and-forget ``msg`` frame
+        (sheddable under buffer pressure); QoS ≥ 1 to a spool-capable
+        peer is journaled first and shipped as a seq-tagged ``msq`` frame
+        — True then means durably accepted, not necessarily sent."""
         w = self._writers.get(node)
         if w is None:
             self.metrics.incr("cluster_publish_no_channel")
             return False
+        if msg.qos > 0 and self._peer_spools(node):
+            return self._spool_send(node, w, "msg", msg_to_term(msg))
         return w.publish(msg)
 
     def enqueue_nowait(self, node: str, sid, msgs: List[Any]) -> bool:
         """Fire-and-forget remote enqueue (shared-subscription delivery to a
-        remote member)."""
+        remote member); QoS ≥ 1 batches ride the spool like publishes."""
         w = self._writers.get(node)
         if w is None:
             return False
-        return w.send_frame(frame(b"enq", (0, list(sid),
-                                           [msg_to_term(m) for m in msgs],
-                                           False)))
+        term = (0, list(sid), [msg_to_term(m) for m in msgs], False)
+        if any(m.qos > 0 for m in msgs) and self._peer_spools(node):
+            return self._spool_send(node, w, "enq", term)
+        return w.send_frame(frame(b"enq", term))
+
+    # -------------------------------------------------------------- spool
+
+    def _peer_spools(self, node: str) -> bool:
+        return (self.spool is not None
+                and "spool" in self._peer_caps.get(node, ()))
+
+    def _spool_send(self, node: str, w: NodeWriter, kind: str, term) -> bool:
+        """Journal-then-send for one QoS ≥ 1 frame. A refused journal
+        write (byte cap, injected/real IO failure) degrades to the
+        legacy best-effort frame ONLY while the stream is in-order
+        (channel up, nothing journaled-but-unsent that it would
+        overtake) — otherwise it is a visible drop. A journaled frame is
+        accepted even when the channel is down or the stream is paused;
+        replay resyncs it."""
+        st = self.spool.state(node)
+        res = self.spool.journal(node, kind, term)
+        if res is None:
+            if w.status == "up" and not st.blocked:
+                return w.send_frame(frame(kind.encode(), term))
+            return False
+        seq, data = res
+        if st.blocked or w.status != "up":
+            return True  # journaled; replay on channel-up / retransmit
+        if len(st.pending) == 1:
+            # this frame starts the in-flight stream: declare the ack
+            # base so the receiver anchors its contiguity cursor here
+            if not w.send_frame(frame(b"msb", seq)):
+                st.blocked = True
+                return True
+        if not w.send_frame(data):
+            st.blocked = True  # order-preserving pause until replay
+        return True
+
+    def _maybe_replay_spool(self, node: str) -> None:
+        if not self._peer_spools(node):
+            return
+        w = self._writers.get(node)
+        if w is None or w.status != "up":
+            return  # channel-up replays when the writer connects
+        self.spool.replay(node, w.send_frame)
+
+    async def _spool_retransmit_loop(self) -> None:
+        """Ack watchdog: frames unacked for a full interval are replayed
+        over the LIVE channel — the recovery path for in-channel loss
+        (injected ``cluster.recv`` drops, a receiver that lost the ack)
+        where no reconnect ever fires the channel-up replay."""
+        interval = self.broker.config.get(
+            "cluster_spool_retransmit_ms", 1000) / 1000.0
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                for node in self.spool.peers():
+                    st = self.spool.state(node)
+                    if not st.pending or not self._peer_spools(node):
+                        continue
+                    w = self._writers.get(node)
+                    if (w is not None and w.status == "up"
+                            and time.monotonic() - st.last_ack_at
+                            >= interval):
+                        self.spool.replay(node, w.send_frame)
+            except Exception:
+                # a transient journal/IO error must not kill the
+                # watchdog — it is the only replay trigger for
+                # in-channel loss; the next tick retries
+                log.exception("spool retransmit pass failed")
+
+    def spool_base(self, origin: str, base: int) -> None:
+        """``msb`` frame: the origin's lowest unacked seq is ``base`` —
+        everything below is acked history, so the contiguity cursor may
+        anchor there (and only there: anchoring on an arbitrary first
+        frame would silently ack across an in-channel-dropped batch)."""
+        st = self._spool_in.get(origin)
+        if st is None:
+            st = self._spool_in[origin] = _SpoolIn()
+        if base - 1 > st.cum:
+            st.cum = base - 1
+
+    def spool_accept(self, origin: str, seq: int, ref: bytes) -> bool:
+        """Receiver-side gate for one ``msq`` frame: True when it is
+        fresh (dispatch it), False for a duplicate (at-or-below the
+        cumulative cursor, or in the dedup window — a replay after a
+        lost ack). Either way the cumulative ack advances/re-fires so
+        the origin can trim."""
+        st = self._spool_in.get(origin)
+        if st is None:
+            st = self._spool_in[origin] = _SpoolIn()
+        key = (seq, ref)
+        dup = seq <= st.cum or key in st.seen
+        if seq == st.cum + 1:
+            # contiguous: advance the cursor (also over an already-seen
+            # above-gap frame a retransmit just filled in below)
+            st.cum = seq
+        if not dup:
+            st.seen.add(key)
+            st.order.append(key)
+            while len(st.order) > st.DEDUP_WINDOW:
+                st.seen.discard(st.order.popleft())
+        else:
+            self.metrics.incr("cluster_spool_deduped")
+        self._schedule_spool_ack(origin, reack=dup)
+        return not dup
+
+    def _schedule_spool_ack(self, origin: str, reack: bool = False) -> None:
+        """Cumulative-ack pacing: at most one ack per
+        ``cluster_spool_ack_interval`` ms per origin, via a trailing
+        timer so the last frames of a burst are never left unacked. A
+        detected duplicate marks the origin for re-ack (it is replaying
+        because an ack was lost) — still paced, so a replay burst of N
+        duplicates yields one ack, not N."""
+        st = self._spool_in.get(origin)
+        if st is None or st.cum <= 0:
+            return
+        if reack:
+            st.reack = True
+        if st.cum <= st.acked_sent and not st.reack:
+            return  # nothing new to tell the origin
+        loop = asyncio.get_event_loop()
+        interval = self.broker.config.get(
+            "cluster_spool_ack_interval", 50) / 1000.0
+        now = loop.time()
+        if now - st.last_ack_t >= interval:
+            self._send_spool_ack(origin)
+        elif st.timer is None:
+            st.timer = loop.call_later(
+                max(0.0, interval - (now - st.last_ack_t)),
+                self._spool_ack_timer, origin)
+
+    def _spool_ack_timer(self, origin: str) -> None:
+        st = self._spool_in.get(origin)
+        if st is None:
+            return
+        st.timer = None
+        if st.cum > st.acked_sent or st.reack:
+            self._send_spool_ack(origin)
+
+    def _send_spool_ack(self, origin: str) -> None:
+        st = self._spool_in.get(origin)
+        w = self._writers.get(origin)
+        if st is None or w is None:
+            return  # no back-channel yet; the origin's retransmit covers
+        if w.send_frame(frame(b"ack", st.cum)):
+            st.acked_sent = st.cum
+            st.reack = False
+            st.last_ack_t = asyncio.get_event_loop().time()
+            self.metrics.incr("cluster_spool_acks_sent")
+
+    def resolve_spool_ack(self, origin: str, seq: int) -> None:
+        if self.spool is not None:
+            self.spool.ack(origin, seq)
 
     async def remote_enqueue(self, node: str, sid, msgs: List[Any],
                              timeout: Optional[float] = None) -> bool:

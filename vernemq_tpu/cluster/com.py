@@ -23,6 +23,19 @@ from .node import term_to_msg
 log = logging.getLogger("vernemq_tpu.cluster")
 
 
+def _count_subframes(blob: bytes) -> int:
+    """Sub-frames in a ``vmq-send`` batch (header walk, no decode) — the
+    frame count for drop accounting when a whole batch is discarded."""
+    pos = n = 0
+    while pos + 7 <= len(blob):
+        (length,) = struct.unpack(">I", blob[pos + 3:pos + 7])
+        pos += 7 + length
+        if pos > len(blob):
+            break
+        n += 1
+    return n
+
+
 class ClusterCom:
     def __init__(self, cluster):
         self.cluster = cluster
@@ -60,8 +73,11 @@ class ClusterCom:
                     # without blocking other connections
                     await faults.inject_async("cluster.recv")
                 except faults.InjectedFault:
+                    # same split accounting as the writer-side drop path
                     self.cluster.metrics.incr("cluster_bytes_dropped",
                                               length)
+                    self.cluster.metrics.incr("cluster_frames_dropped",
+                                              _count_subframes(blob))
                     log.warning("injected fault dropped a %d-byte "
                                 "cluster batch from %s", length, origin)
                     continue
@@ -102,6 +118,27 @@ class ClusterCom:
             # remote publish: local subscribers only (origin covered the rest)
             msg = term_to_msg(term)
             cluster.broker.registry.publish_from_remote(msg)
+        elif cmd == b"msq":
+            # spooled seq-tagged envelope (cluster/spool.py): dedup on
+            # (seq, msg_ref) per origin — a replay after a lost ack must
+            # not double-route QoS 2 — then dispatch the inner msg/enq
+            # frame and schedule the cumulative ack back to the origin
+            seq, kind, inner = term
+            if kind == "msg":
+                ref = inner.get("ref") or b""
+            else:  # enq: (ref_id, sid, msgs, want_ack)
+                msgs = inner[2]
+                ref = (msgs[0].get("ref") or b"") if msgs else b""
+            if cluster.spool_accept(origin, int(seq), ref):
+                self._dispatch(origin, kind.encode(), inner)
+        elif cmd == b"msb":
+            # spool stream base: the origin's lowest unacked seq — the
+            # anchor for the receiver's contiguous-ack cursor
+            cluster.spool_base(origin, int(term))
+        elif cmd == b"ack":
+            # cumulative spool ack: the peer received every spooled frame
+            # up to seq (contiguously) — delete them from our journal
+            cluster.resolve_spool_ack(origin, int(term))
         elif cmd == b"enq":
             ref_id, sid, msgs, want_ack = term
             sid = (sid[0], sid[1])

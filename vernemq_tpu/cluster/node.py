@@ -10,9 +10,12 @@ with drop accounting when the peer is unreachable
 blocks on an ack with timeout for migration backpressure (``:67-83``).
 
 Frame commands:
-``msg`` publish fanout (fire-and-forget) · ``enq`` remote enqueue
-(acked) · ``akn`` enqueue ack · ``mta`` metadata delta · ``mtf`` metadata
-full-state (anti-entropy on connect) · ``hlo`` member info exchange.
+``msg`` publish fanout (fire-and-forget) · ``msq`` seq-tagged spooled
+``msg``/``enq`` envelope (cluster/spool.py) · ``msb`` spool stream base
+(lowest unacked seq) · ``ack`` cumulative spool ack · ``enq`` remote
+enqueue (acked) · ``akn`` enqueue ack · ``mta``
+metadata delta · ``mtf`` metadata full-state (anti-entropy on connect) ·
+``hlo`` member info + capability exchange.
 """
 
 from __future__ import annotations
@@ -87,14 +90,18 @@ class NodeWriter:
         self.node_name = node_name
         self.addr = addr
         self.max_buffer_bytes = max_buffer_bytes
-        self._buf: list = []
+        self._buf: list = []  # (frame_bytes, sheddable) pairs
         self._buf_bytes = 0
+        self._sheddable_bytes = 0  # QoS0 bytes in _buf (shed fast path)
         self._conn_lost = False
         self._wakeup = asyncio.Event()
         self.status = "init"  # init | up | down (vmq_cluster_node.erl:202-212)
         self._task: Optional[asyncio.Task] = None
         self._writer: Optional[asyncio.StreamWriter] = None
-        self.dropped = 0
+        # drop accounting, split by unit: the per-writer totals feed
+        # member_info(); the metric counters feed $SYS/Prometheus
+        self.dropped_frames = 0
+        self.dropped_bytes = 0
 
     def start(self) -> None:
         self._task = asyncio.get_event_loop().create_task(self._run())
@@ -107,20 +114,60 @@ class NodeWriter:
 
     # ----------------------------------------------------------------- send
 
-    def send_frame(self, data: bytes) -> bool:
-        """Append to the bounded buffer; drops (with accounting) when the
-        peer is down and the buffer is full (vmq_cluster_node.erl:124-147)."""
-        if self._buf_bytes + len(data) > self.max_buffer_bytes:
-            self.dropped += 1
-            self.cluster.metrics.incr("cluster_bytes_dropped", len(data))
+    def send_frame(self, data: bytes, sheddable: bool = False) -> bool:
+        """Append to the bounded buffer; drops (with frames+bytes
+        accounting) when the peer is down and the buffer is full
+        (vmq_cluster_node.erl:124-147). ``sheddable`` marks QoS 0
+        publishes: when a non-sheddable frame (QoS ≥ 1 data, metadata,
+        acks) would not fit, buffered QoS 0 frames are evicted
+        oldest-first to make room — delivery-guaranteed traffic sheds
+        best-effort traffic, never the other way around."""
+        size = len(data)
+        if self._buf_bytes + size > self.max_buffer_bytes and not sheddable:
+            self._shed_qos0(size)
+        if self._buf_bytes + size > self.max_buffer_bytes:
+            self.dropped_frames += 1
+            self.dropped_bytes += size
+            m = self.cluster.metrics
+            m.incr("cluster_frames_dropped")
+            m.incr("cluster_bytes_dropped", size)
             return False
-        self._buf.append(data)
-        self._buf_bytes += len(data)
+        self._buf.append((data, sheddable))
+        self._buf_bytes += size
+        if sheddable:
+            self._sheddable_bytes += size
         self._wakeup.set()
         return True
 
+    def _shed_qos0(self, needed: int) -> None:
+        """Evict buffered QoS 0 frames (oldest first) until ``needed``
+        bytes fit. Shed frames count as drops too — they are gone."""
+        if not self._sheddable_bytes:
+            return  # nothing evictable: skip the buffer walk
+        shed = shed_bytes = 0
+        i = 0
+        while (i < len(self._buf)
+               and self._buf_bytes + needed > self.max_buffer_bytes):
+            data, sheddable = self._buf[i]
+            if sheddable:
+                del self._buf[i]
+                self._buf_bytes -= len(data)
+                self._sheddable_bytes -= len(data)
+                shed += 1
+                shed_bytes += len(data)
+            else:
+                i += 1
+        if shed:
+            self.dropped_frames += shed
+            self.dropped_bytes += shed_bytes
+            m = self.cluster.metrics
+            m.incr("cluster_frames_shed_qos0", shed)
+            m.incr("cluster_frames_dropped", shed)
+            m.incr("cluster_bytes_dropped", shed_bytes)
+
     def publish(self, msg) -> bool:
-        return self.send_frame(frame(b"msg", msg_to_term(msg)))
+        return self.send_frame(frame(b"msg", msg_to_term(msg)),
+                               sheddable=msg.qos == 0)
 
     # ------------------------------------------------------------ connection
 
@@ -178,13 +225,14 @@ class NodeWriter:
                     await asyncio.wait_for(self._wakeup.wait(),
                                            self.PING_INTERVAL)
                 except asyncio.TimeoutError:
-                    self._buf.append(frame(b"png", None))
+                    self._buf.append((frame(b"png", None), False))
                     self._buf_bytes += 12
             if self._conn_lost or writer.is_closing():
                 raise ConnectionError("channel closed by peer")
             batch, self._buf = self._buf, []
             nbytes, self._buf_bytes = self._buf_bytes, 0
-            blob = b"".join(batch)
+            self._sheddable_bytes = 0
+            blob = b"".join(d for d, _ in batch)
             writer.write(SEND + struct.pack(">I", len(blob)) + blob)
             await writer.drain()
             self.cluster.metrics.incr("cluster_bytes_sent", nbytes)

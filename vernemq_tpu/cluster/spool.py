@@ -1,0 +1,426 @@
+"""Store-and-forward spool for the cluster data plane.
+
+The reference forwards ``msg`` frames fire-and-forget: a QoS 1/2 publish
+routed to a subscriber on a partitioned or restarting peer is dropped from
+the bounded in-memory buffer (``vmq_cluster_node.erl:124-147``) or lost
+outright on local crash — metadata heals via anti-entropy, the messages
+never do. This module closes that gap: QoS ≥ 1 ``msg``/``enq`` frames to a
+spool-capable peer (negotiated via the ``hlo`` exchange, see
+``Cluster.member_info``) are journaled here *before* they reach the
+writer, tagged with a per-peer monotonic sequence number, shipped as
+``msq`` frames, and deleted only when the receiver's cumulative ``ack``
+covers them. On channel re-establishment (and on the retransmit timer,
+for in-channel loss drills) the spool replays unacked frames in order.
+The receiver acks only along CONTIGUOUS sequence runs anchored by the
+sender's ``msb`` stream-base frame — an ack across a gap would trim
+frames the receiver never saw — suppresses anything at-or-below its
+cursor, and keeps a bounded ``(seq, msg_ref)`` dedup window for
+above-gap frames, so a sender whose sequence space restarted is never
+mistaken for a replay and redelivery is safe for QoS 2.
+
+Storage reuses the ``native/kvstore.py`` engine like ``storage/
+msg_store.py`` does (torn-tail-tolerant log recovery is the engine's),
+with a pure-Python append-log fallback when the toolchain is missing and
+a memory journal when ``cluster_spool_dir`` is unset (replay across
+partitions, no crash durability). Key families:
+
+- ``s<len16><peer><seq:8>`` → the ready-to-send ``msq`` frame bytes
+- ``h<len16><peer>``        → high-water seq (survives full acks, so a
+  restarted sender never reuses a sequence number against a peer)
+
+The spool is bounded by ``cluster_spool_max_bytes``; past the cap new
+frames are refused (counted) and sent best-effort on the legacy path
+when that cannot overtake journaled-but-unsent frames (dropped visibly
+otherwise) — durability is shed before delivery, order before either.
+``cluster.spool`` is a fault-injection
+point (``robustness/faults.py``): an injected error models a journal
+write failure, latency a slow disk (capped — the journal write runs on
+the event loop like the msg-store write seam).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..robustness import faults
+from .node import frame
+
+log = logging.getLogger("vernemq_tpu.cluster")
+
+
+def _peer_key(peer: str) -> bytes:
+    b = peer.encode()
+    return len(b).to_bytes(2, "big") + b
+
+
+def _parse_peer(key: bytes) -> Tuple[str, bytes]:
+    """``key`` without its family byte → (peer, rest)."""
+    n = int.from_bytes(key[:2], "big")
+    return key[2:2 + n].decode(), key[2 + n:]
+
+
+class _NullMetrics:
+    def incr(self, name: str, n: int = 1) -> None:
+        pass
+
+
+class _MemJournal:
+    """In-process journal (``cluster_spool_dir`` unset): replay across
+    partitions and writer-buffer overflow, no crash durability."""
+
+    durable = False
+
+    def __init__(self) -> None:
+        self._d: Dict[bytes, bytes] = {}
+
+    def put_many(self, pairs) -> None:
+        self._d.update(dict(pairs))
+
+    def delete(self, key: bytes) -> None:
+        self._d.pop(key, None)
+
+    def scan(self, prefix: bytes = b"") -> List[Tuple[bytes, bytes]]:
+        return sorted((k, v) for k, v in self._d.items()
+                      if k.startswith(prefix))
+
+    def sync(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class _FileJournal:
+    """Append-log journal for hosts without the native engine: every
+    put/delete is one framed record, state is rebuilt on open, a torn
+    tail (crash mid-append) truncates cleanly at the last whole record —
+    the same recovery discipline as ``NativeMsgStore._recover``."""
+
+    durable = True
+    _COMPACT_MIN = 8 * 1024 * 1024
+
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._d: Dict[bytes, bytes] = {}
+        self._dead = 0  # bytes of overwritten/deleted records on disk
+        self._live = 0  # bytes of live values (O(1) compaction check)
+        self._recover()
+        self._live = sum(len(v) for v in self._d.values())
+        self._fh = open(path, "ab")
+
+    def _recover(self) -> None:
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as fh:
+            blob = fh.read()
+        pos = 0
+        while pos < len(blob):
+            start = pos
+            op = blob[pos:pos + 1]
+            if op not in (b"P", b"D") or pos + 5 > len(blob):
+                break  # torn/garbage tail: keep everything before it
+            (klen,) = struct.unpack(">I", blob[pos + 1:pos + 5])
+            pos += 5
+            key = blob[pos:pos + klen]
+            pos += klen
+            if len(key) != klen:
+                pos = start
+                break
+            if op == b"P":
+                if pos + 4 > len(blob):
+                    pos = start
+                    break
+                (vlen,) = struct.unpack(">I", blob[pos:pos + 4])
+                pos += 4
+                val = blob[pos:pos + vlen]
+                pos += vlen
+                if len(val) != vlen:
+                    pos = start
+                    break
+                if key in self._d:
+                    self._dead += len(self._d[key])
+                self._d[key] = val
+            else:
+                self._dead += len(self._d.pop(key, b""))
+        if pos < len(blob):
+            log.warning("spool journal %s: torn tail at +%d of %d bytes "
+                        "(truncating)", self.path, pos, len(blob))
+            with open(self.path, "r+b") as fh:
+                fh.truncate(pos)
+
+    def put_many(self, pairs) -> None:
+        out = bytearray()
+        for k, v in pairs:
+            if k in self._d:
+                dead = len(self._d[k])
+                self._dead += dead
+                self._live -= dead
+            self._d[k] = v
+            self._live += len(v)
+            out += b"P" + struct.pack(">I", len(k)) + k
+            out += struct.pack(">I", len(v)) + v
+        self._fh.write(out)
+        self._fh.flush()
+
+    def delete(self, key: bytes) -> None:
+        if key not in self._d:
+            return
+        dead = len(self._d.pop(key))
+        self._dead += dead
+        self._live -= dead
+        self._fh.write(b"D" + struct.pack(">I", len(key)) + key)
+        self._fh.flush()
+        self._maybe_compact()
+
+    def scan(self, prefix: bytes = b"") -> List[Tuple[bytes, bytes]]:
+        return sorted((k, v) for k, v in self._d.items()
+                      if k.startswith(prefix))
+
+    def _maybe_compact(self) -> None:
+        if self._dead < self._COMPACT_MIN or self._dead < self._live:
+            return
+        tmp = self.path + ".compact"
+        with open(tmp, "wb") as fh:
+            for k, v in sorted(self._d.items()):
+                fh.write(b"P" + struct.pack(">I", len(k)) + k
+                         + struct.pack(">I", len(v)) + v)
+        self._fh.close()
+        os.replace(tmp, self.path)
+        self._fh = open(self.path, "ab")
+        self._dead = 0
+
+    def sync(self) -> None:
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class _PeerState:
+    """Per-peer spool bookkeeping (all event-loop-thread)."""
+
+    __slots__ = ("next_seq", "pending", "bytes", "blocked", "last_ack_at")
+
+    def __init__(self) -> None:
+        self.next_seq = 1
+        # seq -> frame bytes length, ascending insertion order
+        self.pending: "OrderedDict[int, int]" = OrderedDict()
+        self.bytes = 0
+        # True once a frame failed to buffer: subsequent spooled frames
+        # journal without sending (per-peer order must not invert) until
+        # a replay resyncs the stream
+        self.blocked = False
+        self.last_ack_at = 0.0
+
+
+class ClusterSpool:
+    """Durable per-peer journal of QoS ≥ 1 cluster data-plane frames."""
+
+    def __init__(self, directory: str = "",
+                 max_bytes: int = 128 * 1024 * 1024,
+                 metrics=None):
+        self.directory = directory
+        self.max_bytes = max_bytes
+        self.metrics = metrics if metrics is not None else _NullMetrics()
+        self._peers: Dict[str, _PeerState] = {}
+        self._bytes = 0
+        self._kv = self._open_journal(directory)
+        self._load()
+
+    @staticmethod
+    def _open_journal(directory: str):
+        if not directory:
+            return _MemJournal()
+        os.makedirs(directory, exist_ok=True)
+        try:
+            from ..native.kvstore import KVStore
+
+            return KVStore(os.path.join(directory, "spool.kv"))
+        except Exception as e:
+            log.warning("native kvstore unavailable for the cluster spool "
+                        "(%s); using the append-log journal", e)
+            return _FileJournal(os.path.join(directory, "spool.log"))
+
+    def _load(self) -> None:
+        for key, val in self._kv.scan(b"s"):
+            peer, rest = _parse_peer(key[1:])
+            seq = int.from_bytes(rest[:8], "big")
+            st = self._state(peer)
+            st.pending[seq] = len(val)
+            st.bytes += len(val)
+            self._bytes += len(val)
+            if seq >= st.next_seq:
+                st.next_seq = seq + 1
+        for key, val in self._kv.scan(b"h"):
+            peer, _ = _parse_peer(key[1:])
+            st = self._state(peer)
+            high = int.from_bytes(val, "big")
+            if high >= st.next_seq:
+                st.next_seq = high + 1
+        if self._bytes:
+            log.info("cluster spool recovered %d unacked frame(s) "
+                     "(%d bytes) for %d peer(s)",
+                     sum(len(s.pending) for s in self._peers.values()),
+                     self._bytes, sum(1 for s in self._peers.values()
+                                      if s.pending))
+
+    def _state(self, peer: str) -> _PeerState:
+        st = self._peers.get(peer)
+        if st is None:
+            st = self._peers[peer] = _PeerState()
+        return st
+
+    state = _state  # public accessor (cluster send path, tests)
+
+    def peers(self) -> List[str]:
+        return list(self._peers)
+
+    # ------------------------------------------------------------- journal
+
+    def journal(self, peer: str, kind: str, term) -> Optional[Tuple[int, bytes]]:
+        """Assign the next seq for ``peer`` and durably journal the ready
+        ``msq`` frame. Returns ``(seq, frame_bytes)``, or None when the
+        byte cap refuses the frame or the journal write fails (injected
+        or real) — the caller then sends best-effort on the legacy path.
+        """
+        st = self._state(peer)
+        try:
+            # event-loop-side seam like broker.store_offline: injected
+            # latency models a slow spool disk, capped so a hang drill
+            # stalls rather than freezes the loop
+            faults.inject("cluster.spool", max_delay_s=1.0)
+            seq = st.next_seq
+            data = frame(b"msq", (seq, kind, term))
+            if self._bytes + len(data) > self.max_bytes:
+                self.metrics.incr("cluster_spool_overflow")
+                return None
+            pk = _peer_key(peer)
+            self._kv.put_many([
+                (b"s" + pk + seq.to_bytes(8, "big"), data),
+                (b"h" + pk, seq.to_bytes(8, "big")),
+            ])
+        except Exception:
+            self.metrics.incr("cluster_spool_errors")
+            log.exception("spool journal write for %s failed "
+                          "(frame sent best-effort, durability lost)", peer)
+            return None
+        st.next_seq = seq + 1
+        if not st.pending:
+            st.last_ack_at = time.monotonic()
+        st.pending[seq] = len(data)
+        st.bytes += len(data)
+        self._bytes += len(data)
+        self.metrics.incr("cluster_spool_journaled")
+        return seq, data
+
+    def ack(self, peer: str, seq: int) -> int:
+        """Cumulative ack from ``peer``: delete journaled frames ≤ seq."""
+        st = self._peers.get(peer)
+        if st is None:
+            return 0
+        pk = _peer_key(peer)
+        n = 0
+        for s in list(st.pending):
+            if s > seq:
+                break  # pending is seq-ascending
+            size = st.pending.pop(s)
+            st.bytes -= size
+            self._bytes -= size
+            self._kv.delete(b"s" + pk + s.to_bytes(8, "big"))
+            n += 1
+        if n:
+            st.last_ack_at = time.monotonic()
+            if not st.pending:
+                st.blocked = False
+        return n
+
+    def replay(self, peer: str, send: Callable[[bytes], bool]) -> int:
+        """Resend every unacked frame for ``peer`` in seq order (channel
+        re-establishment / retransmit timer / buffer-drain resync),
+        preceded by an ``msb`` stream-base frame: pending is always a
+        contiguous run [low..high] (acks are cumulative), and the base
+        tells the receiver everything below ``low`` is acked so it can
+        anchor its contiguity cursor there — without it, a receiver that
+        missed the first batch could ack past frames it never saw.
+        Frames the receiver did get are absorbed by its dedup state.
+        ``send`` returning False (writer buffer full) pauses the stream
+        blocked — a later replay picks it up."""
+        st = self._peers.get(peer)
+        if st is None or not st.pending:
+            return 0
+        if not send(frame(b"msb", next(iter(st.pending)))):
+            st.blocked = True
+            return 0
+        sent = 0
+        for _key, data in self._kv.scan(b"s" + _peer_key(peer)):
+            if not send(data):
+                st.blocked = True
+                break
+            sent += 1
+        else:
+            st.blocked = False
+        if sent:
+            st.last_ack_at = time.monotonic()
+            self.metrics.incr("cluster_spool_replayed", sent)
+        return sent
+
+    def flush(self, peer: Optional[str] = None) -> Tuple[int, int]:
+        """Operator escape hatch (`vmq-admin cluster spool flush`): drop
+        journaled frames — for one peer or all — and return (frames,
+        bytes) discarded. High-water marks are kept so sequence numbers
+        never regress."""
+        peers = [peer] if peer is not None else list(self._peers)
+        frames = nbytes = 0
+        for p in peers:
+            st = self._peers.get(p)
+            if st is None:
+                continue
+            pk = _peer_key(p)
+            for s, size in list(st.pending.items()):
+                self._kv.delete(b"s" + pk + s.to_bytes(8, "big"))
+                frames += 1
+                nbytes += size
+            self._bytes -= st.bytes
+            st.pending.clear()
+            st.bytes = 0
+            st.blocked = False
+        return frames, nbytes
+
+    # ------------------------------------------------------- introspection
+
+    def stats(self) -> Dict[str, float]:
+        """Gauge snapshot for the $SYS tree / Prometheus."""
+        return {
+            "cluster_spool_depth_frames": float(
+                sum(len(s.pending) for s in self._peers.values())),
+            "cluster_spool_depth_bytes": float(self._bytes),
+            "cluster_spool_outstanding_acks": float(
+                sum(1 for s in self._peers.values() if s.pending)),
+            "cluster_spool_peers_blocked": float(
+                sum(1 for s in self._peers.values() if s.blocked)),
+        }
+
+    def peer_stats(self) -> List[Dict[str, object]]:
+        out = []
+        for peer, st in sorted(self._peers.items()):
+            out.append({
+                "peer": peer,
+                "pending_frames": len(st.pending),
+                "pending_bytes": st.bytes,
+                "next_seq": st.next_seq,
+                "lowest_unacked": next(iter(st.pending), None),
+                "blocked": st.blocked,
+            })
+        return out
+
+    def sync(self) -> None:
+        self._kv.sync()
+
+    def close(self) -> None:
+        self._kv.close()

@@ -18,6 +18,7 @@ Injection points in the tree (grep for ``faults.inject``):
 ``device.delta``     delta-scatter upload of dirty table slots
 ``device.rebuild``   full device-table (re)build, inline or background
 ``cluster.recv``     inbound cluster data-plane frames (cluster/com.py)
+``cluster.spool``    delivery-spool journal writes (cluster/spool.py)
 ``store.write``      message-store writes (storage/msg_store.py)
 ``listener.bind``    listener (re)bind (broker/listeners.py)
 ==================  =====================================================

@@ -16,12 +16,15 @@ interface in a later round.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import zlib
 from typing import Dict, List, Optional, Tuple
 
 from ..broker.message import Msg, SubscriberId
+
+log = logging.getLogger("vernemq_tpu.storage")
 
 
 class MsgStore:
@@ -126,7 +129,8 @@ class NativeMsgStore(MsgStore):
     reference's one gen_server per bucket serializing that bucket's ops.
     """
 
-    def __init__(self, directory: str, seq: Optional[SeqCounter] = None):
+    def __init__(self, directory: str, seq: Optional[SeqCounter] = None,
+                 fsync: bool = False):
         import time as _time
 
         from ..cluster.codec import decode, encode
@@ -154,6 +158,7 @@ class NativeMsgStore(MsgStore):
         self._refcount: Dict[bytes, int] = {}
         self._seqs: Dict[SubscriberId, Dict[bytes, List[int]]] = {}
         self._seq = seq or SeqCounter()
+        self._fsync = fsync
         self._lock = threading.Lock()
         self._recover()
 
@@ -217,6 +222,8 @@ class NativeMsgStore(MsgStore):
             # _refcount first would make a retried first-delivery skip
             # the m-record forever (silent loss after restart)
             self._kv.put_many(batch)
+            if self._fsync:  # opt-in power-loss durability per write
+                self._kv.sync()
             if first:
                 self._refcount[ref] = 0
             self._refcount[ref] += 1
@@ -288,43 +295,70 @@ class FileMsgStore(MemoryMsgStore):
     (the recovery scan role of vmq_lvldb_store.erl:396-453). Simple but
     durable; swapped for the C++ engine later."""
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, fsync: bool = False):
         super().__init__()
         os.makedirs(directory, exist_ok=True)
         self._path = os.path.join(directory, "msgstore.log")
+        self._fsync = fsync
+        #: corrupt mid-file records skipped at recovery (surfaced as the
+        #: msg_store_recover_skipped metric by the broker)
+        self.recover_skipped = 0
         self._recover()
         self._fh = open(self._path, "ab")
 
     def _recover(self) -> None:
+        """Rebuild state from the journal, streaming (a long-lived log
+        must not be slurped into memory). A torn final record (crash
+        mid-append: no trailing newline) is expected — it is not applied
+        and the file is TRUNCATED past it, or the next append would
+        merge with the partial line and corrupt a good record. A corrupt
+        newline-terminated record is skipped and counted — every later
+        record still recovers (the old behavior discarded the whole
+        tail)."""
         if not os.path.exists(self._path):
             return
+        torn_at = None
+        pos = 0
         with open(self._path, "rb") as fh:
             for line in fh:
+                if not line.endswith(b"\n"):
+                    torn_at = pos  # torn tail write
+                    break
+                pos += len(line)
                 try:
                     rec = json.loads(line)
-                except json.JSONDecodeError:
-                    break  # torn tail write
-                op = rec["op"]
-                sid = (rec["mp"], rec["cid"])
-                if op == "w":
-                    msg = Msg(
-                        topic=tuple(rec["topic"]),
-                        payload=bytes.fromhex(rec["payload"]),
-                        qos=rec["qos"],
-                        retain=rec.get("retain", False),
-                        mountpoint=rec["mp"],
-                        msg_ref=rec["ref"].encode(),
-                        properties=rec.get("props", {}),
-                    )
-                    super().write(sid, msg)
-                elif op == "d":
-                    super().delete(sid, rec["ref"].encode())
-                elif op == "da":
-                    super().delete_all(sid)
+                    op = rec["op"]
+                    sid = (rec["mp"], rec["cid"])
+                    if op == "w":
+                        msg = Msg(
+                            topic=tuple(rec["topic"]),
+                            payload=bytes.fromhex(rec["payload"]),
+                            qos=rec["qos"],
+                            retain=rec.get("retain", False),
+                            mountpoint=rec["mp"],
+                            msg_ref=rec["ref"].encode(),
+                            properties=rec.get("props", {}),
+                        )
+                        super().write(sid, msg)
+                    elif op == "d":
+                        super().delete(sid, rec["ref"].encode())
+                    elif op == "da":
+                        super().delete_all(sid)
+                except (json.JSONDecodeError, KeyError, ValueError,
+                        TypeError):
+                    self.recover_skipped += 1
+        if torn_at is not None:
+            with open(self._path, "r+b") as fh:
+                fh.truncate(torn_at)
+        if self.recover_skipped:
+            log.warning("msg store %s: skipped %d corrupt record(s) "
+                        "during recovery", self._path, self.recover_skipped)
 
     def _log(self, rec: dict) -> None:
         self._fh.write(json.dumps(rec).encode() + b"\n")
         self._fh.flush()
+        if self._fsync:  # opt-in power-loss durability per write
+            os.fsync(self._fh.fileno())
 
     def write(self, sid: SubscriberId, msg: Msg) -> None:
         super().write(sid, msg)
@@ -359,7 +393,8 @@ class BucketedMsgStore(MsgStore):
     ``msg_store_find``, ``vmq_lvldb_store.erl:84-107``).
     """
 
-    def __init__(self, directory: str, instances: int = 12):
+    def __init__(self, directory: str, instances: int = 12,
+                 fsync: bool = False):
         os.makedirs(directory, exist_ok=True)
         # the bucket count is part of the on-disk layout: ref→bucket hashing
         # must match what wrote the data, or deletes silently miss. Persist
@@ -383,7 +418,8 @@ class BucketedMsgStore(MsgStore):
         try:
             for i in range(max(1, instances)):
                 self.instances.append(NativeMsgStore(
-                    os.path.join(directory, f"bucket{i}"), seq=self._seqc))
+                    os.path.join(directory, f"bucket{i}"), seq=self._seqc,
+                    fsync=fsync))
         except Exception:
             for inst in self.instances:  # no half-open engines left locked
                 inst.close()
