@@ -798,6 +798,142 @@ def config7_partition_storm(smoke):
     return asyncio.run(run())
 
 
+def config8_retained_storm(rng, smoke, n_retained=None, batch=None,
+                           iters=None, n_host=None):
+    """Retained subscribe storm: wildcard SUBSCRIBE bursts against a
+    large retained set, device reverse-match vs the serial host walk.
+
+    Builds one RetainStore + one RetainedIndex (write-through, exactly
+    the production wiring), measures the host-walk replay rate
+    (``RetainStore.match_filter`` per subscribe — the config-4 serial
+    path), then batched device replay throughput over the same filter
+    distribution (80% concrete-first single-``+``, 10% trailing-``#``,
+    10% wildcard-first — the dense-phase stressor). ``parity_ok``
+    asserts the device results are bit-identical to the host oracle on a
+    sample; per-filter device escapes resolve against the store exactly
+    like the production collector. A final phase injects a persistent
+    ``device.retained`` outage and verifies replays degrade to the host
+    walk with zero wrong results (graceful-fallback acceptance)."""
+    from vernemq_tpu.broker.retain import RetainStore
+    from vernemq_tpu.models.tpu_matcher import DeviceDegraded
+    from vernemq_tpu.retained.index import RetainedIndex
+    from vernemq_tpu.robustness import faults
+    from vernemq_tpu.robustness.breaker import CircuitBreaker
+
+    n_ret = n_retained or (100_000 if smoke else 1_000_000)
+    b = batch or (2048 if smoke else 4096)
+    reps = iters or (6 if smoke else 20)
+    n_host = n_host or (300 if smoke else 500)
+    l0 = [f"r{i}" for i in range(64)]
+    l1 = [f"d{i}" for i in range(256)]
+    l2 = [f"m{i}" for i in range(64)]
+
+    store = RetainStore()
+    idx = RetainedIndex(store, max_levels=8,
+                        initial_capacity=1 << (n_ret - 1).bit_length(),
+                        max_fanout=256)
+    idx.async_rebuild = False  # bench times the inline build, like cfg 3
+    idx.breaker = CircuitBreaker(failure_threshold=3, backoff_initial=0.05,
+                                 backoff_max=0.4)
+    t0 = time.perf_counter()
+    for i in range(n_ret):
+        t = (rng.choice(l0), rng.choice(l1), rng.choice(l2))
+        store.insert("", t, b"x" * 16)
+        idx.on_retain(t, b"x" * 16)
+    build_s = time.perf_counter() - t0
+
+    def mk_filters(n):
+        # storm mix: concrete-first single-'+' dominates (the config-4
+        # shape), trailing-'#' prefixes ride the same probe windows,
+        # wildcard-first filters exercise the dense phase (device on
+        # accelerators; host-routed on cpu — see RetainedIndex.dense_policy)
+        out = []
+        for _ in range(n):
+            r = rng.random()
+            if r < 0.85:
+                out.append((rng.choice(l0), "+", rng.choice(l2)))
+            elif r < 0.95:
+                out.append((rng.choice(l0), rng.choice(l1), "#"))
+            else:
+                out.append(("+", rng.choice(l1), rng.choice(l2)))
+        return out
+
+    # serial host walk (the config-4 path: one match_filter per subscribe)
+    host_filters = mk_filters(n_host)
+    t0 = time.perf_counter()
+    host_replayed = 0
+    for fw in host_filters:
+        host_replayed += len(store.match_filter("", list(fw)))
+    host_dt = time.perf_counter() - t0
+
+    def norm(rows):
+        return sorted((t, v) for t, v in rows)
+
+    def run_batch(filters):
+        """Production contract: device dispatch, per-filter escapes
+        resolved against the store (what the collector does)."""
+        res = idx.match_filters(filters)
+        fallbacks = 0
+        out = []
+        for fw, rows in zip(filters, res):
+            if rows is None:
+                fallbacks += 1
+                rows = store.match_filter("", list(fw))
+            out.append(rows)
+        return out, fallbacks
+
+    batches = [mk_filters(b) for _ in range(min(reps, 6))]
+    run_batch(batches[0])  # build + compile warm
+    run_batch(batches[0])
+    t0 = time.perf_counter()
+    replayed = fallbacks = 0
+    for i in range(reps):
+        out, fb = run_batch(batches[i % len(batches)])
+        replayed += sum(len(r) for r in out)
+        fallbacks += fb
+    dev_dt = time.perf_counter() - t0
+    dev_rate = b * reps / dev_dt
+
+    # parity: device vs the host oracle on one fresh batch
+    parity_filters = mk_filters(min(b, 512))
+    out, _fb = run_batch(parity_filters)
+    bad = sum(1 for fw, rows in zip(parity_filters, out)
+              if norm(rows) != norm(store.match_filter("", list(fw))))
+
+    # graceful fallback under an injected device.retained outage
+    faults.install(faults.FaultPlan(
+        [faults.FaultRule("device.retained", kind="error")], seed=8))
+    degraded_bad = 0
+    for fw in parity_filters[:64]:
+        try:
+            rows = idx.match_filters([fw])[0]
+            if rows is None:
+                rows = store.match_filter("", list(fw))
+        except DeviceDegraded:
+            rows = store.match_filter("", list(fw))  # the production path
+        if norm(rows) != norm(store.match_filter("", list(fw))):
+            degraded_bad += 1
+    breaker_state = idx.breaker.state_name
+    faults.clear()
+
+    host_rate = n_host / host_dt
+    return {
+        "retained_msgs": len(store),
+        "batch": b,
+        "build_s": round(build_s, 2),
+        "retained_replay_subscribes_per_sec": round(dev_rate),
+        "retained_replayed_per_sec": round(replayed / dev_dt),
+        "host_replay_subscribes_per_sec": round(host_rate),
+        "host_replayed_per_sec": round(host_replayed / host_dt),
+        "speedup_vs_host_walk": round(dev_rate / host_rate, 2),
+        "host_fallback_filters": fallbacks,
+        "dispatches": idx.match_dispatches,
+        "parity_ok": bad == 0 and degraded_bad == 0,
+        "breaker_state_during_storm": breaker_state,
+        "degraded_sheds": idx.degraded_sheds,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--subs", type=int, default=1_000_000)
@@ -816,13 +952,15 @@ def main() -> int:
     ap.add_argument("--stack", type=int, default=8,
                     help="batches per executable for --variant "
                     "packed_stack")
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7",
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8",
                     help="which BASELINE configs to run (3 = headline; "
                     "6 = fault-storm robustness: publish p99 while the "
                     "device path is down + breaker recovery time; "
                     "7 = partition storm: two brokers, inter-node link "
                     "severed under QoS1 load — spool replay throughput "
-                    "+ zero-loss parity)")
+                    "+ zero-loss parity; 8 = retained subscribe storm: "
+                    "wildcard SUBSCRIBE bursts vs 100k-1M retained — "
+                    "device reverse-match rate vs the serial host walk)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
     ap.add_argument("--kernel-only", action="store_true",
@@ -1057,6 +1195,10 @@ def main() -> int:
     if "7" in want:
         guarded("7_partition_storm",
                 lambda: config7_partition_storm(smoke))
+
+    if "8" in want:
+        guarded("8_retained_storm",
+                lambda: config8_retained_storm(rng, smoke))
 
     if headline is not None:
         value = headline["matches_per_sec"]

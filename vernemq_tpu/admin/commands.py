@@ -162,14 +162,17 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "[order_by=f1,f2] [--<field>...]")
     reg.register(["ql", "query"], _ql_query,
                  "vmq-admin ql query q='SELECT f FROM sessions|queues|"
-                 "subscriptions|messages|retain [WHERE ...] "
-                 "[ORDER BY f [DESC]] [LIMIT n]'")
+                 "subscriptions|messages|retain|retained_index "
+                 "[WHERE ...] [ORDER BY f [DESC]] [LIMIT n]'")
     reg.register(["queue", "show"], _queue_show,
                  "vmq-admin queue show [--limit=N]")
     reg.register(["subscription", "show"], _subscription_show,
                  "vmq-admin subscription show [--limit=N]")
     reg.register(["retain", "show"], _retain_show,
                  "vmq-admin retain show [--limit=N]")
+    reg.register(["retain", "index"], _retain_index_show,
+                 "vmq-admin retain index  (device retained-index status; "
+                 "row diffs via ql table retained_index)")
     reg.register(["metrics", "show"], _metrics_show,
                  "vmq-admin metrics show [--with-descriptions]")
     reg.register(["plugin", "show"], _plugin_show, "vmq-admin plugin show")
@@ -240,9 +243,11 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
     reg.register(["breaker", "show"], _breaker_show,
                  "vmq-admin breaker show")
     reg.register(["breaker", "trip"], _breaker_trip,
-                 "vmq-admin breaker trip [mountpoint=]")
+                 "vmq-admin breaker trip [mountpoint=] "
+                 "[path=match|retained]")
     reg.register(["breaker", "reset"], _breaker_reset,
-                 "vmq-admin breaker reset [mountpoint=]")
+                 "vmq-admin breaker reset [mountpoint=] "
+                 "[path=match|retained]")
     reg.register(["api-key", "add"], _api_key_add,
                  "vmq-admin api-key add key=KEY")
     return reg
@@ -513,6 +518,20 @@ def _retain_show(broker, flags):
         rows.append(row)
         if len(rows) >= limit:
             break
+    return {"table": rows}
+
+
+def _retain_index_show(broker, flags):
+    """Device retained-index status per mountpoint (rows, dispatches,
+    host fallbacks, breaker) — the operator's device-vs-host-store view;
+    row-level diffing lives in the ``retained_index`` QL table."""
+    eng = getattr(broker, "_retained_engine", None)
+    if eng is None or not eng._indexes:
+        return ("retained device index not active (needs "
+                "default_reg_view=tpu, tpu_retained_enabled, and at "
+                "least one replayed subscribe)")
+    rows = [{"mountpoint": mp or "(default)", **idx.status()}
+            for mp, idx in eng._indexes.items()]
     return {"table": rows}
 
 
@@ -964,24 +983,52 @@ def _tpu_view(broker):
 
 
 def _breaker_show(broker, flags):
+    """Both device paths' breakers: the publish matcher ("match") and
+    the retained reverse-match index ("retained")."""
     rows = []
-    for mp, st in _tpu_view(broker).breaker_status().items():
-        if st is None:
-            rows.append({"mountpoint": mp, "state": "disabled"})
-        else:
-            rows.append({"mountpoint": mp, **st})
-    return {"table": rows or [{"mountpoint": "(none)",
+    try:
+        for mp, st in _tpu_view(broker).breaker_status().items():
+            if st is None:
+                rows.append({"path": "match", "mountpoint": mp,
+                             "state": "disabled"})
+            else:
+                rows.append({"path": "match", "mountpoint": mp, **st})
+    except CommandError:
+        pass  # tpu view not active; retained may still be
+    eng = getattr(broker, "_retained_engine", None)
+    if eng is not None:
+        for mp, st in eng.breaker_status().items():
+            if st is None:
+                rows.append({"path": "retained", "mountpoint": mp,
+                             "state": "disabled"})
+            else:
+                rows.append({"path": "retained", "mountpoint": mp, **st})
+    return {"table": rows or [{"path": "-", "mountpoint": "(none)",
                                "state": "no matchers yet"}]}
 
 
 def _each_breaker(broker, flags):
-    view = _tpu_view(broker)
+    """Breakers selected by the optional mountpoint=/path= flags — both
+    the publish matchers' and the retained indexes' breakers, so
+    trip/reset drills cover every device path."""
     want = flags.get("mountpoint")
-    for mp, m in view._matchers.items():
-        if want is not None and mp != want:
-            continue
-        if m.breaker is not None:
-            yield mp, m.breaker
+    path = flags.get("path")
+    if path not in (None, "match", "retained"):
+        raise CommandError("path must be match or retained")
+    if path in (None, "match"):
+        view = broker.registry.reg_views.get("tpu")
+        for mp, m in getattr(view, "_matchers", {}).items():
+            if want is not None and mp != want:
+                continue
+            if m.breaker is not None:
+                yield mp, m.breaker
+    if path in (None, "retained"):
+        eng = getattr(broker, "_retained_engine", None)
+        for mp, idx in getattr(eng, "_indexes", {}).items():
+            if want is not None and mp != want:
+                continue
+            if idx.breaker is not None:
+                yield mp, idx.breaker
 
 
 def _breaker_trip(broker, flags):

@@ -56,9 +56,39 @@ def subscription_rows(broker) -> Iterator[Dict[str, Any]]:
 
 
 def retain_rows(broker) -> Iterator[Dict[str, Any]]:
-    for words, rm in broker.retain.items():
-        yield {"topic": "/".join(words), "payload": rm.payload.decode("latin1"),
-               "payload_size": len(rm.payload), "qos": rm.qos}
+    for mp, words, rm in broker.retain.items(None):  # every mountpoint
+        payload = getattr(rm, "payload", b"")
+        yield {"mountpoint": mp, "topic": "/".join(words),
+               "payload": payload.decode("latin1"),
+               "payload_size": len(payload),
+               "qos": getattr(rm, "qos", 0)}
+
+
+def retained_index_rows(broker) -> Iterator[Dict[str, Any]]:
+    """Device retained-index rows (vernemq_tpu/retained/): one row per
+    mirrored retained topic, with its device slot and sync state —
+    operators diff this against the ``retain`` table (the host store) to
+    inspect device-vs-host convergence. Overflow (> L level) topics show
+    slot -1: they are host-matched by design."""
+    eng = getattr(broker, "_retained_engine", None)
+    if eng is None:
+        return
+    for mp, idx in list(eng._indexes.items()):
+        with idx.lock:
+            entries = list(idx.table.entries)
+            dirty = set(idx.table.dirty)
+            overflow = list(idx.table.overflow)
+            resized = idx.table.resized  # same snapshot as the rows
+        for slot, e in enumerate(entries):
+            if e is None:
+                continue
+            topic, _value = e
+            yield {"mountpoint": mp, "slot": slot,
+                   "topic": "/".join(topic),
+                   "synced": slot not in dirty and not resized}
+        for topic in overflow:
+            yield {"mountpoint": mp, "slot": -1, "topic": "/".join(topic),
+                   "synced": False}
 
 
 def queue_rows(broker) -> Iterator[Dict[str, Any]]:
@@ -107,6 +137,7 @@ TABLES: Dict[str, Callable[[Any], Iterator[Dict[str, Any]]]] = {
     "sessions": session_rows,
     "subscriptions": subscription_rows,
     "retain": retain_rows,
+    "retained_index": retained_index_rows,
     "queues": queue_rows,
     "messages": message_rows,
 }

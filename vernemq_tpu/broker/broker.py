@@ -67,6 +67,11 @@ class Broker:
             self.metadata = MetadataStore(node_name, persist_dir=persist_dir)
         self.cluster: Optional[Any] = None  # set by cluster.Cluster
         self.retain = RetainStore(on_dirty=self._retain_dirty)
+        # device-resident retained index (vernemq_tpu/retained/): created
+        # lazily on the first replay once the tpu reg view is live; the
+        # retain dirty hook write-throughs deltas into it
+        self._retained_engine: Optional[Any] = None
+        self._retained_collector: Optional[Any] = None
         self.metadata.subscribe("retain", self._on_retain_event)
         self.registry = Registry(self)
         fsync = bool(self.config.get("msg_store_fsync", False))
@@ -179,6 +184,42 @@ class Broker:
             "cluster_spool_peers_blocked": "Peers whose spooled stream "
                                            "is paused pending replay "
                                            "resync.",
+            # device retained index (vernemq_tpu/retained/): monotonic
+            # counts exposed like the tpu_breaker_* family
+            "retained_index_rows": "Retained messages mirrored in the "
+                                   "device reverse-match index.",
+            "retained_index_rebuilds": "Full device retained-table "
+                                       "(re)builds.",
+            "retained_match_dispatches": "Batched retained reverse-match "
+                                         "device dispatches.",
+            "retained_match_queries": "Subscription filters served by "
+                                      "the retained device path.",
+            "retained_host_fallback_queries": "Filters the device could "
+                                              "not serve exactly "
+                                              "(host-resolved).",
+            "retained_device_failures": "Retained dispatch/upload "
+                                        "failures fed to the breaker.",
+            "retained_degraded_sheds": "Retained match calls refused "
+                                       "while the breaker was open.",
+            "retained_breaker_state": "Retained device breaker state "
+                                      "(0 closed, 1 half-open, 2 open; "
+                                      "worst across mountpoints).",
+            "retained_replay_device_batches": "Replay flushes served by "
+                                              "the device path.",
+            "retained_replay_device_filters": "Replay filters that rode "
+                                              "a device dispatch.",
+            "retained_replay_host_filters": "Small replay flushes served "
+                                            "by the host walk (hybrid "
+                                            "dispatch).",
+            "retained_replay_degraded_filters": "Replay filters the host "
+                                                "walk served while the "
+                                                "breaker was open.",
+            "retained_replay_rebuild_filters": "Replay filters the host "
+                                               "walk served during a "
+                                               "table rebuild.",
+            "retained_replay_fallback_filters": "Per-filter device "
+                                                "escapes resolved "
+                                                "against the host store.",
         })
 
     # ------------------------------------------------------------ plumbing
@@ -192,6 +233,10 @@ class Broker:
         spool = getattr(self.cluster, "spool", None)
         if spool is not None:
             out.update(spool.stats())
+        if self._retained_engine is not None:
+            out.update(self._retained_engine.stats())
+        if self._retained_collector is not None:
+            out.update(self._retained_collector.stats())
         return out
 
     def cluster_ready(self) -> bool:
@@ -210,6 +255,9 @@ class Broker:
             term = {"payload": value.payload, "props": value.properties,
                     "qos": value.qos, "exp": value.expiry_ts}
         self.metadata.put("retain", (mountpoint,) + tuple(topic), term)
+        if self._retained_engine is not None:
+            # delta-scatter write-through into the device retained index
+            self._retained_engine.on_retain(mountpoint, tuple(topic), value)
 
     @staticmethod
     def _retain_term(value):
@@ -225,7 +273,12 @@ class Broker:
         if origin == self.node_name:
             return  # local writes already applied write-through
         mountpoint, topic = key[0], tuple(key[1:])
-        self.retain.apply_remote(mountpoint, topic, self._retain_term(new))
+        value = self._retain_term(new)
+        self.retain.apply_remote(mountpoint, topic, value)
+        if self._retained_engine is not None:
+            # replicated retain changes bypass the dirty hook; the
+            # device index must still see them
+            self._retained_engine.on_retain(mountpoint, topic, value)
 
     # -------------------------------------------------- queue migration
 
@@ -490,6 +543,50 @@ class Broker:
             )
         return self._collector
 
+    def retained_engine(self):
+        """Lazy per-mountpoint device retained index (the reverse-match
+        engine, vernemq_tpu/retained/). Shares the tpu_breaker_* knob
+        family with the publish matcher's breaker."""
+        if self._retained_engine is None:
+            from ..retained.index import RetainedEngine
+
+            cfg = self.config
+            self._retained_engine = RetainedEngine(
+                self.retain,
+                initial_capacity=cfg.get("tpu_retained_initial_capacity",
+                                         2048),
+                max_fanout=cfg.get("tpu_retained_max_fanout", 256),
+                breaker_enabled=cfg.get("tpu_breaker_enabled", True),
+                breaker_failure_threshold=cfg.get(
+                    "tpu_breaker_failure_threshold", 3),
+                breaker_backoff_initial=cfg.get(
+                    "tpu_breaker_backoff_initial_ms", 200) / 1e3,
+                breaker_backoff_max=cfg.get(
+                    "tpu_breaker_backoff_max_ms", 10_000) / 1e3,
+            )
+        return self._retained_engine
+
+    def retained_collector(self):
+        """Retained-replay batch collector, or None when the device
+        retained path is off (config) or the accelerator never came up —
+        the subscribe path then serves the exact host walk directly."""
+        cfg = self.config
+        if (cfg.default_reg_view != "tpu"
+                or not cfg.get("tpu_retained_enabled", True)):
+            return None
+        if not self.registry.batched_view_active():
+            return None  # accelerator down/cold: host walk serves replays
+        if self._retained_collector is None:
+            from ..retained.collector import RetainedBatchCollector
+
+            self._retained_collector = RetainedBatchCollector(
+                self.retained_engine(), self.retain,
+                window_us=cfg.get("tpu_retained_window_us", 500),
+                max_batch=cfg.get("tpu_retained_max_batch", 1024),
+                host_threshold=cfg.get("tpu_retained_host_threshold", 4),
+            )
+        return self._retained_collector
+
     def _resolve_base_dirs(self) -> None:
         """Honor the setup.data_dir / setup.log_dir release knobs
         (vmq_server.schema setup.* tree): relative storage paths resolve
@@ -718,6 +815,12 @@ class Broker:
         tpu_view = self.registry.reg_views.get("tpu")
         if tpu_view is not None and hasattr(tpu_view, "close"):
             tpu_view.close()
+        if self._retained_collector is not None:
+            # settle pending replay futures (host walk) and disarm the
+            # flush timer BEFORE closing the engine it dispatches into
+            self._retained_collector.close()
+        if self._retained_engine is not None:
+            self._retained_engine.close()
         # the fault registry is process-global: a plan THIS broker
         # installed at boot must not keep injecting into other broker
         # instances in the process (multi-node tests, embedding) — but

@@ -133,6 +133,24 @@ DEFAULTS: Dict[str, Any] = {
     # matcher startup so the first post-subscribe flush pays a scatter,
     # not a compile (the sub_to_matchable_ms_max tail); 0 disables
     "tpu_delta_warm_max": 128,
+    # device-resident retained-message index (vernemq_tpu/retained/):
+    # SUBSCRIBE retained replay reverse-matches filter batches against
+    # the retained-topic table on the device instead of the serial host
+    # walk. Active only when default_reg_view=tpu AND the accelerator
+    # actually came up; any degraded signal (breaker open, rebuild,
+    # per-filter escape) serves the exact host walk.
+    "tpu_retained_enabled": True,
+    # replay coalescing window (µs) and max filters per dispatch
+    "tpu_retained_window_us": 500,
+    "tpu_retained_max_batch": 1024,
+    # flushes this small are served by the host walk on the event loop
+    # (a lone subscribe must not pay a device round trip); 0 disables
+    "tpu_retained_host_threshold": 4,
+    # per-filter device match cap: a filter matching more retained
+    # topics than this resolves against the host store instead
+    "tpu_retained_max_fanout": 256,
+    # pre-size the retained device table (growth rebuilds at doublings)
+    "tpu_retained_initial_capacity": 2048,
     # deterministic fault injection (robustness/faults.py): a list of
     # rule dicts ({point, kind, probability, after, count, latency_ms})
     # installed at boot; also live-toggleable via `vmq-admin fault ...`.

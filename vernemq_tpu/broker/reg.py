@@ -609,11 +609,44 @@ class Registry:
         return results
 
     def _deliver_retained(self, sid: SubscriberId, filter_words: List[str], opts: SubOpts) -> None:
+        """Retained replay for one new subscription (vmq_reg.erl:380-418).
+        With the device retained index active the filter rides the
+        replay batch collector (concurrent SUBSCRIBEs coalesce into one
+        reverse-match dispatch) and enqueues when the batch resolves;
+        otherwise — collector off, accelerator down, or the device path
+        degraded — the exact host walk serves synchronously."""
+        if self.queues.get(sid) is None:
+            return
+        col = self.broker.retained_collector()
+        if col is not None:
+            fut = col.submit(sid[0], tuple(filter_words))
+
+            def _done(f: "asyncio.Future") -> None:
+                exc = f.exception()
+                if exc is not None:
+                    # unexpected collector error: the replay must still
+                    # happen — exact host walk, loudly
+                    log.exception("retained replay batch failed; serving "
+                                  "the host walk", exc_info=exc)
+                    matches = self.broker.retain.match_filter(
+                        sid[0], list(filter_words))
+                else:
+                    matches = f.result()
+                self._enqueue_retained(sid, opts, matches)
+
+            fut.add_done_callback(_done)
+            return
+        self._enqueue_retained(
+            sid, opts,
+            self.broker.retain.match_filter(sid[0], list(filter_words)))
+
+    def _enqueue_retained(self, sid: SubscriberId, opts: SubOpts,
+                          matches) -> None:
         queue = self.queues.get(sid)
         if queue is None:
-            return
+            return  # session ended between subscribe and batch resolve
         now = time.time()
-        for topic, rmsg in self.broker.retain.match_filter(sid[0], filter_words):
+        for topic, rmsg in matches:
             if rmsg.expiry_ts is not None and rmsg.expiry_ts < now:
                 continue
             props = dict(rmsg.properties)

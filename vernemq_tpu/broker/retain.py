@@ -173,7 +173,19 @@ class RetainStore:
             if child is not None:
                 self._walk(child, fw, i + 1, path + (w,), out)
 
-    def items(self, mountpoint: str = "") -> Iterator[Tuple[Tuple[str, ...], Any]]:
+    def items(self, mountpoint: Optional[str] = "") -> Iterator:
+        """Iterate retained rows. With a named ``mountpoint`` (default
+        ``""``) yields ``(topic, value)`` pairs, back-compat. With
+        ``mountpoint=None`` iterates EVERY mountpoint, yielding
+        ``(mountpoint, topic, value)`` triples — the all-mountpoints
+        walk the admin/QL surface and the device-index warm load need."""
+        if mountpoint is None:
+            out_all: List[Tuple[str, Tuple[str, ...], Any]] = []
+            for mp, root in self._roots.items():
+                rows: List[Tuple[Tuple[str, ...], Any]] = []
+                self._collect_subtree(root, (), rows)
+                out_all.extend((mp, t, v) for t, v in rows)
+            return iter(out_all)
         root = self._roots.get(mountpoint)
         if root is None:
             return iter(())

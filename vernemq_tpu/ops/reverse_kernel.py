@@ -1,0 +1,312 @@
+"""Batched REVERSE wildcard matching: subscription filters vs a table of
+retained topics — the dual of the publish-fanout kernel.
+
+Forward (``match_kernel``): one literal publish topic against many wildcard
+filters. Retained replay on SUBSCRIBE inverts the roles (the MQTT dual,
+``vmq_retain_srv:match_fold`` / ``vmq_reg.erl:380-418``): the *query*
+carries the wildcards and the table rows are literal topics. Semantics per
+query filter vs topic row:
+
+- exact descent on concrete words (``+`` is a per-level don't-care);
+- length: ``row_len == eff_len`` without a trailing ``#``,
+  ``row_len >= eff_len`` with (``#`` also accepts its parent level);
+- MQTT-4.7.2-1: a filter whose level-0 word is a wildcard never matches a
+  ``$``-topic (deeper ``$`` words are ordinary words, matching
+  ``RetainStore._walk``).
+
+Two device phases, matching the forward engine's posture:
+
+1. **Tiled probe** (concrete-level-0 filters): queries are sorted by their
+   level-0 word's bucket region (the retained table is bucket-partitioned,
+   ``retained/table.py``) and packed into ``[T, TP]`` tiles, each matched
+   against one contiguous ``seg``-row window — a query touches ~its bucket
+   instead of the whole table. The mask is a fused per-level integer
+   compare (VPU-shaped: at window widths of 512-4096 rows the gathers are
+   tiny and the compare beats streaming coded operands through the MXU);
+   per-query rows are gathered out of the tile mask BEFORE extraction so
+   the sort-free compaction runs over the real batch, not T×TP pad slots.
+2. **Dense coded phase** (wildcard-level-0 filters — ``#``, ``+/...`` —
+   which may match any row): the full-table scan as ONE coded matmul,
+   reusing :func:`match_kernel.build_operands` with the roles swapped —
+   ``build_operands`` encodes the WILDCARD side (here: the query block)
+   and the precomputed row operand ``G_t`` (``build_row_operands``, the
+   forward ``build_pub_operand`` transposed) streams from HBM. Exactness
+   is the forward proof verbatim: every bf16 operand is exact and every
+   product < 2^17, so ``mismatch == 0`` iff all concrete levels match.
+
+Extraction reuses the forward path's packed-mask machinery
+(:func:`match_kernel._pack_mask` + :func:`extract_indices_packed`)
+unchanged. Table capacity is kept ``% 2048 == 0`` and probe windows
+``% 512 == 0`` by the allocator so the packed blocks always divide.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import match_kernel as MK
+
+PAD_ID = MK.PAD_ID
+PLUS_ID = MK.PLUS_ID
+HASH_ID = MK.HASH_ID
+
+#: tile geometry: queries per probe tile (kept small — retained tiles are
+#: narrow windows, and slot padding is the dominant waste at storm batch
+#: sizes; the forward kernel's 256 assumes MXU row tiles it doesn't use)
+TILE_QUERIES = 32
+
+#: packed-extraction block for probe windows (windows are pow2 >= 512)
+PROBE_BLOCK = 512
+#: packed-extraction block for the dense full-table phase (capacity is
+#: kept % 2048 by the allocator, same constant as the forward kernel)
+DENSE_BLOCK = 2048
+#: dense-phase chunk over the row axis: bounds the [BW, nc] f32 mismatch
+#: intermediate (~128MB at BW=256)
+DENSE_CHUNK = 1 << 17
+
+
+def pack_row_meta(row_len, row_dollar, row_active):
+    """Fuse the three per-row metadata arrays into ONE int32 word
+    (len in bits 0-15, dollar/active at bits 16-17) — the retained-row
+    sibling of :func:`match_kernel.pack_meta`. Host-side (numpy): the
+    index packs at build/delta time; :func:`_unpack_row_meta` is the
+    kernel-side inverse — THE one layout, do not re-derive it."""
+    import numpy as np
+
+    return (np.asarray(row_len, dtype=np.int32)
+            | (np.asarray(row_dollar, dtype=np.int32) << 16)
+            | (np.asarray(row_active, dtype=np.int32) << 17))
+
+
+def _unpack_row_meta(meta: jax.Array):
+    return (meta & 0xFFFF, ((meta >> 16) & 1).astype(bool),
+            ((meta >> 17) & 1).astype(bool))
+
+
+@functools.partial(jax.jit, static_argnames=("id_bits",))
+def build_row_operands(row_words: jax.Array, id_bits: int = 16) -> jax.Array:
+    """Coded operand of the retained-topic table for the dense phase:
+    the forward :func:`match_kernel.build_pub_operand` (rows are the
+    concrete side here) transposed to ``[K, N]`` bf16 — minor dim long,
+    same lane-padding argument as ``build_operands``'s F_t."""
+    return MK.build_pub_operand(row_words, id_bits).T
+
+
+def reverse_mask_unrolled(
+    q_words: jax.Array,   # int32 [B, L] PLUS_ID on '+', PAD beyond eff
+    q_eff: jax.Array,     # int32 [B] concrete levels (trailing '#' excluded)
+    q_hh: jax.Array,      # bool [B] filter ends in '#'
+    q_fw: jax.Array,      # bool [B] level-0 word is a wildcard
+    row_words: jax.Array,  # int32 [N, L]
+    row_len: jax.Array,    # int32 [N]
+    row_dollar: jax.Array,  # bool [N]
+    row_active: jax.Array,  # bool [N]
+) -> jax.Array:
+    """Reference reverse-match mask [B, N] (fused per-level compare) —
+    the oracle-shaped kernel the probe tiles inline; also the whole
+    device path for tiny tables in tests."""
+    L = q_words.shape[1]
+    len_ok = jnp.where(
+        q_hh[:, None],
+        row_len[None, :] >= q_eff[:, None],
+        row_len[None, :] == q_eff[:, None],
+    )
+    acc = len_ok & ~(row_dollar[None, :] & q_fw[:, None]) & row_active[None, :]
+    for l in range(L):
+        ok_l = (
+            (q_words[:, l][:, None] == row_words[:, l][None, :])
+            | (q_words[:, l] == PLUS_ID)[:, None]
+            | (l >= q_eff)[:, None]
+        )
+        acc = acc & ok_l
+    return acc
+
+
+def _tile_masks(row_words, row_len, row_dollar, row_active,
+                q_words, q_eff, q_hh, q_fw, t_sel, t_start, *, seg, lc):
+    """Probe-phase mask over all tiles: gather each tile's query block
+    and its ``seg``-row window, compare levelwise. Returns the flat
+    ``[T*TP, seg]`` mask (pad slots compute garbage rows that are never
+    gathered back — same contract as the forward window tiles).
+
+    Only ``lc`` levels are compared (the deepest stored topic): a filter
+    with more concrete levels than any row dies on the length rule, so
+    truncating the level loop is exact and cuts the compare volume by
+    ``L/lc`` on shallow topic populations."""
+    T, TP = t_sel.shape
+    qw = jnp.take(q_words, t_sel, axis=0)          # [T, TP, L]
+    qe = jnp.take(q_eff, t_sel)                    # [T, TP]
+    qh = jnp.take(q_hh, t_sel)
+    qf = jnp.take(q_fw, t_sel)
+    ridx = t_start[:, None] + jnp.arange(seg, dtype=jnp.int32)[None, :]
+    rw = jnp.take(row_words, ridx, axis=0)         # [T, seg, L]
+    rl = jnp.take(row_len, ridx)                   # [T, seg]
+    rd = jnp.take(row_dollar, ridx)
+    ra = jnp.take(row_active, ridx)
+    len_ok = jnp.where(
+        qh[:, :, None],
+        rl[:, None, :] >= qe[:, :, None],
+        rl[:, None, :] == qe[:, :, None],
+    )
+    acc = len_ok & ~(rd[:, None, :] & qf[:, :, None]) & ra[:, None, :]
+    for l in range(lc):
+        ok_l = (
+            (qw[:, :, l][:, :, None] == rw[:, :, l][:, None, :])
+            | (qw[:, :, l] == PLUS_ID)[:, :, None]
+            | (l >= qe)[:, :, None]
+        )
+        acc = acc & ok_l
+    return acc.reshape(T * TP, seg)
+
+
+def _dense_coded(G_t, row_len, row_dollar, row_active,
+                 dq_words, dq_eff, dq_hh, dq_fw, dq_valid, *,
+                 id_bits, k, nc):
+    """Dense phase: the padded wildcard-first query block vs EVERY row,
+    as chunked coded matmuls (build_operands on the query side — the
+    wildcard side, exactly the forward role — against the precomputed
+    row operand). Chunk masks pack as they are produced so the [BW, N]
+    bool matrix never materialises; one packed extraction at the end."""
+    F_t, t1 = MK.build_operands(dq_words, dq_eff, id_bits)  # [K, BW], [BW]
+    N = G_t.shape[1]
+    packs = []
+    for c in range(0, N, nc):
+        sl = slice(c, min(c + nc, N))
+        mm = lax.dot_general(
+            F_t, G_t[:, sl], (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) + t1[:, None]                                     # [BW, nc]
+        len_ok = jnp.where(
+            dq_hh[:, None],
+            row_len[None, sl] >= dq_eff[:, None],
+            row_len[None, sl] == dq_eff[:, None],
+        )
+        m = ((mm == 0.0) & len_ok
+             & ~(row_dollar[None, sl] & dq_fw[:, None])
+             & row_active[None, sl] & dq_valid[:, None])
+        packs.append(MK._pack_mask(m))
+    packed = packs[0] if len(packs) == 1 else jnp.concatenate(packs, axis=1)
+    return MK.extract_indices_packed(packed, k, DENSE_BLOCK)
+
+
+def _dense_compare(row_words, row_len, row_dollar, row_active,
+                   dq_words, dq_eff, dq_hh, dq_fw, dq_valid, *,
+                   k, nc, lc):
+    """Dense phase as a chunked levelwise compare — the VPU sibling of
+    :func:`_dense_coded` (bit-identical results). On hosts without a
+    matmul engine the integer compare beats streaming coded operands;
+    on the MXU the coded matmul wins — the index picks per backend,
+    like the forward kernel's match_extract vs match_extract_mxu."""
+    N = row_words.shape[0]
+    packs = []
+    for c in range(0, N, nc):
+        sl = slice(c, min(c + nc, N))
+        len_ok = jnp.where(
+            dq_hh[:, None],
+            row_len[None, sl] >= dq_eff[:, None],
+            row_len[None, sl] == dq_eff[:, None],
+        )
+        m = (len_ok & ~(row_dollar[None, sl] & dq_fw[:, None])
+             & row_active[None, sl] & dq_valid[:, None])
+        for l in range(lc):
+            m = m & (
+                (dq_words[:, l][:, None] == row_words[sl, l][None, :])
+                | (dq_words[:, l] == PLUS_ID)[:, None]
+                | (l >= dq_eff)[:, None]
+            )
+        packs.append(MK._pack_mask(m))
+    packed = packs[0] if len(packs) == 1 else jnp.concatenate(packs, axis=1)
+    return MK.extract_indices_packed(packed, k, DENSE_BLOCK)
+
+
+@functools.partial(jax.jit, static_argnames=("id_bits", "k", "seg", "nc",
+                                              "lc", "dense_mode"))
+def reverse_match(
+    row_words: jax.Array,  # int32 [N, L] retained-topic rows
+    meta: jax.Array,       # int32 [N] pack_row_meta word
+    G_t: jax.Array,        # bf16 [K, N] coded row operand (dense phase)
+    q_words: jax.Array,    # int32 [B, L] query filters (wildcard side)
+    q_eff: jax.Array,      # int32 [B]
+    q_hh: jax.Array,       # bool [B]
+    q_fw: jax.Array,       # bool [B]
+    t_sel: jax.Array,      # int32 [T, TP] probe-tile query selectors
+    t_start: jax.Array,    # int32 [T] window start row per tile
+    q_tile: jax.Array,     # int32 [B] probe tile per query (-1 = none)
+    q_pos: jax.Array,      # int32 [B] slot within that tile
+    d_sel: jax.Array,      # int32 [BW] dense-phase query selector
+    d_valid: jax.Array,    # bool [BW] dense slot liveness
+    *,
+    id_bits: int,
+    k: int,
+    seg: int,
+    nc: int = DENSE_CHUNK,
+    lc: int = 0,
+    dense_mode: str = "coded",
+) -> Tuple[jax.Array, ...]:
+    """ONE fused reverse-match dispatch: probe tiles + dense coded phase.
+
+    Returns ``(idx [B,k], valid [B,k], cnt [B], didx [BW,k],
+    dvalid [BW,k], dcnt [BW])`` — window-probe results in query order
+    (zeroed where ``q_tile < 0``) and dense results in ``d_sel`` slot
+    order. ``cnt``/``dcnt`` may exceed ``k`` (host-fallback contract,
+    same as the forward extraction). Probe idx are absolute row ids
+    (window starts added on device).
+    """
+    lc = lc or row_words.shape[1]
+    row_len, row_dollar, row_active = _unpack_row_meta(meta)
+    flat = _tile_masks(row_words, row_len, row_dollar, row_active,
+                       q_words, q_eff, q_hh, q_fw, t_sel, t_start,
+                       seg=seg, lc=lc)
+    TP = t_sel.shape[1]
+    tiled = q_tile >= 0
+    rowsel = jnp.maximum(q_tile, 0) * TP + q_pos          # [B]
+    mq = jnp.take(flat, rowsel, axis=0) & tiled[:, None]  # [B, seg]
+    idx, valid, cnt = MK.extract_indices_packed(
+        MK._pack_mask(mq), k, PROBE_BLOCK)
+    starts = jnp.where(tiled, t_start[jnp.maximum(q_tile, 0)], 0)
+    idx = idx + starts[:, None]
+    valid = valid & tiled[:, None]
+    cnt = jnp.where(tiled, cnt, 0)
+
+    dq = lambda a: jnp.take(a, d_sel, axis=0)
+    if dense_mode == "compare":
+        didx, dvalid, dcnt = _dense_compare(
+            row_words, row_len, row_dollar, row_active,
+            dq(q_words), dq(q_eff), dq(q_hh), dq(q_fw), d_valid,
+            k=k, nc=nc, lc=lc)
+    else:
+        didx, dvalid, dcnt = _dense_coded(
+            G_t, row_len, row_dollar, row_active,
+            dq(q_words), dq(q_eff), dq(q_hh), dq(q_fw), d_valid,
+            id_bits=id_bits, k=k, nc=nc)
+    dcnt = jnp.where(d_valid, dcnt, 0)
+    return idx, valid, cnt, didx, dvalid & d_valid[:, None], dcnt
+
+
+def _apply_delta_body(row_words, meta, G_t, slots, d_words, d_meta, *,
+                      id_bits):
+    row_words = row_words.at[slots].set(d_words)
+    meta = meta.at[slots].set(d_meta)
+    G = MK.build_pub_operand(d_words, id_bits)             # [D, K] bf16
+    G_t = G_t.at[:, slots].set(G.T)
+    return row_words, meta, G_t
+
+
+#: O(dirty) scatter of retain set/delete deltas into all three device
+#: arrays in ONE call (words + packed meta + the coded dense operand) —
+#: donated so steady-state churn updates in place, mirroring the forward
+#: table's fused delta discipline.
+retained_apply_delta = functools.partial(
+    jax.jit, donate_argnums=(0, 1, 2), static_argnames=("id_bits",),
+)(_apply_delta_body)
+
+#: non-donating variant for when an in-flight reverse match still holds
+#: references to the device arrays.
+retained_apply_delta_copy = functools.partial(
+    jax.jit, static_argnames=("id_bits",),
+)(_apply_delta_body)

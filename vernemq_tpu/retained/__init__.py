@@ -1,0 +1,18 @@
+"""TPU-resident retained-message index: device retained-topic table +
+batched reverse matching (the subscribe-storm replay engine).
+
+Pieces:
+- :mod:`.table` — host-side bucketed retained-topic table (numpy mirrors,
+  dirty-slot delta tracking, interned word ids);
+- :mod:`.index` — :class:`RetainedIndex` (device mirror + batched
+  reverse-match serving behind a circuit breaker) and
+  :class:`RetainedEngine` (one index per mountpoint);
+- :mod:`.collector` — :class:`RetainedBatchCollector`, coalescing
+  concurrent SUBSCRIBE replays into super-batched dispatches.
+
+The kernels live in :mod:`vernemq_tpu.ops.reverse_kernel`.
+"""
+
+from .collector import RetainedBatchCollector  # noqa: F401
+from .index import RetainedEngine, RetainedIndex  # noqa: F401
+from .table import RetainedTopicTable  # noqa: F401

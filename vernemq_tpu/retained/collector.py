@@ -1,0 +1,176 @@
+"""Retained-replay batch collector: coalesce concurrent SUBSCRIBE replays
+into super-batched reverse-match dispatches.
+
+The retained sibling of ``models/tpu_matcher.BatchCollector``: subscribe
+storms submit one ``(mountpoint, filter)`` per subscription, replays
+arriving within ``window_us`` (or until ``max_batch``) ride ONE device
+dispatch, and each caller's future resolves to its own
+``[(topic, value), ...]`` match list. Flushes at or below
+``host_threshold`` are served by the exact host walk on the event loop
+(hybrid dispatch — a lone subscribe must not pay a device round trip),
+and every degraded signal (`RebuildInProgress`, `DeviceDegraded`, a
+breaker-open retained path) falls back to ``RetainStore.match_filter`` —
+the correctness oracle — so an outage costs latency, never wrong or
+missing replays. Per-filter ``None`` escapes from the index (fanout > k,
+untiled leftovers) resolve against the store on the loop thread, where
+store access is race-free.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..models.tpu_matcher import DeviceDegraded, MatcherBusy, \
+    RebuildInProgress
+
+log = logging.getLogger("vernemq_tpu.retained")
+
+
+class RetainedBatchCollector:
+    #: dispatches in flight at once: two slots double-buffer (batch N+1's
+    #: encode/prep overlaps batch N's device time, like the publish path)
+    MAX_INFLIGHT = 2
+
+    def __init__(self, engine, store, window_us: int = 500,
+                 max_batch: int = 1024, host_threshold: int = 4):
+        self.engine = engine
+        self.store = store
+        self.window = window_us / 1e6
+        self.max_batch = max_batch
+        self.host_threshold = host_threshold
+        self._pending: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
+        self._flush_handle: Optional[asyncio.TimerHandle] = None
+        self._inflight = 0
+        self._closed = False
+        # observability (exposed as broker gauges)
+        self.device_batches = 0       # flushes served by the device path
+        self.device_filters = 0
+        self.host_hybrid_filters = 0  # small flushes host-served
+        self.degraded_filters = 0     # host-served while the breaker is open
+        self.rebuild_filters = 0      # host-served during a table rebuild
+        self.fallback_filters = 0     # per-filter None escapes host-resolved
+
+    def close(self) -> None:
+        """Quiesce at broker stop: disarm the flush timer and settle
+        every pending replay from the host walk (the store outlives the
+        collector in the stop order) so no future leaks unresolved and
+        no device work dispatches after teardown."""
+        self._closed = True
+        if self._flush_handle is not None:
+            self._flush_handle.cancel()
+            self._flush_handle = None
+        pending, self._pending = self._pending, []
+        for mp, fw, fut in pending:
+            self._host_match(mp, fw, fut)
+
+    def submit(self, mountpoint: str,
+               filter_words: Sequence[str]) -> asyncio.Future:
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        if self._closed:
+            self._host_match(mountpoint, tuple(filter_words), fut)
+            return fut
+        self._pending.append((mountpoint, tuple(filter_words), fut))
+        if len(self._pending) >= self.max_batch:
+            if self._flush_handle is not None:
+                self._flush_handle.cancel()
+                self._flush_handle = None
+            self._flush()
+        elif self._flush_handle is None:
+            self._flush_handle = loop.call_later(self.window, self._flush)
+        return fut
+
+    def _host_match(self, mp: str, fw: Tuple[str, ...], fut) -> None:
+        if fut.done():
+            return  # caller cancelled
+        try:
+            fut.set_result(self.store.match_filter(mp, list(fw)))
+        except Exception as e:
+            fut.set_exception(e)
+
+    def _flush(self) -> None:
+        self._flush_handle = None
+        if not self._pending:
+            return
+        if len(self._pending) <= self.host_threshold:
+            pending, self._pending = self._pending, []
+            self.host_hybrid_filters += len(pending)
+            for mp, fw, fut in pending:
+                self._host_match(mp, fw, fut)
+            return
+        if self._inflight >= self.MAX_INFLIGHT:
+            # both slots busy: leave items pending so late arrivals
+            # coalesce into one bigger batch; _on_done flushes the moment
+            # a slot frees (bounded self-batching backpressure)
+            return
+        pending, self._pending = (self._pending[:self.max_batch],
+                                  self._pending[self.max_batch:])
+        self._inflight += 1
+        task = asyncio.get_event_loop().create_task(
+            self._flush_async(pending))
+        task.add_done_callback(self._on_done)
+
+    def _on_done(self, task) -> None:
+        self._inflight -= 1
+        if not task.cancelled() and task.exception() is not None:
+            log.warning("retained flush task failed: %s", task.exception())
+        if self._pending:
+            if self._flush_handle is not None:
+                self._flush_handle.cancel()
+                self._flush_handle = None
+            self._flush()
+
+    async def _flush_async(self, pending) -> None:
+        loop = asyncio.get_event_loop()
+        by_mp: Dict[str, List[Tuple[Tuple[str, ...], asyncio.Future]]] = {}
+        for mp, fw, fut in pending:
+            by_mp.setdefault(mp, []).append((fw, fut))
+        for mp, items in by_mp.items():
+            filters = [fw for fw, _ in items]
+            try:
+                # first use chunk-loads the retained snapshot with loop
+                # yields; a failed load serves this flush host-side
+                idx = await self.engine.index_async(mp)
+                results = await loop.run_in_executor(
+                    None, idx.match_filters, filters)
+            except (RebuildInProgress, MatcherBusy, DeviceDegraded) as rb:
+                # degraded window: the host walk serves (identical
+                # results); chunk with yields so a big storm flush can't
+                # stall every session's IO for its whole duration
+                if isinstance(rb, DeviceDegraded):
+                    self.degraded_filters += len(items)
+                else:
+                    self.rebuild_filters += len(items)
+                for i, (fw, fut) in enumerate(items):
+                    self._host_match(mp, fw, fut)
+                    if (i + 1) % 64 == 0:
+                        await asyncio.sleep(0)
+                continue
+            except Exception as e:
+                for _, fut in items:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            self.device_batches += 1
+            self.device_filters += len(items)
+            for i, ((fw, fut), rows) in enumerate(zip(items, results)):
+                if rows is None:
+                    # per-filter device escape: exact host resolution
+                    self.fallback_filters += 1
+                    self._host_match(mp, fw, fut)
+                elif not fut.done():
+                    fut.set_result(rows)
+                if (i + 1) % 256 == 0:
+                    await asyncio.sleep(0)
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "retained_replay_device_batches": self.device_batches,
+            "retained_replay_device_filters": self.device_filters,
+            "retained_replay_host_filters": self.host_hybrid_filters,
+            "retained_replay_degraded_filters": self.degraded_filters,
+            "retained_replay_rebuild_filters": self.rebuild_filters,
+            "retained_replay_fallback_filters": self.fallback_filters,
+        }

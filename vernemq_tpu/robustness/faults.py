@@ -17,6 +17,10 @@ Injection points in the tree (grep for ``faults.inject``):
                      / ``call_match_many`` and the matcher fallbacks)
 ``device.delta``     delta-scatter upload of dirty table slots
 ``device.rebuild``   full device-table (re)build, inline or background
+``device.retained``  retained reverse-match path (retained/index.py):
+                     dispatch, delta scatter and full (re)build — the
+                     whole device half of retained replay degrades to
+                     the host retain walk behind its breaker
 ``cluster.recv``     inbound cluster data-plane frames (cluster/com.py)
 ``cluster.spool``    delivery-spool journal writes (cluster/spool.py)
 ``store.write``      message-store writes (storage/msg_store.py)
