@@ -934,6 +934,227 @@ def config8_retained_storm(rng, smoke, n_retained=None, batch=None,
     }
 
 
+def config9_overload_storm(smoke):
+    """Overload storm: offered load past capacity, naive binary shedding
+    vs the adaptive governor (robustness/overload.py).
+
+    One in-process broker per mode (``overload_mode=binary`` — the old
+    posture: sysmon flag + fixed 0.1s sleep for every producer — vs
+    ``governor``). The storm combines QoS0 flood publishers offering
+    load as fast as the socket accepts (several times what the throttled
+    reader drains — the 3-5x offered-load regime) with a synchronous
+    loop chore modelling CPU saturation, so sysmon sees genuine lag in
+    both modes. A well-behaved QoS1 client publishes at a modest steady
+    rate throughout; its delivered throughput ("goodput retained" — the
+    useful work the broker completes under overload) and per-publish ack
+    p50/p99 are the headline comparison. Also reports zero-QoS>=1-loss
+    (every well-behaved publish delivered), the governor's level/shed
+    accounting, and recovery time after the storm ends (the governor
+    must return to level 0 within ~one hysteresis window; binary pays
+    the full sysmon cooldown)."""
+    import asyncio
+
+    hold_s = 1.0
+
+    async def run_mode(mode):
+        from vernemq_tpu.broker.config import Config
+        from vernemq_tpu.broker.server import start_broker
+        from vernemq_tpu.client import MQTTClient
+
+        storm_s = 2.0 if smoke else 6.0
+        n_flood = 3
+        cfg = Config(
+            systree_enabled=False, allow_anonymous=True,
+            sysmon_lag_threshold=0.01,
+            overload_mode=mode,
+            overload_hold_s=hold_s,
+            overload_tick_ms=100,
+            # wb publishes ~30/s: far under the bucket; floods far over
+            overload_l2_client_rate=100,
+            overload_l2_burst=50,
+            # floods are the 3 heaviest talkers; the wb client must
+            # never be in the shed set
+            overload_l3_disconnect_top=3)
+        broker, server = await start_broker(cfg, port=0,
+                                            node_name=f"ov-{mode}")
+        # fast lag sampling so both modes see the storm promptly
+        broker.sysmon.stop()
+        broker.sysmon.interval = 0.05
+        broker.sysmon.start()
+
+        # ~0.4ms of synchronous per-publish routing/auth work: the cost
+        # model that makes the offered load exceed capacity (6k msgs/s
+        # offered x 0.4ms = 2.4s of work per second, plus fanout). The
+        # governor's QoS0 admission shed happens BEFORE this hook — so
+        # shedding genuinely frees capacity, exactly the cliff the
+        # broker-benchmarking literature describes. Binary mode pays the
+        # hook for every flood message it reads.
+        def cost_hook(user, sid, qos, topic, payload, retain):
+            time.sleep(0.0004)
+            return "ok"
+
+        broker.hooks.register("auth_on_publish", cost_hook)
+
+        sub = MQTTClient("127.0.0.1", server.port, client_id="ov-sub")
+        await sub.connect()
+        await sub.subscribe("ovwb/#", qos=1)
+        await sub.subscribe("ovflood/#", qos=0)
+        wb = MQTTClient("127.0.0.1", server.port, client_id="ov-wb")
+        await wb.connect()
+        floods = []
+        for i in range(n_flood):
+            c = MQTTClient("127.0.0.1", server.port,
+                           client_id=f"ov-flood{i}")
+            await c.connect()
+            floods.append(c)
+
+        storm = asyncio.Event()
+        storm.set()
+        flood_sent = [0]
+
+        async def flood_loop(c, i):
+            # paced bursts (~2000 msgs/s offered per publisher — several
+            # times what the chore-saturated loop drains): the offered
+            # load is bounded so post-storm socket backlogs stay
+            # drainable, unlike an unbounded CPU-speed spin
+            n = 0
+            try:
+                while storm.is_set():
+                    for _ in range(20):
+                        await c.publish(f"ovflood/{i}/{n}", b"f" * 64,
+                                        qos=0)
+                        n += 1
+                    await asyncio.sleep(0.01)
+            except Exception:
+                pass  # L3 shed the talker: offered load stays gone
+            flood_sent[0] += n
+
+        wb_lat = []
+        wb_sent = [0]
+
+        async def wb_loop():
+            n = 0
+            while storm.is_set():
+                t0 = time.perf_counter()
+                try:
+                    await wb.publish(f"ovwb/{n}", b"w%d" % n, qos=1,
+                                     timeout=10.0)
+                except asyncio.TimeoutError:
+                    break
+                wb_lat.append(time.perf_counter() - t0)
+                n += 1
+                await asyncio.sleep(0.03)
+            wb_sent[0] = n
+
+        tasks = [asyncio.get_event_loop().create_task(t) for t in (
+            [wb_loop()]
+            + [flood_loop(c, i) for i, c in enumerate(floods)])]
+        t_storm = time.perf_counter()
+        await asyncio.sleep(storm_s)
+        storm.clear()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        storm_actual = time.perf_counter() - t_storm
+
+        # end the offered load COMPLETELY before timing recovery: the
+        # flood sockets still hold an unread backlog the throttled
+        # readers would keep draining — "load drops" means gone, not
+        # parked (the graceful step-down path covers the parked case)
+        for c in floods:
+            try:
+                await asyncio.wait_for(c.close(), 5.0)
+            except (ConnectionError, asyncio.TimeoutError):
+                pass
+        await asyncio.sleep(0.1)  # let the closed handlers unwind
+
+        # recovery: time from load stop until the shed posture clears
+        gov = broker.overload
+        t_rec = time.perf_counter()
+        while time.perf_counter() - t_rec < 15:
+            if mode == "governor":
+                if gov.level == 0:
+                    break
+            elif not broker.sysmon.overloaded:
+                break
+            await asyncio.sleep(0.05)
+        recovery_s = time.perf_counter() - t_rec
+
+        # drain deliveries (wb deliveries may trail the acks)
+        wb_got, flood_got = set(), 0
+        while True:
+            try:
+                m = await sub.recv(0.5)
+            except asyncio.TimeoutError:
+                break
+            if m is None:
+                break
+            if m.payload.startswith(b"w"):
+                wb_got.add(m.payload)
+            else:
+                flood_got += 1
+
+        metrics = broker.metrics
+        lvl = gov.status()
+        out = {
+            "storm_s": round(storm_actual, 2),
+            "wb_published": wb_sent[0],
+            "wb_delivered": len(wb_got),
+            "wb_goodput_msgs_per_s": round(
+                len(wb_got) / storm_actual, 1),
+            "wb_publish_ms_p50": _pct_ms(wb_lat, 0.50),
+            "wb_publish_ms_p99": _pct_ms(wb_lat, 0.99),
+            "flood_offered": flood_sent[0],
+            "flood_delivered": flood_got,
+            "qos1_missing": wb_sent[0] - len(wb_got),
+            "throttled": metrics.value("mqtt_publish_throttled"),
+            "recovery_s": round(recovery_s, 2),
+        }
+        if mode == "governor":
+            out.update({
+                "max_level_entered": max(
+                    (i for i in (1, 2, 3)
+                     if lvl["enters"][f"l{i}"] > 0), default=0),
+                "qos0_shed": metrics.value("overload_qos0_shed"),
+                "rate_limited": metrics.value("overload_rate_limited"),
+                "talker_disconnects": metrics.value(
+                    "overload_talker_disconnects"),
+                "connects_refused": metrics.value(
+                    "overload_connects_refused"),
+                "level_seconds": lvl["seconds"],
+                # one hold window + lag-EWMA decay, plus slack for the
+                # bench sharing its loop with the draining clients
+                "recovered_within_hold": recovery_s <= 2 * hold_s + 1.0,
+            })
+
+        await wb.disconnect()
+        await sub.disconnect()
+        await broker.stop()
+        await server.stop()
+        return out
+
+    def _pct_ms(lats, q):
+        if not lats:
+            return None
+        lats = sorted(lats)
+        return round(lats[min(len(lats) - 1, int(q * len(lats)))] * 1e3, 2)
+
+    binary = asyncio.run(run_mode("binary"))
+    governor = asyncio.run(run_mode("governor"))
+    return {
+        "binary": binary,
+        "governor": governor,
+        "governor_wins_goodput": (
+            governor["wb_goodput_msgs_per_s"]
+            > binary["wb_goodput_msgs_per_s"]),
+        "governor_wins_p99": (
+            governor["wb_publish_ms_p99"] is not None
+            and binary["wb_publish_ms_p99"] is not None
+            and governor["wb_publish_ms_p99"]
+            < binary["wb_publish_ms_p99"]),
+        "zero_qos1_loss": (governor["qos1_missing"] == 0
+                           and binary["qos1_missing"] == 0),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--subs", type=int, default=1_000_000)
@@ -952,7 +1173,7 @@ def main() -> int:
     ap.add_argument("--stack", type=int, default=8,
                     help="batches per executable for --variant "
                     "packed_stack")
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8",
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9",
                     help="which BASELINE configs to run (3 = headline; "
                     "6 = fault-storm robustness: publish p99 while the "
                     "device path is down + breaker recovery time; "
@@ -960,7 +1181,10 @@ def main() -> int:
                     "severed under QoS1 load — spool replay throughput "
                     "+ zero-loss parity; 8 = retained subscribe storm: "
                     "wildcard SUBSCRIBE bursts vs 100k-1M retained — "
-                    "device reverse-match rate vs the serial host walk)")
+                    "device reverse-match rate vs the serial host walk; "
+                    "9 = overload storm: offered load past capacity, "
+                    "binary shedding vs the adaptive governor on "
+                    "well-behaved goodput/p99 + recovery time)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
     ap.add_argument("--kernel-only", action="store_true",
@@ -1199,6 +1423,10 @@ def main() -> int:
     if "8" in want:
         guarded("8_retained_storm",
                 lambda: config8_retained_storm(rng, smoke))
+
+    if "9" in want:
+        guarded("9_overload_storm",
+                lambda: config9_overload_storm(smoke))
 
     if headline is not None:
         value = headline["matches_per_sec"]

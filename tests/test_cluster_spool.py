@@ -55,6 +55,73 @@ def test_spool_journal_ack_delete(tmp_path):
     sp.close()
 
 
+def test_spool_budgeted_replay_cursor(tmp_path):
+    """Cursor-based partial replay (the retransmit watchdog's mode): at
+    most ``budget`` frames ship per call, the per-peer cursor resumes
+    where the previous tick stopped, a completed sweep wraps back to the
+    lowest pending seq, and an ack advancing past the cursor restarts
+    the sweep at the new head — so a long storm pays linear wire cost
+    per tick instead of re-shipping the whole journal."""
+    sp = ClusterSpool(str(tmp_path / "sp"), metrics=Metrics())
+    frames = {}
+    for i in range(10):
+        seq, data = sp.journal("p", "msg", {"ref": b"r%d" % i})
+        frames[data] = seq
+
+    def seqs_of(sent):
+        assert sent[0][:3] == b"msb"
+        return [frames[d] for d in sent[1:]]
+
+    sent = []
+    assert sp.replay("p", lambda d: sent.append(d) or True, budget=4) == 4
+    assert seqs_of(sent) == [1, 2, 3, 4]
+    assert sp.state("p").cursor == 5
+    sent = []
+    assert sp.replay("p", lambda d: sent.append(d) or True, budget=4) == 4
+    assert seqs_of(sent) == [5, 6, 7, 8]
+    sent = []
+    assert sp.replay("p", lambda d: sent.append(d) or True, budget=4) == 2
+    assert seqs_of(sent) == [9, 10]
+    assert sp.state("p").cursor == 0  # sweep complete: wrap
+    sent = []
+    assert sp.replay("p", lambda d: sent.append(d) or True, budget=4) == 4
+    assert seqs_of(sent) == [1, 2, 3, 4]  # nothing acked: head again
+    # a cumulative ack past the cursor restarts at the new head
+    sp.ack("p", 6)
+    sent = []
+    assert sp.replay("p", lambda d: sent.append(d) or True, budget=4) == 4
+    assert seqs_of(sent) == [7, 8, 9, 10]
+    # unbudgeted (channel-up) replay still ships the whole backlog
+    sent = []
+    assert sp.replay("p", lambda d: sent.append(d) or True) == 4
+    assert seqs_of(sent) == [7, 8, 9, 10]
+    # metrics counted every shipped frame
+    assert sp.metrics.value("cluster_spool_replayed") == 4 + 4 + 2 + 4 + 4 + 4
+    sp.close()
+
+
+def test_spool_budgeted_replay_blocked_writer_pauses(tmp_path):
+    """A send refusal (writer buffer full) mid-budget pauses the stream
+    blocked and restarts the sweep at the head next time — never skips."""
+    sp = ClusterSpool("", metrics=Metrics())
+    for i in range(5):
+        sp.journal("p", "msg", {"ref": b"r%d" % i})
+    calls = []
+
+    def flaky(d):
+        calls.append(d)
+        return len(calls) <= 3  # msb + 2 frames, then the buffer "fills"
+
+    assert sp.replay("p", flaky, budget=10) == 2
+    st = sp.state("p")
+    assert st.blocked
+    assert st.cursor == 0  # restart at the head, no skipped frames
+    sent = []
+    assert sp.replay("p", lambda d: sent.append(d) or True, budget=10) == 5
+    assert not st.blocked
+    sp.close()
+
+
 def test_spool_crash_replay_and_seq_continuity(tmp_path):
     """A new spool over the same directory (sender crash/restart) sees
     the unacked frames; sequence numbers never regress even after a

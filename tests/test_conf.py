@@ -355,6 +355,23 @@ def test_schema_units_and_durations():
         "persistent_client_expiration": 0}
 
 
+def test_schema_overload_family_dotted_and_flat():
+    """The overload-governor extension family parses both as flat knobs
+    and via the dotted conf-tree spelling (schema.FLAT_ALIASES)."""
+    s = parse_conf(
+        """
+        overload.mode = binary
+        overload.hold_s = 2.5
+        overload.l2_client_rate = 25
+        overload_l1_throttle_ms = 40
+        """
+    )
+    assert s["overload_mode"] == "binary"
+    assert s["overload_hold_s"] == 2.5
+    assert s["overload_l2_client_rate"] == 25
+    assert s["overload_l1_throttle_ms"] == 40
+
+
 def test_schema_gap_and_unknown_errors():
     with pytest.raises(ConfError, match="deliberate gap"):
         parse_conf("listener.http.x = 127.0.0.1:8080\n"

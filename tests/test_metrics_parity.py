@@ -86,6 +86,49 @@ async def test_every_reference_metric_name_exposed_or_classified():
 
 
 @pytest.mark.asyncio
+async def test_overload_and_sysmon_hysteresis_metrics_exposed():
+    """The overload-governor family and the sysmon hysteresis counters
+    are first-class metrics: every name appears in the Prometheus scrape
+    with non-empty HELP text AND in all_metrics() (what the $SYS systree
+    reporter publishes) — same parity discipline as the reference table
+    above."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+
+    names = (
+        # gauges (robustness/overload.py stats + sysmon)
+        "overload_level", "overload_pressure", "overload_level_pinned",
+        "overload_level_extends", "overload_l1_seconds",
+        "overload_l2_seconds", "overload_l3_seconds",
+        "overload_level_enters_l1", "overload_level_enters_l2",
+        "overload_level_enters_l3", "sysmon_overload_extends",
+        "sysmon_last_loop_lag_seconds",
+        # per-stage shed counters (metrics.COUNTERS)
+        "overload_publish_throttled", "overload_rate_limited",
+        "overload_qos0_shed", "overload_replay_deferred",
+        "overload_connects_refused", "overload_talker_disconnects",
+    )
+    cfg = Config(systree_enabled=False, allow_anonymous=True)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        text = broker.metrics.prometheus_text(node=broker.node_name)
+        am = broker.metrics.all_metrics()
+        for name in names:
+            assert f"\n{name}{{" in text or text.startswith(
+                f"{name}{{"), f"{name} not scraped"
+            help_line = next(
+                (line for line in text.splitlines()
+                 if line.startswith(f"# HELP {name} ")), None)
+            assert help_line is not None, f"{name} has no HELP"
+            assert len(help_line) > len(f"# HELP {name} "), \
+                f"{name} HELP text empty"
+            assert name in am, f"{name} missing from $SYS metrics"
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_per_reason_families_count():
     """The per-reason-code families actually count: a v4 accepted CONNACK
     hits both the flat per-reason counter and the labeled family; an

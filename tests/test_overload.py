@@ -1,0 +1,438 @@
+"""Adaptive overload governor (robustness/overload.py): signal fusion,
+per-level hysteresis, and the staged L1/L2/L3 responses wired through
+session, listener, collectors and admin.
+
+The reference exposes load shedding through vmq_ranch reader throttling,
+QoS0-first queue drops and CONNECT refusal; these tests pin the ported
+governor's contract: levels never flap at the boundary, L1 throttles
+proportionally, L2 sheds ONLY ack-free work (zero QoS>=1 loss), L3
+refuses connects with the spec reason codes, and the ``device.pressure``
+fault point can force any level for drills.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from vernemq_tpu.broker.config import Config
+from vernemq_tpu.broker.metrics import Metrics
+from vernemq_tpu.broker.server import start_broker
+from vernemq_tpu.client import MQTTClient
+from vernemq_tpu.robustness import faults
+from vernemq_tpu.robustness.overload import OverloadGovernor
+
+
+class FakeBroker:
+    def __init__(self, **cfg):
+        self.config = Config(**cfg)
+        self.metrics = Metrics(native=False)
+        self.sessions = {}
+        self.sysmon = None
+        self.cluster = None
+
+
+def mk_gov(**kw):
+    kw.setdefault("hold_s", 0.15)
+    kw.setdefault("tick_s", 0.01)
+    return OverloadGovernor(FakeBroker(), **kw)
+
+
+# ------------------------------------------------------------ signal fusion
+
+
+def test_raw_lag_spike_is_instant_l1_but_not_l2():
+    """One over-threshold sample floors pressure at the L1 gate (cheap
+    response NOW); the sustained levels key off the EWMA, so a single
+    GC-pause-sized spike can never shed QoS0 or refuse connects."""
+    gov = mk_gov()
+    thr = gov._lag_threshold()
+    gov.observe_lag(thr * 4)  # one huge spike from cold
+    assert gov.level == 1
+    assert gov._target_level(gov._last_pressure) == 1
+
+
+def test_sustained_lag_escalates_through_levels():
+    gov = mk_gov()
+    thr = gov._lag_threshold()
+    for _ in range(20):  # EWMA converges to the raw value
+        gov.observe_lag(thr * 4)
+    # severity = ewma / (4*thr) -> 1.0 >= the L3 gate
+    assert gov.level == 3
+    assert gov.enters[1] >= 1 and gov.enters[2] >= 1 and gov.enters[3] >= 1
+
+
+def test_hysteresis_boundary_pressure_never_flaps():
+    """Pressure hovering just under the enter gate but above the exit
+    bound keeps the level armed (counted as extends) — the observe_lag
+    enter/exit-ratio pattern applied per level."""
+    gov = mk_gov(hold_s=0.05)
+    now = time.monotonic()
+    gov._update_level(now, 0.30)           # enter L1
+    assert gov.level == 1
+    flaps = 0
+    for i in range(10):
+        # boundary: below 0.25 enter, above 0.125 exit bound
+        gov._update_level(now + 0.01 * i, 0.20)
+        if gov.level != 1:
+            flaps += 1
+    assert flaps == 0
+    assert gov.level_extends >= 9
+
+
+def test_recovery_within_one_hold_window():
+    gov = mk_gov(hold_s=0.1)
+    t0 = time.monotonic()
+    gov._update_level(t0, 0.9)
+    assert gov.level == 3
+    # load drops: below every exit bound; level exits straight to 0
+    # (not one step per window) once the hold expires
+    gov._update_level(t0 + 0.05, 0.0)
+    assert gov.level == 3  # still held
+    gov._update_level(t0 + 0.11, 0.0)
+    assert gov.level == 0
+
+
+def test_per_level_seconds_accumulate():
+    gov = mk_gov(hold_s=10.0)
+    gov._update_level(time.monotonic(), 0.9)
+    time.sleep(0.03)
+    gov.tick()
+    assert gov.stats()["overload_l3_seconds"] > 0.0
+
+
+def test_device_pressure_fault_point_forces_levels():
+    """The chaos seam: an error rule at device.pressure reads as full
+    pressure, forcing L3 without a real storm; clearing it recovers
+    within the hold window."""
+    gov = mk_gov(hold_s=0.05)
+    faults.install(faults.FaultPlan(
+        [faults.FaultRule("device.pressure", kind="error")], seed=3))
+    try:
+        gov.tick()
+        assert gov.level == 3
+        assert gov._last_signals.get("injected") == 1.0
+    finally:
+        faults.clear()
+    time.sleep(0.06)
+    gov.tick()
+    assert gov.level == 0
+
+
+def test_broad_device_outage_drill_does_not_force_overload():
+    """A device.* glob fault plan (the breaker drill) must NOT read as
+    total overload: degraded mode serves full traffic from the host
+    trie, so the breaker contributes sub-L1 headroom pressure only, and
+    the device.pressure seam fires only for EXACTLY-targeted rules."""
+    gov = mk_gov()
+    faults.install(faults.FaultPlan(
+        [faults.FaultRule("device.*", kind="error")], seed=11))
+    try:
+        gov.tick()
+        assert "injected" not in gov._last_signals
+        assert gov.level == 0
+    finally:
+        faults.clear()
+    # an open breaker alone: visible pressure, but below the L1 gate
+    assert gov._breaker_severity() == 0.0  # no matchers in the fake
+    class Src:
+        def breaker_status(self):
+            return {"": {"state": "open"}}
+    gov.broker.registry = type("R", (), {"reg_views": {"tpu": Src()}})()
+    assert gov._breaker_severity() == pytest.approx(0.2)
+    gov.tick()
+    assert gov.level == 0 and gov._last_signals["breaker"] == 0.2
+
+
+def test_pin_overrides_signals_and_unpins():
+    gov = mk_gov()
+    gov.pin(2)
+    gov.tick()
+    assert gov.level == 2 and gov.status()["pinned"] == 2
+    with pytest.raises(ValueError):
+        gov.pin(7)
+    gov.pin(None)
+    time.sleep(0.16)  # hold expiry
+    gov.tick()
+    assert gov.level == 0
+
+
+def test_binary_mode_keeps_legacy_posture():
+    """overload_mode=binary: the old flag + fixed 0.1s sleep, no graded
+    responses — the A/B baseline bench config 9 compares against."""
+    gov = mk_gov(mode="binary")
+    gov.pin(2)
+    assert not gov.shed_qos0()
+    assert not gov.defer_replay()
+    gov.pin(3)
+    assert not gov.refuse_connects()
+    assert gov.publish_delay(("", "x")) == 0.0  # no sysmon flag -> no pause
+
+
+def test_proportional_throttle_targets_heavy_talkers():
+    gov = mk_gov()
+    gov.pin(1)
+    heavy, light = ("", "heavy"), ("", "light")
+    gov._talker_rates = {heavy: 900.0, light: 10.0}
+    gov._rates_mean = 455.0  # folded by _fold_talkers in production
+    d_heavy = gov.publish_delay(heavy)
+    d_light = gov.publish_delay(light)
+    assert d_heavy > d_light
+    assert d_heavy >= gov.l1_throttle_s  # heavy pays >= base
+    assert d_light < gov.l1_throttle_s   # light pays under base
+    # a lone/unknown talker pays exactly the base (the sysmon-test
+    # contract: overload still visibly throttles a single publisher)
+    gov._talker_rates = {}
+    gov._rates_mean = 0.0  # recomputed by _fold_talkers in production
+    assert gov.publish_delay(("", "solo")) == pytest.approx(
+        gov.l1_throttle_s)
+
+
+def test_l2_token_bucket_charges_sustained_floods():
+    gov = mk_gov(l2_client_rate=10.0, l2_burst=2.0)
+    gov.pin(2)
+    sid = ("", "flood")
+    waits = [gov._token_wait(sid, 100.0 + i * 1e-6) for i in range(6)]
+    assert waits[0] == 0.0 and waits[1] == 0.0  # burst
+    assert all(w > 0 for w in waits[2:])        # then ~1/rate each
+    assert waits[-1] <= 1.0                     # capped (keepalive safety)
+
+
+# ------------------------------------------------------- broker end-to-end
+
+
+async def boot(**cfg):
+    cfg.setdefault("systree_enabled", False)
+    cfg.setdefault("allow_anonymous", True)
+    return await start_broker(Config(**cfg), port=0)
+
+
+@pytest.mark.asyncio
+async def test_l1_throttles_but_delivers():
+    b, server = await boot()
+    try:
+        b.overload.pin(1)
+        c = MQTTClient(server.host, server.port, client_id="l1c")
+        await c.connect()
+        await c.subscribe("l1/#", qos=0)
+        t0 = time.monotonic()
+        await c.publish("l1/t", b"x", qos=0)
+        msg = await c.recv(5.0)
+        assert msg.payload == b"x"
+        assert time.monotonic() - t0 >= 0.09  # the graded pause applied
+        assert b.metrics.value("overload_publish_throttled") >= 1
+        await c.close()
+    finally:
+        await b.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_l2_sheds_qos0_zero_qos1_loss():
+    b, server = await boot(overload_l1_throttle_ms=1)
+    try:
+        b.overload.pin(2)
+        sub = MQTTClient(server.host, server.port, client_id="l2sub")
+        await sub.connect()
+        await sub.subscribe("l2/#", qos=1)
+        pub = MQTTClient(server.host, server.port, client_id="l2pub")
+        await pub.connect()
+        await pub.publish("l2/t", b"q0", qos=0)       # shed at the gate
+        ack = await pub.publish("l2/t", b"q1", qos=1)  # must survive
+        assert ack is not None
+        m = await sub.recv(5.0)
+        assert m.payload == b"q1"  # the QoS1 arrived; the QoS0 never did
+        with pytest.raises(asyncio.TimeoutError):
+            await sub.recv(0.3)
+        assert b.metrics.value("overload_qos0_shed") == 1
+        await pub.close()
+        await sub.close()
+    finally:
+        await b.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_l2_rate_limits_heavy_talker_without_loss():
+    b, server = await boot(overload_l1_throttle_ms=1,
+                           overload_l2_client_rate=5,
+                           overload_l2_burst=1)
+    try:
+        b.overload.pin(2)
+        sub = MQTTClient(server.host, server.port, client_id="rlsub")
+        await sub.connect()
+        await sub.subscribe("rl/#", qos=1)
+        pub = MQTTClient(server.host, server.port, client_id="rlpub")
+        await pub.connect()
+        t0 = time.monotonic()
+        for i in range(3):
+            assert await pub.publish("rl/t", b"m%d" % i, qos=1,
+                                     timeout=10.0) is not None
+        assert time.monotonic() - t0 >= 0.3  # 2 publishes past the burst
+        assert b.metrics.value("overload_rate_limited") >= 2
+        got = [await sub.recv(5.0) for _ in range(3)]
+        assert [m.payload for m in got] == [b"m0", b"m1", b"m2"]
+        await pub.close()
+        await sub.close()
+    finally:
+        await b.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_l3_refuses_connects_with_spec_reason_codes():
+    b, server = await boot()
+    try:
+        b.overload.pin(3)
+        v4 = MQTTClient(server.host, server.port, client_id="ref4")
+        ack = await v4.connect()
+        assert ack.rc == 3  # MQTT3 Server unavailable
+        v5 = MQTTClient(server.host, server.port, client_id="ref5",
+                        proto_ver=5)
+        ack5 = await v5.connect()
+        assert ack5.rc == 0x97  # MQTT5 Quota exceeded
+        assert b.metrics.value("overload_connects_refused") == 2
+        assert not b.sessions  # nothing registered
+        b.overload.pin(None)
+        b.overload.tick()
+        ok = MQTTClient(server.host, server.port, client_id="ref-ok")
+        # recovery needs the hold window; pin(0) drills it immediately
+        b.overload.pin(0)
+        assert (await ok.connect()).rc == 0
+        await ok.close()
+    finally:
+        await b.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_l3_disconnects_top_talker_with_server_busy():
+    b, server = await boot(overload_l3_disconnect_top=1,
+                           overload_l1_throttle_ms=1,
+                           overload_l2_client_rate=5)
+    try:
+        heavy = MQTTClient(server.host, server.port, client_id="heavy",
+                           proto_ver=5)
+        await heavy.connect()
+        light = MQTTClient(server.host, server.port, client_id="light",
+                           proto_ver=5)
+        await light.connect()
+        for i in range(30):
+            await heavy.publish("hv/t", b"x", qos=0)
+        await light.publish("lt/t", b"y", qos=0)
+        await asyncio.sleep(0.05)  # let the reader loops record
+        b.overload.tick()          # fold talker rates
+        assert b.overload._talker_rates  # heavy is tracked
+        b.overload.pin(3)          # entry schedules the shed
+        await asyncio.sleep(0.1)
+        from vernemq_tpu.protocol.types import Disconnect
+
+        f = await heavy.recv(5.0)
+        assert isinstance(f, Disconnect) and f.reason_code == 0x89
+        assert b.metrics.value("overload_talker_disconnects") == 1
+        assert ("", "light") in b.sessions  # the light talker survives
+        await light.close()
+        await heavy.close()
+    finally:
+        await b.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_retained_replay_deferred_at_l2():
+    """The retained collector's defer gate: at L2 a replay flush above
+    the host threshold re-arms a stretched window (bounded), and the
+    replies still settle — deferral trades latency, never loses."""
+    from vernemq_tpu.retained.collector import RetainedBatchCollector
+
+    class Store:
+        def match_filter(self, mp, fw):
+            return [(("t", "a"), b"v")]
+
+    class Eng:
+        async def index_async(self, mp):
+            return self
+
+        def match_filters(self, filters):
+            return [[(("t", "a"), b"v")] for _ in filters]
+
+    b, server = await boot()
+    try:
+        b.overload.pin(2)
+        col = RetainedBatchCollector(engine=Eng(), store=Store(),
+                                     window_us=1000, host_threshold=0,
+                                     max_batch=2)
+        col.defer_gate = b.overload.defer_replay
+        col.MAX_DEFERS = 2
+        # a storm: submits keep arriving past max_batch WHILE a deferral
+        # window is armed — each arrival must NOT consume a defer (the
+        # fast path would otherwise burn MAX_DEFERS in microseconds)
+        futs = [col.submit("", ("t", "#")) for _ in range(7)]
+        res = await asyncio.wait_for(asyncio.gather(*futs), 5.0)
+        assert all(r == [(("t", "a"), b"v")] for r in res)
+        # bounded: at most MAX_DEFERS consecutive deferrals PER flush
+        # chunk (7 items in 2-item chunks = 4 chunks), never one per
+        # storm submit (which would be 7+ for the first chunk alone)
+        assert 2 <= col.deferred_flushes <= 8
+        assert b.metrics.value("overload_replay_deferred") >= 2
+    finally:
+        await b.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_admin_overload_show_and_set_level():
+    from vernemq_tpu.admin.commands import (CommandError, CommandRegistry,
+                                            register_core_commands)
+
+    reg = register_core_commands(CommandRegistry())
+    b, server = await boot()
+    try:
+        st = reg.run(b, ["overload", "show"])
+        assert st["level"] == 0 and st["level_name"] == "ok"
+        assert "loop_lag" in st["signals"] or st["signals"] == {}
+        assert set(st["counters"]) >= {"overload_qos0_shed",
+                                       "overload_connects_refused"}
+        out = reg.run(b, ["overload", "set-level", "level=2"])
+        assert "pinned at 2" in out
+        assert b.overload.level == 2 and b.overload.pinned == 2
+        out = reg.run(b, ["overload", "set-level", "level=auto"])
+        assert "unpinned" in out and b.overload.pinned is None
+        with pytest.raises(CommandError):
+            reg.run(b, ["overload", "set-level", "level=9"])
+        with pytest.raises(CommandError):
+            reg.run(b, ["overload", "set-level"])
+    finally:
+        await b.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_chaos_drill_end_to_end_recovery():
+    """device.pressure drill against a live broker: forced L3 refuses a
+    connect; clearing the plan recovers to level 0 within one hold
+    window and connects flow again."""
+    b, server = await boot(overload_hold_s=0.2, overload_tick_ms=20)
+    try:
+        faults.install(faults.FaultPlan(
+            [faults.FaultRule("device.pressure", kind="error")], seed=5))
+        deadline = time.monotonic() + 5
+        while b.overload.level < 3 and time.monotonic() < deadline:
+            await asyncio.sleep(0.03)
+        assert b.overload.level == 3
+        c = MQTTClient(server.host, server.port, client_id="drill")
+        assert (await c.connect()).rc == 3
+        faults.clear()
+        t0 = time.monotonic()
+        while b.overload.level != 0 and time.monotonic() - t0 < 5:
+            await asyncio.sleep(0.03)
+        recovery = time.monotonic() - t0
+        assert b.overload.level == 0
+        assert recovery < 2.0  # ~one hold window + tick jitter
+        c2 = MQTTClient(server.host, server.port, client_id="drill2")
+        assert (await c2.connect()).rc == 0
+        await c2.close()
+    finally:
+        faults.clear()
+        await b.stop()
+        await server.stop()

@@ -477,6 +477,88 @@ async def test_retained_replay_carries_remaining_expiry():
 
 
 @pytest.mark.asyncio
+async def test_queue_backlog_notify_ready_under_concurrent_producers():
+    """The passive→active backpressure path (vmq_queue.erl:752-774 /
+    vmq_mqtt_fsm.erl:264-293): with a 1-slot inflight window and a tiny
+    pending list, concurrent producers push the subscriber queue into
+    its backlog (every session refused); acks then pull messages back
+    via notify_ready with ZERO drops and per-producer order intact."""
+    from vernemq_tpu.protocol.types import Puback, Publish
+
+    b, server = await boot(max_inflight_messages=1, max_online_messages=3)
+    sub = RawV5(server.host, server.port)
+    await sub.connect("bp-sub")
+    from vernemq_tpu.protocol.types import SubOpts, Subscribe
+
+    await sub.send(Subscribe(packet_id=1,
+                             topics=[("bp/t", SubOpts(qos=1))],
+                             properties={}))
+    await sub.recv()  # SUBACK
+
+    pubs = []
+    for i in range(3):
+        p = await connected(server, f"bp-pub{i}")
+        pubs.append(p)
+    # 6 concurrent QoS1 publishes against capacity 1 (inflight) + 3
+    # (session pending) + 3 (queue backlog cap) — nothing may drop
+    await asyncio.gather(*[
+        p.publish("bp/t", b"%d-%d" % (i, j), qos=1)
+        for i, p in enumerate(pubs) for j in range(2)])
+    await asyncio.sleep(0.05)
+    queue = b.registry.get_queue(("", "bp-sub"))
+    sess = b.sessions[("", "bp-sub")]
+    # withheld acks parked the overflow in the QUEUE backlog (passive
+    # state), beyond the session's own pending list
+    assert len(sess.waiting_acks) == 1
+    assert len(sess.pending) == 3
+    assert len(queue.backlog) == 2
+    assert b.metrics.value("queue_message_drop") == 0
+
+    got = []
+    for _ in range(6):  # ack one, next flows (notify_ready pull)
+        f = await sub.recv()
+        assert isinstance(f, Publish) and not f.dup
+        got.append(f.payload)
+        await sub.send(Puback(packet_id=f.packet_id))
+    assert sorted(got) == sorted(
+        b"%d-%d" % (i, j) for i in range(3) for j in range(2))
+    # per-producer order preserved through park/replay (MQTT-4.6.0)
+    for i in range(3):
+        mine = [g for g in got if g.startswith(b"%d-" % i)]
+        assert mine == sorted(mine)
+    await asyncio.sleep(0.05)
+    assert not queue.backlog and not sess.pending
+    assert b.metrics.value("queue_message_drop") == 0
+    for p in pubs:
+        await p.disconnect()
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_rate_throttle_waits_window_remainder_not_blind_second():
+    """The fixed-1s stall is gone: a throttled publish resumes at the
+    rate-window rollover, so two windows' worth of traffic completes in
+    ~2s instead of ~1s-per-throttled-publish."""
+    b, server = await boot(max_message_rate=5)
+    pub = await connected(server, "rw-pub")
+    sub = await connected(server, "rw-sub")
+    await sub.subscribe("rw/#", qos=0)
+    t0 = asyncio.get_event_loop().time()
+    for i in range(10):  # 2 windows of budget, 5 over on the first
+        await pub.publish("rw/t", b"p%d" % i, qos=1)
+    elapsed = asyncio.get_event_loop().time() - t0
+    assert elapsed >= 1.0         # the throttle did engage
+    assert elapsed < 3.0          # but never the old 1s-per-publish stall
+    got = [await asyncio.wait_for(sub.messages.get(), 5) for _ in range(10)]
+    assert [m.payload for m in got] == [b"p%d" % i for i in range(10)]
+    await pub.disconnect()
+    await sub.disconnect()
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_max_message_rate_throttles_not_kills():
     b, server = await boot(max_message_rate=5)
     sub = await connected(server, "rsub")

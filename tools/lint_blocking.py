@@ -1,0 +1,120 @@
+#!/usr/bin/env python3
+"""Static check: no loop-blocking calls inside ``async def`` bodies.
+
+The class of bug this catches is exactly what the old binary load
+shedder was: a synchronous stall (``time.sleep(1.0)``) sitting on the
+event loop inside an async path, freezing every session's IO for its
+duration. Flags, inside any ``async def`` in ``vernemq_tpu/``:
+
+- ``time.sleep(...)`` (use ``await asyncio.sleep`` — or run the sync
+  work in an executor);
+- synchronous file IO via a direct ``open(...)`` / ``os.fsync(...)``
+  call (push it behind ``run_in_executor`` or a sync helper that the
+  loop calls knowingly — a *named* helper documents the stall, a bare
+  ``open`` in an async body is almost always an accident);
+- ``input(...)`` (never legal on the loop).
+
+Nested synchronous ``def``s inside an async function are NOT flagged
+(they may run anywhere — an executor, a thread); nested async defs are
+visited in their own right. A line may opt out with a trailing
+``# lint: allow-blocking`` comment naming its reason — the opt-out is
+for deliberate, capped stalls (e.g. a fault-injection seam that models
+a slow disk ON the loop on purpose).
+
+Exits 1 with ``file:line`` findings; wired into ``tools/run_tier1.sh``
+as a pre-test step so a regression fails tier-1 before a single test
+runs.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
+TARGET = os.path.join(ROOT, "vernemq_tpu")
+
+ALLOW_MARK = "lint: allow-blocking"
+
+#: call spellings that block the event loop
+_BAD_ATTR = {("time", "sleep"), ("os", "fsync")}
+_BAD_NAME = {"open", "input"}
+
+
+def _call_name(node: ast.Call):
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return (f.value.id, f.attr)
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+class _AsyncBodyVisitor(ast.NodeVisitor):
+    """Walk ONE async function's body without descending into nested
+    function definitions (each async def gets its own visitor from the
+    module walk; nested sync defs are not loop-bound)."""
+
+    def __init__(self, findings, rel, allowed_lines):
+        self.findings = findings
+        self.rel = rel
+        self.allowed = allowed_lines
+
+    def visit_FunctionDef(self, node):  # noqa: N802 — ast API
+        pass  # nested sync def: not necessarily on the loop
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802
+        pass  # visited by the module-level walk
+
+    def visit_Call(self, node):  # noqa: N802
+        name = _call_name(node)
+        bad = (name in _BAD_NAME if isinstance(name, str)
+               else name in _BAD_ATTR)
+        if bad and node.lineno not in self.allowed:
+            pretty = name if isinstance(name, str) else ".".join(name)
+            self.findings.append(
+                f"{self.rel}:{node.lineno}: blocking call "
+                f"`{pretty}(...)` inside async def")
+        self.generic_visit(node)
+
+
+def scan_file(path: str, rel: str, findings) -> None:
+    with open(path, "r", encoding="utf-8") as fh:
+        src = fh.read()
+    allowed = {i for i, line in enumerate(src.splitlines(), 1)
+               if ALLOW_MARK in line}
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        findings.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
+        return
+    for node in ast.walk(tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            v = _AsyncBodyVisitor(findings, rel, allowed)
+            for child in node.body:
+                v.visit(child)
+
+
+def main() -> int:
+    findings = []
+    for dirpath, _dirs, files in os.walk(TARGET):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            scan_file(path, os.path.relpath(path, ROOT), findings)
+    if findings:
+        print("lint_blocking: loop-blocking calls in async bodies:",
+              file=sys.stderr)
+        for f in findings:
+            print(f"  {f}", file=sys.stderr)
+        return 1
+    print("lint_blocking: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

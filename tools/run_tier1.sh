@@ -25,6 +25,11 @@ else
   EXTRA=(-m 'not slow')
 fi
 
+# pre-test static pass: no loop-blocking calls (time.sleep, sync file
+# IO, input) inside async bodies — the bug class the old fixed-sleep
+# load shedding was (tools/lint_blocking.py)
+python tools/lint_blocking.py || exit 1
+
 rm -f "$LOG"
 timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
   python -m pytest tests/ -q "${EXTRA[@]}" \

@@ -248,6 +248,12 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
     reg.register(["breaker", "reset"], _breaker_reset,
                  "vmq-admin breaker reset [mountpoint=] "
                  "[path=match|retained]")
+    reg.register(["overload", "show"], _overload_show,
+                 "vmq-admin overload show  (governor level, fused "
+                 "signals, per-stage shed counters)")
+    reg.register(["overload", "set-level"], _overload_set_level,
+                 "vmq-admin overload set-level level=0..3|auto  "
+                 "(pin a level for drills, like breaker trip)")
     reg.register(["api-key", "add"], _api_key_add,
                  "vmq-admin api-key add key=KEY")
     return reg
@@ -1029,6 +1035,44 @@ def _each_breaker(broker, flags):
                 continue
             if idx.breaker is not None:
                 yield mp, idx.breaker
+
+
+def _governor(broker):
+    gov = getattr(broker, "overload", None)
+    if gov is None:
+        raise CommandError("overload governor not running")
+    return gov
+
+
+def _overload_show(broker, flags):
+    """Governor state: level, fused signals, per-stage shed counters."""
+    gov = _governor(broker)
+    st = gov.status()
+    m = broker.metrics
+    st["counters"] = {name: m.value(name) for name in (
+        "overload_publish_throttled", "overload_rate_limited",
+        "overload_qos0_shed", "overload_replay_deferred",
+        "overload_connects_refused", "overload_talker_disconnects")}
+    return st
+
+
+def _overload_set_level(broker, flags):
+    """Pin the governor to a level for a drill (``level=auto`` unpins)."""
+    gov = _governor(broker)
+    raw = flags.get("level")
+    if raw is None:
+        raise CommandError("level= required (0..3 or auto)")
+    if str(raw).lower() in ("auto", "none", "-1"):
+        gov.pin(None)
+        return "overload level unpinned (automatic)"
+    try:
+        level = int(raw)
+        gov.pin(level)
+    except ValueError as e:
+        raise CommandError(str(e) if str(e) else "level must be 0..3 "
+                           "or auto") from None
+    return (f"overload level pinned at {level} "
+            f"({gov.status()['level_name']})")
 
 
 def _breaker_trip(broker, flags):

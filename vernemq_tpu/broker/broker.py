@@ -118,6 +118,7 @@ class Broker:
         self._delayed_wills: Dict[SubscriberId, asyncio.Task] = {}
         self.tracer: Optional[Any] = None  # single active session tracer
         self.sysmon: Optional[Any] = None
+        self.overload: Optional[Any] = None  # adaptive overload governor
         self.supervisor: Optional[Any] = None  # crash-restart supervision
         self.crl_refresher: Optional[Any] = None
         self.http: Optional[Any] = None
@@ -150,6 +151,36 @@ class Broker:
             # degraded-mode observability (robustness tentpole): breaker
             # state + fallback/fault counters, published to $SYS like
             # every other metric by the systree reporter
+            # adaptive overload governor (robustness/overload.py):
+            # current level + composite pressure, per-level cumulative
+            # seconds and entry counts, hysteresis extends, plus the
+            # sysmon hysteresis counters the governor builds on
+            "overload_level": "Current overload governor level (0 ok, "
+                              "1 throttle, 2 shed, 3 refuse).",
+            "overload_pressure": "Composite overload pressure score "
+                                 "(max of the fused signal severities, "
+                                 "0..1).",
+            "overload_level_pinned": "Manually pinned overload level "
+                                     "(-1 = automatic).",
+            "overload_level_extends": "Overload hysteresis windows "
+                                      "re-armed by boundary pressure.",
+            "overload_l1_seconds": "Cumulative seconds spent at "
+                                   "overload level 1.",
+            "overload_l2_seconds": "Cumulative seconds spent at "
+                                   "overload level 2.",
+            "overload_l3_seconds": "Cumulative seconds spent at "
+                                   "overload level 3.",
+            "overload_level_enters_l1": "Transitions into overload "
+                                        "level 1.",
+            "overload_level_enters_l2": "Transitions into overload "
+                                        "level 2.",
+            "overload_level_enters_l3": "Transitions into overload "
+                                        "level 3.",
+            "sysmon_overload_extends": "Sysmon overload cooldowns "
+                                       "re-armed by boundary lag "
+                                       "(hysteresis extends).",
+            "sysmon_last_loop_lag_seconds": "Most recent event-loop "
+                                            "lag sample.",
             "tpu_breaker_state": "Device circuit breaker state "
                                  "(0 closed, 1 half-open, 2 open; worst "
                                  "across mountpoints).",
@@ -204,6 +235,9 @@ class Broker:
             "retained_breaker_state": "Retained device breaker state "
                                       "(0 closed, 1 half-open, 2 open; "
                                       "worst across mountpoints).",
+            "retained_replay_deferred_flushes": "Replay flushes deferred "
+                                                "by the overload "
+                                                "governor (level 2+).",
             "retained_replay_device_batches": "Replay flushes served by "
                                               "the device path.",
             "retained_replay_device_filters": "Replay filters that rode "
@@ -230,6 +264,12 @@ class Broker:
         out["retain_memory"] = self.retain.memory()
         out["active_sessions"] = len(self.sessions)
         out["uptime_seconds"] = time.time() - self._started
+        if self.overload is not None:
+            out.update(self.overload.stats())
+        if self.sysmon is not None:
+            st = self.sysmon
+            out["sysmon_overload_extends"] = float(st.overload_extends)
+            out["sysmon_last_loop_lag_seconds"] = round(st.last_lag, 4)
         spool = getattr(self.cluster, "spool", None)
         if spool is not None:
             out.update(spool.stats())
@@ -540,6 +580,8 @@ class Broker:
                 host_threshold=self.config.tpu_host_batch_threshold,
                 lock_busy_shed_ms=self.config.tpu_lock_busy_shed_ms,
                 super_batch_k=self.config.tpu_super_batch_k,
+                latency_budget_ms=self.config.get(
+                    "overload_dispatch_budget_ms", 50.0),
             )
         return self._collector
 
@@ -584,7 +626,13 @@ class Broker:
                 window_us=cfg.get("tpu_retained_window_us", 500),
                 max_batch=cfg.get("tpu_retained_max_batch", 1024),
                 host_threshold=cfg.get("tpu_retained_host_threshold", 4),
+                latency_budget_ms=cfg.get(
+                    "overload_dispatch_budget_ms", 50.0),
             )
+            if self.overload is not None:
+                # L2 response: replay storms defer behind live publishes
+                self._retained_collector.defer_gate = \
+                    self.overload.defer_replay
         return self._retained_collector
 
     def _resolve_base_dirs(self) -> None:
@@ -752,6 +800,25 @@ class Broker:
                 await self.listeners.start_listener(
                     ln["kind"], ln.get("addr", "127.0.0.1"),
                     ln.get("port", 0), ln.get("opts"))
+        # adaptive overload governor BEFORE sysmon so the lag sampler can
+        # feed it from its very first sample (robustness/overload.py)
+        from ..robustness.overload import OverloadGovernor
+
+        cfg = self.config
+        self.overload = OverloadGovernor(
+            self,
+            mode=cfg.get("overload_mode", "governor"),
+            tick_s=cfg.get("overload_tick_ms", 250) / 1e3,
+            hold_s=cfg.get("overload_hold_s", 5.0),
+            exit_ratio=cfg.get("overload_exit_ratio", 0.5),
+            l1_enter=cfg.get("overload_l1_enter", 0.25),
+            l2_enter=cfg.get("overload_l2_enter", 0.5),
+            l3_enter=cfg.get("overload_l3_enter", 0.8),
+            l1_throttle_ms=cfg.get("overload_l1_throttle_ms", 100),
+            l2_client_rate=cfg.get("overload_l2_client_rate", 50),
+            l2_burst=cfg.get("overload_l2_burst", 100),
+            l3_disconnect_top=cfg.get("overload_l3_disconnect_top", 5))
+        self.overload.start()
         if self.config.get("sysmon_enabled", True):
             from .sysmon import Sysmon
 
@@ -795,6 +862,8 @@ class Broker:
             h.close()
         if self.sysmon is not None:
             self.sysmon.stop()
+        if self.overload is not None:
+            self.overload.stop()
         if self.crl_refresher is not None:
             self.crl_refresher.stop()
         for s in list(self.sessions.values()):

@@ -63,6 +63,12 @@ DEFAULTS: Dict[str, Any] = {
     # ack watchdog: unacked frames older than this replay over the live
     # channel (recovers in-channel loss where no reconnect fires replay)
     "cluster_spool_retransmit_ms": 1000,
+    # frames the watchdog replays per tick, with a persistent per-peer
+    # cursor resuming where the last tick stopped — a long partition at
+    # high publish rates no longer re-ships the whole journal every
+    # tick (the quadratic wire cost flagged in ROADMAP). 0 = unbudgeted
+    # (full replay per tick, the old behaviour).
+    "cluster_spool_replay_burst": 512,
     # compat no-op (see schema.COMPAT_NOOPS): queues are dict-sharded
     "queue_sup_sup_children": 50,
     # reg views started at boot; entries from schema.REG_VIEW_ALIASES
@@ -214,6 +220,30 @@ DEFAULTS: Dict[str, Any] = {
     # overload exits only after lag stays below threshold * this ratio
     # for a full cooldown (hysteresis — no shed/unshed flap at the edge)
     "sysmon_lag_exit_ratio": 0.5,
+    # adaptive overload governor (robustness/overload.py): fuses loop-lag
+    # EWMA + RSS watermark, collector pending-depth/dispatch-latency,
+    # breaker state and cluster buffer/spool depth into a pressure level
+    # 0-3 with per-level hysteresis. Staged cheapest-first responses:
+    # L1 proportional per-session read throttle, L2 per-client token
+    # buckets + QoS0 fanout shedding + retained-replay deferral, L3
+    # connect refusal (CONNACK 0x97 / server unavailable) + top-talker
+    # disconnects (Server busy). "binary" keeps the legacy posture (the
+    # sysmon flag + fixed 0.1s sleep) for A/B runs — bench config 9.
+    "overload_mode": "governor",  # governor | binary
+    "overload_tick_ms": 250,
+    "overload_hold_s": 5.0,       # per-level hysteresis hold window
+    "overload_exit_ratio": 0.5,   # exit below enter_threshold * this
+    "overload_l1_enter": 0.25,    # pressure gates per level
+    "overload_l2_enter": 0.5,
+    "overload_l3_enter": 0.8,
+    "overload_l1_throttle_ms": 100,  # base read-throttle, scaled by
+                                     # level and the session's talker
+                                     # share (heaviest wait longest)
+    "overload_l2_client_rate": 50,   # token-bucket refill, msgs/s/client
+    "overload_l2_burst": 100,
+    "overload_l3_disconnect_top": 5,  # heaviest talkers shed at L3 entry
+    # dispatch-latency EWMA budget for the collector pressure signal
+    "overload_dispatch_budget_ms": 50.0,
     "crl_refresh_interval": 60.0,  # seconds (vmq_crl_srv schema knob)
     "swc_replication_groups": 8,  # reference runs 10 (vmq_swc_plugin.erl:36-44)
     "swc_sync_interval": 2.0,  # seconds between AE rounds (sync_interval)

@@ -131,6 +131,23 @@ COUNTERS: List[Tuple[str, str]] = [
      "Event-loop lag events over the sysmon threshold."),
     ("sysmon_large_heap",
      "Forced GCs after crossing the memory high watermark."),
+    # adaptive overload governor (robustness/overload.py): one counter
+    # per shed stage so operators see WHICH response is carrying load
+    ("overload_publish_throttled",
+     "PUBLISHes delayed by the governor's graded read throttle (L1+)."),
+    ("overload_rate_limited",
+     "PUBLISHes delayed by the per-client token bucket at overload "
+     "level 2+."),
+    ("overload_qos0_shed",
+     "QoS0 publishes shed at the fanout admission gate at overload "
+     "level 2+."),
+    ("overload_replay_deferred",
+     "Retained-replay flushes deferred at overload level 2+."),
+    ("overload_connects_refused",
+     "CONNECTs refused at the listener while at overload level 3."),
+    ("overload_talker_disconnects",
+     "Heaviest-talker sessions disconnected (Server busy) entering "
+     "overload level 3."),
 ]
 
 
@@ -296,6 +313,15 @@ class Metrics:
         count += 1
         self._rate_state[key] = (start, count)
         return count <= max_per_sec
+
+    def rate_wait_s(self, key: object) -> float:
+        """Seconds until ``key``'s current rate window rolls over — the
+        precise pause for a throttled publisher (the old path slept a
+        blind 1.0s however much of the window had already elapsed)."""
+        start, _ = self._rate_state.get(key, (0.0, 0))
+        # +2ms past the rollover so the post-wake re-check lands firmly
+        # inside the fresh window despite timer/float granularity
+        return max(0.005, start + 1.0 - time.monotonic() + 0.002)
 
     def drop_rate_state(self, key: object) -> None:
         self._rate_state.pop(key, None)

@@ -42,6 +42,23 @@ FLAT_ALIASES: Dict[str, str] = {
     "vmq_swc.db_backend": "swc_db_backend",
 }
 
+#: extension family: the adaptive overload governor
+#: (robustness/overload.py). The reference exposes its load shedding
+#: through vmq_ranch/vmq_queue internals without conf knobs; ours is
+#: operator-tunable, so the flat ``overload_*`` DEFAULTS also get a
+#: dotted ``overload.<knob>`` conf-tree spelling, consistent with the
+#: reference's dotted trees (plumtree.*, setup.*).
+FLAT_ALIASES.update({
+    f"overload.{k[len('overload_'):]}": k
+    for k in (
+        "overload_mode", "overload_tick_ms", "overload_hold_s",
+        "overload_exit_ratio", "overload_l1_enter", "overload_l2_enter",
+        "overload_l3_enter", "overload_l1_throttle_ms",
+        "overload_l2_client_rate", "overload_l2_burst",
+        "overload_l3_disconnect_top", "overload_dispatch_budget_ms",
+    )
+})
+
 #: reference knobs typed in MILLISECONDS whose internal knob is seconds
 MS_TO_SECONDS = {
     "systree_interval",

@@ -168,6 +168,22 @@ async def mqtt_connection(
             # unknown protocol level: v4-style CONNACK rc=1
             transport.write(b"\x20\x02\x00\x01")
             return
+        gov = getattr(broker, "overload", None)
+        if gov is not None and gov.refuse_connects():
+            # L3 admission control (robustness/overload.py): refuse
+            # before any session/auth/registry cost. This is the
+            # earliest protocol-aware point we control — with asyncio
+            # listeners the TLS handshake has already run by the time
+            # the stream reaches us, so "before TLS" is only possible
+            # for plain listeners (where there is no handshake to
+            # save). v5: CONNACK 0x97 Quota exceeded; v3/4: rc=3
+            # Server unavailable.
+            metrics.incr("mqtt_connect_error")
+            if proto_ver == PROTO_5:
+                transport.write(b"\x20\x03\x00\x97\x00")
+            else:
+                transport.write(b"\x20\x02\x00\x03")
+            return
         connect_frame = codec._parse_body(ptype, flags, body)
         if preauth_user is not None:
             connect_frame.username = preauth_user
@@ -185,6 +201,7 @@ async def mqtt_connection(
 
         # ---- steady-state frame loop ---------------------------------
         buf = bytes(rest)
+        frames_run = 0
         while not session.closed:
             view = memoryview(buf)
             while True:
@@ -208,6 +225,18 @@ async def mqtt_connection(
                 await session.handle_frame(frame)
                 if session.closed:
                     break
+                frames_run += 1
+                if frames_run >= 64:
+                    # bound the synchronous run per read chunk: a 64KB
+                    # chunk can hold ~700 small PUBLISHes, and a handler
+                    # that never truly awaits would process them all in
+                    # ONE loop callback — a flood connection must not
+                    # stall every other session's IO (and the sysmon
+                    # sampler) for the whole chunk
+                    frames_run = 0
+                    await asyncio.sleep(0)
+                    if session.closed:  # closed while we yielded
+                        break
             buf = bytes(view)
             if session.closed:
                 break

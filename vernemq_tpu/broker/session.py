@@ -40,6 +40,7 @@ from ..protocol.types import (
     RC_NO_MATCHING_SUBSCRIBERS,
     RC_NO_SUBSCRIPTION_EXISTED,
     RC_PACKET_ID_NOT_FOUND,
+    RC_SERVER_BUSY,
     RC_SESSION_TAKEN_OVER,
     RC_SUCCESS,
     RC_RECEIVE_MAX_EXCEEDED,
@@ -109,6 +110,9 @@ class Session:
         self._next_pid = 0
         self.awaiting_rel: Dict[int, float] = {}  # incoming qos2 pids
         self.last_activity = time.monotonic()
+        # wakes a rate-throttled reader early: set on the notify_ready /
+        # window-freed edge (_pump_pending) and at close
+        self._throttle_wake = asyncio.Event()
         self._tasks: List[asyncio.Task] = []
         self.closed = False
         self.close_reason = "normal"
@@ -491,13 +495,45 @@ class Session:
         # v5 DISCONNECT 0x95) — an oversize PUBLISH never reaches here
         if not self.broker.metrics.check_rate(self.sid, cfg.max_message_rate):
             # the reference THROTTLES rather than kills the session: the
-            # socket loop pauses reads for ~1s (vmq_mqtt_fsm.erl:243-262 →
+            # socket loop pauses reads (vmq_mqtt_fsm.erl:243-262 →
             # vmq_ranch.erl:198-203); awaiting here backpressures the
-            # reader loop the same way, then the publish proceeds
+            # reader loop the same way. Instead of the old blind 1.0s
+            # sleep regardless of how much window remained, wait only the
+            # REMAINDER of the rate window — waking early when session
+            # capacity frees (the notify_ready edge via _pump_pending) or
+            # the session closes — and re-check the budget on wake.
             self.broker.metrics.incr("mqtt_publish_throttled")
-            await asyncio.sleep(1.0)
-        if self.broker.sysmon is not None and self.broker.sysmon.overloaded:
-            # sysmon load shedding: slow every producer while overloaded
+            while not self.closed:
+                self._throttle_wake.clear()
+                try:
+                    await asyncio.wait_for(
+                        self._throttle_wake.wait(),
+                        self.broker.metrics.rate_wait_s(self.sid))
+                except asyncio.TimeoutError:
+                    pass
+                if self.broker.metrics.check_rate(self.sid,
+                                                  cfg.max_message_rate):
+                    break
+            if self.closed:
+                return  # closed while parked: don't route a dead session
+        gov = self.broker.overload
+        if gov is not None:
+            # graded overload shedding (robustness/overload.py): L1
+            # proportional read throttle + L2 token bucket, replacing
+            # the old fixed 0.1s sleep for every producer; in binary
+            # mode this applies the legacy fixed pause. The governor
+            # counts parked sessions while they sleep (its demand
+            # signal for graceful de-escalation).
+            if await gov.throttle_publish(self.sid) > 0:
+                self.broker.metrics.incr("mqtt_publish_throttled")
+            if self.closed:
+                return  # closed (takeover/disconnect) while parked
+            if f.qos == 0 and gov.shed_qos0():
+                # L2+: QoS0 fanout shed at the admission gate — no ack
+                # owed, the cheapest work in the broker to drop
+                return
+        elif self.broker.sysmon is not None and self.broker.sysmon.overloaded:
+            # no governor wired (embedding/tests): legacy binary shed
             self.broker.metrics.incr("mqtt_publish_throttled")
             await asyncio.sleep(0.1)
         # incoming flow control: QoS2 publishes hold a receive credit
@@ -830,6 +866,8 @@ class Session:
         if (not self.pending and self.queue is not None
                 and len(self.waiting_acks) < window):
             self.queue.notify_ready(self)
+        # capacity freed: a rate-throttled reader may re-check its budget
+        self._throttle_wake.set()
 
     def _handle_puback(self, f: Puback) -> None:
         entry = self.waiting_acks.get(f.packet_id)
@@ -1057,6 +1095,7 @@ class Session:
             return
         self.closed = True
         self.close_reason = reason
+        self._throttle_wake.set()  # release a parked throttle wait
         for t in self._tasks:
             t.cancel()
         if send_will is None:
@@ -1082,6 +1121,17 @@ class Session:
                 self.queue.del_session(self)
         self.broker.metrics.drop_rate_state(self.sid)
         self.transport.close()
+
+    async def overload_disconnect(self) -> None:
+        """L3 top-talker shed (robustness/overload.py): Server busy, then
+        the normal close path — persistent sessions keep their backlog,
+        QoS>=1 inflight re-queues, nothing acked is lost."""
+        if self.closed:
+            return
+        if self.proto_ver == PROTO_5:
+            self.send(Disconnect(reason_code=RC_SERVER_BUSY))
+            self._count_disconnect_sent(RC_SERVER_BUSY)
+        await self.close("overload_shed")
 
     async def takeover_close(self) -> None:
         """Kicked by a newer session with the same client id."""

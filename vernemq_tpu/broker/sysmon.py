@@ -105,8 +105,15 @@ class Sysmon:
             await asyncio.sleep(self.interval)
             lag = time.monotonic() - t0 - self.interval
             self.observe_lag(lag)
+            gov = getattr(self.broker, "overload", None)
+            if gov is not None:
+                # feed the governor's lag-EWMA signal (it recomputes the
+                # level inline so the L1 response lands this sample)
+                gov.observe_lag(lag)
             if self.memory_high_watermark:
                 rss = rss_bytes()
+                if gov is not None:
+                    gov.observe_rss(rss, self.memory_high_watermark)
                 if rss > self.memory_high_watermark:
                     self.gc_forced += 1
                     self.broker.metrics.incr("sysmon_large_heap")

@@ -573,6 +573,8 @@ class Cluster:
         where no reconnect ever fires the channel-up replay."""
         interval = self.broker.config.get(
             "cluster_spool_retransmit_ms", 1000) / 1000.0
+        burst = int(self.broker.config.get(
+            "cluster_spool_replay_burst", 512))
         while True:
             await asyncio.sleep(interval)
             try:
@@ -584,7 +586,11 @@ class Cluster:
                     if (w is not None and w.status == "up"
                             and time.monotonic() - st.last_ack_at
                             >= interval):
-                        self.spool.replay(node, w.send_frame)
+                        # budgeted: at most `burst` frames per tick from
+                        # the per-peer cursor — linear wire cost through
+                        # a long storm (cursor-based partial replay)
+                        self.spool.replay(node, w.send_frame,
+                                          budget=burst or None)
             except Exception:
                 # a transient journal/IO error must not kill the
                 # watchdog — it is the only replay trigger for

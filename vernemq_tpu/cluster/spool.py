@@ -81,6 +81,9 @@ class _MemJournal:
     def put_many(self, pairs) -> None:
         self._d.update(dict(pairs))
 
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._d.get(key)
+
     def delete(self, key: bytes) -> None:
         self._d.pop(key, None)
 
@@ -177,6 +180,9 @@ class _FileJournal:
         self._fh.flush()
         self._maybe_compact()
 
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._d.get(key)
+
     def scan(self, prefix: bytes = b"") -> List[Tuple[bytes, bytes]]:
         return sorted((k, v) for k, v in self._d.items()
                       if k.startswith(prefix))
@@ -205,7 +211,8 @@ class _FileJournal:
 class _PeerState:
     """Per-peer spool bookkeeping (all event-loop-thread)."""
 
-    __slots__ = ("next_seq", "pending", "bytes", "blocked", "last_ack_at")
+    __slots__ = ("next_seq", "pending", "bytes", "blocked", "last_ack_at",
+                 "cursor")
 
     def __init__(self) -> None:
         self.next_seq = 1
@@ -217,6 +224,9 @@ class _PeerState:
         # a replay resyncs the stream
         self.blocked = False
         self.last_ack_at = 0.0
+        # budgeted-replay resume point (next seq the watchdog ships);
+        # 0 = start a fresh sweep at the lowest pending seq
+        self.cursor = 0
 
 
 class ClusterSpool:
@@ -340,8 +350,9 @@ class ClusterSpool:
                 st.blocked = False
         return n
 
-    def replay(self, peer: str, send: Callable[[bytes], bool]) -> int:
-        """Resend every unacked frame for ``peer`` in seq order (channel
+    def replay(self, peer: str, send: Callable[[bytes], bool],
+               budget: Optional[int] = None) -> int:
+        """Resend unacked frames for ``peer`` in seq order (channel
         re-establishment / retransmit timer / buffer-drain resync),
         preceded by an ``msb`` stream-base frame: pending is always a
         contiguous run [low..high] (acks are cumulative), and the base
@@ -350,21 +361,59 @@ class ClusterSpool:
         missed the first batch could ack past frames it never saw.
         Frames the receiver did get are absorbed by its dedup state.
         ``send`` returning False (writer buffer full) pauses the stream
-        blocked — a later replay picks it up."""
+        blocked — a later replay picks it up.
+
+        Without ``budget`` the whole backlog ships (the channel-up
+        resync — a reconnected peer needs everything). With ``budget``
+        (the retransmit watchdog, ``cluster_spool_replay_burst``) at
+        most that many frames ship per call, resuming at the per-peer
+        cursor where the previous call stopped: a long partition at
+        high publish rates pays linear wire cost across ticks instead
+        of re-shipping the whole journal every ``retransmit_ms``. An
+        ack advancing past the cursor restarts the sweep at the new
+        lowest pending seq (the head is what the receiver is missing —
+        its ack IS the cursor acknowledgement)."""
         st = self._peers.get(peer)
         if st is None or not st.pending:
             return 0
-        if not send(frame(b"msb", next(iter(st.pending)))):
+        low = next(iter(st.pending))
+        start = low
+        if budget is not None and budget > 0:
+            if low < st.cursor <= next(reversed(st.pending)):
+                start = st.cursor
+        else:
+            budget = None  # 0/None = unbudgeted full sweep
+        if not send(frame(b"msb", low)):
             st.blocked = True
             return 0
+        # pending is a CONTIGUOUS seq run [low..high] (acks are
+        # cumulative), so the sweep walks seqs directly and point-reads
+        # the journal — O(frames shipped) per call, never a full
+        # journal scan+sort per watchdog tick (the host-side half of
+        # the quadratic-storm cost the budget bounds on the wire)
+        pk = _peer_key(peer)
+        high = next(reversed(st.pending))
         sent = 0
-        for _key, data in self._kv.scan(b"s" + _peer_key(peer)):
+        exhausted = False
+        completed = True
+        for seq in range(start, high + 1):
+            if budget is not None and sent >= budget:
+                st.cursor = seq  # resume here next tick
+                exhausted = True
+                completed = False
+                break
+            data = self._kv.get(b"s" + pk + seq.to_bytes(8, "big"))
+            if data is None:
+                continue  # defensive: acked/flushed under our feet
             if not send(data):
                 st.blocked = True
+                completed = False
                 break
             sent += 1
-        else:
+        if completed:
             st.blocked = False
+        if not exhausted:
+            st.cursor = 0  # sweep finished (or pausing): restart at low
         if sent:
             st.last_ack_at = time.monotonic()
             self.metrics.incr("cluster_spool_replayed", sent)
@@ -390,6 +439,7 @@ class ClusterSpool:
             st.pending.clear()
             st.bytes = 0
             st.blocked = False
+            st.cursor = 0
         return frames, nbytes
 
     # ------------------------------------------------------- introspection
@@ -415,6 +465,7 @@ class ClusterSpool:
                 "pending_bytes": st.bytes,
                 "next_seq": st.next_seq,
                 "lowest_unacked": next(iter(st.pending), None),
+                "replay_cursor": st.cursor or None,
                 "blocked": st.blocked,
             })
         return out

@@ -1477,7 +1477,8 @@ class BatchCollector:
 
     def __init__(self, view: TpuRegView, window_us: int = 200,
                  max_batch: int = 4096, host_threshold: int = 8,
-                 lock_busy_shed_ms: int = 500, super_batch_k: int = 8):
+                 lock_busy_shed_ms: int = 500, super_batch_k: int = 8,
+                 latency_budget_ms: float = 50.0):
         self.view = view
         self.window = window_us / 1e6
         self.max_batch = max_batch
@@ -1504,6 +1505,11 @@ class BatchCollector:
         self.host_hybrid_pubs = 0
         self.saturated_merges = 0  # flushes deferred into a later batch
         self.overload_host_pubs = 0  # shed to the host trie at overload
+        # dispatch-latency EWMA (ms, flush start -> results settled) and
+        # the budget it is judged against: the overload governor's
+        # device-path pressure signal (robustness/overload.py)
+        self.latency_budget_ms = latency_budget_ms
+        self.dispatch_ewma_ms = 0.0
         self.rebuild_host_pubs = 0  # served by the trie during a rebuild
         self.busy_host_pubs = 0  # served by the trie past the lock bound
         self.degraded_host_pubs = 0  # trie-served while the breaker is open
@@ -1519,6 +1525,21 @@ class BatchCollector:
         import collections as _collections
 
         self._order: "_collections.deque" = _collections.deque()
+
+    def pressure(self) -> float:
+        """Device-path pressure in [0, 1] for the overload governor:
+        queue depth against the overload shed bound (K super-batch
+        windows — the point submit() starts shedding to the trie) plus
+        the dispatch-latency EWMA, fused by the shared
+        overload.collector_pressure rule (latency caps below the L1
+        gate: slow-but-covered dispatch is reduced headroom, not
+        overload — only depth may escalate)."""
+        from ..robustness.overload import collector_pressure
+
+        return collector_pressure(
+            len(self._pending),
+            self.max_batch * max(1, self.super_batch_k),
+            self.dispatch_ewma_ms, self.latency_budget_ms)
 
     def _many_capable(self, mountpoint: str) -> bool:
         """Can this mountpoint's flushes amortize as super-batches RIGHT
@@ -1669,6 +1690,7 @@ class BatchCollector:
         reference's per-connection process — it must never wait on the
         matcher)."""
         loop = asyncio.get_event_loop()
+        flush_t0 = time.perf_counter()
         # group by mountpoint (typically one)
         by_mp: Dict[str, List[Tuple[Tuple[str, ...], asyncio.Future]]] = {}
         for mp, topic, fut in pending:
@@ -1743,3 +1765,9 @@ class BatchCollector:
                 continue
             for (_, fut), rows in zip(items, results):
                 self._settle(fut, res=rows)
+        # overload-signal EWMA: whole-flush service time (shed/degraded
+        # paths included — a slow fallback is pressure too)
+        from ..robustness.overload import fold_latency_ewma
+
+        self.dispatch_ewma_ms = fold_latency_ewma(
+            self.dispatch_ewma_ms, (time.perf_counter() - flush_t0) * 1e3)

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..models.tpu_matcher import DeviceDegraded, MatcherBusy, \
@@ -33,8 +34,14 @@ class RetainedBatchCollector:
     #: encode/prep overlaps batch N's device time, like the publish path)
     MAX_INFLIGHT = 2
 
+    #: consecutive overload deferrals before a flush goes out anyway —
+    #: deferral trades replay latency for publish headroom, it must
+    #: never starve replays outright
+    MAX_DEFERS = 8
+
     def __init__(self, engine, store, window_us: int = 500,
-                 max_batch: int = 1024, host_threshold: int = 4):
+                 max_batch: int = 1024, host_threshold: int = 4,
+                 latency_budget_ms: float = 50.0):
         self.engine = engine
         self.store = store
         self.window = window_us / 1e6
@@ -44,6 +51,15 @@ class RetainedBatchCollector:
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         self._inflight = 0
         self._closed = False
+        # overload governor hooks (robustness/overload.py): pressure()
+        # feeds the fused signal; defer_gate (set by the broker) returns
+        # True at L2+ — replay storms then wait out the congestion
+        self.latency_budget_ms = latency_budget_ms
+        self.dispatch_ewma_ms = 0.0
+        self.defer_gate = None
+        self.deferred_flushes = 0
+        self._defers_in_row = 0
+        self._defer_armed = False  # a stretched window is pending
         # observability (exposed as broker gauges)
         self.device_batches = 0       # flushes served by the device path
         self.device_filters = 0
@@ -74,6 +90,12 @@ class RetainedBatchCollector:
             return fut
         self._pending.append((mountpoint, tuple(filter_words), fut))
         if len(self._pending) >= self.max_batch:
+            if self._defer_armed:
+                # an L2+ deferral is waiting out the congestion: more
+                # arrivals must not re-trigger the flush path, or every
+                # storm submit would consume one of the MAX_DEFERS and
+                # burn through the deferral in microseconds
+                return fut
             if self._flush_handle is not None:
                 self._flush_handle.cancel()
                 self._flush_handle = None
@@ -90,10 +112,38 @@ class RetainedBatchCollector:
         except Exception as e:
             fut.set_exception(e)
 
+    def pressure(self) -> float:
+        """Replay-path pressure in [0, 1] for the overload governor:
+        depth against two full batches (past that, subscribe storms are
+        queueing faster than the device serves) plus the dispatch
+        latency EWMA, fused by the shared overload.collector_pressure
+        rule (latency caps below the L1 gate — slow-but-covered
+        dispatch is reduced headroom, not overload)."""
+        from ..robustness.overload import collector_pressure
+
+        return collector_pressure(
+            len(self._pending), self.max_batch * self.MAX_INFLIGHT,
+            self.dispatch_ewma_ms, self.latency_budget_ms)
+
     def _flush(self) -> None:
         self._flush_handle = None
+        self._defer_armed = False
         if not self._pending:
             return
+        if (self.defer_gate is not None
+                and self._defers_in_row < self.MAX_DEFERS
+                and len(self._pending) > self.host_threshold
+                and self.defer_gate()):
+            # L2+ deferral: the replay storm re-arms a stretched window
+            # instead of competing with live publishes for the device;
+            # bounded so a pinned level can't starve replays forever
+            self._defers_in_row += 1
+            self.deferred_flushes += 1
+            self._defer_armed = True
+            self._flush_handle = asyncio.get_event_loop().call_later(
+                self.window * 8, self._flush)
+            return
+        self._defers_in_row = 0
         if len(self._pending) <= self.host_threshold:
             pending, self._pending = self._pending, []
             self.host_hybrid_filters += len(pending)
@@ -124,6 +174,7 @@ class RetainedBatchCollector:
 
     async def _flush_async(self, pending) -> None:
         loop = asyncio.get_event_loop()
+        flush_t0 = time.perf_counter()
         by_mp: Dict[str, List[Tuple[Tuple[str, ...], asyncio.Future]]] = {}
         for mp, fw, fut in pending:
             by_mp.setdefault(mp, []).append((fw, fut))
@@ -164,9 +215,14 @@ class RetainedBatchCollector:
                     fut.set_result(rows)
                 if (i + 1) % 256 == 0:
                     await asyncio.sleep(0)
+        from ..robustness.overload import fold_latency_ewma
+
+        self.dispatch_ewma_ms = fold_latency_ewma(
+            self.dispatch_ewma_ms, (time.perf_counter() - flush_t0) * 1e3)
 
     def stats(self) -> Dict[str, float]:
         return {
+            "retained_replay_deferred_flushes": self.deferred_flushes,
             "retained_replay_device_batches": self.device_batches,
             "retained_replay_device_filters": self.device_filters,
             "retained_replay_host_filters": self.host_hybrid_filters,

@@ -12,9 +12,15 @@ package supplies the two halves of that story:
 - :mod:`breaker` — the :class:`~breaker.CircuitBreaker` the matchers put
   around device dispatch: N consecutive failures open it, matching
   serves from the exact host trie (degraded mode), a half-open probe
-  with exponential backoff + jitter brings the device path back.
+  with exponential backoff + jitter brings the device path back;
+- :mod:`overload` — the :class:`~overload.OverloadGovernor` fusing
+  loop-lag/RSS/collector-depth/breaker/cluster signals into pressure
+  levels 0-3 with staged, cheapest-first shedding (proportional read
+  throttle → token buckets + QoS0 shed + replay deferral → connect
+  refusal + top-talker disconnects).
 """
 
 from . import faults  # noqa: F401
 from .breaker import CircuitBreaker  # noqa: F401
 from .faults import FaultPlan, FaultRule, InjectedFault  # noqa: F401
+from .overload import OverloadGovernor  # noqa: F401
