@@ -934,6 +934,277 @@ def config8_retained_storm(rng, smoke, n_retained=None, batch=None,
     }
 
 
+def config10_stall_storm(smoke):
+    """Stall storm: SILENT hangs (wedge faults — no exception, the call
+    just never returns) at device.dispatch and cluster.recv under load.
+
+    Segment A (device): a full broker on the tpu reg view with wedges
+    injected at every device dispatch. Pre-watchdog this was an
+    unbounded stall — the matcher's executor call never returned, the
+    collector slot wedged forever, publishes queued without limit. With
+    the deadline watchdog, every publish is answered by the exact host
+    trie within `watchdog_dispatch_deadline_ms` + the collector-expiry
+    ε: the bench asserts the storm p99 stays under that bound
+    (`p99_bounded`), that fanouts are bit-exact with zero duplicates
+    through abandon/late-discard (`parity_ok`), that the breaker opens,
+    and that clearing the faults recovers the device path without a
+    restart (`device_recovery_s`).
+
+    Segment B (cluster): a half-open peer — inbound frames AND acks
+    dropped via cluster.recv while the TCP channel stays up, so no
+    exception ever fires. The ack-progress stall detector cycles the
+    channel (`stall_reconnects`); on heal the spool replays with zero
+    QoS1 loss (`cluster_zero_loss`)."""
+    import asyncio
+    import tempfile
+
+    deadline_ms = 300.0
+    expiry_budgets = 4
+    budget_ms = 50.0
+
+    async def device_segment():
+        from vernemq_tpu.broker.config import Config
+        from vernemq_tpu.broker.server import start_broker
+        from vernemq_tpu.client import MQTTClient
+        from vernemq_tpu.robustness import faults
+
+        n_storm = 12 if smoke else 60
+        cfg = Config(
+            allow_anonymous=True, systree_enabled=False,
+            default_reg_view="tpu", tpu_host_batch_threshold=0,
+            tpu_lock_busy_shed_ms=0,
+            watchdog_tick_ms=20,
+            watchdog_dispatch_deadline_ms=deadline_ms,
+            watchdog_collector_expiry_budgets=expiry_budgets,
+            overload_dispatch_budget_ms=budget_ms,
+            tpu_breaker_failure_threshold=2,
+            tpu_breaker_backoff_initial_ms=50,
+            tpu_breaker_backoff_max_ms=200)
+        broker, server = await start_broker(cfg, port=0,
+                                            node_name="stall-bench")
+        sub = MQTTClient("127.0.0.1", server.port, client_id="st-sub")
+        await sub.connect()
+        await sub.subscribe("sb/+/t", qos=1)
+        await sub.subscribe("sb/#", qos=1)
+        pub = MQTTClient("127.0.0.1", server.port, client_id="st-pub")
+        await pub.connect()
+
+        # warm the device path first: with the cold-compile gate off
+        # (lock_busy_shed_ms=0) the first dispatch carries the XLA
+        # compile, which the deadline rightly abandons — the storm must
+        # wedge WARM dispatches or it measures the cold abandon instead
+        matcher = broker.registry.reg_view("tpu").matcher("")
+        warm_deadline = time.perf_counter() + 120
+        seq = 0
+        while (matcher.match_batches == 0
+               or matcher.breaker.state_name != "closed"):
+            if time.perf_counter() > warm_deadline:
+                break
+            await pub.publish("sb/w/t", b"w%d" % seq, qos=0)
+            for _ in range(2):
+                try:
+                    await sub.recv(2)
+                except asyncio.TimeoutError:
+                    break
+            seq += 1
+            await asyncio.sleep(0.05)
+        healthy_lat = []
+        for i in range(8):
+            t0 = time.perf_counter()
+            await pub.publish(f"sb/h{i}/t", b"h%d" % i, qos=1, timeout=30)
+            healthy_lat.append(time.perf_counter() - t0)
+        for _ in range(16):
+            await sub.recv(10)
+
+        # the storm: EVERY device dispatch wedges (probability 1); the
+        # breaker gate bounds how many dispatches actually block —
+        # after it opens the trie serves directly
+        faults.install(faults.FaultPlan(
+            [faults.FaultRule("device.dispatch", kind="wedge")], seed=10))
+        storm_lat = []
+        got = {}
+        for i in range(n_storm):
+            t0 = time.perf_counter()
+            await pub.publish(f"sb/{i}/t", b"s%d" % i, qos=1, timeout=30)
+            storm_lat.append(time.perf_counter() - t0)
+            await asyncio.sleep(0.005)
+        deadline_drain = time.perf_counter() + 30
+        while (sum(got.values()) < 2 * n_storm
+               and time.perf_counter() < deadline_drain):
+            try:
+                m = await sub.recv(2)
+            except asyncio.TimeoutError:
+                break
+            if m.payload.startswith(b"s"):
+                got[m.payload] = got.get(m.payload, 0) + 1
+        breaker_during = matcher.breaker.state_name
+        wedged = faults.active().status()["wedged"]
+
+        # recovery: release the wedges, probes close the breaker
+        faults.clear()
+        rec_t0 = time.perf_counter()
+        recovery_s = None
+        seq = 0
+        while time.perf_counter() - rec_t0 < 30:
+            await pub.publish(f"sb/r{seq}/t", b"r", qos=0)
+            seq += 1
+            if matcher.breaker.state_name == "closed":
+                recovery_s = time.perf_counter() - rec_t0
+                break
+            await asyncio.sleep(0.05)
+        # quiet drain so trailing duplicates (there must be none from
+        # abandoned dispatches) land in the counts
+        while True:
+            try:
+                m = await sub.recv(0.5)
+            except asyncio.TimeoutError:
+                break
+            if m.payload.startswith(b"s"):
+                got[m.payload] = got.get(m.payload, 0) + 1
+
+        wd = broker.watchdog.stats()
+        col = broker.batch_collector()
+        out_dev = {
+            "storm_publishes": n_storm,
+            "wedges_engaged": int(wedged),
+            "stalls": int(wd["watchdog_stalls"]),
+            "abandoned": int(wd["watchdog_abandoned"]),
+            "late_discarded": int(wd["watchdog_late_discarded"]),
+            "stalled_host_pubs": col.stalled_host_pubs,
+            "expired_host_pubs": col.expired_host_pubs,
+            "breaker_state_during_storm": breaker_during,
+            "got": got,
+            "healthy_lat": healthy_lat, "storm_lat": storm_lat,
+            "device_recovery_s": (round(recovery_s, 3)
+                                  if recovery_s is not None else None),
+        }
+        await sub.close()
+        await pub.close()
+        await broker.stop()
+        await server.stop()
+        return out_dev
+
+    async def cluster_segment():
+        from vernemq_tpu.broker.config import Config
+        from vernemq_tpu.broker.server import start_broker
+        from vernemq_tpu.client import MQTTClient
+        from vernemq_tpu.cluster import Cluster
+        from vernemq_tpu.robustness import faults
+
+        n_msgs = 8 if smoke else 40
+        tmp = tempfile.mkdtemp(prefix="vmq-stall-bench-")
+        nodes = []
+        for i in range(2):
+            cfg = Config(systree_enabled=False, allow_anonymous=True,
+                         allow_publish_during_netsplit=True,
+                         cluster_spool_dir=f"{tmp}/node{i}",
+                         cluster_spool_retransmit_ms=100,
+                         cluster_spool_ack_interval=20,
+                         cluster_stall_timeout_s=0.5)
+            broker, server = await start_broker(cfg, port=0,
+                                                node_name=f"node{i}")
+            broker.node_name = broker.metadata.node_name = f"node{i}"
+            broker.registry.node_name = f"node{i}"
+            broker.registry.db.node_name = f"node{i}"
+            cluster = Cluster(broker, "127.0.0.1", 0)
+            await cluster.start()
+            nodes.append((broker, server, cluster))
+        a, b = nodes
+        b[2].join(a[2].listen_host, a[2].listen_port)
+        while not (len(a[2].members()) == 2 and a[2].is_ready()
+                   and b[2].is_ready()):
+            await asyncio.sleep(0.02)
+        sub = MQTTClient("127.0.0.1", b[1].port, client_id="as-sub")
+        await sub.connect()
+        await sub.subscribe("as/#", qos=1)
+        while len(a[0].registry.trie("").match(["as", "x"])) != 1:
+            await asyncio.sleep(0.02)
+        while "spool" not in a[2]._peer_caps.get("node1", ()):
+            await asyncio.sleep(0.02)
+        pub = MQTTClient("127.0.0.1", a[1].port, client_id="as-pub")
+        await pub.connect()
+
+        # half-open: inbound (frames AND acks) dropped, channel "up"
+        faults.install(faults.FaultPlan(
+            [faults.FaultRule("cluster.recv", kind="error")], seed=12))
+        for i in range(n_msgs):
+            await pub.publish(f"as/{i}", b"c%d" % i, qos=1)
+        stall_t0 = time.perf_counter()
+        while (a[0].metrics.value("cluster_stall_reconnects") < 1
+               and time.perf_counter() - stall_t0 < 20):
+            await asyncio.sleep(0.05)
+        detect_s = time.perf_counter() - stall_t0
+        reconnects = a[0].metrics.value("cluster_stall_reconnects")
+
+        faults.clear()
+        got = {}
+        heal_t0 = time.perf_counter()
+        while (len(got) < n_msgs
+               and time.perf_counter() - heal_t0 < 30):
+            try:
+                m = await sub.recv(5)
+            except asyncio.TimeoutError:
+                break
+            got[m.payload] = got.get(m.payload, 0) + 1
+        while True:
+            try:
+                m = await sub.recv(0.5)
+            except asyncio.TimeoutError:
+                break
+            got[m.payload] = got.get(m.payload, 0) + 1
+        replay_s = time.perf_counter() - heal_t0
+
+        await sub.disconnect()
+        await pub.disconnect()
+        for broker, server, cluster in nodes:
+            await cluster.stop()
+            await broker.stop()
+            await server.stop()
+        expect = {b"c%d" % i for i in range(n_msgs)}
+        return {
+            "msgs": n_msgs,
+            "stall_reconnects": int(reconnects),
+            "stall_detect_s": round(detect_s, 3),
+            "replay_s": round(replay_s, 3),
+            "missing": len(expect - set(got)),
+            "duplicates": sum(c - 1 for c in got.values()),
+        }
+
+    dev = asyncio.run(device_segment())
+    clu = asyncio.run(cluster_segment())
+
+    def pct(lats, q):
+        lats = sorted(lats)
+        return round(lats[min(len(lats) - 1, int(q * len(lats)))] * 1e3, 3)
+
+    n_storm = dev["storm_publishes"]
+    got = dev.pop("got")
+    healthy_lat = dev.pop("healthy_lat")
+    storm_lat = dev.pop("storm_lat")
+    # both filters ("sb/+/t" and "sb/#") match every storm publish:
+    # exactly 2 deliveries per payload — fewer is loss, more means an
+    # abandoned dispatch's stale fanout leaked through the discard
+    expect = {b"s%d" % i for i in range(n_storm)}
+    missing = sum(1 for p in expect if got.get(p, 0) < 2)
+    dupes = sum(max(0, c - 2) for c in got.values())
+    bound_ms = deadline_ms + expiry_budgets * budget_ms + 1000.0  # + slack
+    p99 = pct(storm_lat, 0.99)
+    return {
+        **dev,
+        "healthy_publish_ms_p99": pct(healthy_lat, 0.99),
+        "storm_publish_ms_p50": pct(storm_lat, 0.50),
+        "storm_publish_ms_p99": p99,
+        "deadline_plus_eps_ms": bound_ms,
+        "p99_bounded": p99 <= bound_ms,
+        "missing": missing, "duplicates": dupes,
+        "parity_ok": missing == 0 and dupes == 0,
+        "cluster": clu,
+        "cluster_zero_loss": (clu["missing"] == 0
+                              and clu["duplicates"] == 0
+                              and clu["stall_reconnects"] >= 1),
+    }
+
+
 def config9_overload_storm(smoke):
     """Overload storm: offered load past capacity, naive binary shedding
     vs the adaptive governor (robustness/overload.py).
@@ -1173,7 +1444,7 @@ def main() -> int:
     ap.add_argument("--stack", type=int, default=8,
                     help="batches per executable for --variant "
                     "packed_stack")
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9",
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10",
                     help="which BASELINE configs to run (3 = headline; "
                     "6 = fault-storm robustness: publish p99 while the "
                     "device path is down + breaker recovery time; "
@@ -1427,6 +1698,10 @@ def main() -> int:
     if "9" in want:
         guarded("9_overload_storm",
                 lambda: config9_overload_storm(smoke))
+
+    if "10" in want:
+        guarded("10_stall_storm",
+                lambda: config10_stall_storm(smoke))
 
     if headline is not None:
         value = headline["matches_per_sec"]

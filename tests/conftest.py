@@ -30,6 +30,21 @@ import _hypothesis_shim  # noqa: E402
 
 _hypothesis_shim.install()
 
+# Hung-test forensics: a test that wedges (a real stall the watchdog
+# misses, a deadlock in test plumbing) used to die SILENTLY at the
+# outer `timeout -k 10 870` wall with no clue which test or thread
+# hung. With TIER1_FAULTHANDLER_S set (tools/run_tier1.sh sets it just
+# below the outer wall), faulthandler dumps every thread's stack to
+# stderr at that mark — the run still gets killed, but the log says
+# where it was stuck. repeat=True keeps dumping if the hang persists.
+import faulthandler  # noqa: E402
+
+_dump_after = int(os.environ.get("TIER1_FAULTHANDLER_S") or 0)
+if _dump_after > 0:
+    faulthandler.enable()
+    faulthandler.dump_traceback_later(_dump_after, repeat=True,
+                                      exit=False)
+
 # ---------------------------------------------------------------------------
 # Minimal async-test support (pytest-asyncio is not in the image): async test
 # functions run on a per-test event loop; fixtures get the same loop via the

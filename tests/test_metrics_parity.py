@@ -129,6 +129,45 @@ async def test_overload_and_sysmon_hysteresis_metrics_exposed():
 
 
 @pytest.mark.asyncio
+async def test_watchdog_and_stall_metrics_exposed():
+    """The stall-watchdog family is first-class: every name appears in
+    the Prometheus scrape with non-empty HELP text AND in all_metrics()
+    (the $SYS systree feed) — same discipline as the overload family."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+
+    names = (
+        # watchdog gauges (robustness/watchdog.py stats)
+        "watchdog_stalls", "watchdog_abandoned",
+        "watchdog_late_discarded", "watchdog_cluster_stalls",
+        "watchdog_inflight_ops", "watchdog_inflight_age_max",
+        "watchdog_sacrificed_threads",
+        # wedge-fault accounting (robustness/faults.py stats)
+        "faults_wedged_now", "faults_wedge_releases",
+        # channel-cycle counter (metrics.COUNTERS)
+        "cluster_stall_reconnects",
+    )
+    cfg = Config(systree_enabled=False, allow_anonymous=True)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        text = broker.metrics.prometheus_text(node=broker.node_name)
+        am = broker.metrics.all_metrics()
+        for name in names:
+            assert f"\n{name}{{" in text or text.startswith(
+                f"{name}{{"), f"{name} not scraped"
+            help_line = next(
+                (line for line in text.splitlines()
+                 if line.startswith(f"# HELP {name} ")), None)
+            assert help_line is not None, f"{name} has no HELP"
+            assert len(help_line) > len(f"# HELP {name} "), \
+                f"{name} HELP text empty"
+            assert name in am, f"{name} missing from $SYS metrics"
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_per_reason_families_count():
     """The per-reason-code families actually count: a v4 accepted CONNACK
     hits both the flat per-reason counter and the labeled family; an

@@ -12,7 +12,13 @@ duration. Flags, inside any ``async def`` in ``vernemq_tpu/``:
   call (push it behind ``run_in_executor`` or a sync helper that the
   loop calls knowingly — a *named* helper documents the stall, a bare
   ``open`` in an async body is almost always an accident);
-- ``input(...)`` (never legal on the loop).
+- ``input(...)`` (never legal on the loop);
+- unbounded waits that the stall watchdog cannot release: a bare
+  ``<lock>.acquire()`` with no ``timeout=``/``blocking=False``, a
+  ``<future>.result()`` with no timeout, and a no-argument
+  ``<queue>.get()`` — each parks the LOOP behind another thread's
+  progress forever if that thread wedges (``dict.get(key)`` and
+  bounded variants are not flagged).
 
 Nested synchronous ``def``s inside an async function are NOT flagged
 (they may run anywhere — an executor, a thread); nested async defs are
@@ -51,6 +57,38 @@ def _call_name(node: ast.Call):
     return None
 
 
+def _unbounded_wait(node: ast.Call):
+    """Detect unbounded cross-thread waits by METHOD SHAPE (the receiver
+    may be any expression, so typing is out of reach for an AST pass):
+
+    - ``x.acquire()`` with neither a positional ``blocking`` arg nor a
+      ``timeout=``/``blocking=`` kwarg — ``threading.Lock.acquire``'s
+      forever-blocking form (``acquire(False)`` and
+      ``acquire(timeout=...)`` are bounded);
+    - ``x.result()`` with no arguments — ``Future.result`` waiting
+      forever on another thread;
+    - ``x.get()`` with NO positional arguments and no
+      ``timeout=``/``block=`` kwarg — ``queue.Queue.get``'s blocking
+      form. ``dict.get(key[, default])`` always has a positional arg,
+      so it never matches.
+
+    Returns the pretty spelling to report, or None."""
+    f = node.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    kw = {k.arg for k in node.keywords}
+    if f.attr == "acquire":
+        if not node.args and not ({"timeout", "blocking"} & kw):
+            return ".acquire()"
+    elif f.attr == "result":
+        if not node.args and "timeout" not in kw:
+            return ".result()"
+    elif f.attr == "get":
+        if not node.args and not kw:
+            return ".get()"
+    return None
+
+
 class _AsyncBodyVisitor(ast.NodeVisitor):
     """Walk ONE async function's body without descending into nested
     function definitions (each async def gets its own visitor from the
@@ -60,6 +98,14 @@ class _AsyncBodyVisitor(ast.NodeVisitor):
         self.findings = findings
         self.rel = rel
         self.allowed = allowed_lines
+        # directly-awaited calls are loop-FRIENDLY versions of the same
+        # spellings (asyncio.Queue.get, asyncio.Lock.acquire): exempt
+        self._awaited = set()
+
+    def visit_Await(self, node):  # noqa: N802
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
 
     def visit_FunctionDef(self, node):  # noqa: N802 — ast API
         pass  # nested sync def: not necessarily on the loop
@@ -69,6 +115,11 @@ class _AsyncBodyVisitor(ast.NodeVisitor):
 
     def visit_Call(self, node):  # noqa: N802
         name = _call_name(node)
+        if name == ("asyncio", "wait_for") or name == "wait_for":
+            # the wrapped awaitable is bounded by wait_for's timeout
+            for a in node.args:
+                if isinstance(a, ast.Call):
+                    self._awaited.add(id(a))
         bad = (name in _BAD_NAME if isinstance(name, str)
                else name in _BAD_ATTR)
         if bad and node.lineno not in self.allowed:
@@ -76,6 +127,14 @@ class _AsyncBodyVisitor(ast.NodeVisitor):
             self.findings.append(
                 f"{self.rel}:{node.lineno}: blocking call "
                 f"`{pretty}(...)` inside async def")
+        unbounded = (None if id(node) in self._awaited
+                     else _unbounded_wait(node))
+        if unbounded and node.lineno not in self.allowed:
+            self.findings.append(
+                f"{self.rel}:{node.lineno}: unbounded `{unbounded}` "
+                f"inside async def (no timeout= — a wedged holder "
+                f"parks the loop forever; bound it or mark "
+                f"`# {ALLOW_MARK}: <reason>`)")
         self.generic_visit(node)
 
 

@@ -30,8 +30,16 @@ fi
 # load shedding was (tools/lint_blocking.py)
 python tools/lint_blocking.py || exit 1
 
+# hung-test forensics: faulthandler dumps every thread's stack just
+# below the outer timeout wall (tests/conftest.py arms it), so a wedged
+# test prints WHERE it hung instead of dying silently at the kill.
+# Short walls keep a small margin so the dump still beats the SIGTERM;
+# non-positive disables (conftest skips arming).
+DUMP_S=${TIER1_FAULTHANDLER_S:-$((TIMEOUT > 60 ? TIMEOUT - 30 : TIMEOUT - 5))}
+
 rm -f "$LOG"
 timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+  TIER1_FAULTHANDLER_S="$DUMP_S" \
   python -m pytest tests/ -q "${EXTRA[@]}" \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
   -p no:randomly 2>&1 | tee "$LOG"

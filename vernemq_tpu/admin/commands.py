@@ -236,10 +236,15 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin api-key delete key=KEY")
     reg.register(["fault", "show"], _fault_show, "vmq-admin fault show")
     reg.register(["fault", "inject"], _fault_inject,
-                 "vmq-admin fault inject point=P [kind=error|latency|hang] "
-                 "[probability=1.0] [after=0] [count=-1] [latency-ms=0] "
-                 "[seed=0]")
+                 "vmq-admin fault inject point=P "
+                 "[kind=error|latency|hang|wedge] [probability=1.0] "
+                 "[after=0] [count=-1] [latency-ms=0] [seed=0]")
     reg.register(["fault", "clear"], _fault_clear, "vmq-admin fault clear")
+    reg.register(["fault", "release"], _fault_release,
+                 "vmq-admin fault release point=P  (free a wedge fault)")
+    reg.register(["watchdog", "show"], _watchdog_show,
+                 "vmq-admin watchdog show  (in-flight monitored ops, "
+                 "stall/abandon/late-discard counters)")
     reg.register(["breaker", "show"], _breaker_show,
                  "vmq-admin breaker show")
     reg.register(["breaker", "trip"], _breaker_trip,
@@ -931,7 +936,8 @@ def valid_api_key(broker, key: str) -> bool:
 # ------------------------------------------------- robustness (fault/breaker)
 
 def _fault_show(broker, flags):
-    """Active fault plan: rules, per-point hit counts, fired totals."""
+    """Active fault plan: rules, per-point hit counts, fired totals —
+    wedge entries/releases counted separately from latency/hang."""
     from ..robustness import faults
 
     plan = faults.active()
@@ -941,6 +947,48 @@ def _fault_show(broker, flags):
     rows = [{"rule": i, **r} for i, r in enumerate(st["rules"])]
     for point, hits in sorted(st["hits"].items()):
         rows.append({"rule": "", "point": point, "hits": hits})
+    rows.append({"rule": "", "point": "(wedges)",
+                 "hits": st["wedged"],
+                 "wedged_now": st["wedged_now"],
+                 "releases": st["wedge_releases"]})
+    return {"table": rows}
+
+
+def _fault_release(broker, flags):
+    """Free a wedge fault blocked at point=P (the operator half of the
+    escape path; the stall watchdog releases automatically at
+    abandonment)."""
+    from ..robustness import faults
+
+    point = flags.get("point")
+    if not isinstance(point, str):
+        raise CommandError("point=NAME required (e.g. device.dispatch)")
+    if faults.release(point):
+        return f"wedge at {point} released"
+    return f"no wedge blocked at {point}"
+
+
+def _watchdog_show(broker, flags):
+    """In-flight monitored operations + stall counters (the operator
+    face of robustness/watchdog.py)."""
+    wd = broker.watchdog
+    stats = wd.stats()
+    rows = [{"point": op["point"], "label": op["label"],
+             "age_s": op["age_s"], "deadline_s": op["deadline_s"],
+             "stalled": op["stalled"], "abandoned": op["abandoned"]}
+            for op in wd.inflight()]
+    if not rows:
+        rows = [{"point": "(none in flight)", "label": "", "age_s": 0.0,
+                 "deadline_s": 0.0, "stalled": False, "abandoned": False}]
+    rows.append({"point": "(totals)", "label": "",
+                 "age_s": stats["watchdog_inflight_age_max"],
+                 "deadline_s": 0.0,
+                 "stalled": int(stats["watchdog_stalls"]),
+                 "abandoned": int(stats["watchdog_abandoned"])})
+    rows.append({"point": "(late results discarded)", "label": "",
+                 "age_s": 0.0, "deadline_s": 0.0,
+                 "stalled": int(stats["watchdog_late_discarded"]),
+                 "abandoned": int(stats["watchdog_cluster_stalls"])})
     return {"table": rows}
 
 
@@ -962,8 +1010,8 @@ def _fault_inject(broker, flags):
         latency_ms=float(flags.get("latency_ms",
                                    flags.get("latency-ms", 0.0)) or 0.0),
     )
-    if rule.kind not in ("error", "latency", "hang"):
-        raise CommandError("kind must be error, latency or hang")
+    if rule.kind not in ("error", "latency", "hang", "wedge"):
+        raise CommandError("kind must be error, latency, hang or wedge")
     plan = faults.active()
     if plan is None:
         plan = faults.install(

@@ -66,6 +66,16 @@ class Broker:
 
             self.metadata = MetadataStore(node_name, persist_dir=persist_dir)
         self.cluster: Optional[Any] = None  # set by cluster.Cluster
+        # stall watchdog (robustness/watchdog.py): monitored-operation
+        # registry + sacrificial dispatch for every cross-boundary wait
+        # (device dispatch, rebuild threads, delta scatter, store
+        # writes, cluster ack progress). Created unconditionally so the
+        # gauges always exist; the monitor thread starts in start()
+        # when watchdog_enabled.
+        from ..robustness.watchdog import StallWatchdog
+
+        self.watchdog = StallWatchdog(
+            tick_s=self.config.get("watchdog_tick_ms", 100) / 1e3)
         self.retain = RetainStore(on_dirty=self._retain_dirty)
         # device-resident retained index (vernemq_tpu/retained/): created
         # lazily on the first replay once the tpu reg view is live; the
@@ -254,6 +264,57 @@ class Broker:
             "retained_replay_fallback_filters": "Per-filter device "
                                                 "escapes resolved "
                                                 "against the host store.",
+            "retained_replay_stalled_filters": "Replay filters the host "
+                                               "walk served after a "
+                                               "dispatch deadline "
+                                               "abandonment.",
+            "retained_replay_expired_filters": "Queued replay filters "
+                                               "host-served past their "
+                                               "collector expiry.",
+            "retained_dispatch_stalls": "Retained dispatches abandoned "
+                                        "at the watchdog deadline (fed "
+                                        "to the breaker).",
+            "retained_rebuild_abandons": "Wedged retained rebuilds "
+                                         "abandoned by the watchdog.",
+            # stall watchdog (robustness/watchdog.py): the silent-stall
+            # observability family — every cross-boundary wait registers
+            # here, overdue ops are counted/abandoned, late results of
+            # abandoned ops are discarded (never delivered)
+            "watchdog_stalls": "Monitored operations observed past "
+                               "their deadline.",
+            "watchdog_abandoned": "Stalled operations abandoned "
+                                  "(waiters released to the host "
+                                  "fallback; breaker fed).",
+            "watchdog_late_discarded": "Abandoned operations that "
+                                       "completed late; their results "
+                                       "were discarded, never "
+                                       "delivered.",
+            "watchdog_cluster_stalls": "Cluster channels cycled by "
+                                       "ack-progress stall detection.",
+            "watchdog_inflight_ops": "Monitored operations currently "
+                                     "in flight.",
+            "watchdog_inflight_age_max": "Age (seconds) of the oldest "
+                                         "in-flight monitored "
+                                         "operation.",
+            "watchdog_sacrificed_threads": "Executor workers lost to "
+                                           "abandoned (wedged) "
+                                           "dispatches; the pool "
+                                           "spawned around each.",
+            "faults_wedged_now": "Injection points currently blocked "
+                                 "in a wedge fault.",
+            "faults_wedge_releases": "Wedge faults released (watchdog "
+                                     "abandonment or `vmq-admin fault "
+                                     "release`).",
+            "tpu_stalled_host_pubs": "Publishes the host trie served "
+                                     "after a dispatch deadline "
+                                     "abandonment.",
+            "tpu_expired_host_pubs": "Queued publishes host-served "
+                                     "past their collector expiry.",
+            "tpu_dispatch_stalls": "Device dispatches abandoned at the "
+                                   "watchdog deadline (fed to the "
+                                   "breaker).",
+            "tpu_rebuild_abandons": "Wedged device-table rebuilds "
+                                    "abandoned by the watchdog.",
         })
 
     # ------------------------------------------------------------ plumbing
@@ -277,6 +338,7 @@ class Broker:
             out.update(self._retained_engine.stats())
         if self._retained_collector is not None:
             out.update(self._retained_collector.stats())
+        out.update(self.watchdog.stats())
         return out
 
     def cluster_ready(self) -> bool:
@@ -536,9 +598,15 @@ class Broker:
         try:
             # loop-side synchronous seam: injected latency models a slow
             # disk blocking the loop exactly like the real store would,
-            # but capped so a hang drill stays a stall, not an outage
-            faults.inject("store.write", max_delay_s=1.0)
-            self.msg_store.write(sid, msg)
+            # but capped so a hang drill stays a stall, not an outage.
+            # Registered with the stall watchdog for visibility — a
+            # synchronous loop-side write cannot be abandoned, but a
+            # stall here shows up in watchdog_stalls / `watchdog show`
+            # instead of reading as unexplained loop lag.
+            with self.watchdog.monitored("store.write", 2.0,
+                                         label=f"{sid[0]}/{sid[1]}"):
+                faults.inject("store.write", max_delay_s=1.0)
+                self.msg_store.write(sid, msg)
         except Exception:
             # degraded, not fatal: the in-memory queue still holds the
             # message, so live delivery is unaffected — only the
@@ -582,8 +650,30 @@ class Broker:
                 super_batch_k=self.config.tpu_super_batch_k,
                 latency_budget_ms=self.config.get(
                     "overload_dispatch_budget_ms", 50.0),
+                watchdog=self.watchdog,
+                dispatch_deadline_ms=self._dispatch_deadline_ms(),
+                item_expiry_ms=self._collector_expiry_ms(),
             )
         return self._collector
+
+    def _dispatch_deadline_ms(self) -> float:
+        """Device-dispatch abandon deadline (0 when the watchdog is
+        off: the pre-watchdog unbounded wait)."""
+        if not self.config.get("watchdog_enabled", True):
+            return 0.0
+        return float(self.config.get("watchdog_dispatch_deadline_ms",
+                                     5000))
+
+    def _collector_expiry_ms(self) -> float:
+        """Queued-item expiry: derived from the overload dispatch
+        budget so the bounded-tail guarantee tracks the same knob the
+        governor judges dispatch latency against."""
+        if not self.config.get("watchdog_enabled", True):
+            return 0.0
+        budgets = float(self.config.get(
+            "watchdog_collector_expiry_budgets", 4))
+        return budgets * float(self.config.get(
+            "overload_dispatch_budget_ms", 50.0))
 
     def retained_engine(self):
         """Lazy per-mountpoint device retained index (the reverse-match
@@ -605,6 +695,10 @@ class Broker:
                     "tpu_breaker_backoff_initial_ms", 200) / 1e3,
                 breaker_backoff_max=cfg.get(
                     "tpu_breaker_backoff_max_ms", 10_000) / 1e3,
+                watchdog=(self.watchdog
+                          if cfg.get("watchdog_enabled", True) else None),
+                rebuild_deadline_s=cfg.get(
+                    "watchdog_rebuild_deadline_s", 120.0),
             )
         return self._retained_engine
 
@@ -628,6 +722,9 @@ class Broker:
                 host_threshold=cfg.get("tpu_retained_host_threshold", 4),
                 latency_budget_ms=cfg.get(
                     "overload_dispatch_budget_ms", 50.0),
+                watchdog=self.watchdog,
+                dispatch_deadline_ms=self._dispatch_deadline_ms(),
+                item_expiry_ms=self._collector_expiry_ms(),
             )
             if self.overload is not None:
                 # L2 response: replay storms defer behind live publishes
@@ -800,6 +897,14 @@ class Broker:
                 await self.listeners.start_listener(
                     ln["kind"], ln.get("addr", "127.0.0.1"),
                     ln.get("port", 0), ln.get("opts"))
+        # stall watchdog: monitor thread scanning the monitored-op
+        # registry for overdue waits (robustness/watchdog.py). Started
+        # before the governor/sysmon so a wedge during boot warm-up is
+        # already observable.
+        if self.config.get("watchdog_enabled", True):
+            self.watchdog.tick_s = self.config.get(
+                "watchdog_tick_ms", 100) / 1e3
+            self.watchdog.start()
         # adaptive overload governor BEFORE sysmon so the lag sampler can
         # feed it from its very first sample (robustness/overload.py)
         from ..robustness.overload import OverloadGovernor
@@ -897,5 +1002,8 @@ class Broker:
         if (getattr(self, "_boot_fault_plan", None) is not None
                 and faults.active() is self._boot_fault_plan):
             faults.clear()
+        # after the collectors/views that dispatch through it are down;
+        # wedged sacrificial threads are daemons and die with the process
+        self.watchdog.stop()
         self.msg_store.close()
         self.metadata.close()

@@ -244,6 +244,35 @@ DEFAULTS: Dict[str, Any] = {
     "overload_l3_disconnect_top": 5,  # heaviest talkers shed at L3 entry
     # dispatch-latency EWMA budget for the collector pressure signal
     "overload_dispatch_budget_ms": 50.0,
+    # stall watchdog (robustness/watchdog.py): monitored-operation
+    # registry + deadline abandonment for SILENT failures — a device
+    # dispatch that never returns, a wedged rebuild thread, a half-open
+    # cluster peer whose acks stop. Off = stalls wedge exactly as far
+    # as their own seams (lock timeouts, injection caps) allow.
+    "watchdog_enabled": True,
+    "watchdog_tick_ms": 100,      # overdue-op scan interval
+    # device dispatch deadline: a collector flush whose device call has
+    # not returned by then is ABANDONED — the waiters are served by the
+    # exact host trie, the stall feeds the breaker, the wedged executor
+    # thread is sacrificed and its late result discarded. 0 disables
+    # (the pre-watchdog unbounded wait).
+    "watchdog_dispatch_deadline_ms": 5000,
+    # background device-table (re)build deadline: past it the build is
+    # abandoned like a failed one (breaker fed, host path serves, late
+    # install discarded). Generous — full builds at millions of rows
+    # legitimately take seconds; this catches WEDGES, not slowness.
+    "watchdog_rebuild_deadline_s": 120.0,
+    # queued-item expiry, in multiples of overload_dispatch_budget_ms:
+    # a publish/replay still queued in a collector after this many
+    # dispatch budgets is served by the host oracle even if every
+    # pipeline slot is wedged — the bounded-tail guarantee. 0 disables.
+    "watchdog_collector_expiry_budgets": 4,
+    # cluster connection-level stall detection: unacked spooled bytes
+    # with no cumulative-ack progress for this long cycle the channel
+    # (drop + reconnect + spool replay — loss-free by PR 3); catches
+    # half-open peers whose writes succeed but whose acks never arrive.
+    # 0 disables.
+    "cluster_stall_timeout_s": 10.0,
     "crl_refresh_interval": 60.0,  # seconds (vmq_crl_srv schema knob)
     "swc_replication_groups": 8,  # reference runs 10 (vmq_swc_plugin.erl:36-44)
     "swc_sync_interval": 2.0,  # seconds between AE rounds (sync_interval)

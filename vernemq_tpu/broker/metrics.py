@@ -109,6 +109,10 @@ COUNTERS: List[Tuple[str, str]] = [
     ("cluster_publish_drop",
      "Remote publish forwards dropped (buffer full / spool refused "
      "while the stream was paused)."),
+    ("cluster_stall_reconnects",
+     "Cluster channels cycled by the ack-progress stall detector "
+     "(unacked spooled bytes with no cumulative-ack progress for "
+     "cluster_stall_timeout_s; the spool replays on reconnect)."),
     ("netsplit_detected", "Netsplits detected."),
     ("netsplit_resolved", "Netsplits resolved."),
     ("router_matches_local", "Subscriptions matched for local delivery."),

@@ -234,6 +234,10 @@ class Registry:
             delta_warm_max=cfg.get("tpu_delta_warm_max", 128),
             initial_capacity=cfg.tpu_initial_capacity,
             mesh=self._mesh_from_config(),
+            watchdog=(self.broker.watchdog
+                      if cfg.get("watchdog_enabled", True) else None),
+            rebuild_deadline_s=cfg.get("watchdog_rebuild_deadline_s",
+                                       120.0),
         )
 
     def _mesh_from_config(self):
@@ -977,6 +981,12 @@ class Registry:
                 out["tpu_delta_shapes_warmed"] = \
                     out.get("tpu_delta_shapes_warmed", 0) \
                     + m.delta_shapes_warmed
+                # stall-watchdog fallout (abandoned dispatches fed to
+                # the breaker, wedged rebuilds reaped)
+                out["tpu_dispatch_stalls"] = \
+                    out.get("tpu_dispatch_stalls", 0) + m.dispatch_stalls
+                out["tpu_rebuild_abandons"] = \
+                    out.get("tpu_rebuild_abandons", 0) + m.rebuild_abandons
                 br = getattr(m, "breaker", None)
                 if br is not None:
                     # state: worst across mountpoints (0 closed, 1
@@ -1003,6 +1013,10 @@ class Registry:
             out["tpu_busy_shed_pubs"] = col.busy_host_pubs
             # pubs the trie served while the device breaker was open
             out["tpu_degraded_host_pubs"] = col.degraded_host_pubs
+            # pubs the trie served after a dispatch-deadline abandon /
+            # past their queued-item expiry (stall watchdog bounds)
+            out["tpu_stalled_host_pubs"] = col.stalled_host_pubs
+            out["tpu_expired_host_pubs"] = col.expired_host_pubs
         # deterministic fault-injection harness (robustness/faults.py)
         from ..robustness import faults as _faults
 

@@ -59,6 +59,19 @@ FLAT_ALIASES.update({
     )
 })
 
+#: extension family: the stall watchdog (robustness/watchdog.py) —
+#: deadline abandonment for silent stalls; same dotted-tree spelling
+#: discipline as overload.* above
+FLAT_ALIASES.update({
+    f"watchdog.{k[len('watchdog_'):]}": k
+    for k in (
+        "watchdog_enabled", "watchdog_tick_ms",
+        "watchdog_dispatch_deadline_ms", "watchdog_rebuild_deadline_s",
+        "watchdog_collector_expiry_budgets",
+    )
+})
+FLAT_ALIASES["watchdog.cluster_stall_timeout_s"] = "cluster_stall_timeout_s"
+
 #: reference knobs typed in MILLISECONDS whose internal knob is seconds
 MS_TO_SECONDS = {
     "systree_interval",

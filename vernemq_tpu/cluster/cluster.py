@@ -570,22 +570,82 @@ class Cluster:
         """Ack watchdog: frames unacked for a full interval are replayed
         over the LIVE channel — the recovery path for in-channel loss
         (injected ``cluster.recv`` drops, a receiver that lost the ack)
-        where no reconnect ever fires the channel-up replay."""
+        where no reconnect ever fires the channel-up replay.
+
+        Also the connection-level STALL detector: a peer with unacked
+        spooled bytes whose cumulative ack has made no progress for
+        ``cluster_stall_timeout_s`` is half-open — its TCP writes
+        succeed (retransmits included), its acks never arrive, and no
+        exception will ever fire. The channel is cycled (bounce →
+        reconnect → channel-up spool replay), which either lands on a
+        healthy connection or surfaces the peer as genuinely down; the
+        spool makes the cycle loss-free either way. Each stalled-capable
+        peer holds a monitored op in the broker's stall watchdog so the
+        wait is visible in `vmq-admin watchdog show`."""
         interval = self.broker.config.get(
             "cluster_spool_retransmit_ms", 1000) / 1000.0
         burst = int(self.broker.config.get(
             "cluster_spool_replay_burst", 512))
+        stall_s = float(self.broker.config.get(
+            "cluster_stall_timeout_s", 10.0) or 0.0)
+        wd = getattr(self.broker, "watchdog", None)
+        ack_ops: dict = {}  # peer -> MonitoredOp while acks are owed
+        try:
+            await self._spool_retransmit_ticks(interval, burst, stall_s,
+                                               wd, ack_ops)
+        finally:
+            if wd is not None:
+                for op in ack_ops.values():
+                    wd.deregister(op)
+
+    async def _spool_retransmit_ticks(self, interval, burst, stall_s,
+                                      wd, ack_ops) -> None:
         while True:
             await asyncio.sleep(interval)
             try:
                 for node in self.spool.peers():
                     st = self.spool.state(node)
                     if not st.pending or not self._peer_spools(node):
+                        op = ack_ops.pop(node, None)
+                        if op is not None and wd is not None:
+                            wd.deregister(op)
                         continue
                     w = self._writers.get(node)
+                    now = time.monotonic()
+                    if st.last_progress_at == 0.0:
+                        # journal recovered from disk before any live
+                        # traffic: start the progress clock now
+                        st.last_progress_at = now
+                    if wd is not None and stall_s > 0:
+                        op = ack_ops.get(node)
+                        if op is None:
+                            ack_ops[node] = wd.register(
+                                "cluster.ack", stall_s, label=node,
+                                started_at=st.last_progress_at)
+                        elif op.started_at != st.last_progress_at:
+                            wd.touch(op, st.last_progress_at)
+                    if (stall_s > 0 and w is not None
+                            and w.status == "up"
+                            and now - st.last_progress_at >= stall_s):
+                        self.metrics.incr("cluster_stall_reconnects")
+                        if wd is not None:
+                            wd.note_cluster_stall()
+                            op = ack_ops.pop(node, None)
+                            if op is not None:
+                                wd.abandon(op)
+                                wd.deregister(op)
+                        log.warning(
+                            "cluster channel to %s ack-stalled: %d "
+                            "frame(s)/%d byte(s) spooled with no "
+                            "cumulative-ack progress for %.1fs — "
+                            "cycling the connection (spool replays on "
+                            "reconnect)", node, len(st.pending),
+                            st.bytes, now - st.last_progress_at)
+                        st.last_progress_at = now  # full window for the
+                        w.bounce()                 # fresh connection
+                        continue
                     if (w is not None and w.status == "up"
-                            and time.monotonic() - st.last_ack_at
-                            >= interval):
+                            and now - st.last_ack_at >= interval):
                         # budgeted: at most `burst` frames per tick from
                         # the per-peer cursor — linear wire cost through
                         # a long storm (cursor-based partial replay)

@@ -112,6 +112,20 @@ class NodeWriter:
         if self._writer is not None:
             self._writer.close()
 
+    def bounce(self) -> None:
+        """Force-cycle the TCP connection (the ack-stall watchdog's
+        response to a half-open peer: writes keep succeeding into the
+        void while acks never arrive). The write loop observes the
+        loss, tears the socket down, and reconnects after the normal
+        delay — channel-up then replays the spool, so the cycle is
+        loss-free for QoS ≥ 1. No-op while already down (the reconnect
+        loop is the recovery path there)."""
+        if self._writer is None:
+            return
+        self._conn_lost = True
+        self._writer.close()
+        self._wakeup.set()
+
     # ----------------------------------------------------------------- send
 
     def send_frame(self, data: bytes, sheddable: bool = False) -> bool:

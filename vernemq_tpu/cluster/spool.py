@@ -212,7 +212,7 @@ class _PeerState:
     """Per-peer spool bookkeeping (all event-loop-thread)."""
 
     __slots__ = ("next_seq", "pending", "bytes", "blocked", "last_ack_at",
-                 "cursor")
+                 "cursor", "last_progress_at")
 
     def __init__(self) -> None:
         self.next_seq = 1
@@ -227,6 +227,12 @@ class _PeerState:
         # budgeted-replay resume point (next seq the watchdog ships);
         # 0 = start a fresh sweep at the lowest pending seq
         self.cursor = 0
+        # ack-PROGRESS clock for the connection-level stall detector:
+        # reset only when pending transitions empty→nonempty and when a
+        # cumulative ack actually trims — NOT by replays (a retransmit
+        # bumps last_ack_at, so a half-open peer that absorbs writes
+        # but never acks would look alive forever on that clock)
+        self.last_progress_at = 0.0
 
 
 class ClusterSpool:
@@ -323,6 +329,7 @@ class ClusterSpool:
         st.next_seq = seq + 1
         if not st.pending:
             st.last_ack_at = time.monotonic()
+            st.last_progress_at = st.last_ack_at
         st.pending[seq] = len(data)
         st.bytes += len(data)
         self._bytes += len(data)
@@ -346,6 +353,7 @@ class ClusterSpool:
             n += 1
         if n:
             st.last_ack_at = time.monotonic()
+            st.last_progress_at = st.last_ack_at
             if not st.pending:
                 st.blocked = False
         return n

@@ -28,7 +28,9 @@ import numpy as np
 
 from ..ops import match_kernel as K
 from ..robustness import faults
+from ..robustness import watchdog as watchdog_mod
 from ..robustness.breaker import CircuitBreaker
+from ..robustness.watchdog import StallAbandoned
 from .tpu_table import SubscriptionTable
 
 Row = Tuple[Tuple[str, ...], Hashable, Any]
@@ -317,6 +319,17 @@ class TpuMatcher:
         self._rebuild_thread: Optional[threading.Thread] = None
         self._rebuild_barrier: Optional[threading.Event] = None  # tests
         self.rebuilds_async = 0
+        # stall watchdog (robustness/watchdog.py), set by the production
+        # seat (TpuRegView): background rebuilds register a monitored op
+        # and are ABANDONED past rebuild_deadline_s — sync() reaps the
+        # wedged thread like a crashed one, its late install is
+        # discarded, and the breaker is fed (the PR 4 failed-rebuild
+        # rule extended to wedged rebuilds). None = unmonitored.
+        self.watchdog: Optional[Any] = None
+        self.rebuild_deadline_s = 120.0
+        self._rebuild_token: Optional[dict] = None
+        self.rebuild_abandons = 0
+        self.dispatch_stalls = 0  # abandoned dispatches fed via record_stall
         self.busy_sheds = 0  # match_batch lock-timeout / cold-shape sheds
         # compile-signature warmth: a (arg-shapes, statics) signature is
         # warm once one execution completed. require_warm callers (the
@@ -457,11 +470,20 @@ class TpuMatcher:
     def _record_device_failure(self, exc: BaseException) -> None:
         """Feed a device dispatch/upload failure to the breaker and
         re-raise as DeviceDegraded (host trie serves this batch). With
-        no breaker installed the original error propagates verbatim."""
+        no breaker installed the original error propagates verbatim.
+
+        A dispatch whose waiter the stall watchdog already released
+        records NOTHING: the stall was fed to the breaker as a failure
+        at abandonment (``record_stall``), so a late error must not
+        double-count — and a late error from a probe must not double
+        the backoff the stall already applied."""
         self.device_failures += 1
         br = self.breaker
         if br is None:
             raise exc
+        if watchdog_mod.current_op_abandoned():
+            raise DeviceDegraded(
+                f"late failure of abandoned dispatch: {exc!r}") from exc
         import logging
 
         if br.record_failure():
@@ -471,9 +493,31 @@ class TpuMatcher:
                 br.failure_threshold, exc)
         raise DeviceDegraded(f"device dispatch failed: {exc!r}") from exc
 
+    def record_stall(self, exc: Optional[BaseException] = None) -> None:
+        """An abandoned (deadline-overrun) dispatch is a device failure:
+        feed the breaker so matching flips to the host trie instead of
+        queueing more waiters into a wedged device. Called by the
+        collector when the stall watchdog releases its waiter — the
+        stalled call itself records nothing on late completion (see the
+        abandoned-op guards in ``_record_device_success``/``_failure``)."""
+        self.dispatch_stalls += 1
+        try:
+            self._record_device_failure(
+                exc if exc is not None
+                else RuntimeError("device dispatch stalled past deadline"))
+        except Exception:
+            pass  # DeviceDegraded (breaker fed) or re-raised exc (no breaker)
+
     def _record_device_success(self, warmup: bool = False) -> None:
         br = self.breaker
         if br is None:
+            return
+        if watchdog_mod.current_op_abandoned():
+            # late success of an abandoned dispatch: the device may be
+            # back, but this verdict raced a stall the breaker already
+            # absorbed as a failure — only a LIVE probe may close it
+            # (otherwise a wedge-released straggler would flip the
+            # breaker shut the instant the stall opened it)
             return
         if warmup and not br.is_closed:
             # a warmup that entered dispatch BEFORE the outage landed
@@ -516,37 +560,84 @@ class TpuMatcher:
         self._bucketed = state["bucketed"]
         self._entries_snapshot = state["entries"]
 
+    def _abandon_rebuild(self, token: dict) -> None:
+        """Stall-watchdog ``on_stall``: the background rebuild exceeded
+        its deadline. Treat it exactly like a crashed one (the PR 4 rule
+        extended to wedges): mark its token so sync() reaps it and its
+        late install is discarded, and feed the breaker so matching
+        degrades loudly NOW instead of shedding RebuildInProgress
+        silently forever. Runs on the monitor thread — no matcher lock
+        (the wedged holder might be inside it)."""
+        if token.get("abandoned"):
+            return
+        token["abandoned"] = True
+        self.rebuild_abandons += 1
+        self.device_failures += 1
+        br = self.breaker
+        if br is not None and br.record_failure():
+            import logging
+
+            logging.getLogger("vernemq_tpu.matcher").error(
+                "device path OPENED: background table rebuild stalled "
+                "past its %.1fs deadline (abandoned; host trie serves)",
+                self.rebuild_deadline_s)
+
     def _spawn_rebuild_locked(self) -> None:
         """Kick the background rebuild (lock held). The thread builds
         from a snapshot; at install time, if the layout moved AGAIN
-        (another resize while uploading), the stale build is discarded
-        and a fresh snapshot goes around — installing it would let
-        live-layout encodings hit an older device layout."""
+        (another resize while uploading) or the stall watchdog abandoned
+        this build, the stale build is discarded — installing it would
+        let live-layout encodings hit an older device layout (or, for an
+        abandoned build, resurrect state the table has moved past)."""
         import threading
 
         state = self._snapshot_host_locked(copy=True)
         self.rebuilds_async += 1
+        token = {"abandoned": False}
+        self._rebuild_token = token
+        wd = self.watchdog
+        op = (wd.register("device.rebuild", self.rebuild_deadline_s,
+                          label="table-rebuild",
+                          on_stall=lambda _op: self._abandon_rebuild(token))
+              if wd is not None and self.rebuild_deadline_s > 0 else None)
 
         def _run() -> None:
             try:
-                built = self._build_device(state)
-            except Exception:
-                import logging
+                try:
+                    built = self._build_device(state)
+                except Exception:
+                    import logging
 
-                logging.getLogger(__name__).exception(
-                    "background table rebuild failed; will retry from "
-                    "the next sync")
-                return  # sync() reaps the dead thread and re-arms resized
-            barrier = self._rebuild_barrier
-            if barrier is not None:
-                barrier.wait()
-            with self.lock:
-                t = self.table
-                if t.resized or t.id_bits != state["bits"]:
-                    self._spawn_rebuild_locked()
-                    return
-                self._install_built(built, state)
-                self._rebuild_thread = None
+                    if token["abandoned"]:
+                        wd.note_late_discard("device.rebuild",
+                                             "failed after abandonment")
+                        return
+                    logging.getLogger(__name__).exception(
+                        "background table rebuild failed; will retry "
+                        "from the next sync")
+                    return  # sync() reaps the dead thread, re-arms resized
+                barrier = self._rebuild_barrier
+                if barrier is not None:
+                    barrier.wait()
+                with self.lock:
+                    if token["abandoned"] or self._rebuild_thread is not th:
+                        # the watchdog abandoned this build (sync has
+                        # reaped it and may already be running a fresh
+                        # one): a late install would publish stale
+                        # layout — discard, never deliver
+                        if wd is not None:
+                            wd.note_late_discard("device.rebuild",
+                                                 "stale install discarded")
+                        return
+                    t = self.table
+                    if t.resized or t.id_bits != state["bits"]:
+                        self._spawn_rebuild_locked()
+                        return
+                    self._install_built(built, state)
+                    self._rebuild_thread = None
+            finally:
+                if op is not None:
+                    wd.deregister(op)
 
         th = threading.Thread(target=_run, name="tpu-table-rebuild",
                               daemon=True)
@@ -565,12 +656,18 @@ class TpuMatcher:
         t = self.table
         bits = t.id_bits
         if self._rebuild_thread is not None:
-            if self._rebuild_thread.is_alive():
+            tok = self._rebuild_token
+            abandoned = tok is not None and tok.get("abandoned")
+            if self._rebuild_thread.is_alive() and not abandoned:
                 raise RebuildInProgress
-            # crashed worker: the snapshot consumed `resized`, so re-arm
-            # it — falling through to the delta path would scatter
-            # grown-region slots out of bounds against the OLD arrays
-            # (silently dropped) and serve wrong fanout forever
+            # crashed worker — or one the stall watchdog abandoned (a
+            # wedged build is reaped exactly like a failed one): the
+            # snapshot consumed `resized`, so re-arm it — falling
+            # through to the delta path would scatter grown-region
+            # slots out of bounds against the OLD arrays (silently
+            # dropped) and serve wrong fanout forever. The abandoned
+            # thread, if it ever completes, sees its token (or the
+            # thread mismatch) and discards its install.
             self._rebuild_thread = None
             t.resized = True
         if self._dev_arrays is None or t.resized or bits != self._ops_bits:
@@ -626,7 +723,19 @@ class TpuMatcher:
         come through :meth:`sync` only (:meth:`warm_delta_ladder`
         deliberately bypasses this — it compiles the same kernels
         against throwaway zero arrays, outside the lock and without
-        the fault hook)."""
+        the fault hook). Registered with the stall watchdog when one is
+        wired: a wedge here holds the matcher lock, so it cannot be
+        abandoned from outside — but it IS visible (watchdog_stalls,
+        `vmq-admin watchdog show`) while the lock-timeout sheds and the
+        dispatch deadline bound everyone else's wait."""
+        wd = self.watchdog
+        if wd is None:
+            return self._apply_delta_device_impl(slots)
+        with wd.monitored("device.delta", 30.0,
+                          label=f"scatter:{len(slots)}"):
+            return self._apply_delta_device_impl(slots)
+
+    def _apply_delta_device_impl(self, slots: np.ndarray) -> None:
         faults.inject("device.delta")
         t = self.table
         sw, el, hh, fw, ac = self._dev_arrays
@@ -1330,10 +1439,13 @@ class TpuRegView:
                  breaker_failure_threshold: int = 3,
                  breaker_backoff_initial: float = 0.2,
                  breaker_backoff_max: float = 10.0,
-                 delta_warm_max: int = 128):
+                 delta_warm_max: int = 128,
+                 watchdog=None, rebuild_deadline_s: float = 120.0):
         self.registry = registry
         self.mesh = mesh
         self.delta_warm_max = delta_warm_max
+        self.watchdog = watchdog
+        self.rebuild_deadline_s = rebuild_deadline_s
         self._matchers: Dict[str, TpuMatcher] = {}
 
         def _mk() -> TpuMatcher:
@@ -1359,6 +1471,12 @@ class TpuRegView:
                 backoff_initial=breaker_backoff_initial,
                 backoff_max=breaker_backoff_max)
                 if breaker_enabled else None)
+            # stall watchdog: background rebuilds register a monitored
+            # op and are abandoned (breaker fed, late install discarded)
+            # past the deadline instead of wedging the device path
+            # silently behind RebuildInProgress forever
+            m.watchdog = self.watchdog
+            m.rebuild_deadline_s = self.rebuild_deadline_s
             return m
 
         self._mk = _mk
@@ -1478,8 +1596,25 @@ class BatchCollector:
     def __init__(self, view: TpuRegView, window_us: int = 200,
                  max_batch: int = 4096, host_threshold: int = 8,
                  lock_busy_shed_ms: int = 500, super_batch_k: int = 8,
-                 latency_budget_ms: float = 50.0):
+                 latency_budget_ms: float = 50.0,
+                 watchdog=None, dispatch_deadline_ms: float = 0.0,
+                 item_expiry_ms: float = 0.0):
         self.view = view
+        # stall watchdog (robustness/watchdog.py): with a deadline set,
+        # device flushes run as SACRIFICIAL dispatches — the await is
+        # released at the deadline (StallAbandoned → host trie serves,
+        # the matcher breaker is fed) and the wedged executor thread is
+        # spawned around; its late result is discarded, never delivered.
+        # item_expiry_ms (derived from overload_dispatch_budget_ms)
+        # bounds the QUEUED tail the same way: a pending publish older
+        # than its expiry is served by the exact host walk even while
+        # every pipeline slot is wedged. 0 disables either bound.
+        self.watchdog = watchdog
+        self.dispatch_deadline = dispatch_deadline_ms / 1e3
+        self.item_expiry = item_expiry_ms / 1e3
+        self.stalled_host_pubs = 0  # pubs trie-served after an abandon
+        self.expired_host_pubs = 0  # pubs trie-served past item expiry
+        self._expiry_handle: Optional[asyncio.TimerHandle] = None
         self.window = window_us / 1e6
         self.max_batch = max_batch
         # under load (more than one full window already queued) up to
@@ -1620,7 +1755,14 @@ class BatchCollector:
                 self.overload_host_pubs += 1
                 self._settle_via_trie(mountpoint, topic, fut)
                 return fut
-        self._pending.append((mountpoint, tuple(topic), fut))
+        exp = (time.monotonic() + self.item_expiry
+               if self.item_expiry > 0 else None)
+        self._pending.append((mountpoint, tuple(topic), fut, exp))
+        if exp is not None and self._expiry_handle is None:
+            # expiry sweep: fires even when no flush can (both pipeline
+            # slots wedged) — the queued-tail bound of the stall story
+            self._expiry_handle = loop.call_later(self.item_expiry,
+                                                  self._expire_sweep)
         if len(self._pending) >= self.max_batch:
             if self._flush_handle is not None:
                 self._flush_handle.cancel()
@@ -1630,6 +1772,42 @@ class BatchCollector:
             self._flush_handle = loop.call_later(self.window, self._flush)
         return fut
 
+    #: expired items settled per sweep callback: the sweep runs ON the
+    #: loop, and an unbounded backlog (both slots wedged at high rates)
+    #: settled in one callback would stall every session's IO — the
+    #: defect class the parse-loop yield fixed. The remainder re-arms
+    #: at zero delay, so the backlog drains across loop iterations.
+    _EXPIRE_CHUNK = 256
+
+    def _expire_sweep(self) -> None:
+        """Deadline propagation for QUEUED items: anything pending past
+        its expiry is answered by the exact host trie NOW. With a wedge
+        holding both pipeline slots, a publish still waits at most
+        ``item_expiry`` before the oracle serves it — release order is
+        preserved by _settle, so the bound composes with the dispatch
+        deadline as deadline + expiry ε, never reorders."""
+        self._expiry_handle = None
+        if not self._pending:
+            return
+        now = time.monotonic()
+        settled = 0
+        keep = []
+        for item in self._pending:
+            mp, topic, fut, exp = item
+            if (exp is not None and now >= exp
+                    and settled < self._EXPIRE_CHUNK):
+                self.expired_host_pubs += 1
+                self._settle_via_trie(mp, topic, fut)
+                settled += 1
+            else:
+                keep.append(item)
+        self._pending = keep
+        if self._pending and self._pending[0][3] is not None:
+            delay = (0.0 if now >= self._pending[0][3]  # chunk remainder
+                     else max(0.005, self._pending[0][3] - now))
+            self._expiry_handle = asyncio.get_event_loop().call_later(
+                delay, self._expire_sweep)
+
     def _flush(self) -> None:
         self._flush_handle = None
         if not self._pending:
@@ -1638,7 +1816,7 @@ class BatchCollector:
         if len(self._pending) <= self.host_threshold and reg is not None:
             pending, self._pending = self._pending, []
             self.host_hybrid_pubs += len(pending)
-            for mp, topic, fut in pending:
+            for mp, topic, fut, _exp in pending:
                 self._settle_via_trie(mp, topic, fut)
             return
         if self._inflight >= self.MAX_INFLIGHT:
@@ -1691,10 +1869,23 @@ class BatchCollector:
         matcher)."""
         loop = asyncio.get_event_loop()
         flush_t0 = time.perf_counter()
-        # group by mountpoint (typically one)
+        # group by mountpoint (typically one); items that expired while
+        # queued (saturated merges behind a slow/wedged device) go to
+        # the exact host trie instead of riding — and lengthening — a
+        # device dispatch they already waited too long for
+        now = time.monotonic()
         by_mp: Dict[str, List[Tuple[Tuple[str, ...], asyncio.Future]]] = {}
-        for mp, topic, fut in pending:
-            by_mp.setdefault(mp, []).append((topic, fut))
+        expired: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
+        for mp, topic, fut, exp in pending:
+            if exp is not None and now >= exp:
+                expired.append((mp, topic, fut))
+            else:
+                by_mp.setdefault(mp, []).append((topic, fut))
+        for i, (mp, t_, fut) in enumerate(expired):
+            self.expired_host_pubs += 1
+            self._settle_via_trie(mp, t_, fut)
+            if (i + 1) % 64 == 0:
+                await asyncio.sleep(0)
         for mp, items in by_mp.items():
             topics = [t for t, _ in items]
             self.view.matcher(mp)  # warm-load on the loop thread (see matcher())
@@ -1706,20 +1897,57 @@ class BatchCollector:
                        for i in range(0, len(topics), self.max_batch)]
                       if len(topics) > self.max_batch
                       and self._many_capable(mp) else None)
+            wd = self.watchdog
+            sacrificial = wd is not None and self.dispatch_deadline > 0
             try:
                 if chunks:
-                    nested = await loop.run_in_executor(
-                        None, self.view.fold_many, mp, chunks, lock_to
-                    )
+                    if sacrificial:
+                        nested = await wd.dispatch_async(
+                            "device.dispatch",
+                            lambda m=mp, c=chunks, lt=lock_to:
+                                self.view.fold_many(m, c, lt),
+                            self.dispatch_deadline,
+                            label=f"fold_many:{mp or '(default)'}")
+                    else:
+                        nested = await loop.run_in_executor(
+                            None, self.view.fold_many, mp, chunks, lock_to
+                        )
                     results = [rows for batch in nested for rows in batch]
                     # counted only on success: a shed/failed super-batch
                     # served elsewhere must not read as a fused dispatch
                     self.super_batches += 1
                     self.super_batch_pubs += len(topics)
+                elif sacrificial:
+                    # sacrificial dispatch: the await is bounded by the
+                    # deadline; a wedged device call is abandoned (host
+                    # trie serves below), its thread spawned around, and
+                    # its LATE result discarded — never delivered
+                    results = await wd.dispatch_async(
+                        "device.dispatch",
+                        lambda m=mp, t=topics, lt=lock_to:
+                            self.view.fold_batch(m, t, lt),
+                        self.dispatch_deadline,
+                        label=f"fold_batch:{mp or '(default)'}")
                 else:
                     results = await loop.run_in_executor(
                         None, self.view.fold_batch, mp, topics, lock_to
                     )
+            except StallAbandoned as sa:
+                # deadline overrun: record the stall as a device failure
+                # (breaker → host trie until a probe succeeds) and serve
+                # THIS flush from the trie — bounded latency, identical
+                # results, and the abandoned call's eventual output is
+                # discarded by its token (bit-exact: no stale fanout)
+                self.stalled_host_pubs += len(items)
+                m = (self.view.matcher(mp)
+                     if hasattr(self.view, "matcher") else None)
+                if m is not None and hasattr(m, "record_stall"):
+                    m.record_stall(sa)
+                for i, (t_, fut) in enumerate(items):
+                    self._settle_via_trie(mp, t_, fut, fallback_exc=sa)
+                    if (i + 1) % 64 == 0:
+                        await asyncio.sleep(0)
+                continue
             except (RebuildInProgress, MatcherBusy, DeviceDegraded) as rb:
                 # the device can't take this batch promptly — table
                 # re-uploading after growth, the matcher lock held past

@@ -797,10 +797,15 @@ class ShardedTpuMatcher(TpuMatcher):
         delta scatter otherwise. Callers hold ``self.lock``."""
         t = self.table
         if self._rebuild_thread is not None:
-            if self._rebuild_thread.is_alive():
+            tok = self._rebuild_token
+            abandoned = tok is not None and tok.get("abandoned")
+            if self._rebuild_thread.is_alive() and not abandoned:
                 raise RebuildInProgress
+            # crashed — or watchdog-abandoned (wedged) — worker consumed
+            # the flag: re-arm (same reap discipline as TpuMatcher.sync;
+            # a late install discards against its token)
             self._rebuild_thread = None
-            t.resized = True  # crashed worker consumed the flag: re-arm
+            t.resized = True
         if self._dev_arrays is None or t.resized \
                 or t.id_bits != self._ops_bits:
             if self._dev_arrays is not None and self.async_rebuild:

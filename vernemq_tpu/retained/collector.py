@@ -25,6 +25,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..models.tpu_matcher import DeviceDegraded, MatcherBusy, \
     RebuildInProgress
+from ..robustness.watchdog import StallAbandoned
 
 log = logging.getLogger("vernemq_tpu.retained")
 
@@ -41,16 +42,29 @@ class RetainedBatchCollector:
 
     def __init__(self, engine, store, window_us: int = 500,
                  max_batch: int = 1024, host_threshold: int = 4,
-                 latency_budget_ms: float = 50.0):
+                 latency_budget_ms: float = 50.0,
+                 watchdog=None, dispatch_deadline_ms: float = 0.0,
+                 item_expiry_ms: float = 0.0):
         self.engine = engine
         self.store = store
         self.window = window_us / 1e6
         self.max_batch = max_batch
         self.host_threshold = host_threshold
-        self._pending: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
+        self._pending: List[Tuple] = []  # (mp, filter, fut, expiry)
         self._flush_handle: Optional[asyncio.TimerHandle] = None
         self._inflight = 0
         self._closed = False
+        # stall watchdog: reverse-match dispatches become sacrificial
+        # (abandoned past dispatch_deadline_ms → host walk serves, the
+        # index breaker is fed, the late result is discarded); queued
+        # replays older than item_expiry_ms are host-served even while
+        # every pipeline slot is wedged. 0 disables either bound.
+        self.watchdog = watchdog
+        self.dispatch_deadline = dispatch_deadline_ms / 1e3
+        self.item_expiry = item_expiry_ms / 1e3
+        self.stalled_filters = 0
+        self.expired_filters = 0
+        self._expiry_handle: Optional[asyncio.TimerHandle] = None
         # overload governor hooks (robustness/overload.py): pressure()
         # feeds the fused signal; defer_gate (set by the broker) returns
         # True at L2+ — replay storms then wait out the congestion
@@ -77,8 +91,11 @@ class RetainedBatchCollector:
         if self._flush_handle is not None:
             self._flush_handle.cancel()
             self._flush_handle = None
+        if self._expiry_handle is not None:
+            self._expiry_handle.cancel()
+            self._expiry_handle = None
         pending, self._pending = self._pending, []
-        for mp, fw, fut in pending:
+        for mp, fw, fut, _exp in pending:
             self._host_match(mp, fw, fut)
 
     def submit(self, mountpoint: str,
@@ -88,7 +105,12 @@ class RetainedBatchCollector:
         if self._closed:
             self._host_match(mountpoint, tuple(filter_words), fut)
             return fut
-        self._pending.append((mountpoint, tuple(filter_words), fut))
+        exp = (time.monotonic() + self.item_expiry
+               if self.item_expiry > 0 else None)
+        self._pending.append((mountpoint, tuple(filter_words), fut, exp))
+        if exp is not None and self._expiry_handle is None:
+            self._expiry_handle = loop.call_later(self.item_expiry,
+                                                  self._expire_sweep)
         if len(self._pending) >= self.max_batch:
             if self._defer_armed:
                 # an L2+ deferral is waiting out the congestion: more
@@ -103,6 +125,38 @@ class RetainedBatchCollector:
         elif self._flush_handle is None:
             self._flush_handle = loop.call_later(self.window, self._flush)
         return fut
+
+    #: expired filters settled per sweep callback (loop-side host
+    #: walks): the remainder re-arms at zero delay so a storm backlog
+    #: drains across loop iterations instead of one long stall
+    _EXPIRE_CHUNK = 256
+
+    def _expire_sweep(self) -> None:
+        """Queued-replay deadline: pending filters older than their
+        expiry are served by the exact host walk now — a subscribe's
+        retained replay is bounded even with both pipeline slots wedged
+        (the dispatch deadline bounds the in-flight half)."""
+        self._expiry_handle = None
+        if not self._pending:
+            return
+        now = time.monotonic()
+        settled = 0
+        keep = []
+        for item in self._pending:
+            mp, fw, fut, exp = item
+            if (exp is not None and now >= exp
+                    and settled < self._EXPIRE_CHUNK):
+                self.expired_filters += 1
+                self._host_match(mp, fw, fut)
+                settled += 1
+            else:
+                keep.append(item)
+        self._pending = keep
+        if self._pending and self._pending[0][3] is not None:
+            delay = (0.0 if now >= self._pending[0][3]
+                     else max(0.005, self._pending[0][3] - now))
+            self._expiry_handle = asyncio.get_event_loop().call_later(
+                delay, self._expire_sweep)
 
     def _host_match(self, mp: str, fw: Tuple[str, ...], fut) -> None:
         if fut.done():
@@ -147,7 +201,7 @@ class RetainedBatchCollector:
         if len(self._pending) <= self.host_threshold:
             pending, self._pending = self._pending, []
             self.host_hybrid_filters += len(pending)
-            for mp, fw, fut in pending:
+            for mp, fw, fut, _exp in pending:
                 self._host_match(mp, fw, fut)
             return
         if self._inflight >= self.MAX_INFLIGHT:
@@ -175,17 +229,50 @@ class RetainedBatchCollector:
     async def _flush_async(self, pending) -> None:
         loop = asyncio.get_event_loop()
         flush_t0 = time.perf_counter()
+        now = time.monotonic()
         by_mp: Dict[str, List[Tuple[Tuple[str, ...], asyncio.Future]]] = {}
-        for mp, fw, fut in pending:
-            by_mp.setdefault(mp, []).append((fw, fut))
+        expired: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
+        for mp, fw, fut, exp in pending:
+            if exp is not None and now >= exp:
+                expired.append((mp, fw, fut))
+            else:
+                by_mp.setdefault(mp, []).append((fw, fut))
+        for i, (mp, fw, fut) in enumerate(expired):
+            # waited out its expiry behind a slow/wedged device: the
+            # exact host walk answers instead of deepening the queue
+            self.expired_filters += 1
+            self._host_match(mp, fw, fut)
+            if (i + 1) % 64 == 0:
+                await asyncio.sleep(0)
         for mp, items in by_mp.items():
             filters = [fw for fw, _ in items]
+            wd = self.watchdog
             try:
                 # first use chunk-loads the retained snapshot with loop
                 # yields; a failed load serves this flush host-side
                 idx = await self.engine.index_async(mp)
-                results = await loop.run_in_executor(
-                    None, idx.match_filters, filters)
+                if wd is not None and self.dispatch_deadline > 0:
+                    # sacrificial dispatch: bounded await, late result
+                    # discarded (see models/tpu_matcher.BatchCollector)
+                    results = await wd.dispatch_async(
+                        "device.retained",
+                        lambda ix=idx, fs=filters: ix.match_filters(fs),
+                        self.dispatch_deadline,
+                        label=f"match_filters:{mp or '(default)'}")
+                else:
+                    results = await loop.run_in_executor(
+                        None, idx.match_filters, filters)
+            except StallAbandoned as sa:
+                # deadline overrun: stall feeds the index breaker and
+                # the host walk serves this flush (identical results)
+                self.stalled_filters += len(items)
+                if hasattr(idx, "record_stall"):
+                    idx.record_stall(sa)
+                for i, (fw, fut) in enumerate(items):
+                    self._host_match(mp, fw, fut)
+                    if (i + 1) % 64 == 0:
+                        await asyncio.sleep(0)
+                continue
             except (RebuildInProgress, MatcherBusy, DeviceDegraded) as rb:
                 # degraded window: the host walk serves (identical
                 # results); chunk with yields so a big storm flush can't
@@ -229,4 +316,6 @@ class RetainedBatchCollector:
             "retained_replay_degraded_filters": self.degraded_filters,
             "retained_replay_rebuild_filters": self.rebuild_filters,
             "retained_replay_fallback_filters": self.fallback_filters,
+            "retained_replay_stalled_filters": self.stalled_filters,
+            "retained_replay_expired_filters": self.expired_filters,
         }

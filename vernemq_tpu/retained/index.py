@@ -38,6 +38,7 @@ from ..models.tpu_matcher import (
 from ..ops import reverse_kernel as RK
 from ..protocol.topic import match_dollar_aware
 from ..robustness import faults
+from ..robustness import watchdog as watchdog_mod
 from ..robustness.breaker import CircuitBreaker
 from .table import RetainedTopicTable
 
@@ -63,7 +64,8 @@ class RetainedIndex:
                  initial_capacity: int = 2048, max_fanout: int = 256,
                  device=None,
                  breaker: Optional[CircuitBreaker] = None,
-                 breaker_enabled: bool = True):
+                 breaker_enabled: bool = True,
+                 watchdog=None, rebuild_deadline_s: float = 120.0):
         import jax
 
         self._jax = jax
@@ -87,6 +89,15 @@ class RetainedIndex:
         # bare indexes in benches/tests time the inline path instead
         self.async_rebuild = True
         self._rebuild_thread: Optional[threading.Thread] = None
+        # stall watchdog (robustness/watchdog.py): background rebuilds
+        # register a monitored op; past rebuild_deadline_s the build is
+        # abandoned (breaker fed, late install discarded) instead of
+        # shedding RebuildInProgress silently forever
+        self.watchdog = watchdog
+        self.rebuild_deadline_s = rebuild_deadline_s
+        self._rebuild_token: Optional[dict] = None
+        self.rebuild_abandons = 0
+        self.dispatch_stalls = 0  # abandoned dispatches (record_stall)
         # wildcard-first filters need a full-table dense pass; on hosts
         # without a matmul engine the host retain trie serves them better
         # (it narrows on their concrete deeper levels), so "auto" routes
@@ -227,45 +238,99 @@ class RetainedIndex:
         self._entries_snapshot = state["entries"]
         self.rebuilds += 1
 
+    def _abandon_rebuild(self, token: dict) -> None:
+        """Stall-watchdog ``on_stall``: a wedged background build is
+        treated exactly like a failed one — token marked (sync() reaps,
+        the late install is discarded), breaker fed so a stalled device
+        opens it instead of reading healthy while replays shed forever.
+        Monitor-thread context: no index lock taken."""
+        if token.get("abandoned"):
+            return
+        token["abandoned"] = True
+        self.rebuild_abandons += 1
+        self.device_failures += 1
+        br = self.breaker
+        if br is not None and br.record_failure():
+            log.error("retained device path OPENED: background rebuild "
+                      "stalled past its %.1fs deadline (abandoned; host "
+                      "retain walk serves)", self.rebuild_deadline_s)
+
+    def record_stall(self, exc: Optional[BaseException] = None) -> None:
+        """An abandoned (deadline-overrun) reverse-match dispatch is a
+        device failure — feed the breaker (collector-side hook, like
+        ``TpuMatcher.record_stall``)."""
+        self.dispatch_stalls += 1
+        try:
+            self._record_device_failure(
+                exc if exc is not None
+                else RuntimeError("retained dispatch stalled past deadline"))
+        except Exception:
+            pass
+
     def _spawn_rebuild_locked(self) -> None:
         state = self._snapshot_locked(copy=True)
         self.table.resized = False
         self.table.dirty.clear()
         self.rebuilds_async += 1
+        token = {"abandoned": False}
+        self._rebuild_token = token
+        wd = self.watchdog
+        op = (wd.register("device.retained", self.rebuild_deadline_s,
+                          label="retained-rebuild",
+                          on_stall=lambda _op: self._abandon_rebuild(token))
+              if wd is not None and self.rebuild_deadline_s > 0 else None)
 
         def _run() -> None:
-            if self._closed:
-                return
             try:
-                built = self._build_device(state)
-            except Exception as e:
-                # a failed background build is a DEVICE failure: feed the
-                # breaker so a persistent outage opens it (further
-                # replays shed at the gate instead of respawning a
-                # failing snapshot+upload thread per flush) — without
-                # this the breaker metrics read healthy while the
-                # device path is permanently down
-                self.device_failures += 1
-                br = self.breaker
-                if br is not None and br.record_failure():
-                    log.error(
-                        "retained device path OPENED after %d consecutive "
-                        "failures (background rebuild: %s); replays "
-                        "degrade to the host retain walk",
-                        br.failure_threshold, e)
-                else:
-                    log.exception("background retained-table rebuild "
-                                  "failed; will retry from the next sync")
-                return  # sync() reaps the dead thread and re-arms resized
-            with self.lock:
                 if self._closed:
-                    return  # broker stopped mid-build: don't respawn
-                t = self.table
-                if t.resized or t.id_bits != state["bits"]:
-                    self._spawn_rebuild_locked()  # layout moved again
                     return
-                self._install(built, state)
-                self._rebuild_thread = None
+                try:
+                    built = self._build_device(state)
+                except Exception as e:
+                    if token["abandoned"]:
+                        wd.note_late_discard("device.retained",
+                                             "failed after abandonment")
+                        return
+                    # a failed background build is a DEVICE failure: feed
+                    # the breaker so a persistent outage opens it (further
+                    # replays shed at the gate instead of respawning a
+                    # failing snapshot+upload thread per flush) — without
+                    # this the breaker metrics read healthy while the
+                    # device path is permanently down
+                    self.device_failures += 1
+                    br = self.breaker
+                    if br is not None and br.record_failure():
+                        log.error(
+                            "retained device path OPENED after %d "
+                            "consecutive failures (background rebuild: "
+                            "%s); replays degrade to the host retain walk",
+                            br.failure_threshold, e)
+                    else:
+                        log.exception(
+                            "background retained-table rebuild failed; "
+                            "will retry from the next sync")
+                    return  # sync() reaps the dead thread, re-arms resized
+                with self.lock:
+                    if self._closed:
+                        return  # broker stopped mid-build: don't respawn
+                    if token["abandoned"] or self._rebuild_thread is not th:
+                        # abandoned by the watchdog (sync may already be
+                        # running a fresh build): a late install would
+                        # publish stale layout — discard, never deliver
+                        if wd is not None:
+                            wd.note_late_discard(
+                                "device.retained",
+                                "stale install discarded")
+                        return
+                    t = self.table
+                    if t.resized or t.id_bits != state["bits"]:
+                        self._spawn_rebuild_locked()  # layout moved again
+                        return
+                    self._install(built, state)
+                    self._rebuild_thread = None
+            finally:
+                if op is not None:
+                    wd.deregister(op)
 
         th = threading.Thread(target=_run, name="retained-rebuild",
                               daemon=True)
@@ -280,10 +345,14 @@ class RetainedIndex:
         t = self.table
         bits = t.id_bits
         if self._rebuild_thread is not None:
-            if self._rebuild_thread.is_alive():
+            tok = self._rebuild_token
+            abandoned = tok is not None and tok.get("abandoned")
+            if self._rebuild_thread.is_alive() and not abandoned:
                 raise RebuildInProgress
+            # crashed — or watchdog-abandoned (wedged) — worker: re-arm
+            # the full build; a late install discards against its token
             self._rebuild_thread = None
-            t.resized = True  # crashed worker: re-arm the full build
+            t.resized = True
         if self._dev is None or t.resized or bits != self._ops_bits:
             if self.async_rebuild:
                 # unlike the forward matcher, the FIRST build goes async
@@ -353,6 +422,11 @@ class RetainedIndex:
         br = self.breaker
         if br is None:
             raise exc
+        if watchdog_mod.current_op_abandoned():
+            # late error of an abandoned dispatch: the stall already fed
+            # the breaker (record_stall) — don't double-count
+            raise DeviceDegraded(
+                f"late failure of abandoned dispatch: {exc!r}") from exc
         if br.record_failure():
             log.error("retained device path OPENED after %d consecutive "
                       "failures (last: %s); replays degrade to the host "
@@ -364,6 +438,8 @@ class RetainedIndex:
         br = self.breaker
         if br is None:
             return
+        if watchdog_mod.current_op_abandoned():
+            return  # stale verdict: only a live probe may close it
         if br.record_success():
             log.warning("retained device path recovered (probe succeeded "
                         "after %.1fs degraded)", br.time_degraded())
@@ -610,7 +686,8 @@ class RetainedEngine:
                  breaker_enabled: bool = True,
                  breaker_failure_threshold: int = 3,
                  breaker_backoff_initial: float = 0.2,
-                 breaker_backoff_max: float = 10.0):
+                 breaker_backoff_max: float = 10.0,
+                 watchdog=None, rebuild_deadline_s: float = 120.0):
         self.store = store
         self._indexes: Dict[str, RetainedIndex] = {}
         self._loading: Dict[str, Any] = {}  # mp -> in-flight warm-load task
@@ -622,7 +699,8 @@ class RetainedEngine:
                 backoff_initial=breaker_backoff_initial,
                 backoff_max=breaker_backoff_max)
                 if breaker_enabled else None),
-            breaker_enabled=breaker_enabled)
+            breaker_enabled=breaker_enabled,
+            watchdog=watchdog, rebuild_deadline_s=rebuild_deadline_s)
 
     def index(self, mountpoint: str = "") -> RetainedIndex:
         """Get/create the mountpoint's index, warm-loading SYNCHRONOUSLY
@@ -688,6 +766,7 @@ class RetainedEngine:
             "retained_match_dispatches": 0, "retained_match_queries": 0,
             "retained_host_fallback_queries": 0,
             "retained_device_failures": 0, "retained_degraded_sheds": 0,
+            "retained_dispatch_stalls": 0, "retained_rebuild_abandons": 0,
         }
         state = 0
         for idx in self._indexes.values():
@@ -700,6 +779,8 @@ class RetainedEngine:
                 idx.host_fallback_queries
             out["retained_device_failures"] += idx.device_failures
             out["retained_degraded_sheds"] += idx.degraded_sheds
+            out["retained_dispatch_stalls"] += idx.dispatch_stalls
+            out["retained_rebuild_abandons"] += idx.rebuild_abandons
             if idx.breaker is not None:
                 state = max(state, idx.breaker.state)
         out["retained_breaker_state"] = state

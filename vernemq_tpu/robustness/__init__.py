@@ -17,10 +17,16 @@ package supplies the two halves of that story:
   loop-lag/RSS/collector-depth/breaker/cluster signals into pressure
   levels 0-3 with staged, cheapest-first shedding (proportional read
   throttle → token buckets + QoS0 shed + replay deferral → connect
-  refusal + top-talker disconnects).
+  refusal + top-talker disconnects);
+- :mod:`watchdog` — the :class:`~watchdog.StallWatchdog` for SILENT
+  failures the other three can't see (a dispatch that never returns, a
+  half-open peer, a wedged rebuild thread): monitored-operation
+  registry, deadline abandonment with sacrificial dispatch, and
+  late-result discard so a stale fanout is never delivered.
 """
 
 from . import faults  # noqa: F401
 from .breaker import CircuitBreaker  # noqa: F401
 from .faults import FaultPlan, FaultRule, InjectedFault  # noqa: F401
 from .overload import OverloadGovernor  # noqa: F401
+from .watchdog import StallAbandoned, StallWatchdog  # noqa: F401

@@ -42,7 +42,12 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 #: faults longer than this are "hangs" capped to a bounded sleep — an
 #: injected hang must be escapable by the surrounding timeouts, not
-#: wedge the process forever
+#: wedge the process forever. The uncapped variant is the ``wedge``
+#: kind: it blocks until the stall watchdog abandons the operation
+#: (robustness/watchdog.py calls :func:`release` at abandonment) or an
+#: operator runs ``vmq-admin fault release <point>`` — the drill for
+#: sites that HAVE surrounding deadlines. ``hang`` stays capped for
+#: sites that still lack them.
 HANG_CAP_S = 60.0
 
 
@@ -67,9 +72,10 @@ class FaultRule:
     (``device.*``). ``after`` skips the first N hits of the point;
     ``count`` bounds total firings (-1 = unlimited); ``probability``
     gates each eligible hit on a draw from the point's seeded stream.
-    ``kind`` is ``error`` (raise), ``latency`` (sleep ``latency_ms``)
-    or ``hang`` (sleep ``latency_ms`` capped at :data:`HANG_CAP_S`,
-    default the cap)."""
+    ``kind`` is ``error`` (raise), ``latency`` (sleep ``latency_ms``),
+    ``hang`` (sleep ``latency_ms`` capped at :data:`HANG_CAP_S`,
+    default the cap) or ``wedge`` (block until :func:`release` — by the
+    stall watchdog's abandonment or ``vmq-admin fault release``)."""
 
     point: str
     kind: str = "error"
@@ -99,6 +105,10 @@ class FaultPlan:
         self.rules: List[FaultRule] = list(rules)
         self.injected = 0       # faults raised
         self.delayed = 0        # latency/hang faults applied
+        self.wedged = 0         # wedge faults entered (monotonic)
+        self.wedge_releases = 0  # release() calls that freed a wedge
+        self._wedge_now = 0     # waiters currently blocked in a wedge
+        self._wedge_evs: Dict[str, threading.Event] = {}
         self._hits: Dict[str, int] = {}
         self._rngs: Dict[str, random.Random] = {}
         self._lock = threading.Lock()
@@ -163,6 +173,44 @@ class FaultPlan:
                 return (r.kind, delay, i, hit)
         return None
 
+    # --------------------------------------------------------------- wedge
+
+    def wedge_event(self, point: str) -> threading.Event:
+        """The gate a ``wedge`` fault at ``point`` blocks on. One event
+        per point per episode: :meth:`release` sets AND retires it, so
+        the next wedge firing at the same point blocks afresh."""
+        with self._lock:
+            ev = self._wedge_evs.get(point)
+            if ev is None:
+                ev = self._wedge_evs[point] = threading.Event()
+            return ev
+
+    def wedge_wait(self, point: str,
+                   timeout: Optional[float] = None) -> None:
+        """Block the injection-point thread until release (or
+        ``timeout`` — loop-side seams pass their cap so a wedge drill
+        stalls the loop boundedly, like ``hang``)."""
+        ev = self.wedge_event(point)
+        with self._lock:
+            self.wedged += 1
+            self._wedge_now += 1
+        try:
+            ev.wait(timeout)
+        finally:
+            with self._lock:
+                self._wedge_now -= 1
+
+    def release(self, point: str) -> bool:
+        """Free the wedge blocked at ``point`` (watchdog abandonment /
+        ``vmq-admin fault release``). True when a gate was armed."""
+        with self._lock:
+            ev = self._wedge_evs.pop(point, None)
+            if ev is None:
+                return False
+            self.wedge_releases += 1
+        ev.set()
+        return True
+
     def hits(self, point: str) -> int:
         with self._lock:
             return self._hits.get(point, 0)
@@ -171,6 +219,9 @@ class FaultPlan:
         with self._lock:
             return {"seed": self.seed, "injected": self.injected,
                     "delayed": self.delayed,
+                    "wedged": self.wedged,
+                    "wedged_now": self._wedge_now,
+                    "wedge_releases": self.wedge_releases,
                     "hits": dict(self._hits),
                     "rules": [r.as_dict() for r in self.rules]}
 
@@ -197,14 +248,25 @@ def active() -> Optional[FaultPlan]:
     return _active
 
 
+def release(point: str) -> bool:
+    """Free a ``wedge`` fault blocked at ``point`` on the active plan
+    (no-op without one). Called by the stall watchdog at abandonment
+    and by ``vmq-admin fault release``."""
+    p = _active
+    return p.release(point) if p is not None else False
+
+
 def stats() -> Dict[str, float]:
     """Gauge snapshot for the metrics/$SYS surface."""
     p = _active
     if p is None:
         return {"fault_plan_active": 0.0, "faults_injected": 0.0,
-                "faults_delayed": 0.0}
+                "faults_delayed": 0.0, "faults_wedged_now": 0.0,
+                "faults_wedge_releases": 0.0}
     return {"fault_plan_active": 1.0, "faults_injected": float(p.injected),
-            "faults_delayed": float(p.delayed)}
+            "faults_delayed": float(p.delayed),
+            "faults_wedged_now": float(p._wedge_now),
+            "faults_wedge_releases": float(p.wedge_releases)}
 
 
 def inject(point: str, max_delay_s: Optional[float] = None) -> None:
@@ -224,6 +286,11 @@ def inject(point: str, max_delay_s: Optional[float] = None) -> None:
     if kind == "error":
         raise InjectedFault(point, rule_index, hit,
                             plan.rules[rule_index].message)
+    if kind == "wedge":
+        # uncapped on sacrificial/executor threads; loop-side seams
+        # pass their cap so the drill stalls boundedly like `hang`
+        plan.wedge_wait(point, timeout=max_delay_s)
+        return
     if max_delay_s is not None:
         delay = min(delay, max_delay_s)
     time.sleep(delay)
@@ -244,4 +311,18 @@ async def inject_async(point: str) -> None:
                             plan.rules[rule_index].message)
     import asyncio
 
+    if kind == "wedge":
+        # loop-safe wedge: poll the gate instead of blocking the loop —
+        # only THIS coroutine stalls; other sessions' IO keeps flowing
+        ev = plan.wedge_event(point)
+        with plan._lock:
+            plan.wedged += 1
+            plan._wedge_now += 1
+        try:
+            while not ev.is_set():
+                await asyncio.sleep(0.02)
+        finally:
+            with plan._lock:
+                plan._wedge_now -= 1
+        return
     await asyncio.sleep(delay)
