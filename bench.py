@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import sys
 import time
@@ -1426,6 +1427,310 @@ def config9_overload_storm(smoke):
     }
 
 
+def _admission_client_proc(port, n_clients, storm_s, tag,
+                           connect_churn, out_q):
+    """Spawn-safe load-generator entry for bench config 11. Each
+    process runs its own asyncio loop with ``n_clients`` QoS0 flood
+    publishers — each writes a pre-serialised blob of 2048 PUBLISH
+    frames per drain cycle, so the load side costs ~a memcpy per
+    message and the broker's admission path (parse, auth chain, route,
+    governor) is what saturates. ``connect_churn`` adds a
+    connect/disconnect loop recording CONNECT->CONNACK latencies (the
+    connect-storm component). Admitted throughput is counted on the
+    WORKER side (mqtt_publish_received via the shared stats block) —
+    the client's send count only bounds the offered load."""
+    import asyncio as aio
+    import socket as _sck
+    import time as _t
+
+    results = {"sent": 0, "connect_s": [], "errors": 0, "refused": 0}
+
+    def _nodelay(writer):
+        sock = writer.get_extra_info("socket")
+        if sock is not None:
+            sock.setsockopt(_sck.IPPROTO_TCP, _sck.TCP_NODELAY, 1)
+
+    async def publisher(i):
+        from vernemq_tpu.protocol import codec_v4
+        from vernemq_tpu.protocol.types import Connect, Publish
+
+        t0 = _t.perf_counter()
+        reader, writer = await aio.open_connection("127.0.0.1", port)
+        _nodelay(writer)
+        writer.write(codec_v4.serialise(
+            Connect(client_id=f"adm{tag}-{i}", keepalive=0)))
+        ack = await aio.wait_for(reader.readexactly(4), 15.0)
+        results["connect_s"].append(_t.perf_counter() - t0)
+        if ack[3] != 0:
+            results["refused"] += 1
+            writer.close()
+            return
+        frame = codec_v4.serialise(Publish(
+            topic=f"adm/{tag}/{i}", payload=b"x" * 32, qos=0))
+        blob = frame * 2048
+        deadline = _t.monotonic() + storm_s
+        sent = 0
+        try:
+            while _t.monotonic() < deadline:
+                writer.write(blob)
+                # drain() is the only pacing: TCP backpressure from the
+                # broker's read rate bounds the offered load
+                await writer.drain()
+                sent += 2048
+        except (ConnectionError, OSError):
+            # L3 talker shed / worker death: offered load stays gone,
+            # which is exactly the admission-control contract
+            results["errors"] += 1
+        results["sent"] += sent
+        writer.close()
+
+    async def churner():
+        from vernemq_tpu.protocol import codec_v4
+        from vernemq_tpu.protocol.types import Connect
+
+        deadline = _t.monotonic() + storm_s
+        i = 0
+        while _t.monotonic() < deadline:
+            t0 = _t.perf_counter()
+            try:
+                reader, writer = await aio.open_connection(
+                    "127.0.0.1", port)
+                _nodelay(writer)
+                writer.write(codec_v4.serialise(
+                    Connect(client_id=f"chn{tag}-{i}", keepalive=0)))
+                ack = await aio.wait_for(reader.readexactly(4), 10.0)
+                results["connect_s"].append(_t.perf_counter() - t0)
+                if ack[3] != 0:
+                    results["refused"] += 1
+                writer.close()
+            except (ConnectionError, OSError, aio.TimeoutError,
+                    aio.IncompleteReadError):
+                results["errors"] += 1
+            i += 1
+            await aio.sleep(0.01)
+
+    async def amain():
+        tasks = [publisher(i) for i in range(n_clients)]
+        if connect_churn:
+            tasks.append(churner())
+        await aio.gather(*tasks, return_exceptions=True)
+
+    aio.run(amain())
+    out_q.put(results)
+
+
+def config11_admission_storm(smoke):
+    """Admission storm across worker counts (the multi-process session
+    front end, broker/workers.py): connect churn + a QoS0 small-publish
+    flood from SEPARATE load-generator processes, at workers in
+    {1, 2, 4}, reporting admitted pubs/s (counted on the WORKER side:
+    mqtt_publish_received deltas out of the shared stats block over a
+    mid-storm window), CONNECT p99, per-worker loop-lag p99, and a
+    bit-identical QoS1 fanout parity phase at every worker count. An
+    in-process single-loop broker runs the same storm as the pre-PR
+    baseline: workers=1 must sit within noise of it (the
+    byte-identical degradation rule). ``cpu_count`` travels with the
+    artifact: admission is pure Python CPU, so the worker ladder's
+    ceiling is min(workers, cores - load-gen share) — on a 2-core
+    smoke box the w4 number reads as the CORE ceiling, not the front
+    end's."""
+    import asyncio
+    import multiprocessing as mp
+    import socket as _socket
+
+    storm_s = 5.0 if smoke else 10.0
+    n_procs = 2
+    clients_per = 4
+    parity_n = 120 if smoke else 400
+    ctx = mp.get_context("spawn")
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def wait_ready(port, timeout=60.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            try:
+                _socket.create_connection(("127.0.0.1", port),
+                                          0.5).close()
+                return True
+            except OSError:
+                time.sleep(0.25)
+        return False
+
+    async def storm_measure(port, tag, sampler):
+        """Fan out the load processes and measure admitted throughput
+        over a mid-storm window via ``sampler()`` (a monotonic admitted
+        count read on the broker side). Async so the single-loop
+        baseline can host the broker on THIS loop while measuring."""
+        loop = asyncio.get_event_loop()
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_admission_client_proc,
+                             args=(port, clients_per, storm_s,
+                                   f"{tag}{j}", j == 0, q),
+                             daemon=True)
+                 for j in range(n_procs)]
+        for p in procs:
+            p.start()
+        await asyncio.sleep(1.0)  # ramp: connects + first blobs
+        a0, t0 = sampler(), time.perf_counter()
+        await asyncio.sleep(max(1.0, storm_s - 2.0))
+        a1, dt = sampler(), time.perf_counter() - t0
+        folded = {"sent": 0, "connect_s": [], "errors": 0, "refused": 0}
+        for _ in procs:
+            r = await loop.run_in_executor(None, q.get, True,
+                                           storm_s + 120)
+            folded["sent"] += r["sent"]
+            folded["connect_s"].extend(r["connect_s"])
+            folded["errors"] += r["errors"]
+            folded["refused"] += r["refused"]
+        for p in procs:
+            p.join(10.0)
+        lat = sorted(folded["connect_s"])
+        return {
+            "admitted_pubs_per_s": round((a1 - a0) / dt, 1),
+            "offered_pubs": folded["sent"],
+            "connect_ms_p99": (round(
+                lat[min(len(lat) - 1, int(0.99 * len(lat)))] * 1e3, 2)
+                if lat else None),
+            "connects": len(lat),
+            "connects_refused": folded["refused"],
+            "client_errors": folded["errors"],
+        }
+
+    async def parity_phase(port, tag):
+        """Bit-identical fanout at this worker count: every distinct
+        QoS1 payload published is delivered exactly once."""
+        from vernemq_tpu.client import MQTTClient
+
+        sub = MQTTClient("127.0.0.1", port, client_id=f"par-sub{tag}")
+        await sub.connect()
+        await sub.subscribe("par/#", qos=1)
+        await asyncio.sleep(1.2)  # cross-worker replication
+        pub = MQTTClient("127.0.0.1", port, client_id=f"par-pub{tag}")
+        await pub.connect()
+        sent = set()
+        for i in range(parity_n):
+            payload = b"par-%d" % i
+            await pub.publish(f"par/{tag}/{i}", payload, qos=1,
+                              timeout=15.0)
+            sent.add(payload)
+        got = set()
+        dupes = 0
+        deadline = time.monotonic() + 25.0
+        while time.monotonic() < deadline:
+            try:
+                f = await sub.recv(1.0)
+            except asyncio.TimeoutError:
+                if len(got) >= len(sent):
+                    break
+                continue
+            if f is None:
+                break
+            if f.payload in got:
+                dupes += 1
+            got.add(f.payload)
+        await sub.disconnect()
+        await pub.disconnect()
+        return got == sent and dupes == 0
+
+    def run_workers(n_workers, base):
+        from vernemq_tpu.broker.workers import WorkerGroup
+
+        port = free_port()
+        g = WorkerGroup(n_workers, "127.0.0.1", port,
+                        cluster_base=base, allow_anonymous=True,
+                        systree_enabled=False,
+                        sysmon_lag_threshold=30.0)
+        g.start()
+        try:
+            if not wait_ready(port):
+                raise RuntimeError(f"workers={n_workers} never came up")
+            time.sleep(1.0 + 0.5 * n_workers)  # mesh formation
+
+            def sampler():
+                return sum(s["admitted_pubs"]
+                           for s in g.stats_block().read_all())
+
+            out = asyncio.run(storm_measure(port, f"w{n_workers}",
+                                            sampler))
+            out["parity_ok"] = asyncio.run(parity_phase(port,
+                                                        n_workers))
+            lag_p99 = []
+            for s in g.stats_block().read_all():
+                lags = sorted(s["lag_samples"])
+                lag_p99.append(round(
+                    lags[min(len(lags) - 1, int(0.99 * len(lags)))]
+                    * 1e3, 2) if lags else None)
+            out["loop_lag_ms_p99_per_worker"] = lag_p99
+            out["workers_alive"] = g.alive_count()
+            return out
+        finally:
+            g.stop()
+
+    async def run_single_loop():
+        """Pre-PR baseline: ONE in-process broker on this loop, same
+        storm from the same external load processes."""
+        from vernemq_tpu.broker.config import Config
+        from vernemq_tpu.broker.server import start_broker
+
+        cfg = Config(systree_enabled=False, allow_anonymous=True,
+                     sysmon_lag_threshold=30.0)
+        broker, server = await start_broker(cfg, port=0,
+                                            node_name="adm-base")
+        out = await storm_measure(
+            server.port, "base",
+            lambda: broker.metrics.value("mqtt_publish_received"))
+        await broker.stop()
+        await server.stop()
+        return out
+
+    base = asyncio.run(run_single_loop())
+    per = {}
+    for i, n in enumerate((1, 2, 4)):
+        note(f"[bench] config11 workers={n} storm...")
+        per[str(n)] = run_workers(n, 25150 + 150 * i)
+    r1 = per["1"]["admitted_pubs_per_s"]
+    out = {
+        "storm_s": storm_s,
+        "cpu_count": os.cpu_count(),
+        "load_procs": n_procs,
+        "publishers": n_procs * clients_per,
+        "single_loop_pubs_per_s": base["admitted_pubs_per_s"],
+        "single_loop_connect_ms_p99": base["connect_ms_p99"],
+        "per_workers": per,
+        "speedup_w2_vs_w1": round(
+            per["2"]["admitted_pubs_per_s"] / r1, 2) if r1 else None,
+        "speedup_w4_vs_w1": round(
+            per["4"]["admitted_pubs_per_s"] / r1, 2) if r1 else None,
+        "w1_vs_single_loop": round(
+            r1 / base["admitted_pubs_per_s"], 2)
+        if base["admitted_pubs_per_s"] else None,
+        # capacity ladder posture: the overload governor's lag gate is
+        # lifted IDENTICALLY in every measured broker (threshold 30s).
+        # At saturation the governor's job is to shed — a closed-loop
+        # throughput probe with shedding active measures the shed
+        # equilibrium (config 9's subject, and bistable around the
+        # threshold), not admission capacity.
+        "governor_lag_gate_lifted": True,
+        "core_bound": (os.cpu_count() or 1) < 5,
+        "speedup_note": (
+            "admission is pure Python CPU: with cpu_count < workers + "
+            "load procs, every multi-worker rung measures the machine's "
+            "core ceiling, not front-end scaling — the w1 rung already "
+            "saturates ~1 core and the load generators the rest. "
+            "Re-run on a many-core host (ROADMAP million-session item) "
+            "for the real ladder."
+            if (os.cpu_count() or 1) < 5 else None),
+        "parity_ok": all(p["parity_ok"] for p in per.values()),
+    }
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--subs", type=int, default=1_000_000)
@@ -1444,7 +1749,7 @@ def main() -> int:
     ap.add_argument("--stack", type=int, default=8,
                     help="batches per executable for --variant "
                     "packed_stack")
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10",
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11",
                     help="which BASELINE configs to run (3 = headline; "
                     "6 = fault-storm robustness: publish p99 while the "
                     "device path is down + breaker recovery time; "
@@ -1455,7 +1760,10 @@ def main() -> int:
                     "device reverse-match rate vs the serial host walk; "
                     "9 = overload storm: offered load past capacity, "
                     "binary shedding vs the adaptive governor on "
-                    "well-behaved goodput/p99 + recovery time)")
+                    "well-behaved goodput/p99 + recovery time; "
+                    "11 = admission storm: SO_REUSEPORT worker scaling "
+                    "at workers 1/2/4 — admitted pubs/s, CONNECT p99, "
+                    "per-worker loop lag, fanout parity)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
     ap.add_argument("--kernel-only", action="store_true",
@@ -1702,6 +2010,10 @@ def main() -> int:
     if "10" in want:
         guarded("10_stall_storm",
                 lambda: config10_stall_storm(smoke))
+
+    if "11" in want:
+        guarded("11_admission_storm",
+                lambda: config11_admission_storm(smoke))
 
     if headline is not None:
         value = headline["matches_per_sec"]

@@ -81,8 +81,32 @@ def pytest_pyfunc_call(pyfuncitem):
     return None
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _reap_worker_processes():
+    """Multi-process hygiene: any broker worker / match-service child
+    still alive when a test module finishes is reaped here. A leaked
+    worker would keep the SO_REUSEPORT socket (and its shm segments)
+    open and flake the next module's port/segment setup. Module scope
+    tears down AFTER the module's own group fixtures, so this only
+    catches what a failed test left behind."""
+    yield
+    import multiprocessing as mp
+
+    for p in mp.active_children():
+        if p.name.startswith(("vmq-worker", "vmq-match-service")):
+            p.terminate()
+            p.join(3.0)
+            if p.is_alive():
+                p.kill()
+                p.join(1.0)
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "asyncio: async test (built-in shim)")
+    config.addinivalue_line(
+        "markers",
+        "multiproc: boots real worker processes (reaped on module "
+        "teardown by conftest)")
     config.addinivalue_line(
         "markers", "slow: long-running test (excluded from tier-1)")
     config.addinivalue_line(

@@ -380,3 +380,141 @@ async def test_listener_mountpoint(broker):
     assert msg.payload == b"tenant-mp"
     assert ca.messages.empty()
     await ca.disconnect(); await cb.disconnect(); await c0.disconnect()
+
+
+# --------------------------------------------- SO_REUSEPORT listener group
+# Two in-process brokers stand in for two SO_REUSEPORT workers
+# (broker/workers.py): same bind semantics, same listener options, no
+# spawn cost. The kernel balances accepts between them by 4-tuple hash.
+
+
+def _tls_opts(**extra):
+    opts = {"certfile": os.path.join(SSL_DIR, "server.crt"),
+            "keyfile": os.path.join(SSL_DIR, "server.key"),
+            "reuse_port": True}
+    opts.update(extra)
+    return opts
+
+
+@pytest.fixture
+def broker_pair(event_loop):
+    brokers = []
+    for i in range(2):
+        b, server = event_loop.run_until_complete(start_broker(
+            Config(systree_enabled=False, allow_anonymous=True),
+            port=0, node_name=f"rp{i}"))
+        brokers.append((b, server))
+    yield brokers
+    for b, server in brokers:
+        event_loop.run_until_complete(b.stop())
+        event_loop.run_until_complete(server.stop())
+
+
+async def _connect_spread(port, n, prefix, ssl_context=None,
+                          proxy=False):
+    """Open n MQTT connections against the shared port; returns the
+    open client handles (sessions stay up so ownership is countable)."""
+    clients = []
+    for i in range(n):
+        if proxy:
+            reader, writer = await asyncio.open_connection(
+                "127.0.0.1", port)
+            writer.write(proxy_proto.build_v1(
+                (f"192.0.2.{i + 1}", 40000 + i), ("10.0.0.1", 1883)))
+            writer.write(codec_v4.serialise(
+                Connect(client_id=f"{prefix}{i}")))
+            buf = await asyncio.wait_for(reader.read(64), 5)
+            ack, _ = codec_v4.parse(memoryview(buf), 1 << 20)
+            assert isinstance(ack, Connack) and ack.rc == 0
+            clients.append(writer)
+        else:
+            c = MQTTClient("127.0.0.1", port,
+                           client_id=f"{prefix}{i}",
+                           ssl_context=ssl_context)
+            await c.connect()
+            clients.append(c)
+    return clients
+
+
+@pytest.mark.asyncio
+async def test_tls_listeners_under_reuseport(broker_pair):
+    """Both workers' TLS listeners bind the SAME port (SO_REUSEPORT);
+    every handshake lands on one of them and completes — the per-worker
+    SSLContext works inside the shared-port group."""
+    (b1, _), (b2, _) = broker_pair
+    srv1 = await b1.listeners.start_listener("mqtts", "127.0.0.1", 0,
+                                             _tls_opts())
+    srv2 = await b2.listeners.start_listener("mqtts", "127.0.0.1",
+                                             srv1.port, _tls_opts())
+    assert srv2.port == srv1.port
+    clients = await _connect_spread(srv1.port, 16, "tls-rp",
+                                    ssl_context=_client_ctx())
+    owners = (len(b1.sessions), len(b2.sessions))
+    assert sum(owners) == 16
+    # kernel accept balancing: with 16 distinct 4-tuples both members
+    # of the group get traffic (P[all one side] ~ 2^-15)
+    assert owners[0] > 0 and owners[1] > 0, owners
+    for c in clients:
+        await c.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_proxy_listeners_under_reuseport(broker_pair):
+    """PROXY-protocol listeners work per-worker inside the reuseport
+    group: whichever worker accepts, the proxied source address is
+    honoured."""
+    (b1, _), (b2, _) = broker_pair
+    srv1 = await b1.listeners.start_listener(
+        "mqtt", "127.0.0.1", 0,
+        {"proxy_protocol": True, "reuse_port": True})
+    srv2 = await b2.listeners.start_listener(
+        "mqtt", "127.0.0.1", srv1.port,
+        {"proxy_protocol": True, "reuse_port": True})
+    assert srv2.port == srv1.port
+    writers = await _connect_spread(srv1.port, 12, "pp-rp", proxy=True)
+    sessions = {**b1.sessions, **b2.sessions}
+    assert len(b1.sessions) + len(b2.sessions) == 12
+    assert len(b1.sessions) > 0 and len(b2.sessions) > 0
+    for i in range(12):
+        sess = sessions[("", f"pp-rp{i}")]
+        assert sess.peer == (f"192.0.2.{i + 1}", 40000 + i)
+    for w in writers:
+        w.close()
+
+
+@pytest.mark.asyncio
+async def test_bind_fault_in_one_worker_does_not_poison_group(
+        broker_pair):
+    """The listener.bind fault point fires for ONE worker's bind: that
+    worker's listener start fails loudly, the OTHER worker binds the
+    same port fine and serves, and the faulted worker joins the group
+    on retry once the fault clears — no hung accept queue, no
+    EADDRINUSE poisoning."""
+    from vernemq_tpu.robustness import faults
+
+    (b1, _), (b2, _) = broker_pair
+    plan = faults.install(faults.FaultPlan(seed=7))
+    plan.add_rule(faults.FaultRule(point="listener.bind", kind="error",
+                                   probability=1.0, count=1))
+    try:
+        with pytest.raises(Exception):
+            await b1.listeners.start_listener(
+                "mqtts", "127.0.0.1", 0, _tls_opts())
+        # the rule is spent: worker 2 binds and serves
+        srv2 = await b2.listeners.start_listener(
+            "mqtts", "127.0.0.1", 0, _tls_opts())
+        c = MQTTClient("127.0.0.1", srv2.port, client_id="bf-ok",
+                       ssl_context=_client_ctx())
+        await c.connect()
+        await c.disconnect()
+    finally:
+        faults.clear()
+    # fault gone: worker 1 retries the bind and JOINS the group
+    srv1 = await b1.listeners.start_listener(
+        "mqtts", "127.0.0.1", srv2.port, _tls_opts())
+    assert srv1.port == srv2.port
+    clients = await _connect_spread(srv1.port, 8, "bf-rp",
+                                    ssl_context=_client_ctx())
+    assert len(b1.sessions) + len(b2.sessions) == 8
+    for c in clients:
+        await c.disconnect()

@@ -23,6 +23,8 @@ import pytest
 from vernemq_tpu.broker.workers import WorkerGroup
 from vernemq_tpu.client import MQTTClient
 
+pytestmark = pytest.mark.multiproc  # conftest reaps leaked children
+
 
 def _free_port() -> int:
     s = socket.socket()
@@ -128,6 +130,182 @@ async def test_worker_restart_supervision(group):
     while time.time() < deadline and group.alive_count() < 2:
         time.sleep(0.25)
     assert group.alive_count() == 2
+
+
+# ------------------------------------------------------ match service mode
+
+
+@pytest.fixture(scope="module")
+def ms_group():
+    """2 workers + ONE shared-memory match service; host_threshold=0
+    forces every flush through the rings so the tests actually
+    exercise the cross-process seam (the hybrid path would otherwise
+    serve small flushes locally)."""
+    port = _free_port()
+    g = WorkerGroup(2, "127.0.0.1", port, cluster_base=26700,
+                    match_service=True, match_view="trie",
+                    allow_anonymous=True, systree_enabled=False,
+                    tpu_host_batch_threshold=0,
+                    match_service_timeout_ms=300)
+    g.start()
+    assert _wait_ready(port), "ms workers never became reachable"
+    time.sleep(1.5)  # worker mesh formation + first resync
+    yield g
+    g.stop()
+    assert g.alive_count() == 0
+
+
+async def _qos1_burst(pub, sub, tag, n):
+    """Publish n distinct QoS1 messages and drain the subscriber;
+    returns the payload set received (parity check material)."""
+    for i in range(n):
+        await pub.publish(f"mq/{tag}/{i}", b"%s-%d" % (tag.encode(), i),
+                          qos=1)
+    got = set()
+    deadline = time.monotonic() + 20.0
+    while len(got) < n and time.monotonic() < deadline:
+        try:
+            f = await sub.recv(1.0)
+        except asyncio.TimeoutError:
+            continue
+        if f is not None:
+            got.add(f.payload)
+    return got
+
+
+@pytest.mark.asyncio
+async def test_match_service_fanout_and_ring_folds(ms_group):
+    """Publishes route through the service's trie over the rings
+    (service fold counters move), delivery parity holds bit-exact, and
+    the workers' admitted counters land in the shared stats block."""
+    g = ms_group
+    sub = MQTTClient("127.0.0.1", g.port, "mq-sub")
+    await sub.connect()
+    await sub.subscribe("mq/#", qos=1)
+    await asyncio.sleep(1.2)  # replication + service forward
+    pub = MQTTClient("127.0.0.1", g.port, "mq-pub")
+    await pub.connect()
+    folds0 = g.stats_block().service_info()["folds"]
+    got = await _qos1_burst(pub, sub, "a", 40)
+    assert got == {b"a-%d" % i for i in range(40)}
+    info = g.stats_block().service_info()
+    assert info["folds"] > folds0  # the ring path actually served
+    await asyncio.sleep(0.6)  # one heartbeat interval
+    slots = g.stats_block().read_all()
+    assert sum(s["admitted_pubs"] for s in slots) >= 40
+    assert any(s["sessions"] for s in slots)
+    await sub.disconnect()
+    await pub.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_match_service_kill_respawn_resync(ms_group):
+    """kill -9 of the match service mid-traffic: folds degrade to the
+    workers' local tries (zero loss — the trie is the oracle), the
+    supervisor respawns the service under a new epoch, the workers
+    notice the bump and replay their owned rows, and the ring path
+    serves again. The partition heals without operator action."""
+    g = ms_group
+    sub = MQTTClient("127.0.0.1", g.port, "kr-sub")
+    await sub.connect()
+    await sub.subscribe("mq/#", qos=1)
+    await asyncio.sleep(1.2)
+    pub = MQTTClient("127.0.0.1", g.port, "kr-pub")
+    await pub.connect()
+    epoch0 = g.stats_block().service_info()["epoch"]
+    g._service_proc.kill()
+    g._service_proc.join(5.0)
+    assert not g.service_alive()
+    # degraded: every publish still delivered, served by local tries
+    got = await _qos1_burst(pub, sub, "deg", 15)
+    assert got == {b"deg-%d" % i for i in range(15)}
+    assert g.poll_restart() >= 1
+    deadline = time.monotonic() + 20.0
+    while time.monotonic() < deadline:
+        info = g.stats_block().service_info()
+        if (info["epoch"] > epoch0 and info["heartbeat_age_s"] is not None
+                and info["heartbeat_age_s"] < 2.0):
+            break
+        await asyncio.sleep(0.2)
+    info = g.stats_block().service_info()
+    assert info["epoch"] > epoch0, "service never respawned"
+    # workers resync their owned rows into the fresh (empty) service
+    deadline = time.monotonic() + 10.0
+    while (g.stats_block().service_info()["ops"] == 0
+           and time.monotonic() < deadline):
+        await asyncio.sleep(0.2)
+    assert g.stats_block().service_info()["ops"] >= 1
+    got = await _qos1_burst(pub, sub, "heal", 15)
+    assert got == {b"heal-%d" % i for i in range(15)}
+    await sub.disconnect()
+    await pub.disconnect()
+
+
+@pytest.fixture
+def storm_group():
+    """3 workers with per-worker direct ports, booted OUTSIDE the async
+    test body (the async shim caps each test at 30s; a 3-worker spawn
+    boot alone can eat most of that)."""
+    port = _free_port()
+    g = WorkerGroup(3, "127.0.0.1", port, cluster_base=26800,
+                    direct_base=26810, allow_anonymous=True,
+                    systree_enabled=False)
+    g.start()
+    for p in (26810, 26811, 26812):
+        assert _wait_ready(p)
+    time.sleep(1.5)  # mesh formation
+    yield g
+    g.stop()
+
+
+def test_worker_kill9_midstorm_qos1_no_loss(storm_group):
+    """Acceptance drill: kill -9 one worker while QoS1 traffic flows
+    between sessions pinned (direct ports) to the OTHER two workers.
+    Surviving workers keep serving with zero accepted-message loss,
+    and the dead worker is respawned within the supervisor budget.
+    (Sync test on its own loop: storm + respawn legitimately exceeds
+    the async shim's 30s per-test cap.)"""
+    g = storm_group
+    asyncio.run(_kill9_storm_body(g))
+    # supervisor budget: the dead worker comes back
+    assert g.poll_restart() == 1
+    assert _wait_ready(26812, 45.0), "killed worker never respawned"
+
+
+async def _kill9_storm_body(g):
+    sub = MQTTClient("127.0.0.1", 26810, "st-sub")  # worker 0
+    await sub.connect()
+    await sub.subscribe("st/#", qos=1)
+    await asyncio.sleep(1.0)  # replication
+    pub = MQTTClient("127.0.0.1", 26811, "st-pub")  # worker 1
+    await pub.connect()
+    sent = []
+
+    async def storm(n=60):
+        for i in range(n):
+            await pub.publish(f"st/{i}", b"s%d" % i, qos=1,
+                              timeout=10.0)
+            sent.append(b"s%d" % i)
+            await asyncio.sleep(0.01)
+
+    task = asyncio.get_event_loop().create_task(storm())
+    await asyncio.sleep(0.2)  # storm in flight
+    victim = g._procs[2]
+    victim.kill()  # SIGKILL, no cleanup
+    await task  # every publish ACKED by a surviving worker
+    # zero QoS>=1 loss: everything acked arrives at the subscriber
+    got = set()
+    deadline = time.monotonic() + 20.0
+    while len(got) < len(sent) and time.monotonic() < deadline:
+        try:
+            f = await sub.recv(1.0)
+        except asyncio.TimeoutError:
+            continue
+        if f is not None:
+            got.add(f.payload)
+    assert got == set(sent)
+    await sub.disconnect()
+    await pub.disconnect()
 
 
 @pytest.mark.asyncio

@@ -18,7 +18,14 @@ duration. Flags, inside any ``async def`` in ``vernemq_tpu/``:
   ``<future>.result()`` with no timeout, and a no-argument
   ``<queue>.get()`` — each parks the LOOP behind another thread's
   progress forever if that thread wedges (``dict.get(key)`` and
-  bounded variants are not flagged).
+  bounded variants are not flagged);
+- the cross-process seam (parallel/shm_ring.py): the blocking ring
+  helpers ``.pop_wait(...)``/``.push_wait(...)`` (sleep-poll loops for
+  plain-thread ring ends — on the loop they freeze every session for
+  the full timeout while the peer process lags), and a direct
+  ``SharedMemory(...)`` construction (segment create/attach is
+  synchronous filesystem+mmap work; do it at boot or in an executor,
+  never per-request on the loop).
 
 Nested synchronous ``def``s inside an async function are NOT flagged
 (they may run anywhere — an executor, a thread); nested async defs are
@@ -44,8 +51,15 @@ TARGET = os.path.join(ROOT, "vernemq_tpu")
 ALLOW_MARK = "lint: allow-blocking"
 
 #: call spellings that block the event loop
-_BAD_ATTR = {("time", "sleep"), ("os", "fsync")}
-_BAD_NAME = {"open", "input"}
+_BAD_ATTR = {("time", "sleep"), ("os", "fsync"),
+             ("shared_memory", "SharedMemory")}
+_BAD_NAME = {"open", "input", "SharedMemory"}
+
+#: method names that are ALWAYS blocking regardless of arguments: the
+#: shm-ring sleep-poll helpers for plain-thread producers/consumers
+#: (parallel/shm_ring.py) — the timeout bounds the wait but still parks
+#: the loop for up to its full length while the peer process lags
+_BLOCKING_METHODS = {"pop_wait", "push_wait"}
 
 
 def _call_name(node: ast.Call):
@@ -122,6 +136,11 @@ class _AsyncBodyVisitor(ast.NodeVisitor):
                     self._awaited.add(id(a))
         bad = (name in _BAD_NAME if isinstance(name, str)
                else name in _BAD_ATTR)
+        if (not bad and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _BLOCKING_METHODS):
+            # shm-ring blocking helpers: any receiver spelling counts
+            # (the method shape is the contract, like _unbounded_wait)
+            bad, name = True, f".{node.func.attr}"
         if bad and node.lineno not in self.allowed:
             pretty = name if isinstance(name, str) else ".".join(name)
             self.findings.append(

@@ -245,6 +245,10 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
     reg.register(["watchdog", "show"], _watchdog_show,
                  "vmq-admin watchdog show  (in-flight monitored ops, "
                  "stall/abandon/late-discard counters)")
+    reg.register(["workers", "show"], _workers_show,
+                 "vmq-admin workers show  (per-worker health/pressure "
+                 "rows from the shared stats block + match-service "
+                 "state; multi-process mode only)")
     reg.register(["breaker", "show"], _breaker_show,
                  "vmq-admin breaker show")
     reg.register(["breaker", "trip"], _breaker_trip,
@@ -990,6 +994,43 @@ def _watchdog_show(broker, flags):
                  "stalled": int(stats["watchdog_late_discarded"]),
                  "abandoned": int(stats["watchdog_cluster_stalls"])})
     return {"table": rows}
+
+
+def _workers_show(broker, flags):
+    """Per-worker health rows out of the shared stats block plus the
+    match service header — the operator face of the multi-process
+    front end (broker/workers.py, broker/match_service.py)."""
+    ws = broker.worker_stats
+    if ws is None:
+        raise CommandError("not running in multi-process worker mode "
+                           "(no shared stats block attached)")
+    rows = []
+    for s in ws.read_all():
+        lags = sorted(s.pop("lag_samples", []))
+        hb = s["heartbeat_age_s"]
+        lag_p99 = (round(lags[min(len(lags) - 1,
+                                  int(0.99 * len(lags)))] * 1e3, 2)
+                   if lags else None)
+        rows.append({
+            "worker": s["worker"], "pid": s["pid"],
+            "alive": hb is not None and hb < 5.0,
+            "heartbeat_age_s": (round(hb, 2) if hb is not None
+                                else None),
+            "level": s["level"], "pressure": round(s["pressure"], 3),
+            "sessions": s["sessions"],
+            "admitted_pubs": s["admitted_pubs"],
+            "loop_lag_ms_p99": lag_p99,
+        })
+    out = {"table": rows}
+    svc = ws.service_info()
+    if svc["epoch"]:
+        out["match_service"] = {
+            k: (round(v, 2) if isinstance(v, float) else v)
+            for k, v in svc.items()}
+    if broker.match_client is not None:
+        out["match_client"] = {
+            k: int(v) for k, v in broker.match_client.stats_dict().items()}
+    return out
 
 
 def _fault_inject(broker, flags):

@@ -127,6 +127,15 @@ class Broker:
         self.migrations: Dict[SubscriberId, Dict[str, Any]] = {}
         self._delayed_wills: Dict[SubscriberId, asyncio.Task] = {}
         self.tracer: Optional[Any] = None  # single active session tracer
+        # multi-process session front end (broker/workers.py): when this
+        # broker is one of N SO_REUSEPORT workers, the parent hands it a
+        # shared stats slot (fused overload pressure, `vmq-admin workers
+        # show`) and optionally a ring pair to the device-match service.
+        # Both stay None in the classic single-process boot — the
+        # workers=1 byte-identical guarantee.
+        self.worker_index = int(self.config.get("worker_index", 0) or 0)
+        self.worker_stats: Optional[Any] = None
+        self.match_client: Optional[Any] = None
         self.sysmon: Optional[Any] = None
         self.overload: Optional[Any] = None  # adaptive overload governor
         self.supervisor: Optional[Any] = None  # crash-restart supervision
@@ -315,6 +324,55 @@ class Broker:
                                    "breaker).",
             "tpu_rebuild_abandons": "Wedged device-table rebuilds "
                                     "abandoned by the watchdog.",
+            # multi-process front end (broker/workers.py +
+            # broker/match_service.py): per-worker counters aggregated
+            # at the scrape/$SYS point from the shared stats block,
+            # plus the worker's own match-service client stats
+            "workers_total": "Worker slots in the shared stats block "
+                             "(the SO_REUSEPORT group size).",
+            "workers_alive": "Workers with a fresh heartbeat in the "
+                             "shared stats block.",
+            "workers_sessions_total": "Connected sessions summed "
+                                      "across live workers.",
+            "workers_admitted_pubs_total": "PUBLISHes admitted summed "
+                                           "across live workers.",
+            "workers_level_max": "Highest overload level any live "
+                                 "worker reports (the fused L2/L3 "
+                                 "shedding gate).",
+            "workers_pressure_max": "Highest local overload pressure "
+                                    "any live worker reports.",
+            "overload_peer_pressure": "Peer-worker pressure fused into "
+                                      "this governor (0 outside "
+                                      "multi-process mode).",
+            "match_client_folds": "Fold batches this worker shipped to "
+                                  "the match service.",
+            "match_client_fold_pubs": "Publishes that rode a "
+                                      "match-service fold batch.",
+            "match_client_timeouts": "Match-service folds abandoned at "
+                                     "the reply deadline (local trie "
+                                     "served).",
+            "match_client_stalls": "Match-service folds abandoned by "
+                                   "the stall watchdog (local trie "
+                                   "served).",
+            "match_client_degraded": "Folds refused while the "
+                                     "match-service breaker was open "
+                                     "(local trie served).",
+            "match_client_held": "Folds served locally while an op "
+                                 "backlog/resync was still in flight "
+                                 "(ordering fence).",
+            "match_client_ops_sent": "Subscription write ops forwarded "
+                                     "to the match service.",
+            "match_client_ops_dropped": "Subscription ops dropped on "
+                                        "backlog overflow (a full "
+                                        "resync replaces them).",
+            "match_client_resyncs": "Owned-row replays after a "
+                                    "match-service (re)start.",
+            "match_client_breaker_state": "Match-service client breaker "
+                                          "state (0 closed, 1 "
+                                          "half-open, 2 open).",
+            "match_client_op_backlog": "Subscription ops buffered "
+                                       "while the request ring is "
+                                       "full.",
         })
 
     # ------------------------------------------------------------ plumbing
@@ -334,6 +392,29 @@ class Broker:
         spool = getattr(self.cluster, "spool", None)
         if spool is not None:
             out.update(spool.stats())
+        if self.worker_stats is not None:
+            # scrape-point aggregation: every worker writes only its own
+            # slot; any worker's scrape (and the parent's bench reads)
+            # fuse the block into one node-level view
+            try:
+                slots = self.worker_stats.read_all()
+                live = [s for s in slots
+                        if s["heartbeat_age_s"] is not None
+                        and s["heartbeat_age_s"] < 5.0]
+                out["workers_total"] = float(self.worker_stats.n_workers)
+                out["workers_alive"] = float(len(live))
+                out["workers_sessions_total"] = float(
+                    sum(s["sessions"] for s in live))
+                out["workers_admitted_pubs_total"] = float(
+                    sum(s["admitted_pubs"] for s in live))
+                out["workers_level_max"] = float(
+                    max((s["level"] for s in live), default=0))
+                out["workers_pressure_max"] = round(
+                    max((s["pressure"] for s in live), default=0.0), 4)
+            except Exception:
+                pass  # a torn attach must never break the scrape
+        if self.match_client is not None:
+            out.update(self.match_client.stats_dict())
         if self._retained_engine is not None:
             out.update(self._retained_engine.stats())
         if self._retained_collector is not None:
@@ -752,6 +833,23 @@ class Broker:
         if log_dir and log_file and not _os.path.isabs(log_file):
             self.config.set("log_file", _os.path.join(log_dir, log_file))
 
+    async def _publish_worker_stats(self, interval: float = 0.25) -> None:
+        """Heartbeat this worker's health row into the shared stats
+        block (pid, live sessions, admitted publishes). The overload
+        level/pressure pair is written by the governor's own tick and
+        the loop-lag samples by sysmon — every field has exactly one
+        writer, so the block needs no locking."""
+        ws = self.worker_stats
+        idx = self.worker_index
+        while True:
+            try:
+                ws.write_health(
+                    idx, pid=os.getpid(), sessions=len(self.sessions),
+                    admitted=self.metrics.value("mqtt_publish_received"))
+            except Exception:
+                log.exception("worker stats heartbeat failed")
+            await asyncio.sleep(interval)
+
     async def start_systree(self) -> None:
         """$SYS tree publisher (vmq_systree.erl): periodic internal publish
         of all metrics to $SYS/<node>/... topics. Mountpoint, QoS and
@@ -905,6 +1003,43 @@ class Broker:
             self.watchdog.tick_s = self.config.get(
                 "watchdog_tick_ms", 100) / 1e3
             self.watchdog.start()
+        # multi-process front end: attach the shared worker stats slot
+        # and, when the parent configured a match service, mount the
+        # ring-backed reg view so folds route to the service process
+        # (broker/match_service.py). Both are worker-only — the classic
+        # boot leaves the config keys empty and changes nothing.
+        stats_name = str(self.config.get("worker_stats_block", "") or "")
+        if stats_name:
+            from ..parallel.shm_ring import WorkerStatsBlock
+
+            try:
+                self.worker_stats = WorkerStatsBlock.attach(stats_name)
+            except Exception:
+                log.exception("worker stats block %r unavailable; "
+                              "running without fused worker pressure",
+                              stats_name)
+        req_ring = str(self.config.get("match_service_req_ring", "") or "")
+        if req_ring and stats_name:
+            from .match_service import MatchServiceClient, ShmMatchView
+
+            try:
+                client = MatchServiceClient(
+                    req_ring,
+                    str(self.config.get("match_service_resp_ring", "")),
+                    stats_name, self.worker_index, self.node_name,
+                    timeout_ms=float(self.config.get(
+                        "match_service_timeout_ms", 2000)))
+                self.match_client = client
+                # pre-mounting "tpu" short-circuits the accelerator
+                # probe: the worker never touches a device — the
+                # service owns the mirror; the worker's trie stays the
+                # degraded-mode oracle
+                self.registry.reg_views["tpu"] = ShmMatchView(
+                    self.registry, client)
+                client.start(self.registry)
+            except Exception:
+                log.exception("match-service rings unavailable; this "
+                              "worker matches on its local trie")
         # adaptive overload governor BEFORE sysmon so the lag sampler can
         # feed it from its very first sample (robustness/overload.py)
         from ..robustness.overload import OverloadGovernor
@@ -924,6 +1059,14 @@ class Broker:
             l2_burst=cfg.get("overload_l2_burst", 100),
             l3_disconnect_top=cfg.get("overload_l3_disconnect_top", 5))
         self.overload.start()
+        if self.worker_stats is not None:
+            # fuse per-worker governors into one cluster-style level:
+            # each tick writes THIS worker's local pressure into its
+            # slot and reads the peers' as the "workers" signal
+            self.overload.attach_worker_stats(self.worker_stats,
+                                              self.worker_index)
+            self.supervisor.spawn("worker-stats",
+                                  self._publish_worker_stats)
         if self.config.get("sysmon_enabled", True):
             from .sysmon import Sysmon
 
@@ -1002,6 +1145,11 @@ class Broker:
         if (getattr(self, "_boot_fault_plan", None) is not None
                 and faults.active() is self._boot_fault_plan):
             faults.clear()
+        if self.worker_stats is not None:
+            # the match client's own attachment went down with the tpu
+            # view close above; this is the broker's direct handle
+            self.worker_stats.close()
+            self.worker_stats = None
         # after the collectors/views that dispatch through it are down;
         # wedged sacrificial threads are daemons and die with the process
         self.watchdog.stop()

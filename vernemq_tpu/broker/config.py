@@ -157,6 +157,28 @@ DEFAULTS: Dict[str, Any] = {
     "tpu_retained_max_fanout": 256,
     # pre-size the retained device table (growth rebuilds at doublings)
     "tpu_retained_initial_capacity": 2048,
+    # multi-process session front end (broker/workers.py +
+    # broker/match_service.py): N worker processes share the MQTT port
+    # via SO_REUSEPORT, each running parse/auth/session/queue locally;
+    # matching optionally centralizes in ONE device-match service
+    # process reached over shared-memory rings. workers=1 (the default,
+    # and what every test boots) runs byte-identical to the classic
+    # single-process broker — none of the keys below change any code
+    # path until the WorkerGroup parent sets them.
+    "workers": 1,
+    # shared-memory stats table name (parallel/shm_ring.py
+    # WorkerStatsBlock): per-worker health/pressure slots the governors
+    # fuse and `vmq-admin workers show` reads. Empty = not a worker.
+    "worker_stats_block": "",
+    "worker_index": 0,
+    "workers_total": 1,
+    # request/response ring names for the match-service channel; empty =
+    # no service (each process matches in-process, the classic path)
+    "match_service_req_ring": "",
+    "match_service_resp_ring": "",
+    # worker-side fold reply deadline: past it the fold degrades to the
+    # worker's local trie through the client breaker
+    "match_service_timeout_ms": 2000,
     # deterministic fault injection (robustness/faults.py): a list of
     # rule dicts ({point, kind, probability, after, count, latency_ms})
     # installed at boot; also live-toggleable via `vmq-admin fault ...`.

@@ -72,6 +72,16 @@ FLAT_ALIASES.update({
 })
 FLAT_ALIASES["watchdog.cluster_stall_timeout_s"] = "cluster_stall_timeout_s"
 
+#: extension family: the multi-process session front end
+#: (broker/workers.py / broker/match_service.py). The plumbing knobs
+#: (ring/stats segment names, worker index) are set by the WorkerGroup
+#: parent, never by conf files — only the operator-facing ones get a
+#: dotted spelling.
+FLAT_ALIASES.update({
+    "workers.count": "workers",
+    "workers.match_service_timeout_ms": "match_service_timeout_ms",
+})
+
 #: reference knobs typed in MILLISECONDS whose internal knob is seconds
 MS_TO_SECONDS = {
     "systree_interval",

@@ -32,8 +32,11 @@ MAX_FRAME_SIZE = 268435455
 
 
 class StreamTransport(Transport):
-    """Write-coalescing wrapper over an asyncio StreamWriter: session writes
-    within one loop tick are flushed as a single TCP write."""
+    """Write-coalescing wrapper over an asyncio StreamWriter: session
+    writes within one loop tick append to ONE buffer that the flush
+    cuts loose as a single transport write (writev-style — one
+    syscall-bound send per loop iteration, however many small
+    PUBACK/PUBLISH frames landed in it)."""
 
     def __init__(self, writer: asyncio.StreamWriter):
         self._writer = writer
@@ -53,11 +56,16 @@ class StreamTransport(Transport):
         self._flush_scheduled = False
         if self.closed or not self._buf:
             return
+        # single buffer cut: swap a fresh buffer in and hand the full
+        # coalesced bytearray to the transport as-is (bytes-like, never
+        # mutated again). The old path re-copied every flushed byte
+        # (bytes(buf) then clear) on top of the per-frame append — at
+        # small-frame fanout rates that copy, not the append, dominated
+        buf, self._buf = self._buf, bytearray()
         try:
-            self._writer.write(bytes(self._buf))
+            self._writer.write(buf)
         except Exception:
             self.closed = True
-        self._buf.clear()
 
     def close(self) -> None:
         if self.closed:

@@ -110,6 +110,15 @@ class Sysmon:
                 # feed the governor's lag-EWMA signal (it recomputes the
                 # level inline so the L1 response lands this sample)
                 gov.observe_lag(lag)
+            ws = getattr(self.broker, "worker_stats", None)
+            if ws is not None:
+                # multi-process front end: every lag sample also lands
+                # in this worker's shared slot — the per-worker
+                # loop-lag p99 bench config 11 and `workers show` read
+                try:
+                    ws.push_lag(self.broker.worker_index, lag)
+                except Exception:
+                    pass
             if self.memory_high_watermark:
                 rss = rss_bytes()
                 if gov is not None:

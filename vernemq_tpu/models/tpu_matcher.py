@@ -1772,6 +1772,21 @@ class BatchCollector:
             self._flush_handle = loop.call_later(self.window, self._flush)
         return fut
 
+    def submit_batch(self, mountpoint: str,
+                     topics: Sequence[Sequence[str]]) -> "asyncio.Future":
+        """Submit a whole pre-batched group of publishes and resolve to
+        the list of per-topic row lists (in submission order).
+
+        This is the cross-process seam of the multi-process front end
+        (broker/match_service.py): each SO_REUSEPORT worker ships its
+        coalesced batch over a shared-memory ring, and the service-side
+        drainer submits it here — the submitters become PROCESSES
+        instead of tasks, but they coalesce in exactly the same pending
+        queue, so K worker batches super-batch into one match_many
+        dispatch like K tasks always did."""
+        futs = [self.submit(mountpoint, t) for t in topics]
+        return asyncio.gather(*futs)
+
     #: expired items settled per sweep callback: the sweep runs ON the
     #: loop, and an unbounded backlog (both slots wedged at high rates)
     #: settled in one callback would stall every session's IO — the

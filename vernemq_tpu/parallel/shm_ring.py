@@ -1,0 +1,375 @@
+"""Shared-memory plumbing for the multi-process session front end.
+
+Two primitives, both over ``multiprocessing.shared_memory``:
+
+- :class:`ShmRing` — a single-producer/single-consumer byte ring carrying
+  length-prefixed records (the framing the worker<->match-service channel
+  uses: pickled fold-request batches one way, match-result rows the
+  other). Producer and consumer are in DIFFERENT processes; the ring is
+  lock-free — the producer owns ``tail``, the consumer owns ``head``,
+  each 8-byte counter store is a single aligned write, and records are
+  written fully before the tail is published. That publish ordering is
+  what the consumer relies on to never see a torn record, and it holds
+  on the deployment target (x86-64 Linux: TSO keeps stores ordered, and
+  CPython's eval loop never splits an aligned ``struct.pack_into``).
+  On weakly-ordered ISAs (aarch64) the payload stores could in
+  principle become visible AFTER the tail store; pure Python cannot
+  express the needed release fence, so a C helper would be required —
+  deferred (see ROADMAP), the multi-process front end targets x86-64.
+
+- :class:`WorkerStatsBlock` — a fixed-layout per-worker stats table
+  (pid, heartbeat, overload level/pressure, session + admitted-publish
+  counters, a small loop-lag sample ring) plus a service header
+  (epoch/generation/heartbeat). Every worker writes its own slot and
+  reads everyone else's: this is how per-worker ``OverloadGovernor``
+  instances fuse into one cluster-style aggregate pressure level, and
+  what ``vmq-admin workers show`` / bench config 11 read.
+
+Blocking helpers (``pop_wait``/``push_wait``) exist for plain-thread
+consumers (the match service's drainer). They must never be called from
+an ``async def`` body — ``tools/lint_blocking.py`` flags them, exactly
+like a bare ``queue.get()``.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from multiprocessing import shared_memory
+from typing import Any, Dict, List, Optional
+
+_MAGIC = 0x564D5152  # "VMQR"
+_HDR = 64
+_WRAP = 0xFFFFFFFF
+
+#: loop-lag samples retained per worker slot (enough for a p99 over the
+#: last ~2 minutes at the 1 Hz sysmon cadence)
+LAG_SAMPLES = 64
+
+_STATS_MAGIC = 0x564D5153  # "VMQS"
+_STATS_HDR = 128
+_SLOT_BYTES = 128 + LAG_SAMPLES * 8
+
+
+def _pad4(n: int) -> int:
+    return (n + 3) & ~3
+
+
+class RingClosed(Exception):
+    """The peer marked the ring closed (orderly service shutdown)."""
+
+
+class RingFull(Exception):
+    """No space for the record (the consumer is behind or gone)."""
+
+
+class ShmRing:
+    """SPSC byte ring over one SharedMemory segment.
+
+    Layout: 64B header (magic u32, capacity u64, head u64 @16 — consumer
+    cursor, tail u64 @24 — producer cursor, closed u8 @32), then
+    ``capacity`` bytes of record storage. Records are ``u32 length`` +
+    payload, padded to 4 bytes; a ``0xFFFFFFFF`` length is a wrap marker
+    (the rest of the buffer tail is skipped). Cursors are monotonic byte
+    counts; ``cursor % capacity`` is the buffer offset.
+    """
+
+    def __init__(self, shm: shared_memory.SharedMemory, owner: bool):
+        self._shm = shm
+        self._buf = shm.buf
+        self._owner = owner
+        (magic,) = struct.unpack_from("<I", self._buf, 0)
+        if magic != _MAGIC:
+            raise ValueError(f"not a ShmRing segment: {shm.name}")
+        (self._cap,) = struct.unpack_from("<Q", self._buf, 8)
+
+    # ------------------------------------------------------------ lifecycle
+
+    @classmethod
+    def create(cls, name: str, capacity: int) -> "ShmRing":
+        capacity = _pad4(max(capacity, 4096))
+        shm = shared_memory.SharedMemory(name=name, create=True,
+                                         size=_HDR + capacity)
+        struct.pack_into("<I", shm.buf, 0, _MAGIC)
+        struct.pack_into("<Q", shm.buf, 8, capacity)
+        struct.pack_into("<QQ", shm.buf, 16, 0, 0)
+        struct.pack_into("<B", shm.buf, 32, 0)
+        return cls(shm, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmRing":
+        return cls(shared_memory.SharedMemory(name=name), owner=False)
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    @property
+    def closed(self) -> bool:
+        return bool(self._buf[32])
+
+    def mark_closed(self) -> None:
+        self._buf[32] = 1
+
+    def mark_open(self) -> None:
+        """Clear the closed flag: a respawned producer re-opens its ring
+        (closed means 'the producer is gone', and only the producer may
+        say otherwise)."""
+        self._buf[32] = 0
+
+    def close(self) -> None:
+        """Detach this process's mapping (unlink separately)."""
+        try:
+            self._buf = None
+            self._shm.close()
+        except (BufferError, OSError):
+            pass
+
+    def unlink(self) -> None:
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------- cursors
+
+    def _head(self) -> int:
+        return struct.unpack_from("<Q", self._buf, 16)[0]
+
+    def _tail(self) -> int:
+        return struct.unpack_from("<Q", self._buf, 24)[0]
+
+    def _set_head(self, v: int) -> None:
+        struct.pack_into("<Q", self._buf, 16, v)
+
+    def _set_tail(self, v: int) -> None:
+        struct.pack_into("<Q", self._buf, 24, v)
+
+    def depth_bytes(self) -> int:
+        return self._tail() - self._head()
+
+    # ------------------------------------------------------------ producer
+
+    def push(self, payload: bytes) -> bool:
+        """Append one record; returns False (without blocking) when the
+        ring lacks space — the caller decides whether that means 'retry
+        later' or 'peer is dead, degrade'."""
+        if self.closed:
+            raise RingClosed(self._shm.name)
+        need = 4 + _pad4(len(payload))
+        if need > self._cap // 2:
+            # beyond cap/2 the worst-case wrap burn (contiguous < need)
+            # means the record may NEVER fit even on an empty ring — a
+            # plain False would have the caller retry to full timeout
+            # instead of degrading immediately
+            raise RingFull(f"record of {len(payload)}B exceeds ring "
+                           f"capacity {self._cap}B / 2 (can never be "
+                           f"guaranteed to fit)")
+        head, tail = self._head(), self._tail()
+        free = self._cap - (tail - head)
+        off = tail % self._cap
+        contiguous = self._cap - off
+        if contiguous < need:
+            # wrap: burn the buffer tail with a marker and restart at 0
+            if free < contiguous + need:
+                return False
+            struct.pack_into("<I", self._buf, _HDR + off, _WRAP)
+            tail += contiguous
+            off = 0
+        elif free < need:
+            return False
+        base = _HDR + off
+        self._buf[base + 4:base + 4 + len(payload)] = payload
+        struct.pack_into("<I", self._buf, base, len(payload))
+        # publish AFTER the payload bytes are in place (store ordering
+        # guaranteed by x86-TSO only — see the module docstring)
+        self._set_tail(tail + need)
+        return True
+
+    def push_wait(self, payload: bytes, timeout: float = 1.0,
+                  poll_s: float = 0.0005) -> bool:
+        """Blocking push for plain-thread producers (NEVER on the event
+        loop — lint_blocking flags it)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            if self.push(payload):
+                return True
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+
+    # ------------------------------------------------------------ consumer
+
+    def pop_many(self, max_records: int = 64) -> List[bytes]:
+        """Drain up to ``max_records`` records without blocking."""
+        out: List[bytes] = []
+        head = self._head()
+        tail = self._tail()
+        while head != tail and len(out) < max_records:
+            off = head % self._cap
+            (ln,) = struct.unpack_from("<I", self._buf, _HDR + off)
+            if ln == _WRAP:
+                head += self._cap - off
+                continue
+            base = _HDR + off
+            out.append(bytes(self._buf[base + 4:base + 4 + ln]))
+            head += 4 + _pad4(ln)
+        self._set_head(head)
+        return out
+
+    def pop_wait(self, timeout: float = 1.0,
+                 poll_s: float = 0.0005) -> List[bytes]:
+        """Blocking drain for plain-thread consumers (NEVER on the event
+        loop — lint_blocking flags it)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            got = self.pop_many()
+            if got or time.monotonic() >= deadline:
+                return got
+            if self.closed and self._head() == self._tail():
+                raise RingClosed(self._shm.name)
+            time.sleep(poll_s)
+
+
+class WorkerStatsBlock:
+    """Fixed-layout shared stats table: one 128B+lag-ring slot per
+    worker plus a service header. All fields are written by exactly one
+    process (the slot's worker, or the match service for the header) and
+    read by anyone; every field is an aligned 8-byte store."""
+
+    def __init__(self, shm: shared_memory.SharedMemory, owner: bool):
+        self._shm = shm
+        self._buf = shm.buf
+        self._owner = owner
+        magic, n = struct.unpack_from("<II", self._buf, 0)
+        if magic != _STATS_MAGIC:
+            raise ValueError(f"not a WorkerStatsBlock: {shm.name}")
+        self.n_workers = n
+
+    @classmethod
+    def create(cls, name: str, n_workers: int) -> "WorkerStatsBlock":
+        size = _STATS_HDR + n_workers * _SLOT_BYTES
+        shm = shared_memory.SharedMemory(name=name, create=True, size=size)
+        shm.buf[:size] = b"\x00" * size
+        struct.pack_into("<II", shm.buf, 0, _STATS_MAGIC, n_workers)
+        return cls(shm, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "WorkerStatsBlock":
+        return cls(shared_memory.SharedMemory(name=name), owner=False)
+
+    @property
+    def name(self) -> str:
+        return self._shm.name
+
+    def close(self) -> None:
+        try:
+            self._buf = None
+            self._shm.close()
+        except (BufferError, OSError):
+            pass
+
+    def unlink(self) -> None:
+        if self._owner:
+            try:
+                self._shm.unlink()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------ service header
+
+    def set_service(self, epoch: int, pid: int) -> None:
+        struct.pack_into("<Q", self._buf, 8, epoch)
+        struct.pack_into("<Q", self._buf, 24, pid)
+        self.service_heartbeat()
+
+    def service_heartbeat(self) -> None:
+        struct.pack_into("<d", self._buf, 32, time.time())
+
+    def bump_generation(self, n: int = 1) -> None:
+        (g,) = struct.unpack_from("<Q", self._buf, 16)
+        struct.pack_into("<Q", self._buf, 16, g + n)
+
+    def set_service_counters(self, ops: int, folds: int, pubs: int) -> None:
+        struct.pack_into("<QQQ", self._buf, 40, ops, folds, pubs)
+
+    def service_info(self) -> Dict[str, Any]:
+        epoch, gen, pid = struct.unpack_from("<QQQ", self._buf, 8)
+        (hb,) = struct.unpack_from("<d", self._buf, 32)
+        ops, folds, pubs = struct.unpack_from("<QQQ", self._buf, 40)
+        return {"epoch": epoch, "generation": gen, "pid": pid,
+                "heartbeat_age_s": (time.time() - hb) if hb else None,
+                "ops": ops, "folds": folds, "fold_pubs": pubs}
+
+    def generation(self) -> int:
+        return struct.unpack_from("<Q", self._buf, 16)[0]
+
+    def epoch(self) -> int:
+        return struct.unpack_from("<Q", self._buf, 8)[0]
+
+    # -------------------------------------------------------- worker slots
+
+    def _base(self, idx: int) -> int:
+        if not 0 <= idx < self.n_workers:
+            raise IndexError(f"worker slot {idx} of {self.n_workers}")
+        return _STATS_HDR + idx * _SLOT_BYTES
+
+    def write_health(self, idx: int, *, pid: int, sessions: int,
+                     admitted: int) -> None:
+        b = self._base(idx)
+        struct.pack_into("<Q", self._buf, b, pid)
+        struct.pack_into("<d", self._buf, b + 8, time.time())
+        struct.pack_into("<QQ", self._buf, b + 32, sessions, admitted)
+
+    def write_overload(self, idx: int, level: int, pressure: float) -> None:
+        b = self._base(idx)
+        struct.pack_into("<dd", self._buf, b + 16, float(level), pressure)
+
+    def push_lag(self, idx: int, lag_s: float) -> None:
+        b = self._base(idx)
+        (i,) = struct.unpack_from("<Q", self._buf, b + 48)
+        struct.pack_into("<d", self._buf, b + 128 + (i % LAG_SAMPLES) * 8,
+                         lag_s)
+        struct.pack_into("<Q", self._buf, b + 48, i + 1)
+
+    def read_slot(self, idx: int) -> Dict[str, Any]:
+        b = self._base(idx)
+        (pid,) = struct.unpack_from("<Q", self._buf, b)
+        (hb,) = struct.unpack_from("<d", self._buf, b + 8)
+        level, pressure = struct.unpack_from("<dd", self._buf, b + 16)
+        sessions, admitted = struct.unpack_from("<QQ", self._buf, b + 32)
+        (n_lag,) = struct.unpack_from("<Q", self._buf, b + 48)
+        k = min(n_lag, LAG_SAMPLES)
+        lags = list(struct.unpack_from(f"<{k}d", self._buf, b + 128)) \
+            if k else []
+        return {"worker": idx, "pid": pid,
+                "heartbeat_age_s": (time.time() - hb) if hb else None,
+                "level": int(level), "pressure": pressure,
+                "sessions": sessions, "admitted_pubs": admitted,
+                "lag_samples": lags}
+
+    def read_all(self) -> List[Dict[str, Any]]:
+        return [self.read_slot(i) for i in range(self.n_workers)]
+
+    def peer_pressure(self, my_idx: int,
+                      stale_s: float = 5.0) -> Dict[str, float]:
+        """Fused view of the OTHER workers: max overload pressure and
+        level across live slots (heartbeat fresher than ``stale_s``) —
+        the governor's ``workers`` signal. A dead worker's last written
+        pressure must not pin everyone at L3 forever, hence the
+        staleness gate."""
+        now = time.time()
+        pressure = 0.0
+        level = 0.0
+        for i in range(self.n_workers):
+            if i == my_idx:
+                continue
+            b = self._base(i)
+            (hb,) = struct.unpack_from("<d", self._buf, b + 8)
+            if not hb or now - hb > stale_s:
+                continue
+            lv, p = struct.unpack_from("<dd", self._buf, b + 16)
+            pressure = max(pressure, p)
+            level = max(level, lv)
+        return {"pressure": pressure, "level": level}
