@@ -88,6 +88,67 @@ def init_backend(retries: int = 2, probe_timeout: float = 120.0,
 
 # ---------------------------------------------------------------- corpora
 
+def _stage_snapshot():
+    """Snapshot the process-global stage histograms (observability) —
+    the 'before' half of per-config attribution."""
+    from vernemq_tpu.observability import histogram as hist
+
+    return hist.snapshot_all()
+
+
+def stage_breakdown(before):
+    """Per-seam p50/p99/p99.9 of the observations made SINCE
+    ``before`` (families with no new observations are omitted)."""
+    from vernemq_tpu.observability import histogram as hist
+
+    out = {}
+    for name, after in hist.snapshot_all().items():
+        delta = hist.diff(after, before.get(name, ([0] * len(after[0]),
+                                                   0.0, 0)))
+        if delta[2] <= 0:
+            continue
+        s = hist.summary(delta)
+        out[name] = {k: (round(v, 4) if isinstance(v, float) else v)
+                     for k, v in s.items()}
+    return out
+
+
+def observability_overhead_probe(wb, reps=40):
+    """The acceptance overhead guard: publish p50 through the
+    PRODUCTION match path (TpuMatcher.match_batch — the seam the stage
+    histograms + dispatch profiler instrument) with observability ON
+    vs OFF, both recorded in the artifact. The guard requires the ON
+    number within 2% of OFF."""
+    from vernemq_tpu.observability import histogram as hist
+
+    topics = zipf_topics(wb.rng, wb.pools, min(wb.batch, 512))
+    wb.m.match_batch(topics)  # warm the shape once for both modes
+    wb.m.match_batch(topics)
+    # INTERLEAVED on/off reps: two sequential blocks would attribute
+    # clock drift / cache-state luck to the flag — alternating pairs
+    # measure only the flag's own cost
+    lat_on, lat_off = [], []
+    try:
+        for _ in range(reps):
+            hist.set_enabled(False)
+            t0 = time.perf_counter()
+            wb.m.match_batch(topics)
+            lat_off.append((time.perf_counter() - t0) * 1e3)
+            hist.set_enabled(True)
+            t0 = time.perf_counter()
+            wb.m.match_batch(topics)
+            lat_on.append((time.perf_counter() - t0) * 1e3)
+    finally:
+        hist.set_enabled(True)
+    off = float(np.percentile(lat_off, 50))
+    on = float(np.percentile(lat_on, 50))
+    return {
+        "publish_ms_p50_obs_off": round(off, 4),
+        "publish_ms_p50_obs_on": round(on, 4),
+        "overhead_pct": round((on - off) / off * 100.0, 3) if off else 0.0,
+    }
+
+
 def build_corpus(rng: random.Random, n_subs: int, table, shared_frac=0.0):
     """Mixed subscription corpus over a 3-level topic tree (BASELINE
     config 2/3 shape): words chosen so wildcard fanout is realistic.
@@ -1668,6 +1729,33 @@ def config11_admission_storm(smoke):
                     * 1e3, 2) if lags else None)
             out["loop_lag_ms_p99_per_worker"] = lag_p99
             out["workers_alive"] = g.alive_count()
+            # scrape-point histogram aggregation, read exactly like a
+            # worker's /metrics would: merge every live slot's packed
+            # stage-histogram block — the artifact shows merged
+            # families actually carrying observations from N processes
+            try:
+                from vernemq_tpu.observability import histogram as hist
+
+                merged = {}
+                ws = g.stats_block()
+                # worker slots + the match service's block (the
+                # device-side seams live in the service process) —
+                # exactly the set Broker._peer_histograms merges
+                blocks = [ws.read_hist(i) for i in range(ws.n_workers)]
+                blocks.append(ws.read_service_hist())
+                for flat in blocks:
+                    for name, snap in hist.unpack_flat(flat).items():
+                        cur = merged.get(name)
+                        merged[name] = (hist.merge(cur, snap)
+                                        if cur else snap)
+                out["stage_latency_merged"] = {
+                    name: {k: (round(v, 4) if isinstance(v, float)
+                               else v)
+                           for k, v in hist.summary(snap).items()}
+                    for name, snap in merged.items() if snap[2] > 0}
+            except Exception as e:
+                out["stage_latency_merged"] = {
+                    "error": f"{type(e).__name__}: {e}"}
             return out
         finally:
             g.stop()
@@ -1799,9 +1887,17 @@ def main() -> int:
 
     def guarded(name, fn):
         # one ladder rung failing (flaky tunnel, OOM at 5M) must not zero
-        # the headline metric — record the error and keep going
+        # the headline metric — record the error and keep going. Every
+        # config also gets the per-seam stage-latency attribution: the
+        # delta of the process-global stage histograms across its run
+        # (p50/p99/p99.9 per instrumented seam) travels in the artifact,
+        # so BENCH_*.json carries WHERE the time went, not just totals.
+        before = _stage_snapshot()
         try:
             configs[name] = fn()
+            breakdown = stage_breakdown(before)
+            if breakdown:
+                configs[name]["stage_latency"] = breakdown
             note(f"[bench] {name} {configs[name]}")
         except Exception as e:
             import traceback
@@ -1845,6 +1941,7 @@ def main() -> int:
     table = None
     pools = None
     if "3" in want or "4" in want:
+        _cfg3_stage_before = _stage_snapshot()
         shared = 0.1 if "4" in want else 0.0
         table = SubscriptionTable(
             max_levels=args.levels,
@@ -1889,9 +1986,26 @@ def main() -> int:
             except Exception as e:
                 note(f"[bench] match_many probe failed: "
                      f"{type(e).__name__}: {e}")
+        # per-seam attribution of the REAL config-3 workload — captured
+        # BEFORE the overhead probe below, whose synthetic interleaved
+        # match_batch reps would otherwise skew the very breakdown this
+        # artifact exists to carry
+        _cfg3_stages = stage_breakdown(_cfg3_stage_before)
+        # acceptance overhead guard: publish p50 through the
+        # instrumented production path with observability on vs off —
+        # both numbers (and the regression pct) travel in the artifact
+        try:
+            headline["observability"] = observability_overhead_probe(
+                wb, reps=12 if smoke else 40)
+            note(f"[bench] observability overhead "
+                 f"{headline['observability']}")
+        except Exception as e:
+            note(f"[bench] observability probe failed: "
+                 f"{type(e).__name__}: {e}")
         configs["3_mixed_1m_zipf"] = {
             k: round(v, 3) if isinstance(v, float) else v
             for k, v in headline.items() if v is not None}
+        configs["3_mixed_1m_zipf"]["stage_latency"] = _cfg3_stages
         note(f"[bench] config3 {configs['3_mixed_1m_zipf']}")
 
     if "4" in want and table is not None and headline is not None:

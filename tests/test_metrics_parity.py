@@ -168,6 +168,135 @@ async def test_watchdog_and_stall_metrics_exposed():
 
 
 @pytest.mark.asyncio
+async def test_histogram_families_exposed_and_consistent():
+    """Stage latency histograms are first-class Prometheus families:
+    HELP/TYPE present for every STAGE_FAMILIES entry, bucket counts
+    cumulative monotone non-decreasing, the +Inf bucket equal to
+    _count, and _sum/_count consistent with the observations made."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.observability import histogram as hist
+
+    cfg = Config(systree_enabled=False, allow_anonymous=True)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        hist.reset_all()
+        vals = [0.5, 2.0, 2.1, 300.0]
+        for v in vals:
+            broker.metrics.observe("stage_spool_journal_ms", v)
+        text = broker.metrics.prometheus_text(node=broker.node_name)
+        for name, _help in hist.STAGE_FAMILIES:
+            help_line = next(
+                (ln for ln in text.splitlines()
+                 if ln.startswith(f"# HELP {name} ")), None)
+            assert help_line is not None, f"{name} has no HELP"
+            assert len(help_line) > len(f"# HELP {name} "), \
+                f"{name} HELP empty"
+            assert f"# TYPE {name} histogram" in text, name
+            buckets = [
+                int(m.group(2))
+                for m in re.finditer(
+                    rf'^{name}_bucket{{[^}}]*le="([^"]+)"}} (\d+)$',
+                    text, re.M)]
+            assert len(buckets) == hist.N_BUCKETS + 1, name
+            assert buckets == sorted(buckets), \
+                f"{name} bucket counts not monotone"
+            count = int(re.search(rf"^{name}_count{{[^}}]*}} (\d+)$",
+                                  text, re.M).group(1))
+            assert buckets[-1] == count, f"{name} +Inf != _count"
+        s = float(re.search(
+            r"^stage_spool_journal_ms_sum{[^}]*} ([\d.]+)$",
+            text, re.M).group(1))
+        c = int(re.search(
+            r"^stage_spool_journal_ms_count{[^}]*} (\d+)$",
+            text, re.M).group(1))
+        assert c == len(vals) and s == pytest.approx(sum(vals))
+        # the $SYS feed carries the count/sum scalars (quantiles live
+        # in the Prometheus buckets and the graphite .pXX summaries)
+        am = broker.metrics.all_metrics()
+        assert am["stage_spool_journal_ms_count"] == len(vals)
+        assert am["stage_spool_journal_ms_sum"] == pytest.approx(
+            sum(vals), rel=1e-3)
+    finally:
+        hist.reset_all()
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_histogram_scrape_merges_two_fake_worker_slots():
+    """Worker-mode scrape-point aggregation: a broker attached as
+    worker 0 of 3 merges the OTHER live slots' packed histogram blocks
+    into its own scrape — a stale (no-heartbeat) slot is excluded."""
+    import os
+
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.observability import histogram as hist
+    from vernemq_tpu.parallel.shm_ring import WorkerStatsBlock
+
+    stats = WorkerStatsBlock.create(f"mph{os.getpid() % 100000}", 3)
+    try:
+        broker, server = await start_broker(
+            Config(systree_enabled=False, allow_anonymous=True,
+                   worker_stats_block=stats.name, worker_index=0,
+                   workers_total=3),
+            port=0, node_name="w0")
+        try:
+            hist.reset_all()
+            broker.metrics.observe("stage_ring_rtt_ms", 1.0)
+            broker.metrics.observe("stage_ring_rtt_ms", 2.0)
+            fam_idx = [n for n, _ in hist.STAGE_FAMILIES].index(
+                "stage_ring_rtt_ms")
+
+            def fake_slot(n_obs, total_ms, val_ms):
+                flat = [0.0] * (len(hist.STAGE_FAMILIES)
+                                * hist.FLAT_WIDTH)
+                base = fam_idx * hist.FLAT_WIDTH
+                flat[base + hist.bucket_index(val_ms)] = float(n_obs)
+                flat[base + hist.N_BUCKETS + 1] = total_ms
+                flat[base + hist.N_BUCKETS + 2] = float(n_obs)
+                return flat
+
+            # slot 1: live peer with 3 observations
+            stats.write_health(1, pid=111, sessions=0, admitted=0)
+            stats.write_hist(1, fake_slot(3, 12.0, 4.0))
+            # slot 2: data but NO heartbeat — must be excluded
+            stats.write_hist(2, fake_slot(100, 100.0, 1.0))
+            text = broker.metrics.prometheus_text(node="w0")
+            count = int(re.search(
+                r"^stage_ring_rtt_ms_count{[^}]*} (\d+)$",
+                text, re.M).group(1))
+            assert count == 2 + 3  # local + live peer, not the stale one
+            s = float(re.search(
+                r"^stage_ring_rtt_ms_sum{[^}]*} ([\d.]+)$",
+                text, re.M).group(1))
+            assert s == pytest.approx(3.0 + 12.0)
+            # the match SERVICE's block (device-side seams live in its
+            # process) merges too — but only from a DIFFERENT pid (an
+            # in-process service shares this worker's registry; merging
+            # its block would double count)
+            stats.write_service_hist(fake_slot(7, 7.0, 2.0))
+            stats.set_service(1, os.getpid())  # same pid: skipped
+            text = broker.metrics.prometheus_text(node="w0")
+            assert int(re.search(
+                r"^stage_ring_rtt_ms_count{[^}]*} (\d+)$",
+                text, re.M).group(1)) == 5
+            stats.set_service(1, os.getpid() + 1)  # foreign pid: merged
+            text = broker.metrics.prometheus_text(node="w0")
+            assert int(re.search(
+                r"^stage_ring_rtt_ms_count{[^}]*} (\d+)$",
+                text, re.M).group(1)) == 5 + 7
+        finally:
+            hist.reset_all()
+            await broker.stop()
+            await server.stop()
+    finally:
+        stats.close()
+        stats.unlink()
+
+
+@pytest.mark.asyncio
 async def test_per_reason_families_count():
     """The per-reason-code families actually count: a v4 accepted CONNACK
     hits both the flat per-reason counter and the labeled family; an

@@ -1,0 +1,494 @@
+"""Observability subsystem: stage histograms, flight recorder, dispatch
+profiler, trace-event export, and the worker-mode fold-envelope path.
+
+The histogram registry and profiler are process-global (like the fault
+registry), so every test resets them first — counts asserted here are
+counts THIS test produced.
+"""
+
+import asyncio
+import json
+import os
+import re
+import threading
+import time
+
+import pytest
+
+from vernemq_tpu.observability import chrome_trace, histogram as hist
+from vernemq_tpu.observability.profiler import profiler
+from vernemq_tpu.observability.recorder import FlightRecorder, PublishTrace
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    hist.set_enabled(True)
+    hist.reset_all()
+    profiler().reset()
+    yield
+    hist.set_enabled(True)
+
+
+def _poll(cond, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# ---------------------------------------------------------------- histogram
+
+
+def test_histogram_sum_count_consistent_with_observations():
+    h = hist.get("stage_device_dispatch_ms")
+    vals = [0.05, 1.2, 1.3, 40.0, 9000.0]
+    for v in vals:
+        h.observe(v)
+    counts, s, n = h.snapshot()
+    assert n == len(vals)
+    assert s == pytest.approx(sum(vals))
+    assert sum(counts) == len(vals)
+    # each observation landed in the first bucket whose bound >= value
+    for v in vals:
+        i = hist.bucket_index(v)
+        assert counts[i] >= 1
+        assert v <= hist.BUCKET_BOUNDS_MS[i]
+        if i:
+            assert v > hist.BUCKET_BOUNDS_MS[i - 1]
+
+
+def test_histogram_cross_thread_buffers_visible_without_flush():
+    """The counter-block pattern: a writer thread's buffered (not yet
+    folded) observations are visible to a reader immediately, and a
+    dead thread's residuals fold exactly once."""
+    h = hist.get("stage_queue_flush_ms")
+    t = threading.Thread(target=lambda: [h.observe(2.0)
+                                         for _ in range(10)])
+    t.start()
+    t.join()
+    counts, s, n = h.snapshot()
+    assert n == 10 and s == pytest.approx(20.0)
+    # second read after the dead-thread sweep: no double count
+    counts2, s2, n2 = h.snapshot()
+    assert (n2, s2) == (10, pytest.approx(20.0))
+    assert sum(counts2) == 10
+
+
+def test_histogram_disabled_is_a_noop():
+    hist.set_enabled(False)
+    hist.observe("stage_device_dispatch_ms", 5.0)
+    hist.set_enabled(True)
+    assert hist.get("stage_device_dispatch_ms").snapshot()[2] == 0
+
+
+def test_quantile_interpolation_and_overflow_clamp():
+    counts = [0] * (hist.N_BUCKETS + 1)
+    # 100 observations in the bucket (2.048, 4.096]
+    i = hist.bucket_index(3.0)
+    counts[i] = 100
+    q50 = hist.quantile(counts, 0.5)
+    assert hist.BUCKET_BOUNDS_MS[i - 1] < q50 <= hist.BUCKET_BOUNDS_MS[i]
+    # overflow bucket clamps to the top bound
+    counts = [0] * (hist.N_BUCKETS + 1)
+    counts[hist.N_BUCKETS] = 10
+    assert hist.quantile(counts, 0.99) == hist.BUCKET_BOUNDS_MS[-1]
+    assert hist.quantile([0] * (hist.N_BUCKETS + 1), 0.5) is None
+
+
+def test_pack_unpack_merge_roundtrip():
+    hist.observe("stage_device_dispatch_ms", 1.0)
+    hist.observe("stage_ring_rtt_ms", 2.0)
+    flat = hist.pack_all()
+    assert len(flat) == len(hist.STAGE_FAMILIES) * hist.FLAT_WIDTH
+    snap = hist.unpack_flat(flat)
+    assert snap["stage_device_dispatch_ms"][2] == 1
+    assert snap["stage_ring_rtt_ms"][1] == pytest.approx(2.0)
+    merged = hist.merge(snap["stage_ring_rtt_ms"],
+                        snap["stage_ring_rtt_ms"])
+    assert merged[2] == 2 and merged[1] == pytest.approx(4.0)
+    # short/empty blocks (a worker that never heartbeated) are tolerated
+    assert hist.unpack_flat([]) == {}
+
+
+# ----------------------------------------------------------- recorder unit
+
+
+def test_recorder_sampling_is_deterministic_one_in_n():
+    rec = FlightRecorder(sample_n=4, capacity=64)
+    traces = [rec.admit("c", "t", 0) for _ in range(16)]
+    got = [t for t in traces if t is not None]
+    assert len(got) == 4
+    # exactly every 4th admission samples
+    assert [i for i, t in enumerate(traces) if t is not None] == \
+        [3, 7, 11, 15]
+    # observability off: no sampling at all
+    hist.set_enabled(False)
+    assert FlightRecorder(sample_n=1).admit("c", "t", 0) is None
+    hist.set_enabled(True)
+    assert FlightRecorder(sample_n=0).admit("c", "t", 0) is None
+
+
+def test_recorder_stage_deltas_match_injected_sleeps():
+    rec = FlightRecorder(sample_n=1)
+    tr = rec.admit("cid", "a/b", 1)
+    time.sleep(0.03)
+    tr.stamp("admit")
+    time.sleep(0.05)
+    tr.stamp("route")
+    out = rec.finish(tr)
+    st = out["stages"]
+    assert st["admission_ms"] == pytest.approx(30.0, abs=20.0)
+    assert st["route_ms"] == pytest.approx(50.0, abs=20.0)
+    assert out["total_ms"] >= 70.0
+    assert out["client"] == "cid" and out["qos"] == 1
+    # the sampled total feeds the parse->route histogram
+    assert hist.get("stage_parse_route_ms").snapshot()[2] == 1
+    assert len(rec.records) == 1
+
+
+def test_recorder_service_meta_splits_ring_round_trip():
+    rec = FlightRecorder(sample_n=1)
+    tr = rec.admit("c", "t", 0)
+    t = tr.t0
+    tr.stamp("submit")
+    tr.marks[-1] = ("submit", t + 0.001)
+    tr.stamp("match")
+    tr.marks[-1] = ("match", t + 0.011)
+    tr.meta = {"send_t": t + 0.001, "svc_recv": t + 0.003,
+               "svc_done": t + 0.009, "recv_t": t + 0.010,
+               "svc_pid": 777}
+    out = rec.finish(tr)
+    st = out["stages"]
+    assert st["ring_request_ms"] == pytest.approx(2.0, abs=0.01)
+    assert st["service_ms"] == pytest.approx(6.0, abs=0.01)
+    assert st["ring_reply_ms"] == pytest.approx(1.0, abs=0.01)
+    assert out["svc_pid"] == 777
+    assert out["svc_span"] == (t + 0.003, t + 0.009)
+
+
+# ------------------------------------------------------------- trace export
+
+
+def test_chrome_trace_json_well_formed():
+    rec = FlightRecorder(sample_n=1)
+    tr = rec.admit("c1", "x/y", 1)
+    tr.stamp("admit")
+    tr.stamp("route")
+    tr.meta = {"send_t": tr.t0, "svc_recv": tr.t0 + 0.001,
+               "svc_done": tr.t0 + 0.002, "recv_t": tr.t0 + 0.003,
+               "svc_pid": os.getpid() + 1}
+    rec.finish(tr)
+    profiler().record("match", time.monotonic(), 3.5, k=2, batch=64,
+                      bpad=64, compiled=True)
+    trace = chrome_trace(rec.snapshot(), profiler().snapshot(),
+                         node="n1")
+    blob = json.dumps(trace)  # must be JSON-serializable as-is
+    parsed = json.loads(blob)
+    events = parsed["traceEvents"]
+    assert events, "no events emitted"
+    x_events = [e for e in events if e["ph"] == "X"]
+    for e in x_events:
+        assert {"name", "ts", "dur", "pid", "tid"} <= set(e)
+        assert e["dur"] > 0
+    # spans land in SEPARATE pid tracks: worker + service
+    pids = {e["pid"] for e in x_events}
+    assert len(pids) >= 2, "worker and service spans share one pid"
+    svc = [e for e in x_events if e["name"] == "service_fold"]
+    assert svc and svc[0]["pid"] == os.getpid() + 1
+    dev = [e for e in x_events if e["name"] == "device.match"]
+    assert dev and dev[0]["args"]["k"] == 2
+
+
+# --------------------------------------------------------------- profiler
+
+
+def test_profiler_records_and_summary():
+    p = profiler()
+    t = time.monotonic()
+    p.record("match", t, 5.0, k=1, batch=32, bpad=32, compiled=True)
+    p.record("match", t, 1.0, k=8, batch=256, bpad=512, compiled=False)
+    p.record("delta", t, 0.5, dpad=16)
+    assert len(p.snapshot("match")) == 2
+    assert p.snapshot("delta")[0]["dpad"] == 16
+    s = p.summary()
+    assert s["match"]["count"] == 2 and s["match"]["compiles"] == 1
+    assert s["match"]["max_ms"] == 5.0
+    assert "ring_p50_ms" in s["match"]
+    # disabled: nothing records
+    hist.set_enabled(False)
+    p.record("match", t, 9.0)
+    hist.set_enabled(True)
+    assert len(p.snapshot("match")) == 2
+
+
+# -------------------------------------------------------- broker e2e (tpu)
+
+
+@pytest.mark.asyncio
+async def test_broker_e2e_sampled_publishes_record_collector_stages():
+    """Single-process tpu-view broker: sampled publishes yield one
+    record each with collector/dispatch stage deltas, the device seams
+    feed the stage histograms, and `vmq-admin timeline|profile` render
+    them."""
+    from vernemq_tpu.admin.commands import CommandRegistry, \
+        register_core_commands
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    cfg = Config(systree_enabled=False, allow_anonymous=True,
+                 default_reg_view="tpu", flight_recorder_sample_n=2,
+                 tpu_host_batch_threshold=0)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        c = MQTTClient("127.0.0.1", server.port, client_id="obs-e2e")
+        assert (await c.connect()).rc == 0
+        await c.subscribe("a/b")
+        # publish in waves until a sampled record rides a real device
+        # dispatch: the first flushes shed to the trie while the cold
+        # batch shape background-compiles (ensure_warm), and those shed
+        # records legitimately carry no match stage
+        n_pub = 0
+        deadline = time.monotonic() + 30.0
+        full = []
+        while not full and time.monotonic() < deadline:
+            for _ in range(10):
+                await c.publish("a/b", b"p", qos=1)
+            n_pub += 10
+            await asyncio.sleep(0.1)
+            full = [r for r in broker.recorder.snapshot()
+                    if "match_ms" in r["stages"]]
+        assert full, "no record captured the device dispatch stage"
+        assert _poll(lambda: broker.recorder.finished
+                     == broker.recorder.sampled)
+        assert broker.recorder.sampled == n_pub // 2
+        recs = broker.recorder.snapshot()
+        assert len(recs) == n_pub // 2  # ONE record per sampled publish
+        assert "collector_wait_ms" in full[-1]["stages"]
+        # device dispatches observed + profiled
+        assert hist.get("stage_device_dispatch_ms").snapshot()[2] > 0
+        assert hist.get("stage_collector_wait_ms").snapshot()[2] > 0
+        assert any(r["kind"] == "match" for r in profiler().snapshot())
+        # admin surface renders
+        reg = register_core_commands(CommandRegistry())
+        out = reg.run(broker, ["timeline", "show", "n=5"])
+        assert out["recorder"]["flight_sampled"] == n_pub // 2
+        assert out["table"][0]["total_ms"] >= 0
+        prof = reg.run(broker, ["profile", "device"])
+        assert "match" in prof["summary"]
+        await c.disconnect()
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_timeline_dump_writes_valid_chrome_trace(tmp_path):
+    from vernemq_tpu.admin.commands import CommandRegistry, \
+        register_core_commands
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    cfg = Config(systree_enabled=False, allow_anonymous=True,
+                 flight_recorder_sample_n=1)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        c = MQTTClient("127.0.0.1", server.port, client_id="dmp")
+        assert (await c.connect()).rc == 0
+        for _ in range(5):
+            await c.publish("q/r", b"x", qos=1)
+        assert _poll(lambda: broker.recorder.finished >= 5)
+        reg = register_core_commands(CommandRegistry())
+        path = str(tmp_path / "tl.json")
+        out = reg.run(broker, ["timeline", "dump", f"path={path}"])
+        assert out["writing"] == path and out["events"] > 0
+        # the file write runs off-loop (a slow disk must not stall
+        # session IO); the tmp->rename publish makes it atomic
+        assert _poll(lambda: os.path.exists(path))
+        with open(path) as fh:
+            trace = json.load(fh)
+        assert isinstance(trace["traceEvents"], list)
+        assert all("ph" in e and "pid" in e
+                   for e in trace["traceEvents"])
+        await c.disconnect()
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+# ------------------------------------------------- worker-mode fold envelope
+
+
+@pytest.mark.asyncio
+async def test_worker_mode_one_record_per_sampled_publish_with_ring_meta():
+    """Worker-mode e2e over REAL shared-memory rings (service core
+    drained by a thread, as in test_match_service): every sampled
+    publish yields exactly ONE record whose stages include the
+    cross-process ring split (request transit / service residency /
+    reply transit) carried back in the fold envelope."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.match_service import MatchService
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+    from vernemq_tpu.parallel.shm_ring import ShmRing, WorkerStatsBlock
+
+    tag = f"obs{os.getpid() % 100000}"
+    stats = WorkerStatsBlock.create(tag + "s", 1)
+    req = ShmRing.create(tag + "q", 1 << 16)
+    resp = ShmRing.create(tag + "r", 1 << 16)
+    svc = MatchService(stats, [(ShmRing.attach(req.name),
+                                ShmRing.attach(resp.name))])
+    stats.set_service(1, os.getpid())
+    stop = threading.Event()
+
+    def drain():
+        while not stop.is_set():
+            if not svc.poll_once():
+                time.sleep(0.0005)
+
+    th = threading.Thread(target=drain, daemon=True)
+    th.start()
+    broker = server = None
+    try:
+        cfg = Config(systree_enabled=False, allow_anonymous=True,
+                     default_reg_view="tpu", flight_recorder_sample_n=2,
+                     tpu_host_batch_threshold=0,
+                     worker_stats_block=stats.name, worker_index=0,
+                     workers_total=1,
+                     match_service_req_ring=req.name,
+                     match_service_resp_ring=resp.name)
+        broker, server = await start_broker(cfg, port=0,
+                                            node_name="w0")
+        client = broker.match_client
+        assert client is not None
+        # wait out the first-boot resync so folds ride the rings
+        # instead of the ordering-fence local-trie path
+        assert _poll(lambda: not client._need_resync
+                     and client._resync_rows is None)
+        c = MQTTClient("127.0.0.1", server.port, client_id="wm")
+        assert (await c.connect()).rc == 0
+        await c.subscribe("w/t")
+        n_pub = 20
+        for _ in range(n_pub):
+            await c.publish("w/t", b"z", qos=1)
+        assert _poll(lambda: broker.recorder.finished >= n_pub // 2)
+        recs = broker.recorder.snapshot()
+        assert len(recs) == n_pub // 2  # ONE record per sampled publish
+        ringed = [r for r in recs if "ring_request_ms" in r["stages"]]
+        assert ringed, "no record carried the fold-envelope ring split"
+        st = ringed[-1]["stages"]
+        assert st["service_ms"] >= 0 and st["ring_reply_ms"] >= 0
+        assert ringed[-1]["svc_pid"] == os.getpid()
+        assert ringed[-1]["svc_span"][1] >= ringed[-1]["svc_span"][0]
+        # the ring RTT seam observed on the worker side
+        assert hist.get("stage_ring_rtt_ms").snapshot()[2] > 0
+        # the dump spans both "processes" (worker pid + service pid
+        # tracks — same OS pid here, distinct metadata tracks in a
+        # real deployment where the service is its own process)
+        trace = chrome_trace(recs, profiler().snapshot(), node="w0")
+        assert any(e["name"] == "service_fold"
+                   for e in trace["traceEvents"])
+        await c.disconnect()
+    finally:
+        stop.set()
+        th.join(2.0)
+        if broker is not None:
+            await broker.stop()
+        if server is not None:
+            await server.stop()
+        svc.close()
+        for h in (req, resp):
+            h.close()
+            h.unlink()
+        stats.close()
+        stats.unlink()
+
+
+# -------------------------------------------------------- tracer satellite
+
+
+@pytest.mark.asyncio
+async def test_tracer_rate_limit_counts_and_marks_suppressed_frames():
+    """Satellite: the tracer's rate limiter counts what it drops
+    (trace_rate_limited) and prints the '... N frames suppressed'
+    marker when the window reopens — a traced storm reads as visibly
+    truncated."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    cfg = Config(systree_enabled=False, allow_anonymous=True)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        tracer = broker.start_trace("storm", max_rate=(2, 0.2))
+        c = MQTTClient("127.0.0.1", server.port, client_id="storm")
+        assert (await c.connect()).rc == 0
+        for _ in range(10):
+            await c.publish("s/t", b"x", qos=1)
+        assert tracer.suppressed_frames > 0
+        assert broker.metrics.value("trace_rate_limited") == \
+            tracer.suppressed_frames
+        before = tracer.suppressed_frames
+        await asyncio.sleep(0.25)  # window rolls over
+        await c.publish("s/t", b"x", qos=1)  # reopens the window
+        await asyncio.sleep(0.05)
+        lines = tracer.drain()
+        assert any(re.match(r"\.\.\. \d+ frames suppressed", ln)
+                   for ln in lines), lines
+        marker = next(ln for ln in lines
+                      if ln.endswith("frames suppressed"))
+        assert int(marker.split()[1]) == before
+        assert tracer.info()["suppressed_frames"] >= before
+        await c.disconnect()
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+# --------------------------------------------------- graphite percentiles
+
+
+@pytest.mark.asyncio
+async def test_graphite_lines_include_histogram_percentiles():
+    """Satellite: the graphite reporter derives <family>.p50/p99/p999
+    lines from the bucket snapshot — same data the Prometheus _bucket
+    surface carries."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+
+    received = []
+    done = asyncio.Event()
+
+    async def sink(reader, writer):
+        while not done.is_set():
+            data = await reader.read(1 << 16)
+            if not data:
+                break
+            received.append(data)
+            if b".p999 " in b"".join(received):
+                done.set()
+        writer.close()
+
+    gserver = await asyncio.start_server(sink, "127.0.0.1", 0)
+    gport = gserver.sockets[0].getsockname()[1]
+    cfg = Config(systree_enabled=False, allow_anonymous=True,
+                 graphite_enabled=True, graphite_host="127.0.0.1",
+                 graphite_port=gport, graphite_interval=0.1)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        for v in (1.0, 2.0, 3.0, 50.0):
+            broker.metrics.observe("stage_queue_flush_ms", v)
+        await asyncio.wait_for(done.wait(), 10.0)
+        text = b"".join(received).decode()
+        assert re.search(
+            r"vmq\.node1\.stage_queue_flush_ms\.p50 [\d.]+ \d+", text)
+        assert ".stage_queue_flush_ms.p99 " in text
+        assert ".stage_queue_flush_ms.p999 " in text
+    finally:
+        await broker.stop()
+        await server.stop()
+        gserver.close()
+        await gserver.wait_closed()

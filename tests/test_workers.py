@@ -188,8 +188,23 @@ async def test_match_service_fanout_and_ring_folds(ms_group):
     folds0 = g.stats_block().service_info()["folds"]
     got = await _qos1_burst(pub, sub, "a", 40)
     assert got == {b"a-%d" % i for i in range(40)}
+    # the ring path actually serves. Under a loaded host the first
+    # burst can catch the client breaker open (a slow early fold blew
+    # match_service_timeout_ms and every fold degraded to the local
+    # trie — delivery parity held above exactly as designed); the
+    # breaker half-opens within its backoff, so keep nudging small
+    # bursts until the service's fold counter moves.
+    deadline = time.monotonic() + 25.0
+    extra = 0
+    while (g.stats_block().service_info()["folds"] <= folds0
+           and time.monotonic() < deadline):
+        got = await _qos1_burst(pub, sub, f"x{extra}", 5)
+        assert got == {b"x%d-%d" % (extra, i) for i in range(5)}
+        extra += 1
     info = g.stats_block().service_info()
-    assert info["folds"] > folds0  # the ring path actually served
+    assert info["folds"] > folds0, (
+        f"service saw no folds: info={info} alive={g.service_alive()} "
+        f"restarts={g.poll_restart()} slots={g.stats_block().read_all()}")
     await asyncio.sleep(0.6)  # one heartbeat interval
     slots = g.stats_block().read_all()
     assert sum(s["admitted_pubs"] for s in slots) >= 40

@@ -29,6 +29,9 @@ fi
 # IO, input) inside async bodies — the bug class the old fixed-sleep
 # load shedding was (tools/lint_blocking.py)
 python tools/lint_blocking.py || exit 1
+# metrics-registry lint: every counter/gauge/histogram has HELP text,
+# every observe() call site names a registered family
+python tools/lint_metrics.py || exit 1
 
 # hung-test forensics: faulthandler dumps every thread's stack just
 # below the outer timeout wall (tests/conftest.py arms it), so a wedged

@@ -14,6 +14,7 @@ return JSON unchanged (the clique writer split, ``vmq_cli_json_writer``).
 
 from __future__ import annotations
 
+import os
 import secrets
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -257,6 +258,18 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
     reg.register(["breaker", "reset"], _breaker_reset,
                  "vmq-admin breaker reset [mountpoint=] "
                  "[path=match|retained]")
+    reg.register(["timeline", "show"], _timeline_show,
+                 "vmq-admin timeline show [n=20]",
+                 "Recent flight-recorder publish samples with "
+                 "per-stage latency deltas")
+    reg.register(["timeline", "dump"], _timeline_dump,
+                 "vmq-admin timeline dump [path=timeline.json]",
+                 "Export flight-recorder samples + device dispatch "
+                 "records as Chrome trace-event JSON (Perfetto)")
+    reg.register(["profile", "device"], _profile_device,
+                 "vmq-admin profile device [kind=match] [n=20]",
+                 "Per-dispatch device profile: K, batch fill, "
+                 "Bpad/Dpad, compile-vs-execute, rebuild phases")
     reg.register(["overload", "show"], _overload_show,
                  "vmq-admin overload show  (governor level, fused "
                  "signals, per-stage shed counters)")
@@ -1031,6 +1044,94 @@ def _workers_show(broker, flags):
         out["match_client"] = {
             k: int(v) for k, v in broker.match_client.stats_dict().items()}
     return out
+
+
+def _timeline_show(broker, flags):
+    """Recent flight-recorder samples (observability/recorder.py): one
+    row per sampled publish, stage deltas in ms."""
+    n = int(flags.get("n", 20) or 20)
+    recs = broker.recorder.snapshot(limit=n)
+    rows = []
+    for r in recs:
+        row = {"client": r.get("client"), "topic": r.get("topic"),
+               "qos": r.get("qos"), "total_ms": r.get("total_ms"),
+               "pid": r.get("pid")}
+        if r.get("svc_pid"):
+            row["svc_pid"] = r["svc_pid"]
+        row.update(r.get("stages", {}))
+        rows.append(row)
+    if not rows:
+        rows = [{"client": "(no samples yet)", "topic": "",
+                 "qos": "", "total_ms": 0.0, "pid": 0}]
+    st = broker.recorder.stats()
+    return {"table": rows,
+            "recorder": {k: int(v) for k, v in st.items()}}
+
+
+def _timeline_dump(broker, flags):
+    """Chrome trace-event export: flight-recorder publish stages plus
+    device dispatch records on one CLOCK_MONOTONIC axis, pid-tagged so
+    worker and match-service spans land in separate Perfetto tracks."""
+    import json as _json
+    import threading as _threading
+
+    from ..observability import chrome_trace
+    from ..observability.profiler import profiler as _profiler
+
+    trace = chrome_trace(broker.recorder.snapshot(),
+                         _profiler().snapshot(),
+                         node=broker.node_name)
+    path = flags.get("path")
+    if not isinstance(path, str) or not path:
+        path = f"timeline_{broker.node_name}.json"
+    blob = _json.dumps(trace)
+
+    # the admin handlers run ON the event loop (sync fns called from
+    # the async mgmt path): a multi-MB dump to a slow disk must not
+    # stall every session's IO mid-diagnosis — serialize here (cheap,
+    # bounded by the ring caps), write in a throwaway thread. The tmp
+    # name is per-dump unique so two overlapping dumps to one path
+    # can't replace each other's half-written blob, and a write
+    # failure is logged (the command already returned — the broker log
+    # is the only place the operator can see it)
+    def _write(p=path, b=blob):
+        tmp = f"{p}.{os.getpid()}.{_threading.get_ident()}.tmp"
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(b)
+            os.replace(tmp, p)
+        except OSError:
+            import logging
+
+            logging.getLogger("vernemq_tpu.admin").exception(
+                "timeline dump to %r failed", p)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    _threading.Thread(target=_write, name="timeline-dump",
+                      daemon=True).start()
+    return {"writing": path, "events": len(trace["traceEvents"])}
+
+
+def _profile_device(broker, flags):
+    """Per-dispatch device profile records + per-kind aggregates (the
+    operator face of observability/profiler.py)."""
+    from ..observability.profiler import profiler as _profiler
+
+    kind = flags.get("kind")
+    n = int(flags.get("n", 20) or 20)
+    prof = _profiler()
+    rows = [dict(r) for r in prof.snapshot(
+        kind if isinstance(kind, str) else None, limit=n)]
+    for r in rows:
+        r.pop("t0", None)
+    if not rows:
+        rows = [{"kind": "(no dispatches recorded)", "dur_ms": 0.0}]
+    return {"table": rows,
+            "summary": {k: {kk: round(vv, 3) for kk, vv in v.items()}
+                        for k, v in prof.summary().items()}}
 
 
 def _fault_inject(broker, flags):

@@ -56,6 +56,23 @@ class GraphiteReporter:
                 f"{prefix}vmq.{node}.{name} {value} {now}\n"
                 for name, value in self.broker.metrics.all_metrics().items()
             ]
+            # histogram families go out as bucket-derived quantile
+            # summaries (<name>.p50/p99/p999) — parity with the
+            # Prometheus _bucket surface without shipping 33 bucket
+            # series per family over plaintext
+            from ..observability import histogram as _hist
+
+            for name, snap in sorted(
+                    self.broker.metrics.histogram_snapshot().items()):
+                counts, _s, n_obs = snap
+                if not n_obs:
+                    continue
+                for key, q in (("p50", 0.50), ("p99", 0.99),
+                               ("p999", 0.999)):
+                    v = _hist.quantile(counts, q)
+                    if v is not None:
+                        lines.append(f"{prefix}vmq.{node}.{name}.{key} "
+                                     f"{round(v, 4)} {now}\n")
             try:
                 writer.write("".join(lines).encode())
                 await writer.drain()

@@ -80,18 +80,24 @@ class Tracer:
                  max_rate: Tuple[int, float] = (10, 0.1),
                  payload_limit: int = 1000,
                  sink: Optional[Callable[[str], None]] = None,
-                 buffer_size: int = 10_000):
+                 buffer_size: int = 10_000,
+                 metrics: Optional[Any] = None):
         self.client_id = client_id
         self.mountpoint = mountpoint
         self.max_rate = max_rate  # (messages, seconds) — recon-style
         self.payload_limit = payload_limit
         self.sink = sink
+        self.metrics = metrics  # trace_rate_limited counter sink
         self.lines: Deque[str] = deque(maxlen=buffer_size)
         self._rate_count = 0
         self._rate_start = time.monotonic()
         self.rate_tripped = False
         self.started = time.time()
         self.traced_frames = 0
+        # frames the rate limiter dropped: per-window (for the '... N
+        # frames suppressed' marker when the window reopens) and total
+        self._suppressed_window = 0
+        self.suppressed_frames = 0
 
     def matches(self, mountpoint: str, client_id: Optional[str]) -> bool:
         return client_id == self.client_id and mountpoint == self.mountpoint
@@ -103,10 +109,17 @@ class Tracer:
 
     def _rate_ok(self) -> bool:
         """Allowance check (rate_tracer, vmq_tracer.erl:377-390): at most
-        ``max`` events per ``interval``; when tripped, one notice line."""
+        ``max`` events per ``interval``; when tripped, one notice line,
+        and the drops are COUNTED — the window-reopen marker says how
+        many frames the trace is missing, so a traced storm reads as
+        visibly truncated instead of quietly complete."""
         maxn, interval = self.max_rate
         now = time.monotonic()
         if now - self._rate_start > interval:
+            if self._suppressed_window:
+                self._emit(f"... {self._suppressed_window} frames "
+                           "suppressed")
+                self._suppressed_window = 0
             self._rate_start = now
             self._rate_count = 0
             self.rate_tripped = False
@@ -116,6 +129,10 @@ class Tracer:
         if not self.rate_tripped:
             self.rate_tripped = True
             self._emit("Trace rate limit triggered, dropping.")
+        self._suppressed_window += 1
+        self.suppressed_frames += 1
+        if self.metrics is not None:
+            self.metrics.incr("trace_rate_limited")
         return False
 
     def trace(self, direction: str, client_id: str, frame: Any) -> None:
@@ -138,5 +155,6 @@ class Tracer:
             "mountpoint": self.mountpoint,
             "started": self.started,
             "traced_frames": self.traced_frames,
+            "suppressed_frames": self.suppressed_frames,
             "buffered_lines": len(self.lines),
         }

@@ -127,6 +127,18 @@ class Broker:
         self.migrations: Dict[SubscriberId, Dict[str, Any]] = {}
         self._delayed_wills: Dict[SubscriberId, asyncio.Task] = {}
         self.tracer: Optional[Any] = None  # single active session tracer
+        # hot-path flight recorder (observability/recorder.py): the
+        # 1-in-N publish sample decision is made once at admission
+        # (session._handle_publish) and the trace rides the fold
+        # envelope; `vmq-admin timeline show|dump` read the ring. The
+        # dispatch profiler is process-global (observability/profiler)
+        # — the matcher records into it without a broker handle.
+        from ..observability import FlightRecorder
+
+        self.recorder = FlightRecorder(
+            sample_n=int(self.config.get("flight_recorder_sample_n", 32)),
+            capacity=int(self.config.get("flight_recorder_capacity",
+                                         4096)))
         # multi-process session front end (broker/workers.py): when this
         # broker is one of N SO_REUSEPORT workers, the parent hands it a
         # shared stats slot (fused overload pressure, `vmq-admin workers
@@ -373,6 +385,13 @@ class Broker:
             "match_client_op_backlog": "Subscription ops buffered "
                                        "while the request ring is "
                                        "full.",
+            # flight recorder (observability/recorder.py)
+            "flight_sampled": "Publishes sampled by the flight "
+                              "recorder (1-in-N at admission).",
+            "flight_records": "Stage-stamped publish records currently "
+                              "in the flight-recorder ring.",
+            "flight_sample_n": "Flight-recorder sampling divisor "
+                               "(every Nth admitted publish records).",
         })
 
     # ------------------------------------------------------------ plumbing
@@ -420,6 +439,46 @@ class Broker:
         if self._retained_collector is not None:
             out.update(self._retained_collector.stats())
         out.update(self.watchdog.stats())
+        out.update(self.recorder.stats())
+        return out
+
+    def _peer_histograms(self):
+        """Merged stage-histogram blocks of every OTHER live worker
+        (heartbeat-fresh slots only — a dead worker's frozen block must
+        not pin the tail forever). Wired as ``metrics.histogram_extra``
+        in worker mode."""
+        from ..observability import histogram as _hist
+
+        ws = self.worker_stats
+        out = {}
+        if ws is None:
+            return out
+        for i in range(ws.n_workers):
+            if i == self.worker_index:
+                continue
+            slot = ws.read_slot(i)
+            hb = slot.get("heartbeat_age_s")
+            if hb is None or hb > 5.0:
+                continue
+            for name, snap in _hist.unpack_flat(ws.read_hist(i)).items():
+                cur = out.get(name)
+                out[name] = _hist.merge(cur, snap) if cur else snap
+        # the match service's block carries the device-side seams
+        # (dispatch/delta/rebuild run in ITS process) — merged when the
+        # service is live and a DIFFERENT process (an in-process service
+        # shares this worker's registry; merging its block would double
+        # count every observation)
+        try:
+            svc = ws.service_info()
+            if (svc.get("pid") and svc["pid"] != os.getpid()
+                    and svc.get("heartbeat_age_s") is not None
+                    and svc["heartbeat_age_s"] < 5.0):
+                for name, snap in _hist.unpack_flat(
+                        ws.read_service_hist()).items():
+                    cur = out.get(name)
+                    out[name] = _hist.merge(cur, snap) if cur else snap
+        except Exception:
+            pass
         return out
 
     def cluster_ready(self) -> bool:
@@ -839,6 +898,8 @@ class Broker:
         level/pressure pair is written by the governor's own tick and
         the loop-lag samples by sysmon — every field has exactly one
         writer, so the block needs no locking."""
+        from ..observability import histogram as _hist
+
         ws = self.worker_stats
         idx = self.worker_index
         while True:
@@ -846,6 +907,11 @@ class Broker:
                 ws.write_health(
                     idx, pid=os.getpid(), sessions=len(self.sessions),
                     admitted=self.metrics.value("mqtt_publish_received"))
+                # publish this worker's stage histograms into its slot:
+                # the scrape-point aggregation reads every live slot so
+                # ANY worker's /metrics (and the parent's bench read)
+                # shows the node-level merged families
+                ws.write_hist(idx, _hist.pack_all())
             except Exception:
                 log.exception("worker stats heartbeat failed")
             await asyncio.sleep(interval)
@@ -894,6 +960,7 @@ class Broker:
             raise RuntimeError("another trace is already running")
         from ..admin.tracer import Tracer
 
+        opts.setdefault("metrics", self.metrics)
         self.tracer = Tracer(client_id, mountpoint, **opts)
         n = sum(1 for sid in self.sessions
                 if sid == (mountpoint, client_id))
@@ -936,6 +1003,17 @@ class Broker:
     async def start(self) -> None:
         self._log_handlers: List[Any] = []
         self._setup_logging()
+        # observability master switch: off reduces every histogram/
+        # profiler seam to one module-global boolean test (the bench
+        # overhead guard measures exactly this difference). The flag is
+        # process-global like the registries it gates.
+        from ..observability import histogram as _hist
+        from ..observability import profiler as _profiler
+
+        _hist.set_enabled(
+            bool(self.config.get("observability_enabled", True)))
+        _profiler().set_capacity(
+            int(self.config.get("profiler_capacity", 2048)))
         # warm-load from persisted metadata: routing state, offline queues,
         # retain cache (boot order of vmq_server_sup + vmq_reg_trie /
         # vmq_retain_srv warm-loads)
@@ -1014,6 +1092,11 @@ class Broker:
 
             try:
                 self.worker_stats = WorkerStatsBlock.attach(stats_name)
+                # scrape-point histogram aggregation: merge the OTHER
+                # live workers' slot blocks into this worker's scrape
+                # (our own observations come from the live in-process
+                # registry, which is fresher than our own slot)
+                self.metrics.histogram_extra = self._peer_histograms
             except Exception:
                 log.exception("worker stats block %r unavailable; "
                               "running without fused worker pressure",

@@ -295,6 +295,18 @@ DEFAULTS: Dict[str, Any] = {
     # half-open peers whose writes succeed but whose acks never arrive.
     # 0 disables.
     "cluster_stall_timeout_s": 10.0,
+    # observability (vernemq_tpu/observability/): stage latency
+    # histograms + publish-path flight recorder + device dispatch
+    # profiler. Off reduces every instrumented seam to one boolean test
+    # (the bench overhead guard measures the difference).
+    "observability_enabled": True,
+    # flight recorder: every Nth admitted publish carries a stage-
+    # stamped trace through the whole path (0 disables sampling)
+    "flight_recorder_sample_n": 32,
+    "flight_recorder_capacity": 4096,
+    # device dispatch profiler ring (records kept for `vmq-admin
+    # profile device` / `timeline dump`)
+    "profiler_capacity": 2048,
     "crl_refresh_interval": 60.0,  # seconds (vmq_crl_srv schema knob)
     "swc_replication_groups": 8,  # reference runs 10 (vmq_swc_plugin.erl:36-44)
     "swc_sync_interval": 2.0,  # seconds between AE rounds (sync_interval)

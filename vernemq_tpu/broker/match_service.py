@@ -58,6 +58,7 @@ from typing import Any, Deque, Dict, Iterable, List, Optional, Sequence, \
 
 from ..models.trie import SubscriptionTrie
 from ..models.tpu_matcher import DeviceDegraded
+from ..observability import histogram as obs
 from ..parallel.shm_ring import RingClosed, RingFull, ShmRing, \
     WorkerStatsBlock
 from ..robustness import watchdog as watchdog_mod
@@ -260,14 +261,22 @@ class MatchService:
             log.exception("undecodable ring record from worker %d", widx)
             return
         if kind == "fold":
-            _, req_id, mp, topics = rec
+            _, req_id, mp, topics = rec[:4]
+            # flight-recorder envelope: a 5th element marks a traced
+            # fold — the reply then carries this process's receive/done
+            # CLOCK_MONOTONIC stamps + pid so the worker's recorder can
+            # split the ring round trip into request transit / service
+            # residency / reply transit (recorder.PublishTrace.meta)
+            traced = len(rec) > 4 and bool(rec[4])
+            t_recv = time.monotonic() if traced else 0.0
             self.folds += 1
             self.fold_pubs += len(topics)
             if self._collector is not None:
                 fut = self._collector.submit_batch(mp, topics)
 
                 def _done(f, widx=widx, req_id=req_id,
-                          mp=mp, topics=topics):
+                          mp=mp, topics=topics, t_recv=t_recv,
+                          traced=traced):
                     exc = f.exception()
                     if exc is not None:
                         # the collector itself degrades to the service
@@ -275,6 +284,9 @@ class MatchService:
                         self.fold_errors += 1
                         self._respond(widx,
                                       (req_id, "err", repr(exc)))
+                    elif traced:
+                        self._respond(widx, (req_id, "ok", f.result(),
+                                             self._fold_meta(t_recv)))
                     else:
                         self._respond(widx, (req_id, "ok", f.result()))
 
@@ -282,7 +294,11 @@ class MatchService:
             else:
                 trie = self.trie(mp)
                 rows = [trie.match(list(t)) for t in topics]
-                self._respond(widx, (req_id, "ok", rows))
+                if traced:
+                    self._respond(widx, (req_id, "ok", rows,
+                                         self._fold_meta(t_recv)))
+                else:
+                    self._respond(widx, (req_id, "ok", rows))
         elif kind == "sub":
             _, mp, fw, key, opts = rec
             self.apply_sub(mp, fw, key, opts)
@@ -296,6 +312,11 @@ class MatchService:
         else:
             log.warning("unknown ring record kind %r from worker %d",
                         kind, widx)
+
+    @staticmethod
+    def _fold_meta(t_recv: float) -> Dict[str, float]:
+        return {"svc_recv": t_recv, "svc_done": time.monotonic(),
+                "svc_pid": os.getpid()}
 
     #: unsent responses older than this are dropped — the worker's fold
     #: timed out long ago and is serving its local trie already
@@ -341,6 +362,13 @@ class MatchService:
         self.stats.service_heartbeat()
         self.stats.set_service_counters(self.ops_applied, self.folds,
                                         self.fold_pubs)
+        # the device-side stage histograms (dispatch/delta/rebuild/
+        # collector wait) live in THIS process; publishing the packed
+        # block is the only way they reach a worker's scrape endpoint
+        try:
+            self.stats.write_service_hist(obs.pack_all())
+        except Exception:
+            pass  # an old-layout block (no hist region) stays healthy
 
     async def run(self, stop: asyncio.Event,
                   idle_min_s: float = 0.0003,
@@ -426,12 +454,13 @@ class _ResponseMux:
         self._draining = False
         self._last_prune = 0.0
 
-    def wait_for(self, req_id: int, deadline: float) -> Tuple[str, Any]:
+    def wait_for(self, req_id: int,
+                 deadline: float) -> Tuple[str, Any, Optional[dict]]:
         while True:
             with self._cond:
                 if req_id in self._resp:
-                    _, status, payload = self._resp.pop(req_id)
-                    return (status, payload)
+                    _, status, payload, meta = self._resp.pop(req_id)
+                    return (status, payload, meta)
                 if self._draining:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
@@ -449,7 +478,8 @@ class _ResponseMux:
                     self._cond.notify_all()
 
     def _drain(self, req_id: int,
-               deadline: float) -> Optional[Tuple[str, Any]]:
+               deadline: float) -> Optional[Tuple[str, Any,
+                                                  Optional[dict]]]:
         while True:
             recs = self._ring.pop_many()
             if recs:
@@ -458,17 +488,19 @@ class _ResponseMux:
                     out = None
                     for raw in recs:
                         try:
-                            rid, status, payload = _dec(raw)
+                            rec = _dec(raw)
+                            rid, status, payload = rec[0], rec[1], rec[2]
+                            meta = rec[3] if len(rec) > 3 else None
                         except Exception:
                             continue
                         if rid == req_id:
-                            out = (status, payload)
+                            out = (status, payload, meta)
                         else:
-                            self._resp[rid] = (now, status, payload)
+                            self._resp[rid] = (now, status, payload, meta)
                     if self._resp and now - self._last_prune > 1.0:
                         self._last_prune = now
                         cutoff = now - self.STALE_TTL_S
-                        for rid in [r for r, (ts, _, _)
+                        for rid in [r for r, (ts, *_)
                                     in self._resp.items() if ts < cutoff]:
                             del self._resp[rid]
                     self._cond.notify_all()
@@ -554,12 +586,18 @@ class MatchServiceClient:
     # ------------------------------------------------------------- fold
 
     def fold(self, mountpoint: str,
-             topics: Sequence[Tuple[str, ...]]) -> List[List[Tuple]]:
+             topics: Sequence[Tuple[str, ...]],
+             meta_out: Optional[dict] = None) -> List[List[Tuple]]:
         """Round-trip one batch of publish topics through the service.
         BLOCKING — call from an executor/sacrificial thread only (the
         BatchCollector already runs its flushes there). Raises
         DeviceDegraded when the service can't serve promptly; the
-        caller's shed path serves the local trie."""
+        caller's shed path serves the local trie.
+
+        ``meta_out`` (flight recorder): when given, the fold is marked
+        traced in the envelope and this dict is filled with the ring
+        send/receive stamps plus the service's own receive/done stamps
+        and pid — the cross-process half of ONE publish record."""
         if self._closed:
             raise DeviceDegraded("match service client closed")
         if not self.breaker.allow():
@@ -581,8 +619,13 @@ class MatchServiceClient:
             self.fold_held += 1
             raise DeviceDegraded("match service op backlog pending")
         req_id = next(self._ids)
-        data = _enc(("fold", req_id, mountpoint,
-                     [tuple(t) for t in topics]))
+        if meta_out is None:
+            data = _enc(("fold", req_id, mountpoint,
+                         [tuple(t) for t in topics]))
+        else:
+            data = _enc(("fold", req_id, mountpoint,
+                         [tuple(t) for t in topics], True))
+        send_t = time.monotonic()
         try:
             with self._req_lock:
                 ok = self.req.push(data)
@@ -596,7 +639,7 @@ class MatchServiceClient:
         self.fold_pubs_sent += len(topics)
         deadline = time.monotonic() + self.timeout_s
         try:
-            status, payload = self._mux.wait_for(req_id, deadline)
+            status, payload, meta = self._mux.wait_for(req_id, deadline)
         except TimeoutError as e:
             self.fold_timeouts += 1
             self._mux.forget(req_id)
@@ -605,9 +648,21 @@ class MatchServiceClient:
         except RingClosed as e:
             self._fold_failed()
             raise DeviceDegraded("match service ring closed") from e
+        recv_t = time.monotonic()
+        # per-fold ring round trip (request push -> reply landed): the
+        # seam the match_service_timeout_ms knob is judged against.
+        # Straggler-guarded: a watchdog-abandoned fold's late reply
+        # must not record its wedge-inflated RTT into the tuning base
+        if not watchdog_mod.current_op_abandoned():
+            obs.observe("stage_ring_rtt_ms", (recv_t - send_t) * 1e3)
         if status != "ok":
             self._fold_failed()
             raise DeviceDegraded(f"match service error: {payload}")
+        if meta_out is not None:
+            meta_out["send_t"] = send_t
+            meta_out["recv_t"] = recv_t
+            if meta:
+                meta_out.update(meta)
         if not watchdog_mod.current_op_abandoned():
             # a watchdog-abandoned fold's straggler reply must not close
             # the breaker its own stall just fed (record_stall) — same
@@ -811,6 +866,10 @@ class ShmMatchView:
     worker's local trie through the standard shed exceptions."""
 
     name = "tpu"
+    #: BatchCollector probes this: fold_batch/fold_many accept a
+    #: meta_out box that comes back filled with the cross-process ring
+    #: stamps for a traced flush (flight recorder envelope)
+    fold_meta_capable = True
 
     def __init__(self, registry, client: MatchServiceClient):
         self.registry = registry
@@ -830,16 +889,19 @@ class ShmMatchView:
 
     def fold_batch(self, mountpoint: str,
                    topics: Sequence[Sequence[str]],
-                   lock_timeout: Optional[float] = None):
-        return self.client.fold(mountpoint, [tuple(t) for t in topics])
+                   lock_timeout: Optional[float] = None,
+                   meta_out: Optional[dict] = None):
+        return self.client.fold(mountpoint, [tuple(t) for t in topics],
+                                meta_out=meta_out)
 
     def fold_many(self, mountpoint: str,
                   batches: Sequence[Sequence[Sequence[str]]],
-                  lock_timeout: Optional[float] = None):
+                  lock_timeout: Optional[float] = None,
+                  meta_out: Optional[dict] = None):
         flat: List[Tuple[str, ...]] = []
         for b in batches:
             flat.extend(tuple(t) for t in b)
-        rows = self.client.fold(mountpoint, flat)
+        rows = self.client.fold(mountpoint, flat, meta_out=meta_out)
         out, i = [], 0
         for b in batches:
             out.append(rows[i:i + len(b)])

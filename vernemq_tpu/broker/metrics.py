@@ -17,6 +17,8 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..observability import histogram as _hist
+
 COUNTERS: List[Tuple[str, str]] = [
     # socket / session counters (vmq_metrics.hrl names)
     ("socket_open", "The number of AF_INET opens."),
@@ -152,6 +154,12 @@ COUNTERS: List[Tuple[str, str]] = [
     ("overload_talker_disconnects",
      "Heaviest-talker sessions disconnected (Server busy) entering "
      "overload level 3."),
+    # observability (admin/tracer.py): frames the per-client tracer's
+    # rate limiter suppressed — a traced storm is visibly truncated
+    ("trace_rate_limited",
+     "Traced frames suppressed by the tracer rate limiter "
+     "(max_rate); the trace output carries a '... N frames "
+     "suppressed' marker when the window reopens."),
 ]
 
 
@@ -177,6 +185,11 @@ class Metrics:
         self._gauge_providers: List[Callable[[], Dict[str, float]]] = []
         self._gauge_desc: Dict[str, str] = {}
         self._rate_state: Dict[object, Tuple[float, int]] = {}
+        # worker-mode scrape aggregation hook: a callable returning
+        # peer workers' histogram blocks (name -> (counts, sum, count))
+        # merged into prometheus_text/histogram_snapshot
+        self.histogram_extra: Optional[
+            Callable[[], Dict[str, Tuple[List[int], float, int]]]] = None
         # wait-free native counter block for the registered counters (the
         # mzmetrics seat); unknown/dynamic names stay in the dict
         self._native = None
@@ -223,6 +236,30 @@ class Metrics:
         tl.ops += 1
         if tl.ops >= self._FLUSH_OPS:
             self._flush_own()
+
+    def observe(self, name: str, ms: float) -> None:
+        """Record one latency observation into a registered stage
+        histogram (observability/histogram.py). The registry is
+        process-global; this seam exists so layers holding a Metrics
+        handle (cluster spool, queues) need no second import."""
+        _hist.observe(name, ms)  # lint: observe-passthrough
+
+    def histogram_snapshot(self) -> Dict[str, Tuple[List[int], float, int]]:
+        """Merged histogram families: this process's registry plus
+        whatever ``histogram_extra`` contributes (the broker wires the
+        other workers' shm stat-slot blocks in worker mode) — name ->
+        (bucket counts incl. overflow, sum_ms, count)."""
+        snap = _hist.snapshot_all()
+        extra = self.histogram_extra
+        if extra is not None:
+            try:
+                for name, peer in extra().items():
+                    cur = snap.get(name)
+                    snap[name] = (_hist.merge(cur, peer)
+                                  if cur is not None else peer)
+            except Exception:
+                pass  # a torn slot read must never break the scrape
+        return snap
 
     def incr_labeled(self, name: str, n: int = 1, **labels: str) -> None:
         """Count into a labeled series (per-reason-code families). The
@@ -351,6 +388,14 @@ class Metrics:
             out[f"{name}{{{lbl}}}"] = val
         for provider in self._gauge_providers:
             out.update(provider())
+        # histogram families surface in the $SYS feed as count/sum
+        # scalars (rate + mean are derivable); the bucket vectors are
+        # Prometheus-exposition-only and the quantiles are the graphite
+        # reporter's <name>.p50/p99/p999 — one home per representation
+        for name, snap in self.histogram_snapshot().items():
+            _counts, s, n = snap
+            out[f"{name}_count"] = float(n)
+            out[f"{name}_sum"] = round(s, 3)
         return out
 
     def prometheus_text(self, node: str = "local") -> str:
@@ -382,4 +427,23 @@ class Metrics:
             lines.append(f"# HELP {name} {desc}")
             lines.append(f"# TYPE {name} gauge")
             lines.append(f'{name}{{node="{node}"}} {val}')
+        # stage latency histograms: proper _bucket/_sum/_count families
+        # with cumulative le buckets (observability/histogram.py); in
+        # worker mode the snapshot already merged every live worker's
+        # shm slot, so any worker's scrape is the node-level view
+        helps = dict(_hist.STAGE_FAMILIES)
+        for name, snap in sorted(self.histogram_snapshot().items()):
+            counts, s, n = snap
+            lines.append(f"# HELP {name} {helps.get(name, name)}")
+            lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            for i, bound in enumerate(_hist.BUCKET_BOUNDS_MS):
+                cum += counts[i]
+                lines.append(f'{name}_bucket{{node="{node}",'
+                             f'le="{bound:g}"}} {cum}')
+            cum += counts[_hist.N_BUCKETS]
+            lines.append(
+                f'{name}_bucket{{node="{node}",le="+Inf"}} {cum}')
+            lines.append(f'{name}_sum{{node="{node}"}} {round(s, 6)}')
+            lines.append(f'{name}_count{{node="{node}"}} {n}')
         return "\n".join(lines) + "\n"

@@ -264,10 +264,15 @@ class SubscriberQueue:
         order until it refuses again. Peek-then-pop: a refused head must
         stay at the FRONT or same-subscriber delivery reorders
         (MQTT-4.6.0)."""
+        if not self.backlog:
+            return
+        t0 = time.monotonic()
         while self.backlog and self.state == ONLINE and self.sessions:
             if not self._try_sessions(self.backlog[0]):
                 break
             self.backlog.popleft()
+        self.broker.metrics.observe(
+            "stage_queue_flush_ms", (time.monotonic() - t0) * 1e3)
 
     def _enqueue_offline(self, msg: Msg) -> None:
         if self.opts.clean_session:

@@ -698,25 +698,33 @@ class Registry:
         return self.route_rows(msg, rows, from_sid)
 
     async def publish_async(
-        self, msg: Msg, from_sid: Optional[SubscriberId] = None
+        self, msg: Msg, from_sid: Optional[SubscriberId] = None,
+        trace=None,
     ) -> int:
         """Batched publish path: retain handling is synchronous (local
         read-your-writes ordering like the reference's synchronous trie
         events), then the match rides the broker's BatchCollector — many
-        concurrent publishes share one device call."""
+        concurrent publishes share one device call. ``trace`` (flight
+        recorder) rides the collector item into the fold envelope."""
         msg = self._pre_publish(msg)
-        rows = await self.broker.batch_collector().submit(msg.mountpoint, msg.topic)
+        rows = await self.broker.batch_collector().submit(
+            msg.mountpoint, msg.topic, trace)
         return self.route_rows(msg, rows, from_sid)
 
-    def publish_nowait(self, msg: Msg, from_sid: Optional[SubscriberId] = None) -> int:
+    def publish_nowait(self, msg: Msg,
+                       from_sid: Optional[SubscriberId] = None,
+                       trace=None) -> int:
         """QoS0 fast path for the batched view: submit to the collector and
         route when the batch resolves, without blocking the session reader
         on the batch window (a single publisher would otherwise get exactly
         one message per window). Retain handling stays synchronous so local
         read-your-writes ordering holds. Per-publisher delivery order is
-        preserved by collector submission order."""
+        preserved by collector submission order. A sampled publish's
+        ``trace`` finishes here, after route_rows — the record's route
+        stage covers the fanout work too."""
         msg = self._pre_publish(msg)
-        fut = self.broker.batch_collector().submit(msg.mountpoint, msg.topic)
+        fut = self.broker.batch_collector().submit(
+            msg.mountpoint, msg.topic, trace)
 
         def _done(f: "asyncio.Future") -> None:
             exc = f.exception()
@@ -724,6 +732,9 @@ class Registry:
                 self.broker.metrics.incr("mqtt_publish_error")
                 return
             self.route_rows(msg, f.result(), from_sid)
+            if trace is not None:
+                trace.stamp("route")
+                self.broker.recorder.finish(trace)
 
         fut.add_done_callback(_done)
         return 0

@@ -82,6 +82,15 @@ FLAT_ALIASES.update({
     "workers.match_service_timeout_ms": "match_service_timeout_ms",
 })
 
+#: extension family: the hot-path flight recorder / stage histograms
+#: (vernemq_tpu/observability/) — same dotted-tree discipline
+FLAT_ALIASES.update({
+    "observability.enabled": "observability_enabled",
+    "observability.sample_n": "flight_recorder_sample_n",
+    "observability.recorder_capacity": "flight_recorder_capacity",
+    "observability.profiler_capacity": "profiler_capacity",
+})
+
 #: reference knobs typed in MILLISECONDS whose internal knob is seconds
 MS_TO_SECONDS = {
     "systree_interval",

@@ -489,6 +489,12 @@ class Session:
 
     async def _handle_publish(self, f: Publish) -> None:
         cfg = self.broker.config
+        # flight recorder: the ONE 1-in-N sample decision, made here at
+        # admission; the trace context rides the whole routing path
+        # (including the match-service fold envelope) and yields ONE
+        # record with per-stage deltas (observability/recorder.py)
+        trace = self.broker.recorder.admit(self.client_id or "",
+                                           f.topic, f.qos)
         # NOTE max_message_size is enforced at the PARSER as a frame cap
         # for every packet type (vmq_parser.erl semantics; server.py
         # steady-state loop incrs mqtt_invalid_msg_size_error and sends
@@ -626,11 +632,14 @@ class Session:
         expiry = props.get("message_expiry_interval")
         if expiry:
             msg.expires_at = time.monotonic() + expiry
+        if trace is not None:
+            # gates passed, topic validated, auth done: admitted
+            trace.stamp("admit")
 
         if f.qos == 0:
-            await self._route(msg, nowait=True)
+            await self._route(msg, nowait=True, trace=trace)
         elif f.qos == 1:
-            matches = await self._route(msg)
+            matches = await self._route(msg, trace=trace)
             if matches < 0:
                 # internal routing failure: withhold the PUBACK so the
                 # client's DUP retry re-routes (same contract as QoS2 below)
@@ -644,7 +653,7 @@ class Session:
         else:  # qos 2: route on first arrival, dedup until PUBREL
             if f.packet_id not in self.awaiting_rel:
                 self.awaiting_rel[f.packet_id] = time.monotonic()
-                n = await self._route(msg)
+                n = await self._route(msg, trace=trace)
                 if n < 0:
                     # internal routing failure: forget the packet id so the
                     # client's DUP retry re-routes instead of being deduped
@@ -653,21 +662,30 @@ class Session:
             self.send(Pubrec(packet_id=f.packet_id))
             self.broker.metrics.incr("mqtt_pubrec_sent")
 
-    async def _route(self, msg: Msg, nowait: bool = False) -> int:
+    async def _route(self, msg: Msg, nowait: bool = False,
+                     trace=None) -> int:
         """Route via the registry; returns match count, or -1 on an internal
         matcher failure (distinct from the not_ready gate: internal errors
         are logged and, for QoS2, leave the packet eligible for re-route on
         the client's DUP retry). ``nowait`` (QoS0 under the batched view)
         submits without awaiting the batch window so one publisher can fill
-        a batch instead of sending one message per window."""
+        a batch instead of sending one message per window. ``trace`` is
+        the flight-recorder context of a sampled publish; the registry
+        finishes it when routing completes (async for nowait)."""
         try:
             if self.broker.registry.batched_view_active():
                 if nowait:
-                    n = self.broker.registry.publish_nowait(msg, from_sid=self.sid)
+                    n = self.broker.registry.publish_nowait(
+                        msg, from_sid=self.sid, trace=trace)
+                    trace = None  # finished by the route callback
                 else:
-                    n = await self.broker.registry.publish_async(msg, from_sid=self.sid)
+                    n = await self.broker.registry.publish_async(
+                        msg, from_sid=self.sid, trace=trace)
             else:
                 n = self.broker.registry.publish(msg, from_sid=self.sid)
+            if trace is not None:
+                trace.stamp("route")
+                self.broker.recorder.finish(trace)
         except RuntimeError as e:
             self.broker.metrics.incr("mqtt_publish_error")
             if e.args != ("not_ready",):

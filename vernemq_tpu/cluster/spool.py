@@ -68,6 +68,9 @@ class _NullMetrics:
     def incr(self, name: str, n: int = 1) -> None:
         pass
 
+    def observe(self, name: str, ms: float) -> None:
+        pass
+
 
 class _MemJournal:
     """In-process journal (``cluster_spool_dir`` unset): replay across
@@ -212,12 +215,16 @@ class _PeerState:
     """Per-peer spool bookkeeping (all event-loop-thread)."""
 
     __slots__ = ("next_seq", "pending", "bytes", "blocked", "last_ack_at",
-                 "cursor", "last_progress_at")
+                 "cursor", "last_progress_at", "journaled_at")
 
     def __init__(self) -> None:
         self.next_seq = 1
         # seq -> frame bytes length, ascending insertion order
         self.pending: "OrderedDict[int, int]" = OrderedDict()
+        # seq -> journal time (monotonic) for the ack-RTT histogram;
+        # parallels pending (recovered-from-disk seqs have no stamp and
+        # are skipped — a restart must not pollute the RTT tail)
+        self.journaled_at: Dict[int, float] = {}
         self.bytes = 0
         # True once a frame failed to buffer: subsequent spooled frames
         # journal without sending (per-peer order must not invert) until
@@ -306,6 +313,7 @@ class ClusterSpool:
         or real) — the caller then sends best-effort on the legacy path.
         """
         st = self._state(peer)
+        t0 = time.monotonic()
         try:
             # event-loop-side seam like broker.store_offline: injected
             # latency models a slow spool disk, capped so a hang drill
@@ -326,10 +334,13 @@ class ClusterSpool:
             log.exception("spool journal write for %s failed "
                           "(frame sent best-effort, durability lost)", peer)
             return None
+        done = time.monotonic()
+        self.metrics.observe("stage_spool_journal_ms", (done - t0) * 1e3)
         st.next_seq = seq + 1
         if not st.pending:
-            st.last_ack_at = time.monotonic()
+            st.last_ack_at = done
             st.last_progress_at = st.last_ack_at
+        st.journaled_at[seq] = done
         st.pending[seq] = len(data)
         st.bytes += len(data)
         self._bytes += len(data)
@@ -342,6 +353,7 @@ class ClusterSpool:
         if st is None:
             return 0
         pk = _peer_key(peer)
+        now = time.monotonic()
         n = 0
         for s in list(st.pending):
             if s > seq:
@@ -350,6 +362,12 @@ class ClusterSpool:
             st.bytes -= size
             self._bytes -= size
             self._kv.delete(b"s" + pk + s.to_bytes(8, "big"))
+            t_j = st.journaled_at.pop(s, None)
+            if t_j is not None:
+                # journal->cumulative-ack round trip per frame: the
+                # measured base for cluster_stall_timeout_s tuning
+                self.metrics.observe("stage_cluster_ack_rtt_ms",
+                                     (now - t_j) * 1e3)
             n += 1
         if n:
             st.last_ack_at = time.monotonic()
@@ -445,6 +463,7 @@ class ClusterSpool:
                 nbytes += size
             self._bytes -= st.bytes
             st.pending.clear()
+            st.journaled_at.clear()
             st.bytes = 0
             st.blocked = False
             st.cursor = 0

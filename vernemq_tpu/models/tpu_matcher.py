@@ -21,11 +21,14 @@ Pieces:
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from typing import Any, Dict, Hashable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..observability import histogram as obs
+from ..observability.profiler import record_dispatch
 from ..ops import match_kernel as K
 from ..robustness import faults
 from ..robustness import watchdog as watchdog_mod
@@ -34,6 +37,12 @@ from ..robustness.watchdog import StallAbandoned
 from .tpu_table import SubscriptionTable
 
 Row = Tuple[Tuple[str, ...], Hashable, Any]
+
+#: background-rebuild threads stash their abandon token here so the
+#: observability seams inside _build_device can tell a healthy build
+#: from a watchdog-abandoned straggler (threading.local: concurrent
+#: old-abandoned + fresh rebuild threads each see their own token)
+_rebuild_tls = threading.local()
 
 TILE_PUBS = 256  # pubs per window tile (MXU row-tile friendly)
 FAIR_MULT = 2    # window width vs per-tile fair share of the zone (the
@@ -400,16 +409,32 @@ class TpuMatcher:
         """Device-side half of a full build (no lock held): upload the
         snapshot and derive the coded operands + packed meta."""
         faults.inject("device.rebuild")
+        t0 = time.monotonic()
         put = lambda a: self._jax.device_put(a, self.device)
         dev = (put(state["words"]), put(state["eff_len"]),
                put(state["has_hash"]), put(state["first_wild"]),
                put(state["active"]))
+        t_upload = time.monotonic()
         # derived coded operands (F/t1) live device-side next to the
         # base arrays; id_bits growth (interner crossing a byte plane)
         # forces this full rebuild path too
         operands = (K.build_operands(dev[0], dev[1], state["bits"])
                     if state["bits"] else None)
         meta = K.pack_meta(*dev[1:5]) if self.packed_io else None
+        done = time.monotonic()
+        # a watchdog-abandoned build's straggler must not record its
+        # wedge-inflated duration: stage_rebuild_ms is the tuning base
+        # for watchdog_rebuild_deadline_s — one drill would pin its
+        # max/p99.9 forever (same discard rule as the breaker verdict)
+        tok = getattr(_rebuild_tls, "token", None)
+        if not (tok and tok.get("abandoned")) \
+                and not watchdog_mod.current_op_abandoned():
+            obs.observe("stage_rebuild_ms", (done - t0) * 1e3)
+            record_dispatch(
+                "rebuild", t0, (done - t0) * 1e3,
+                rows=int(state["words"].shape[0]),
+                upload_ms=round((t_upload - t0) * 1e3, 3),
+                operands_ms=round((done - t_upload) * 1e3, 3))
         return dev, operands, meta
 
     def ensure_warm(self, n: int) -> None:
@@ -602,6 +627,7 @@ class TpuMatcher:
               if wd is not None and self.rebuild_deadline_s > 0 else None)
 
         def _run() -> None:
+            _rebuild_tls.token = token  # observability straggler guard
             try:
                 try:
                     built = self._build_device(state)
@@ -737,6 +763,17 @@ class TpuMatcher:
 
     def _apply_delta_device_impl(self, slots: np.ndarray) -> None:
         faults.inject("device.delta")
+        t_obs = time.monotonic()
+        self._apply_delta_device_inner(slots)
+        # success-only + straggler-guarded: a failed or watchdog-
+        # abandoned scatter must not feed the sub_to_matchable tuning
+        # base with fault/wedge durations
+        if not watchdog_mod.current_op_abandoned():
+            dur = (time.monotonic() - t_obs) * 1e3
+            obs.observe("stage_delta_scatter_ms", dur)
+            record_dispatch("delta", t_obs, dur, dpad=int(len(slots)))
+
+    def _apply_delta_device_inner(self, slots: np.ndarray) -> None:
         t = self.table
         sw, el, hh, fw, ac = self._dev_arrays
         # donating scatters update in place (a 128-slot delta at 5M subs
@@ -1016,6 +1053,8 @@ class TpuMatcher:
             self.match_batches += 1
             self.match_publishes += len(topics)
             self._last_shape = ("batch", len(topics))
+        t_disp = time.monotonic()
+        warm_before = len(self._warm_sigs)
         try:
             if bucketed:
                 idx_rows, need_host = self._match_windowed(
@@ -1054,6 +1093,21 @@ class TpuMatcher:
             self._record_device_failure(e)
         else:
             self._record_device_success(_warmup)
+            # straggler guard: a watchdog-abandoned dispatch's late
+            # completion must not record its wedge-inflated duration —
+            # this histogram is the tuning base for
+            # watchdog_dispatch_deadline_ms (same rule as the breaker
+            # verdict suppression in _record_device_success)
+            if not _warmup and not watchdog_mod.current_op_abandoned():
+                dur = (time.monotonic() - t_disp) * 1e3
+                obs.observe("stage_device_dispatch_ms", dur)
+                record_dispatch(
+                    "match", t_disp, dur, k=1, batch=len(topics),
+                    bpad=int(pw.shape[0]),
+                    # a dispatch that grew the warm-signature set just
+                    # paid an XLA compile; everything else executed a
+                    # cached executable (compile-vs-execute detection)
+                    compiled=len(self._warm_sigs) > warm_before)
         finally:
             with self.lock:
                 self._inflight -= 1
@@ -1168,6 +1222,8 @@ class TpuMatcher:
             self.match_publishes += n_pubs
             self._last_shape = ("many", len(batches),
                                 max(len(b) for b in batches))
+        t_disp = time.monotonic()
+        warm_before = len(self._warm_sigs)
         try:
             preps: List[tuple] = []
             lefts: List[set] = []
@@ -1197,6 +1253,14 @@ class TpuMatcher:
             self._record_device_failure(e)
         else:
             self._record_device_success(_warmup)
+            # straggler guard — see match_batch
+            if not _warmup and not watchdog_mod.current_op_abandoned():
+                dur = (time.monotonic() - t_disp) * 1e3
+                obs.observe("stage_device_dispatch_ms", dur)
+                record_dispatch(
+                    "match", t_disp, dur, k=len(batches), batch=n_pubs,
+                    bpad=int(Bpad),
+                    compiled=len(self._warm_sigs) > warm_before)
         finally:
             with self.lock:
                 self._inflight -= 1
@@ -1732,7 +1796,13 @@ class BatchCollector:
         except Exception as e:
             self._settle(fut, exc=e)
 
-    def submit(self, mountpoint: str, topic: Sequence[str]) -> asyncio.Future:
+    def submit(self, mountpoint: str, topic: Sequence[str],
+               trace=None) -> asyncio.Future:
+        """``trace`` — an optional flight-recorder PublishTrace
+        (observability/recorder.py): the sampled-at-admission context
+        rides the pending item into the flush, where the collector
+        stamps dequeue/match and, in worker mode, attaches the
+        match-service fold meta (the cross-process ring stamps)."""
         loop = asyncio.get_event_loop()
         fut = self._enqueue_fut(loop)
         if (self._inflight >= self.MAX_INFLIGHT
@@ -1755,9 +1825,13 @@ class BatchCollector:
                 self.overload_host_pubs += 1
                 self._settle_via_trie(mountpoint, topic, fut)
                 return fut
-        exp = (time.monotonic() + self.item_expiry
+        now_sub = time.monotonic()
+        exp = (now_sub + self.item_expiry
                if self.item_expiry > 0 else None)
-        self._pending.append((mountpoint, tuple(topic), fut, exp))
+        if trace is not None:
+            trace.stamp("submit")
+        self._pending.append((mountpoint, tuple(topic), fut, exp,
+                              now_sub, trace))
         if exp is not None and self._expiry_handle is None:
             # expiry sweep: fires even when no flush can (both pipeline
             # slots wedged) — the queued-tail bound of the stall story
@@ -1808,7 +1882,7 @@ class BatchCollector:
         settled = 0
         keep = []
         for item in self._pending:
-            mp, topic, fut, exp = item
+            mp, topic, fut, exp = item[:4]
             if (exp is not None and now >= exp
                     and settled < self._EXPIRE_CHUNK):
                 self.expired_host_pubs += 1
@@ -1831,7 +1905,7 @@ class BatchCollector:
         if len(self._pending) <= self.host_threshold and reg is not None:
             pending, self._pending = self._pending, []
             self.host_hybrid_pubs += len(pending)
-            for mp, topic, fut, _exp in pending:
+            for mp, topic, fut, _exp, _t_sub, _trace in pending:
                 self._settle_via_trie(mp, topic, fut)
             return
         if self._inflight >= self.MAX_INFLIGHT:
@@ -1890,12 +1964,25 @@ class BatchCollector:
         # device dispatch they already waited too long for
         now = time.monotonic()
         by_mp: Dict[str, List[Tuple[Tuple[str, ...], asyncio.Future]]] = {}
+        traces_mp: Dict[str, list] = {}
         expired: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
-        for mp, topic, fut, exp in pending:
+        oldest_sub = None
+        for mp, topic, fut, exp, t_sub, trace in pending:
             if exp is not None and now >= exp:
                 expired.append((mp, topic, fut))
             else:
                 by_mp.setdefault(mp, []).append((topic, fut))
+                if oldest_sub is None or t_sub < oldest_sub:
+                    oldest_sub = t_sub
+                if trace is not None:
+                    trace.stamp("dequeue")
+                    traces_mp.setdefault(mp, []).append(trace)
+        if oldest_sub is not None:
+            # head-of-flush queue wait: the max wait any publish in this
+            # flush spent pending (per-flush, not per-item — one observe
+            # per dispatch keeps the seam cost flat at any batch size)
+            obs.observe("stage_collector_wait_ms",
+                        (now - oldest_sub) * 1e3)
         for i, (mp, t_, fut) in enumerate(expired):
             self.expired_host_pubs += 1
             self._settle_via_trie(mp, t_, fut)
@@ -1906,6 +1993,24 @@ class BatchCollector:
             self.view.matcher(mp)  # warm-load on the loop thread (see matcher())
             lock_to = (self.lock_busy_shed_ms / 1e3
                        if self.lock_busy_shed_ms else None)
+            # flight-recorder envelope: when a sampled publish rides
+            # this flush and the view can report fold meta (the
+            # match-service client's cross-process ring stamps), hand
+            # the fold a box to fill — the executor thread writes it,
+            # the loop reads it after the await
+            mtraces = traces_mp.get(mp)
+            meta_box = ({} if mtraces
+                        and getattr(self.view, "fold_meta_capable", False)
+                        else None)
+            view = self.view
+            if meta_box is not None:
+                fold_many_fn = (lambda m, c, lt, _mb=meta_box:
+                                view.fold_many(m, c, lt, meta_out=_mb))
+                fold_batch_fn = (lambda m, t, lt, _mb=meta_box:
+                                 view.fold_batch(m, t, lt, meta_out=_mb))
+            else:
+                fold_many_fn = getattr(view, "fold_many", None)
+                fold_batch_fn = view.fold_batch
             # super-batch: more than one window's worth of pubs in this
             # flush rides ONE device dispatch (fold_many -> match_many)
             chunks = ([topics[i:i + self.max_batch]
@@ -1920,12 +2025,12 @@ class BatchCollector:
                         nested = await wd.dispatch_async(
                             "device.dispatch",
                             lambda m=mp, c=chunks, lt=lock_to:
-                                self.view.fold_many(m, c, lt),
+                                fold_many_fn(m, c, lt),
                             self.dispatch_deadline,
                             label=f"fold_many:{mp or '(default)'}")
                     else:
                         nested = await loop.run_in_executor(
-                            None, self.view.fold_many, mp, chunks, lock_to
+                            None, fold_many_fn, mp, chunks, lock_to
                         )
                     results = [rows for batch in nested for rows in batch]
                     # counted only on success: a shed/failed super-batch
@@ -1940,12 +2045,12 @@ class BatchCollector:
                     results = await wd.dispatch_async(
                         "device.dispatch",
                         lambda m=mp, t=topics, lt=lock_to:
-                            self.view.fold_batch(m, t, lt),
+                            fold_batch_fn(m, t, lt),
                         self.dispatch_deadline,
                         label=f"fold_batch:{mp or '(default)'}")
                 else:
                     results = await loop.run_in_executor(
-                        None, self.view.fold_batch, mp, topics, lock_to
+                        None, fold_batch_fn, mp, topics, lock_to
                     )
             except StallAbandoned as sa:
                 # deadline overrun: record the stall as a device failure
@@ -2006,6 +2111,11 @@ class BatchCollector:
                 for _, fut in items:
                     self._settle(fut, exc=e)
                 continue
+            if mtraces:
+                for tr in mtraces:
+                    tr.stamp("match")
+                    if meta_box:
+                        tr.meta = meta_box
             for (_, fut), rows in zip(items, results):
                 self._settle(fut, res=rows)
         # overload-signal EWMA: whole-flush service time (shed/degraded

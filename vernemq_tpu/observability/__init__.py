@@ -1,0 +1,39 @@
+"""Hot-path flight recorder & stage-level latency attribution.
+
+Three pieces, all always-on and cheap enough for the publish hot path:
+
+- :mod:`.histogram` — fixed log-bucket latency histograms following the
+  counter-block pattern (per-thread increment buffers, single merge at
+  read), one family per load-bearing seam (device dispatch, delta
+  scatter, rebuild, collector queue wait, ring round-trip, parse→route,
+  queue flush, spool journal write, cluster ack RTT). Exposed as proper
+  Prometheus ``_bucket``/``_sum``/``_count`` families and aggregated
+  across worker processes at the scrape point via
+  ``WorkerStatsBlock`` histogram slots.
+
+- :mod:`.recorder` — the publish-path flight recorder: a bounded ring
+  of stage-stamped samples. The 1-in-N sample decision is made ONCE at
+  admission and the trace context rides the fold envelope (including
+  the shared-memory ring to the match service), so a
+  worker→service→device→route publish yields ONE record with per-stage
+  deltas spanning both processes.
+
+- :mod:`.profiler` — per-dispatch device profiling records (K, batch
+  fill, Bpad/Dpad, compile-vs-execute, delta rows, rebuild timings)
+  plus Chrome trace-event JSON export (``vmq-admin timeline dump``,
+  loadable in Perfetto).
+
+The whole subsystem is gated by one flag (``observability_enabled``):
+off, every seam pays a single module-global boolean test.
+"""
+
+from . import histogram
+from .histogram import observe, set_enabled, enabled
+from .profiler import DispatchProfiler, profiler
+from .recorder import FlightRecorder, PublishTrace, chrome_trace
+
+__all__ = [
+    "histogram", "observe", "set_enabled", "enabled",
+    "DispatchProfiler", "profiler",
+    "FlightRecorder", "PublishTrace", "chrome_trace",
+]

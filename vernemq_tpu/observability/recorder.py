@@ -1,0 +1,205 @@
+"""Publish-path flight recorder: stage-stamped samples in a bounded ring.
+
+One :class:`PublishTrace` per SAMPLED publish (1-in-N, decided once at
+admission in ``session._handle_publish``), carried through the routing
+layers and the batch-collector fold envelope: the session stamps
+admission and route completion, the collector stamps dequeue/dispatch,
+and in worker mode the match-service fold meta (service receive/done
+monotonic stamps + pid, carried back in the ring reply) lands in the
+same trace — ONE record per publish with per-stage deltas including the
+cross-process ring transit, computable because ``time.monotonic`` is
+CLOCK_MONOTONIC and system-wide on the deployment target (Linux).
+
+Records are plain dicts in a ``deque(maxlen=...)``: admission under
+load evicts the oldest sample, never blocks, never grows. The ring is
+drained by ``vmq-admin timeline show`` and exported as Chrome
+trace-event JSON by ``vmq-admin timeline dump`` (Perfetto-loadable).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import histogram as hist
+
+#: trace mark label -> human stage name used in records/trace events
+_STAGE_OF = {
+    "admit": "admission",
+    "submit": "collector_submit",
+    "dequeue": "collector_wait",
+    "match": "match",
+    "route": "route",
+}
+
+
+class PublishTrace:
+    """Stage stamps for one sampled publish. ``stamp()`` is append-only
+    and thread-safe enough for its single-writer-per-stage reality (the
+    session, then the collector flush, then the route callback)."""
+
+    __slots__ = ("t0", "wall", "info", "marks", "meta")
+
+    def __init__(self, info: Tuple[str, str, int]):
+        self.t0 = time.monotonic()
+        self.wall = time.time()
+        self.info = info  # (client_id, topic, qos)
+        self.marks: List[Tuple[str, float]] = []
+        self.meta: Optional[Dict[str, Any]] = None  # service fold meta
+
+    def stamp(self, label: str) -> None:
+        self.marks.append((label, time.monotonic()))
+
+
+class FlightRecorder:
+    """Bounded ring of per-publish stage records."""
+
+    def __init__(self, sample_n: int = 32, capacity: int = 4096):
+        self.sample_n = max(0, int(sample_n))
+        self.records: deque = deque(maxlen=max(16, int(capacity)))
+        self._admitted = 0
+        self.sampled = 0
+        self.finished = 0
+
+    # ------------------------------------------------------------ sampling
+
+    def admit(self, client_id: str, topic: str,
+              qos: int) -> Optional[PublishTrace]:
+        """The ONE sample decision, made at admission: every
+        ``sample_n``-th publish gets a trace that rides the whole path.
+        Deterministic (a counter, not a RNG) so tests and drills can
+        predict exactly which publishes record."""
+        if not hist.enabled() or self.sample_n <= 0:
+            return None
+        self._admitted += 1
+        if self._admitted % self.sample_n:
+            return None
+        self.sampled += 1
+        return PublishTrace((client_id, topic, qos))
+
+    # ------------------------------------------------------------- records
+
+    def finish(self, trace: PublishTrace) -> Dict[str, Any]:
+        """Compute per-stage deltas and append ONE record. Also feeds
+        the sampled ``stage_parse_route_ms`` histogram (total broker
+        residency of the sampled publish)."""
+        cid, topic, qos = trace.info
+        stages: Dict[str, float] = {}
+        prev = trace.t0
+        last = trace.t0
+        for label, t in trace.marks:
+            name = _STAGE_OF.get(label, label)
+            stages[f"{name}_ms"] = round((t - prev) * 1e3, 4)
+            prev = t
+            last = max(last, t)
+        meta = trace.meta
+        if meta and "svc_recv" in meta:
+            # cross-process split of the ring round trip: request
+            # transit, service residency (its own collector + device
+            # dispatch), reply transit — stamps are system-wide
+            # CLOCK_MONOTONIC, comparable across processes
+            send_t = meta.get("send_t")
+            recv_t = meta.get("recv_t")
+            if send_t is not None:
+                stages["ring_request_ms"] = round(
+                    (meta["svc_recv"] - send_t) * 1e3, 4)
+            if "svc_done" in meta:
+                stages["service_ms"] = round(
+                    (meta["svc_done"] - meta["svc_recv"]) * 1e3, 4)
+                if recv_t is not None:
+                    stages["ring_reply_ms"] = round(
+                        (recv_t - meta["svc_done"]) * 1e3, 4)
+        total_ms = (last - trace.t0) * 1e3
+        rec: Dict[str, Any] = {
+            "ts": trace.wall,
+            "t0": trace.t0,
+            "client": cid,
+            "topic": topic,
+            "qos": qos,
+            "pid": os.getpid(),
+            "total_ms": round(total_ms, 4),
+            "stages": stages,
+            "marks": [("start", trace.t0)] + list(trace.marks),
+        }
+        if meta:
+            rec["svc_pid"] = meta.get("svc_pid")
+            if "svc_recv" in meta:
+                rec["svc_span"] = (meta["svc_recv"],
+                                   meta.get("svc_done", meta["svc_recv"]))
+        self.records.append(rec)
+        self.finished += 1
+        hist.observe("stage_parse_route_ms", total_ms)
+        return rec
+
+    def snapshot(self, limit: int = 0) -> List[Dict[str, Any]]:
+        out = list(self.records)
+        return out[-limit:] if limit else out
+
+    def stats(self) -> Dict[str, float]:
+        return {
+            "flight_sampled": float(self.sampled),
+            "flight_records": float(len(self.records)),
+            "flight_sample_n": float(self.sample_n),
+        }
+
+
+# ------------------------------------------------------- trace-event export
+
+def chrome_trace(records: List[Dict[str, Any]],
+                 dispatches: Optional[List[Dict[str, Any]]] = None,
+                 node: str = "broker") -> Dict[str, Any]:
+    """Chrome trace-event JSON (the ``{"traceEvents": [...]}`` object
+    format Perfetto/chrome://tracing load): one complete ("ph": "X")
+    event per publish stage and per device-dispatch record, pid-tagged
+    so worker and match-service spans land in separate tracks.
+    Timestamps are CLOCK_MONOTONIC microseconds — one shared axis for
+    every process on the host."""
+    events: List[Dict[str, Any]] = []
+    pids = {}
+
+    def _proc(pid: Optional[int], name: str) -> int:
+        p = int(pid or os.getpid())
+        if p not in pids:
+            pids[p] = name
+            events.append({"name": "process_name", "ph": "M", "pid": p,
+                           "tid": 0, "args": {"name": f"{name} ({p})"}})
+        return p
+
+    for rec in records or []:
+        pid = _proc(rec.get("pid"), f"{node}-worker")
+        marks = rec.get("marks") or []
+        for (l0, t0), (l1, t1) in zip(marks, marks[1:]):
+            events.append({
+                "name": _STAGE_OF.get(l1, l1), "cat": "publish",
+                "ph": "X", "ts": round(t0 * 1e6, 1),
+                "dur": max(0.1, round((t1 - t0) * 1e6, 1)),
+                "pid": pid, "tid": 1,
+                "args": {"client": rec.get("client"),
+                         "topic": rec.get("topic"),
+                         "qos": rec.get("qos")},
+            })
+        span = rec.get("svc_span")
+        if span:
+            spid = _proc(rec.get("svc_pid"), "match-service")
+            events.append({
+                "name": "service_fold", "cat": "publish", "ph": "X",
+                "ts": round(span[0] * 1e6, 1),
+                "dur": max(0.1, round((span[1] - span[0]) * 1e6, 1)),
+                "pid": spid, "tid": 1,
+                "args": {"client": rec.get("client"),
+                         "topic": rec.get("topic")},
+            })
+    for d in dispatches or []:
+        pid = _proc(d.get("pid"), f"{node}-worker")
+        args = {k: v for k, v in d.items()
+                if k not in ("t0", "dur_ms", "pid", "kind")}
+        events.append({
+            "name": f"device.{d.get('kind', 'dispatch')}", "cat": "device",
+            "ph": "X", "ts": round(d["t0"] * 1e6, 1),
+            "dur": max(0.1, round(d["dur_ms"] * 1e3, 1)),
+            "pid": pid, "tid": 2, "args": args,
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"node": node, "clock": "CLOCK_MONOTONIC"}}

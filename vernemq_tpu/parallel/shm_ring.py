@@ -48,7 +48,7 @@ LAG_SAMPLES = 64
 
 _STATS_MAGIC = 0x564D5153  # "VMQS"
 _STATS_HDR = 128
-_SLOT_BYTES = 128 + LAG_SAMPLES * 8
+_SLOT_FIXED = 128 + LAG_SAMPLES * 8
 
 
 def _pad4(n: int) -> int:
@@ -246,13 +246,32 @@ class WorkerStatsBlock:
         if magic != _STATS_MAGIC:
             raise ValueError(f"not a WorkerStatsBlock: {shm.name}")
         self.n_workers = n
+        # per-worker stage-histogram block layout (observability
+        # scrape-point aggregation): written by create(), read here so
+        # both sides agree without recompiling constants
+        self._hist_f64 = struct.unpack_from("<I", self._buf, 120)[0]
+        self._slot_bytes = _SLOT_FIXED + self._hist_f64 * 8
 
     @classmethod
-    def create(cls, name: str, n_workers: int) -> "WorkerStatsBlock":
-        size = _STATS_HDR + n_workers * _SLOT_BYTES
+    def create(cls, name: str, n_workers: int,
+               hist_f64: Optional[int] = None) -> "WorkerStatsBlock":
+        """``hist_f64`` — flat f64 width of one histogram block
+        (defaults to the full STAGE_FAMILIES pack width; 0 disables the
+        region). One block per worker slot plus ONE for the match
+        service process: the device-side seams (dispatch, delta,
+        rebuild) run in the service, which has no scrape endpoint of
+        its own — its block is how those observations reach a worker's
+        /metrics."""
+        if hist_f64 is None:
+            from ..observability import histogram as _hist
+
+            hist_f64 = len(_hist.STAGE_FAMILIES) * _hist.FLAT_WIDTH
+        slot = _SLOT_FIXED + hist_f64 * 8
+        size = _STATS_HDR + n_workers * slot + hist_f64 * 8
         shm = shared_memory.SharedMemory(name=name, create=True, size=size)
         shm.buf[:size] = b"\x00" * size
         struct.pack_into("<II", shm.buf, 0, _STATS_MAGIC, n_workers)
+        struct.pack_into("<I", shm.buf, 120, hist_f64)
         return cls(shm, owner=True)
 
     @classmethod
@@ -313,7 +332,7 @@ class WorkerStatsBlock:
     def _base(self, idx: int) -> int:
         if not 0 <= idx < self.n_workers:
             raise IndexError(f"worker slot {idx} of {self.n_workers}")
-        return _STATS_HDR + idx * _SLOT_BYTES
+        return _STATS_HDR + idx * self._slot_bytes
 
     def write_health(self, idx: int, *, pid: int, sessions: int,
                      admitted: int) -> None:
@@ -351,6 +370,46 @@ class WorkerStatsBlock:
 
     def read_all(self) -> List[Dict[str, Any]]:
         return [self.read_slot(i) for i in range(self.n_workers)]
+
+    # -------------------------------------------------- histogram slots
+
+    def write_hist(self, idx: int, flat: List[float]) -> None:
+        """Publish this worker's packed stage-histogram snapshot
+        (observability.histogram.pack_all) into its slot. Single writer
+        per slot; readers tolerate a mid-write tear — bucket counts are
+        monotone, so the next heartbeat restores consistency and a
+        scrape can only ever under-report by one interval."""
+        if not self._hist_f64:
+            return
+        b = self._base(idx) + _SLOT_FIXED
+        k = min(len(flat), self._hist_f64)
+        struct.pack_into(f"<{k}d", self._buf, b, *flat[:k])
+
+    def read_hist(self, idx: int) -> List[float]:
+        if not self._hist_f64:
+            return []
+        b = self._base(idx) + _SLOT_FIXED
+        return list(struct.unpack_from(f"<{self._hist_f64}d",
+                                       self._buf, b))
+
+    def _service_hist_base(self) -> int:
+        return _STATS_HDR + self.n_workers * self._slot_bytes
+
+    def write_service_hist(self, flat: List[float]) -> None:
+        """The match service's packed histogram block (single writer:
+        the service process) — how the device-side stage observations
+        reach the workers' scrape endpoints."""
+        if not self._hist_f64:
+            return
+        k = min(len(flat), self._hist_f64)
+        struct.pack_into(f"<{k}d", self._buf, self._service_hist_base(),
+                         *flat[:k])
+
+    def read_service_hist(self) -> List[float]:
+        if not self._hist_f64:
+            return []
+        return list(struct.unpack_from(f"<{self._hist_f64}d", self._buf,
+                                       self._service_hist_base()))
 
     def peer_pressure(self, my_idx: int,
                       stale_s: float = 5.0) -> Dict[str, float]:

@@ -23,6 +23,8 @@ import logging
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from ..observability import histogram as obs
+from ..observability.profiler import record_dispatch
 from ..models.tpu_matcher import DeviceDegraded, MatcherBusy, \
     RebuildInProgress
 from ..robustness.watchdog import StallAbandoned
@@ -247,6 +249,7 @@ class RetainedBatchCollector:
         for mp, items in by_mp.items():
             filters = [fw for fw, _ in items]
             wd = self.watchdog
+            t_disp = time.monotonic()
             try:
                 # first use chunk-loads the retained snapshot with loop
                 # yields; a failed load serves this flush host-side
@@ -293,6 +296,11 @@ class RetainedBatchCollector:
                 continue
             self.device_batches += 1
             self.device_filters += len(items)
+            dur = (time.monotonic() - t_disp) * 1e3
+            obs.observe("stage_retained_dispatch_ms", dur)
+            record_dispatch("retained", t_disp, dur,
+                            batch=len(filters),
+                            mountpoint=mp or "(default)")
             for i, ((fw, fut), rows) in enumerate(zip(items, results)):
                 if rows is None:
                     # per-filter device escape: exact host resolution
