@@ -1819,6 +1819,178 @@ def config11_admission_storm(smoke):
     return out
 
 
+def _mesh_rung_main(n_slices: int, subs: int, seed: int,
+                    iters: int) -> int:
+    """One rung of the mesh ladder, run in a FRESH process whose
+    XLA_FLAGS forced ``n_slices`` host devices (the parent sets the
+    env — device count is fixed at backend init). Builds the mesh-
+    native matcher and the single-process ShardedWindowedMatcher over
+    the SAME mesh + table, and prints one JSON line: per-slice rows,
+    delta-routing hit rate, bit-identical parity vs the oracle (and the
+    trie), amortized dispatch ms."""
+    import jax
+
+    from vernemq_tpu.models.tpu_table import SubscriptionTable
+    from vernemq_tpu.models.trie import SubscriptionTrie
+    from vernemq_tpu.parallel.mesh import make_mesh
+    from vernemq_tpu.parallel.mesh_match import MeshMatcher
+    from vernemq_tpu.parallel.sharded_match import ShardedWindowedMatcher
+
+    rng = random.Random(seed)
+    devs = jax.devices()
+    assert len(devs) >= n_slices, (len(devs), n_slices)
+    table = SubscriptionTable(
+        max_levels=8,
+        initial_capacity=max(1 << (subs - 1).bit_length(),
+                             4096 * n_slices, 1 << 14))
+    trie = SubscriptionTrie()
+    l0 = [f"r{i}" for i in range(48)]
+    l1 = [f"d{i}" for i in range(96)]
+    l2 = [f"m{i}" for i in range(24)]
+    for i in range(subs):
+        r = rng.random()
+        w = [rng.choice(l0), rng.choice(l1), rng.choice(l2)]
+        if r < 0.6:
+            f = w
+        elif r < 0.8:
+            f = [w[0], "+", w[2]]
+        elif r < 0.9:
+            f = ["+", w[1], w[2]]
+        else:
+            f = [w[0], w[1], "#"]
+        table.add(f, i, None)
+        trie.add(list(f), i, None)
+    table.add(["$SYS", "stats", "#"], "sys", None)
+    trie.add(["$SYS", "stats", "#"], "sys", None)
+    mesh = make_mesh(devs[:n_slices], batch=1)
+    m = MeshMatcher(table, mesh, max_fanout=256)
+    oracle = ShardedWindowedMatcher(table, mesh, max_fanout=256)
+
+    def norm(rows):
+        return sorted((k for _, k, _ in rows), key=repr)
+
+    topics = [(rng.choice(l0), rng.choice(l1), rng.choice(l2))
+              for _ in range(128)]
+    topics += [("$SYS", "stats", "x"), ("never", "seen", "words")]
+    got = m.match_batch(topics)
+    want_o = oracle.match_batch(topics)
+    parity = all(norm(a) == norm(trie.match(list(tp)))
+                 for tp, a in zip(topics, got))
+    oracle_ok = all(norm(a) == norm(b) for a, b in zip(got, want_o))
+
+    # delta-routing phase: R single-bucket subscribe bursts, each
+    # flushed by the next match — dirty slices per flush vs total.
+    flushes0 = m.route_flushes
+    dirty0 = m.route_dirty_slices
+    scatters0 = m.full_scatters
+    rounds = 8
+    for r_i in range(rounds):
+        w0 = rng.choice(l0)
+        for j in range(4):
+            f = [w0, rng.choice(l1), f"new{r_i}x{j}"]
+            table.add(f, 10_000_000 + r_i * 100 + j, None)
+            trie.add(list(f), 10_000_000 + r_i * 100 + j, None)
+        got = m.match_batch(topics[:8])
+        if not all(norm(a) == norm(trie.match(list(tp)))
+                   for tp, a in zip(topics[:8], got)):
+            parity = False
+    flushes = m.route_flushes - flushes0
+    dirty = m.route_dirty_slices - dirty0
+    # the routing guarantee: delta flushes NEVER fell back to a
+    # full-table placement (full_scatters moves only on build/growth)
+    assert m.full_scatters == scatters0, "delta flush fell back to a " \
+        "full-table scatter"
+    assert flushes == rounds, (flushes, rounds)
+
+    # dispatch amortization: K batches launched back-to-back, pulled
+    # after (the match_many posture at the mesh layer)
+    bs = 256
+    bench_topics = [(rng.choice(l0), rng.choice(l1), rng.choice(l2))
+                    for _ in range(bs)]
+    m.match_batch(bench_topics)  # warm the shape
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        m.match_batch(bench_topics)
+    k1_ms = (time.perf_counter() - t0) / iters * 1e3
+    K = 4
+    m.sync()
+    preps = [m._prep(bench_topics) for _ in range(K)]
+    refs = [m._dispatch_device(p) for p in preps]  # warm
+    for r in refs:
+        m._pull(r)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        refs = [m._dispatch_device(p) for p in preps]
+        for r in refs:
+            m._pull(r)
+    k4_ms = (time.perf_counter() - t0) / (iters * K) * 1e3
+    st = m.mesh_status()
+    print(json.dumps({
+        "slices": n_slices,
+        "rows": subs,
+        "per_slice_rows": st["rows_per_slice"],
+        "parity_ok": bool(parity),
+        "oracle_bit_identical": bool(oracle_ok),
+        "routing": {
+            "flushes": flushes,
+            "dirty_slices": dirty,
+            "total_slices": flushes * n_slices,
+            "hit_rate": round(1.0 - dirty / max(flushes * n_slices, 1),
+                              3),
+            "gzone_flushes": st["route_gzone_flushes"],
+            "full_scatter_fallbacks": m.full_scatters - scatters0,
+        },
+        "dispatch_ms_k1": round(k1_ms, 3),
+        "amortized_dispatch_ms_k4": round(k4_ms, 3),
+    }))
+    return 0
+
+
+def config12_mesh_ladder(smoke, seed, subs):
+    """Mesh ladder: the mesh-native matcher at 1/2/4 forced-host-device
+    slices (CPU smoke — device count is fixed at backend init, so each
+    rung runs in a fresh subprocess with its own XLA_FLAGS). Honest
+    flags: cpu_smoke travels in the artifact; virtual CPU 'slices' share
+    one socket, so the ladder validates ROUTING and PARITY, not
+    multi-host bandwidth (ROOFLINE.md multi-host section has the
+    model)."""
+    import subprocess
+
+    rung_subs = min(subs, 20_000) if smoke else min(subs, 200_000)
+    iters = 4 if smoke else 12
+    rungs = {}
+    for n in (1, 2, 4):
+        env = os.environ.copy()
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={n}"
+        note(f"[bench] config12 mesh rung slices={n}...")
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--mesh-rung", str(n), "--subs", str(rung_subs),
+             "--seed", str(seed), "--iters", str(iters)],
+            env=env, capture_output=True, text=True, timeout=900)
+        if proc.returncode != 0:
+            tail = (proc.stderr or "").strip().splitlines()[-3:]
+            rungs[f"s{n}"] = {"error": " | ".join(tail) or "rung failed"}
+            continue
+        line = (proc.stdout or "").strip().splitlines()[-1]
+        rungs[f"s{n}"] = json.loads(line)
+    ok_rungs = [r for r in rungs.values() if "error" not in r]
+    return {
+        "cpu_smoke": True,
+        "rows": rung_subs,
+        "rungs": rungs,
+        "parity_ok": bool(ok_rungs) and all(
+            r["parity_ok"] and r["oracle_bit_identical"]
+            for r in ok_rungs),
+        "routing_hit_rate_s4": rungs.get("s4", {}).get(
+            "routing", {}).get("hit_rate"),
+        "note": ("forced-host-device CPU slices share one socket: this "
+                 "ladder validates slice routing + bit-identical "
+                 "parity, not multi-host bandwidth"),
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--subs", type=int, default=1_000_000)
@@ -1837,7 +2009,11 @@ def main() -> int:
     ap.add_argument("--stack", type=int, default=8,
                     help="batches per executable for --variant "
                     "packed_stack")
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11",
+    ap.add_argument("--mesh-rung", type=int, default=0,
+                    help="internal: run ONE mesh-ladder rung at this "
+                    "slice count in-process (config 12 spawns these "
+                    "with forced host device counts)")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12",
                     help="which BASELINE configs to run (3 = headline; "
                     "6 = fault-storm robustness: publish p99 while the "
                     "device path is down + breaker recovery time; "
@@ -1851,13 +2027,23 @@ def main() -> int:
                     "well-behaved goodput/p99 + recovery time; "
                     "11 = admission storm: SO_REUSEPORT worker scaling "
                     "at workers 1/2/4 — admitted pubs/s, CONNECT p99, "
-                    "per-worker loop lag, fanout parity)")
+                    "per-worker loop lag, fanout parity; "
+                    "12 = mesh ladder: mesh-native matcher at 1/2/4 "
+                    "forced-host-device slices — per-slice rows, "
+                    "delta-routing hit rate, parity vs the "
+                    "single-process sharded oracle)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
     ap.add_argument("--kernel-only", action="store_true",
                     help="also run the device-resident kernel throughput "
                     "probe on CPU (always runs on an accelerator)")
     args = ap.parse_args()
+
+    if args.mesh_rung:
+        # one mesh-ladder rung inside the forced-device-count env the
+        # parent set — never touches the accelerator probe machinery
+        return _mesh_rung_main(args.mesh_rung, args.subs, args.seed,
+                               args.iters)
 
     if args.platform:
         import jax
@@ -2128,6 +2314,11 @@ def main() -> int:
     if "11" in want:
         guarded("11_admission_storm",
                 lambda: config11_admission_storm(smoke))
+
+    if "12" in want:
+        guarded("12_mesh_ladder",
+                lambda: config12_mesh_ladder(smoke, args.seed,
+                                             args.subs))
 
     if headline is not None:
         value = headline["matches_per_sec"]

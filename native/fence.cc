@@ -1,0 +1,24 @@
+// Memory fences for the shared-memory ring (parallel/shm_ring.py).
+//
+// ShmRing's lock-free publish contract is "payload bytes land before the
+// tail counter" — guaranteed by x86-TSO store ordering alone today. On a
+// weakly-ordered ISA (aarch64) the payload stores can become visible
+// AFTER the tail store without an explicit release fence, which pure
+// Python cannot express; this shim is that fence (ROADMAP item (d) of
+// the million-session front end). The consumer side pairs it with an
+// acquire fence after reading the tail.
+#include <atomic>
+
+extern "C" {
+
+void vmq_release_fence() {
+    std::atomic_thread_fence(std::memory_order_release);
+}
+
+void vmq_acquire_fence() {
+    std::atomic_thread_fence(std::memory_order_acquire);
+}
+
+int vmq_fence_probe() { return 1; }
+
+}  // extern "C"

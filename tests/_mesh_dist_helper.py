@@ -1,0 +1,130 @@
+"""One process of the 2-process ``jax.distributed`` mesh e2e
+(tests/test_mesh_distributed.py runs two of these). Builds the SAME
+deterministic table in each process, initialises the distributed
+runtime, places the mesh-native matcher state (each process contributes
+its addressable shards), and prints ONE JSON line with:
+
+- per-topic partial fanout over this process's addressable slices (the
+  per-process device path — XLA's CPU backend cannot run cross-process
+  computations, so matching is slice-local and the parent unions);
+- delta-route accounting (the write-through must scatter only this
+  process's addressable dirty slices);
+- process 0 only: the slice-failure degradation check — partial device
+  fanout plus the exact host walk restricted to the OTHER process's row
+  ranges reproduces the full oracle bit-identically.
+"""
+
+import json
+import os
+import random
+import sys
+
+
+def corpus(table, trie, n=2000, seed=3):
+    rng = random.Random(seed)
+    l0 = [f"r{i}" for i in range(16)]
+    l1 = [f"d{i}" for i in range(24)]
+    l2 = [f"m{i}" for i in range(8)]
+    for i in range(n):
+        r = rng.random()
+        w = [rng.choice(l0), rng.choice(l1), rng.choice(l2)]
+        if r < 0.7:
+            f = w
+        elif r < 0.9:
+            f = [w[0], "+", w[2]]
+        else:
+            f = [w[0], w[1], "#"]
+        table.add(f, i, None)
+        trie.add(list(f), i, None)
+    table.add(["$SYS", "stats", "#"], "sys", None)
+    trie.add(["$SYS", "stats", "#"], "sys", None)
+    topics = [(rng.choice(l0), rng.choice(l1), rng.choice(l2))
+              for _ in range(12)]
+    topics += [("$SYS", "stats", "x"), ("never", "seen", "words")]
+    return (l0, l1, l2), topics
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+        process_id=pid,
+        initialization_timeout=60)
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from vernemq_tpu.models.tpu_table import SubscriptionTable
+    from vernemq_tpu.models.trie import SubscriptionTrie
+    from vernemq_tpu.parallel.mesh import make_mesh
+    from vernemq_tpu.parallel.mesh_match import MeshMatcher
+    from vernemq_tpu.protocol.topic import match_dollar_aware
+
+    table = SubscriptionTable(max_levels=8, initial_capacity=1 << 14)
+    trie = SubscriptionTrie()
+    pools, topics = corpus(table, trie)
+    assert jax.process_count() == 2
+    assert len(jax.devices()) == 4
+    mesh = make_mesh(jax.devices(), batch=1)
+    m = MeshMatcher(table, mesh, max_fanout=128)
+    m.sync()
+    addressable = sorted(m.addressable_slices())
+
+    def resolve(slot_ids):
+        ent = table.entries
+        return sorted(repr(ent[i][1]) for i in slot_ids
+                      if ent[i] is not None)
+
+    ids, ranges = m.match_local_slices(topics)
+    partial = [resolve(sl) for sl in ids]
+
+    # delta-route phase: BOTH processes apply the same write-through
+    # (the metadata plane replicates subscription events everywhere);
+    # each scatters only its addressable dirty slices
+    l0, l1, l2 = pools
+    table.add([l0[1], l1[1], "fresh"], "late", None)
+    trie.add([l0[1], l1[1], "fresh"], "late", None)
+    m.sync()
+    route = {
+        "dirty": m.last_route["dirty_slices"],
+        "addressable": addressable,
+        "routed": m.route_dirty_slices,
+        "full_scatters": m.full_scatters,
+    }
+    ids2, _ = m.match_local_slices(topics + [(l0[1], l1[1], "fresh")])
+    partial2 = [resolve(sl) for sl in ids2]
+
+    degraded_ok = None
+    if pid == 0:
+        # slice failure: the peer's slices are gone — this process's
+        # partial device fanout + the exact host walk over the FAILED
+        # row ranges must reproduce the oracle bit-identically
+        owned = set()
+        for lo, hi in ranges:
+            owned.update(range(lo, hi))
+        degraded_ok = True
+        ent = table.entries
+        for tp, sl in zip(topics, ids):
+            dev_rows = resolve(sl)
+            host_rows = sorted(
+                repr(e[1]) for i, e in enumerate(ent)
+                if e is not None and i not in owned
+                and match_dollar_aware(list(tp), list(e[0])))
+            want = sorted(repr(k) for _, k, _ in trie.match(list(tp)))
+            if sorted(dev_rows + host_rows) != want:
+                degraded_ok = False
+                break
+
+    print(json.dumps({
+        "pid": pid, "addressable": addressable,
+        "ranges": ranges, "partial": partial, "partial2": partial2,
+        "route": route, "degraded_ok": degraded_ok,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -372,6 +372,21 @@ def test_schema_overload_family_dotted_and_flat():
     assert s["overload_l1_throttle_ms"] == 40
 
 
+def test_schema_mesh_family_dotted_and_flat():
+    """The mesh-native matcher family (parallel/mesh_match.py) parses
+    both spellings, like the overload family above."""
+    s = parse_conf(
+        """
+        mesh.topology = 1x8
+        mesh.native = off
+        """
+    )
+    assert s["tpu_mesh"] == "1x8"
+    assert s["tpu_mesh_native"] is False
+    assert parse_conf("tpu_mesh_native = on") == {
+        "tpu_mesh_native": True}
+
+
 def test_schema_gap_and_unknown_errors():
     with pytest.raises(ConfError, match="deliberate gap"):
         parse_conf("listener.http.x = 127.0.0.1:8080\n"

@@ -69,6 +69,55 @@ def test_ring_fifo_and_wrap_integrity():
         ring.unlink()
 
 
+def test_ring_fence_mode_and_fallback_warning(monkeypatch, caplog):
+    """The tail-publish release fence: fence_active() reflects the
+    native shim, pushes still work with the fences forcibly absent
+    (the x86-TSO fallback), and fence_startup_check warns EXACTLY once
+    on a weakly-ordered machine while staying silent on x86."""
+    import logging
+    import platform
+
+    from vernemq_tpu.parallel import shm_ring as sr
+
+    # whatever mode this box is in, push/pop round-trips
+    ring = ShmRing.create(_name("fz"), 4096)
+    try:
+        assert ring.push(b"fenced")
+        assert ring.pop_many() == [b"fenced"]
+    finally:
+        ring.close()
+        ring.unlink()
+    # force the pure-Python fallback and a weakly-ordered machine
+    monkeypatch.setattr(sr, "_fence_checked", True)
+    monkeypatch.setattr(sr, "_release_fence", None)
+    monkeypatch.setattr(sr, "_acquire_fence", None)
+    monkeypatch.setattr(sr, "_fence_warned", False)
+    monkeypatch.setattr(platform, "machine", lambda: "aarch64")
+    assert sr.fence_active() is False
+    with caplog.at_level(logging.WARNING, "vernemq_tpu.shm_ring"):
+        assert sr.fence_startup_check() is False
+        assert sr.fence_startup_check() is False  # once, not per ring
+    warns = [r for r in caplog.records
+             if "x86-TSO" in r.getMessage()]
+    assert len(warns) == 1
+    # fallback rings still function
+    ring = ShmRing.create(_name("fz2"), 4096)
+    try:
+        assert ring.push(b"tso")
+        assert ring.pop_many() == [b"tso"]
+    finally:
+        ring.close()
+        ring.unlink()
+    # x86 stays silent
+    monkeypatch.setattr(sr, "_fence_warned", False)
+    monkeypatch.setattr(platform, "machine", lambda: "x86_64")
+    with caplog.at_level(logging.WARNING, "vernemq_tpu.shm_ring"):
+        caplog.clear()
+        sr.fence_startup_check()
+    assert not [r for r in caplog.records
+                if "x86-TSO" in r.getMessage()]
+
+
 def test_ring_full_and_oversized():
     ring = ShmRing.create(_name("rf"), 4096)
     try:

@@ -168,6 +168,43 @@ async def test_watchdog_and_stall_metrics_exposed():
 
 
 @pytest.mark.asyncio
+async def test_mesh_and_fence_metrics_exposed():
+    """The mesh-native matcher family (parallel/mesh_match.py +
+    cluster/mesh_map.py) and the shm-ring fence-mode gauge are
+    first-class: present in the Prometheus scrape with non-empty HELP
+    and in all_metrics(), even with no mesh configured (zeros)."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+
+    names = (
+        "mesh_slices_total", "mesh_slices_local", "mesh_rows_resident",
+        "mesh_dispatches", "mesh_delta_flushes",
+        "mesh_delta_dirty_slices", "mesh_delta_gzone_flushes",
+        "mesh_delta_rows", "mesh_full_scatters", "mesh_slice_adoptions",
+        "shm_ring_fence",
+    )
+    cfg = Config(systree_enabled=False, allow_anonymous=True)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        text = broker.metrics.prometheus_text(node=broker.node_name)
+        am = broker.metrics.all_metrics()
+        for name in names:
+            assert f"\n{name}{{" in text or text.startswith(
+                f"{name}{{"), f"{name} not scraped"
+            help_line = next(
+                (line for line in text.splitlines()
+                 if line.startswith(f"# HELP {name} ")), None)
+            assert help_line is not None, f"{name} has no HELP"
+            assert len(help_line) > len(f"# HELP {name} "), \
+                f"{name} HELP text empty"
+            assert name in am, f"{name} missing from $SYS metrics"
+        assert am["mesh_slices_total"] == 0.0  # no mesh configured
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_histogram_families_exposed_and_consistent():
     """Stage latency histograms are first-class Prometheus families:
     HELP/TYPE present for every STAGE_FAMILIES entry, bucket counts

@@ -25,7 +25,16 @@ duration. Flags, inside any ``async def`` in ``vernemq_tpu/``:
   the full timeout while the peer process lags), and a direct
   ``SharedMemory(...)`` construction (segment create/attach is
   synchronous filesystem+mmap work; do it at boot or in an executor,
-  never per-request on the loop).
+  never per-request on the loop);
+- the mesh seam (parallel/mesh_match.py): ``jax.distributed.
+  initialize(...)`` (blocks until every process of the runtime has
+  dialed the coordinator — boot-time work, never on the loop),
+  ``.block_until_ready()`` (parks the loop behind device completion —
+  dispatch from an executor like every other device call), and the
+  blocking multihost collectives ``multihost_utils.
+  sync_global_devices`` / ``process_allgather`` (barriers over every
+  process of the mesh: one slow peer stalls every session this loop
+  serves).
 
 Nested synchronous ``def``s inside an async function are NOT flagged
 (they may run anywhere — an executor, a thread); nested async defs are
@@ -50,22 +59,35 @@ TARGET = os.path.join(ROOT, "vernemq_tpu")
 
 ALLOW_MARK = "lint: allow-blocking"
 
-#: call spellings that block the event loop
+#: call spellings that block the event loop. Attribute calls match on
+#: the LAST TWO components, so ``jax.distributed.initialize`` and a
+#: bare ``distributed.initialize`` both hit ("distributed",
+#: "initialize").
 _BAD_ATTR = {("time", "sleep"), ("os", "fsync"),
-             ("shared_memory", "SharedMemory")}
+             ("shared_memory", "SharedMemory"),
+             # mesh seams: process-wide barriers / device waits
+             ("distributed", "initialize"),
+             ("multihost_utils", "sync_global_devices"),
+             ("multihost_utils", "process_allgather")}
 _BAD_NAME = {"open", "input", "SharedMemory"}
 
 #: method names that are ALWAYS blocking regardless of arguments: the
 #: shm-ring sleep-poll helpers for plain-thread producers/consumers
 #: (parallel/shm_ring.py) — the timeout bounds the wait but still parks
-#: the loop for up to its full length while the peer process lags
-_BLOCKING_METHODS = {"pop_wait", "push_wait"}
+#: the loop for up to its full length while the peer process lags —
+#: and jax's device-completion wait (a wedged mesh collective would
+#: park the loop forever; device waits belong on executor threads)
+_BLOCKING_METHODS = {"pop_wait", "push_wait", "block_until_ready"}
 
 
 def _call_name(node: ast.Call):
     f = node.func
     if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
         return (f.value.id, f.attr)
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Attribute):
+        # dotted chain (jax.distributed.initialize): match on the last
+        # two components — the prefix module alias is spelling-dependent
+        return (f.value.attr, f.attr)
     if isinstance(f, ast.Name):
         return f.id
     return None

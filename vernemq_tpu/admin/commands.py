@@ -250,6 +250,10 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin workers show  (per-worker health/pressure "
                  "rows from the shared stats block + match-service "
                  "state; multi-process mode only)")
+    reg.register(["mesh", "show"], _mesh_show,
+                 "vmq-admin mesh show  (slice map: slice->node "
+                 "ownership, rows/slice, delta-route counts; mesh "
+                 "mode only)")
     reg.register(["breaker", "show"], _breaker_show,
                  "vmq-admin breaker show")
     reg.register(["breaker", "trip"], _breaker_trip,
@@ -298,7 +302,56 @@ def _cluster_show(broker, flags):
         for node, up in broker.cluster.status():
             if node != broker.node_name:
                 rows.append({"node": node, "running": up, "self": False})
+    mm = getattr(broker, "mesh_map", None)
+    if mm is not None:
+        # mesh slice ownership per node (the gossiped slice map —
+        # `vmq-admin mesh show` has the per-slice detail)
+        counts = mm.counts_by_node()
+        for r in rows:
+            r["mesh_slices"] = counts.get(r["node"], 0)
     return {"table": rows}
+
+
+def _mesh_show(broker, flags):
+    """Slice map + routing counters of the mesh-native matcher
+    (parallel/mesh_match.py, cluster/mesh_map.py)."""
+    mm = getattr(broker, "mesh_map", None)
+    view = broker.registry.reg_views.get("tpu")
+    st_fn = getattr(view, "mesh_status", None)
+    st = st_fn() if st_fn is not None else None
+    if mm is None and not st:
+        raise CommandError("no mesh configured (tpu_mesh unset, or "
+                           "tpu_mesh_native=false)")
+    n = mm.n_slices if mm is not None else st["slices"]
+    owners = {r["slice"]: r for r in mm.snapshot()} if mm is not None \
+        else {}
+    rps = (st or {}).get("rows_per_slice", [])
+    slice_rows = (st or {}).get("slice_rows", 0)
+    addressable = set((st or {}).get("addressable", []))
+    rows = []
+    for s in range(n):
+        rec = owners.get(s, {})
+        rows.append({
+            "slice": s,
+            "node": rec.get("node"),
+            "epoch": rec.get("epoch", 0),
+            "rows": rps[s] if s < len(rps) else None,
+            "window": slice_rows or None,
+            "resident": s in addressable,
+        })
+    out: Dict[str, Any] = {"table": rows}
+    if st:
+        out["routing"] = {
+            "delta_flushes": st["route_flushes"],
+            "dirty_slices": st["route_dirty_slices"],
+            "gzone_flushes": st["route_gzone_flushes"],
+            "delta_rows": st["route_rows"],
+            "full_scatters": st["full_scatters"],
+            "dispatches": st["mesh_dispatches"],
+            "slice_adoptions": st.get("slice_adoptions", 0),
+            "last": st.get("last_route", {}),
+        }
+    return out
 
 
 def _cluster_join(broker, flags):

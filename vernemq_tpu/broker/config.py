@@ -119,6 +119,12 @@ DEFAULTS: Dict[str, Any] = {
     # (SURVEY §5.7: the per-node trie replica sharded across chips,
     # vmq_reg_trie.erl:503-520). Empty = single-device matcher.
     "tpu_mesh": "",
+    # mesh implementation: the mesh-native matcher (persistent
+    # NamedSharding/pjit arrays placed via partition rules, slice-routed
+    # delta scatter, multi-process capable — parallel/mesh_match.py) is
+    # the default when tpu_mesh is set; false keeps the legacy per-call
+    # shard_map seat
+    "tpu_mesh_native": True,
     # device flush waits at most this long for the matcher lock before
     # the whole flush serves from the host trie (0 = unbounded wait)
     "tpu_lock_busy_shed_ms": 500,

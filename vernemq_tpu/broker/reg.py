@@ -209,11 +209,30 @@ class Registry:
                           "trie view (will re-probe)")
                 self.reg_views["tpu"] = self.reg_views["trie"]
                 self._arm_accel_recovery()
+                self._mesh_claims_check(self.reg_views["trie"])
                 return self.reg_views["trie"]
             view = self.reg_views["tpu"] = self._make_tpu_view()
+            self._mesh_claims_check(view)
         if view is None:
             raise KeyError(f"unknown reg view {name!r}")
         return view
+
+    def _mesh_claims_check(self, view) -> None:
+        """The tpu view just materialized: if it is serving WITHOUT its
+        mesh (tpu_mesh unsatisfiable / accel down — the documented loud
+        single-chip degrade), retract this node's gossiped slice claims
+        so the cluster never sees it advertising slices it cannot serve
+        (boot claims happen before the lazy view exists, so this is the
+        first point the truth is known)."""
+        mm = getattr(self.broker, "mesh_map", None)
+        if mm is None:
+            return
+        try:
+            st = getattr(view, "mesh_status", None)
+            if st is None or st() is None:
+                mm.release_local()
+        except Exception:
+            log.exception("mesh slice-claim check failed")
 
     def _make_tpu_view(self):
         from ..models.tpu_matcher import TpuRegView
@@ -234,6 +253,7 @@ class Registry:
             delta_warm_max=cfg.get("tpu_delta_warm_max", 128),
             initial_capacity=cfg.tpu_initial_capacity,
             mesh=self._mesh_from_config(),
+            mesh_native=bool(cfg.get("tpu_mesh_native", True)),
             watchdog=(self.broker.watchdog
                       if cfg.get("watchdog_enabled", True) else None),
             rebuild_deadline_s=cfg.get("watchdog_rebuild_deadline_s",
@@ -245,15 +265,17 @@ class Registry:
         "S"); None (single-device matcher) when unset or unsatisfiable —
         a config asking for more devices than exist degrades LOUDLY to
         the single-chip path rather than refusing to boot."""
+        from ..cluster.mesh_map import parse_mesh_spec
+
         spec = str(self.broker.config.get("tpu_mesh", "") or "").strip()
-        if not spec:
+        parsed = parse_mesh_spec(spec)
+        if parsed is None:
+            if spec:
+                log.error("invalid tpu_mesh %r; serving on the "
+                          "single-device matcher", spec)
             return None
+        batch, sub = parsed
         try:
-            if "x" in spec:
-                b_s = spec.lower().split("x")
-                batch, sub = int(b_s[0]), int(b_s[1])
-            else:
-                batch, sub = 1, int(spec)
             import jax
 
             from ..parallel.mesh import make_mesh

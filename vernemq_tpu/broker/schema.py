@@ -91,6 +91,13 @@ FLAT_ALIASES.update({
     "observability.profiler_capacity": "profiler_capacity",
 })
 
+#: extension family: the mesh-native matcher (parallel/mesh_match.py)
+#: + slice map (cluster/mesh_map.py) — same dotted-tree discipline
+FLAT_ALIASES.update({
+    "mesh.topology": "tpu_mesh",
+    "mesh.native": "tpu_mesh_native",
+})
+
 #: reference knobs typed in MILLISECONDS whose internal knob is seconds
 MS_TO_SECONDS = {
     "systree_interval",

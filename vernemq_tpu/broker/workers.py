@@ -293,6 +293,11 @@ class WorkerGroup:
 
     def start(self) -> None:
         self._stopping = False
+        # publish-ordering fence check before any ring exists: one
+        # warning when the TSO fallback runs on a weakly-ordered host
+        from ..parallel.shm_ring import fence_startup_check
+
+        fence_startup_check()
         self.cluster_base = self._probe_cluster_base()
         self._create_shm()
         atexit.register(self.stop)  # leaked groups must not pin the

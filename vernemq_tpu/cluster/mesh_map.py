@@ -1,0 +1,157 @@
+"""Mesh slice map: which broker node owns which matcher slice.
+
+The mesh-native matcher (``parallel/mesh_match.py``) splits the
+subscription table into contiguous row slices over the mesh's 'sub'
+axis; in a multi-node deployment each broker node serves the slices it
+owns (its processes hold those shards' HBM). This module is the
+metadata-plane half: slice ownership lives in the replicated
+:class:`~vernemq_tpu.cluster.metadata.MetadataStore` under the
+``mesh_slices`` prefix, so it gossips exactly like the netsplit CAPs and
+peer capability flags do — every write broadcasts, reconnects reconcile
+through anti-entropy, and LWW resolves concurrent claims.
+
+Assignment is deterministic round-robin over the SORTED member list
+(slice ``i`` belongs to ``members[i % len(members)]``), so every node
+computes the same target map from the same membership and only ever
+writes claims for itself — concurrent claims for the same slice can only
+happen across a membership change, and LWW plus the next
+:meth:`claim_local` pass converge them. When a node GAINS a slice, the
+change event fires ``on_adopt(slice_ids, epoch)`` — the registry's mesh
+seat replays the owned rows into its device table exactly once per
+epoch (``MeshTpuMatcher.adopt_slices``).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+log = logging.getLogger("vernemq_tpu.mesh")
+
+PREFIX = "mesh_slices"
+
+
+def parse_mesh_spec(spec: str) -> Optional[Tuple[int, int]]:
+    """THE parser for the ``tpu_mesh`` knob ("BxS" or "S") —
+    deliberately jax-free (the broker builds the slice map before, and
+    regardless of whether, a backend initialises) and shared with the
+    registry's mesh construction so the slice map and the serving mesh
+    can never disagree on the slice count. Returns (batch, sub) or
+    None on an empty/malformed spec."""
+    spec = str(spec or "").strip().lower()
+    if not spec:
+        return None
+    try:
+        if "x" in spec:
+            b_s = spec.split("x")
+            return int(b_s[0]), int(b_s[1])
+        return 1, int(spec)
+    except (ValueError, IndexError):
+        return None
+
+
+class MeshSliceMap:
+    def __init__(self, metadata, node_name: str, n_slices: int,
+                 on_adopt: Optional[Callable[[List[int], int], None]] = None):
+        self.metadata = metadata
+        self.node_name = node_name
+        self.n_slices = int(n_slices)
+        #: fired with (newly_owned_slice_ids, token) after a claim pass
+        #: or a gossiped change hands this node new slices; the token
+        #: is the adopt-replay exactly-once key (claimer node + epoch)
+        self.on_adopt = on_adopt
+        # wall-clock-seeded so a node's epochs stay monotonic ACROSS
+        # boots: the adopt-replay guard keys on (claimer, epoch), and a
+        # boot-reset counter could repeat an old epoch and silently
+        # suppress a replay the re-adopted slice needs
+        self._epoch = int(time.time())
+        self.adoptions = 0
+        metadata.subscribe(PREFIX, self._on_change)
+
+    # ---------------------------------------------------------------- claims
+
+    def claim_local(self, members: Optional[Sequence[str]] = None) -> List[int]:
+        """Write this node's claims for the slices the deterministic
+        round-robin assigns it (single node: all slices). Returns the
+        slices NEWLY owned by this pass; fires ``on_adopt`` for them."""
+        members = sorted(members) if members else [self.node_name]
+        if self.node_name not in members:
+            members = sorted(set(members) | {self.node_name})
+        newly: List[int] = []
+        for s in range(self.n_slices):
+            target = members[s % len(members)]
+            if target != self.node_name:
+                continue
+            cur = self.metadata.get(PREFIX, s)
+            if cur is not None and cur.get("node") == self.node_name:
+                continue
+            self._epoch += 1
+            self.metadata.put(PREFIX, s, {
+                "node": self.node_name, "epoch": self._epoch})
+            newly.append(s)
+        if newly:
+            self.adoptions += 1
+            log.info("claimed mesh slices %s (of %d) for %s", newly,
+                     self.n_slices, self.node_name)
+            if self.on_adopt is not None:
+                self.on_adopt(newly, (self.node_name, self._epoch))
+        return newly
+
+    def release_local(self) -> List[int]:
+        """Retract every slice this node currently claims (tombstones
+        gossip like any other write). The registry calls this when the
+        tpu view comes up WITHOUT its mesh (tpu_mesh unsatisfiable —
+        the loud single-chip degrade): a node must not keep advertising
+        slices it cannot serve."""
+        released = []
+        for s in range(self.n_slices):
+            rec = self.metadata.get(PREFIX, s)
+            if rec and rec.get("node") == self.node_name:
+                self.metadata.delete(PREFIX, s)
+                released.append(s)
+        if released:
+            log.warning("released mesh slices %s: this node cannot "
+                        "serve them", released)
+        return released
+
+    def _on_change(self, key: Any, old: Any, new: Any, origin: str) -> None:
+        """Gossiped slice-map change: a slice that flipped TO this node
+        from a remote claim (e.g. an admin rebalance) replays through
+        the same adopt hook; everything else is bookkeeping only."""
+        if origin == self.node_name or new is None:
+            return
+        if (new.get("node") == self.node_name
+                and (old is None or old.get("node") != self.node_name)
+                and self.on_adopt is not None):
+            self.adoptions += 1
+            # token = (writer, its epoch): epochs are per-node
+            # counters, so the claimer must ride in the exactly-once
+            # key or two nodes' colliding counters suppress a replay
+            self.on_adopt([int(key)], (origin, int(new.get("epoch", 0))))
+
+    # ---------------------------------------------------------------- views
+
+    def owner(self, slice_id: int) -> Optional[str]:
+        rec = self.metadata.get(PREFIX, slice_id)
+        return rec.get("node") if rec else None
+
+    def local_slices(self) -> List[int]:
+        return [s for s in range(self.n_slices)
+                if self.owner(s) == self.node_name]
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        out = []
+        for s in range(self.n_slices):
+            rec = self.metadata.get(PREFIX, s) or {}
+            out.append({"slice": s, "node": rec.get("node"),
+                        "epoch": rec.get("epoch", 0)})
+        return out
+
+    def counts_by_node(self) -> Dict[str, int]:
+        counts: Dict[str, int] = {}
+        for row in self.snapshot():
+            n = row["node"]
+            if n is not None:
+                counts[n] = counts.get(n, 0) + 1
+        return counts

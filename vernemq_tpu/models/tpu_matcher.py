@@ -1499,6 +1499,7 @@ class TpuRegView:
                  initial_capacity: int = 1024, max_fanout: int = 256,
                  flat_avg: int = 128, use_pallas: bool = False,
                  packed_io: bool = True, mesh=None,
+                 mesh_native: bool = True,
                  breaker_enabled: bool = True,
                  breaker_failure_threshold: int = 3,
                  breaker_backoff_initial: float = 0.2,
@@ -1507,16 +1508,29 @@ class TpuRegView:
                  watchdog=None, rebuild_deadline_s: float = 120.0):
         self.registry = registry
         self.mesh = mesh
+        self.mesh_native = mesh_native
         self.delta_warm_max = delta_warm_max
         self.watchdog = watchdog
         self.rebuild_deadline_s = rebuild_deadline_s
         self._matchers: Dict[str, TpuMatcher] = {}
 
         def _mk() -> TpuMatcher:
-            if mesh is not None:
+            if mesh is not None and mesh_native:
+                # the mesh-native seat (parallel/mesh_match.py):
+                # persistent NamedSharding state placed via partition
+                # rules, slice-routed delta scatter — the default mesh
+                # posture (tpu_mesh_native=false keeps the legacy
+                # per-call shard_map seat below)
+                from ..parallel.mesh_match import MeshTpuMatcher
+
+                m: TpuMatcher = MeshTpuMatcher(
+                    mesh, max_levels=max_levels,
+                    initial_capacity=initial_capacity,
+                    max_fanout=max_fanout, flat_avg=flat_avg)
+            elif mesh is not None:
                 from ..parallel.sharded_match import ShardedTpuMatcher
 
-                m: TpuMatcher = ShardedTpuMatcher(
+                m = ShardedTpuMatcher(
                     mesh, max_levels=max_levels,
                     initial_capacity=initial_capacity,
                     max_fanout=max_fanout, flat_avg=flat_avg)
@@ -1636,6 +1650,49 @@ class TpuRegView:
         return {mp or "(default)": (m.breaker.status()
                                     if m.breaker is not None else None)
                 for mp, m in self._matchers.items()}
+
+    def mesh_status(self) -> Optional[Dict[str, Any]]:
+        """Aggregated mesh-native status across mountpoints (None when
+        this view is not mesh-native): summed routing counters + the
+        default mountpoint's slice layout — what `vmq-admin mesh show`
+        and the mesh_* gauges read."""
+        if self.mesh is None or not self.mesh_native:
+            return None
+        agg: Dict[str, Any] = {
+            "slices": int(self.mesh.shape["sub"]),
+            "slice_rows": 0, "rows_per_slice": [], "addressable": [],
+            "route_flushes": 0, "route_dirty_slices": 0,
+            "route_gzone_flushes": 0, "route_rows": 0,
+            "full_scatters": 0, "mesh_dispatches": 0,
+            "slice_adoptions": 0, "last_route": {},
+        }
+        for mp, m in self._matchers.items():
+            st = getattr(m, "mesh_status", None)
+            if st is None:
+                continue
+            st = st()
+            for k in ("route_flushes", "route_dirty_slices",
+                      "route_gzone_flushes", "route_rows",
+                      "full_scatters", "mesh_dispatches",
+                      "slice_adoptions"):
+                agg[k] += st.get(k, 0)
+            if mp == "" or not agg["rows_per_slice"]:
+                agg["slice_rows"] = st.get("slice_rows", 0)
+                agg["rows_per_slice"] = st.get("rows_per_slice", [])
+                agg["addressable"] = st.get("addressable", [])
+                agg["last_route"] = st.get("last_route", {})
+        return agg
+
+    def adopt_slices(self, slice_ids, epoch) -> int:
+        """Slice-map adoption fan-in: replay newly-owned slices' rows on
+        every mountpoint's mesh matcher (exactly once per adoption
+        token — the seat guards). Returns total rows marked."""
+        total = 0
+        for m in self._matchers.values():
+            fn = getattr(m, "adopt_slices", None)
+            if fn is not None:
+                total += fn(slice_ids, epoch)
+        return total
 
     def close(self) -> None:
         """Wind down background warm threads of every mountpoint's

@@ -31,7 +31,7 @@ _build_attempted = False
 
 
 _TARGETS = ("libvmq_kvstore.so", "libvmq_counters.so", "libvmq_bcrypt.so",
-            "vmq-passwd", "_vmq_codec.so")
+            "vmq-passwd", "_vmq_codec.so", "libvmq_fence.so")
 
 
 def _all_built() -> bool:

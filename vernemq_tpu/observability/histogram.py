@@ -75,6 +75,14 @@ STAGE_FAMILIES: List[Tuple[str, str]] = [
      "Cluster frame journal->cumulative-ack round trip per spooled "
      "frame (informs cluster_stall_timeout_s and "
      "cluster_spool_retransmit_ms)."),
+    ("stage_mesh_dispatch_ms",
+     "Mesh-native match dispatch latency: launch-to-results-pulled wall "
+     "per pjit'd batch over the NamedSharding mesh (informs "
+     "watchdog_dispatch_deadline_ms on multi-slice topologies)."),
+    ("stage_mesh_delta_route_ms",
+     "Slice-routed delta flush latency: per-slice sub-delta build + "
+     "scatter over only the dirty slices' shards (informs "
+     "sub_to_matchable_ms_max at mesh scale)."),
 ]
 
 _ENABLED = True

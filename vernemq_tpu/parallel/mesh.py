@@ -5,11 +5,19 @@ concurrent publishes, ``sub`` is the subscription-table shard (the
 tensor-parallel analog — the reference's per-node trie replica becomes a
 segment-array sharded across chips). Cross-shard combine is XLA collectives
 over ICI; nothing here uses point-to-point messaging.
+
+Also home of the SHARED partition-spec machinery (the rule-matching +
+shard/gather-fn pattern): the mesh-native matcher
+(``parallel/mesh_match.py``) names its 12 windowed-state arrays and places
+them through :func:`match_partition_rules` + :func:`make_shard_and_gather_fns`
+instead of hand-placing each one — and the retained reverse table reuses
+the same helpers when it goes multi-host (same operand layout, ROADMAP).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -43,3 +51,123 @@ def table_sharding_2d(mesh: Mesh) -> NamedSharding:
 def pub_sharding(mesh: Mesh) -> NamedSharding:
     """Publish batch: sharded along B over the 'batch' axis."""
     return NamedSharding(mesh, P("batch", None))
+
+
+# ---------------------------------------------------------------------------
+# Partition rules + shard/gather fns (the mesh-native placement machinery)
+# ---------------------------------------------------------------------------
+
+#: Canonical names of the 12 windowed matcher state arrays, in the exact
+#: positional order ShardedWindowedMatcher/MeshMatcher carry them:
+#: the column-sharded coded operand + its per-row metadata, then the
+#: replicated dense g-zone mirrors.
+MATCHER_STATE_NAMES: Tuple[str, ...] = (
+    "F_t", "t1", "eff_len", "has_hash", "first_wild", "active",
+    "g/F_t", "g/t1", "g/eff_len", "g/has_hash", "g/first_wild", "g/active",
+)
+
+#: Partition rules for the matcher state: regex on the array name →
+#: PartitionSpec. Rows are sharded on the subscription axis ('sub'); the
+#: dense g-zone mirrors are replicated (every slice matches its column
+#: chunk of the replicated zone); publish operands are built per dispatch
+#: and travel under the kernel's own in_specs ('batch'-sharded).
+MATCHER_PARTITION_RULES: List[Tuple[str, P]] = [
+    (r"^g/F_t$", P(None, None)),
+    (r"^g/", P(None)),
+    (r"^F_t$", P(None, "sub")),  # coded operand [K, S]: columns = rows
+    (r".*", P("sub")),           # per-row metadata [S]
+]
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]],
+                          arrays: Dict[str, "np.ndarray"]) -> Dict[str, P]:
+    """PartitionSpec per named array by first matching rule (the
+    rule-matching pattern of the reference sharding toolkits): scalars
+    are never partitioned; a name no rule covers is an error — silent
+    replication of a multi-GB table array is exactly the bug class this
+    exists to prevent."""
+    out: Dict[str, P] = {}
+    for name, arr in arrays.items():
+        shape = getattr(arr, "shape", ())
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            out[name] = P()
+            continue
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                out[name] = spec
+                break
+        else:
+            raise ValueError(f"no partition rule for array {name!r}")
+    return out
+
+
+def make_shard_and_gather_fns(
+    partition_specs: Dict[str, P], mesh: Mesh,
+) -> Tuple[Dict[str, Callable], Dict[str, Callable]]:
+    """Shard/gather function per named array from its PartitionSpec.
+
+    Shard fns place a host array onto the mesh under its NamedSharding;
+    in a multi-process runtime (``jax.distributed.initialize``) each
+    process contributes only its ADDRESSABLE shards
+    (``jax.make_array_from_callback`` — device_put of a full host array
+    cannot place remote shards). Gather fns pull back to host: the full
+    array when every shard is addressable, else only the local shards
+    concatenated in row order (the per-process view — cross-process
+    gathers ride the cluster plane, not the host link).
+    """
+    shardings = {name: NamedSharding(mesh, spec)
+                 for name, spec in partition_specs.items()}
+    multiproc = jax.process_count() > 1
+
+    def make_shard_fn(sharding: NamedSharding) -> Callable:
+        if multiproc:
+            def shard(x):
+                x = np.asarray(x)
+                return jax.make_array_from_callback(
+                    x.shape, sharding, lambda idx: x[idx])
+        else:
+            def shard(x):
+                return jax.device_put(x, sharding)
+        return shard
+
+    def make_gather_fn(sharding: NamedSharding) -> Callable:
+        def gather(arr):
+            if getattr(arr, "is_fully_addressable", True):
+                return np.asarray(arr)
+            shards = sorted(
+                arr.addressable_shards,
+                key=lambda s: tuple((sl.start or 0) for sl in s.index))
+            seen, datas = set(), []
+            for s in shards:
+                key = tuple((sl.start or 0) for sl in s.index)
+                if key in seen:  # replicated copy of the same block
+                    continue
+                seen.add(key)
+                datas.append(np.asarray(s.data))
+            return np.concatenate(datas, axis=-1 if len(
+                datas[0].shape) > 1 else 0) if datas else np.empty(0)
+        return gather
+
+    shard_fns = {n: make_shard_fn(s) for n, s in shardings.items()}
+    gather_fns = {n: make_gather_fn(s) for n, s in shardings.items()}
+    return shard_fns, gather_fns
+
+
+def place_matcher_state(mesh: Mesh, F_t, t1, eff_len, has_hash,
+                        first_wild, active, glob: int) -> tuple:
+    """Place the 12-array windowed matcher state onto ``mesh`` through
+    the partition rules (shared by MeshMatcher.sync and the seat's
+    background builds): full-table arrays row-sharded over 'sub', the
+    [0, glob) dense g-zone mirrored replicated. Returns the arrays as a
+    tuple in MATCHER_STATE_NAMES order — the exact positional layout
+    the windowed shard_map kernel takes."""
+    named = {
+        "F_t": F_t, "t1": t1, "eff_len": eff_len, "has_hash": has_hash,
+        "first_wild": first_wild, "active": active,
+        "g/F_t": F_t[:, :glob], "g/t1": t1[:glob],
+        "g/eff_len": eff_len[:glob], "g/has_hash": has_hash[:glob],
+        "g/first_wild": first_wild[:glob], "g/active": active[:glob],
+    }
+    specs = match_partition_rules(MATCHER_PARTITION_RULES, named)
+    shard_fns, _ = make_shard_and_gather_fns(specs, mesh)
+    return tuple(shard_fns[n](named[n]) for n in MATCHER_STATE_NAMES)

@@ -9,13 +9,18 @@ Two primitives, both over ``multiprocessing.shared_memory``:
   lock-free — the producer owns ``tail``, the consumer owns ``head``,
   each 8-byte counter store is a single aligned write, and records are
   written fully before the tail is published. That publish ordering is
-  what the consumer relies on to never see a torn record, and it holds
-  on the deployment target (x86-64 Linux: TSO keeps stores ordered, and
-  CPython's eval loop never splits an aligned ``struct.pack_into``).
-  On weakly-ordered ISAs (aarch64) the payload stores could in
-  principle become visible AFTER the tail store; pure Python cannot
-  express the needed release fence, so a C helper would be required —
-  deferred (see ROADMAP), the multi-process front end targets x86-64.
+  what the consumer relies on to never see a torn record. When the
+  native fence shim is present (``native/fence.cc`` — a single
+  ``atomic_thread_fence``), a RELEASE fence precedes every cursor
+  publish (tail on push, head on drain — the head store hands the
+  region back to the producer, so the consumer's payload loads must
+  retire first) and an ACQUIRE fence follows every peer-cursor read,
+  making the ordering architectural on any ISA. Without the shim the pure-Python fallback
+  relies on x86-TSO (stores ordered, CPython never splits an aligned
+  ``struct.pack_into``) — correct on the x86-64 deployment target,
+  and a LOUD gap elsewhere: :func:`fence_startup_check` warns once on a
+  non-x86 ``platform.machine()`` and the ``shm_ring_fence`` gauge
+  reports which mode is live.
 
 - :class:`WorkerStatsBlock` — a fixed-layout per-worker stats table
   (pid, heartbeat, overload level/pressure, session + admitted-publish
@@ -55,6 +60,62 @@ def _pad4(n: int) -> int:
     return (n + 3) & ~3
 
 
+# --------------------------------------------------------------- fences
+
+_fence_checked = False
+_release_fence = None
+_acquire_fence = None
+_fence_warned = False
+
+
+def _load_fences() -> None:
+    """Bind the native fences on first ring use (lazy: the native
+    build must not run at module import)."""
+    global _fence_checked, _release_fence, _acquire_fence
+    if _fence_checked:
+        return
+    _fence_checked = True
+    try:
+        from ..native import fence as _f
+
+        _release_fence = _f.release_fence_fn()
+        _acquire_fence = _f.acquire_fence_fn()
+    except Exception:
+        _release_fence = _acquire_fence = None
+
+
+def fence_active() -> bool:
+    """True when the native release/acquire fences back the ring's tail
+    publish (the ``shm_ring_fence`` gauge)."""
+    _load_fences()
+    return _release_fence is not None
+
+
+def fence_startup_check() -> bool:
+    """Warn ONCE when the rings run on the pure-Python TSO fallback on a
+    weakly-ordered host — the one configuration where the publish
+    ordering is not guaranteed. Returns fence_active(); called from ring
+    creation and the worker-group boot."""
+    global _fence_warned
+    active = fence_active()
+    if not active and not _fence_warned:
+        import platform
+
+        machine = platform.machine().lower()
+        if machine not in ("x86_64", "amd64", "i686", "i386"):
+            _fence_warned = True
+            import logging
+
+            logging.getLogger("vernemq_tpu.shm_ring").warning(
+                "ShmRing is running the pure-Python x86-TSO publish-"
+                "ordering fallback on %s (weakly ordered): torn ring "
+                "records are possible under load. Build the native "
+                "fence shim (`make -C native`) before deploying the "
+                "multi-process front end on this host "
+                "(shm_ring_fence gauge = 0).", machine)
+    return active
+
+
 class RingClosed(Exception):
     """The peer marked the ring closed (orderly service shutdown)."""
 
@@ -78,6 +139,7 @@ class ShmRing:
         self._shm = shm
         self._buf = shm.buf
         self._owner = owner
+        _load_fences()  # bind fences for BOTH ends (attach included)
         (magic,) = struct.unpack_from("<I", self._buf, 0)
         if magic != _MAGIC:
             raise ValueError(f"not a ShmRing segment: {shm.name}")
@@ -87,6 +149,7 @@ class ShmRing:
 
     @classmethod
     def create(cls, name: str, capacity: int) -> "ShmRing":
+        fence_startup_check()
         capacity = _pad4(max(capacity, 4096))
         shm = shared_memory.SharedMemory(name=name, create=True,
                                          size=_HDR + capacity)
@@ -167,6 +230,12 @@ class ShmRing:
                            f"capacity {self._cap}B / 2 (can never be "
                            f"guaranteed to fit)")
         head, tail = self._head(), self._tail()
+        # pair of the consumer's head-publish release fence: the
+        # payload stores below must not be satisfied before this head
+        # read, or we could overwrite a region the consumer is still
+        # copying out of (no-op on TSO)
+        if _acquire_fence is not None:
+            _acquire_fence()
         free = self._cap - (tail - head)
         off = tail % self._cap
         contiguous = self._cap - off
@@ -182,8 +251,11 @@ class ShmRing:
         base = _HDR + off
         self._buf[base + 4:base + 4 + len(payload)] = payload
         struct.pack_into("<I", self._buf, base, len(payload))
-        # publish AFTER the payload bytes are in place (store ordering
-        # guaranteed by x86-TSO only — see the module docstring)
+        # publish AFTER the payload bytes are in place: a release fence
+        # when the native shim is present (bound by __init__), x86-TSO
+        # store ordering on the pure-Python fallback (module docstring)
+        if _release_fence is not None:
+            _release_fence()
         self._set_tail(tail + need)
         return True
 
@@ -206,6 +278,10 @@ class ShmRing:
         out: List[bytes] = []
         head = self._head()
         tail = self._tail()
+        # pair of the producer's release fence: payload reads below must
+        # not be satisfied from before the tail read (no-op on TSO)
+        if _acquire_fence is not None:
+            _acquire_fence()
         while head != tail and len(out) < max_records:
             off = head % self._cap
             (ln,) = struct.unpack_from("<I", self._buf, _HDR + off)
@@ -215,6 +291,12 @@ class ShmRing:
             base = _HDR + off
             out.append(bytes(self._buf[base + 4:base + 4 + ln]))
             head += 4 + _pad4(ln)
+        # head publish is a RELEASE too: it hands the drained region
+        # back to the producer, so the payload copies above must
+        # complete before the head store becomes visible (ARM permits
+        # load->store reordering; no-op on TSO)
+        if _release_fence is not None:
+            _release_fence()
         self._set_head(head)
         return out
 
