@@ -1991,6 +1991,128 @@ def config12_mesh_ladder(smoke, seed, subs):
     }
 
 
+def config13_downsampling_storm(smoke, seed):
+    """Telemetry downsampling storm (the MQTT+/edge-broker scenario):
+    fan-in publishes against predicate + aggregation subscriptions.
+
+    Builds the production wiring standalone — SchemaRegistry +
+    FilterEngine — registers N ``$gt(value,T)`` predicate subscriptions
+    (spread thresholds), M ``$avg(value,50)`` aggregation windows and a
+    sprinkle of unrepresentable conjunctions (host escapes), then
+    drives fan-in publish batches through ``filter_batch`` (the device
+    phase: one dispatch evaluates every (matched-subscriber ×
+    predicate) pair and folds the windows) vs the forced host
+    evaluator on identical inputs. Reports pair throughput both ways
+    (speedup_vs_host), filtered-row and emission counts, ``parity_ok``
+    covering healthy runs AND an injected ``device.predicate`` outage
+    (breaker opens, host serves bit-identically), honestly flagged
+    cpu_smoke off-TPU."""
+    import jax as _jax
+
+    from vernemq_tpu.cluster.metadata import MetadataStore
+    from vernemq_tpu.filters.engine import FilterEngine
+    from vernemq_tpu.filters.schema_registry import SchemaRegistry
+    from vernemq_tpu.protocol.types import SubOpts
+    from vernemq_tpu.robustness import faults
+
+    rng = random.Random(seed + 13)
+    n_pred = 64 if smoke else 512
+    n_agg = 16 if smoke else 128
+    n_conj = 8 if smoke else 32
+    batch = 512 if smoke else 2048
+    reps = 8 if smoke else 24
+
+    md = MetadataStore("bench13")
+    sreg = SchemaRegistry(md, "bench13")
+    sreg.set_schema("", "sensors/+/temp", "value:number,unit:enum(c|f)")
+    eng = FilterEngine(sreg, device_gate=lambda: True, host_threshold=1,
+                       window_cap=1 << 14)
+    emissions = [0]
+    eng.emit = lambda *_a: emissions.__setitem__(0, emissions[0] + 1)
+
+    rows = []
+    for i in range(n_pred):
+        o = SubOpts()
+        o.filter_expr = f"$gt(value,{rng.randrange(0, 100)})"
+        eng.on_sub_delta("add", "", o)
+        rows.append((("sensors", "+", "temp"), ("", f"p{i}"), o))
+    for i in range(n_agg):
+        o = SubOpts()
+        o.filter_expr = "$avg(value,50)"
+        rows.append((("sensors", "+", "temp"), ("", f"a{i}"), o))
+    for i in range(n_conj):
+        o = SubOpts()
+        o.filter_expr = (f"$gt(value,{rng.randrange(0, 50)})"
+                         f"&$eq(unit,c)")
+        rows.append((("sensors", "+", "temp"), ("", f"x{i}"), o))
+
+    sensors = [f"s{i}" for i in range(64)]
+
+    def mk_batch():
+        items = []
+        for _ in range(batch):
+            t = ("sensors", rng.choice(sensors), "temp")
+            payload = json.dumps(
+                {"value": round(rng.uniform(0, 100), 2),
+                 "unit": rng.choice(["c", "f"])}).encode()
+            items.append((t, eng.encode("", t, payload)))
+        return items
+
+    batches = [mk_batch() for _ in range(min(reps, 6))]
+    pairs_per_pub = n_pred + n_agg + n_conj
+    # warm (compile) then measure the device path
+    eng.filter_batch("", batches[0], [list(rows) for _ in batches[0]])
+    t0 = time.perf_counter()
+    for i in range(reps):
+        b = batches[i % len(batches)]
+        eng.filter_batch("", b, [list(rows) for _ in b])
+    dev_dt = time.perf_counter() - t0
+    dev_pairs_s = reps * batch * pairs_per_pub / dev_dt
+    # forced host evaluator on the same inputs
+    t0 = time.perf_counter()
+    for i in range(reps):
+        b = batches[i % len(batches)]
+        eng.filter_batch_host("", b, [list(rows) for _ in b])
+    host_dt = time.perf_counter() - t0
+    host_pairs_s = reps * batch * pairs_per_pub / host_dt
+
+    # parity: device vs host on a fresh batch, then under an injected
+    # persistent device.predicate outage (breaker opens, host serves)
+    pb = mk_batch()
+    healthy = eng.filter_batch("", pb, [list(rows) for _ in pb])
+    oracle = eng.filter_batch_host("", pb, [list(rows) for _ in pb])
+    bad = sum(1 for a, b2 in zip(healthy, oracle) if a != b2)
+    faults.install(faults.FaultPlan(
+        [faults.FaultRule("device.predicate", kind="error")], seed=13))
+    degraded = eng.filter_batch("", pb, [list(rows) for _ in pb])
+    eng.filter_batch("", pb, [list(rows) for _ in pb])
+    eng.filter_batch("", pb, [list(rows) for _ in pb])
+    degraded_bad = sum(1 for a, b2 in zip(degraded, oracle) if a != b2)
+    breaker_state = eng.breaker.state_name
+    faults.clear()
+
+    return {
+        "cpu_smoke": _jax.devices()[0].platform != "tpu",
+        "subscriptions": {"predicate": n_pred, "aggregate": n_agg,
+                          "conjunction_escapes": n_conj},
+        "batch": batch,
+        "pairs_per_publish": pairs_per_pub,
+        "device_pairs_per_sec": round(dev_pairs_s),
+        "host_pairs_per_sec": round(host_pairs_s),
+        "speedup_vs_host": round(dev_pairs_s / host_pairs_s, 2),
+        "device_publishes_per_sec": round(reps * batch / dev_dt),
+        "predicate_dispatches": eng.dispatches,
+        "rows_filtered": eng.rows_filtered,
+        "pairs_escaped_host": eng.pairs_escaped,
+        "aggregate_emissions": emissions[0],
+        "values_folded": eng.values_folded,
+        "windows_open": eng.status()["windows_open"],
+        "parity_ok": bad == 0 and degraded_bad == 0,
+        "breaker_state_during_outage": breaker_state,
+        "degraded_sheds": eng.degraded_sheds,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--subs", type=int, default=1_000_000)
@@ -2013,7 +2135,7 @@ def main() -> int:
                     help="internal: run ONE mesh-ladder rung at this "
                     "slice count in-process (config 12 spawns these "
                     "with forced host device counts)")
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12",
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13",
                     help="which BASELINE configs to run (3 = headline; "
                     "6 = fault-storm robustness: publish p99 while the "
                     "device path is down + breaker recovery time; "
@@ -2319,6 +2441,10 @@ def main() -> int:
         guarded("12_mesh_ladder",
                 lambda: config12_mesh_ladder(smoke, args.seed,
                                              args.subs))
+
+    if "13" in want:
+        guarded("13_downsampling_storm",
+                lambda: config13_downsampling_storm(smoke, args.seed))
 
     if headline is not None:
         value = headline["matches_per_sec"]

@@ -433,20 +433,28 @@ async def test_admin_fault_and_breaker_commands():
         assert faults.active().seed == 9
         table = reg.run(b, ["fault", "show"])["table"]
         assert any(r.get("point") == "device.dispatch" for r in table)
-        # breaker drill: trip forces degraded mode, reset restores
+        # breaker drill: trip forces degraded mode, reset restores.
+        # An unscoped trip covers EVERY device path — the match
+        # breaker plus the payload-predicate engine's (PR 10)
         b.registry.reg_view("tpu").matcher("")
         out = reg.run(b, ["breaker", "trip"])
-        assert "tripped 1" in out
+        assert "tripped 2" in out
         rows = reg.run(b, ["breaker", "show"])["table"]
-        assert rows[0]["state"] == "forced_open"
+        assert {r["path"] for r in rows} == {"match", "predicate"}
+        assert all(r["state"] == "forced_open" for r in rows)
         # pinned: no backoff expiry or stray success may close it
         m = b.registry.reg_view("tpu").matcher("")
         assert not m.breaker.allow()
         assert not m.breaker.record_success()
-        assert rows[0]["state"] == "forced_open"
+        assert not b.filter_engine.breaker.allow()
         reg.run(b, ["breaker", "reset"])
         rows = reg.run(b, ["breaker", "show"])["table"]
-        assert rows[0]["state"] == "closed"
+        assert all(r["state"] == "closed" for r in rows)
+        # a path-scoped trip touches only its own breaker
+        out = reg.run(b, ["breaker", "trip", "path=match"])
+        assert "tripped 1" in out
+        assert b.filter_engine.breaker.allow()
+        reg.run(b, ["breaker", "reset"])
         assert "cleared" in reg.run(b, ["fault", "clear"])
         assert faults.active() is None
     finally:

@@ -205,6 +205,57 @@ async def test_mesh_and_fence_metrics_exposed():
 
 
 @pytest.mark.asyncio
+async def test_predicate_and_aggregate_metrics_exposed():
+    """The payload-filter family (vernemq_tpu/filters/) is first-class:
+    every predicate_*/aggregate_* counter AND engine gauge appears in
+    the Prometheus scrape with non-empty HELP and in all_metrics(),
+    even with no schemas/predicates registered (zeros)."""
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+
+    names = (
+        # counters (metrics.COUNTERS)
+        "predicate_dispatches", "predicate_pairs_evaluated",
+        "predicate_host_evals", "predicate_escapes",
+        "predicate_rows_filtered", "predicate_phase_skips",
+        "predicate_device_failures", "predicate_degraded_sheds",
+        "predicate_errors", "aggregate_values_folded",
+        "aggregate_windows_closed", "aggregate_publishes",
+        "aggregate_publishes_delivered", "aggregate_window_overflow",
+        # engine gauges (FilterEngine.stats via broker._gauges)
+        "predicate_compiled", "predicate_dispatches_total",
+        "predicate_host_batches", "predicate_rows_filtered_total",
+        "predicate_degraded_sheds_total",
+        "predicate_device_failures_total", "predicate_dispatch_stalls",
+        "predicate_fail_open_errors", "predicate_breaker_state",
+        "predicate_breaker_opens", "aggregate_windows_open",
+        "aggregate_window_capacity", "aggregate_window_overflows",
+        "aggregate_emissions_total",
+    )
+    cfg = Config(systree_enabled=False, allow_anonymous=True)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        assert broker.filter_engine is not None  # default-on
+        text = broker.metrics.prometheus_text(node=broker.node_name)
+        am = broker.metrics.all_metrics()
+        for name in names:
+            assert f"\n{name}{{" in text or text.startswith(
+                f"{name}{{"), f"{name} not scraped"
+            help_line = next(
+                (line for line in text.splitlines()
+                 if line.startswith(f"# HELP {name} ")), None)
+            assert help_line is not None, f"{name} has no HELP"
+            assert len(help_line) > len(f"# HELP {name} "), \
+                f"{name} HELP text empty"
+            assert name in am, f"{name} missing from $SYS metrics"
+        assert am["predicate_breaker_state"] == 0.0
+        assert am["aggregate_windows_open"] == 0.0
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_histogram_families_exposed_and_consistent():
     """Stage latency histograms are first-class Prometheus families:
     HELP/TYPE present for every STAGE_FAMILIES entry, bucket counts

@@ -258,10 +258,24 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin breaker show")
     reg.register(["breaker", "trip"], _breaker_trip,
                  "vmq-admin breaker trip [mountpoint=] "
-                 "[path=match|retained]")
+                 "[path=match|retained|predicate]")
     reg.register(["breaker", "reset"], _breaker_reset,
                  "vmq-admin breaker reset [mountpoint=] "
-                 "[path=match|retained]")
+                 "[path=match|retained|predicate]")
+    reg.register(["schema", "show"], _schema_show,
+                 "vmq-admin schema show [mountpoint=MP]",
+                 "Registered payload schemas (replicated cluster-wide "
+                 "through the metadata plane)")
+    reg.register(["schema", "set"], _schema_set,
+                 "vmq-admin schema set topic=FILTER "
+                 "fields=name:kind,... [mountpoint=MP]  (kinds: "
+                 "number, bool, enum(a|b|...))")
+    reg.register(["schema", "del"], _schema_del,
+                 "vmq-admin schema del topic=FILTER [mountpoint=MP]")
+    reg.register(["filter", "show"], _filter_show,
+                 "vmq-admin filter show  (payload-filter engine: "
+                 "compiled predicates, window table, device-vs-host "
+                 "split, breaker)")
     reg.register(["timeline", "show"], _timeline_show,
                  "vmq-admin timeline show [n=20]",
                  "Recent flight-recorder publish samples with "
@@ -1252,6 +1266,15 @@ def _breaker_show(broker, flags):
                              "state": "disabled"})
             else:
                 rows.append({"path": "retained", "mountpoint": mp, **st})
+    feng = getattr(broker, "filter_engine", None)
+    if feng is not None:
+        for mp, st in feng.breaker_status().items():
+            if st is None:
+                rows.append({"path": "predicate", "mountpoint": mp,
+                             "state": "disabled"})
+            else:
+                rows.append({"path": "predicate", "mountpoint": mp,
+                             **st})
     return {"table": rows or [{"path": "-", "mountpoint": "(none)",
                                "state": "no matchers yet"}]}
 
@@ -1262,8 +1285,8 @@ def _each_breaker(broker, flags):
     trip/reset drills cover every device path."""
     want = flags.get("mountpoint")
     path = flags.get("path")
-    if path not in (None, "match", "retained"):
-        raise CommandError("path must be match or retained")
+    if path not in (None, "match", "retained", "predicate"):
+        raise CommandError("path must be match, retained or predicate")
     if path in (None, "match"):
         view = broker.registry.reg_views.get("tpu")
         for mp, m in getattr(view, "_matchers", {}).items():
@@ -1278,6 +1301,70 @@ def _each_breaker(broker, flags):
                 continue
             if idx.breaker is not None:
                 yield mp, idx.breaker
+    if path in (None, "predicate"):
+        feng = getattr(broker, "filter_engine", None)
+        if feng is not None and feng.breaker is not None \
+                and want is None:
+            # one engine-wide breaker (the predicate table is tiny):
+            # no per-mountpoint granularity to select on
+            yield "(all)", feng.breaker
+
+
+def _schemas(broker):
+    sr = getattr(broker, "schema_registry", None)
+    if sr is None:
+        raise CommandError("payload filters disabled "
+                           "(payload_filters_enabled=off)")
+    return sr
+
+
+def _schema_show(broker, flags):
+    """Registered payload schemas (the replicated field layouts the
+    predicate compiler and payload decoder resolve against)."""
+    sr = _schemas(broker)
+    rows = [{"mountpoint": s.mountpoint or "(default)",
+             "topic": s.filter_str, "fields": s.fields_spec()}
+            for s in sr.schemas(flags.get("mountpoint"))]
+    return {"table": rows or [{"mountpoint": "-", "topic": "(none)",
+                               "fields": "-"}]}
+
+
+def _schema_set(broker, flags):
+    """vmq-admin schema set topic=... fields=... [mountpoint=] —
+    replicates cluster-wide through the metadata plane (LWW, AE)."""
+    sr = _schemas(broker)
+    topic = flags.get("topic")
+    fields = flags.get("fields")
+    if not topic or not fields:
+        raise CommandError("topic= and fields= required")
+    try:
+        schema = sr.set_schema(str(flags.get("mountpoint", "") or ""),
+                               str(topic), str(fields))
+    except ValueError as e:
+        raise CommandError(str(e)) from None
+    return (f"schema set for {schema.mountpoint or '(default)'} "
+            f"{schema.filter_str}: {schema.fields_spec()}")
+
+
+def _schema_del(broker, flags):
+    sr = _schemas(broker)
+    topic = flags.get("topic")
+    if not topic:
+        raise CommandError("topic= required")
+    mp = str(flags.get("mountpoint", "") or "")
+    if not sr.delete_schema(mp, str(topic)):
+        raise CommandError(f"no schema for {mp or '(default)'} {topic}")
+    return f"schema deleted: {mp or '(default)'} {topic}"
+
+
+def _filter_show(broker, flags):
+    """Payload-filter engine status: compiled predicates, window table,
+    device-vs-host serving split, breaker state."""
+    eng = getattr(broker, "filter_engine", None)
+    if eng is None:
+        raise CommandError("payload filters disabled "
+                           "(payload_filters_enabled=off)")
+    return eng.status()
 
 
 def _governor(broker):

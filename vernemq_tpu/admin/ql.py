@@ -133,6 +133,44 @@ def message_rows(broker) -> Iterator[Dict[str, Any]]:
             }
 
 
+def payload_schema_rows(broker) -> Iterator[Dict[str, Any]]:
+    """Registered payload schemas (vernemq_tpu/filters/): one row per
+    (mountpoint, topic filter) with the field layout predicates
+    compile against."""
+    sr = getattr(broker, "schema_registry", None)
+    if sr is None:
+        return
+    for s in sr.schemas():
+        yield {"mountpoint": s.mountpoint, "topic": s.filter_str,
+               "fields": s.fields_spec(), "width": s.width}
+
+
+def filter_window_rows(broker) -> Iterator[Dict[str, Any]]:
+    """Open aggregation windows: one row per (subscription, topic)
+    accumulator slot — count/sum/min/max as currently folded."""
+    eng = getattr(broker, "filter_engine", None)
+    if eng is None:
+        return
+    with eng._lock:
+        win = eng._win
+        items = list(win.slot_of.items())
+        acc = win.acc.copy()
+    for _key, slot in items:
+        meta = win.meta[slot]
+        if meta is None:
+            continue
+        c = float(acc[slot][0])
+        yield {"mountpoint": meta.mountpoint,
+               "topic": "/".join(meta.topic),
+               "subscriber": str(meta.sub_key),
+               "filter": meta.expr,
+               "window": meta.agg.window_label,
+               "count": int(c),
+               "sum": round(float(acc[slot][1]), 6),
+               "min": round(float(acc[slot][2]), 6) if c else None,
+               "max": round(float(acc[slot][3]), 6) if c else None}
+
+
 TABLES: Dict[str, Callable[[Any], Iterator[Dict[str, Any]]]] = {
     "sessions": session_rows,
     "subscriptions": subscription_rows,
@@ -140,6 +178,8 @@ TABLES: Dict[str, Callable[[Any], Iterator[Dict[str, Any]]]] = {
     "retained_index": retained_index_rows,
     "queues": queue_rows,
     "messages": message_rows,
+    "payload_schemas": payload_schema_rows,
+    "filter_windows": filter_window_rows,
 }
 
 

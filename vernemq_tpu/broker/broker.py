@@ -84,6 +84,46 @@ class Broker:
         self._retained_collector: Optional[Any] = None
         self.metadata.subscribe("retain", self._on_retain_event)
         self.registry = Registry(self)
+        # payload filtering & windowed aggregation (vernemq_tpu/filters/,
+        # MQTT+): per-mountpoint schemas replicate through the metadata
+        # plane like the mesh slice map; the engine runs the predicate
+        # phase behind topic match. Disabled ⇒ both stay None and every
+        # hook is one attribute test — byte-identical to the pre-filter
+        # broker.
+        self.schema_registry: Optional[Any] = None
+        self.filter_engine: Optional[Any] = None
+        if self.config.get("payload_filters_enabled", True):
+            from ..filters.engine import FilterEngine
+            from ..filters.schema_registry import SchemaRegistry
+
+            self.schema_registry = SchemaRegistry(self.metadata, node_name)
+            self.schema_registry.boot_install(
+                self.config.get("payload_schemas", []))
+            cfg = self.config
+            self.filter_engine = FilterEngine(
+                self.schema_registry, metrics=self.metrics,
+                breaker_enabled=cfg.get("tpu_breaker_enabled", True),
+                breaker_failure_threshold=cfg.get(
+                    "tpu_breaker_failure_threshold", 3),
+                breaker_backoff_initial=cfg.get(
+                    "tpu_breaker_backoff_initial_ms", 200) / 1e3,
+                breaker_backoff_max=cfg.get(
+                    "tpu_breaker_backoff_max_ms", 10_000) / 1e3,
+                host_threshold=cfg.get("predicate_host_threshold", 16),
+                max_pairs=cfg.get("predicate_max_pairs", 65536),
+                window_initial=cfg.get("aggregate_initial_windows", 256),
+                window_cap=cfg.get("aggregate_max_windows", 4096),
+                tick_ms=cfg.get("aggregate_tick_ms", 250),
+                # the device phase runs only where the device lives:
+                # never in SO_REUSEPORT workers (the match service owns
+                # JAX; rows come back over the rings and the worker's
+                # exact host evaluator filters them), and only while
+                # the tpu view actually serves
+                device_gate=lambda: (
+                    self.match_client is None
+                    and self.registry.batched_view_active()),
+            )
+            self.filter_engine.emit = self._deliver_aggregate
         # mesh slice map (cluster/mesh_map.py): slice→node ownership in
         # the replicated metadata plane, gossiped like the netsplit
         # CAPs. Created whenever a tpu_mesh is configured — single-node
@@ -438,6 +478,54 @@ class Broker:
             "shm_ring_fence": "1 when the native release fence backs "
                               "ShmRing tail publishes, 0 on the "
                               "pure-Python x86-TSO fallback.",
+            # payload filtering & aggregation (vernemq_tpu/filters/):
+            # predicate-phase + window-table health, the tpu_breaker_*
+            # pattern extended to the third device path
+            "predicate_compiled": "Distinct compiled predicate rows "
+                                  "resident in the device predicate "
+                                  "tables.",
+            "predicate_dispatches_total": "Predicate-phase device "
+                                          "dispatches completed.",
+            "predicate_host_batches": "Predicate batches served by the "
+                                      "exact host evaluator (degraded/"
+                                      "small/forced-host).",
+            "predicate_rows_filtered_total": "Matched fanout rows "
+                                             "removed by payload "
+                                             "predicates.",
+            "predicate_degraded_sheds_total": "Predicate dispatches "
+                                              "refused while the "
+                                              "breaker was open (host "
+                                              "evaluator served).",
+            "predicate_device_failures_total": "Predicate device "
+                                               "failures fed to the "
+                                               "breaker.",
+            "predicate_dispatch_stalls": "Predicate dispatches "
+                                         "abandoned at the watchdog "
+                                         "deadline (fed to the "
+                                         "breaker).",
+            "predicate_fail_open_errors": "Predicate phase internal "
+                                          "errors that delivered the "
+                                          "batch unfiltered (fail-"
+                                          "open, loud).",
+            "predicate_breaker_state": "Predicate device breaker state "
+                                       "(0 closed, 1 half-open, 2 "
+                                       "open).",
+            "predicate_breaker_opens": "Predicate breaker open "
+                                       "transitions (device phase "
+                                       "degraded to the host "
+                                       "evaluator).",
+            "aggregate_windows_open": "Aggregation windows currently "
+                                      "accumulating.",
+            "aggregate_window_capacity": "Aggregation accumulator-"
+                                         "table capacity (grows in "
+                                         "doublings to the cap).",
+            "aggregate_window_overflows": "Aggregation subscriptions "
+                                          "degraded to raw delivery "
+                                          "because the window table "
+                                          "was full.",
+            "aggregate_emissions_total": "Synthesized aggregate "
+                                         "PUBLISHes emitted by closed "
+                                         "windows.",
         })
 
     # ------------------------------------------------------------ plumbing
@@ -557,6 +645,8 @@ class Broker:
             out.update(self._retained_engine.stats())
         if self._retained_collector is not None:
             out.update(self._retained_collector.stats())
+        if self.filter_engine is not None:
+            out.update(self.filter_engine.stats())
         out.update(self.watchdog.stats())
         out.update(self.recorder.stats())
         out.update(self._mesh_gauges())
@@ -916,8 +1006,26 @@ class Broker:
                 watchdog=self.watchdog,
                 dispatch_deadline_ms=self._dispatch_deadline_ms(),
                 item_expiry_ms=self._collector_expiry_ms(),
+                filter_engine=self.filter_engine,
             )
         return self._collector
+
+    def _deliver_aggregate(self, mountpoint: str, sub_key, opts,
+                           topic_words, payload: bytes) -> None:
+        """A closed aggregation window emits ONE synthesized PUBLISH to
+        its subscriber (the telemetry-downsampling delivery): topic =
+        the concrete aggregated topic, payload = the JSON aggregate.
+        Runs on the event loop (the engine marshals emissions here);
+        the subscriber's queue applies the normal delivery transform."""
+        sid = sub_key[2] if (isinstance(sub_key, tuple) and len(sub_key) == 3
+                             and sub_key[0] == "$g") else sub_key
+        queue = self.registry.queues.get(sid)
+        if queue is None:
+            return  # subscriber gone between fold and close: drop
+        msg = Msg(topic=tuple(topic_words), payload=payload,
+                  qos=getattr(opts, "qos", 0), mountpoint=mountpoint)
+        self.registry._enqueue_to(sid, msg, opts)
+        self.metrics.incr("aggregate_publishes_delivered")
 
     def _dispatch_deadline_ms(self) -> float:
         """Device-dispatch abandon deadline (0 when the watchdog is
@@ -1141,6 +1249,10 @@ class Broker:
         # retain cache (boot order of vmq_server_sup + vmq_reg_trie /
         # vmq_retain_srv warm-loads)
         self.registry.bootstrap()
+        if self.filter_engine is not None:
+            # time-window closes + aggregate emissions marshal onto the
+            # loop from the dispatch threads
+            self.filter_engine.arm(asyncio.get_event_loop())
         for key, value in self.metadata.fold("retain"):
             self.retain.apply_remote(key[0], tuple(key[1:]),
                                      self._retain_term(value))
@@ -1373,6 +1485,8 @@ class Broker:
             self._retained_collector.close()
         if self._retained_engine is not None:
             self._retained_engine.close()
+        if self.filter_engine is not None:
+            self.filter_engine.close()
         # the fault registry is process-global: a plan THIS broker
         # installed at boot must not keep injecting into other broker
         # instances in the process (multi-node tests, embedding) — but

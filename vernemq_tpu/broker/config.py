@@ -163,6 +163,31 @@ DEFAULTS: Dict[str, Any] = {
     "tpu_retained_max_fanout": 256,
     # pre-size the retained device table (growth rebuilds at doublings)
     "tpu_retained_initial_capacity": 2048,
+    # payload filtering & windowed aggregation (vernemq_tpu/filters/,
+    # MQTT+): subscriptions may carry a ?$-suffix predicate/aggregation
+    # over fields named in the per-mountpoint schema registry
+    # (`vmq-admin schema set`). Disabled = the '?' stays part of the
+    # topic and no engine is built — byte-identical to the pre-filter
+    # broker. Enabled with no schemas/predicates registered costs one
+    # dict probe per publish.
+    "payload_filters_enabled": True,
+    # boot-installed schemas: [{mountpoint, topic, fields}] dicts, e.g.
+    # {"mountpoint": "", "topic": "sensors/+/temp",
+    #  "fields": "value:number,unit:enum(c|f)"}
+    "payload_schemas": [],
+    # (matched-subscriber x predicate) pairs below this are evaluated
+    # by the exact host evaluator instead of paying a device round trip
+    # (the predicate analog of tpu_host_batch_threshold)
+    "predicate_host_threshold": 16,
+    # device pair cap per predicate dispatch; larger batches host-serve
+    "predicate_max_pairs": 65536,
+    # aggregation accumulator table: initial slots (grows in doublings)
+    # and the hard cap — past it aggregation subs degrade to raw
+    # per-message delivery, visibly (aggregate_window_overflows)
+    "aggregate_initial_windows": 256,
+    "aggregate_max_windows": 4096,
+    # time-window close scan interval (ms)
+    "aggregate_tick_ms": 250,
     # multi-process session front end (broker/workers.py +
     # broker/match_service.py): N worker processes share the MQTT port
     # via SO_REUSEPORT, each running parse/auth/session/queue locally;

@@ -160,6 +160,53 @@ COUNTERS: List[Tuple[str, str]] = [
      "Traced frames suppressed by the tracer rate limiter "
      "(max_rate); the trace output carries a '... N frames "
      "suppressed' marker when the window reopens."),
+    # payload filtering & windowed aggregation (vernemq_tpu/filters/):
+    # the predicate_*/aggregate_* families — one counter per path so
+    # operators see device-vs-host split, escapes, and the zero-cost
+    # skip gate working
+    ("predicate_dispatches",
+     "Predicate-phase device dispatches (one per fold batch carrying "
+     "compiled predicates)."),
+    ("predicate_pairs_evaluated",
+     "(matched-subscriber x predicate) pairs evaluated on the device "
+     "path."),
+    ("predicate_host_evals",
+     "Predicate pairs evaluated by the exact host evaluator "
+     "(breaker-open/degraded, sub-threshold batches, and "
+     "unrepresentable escapes)."),
+    ("predicate_escapes",
+     "Predicate pairs host-resolved because the predicate cannot be "
+     "represented as one device row (conjunctions, enum alphabets "
+     "past 64 codes)."),
+    ("predicate_rows_filtered",
+     "Matched fanout rows removed by payload predicates before any "
+     "per-subscriber queue work."),
+    ("predicate_phase_skips",
+     "Fold batches that skipped the predicate phase entirely (no "
+     "compiled predicates for the batch — the zero-cost gate)."),
+    ("predicate_device_failures",
+     "Predicate-phase device failures (dispatch errors and watchdog "
+     "stalls) fed to the predicate breaker."),
+    ("predicate_degraded_sheds",
+     "Predicate dispatches refused while the predicate breaker was "
+     "open (host evaluator served)."),
+    ("predicate_errors",
+     "Predicate-phase internal errors that delivered a batch "
+     "unfiltered (fail-open, logged loudly)."),
+    ("aggregate_values_folded",
+     "Payload values folded into aggregation windows (device and "
+     "host paths)."),
+    ("aggregate_windows_closed",
+     "Aggregation windows closed (count target reached or time "
+     "window elapsed)."),
+    ("aggregate_publishes",
+     "Synthesized aggregate PUBLISHes emitted by closed windows."),
+    ("aggregate_publishes_delivered",
+     "Synthesized aggregate PUBLISHes enqueued to a live subscriber "
+     "queue."),
+    ("aggregate_window_overflow",
+     "Aggregation subscriptions served raw per-message delivery "
+     "because the window table hit aggregate_max_windows."),
 ]
 
 

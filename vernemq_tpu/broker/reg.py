@@ -486,7 +486,7 @@ class Registry:
         new_node = new.node if new is not None else None
         for fw, opts in old_subs.items():
             if fw not in new_subs or new_node != old_node:
-                self._trie_remove(mountpoint, fw, sid, old_node)
+                self._trie_remove(mountpoint, fw, sid, old_node, opts)
         for fw, opts in new_subs.items():
             prev = old_subs.get(fw)
             if prev is None or old_node != new_node:
@@ -497,6 +497,13 @@ class Registry:
                 # their refcount bumped)
                 group, _ = unshare(list(fw))
                 if group is not None or new_node == self.node_name:
+                    # in-place row replace: balance the filter-engine
+                    # refcount (and free the old opts' windows) before
+                    # the add bumps it — a re-subscribe changing the
+                    # predicate must not leak a wants() ref or inherit
+                    # a dead window's accumulator
+                    self._filters_delta("remove", mountpoint, prev,
+                                        fw, sid)
                     self._trie_add(mountpoint, fw, sid, new_node, opts)
         # a remote node took over a persistent subscriber we hold a queue
         # for → queue migration trigger (vmq_reg_mgr.erl:155-243, task:
@@ -530,6 +537,7 @@ class Registry:
                   sid: SubscriberId, node: str, opts: SubOpts) -> None:
         trie = self.trie(mountpoint)
         opts.node = node  # locality for shared-sub policy + introspection
+        self._filters_delta("add", mountpoint, opts)
         group, rest = unshare(list(fw))
         if group is not None:
             key = ("$g", group, sid)
@@ -547,8 +555,10 @@ class Registry:
                 self._emit_delta("add", mountpoint, list(fw), node, None)
 
     def _trie_remove(self, mountpoint: str, fw: Tuple[str, ...],
-                     sid: SubscriberId, node: str) -> None:
+                     sid: SubscriberId, node: str,
+                     opts: Optional[SubOpts] = None) -> None:
         trie = self.trie(mountpoint)
+        self._filters_delta("remove", mountpoint, opts, fw, sid)
         group, rest = unshare(list(fw))
         if group is not None:
             key = ("$g", group, sid)
@@ -616,6 +626,23 @@ class Registry:
             # view, which is fed through the trie events directly)
             view.on_delta(op, mountpoint, filter_words, key, opts)
 
+    def _filters_delta(self, op: str, mountpoint: str, opts,
+                       fw=None, sid=None) -> None:
+        """Subscription change → payload-filter engine refcounts (the
+        wants() gate of vernemq_tpu/filters/engine.py): predicate-
+        carrying subscriptions register per mountpoint so unfiltered
+        traffic skips the predicate phase at one dict probe. Removes
+        carry the routing-row key so the engine frees the
+        subscription's aggregation windows."""
+        eng = getattr(self.broker, "filter_engine", None)
+        if eng is None:
+            return
+        key = None
+        if fw is not None and sid is not None:
+            group, _ = unshare(list(fw))
+            key = ("$g", group, sid) if group is not None else sid
+        eng.on_sub_delta(op, mountpoint, opts, key)
+
     def unsubscribe(self, sid: SubscriberId, topics: List[List[str]]) -> List[bool]:
         cfg = self.broker.config
         if not self.broker.cluster_ready() and not cfg.allow_unsubscribe_during_netsplit:
@@ -672,8 +699,16 @@ class Registry:
         if queue is None:
             return  # session ended between subscribe and batch resolve
         now = time.time()
+        # payload-filter replay seam: a predicated subscription replays
+        # only passing retained messages (exact host evaluator — the
+        # payload is in hand); aggregation subs get no raw replay
+        eng = (self.broker.filter_engine
+               if getattr(opts, "filter_expr", None) else None)
         for topic, rmsg in matches:
             if rmsg.expiry_ts is not None and rmsg.expiry_ts < now:
+                continue
+            if eng is not None and eng.passes_single(
+                    sid[0], topic, rmsg.payload, opts) is False:
                 continue
             props = dict(rmsg.properties)
             expires_at = None
@@ -717,7 +752,27 @@ class Registry:
             # publish_async/BatchCollector
             name = "trie"
         rows = self.reg_view(name).fold(msg.mountpoint, msg.topic)
+        rows = self._filter_rows_host(msg, rows)
         return self.route_rows(msg, rows, from_sid)
+
+    def _filter_rows_host(self, msg: Msg, rows):
+        """Payload-predicate phase for the synchronous fold paths (the
+        exact host evaluator; the device phase rides the collector).
+        One dict probe when no predicates exist on the mountpoint."""
+        eng = getattr(self.broker, "filter_engine", None)
+        if eng is None or not eng.wants(msg.mountpoint):
+            return rows
+        feat = eng.encode(msg.mountpoint, msg.topic, msg.payload)
+        return eng.filter_single(msg.mountpoint, msg.topic, feat,
+                                 list(rows))
+
+    def _filters_feat(self, msg: Msg):
+        """Feature row riding the collector submit (the K-batch staging
+        of the device predicate phase); None when the phase won't run."""
+        eng = getattr(self.broker, "filter_engine", None)
+        if eng is None or not eng.wants(msg.mountpoint):
+            return None
+        return eng.encode(msg.mountpoint, msg.topic, msg.payload)
 
     async def publish_async(
         self, msg: Msg, from_sid: Optional[SubscriberId] = None,
@@ -730,7 +785,7 @@ class Registry:
         recorder) rides the collector item into the fold envelope."""
         msg = self._pre_publish(msg)
         rows = await self.broker.batch_collector().submit(
-            msg.mountpoint, msg.topic, trace)
+            msg.mountpoint, msg.topic, trace, feat=self._filters_feat(msg))
         return self.route_rows(msg, rows, from_sid)
 
     def publish_nowait(self, msg: Msg,
@@ -746,7 +801,7 @@ class Registry:
         stage covers the fanout work too."""
         msg = self._pre_publish(msg)
         fut = self.broker.batch_collector().submit(
-            msg.mountpoint, msg.topic, trace)
+            msg.mountpoint, msg.topic, trace, feat=self._filters_feat(msg))
 
         def _done(f: "asyncio.Future") -> None:
             exc = f.exception()
@@ -855,6 +910,7 @@ class Registry:
         """Entry for ``msg`` frames from the cluster channel: fold the local
         view, local subscribers only (vmq_cluster_com.erl:153-157)."""
         rows = self.reg_view("trie").fold(msg.mountpoint, msg.topic)
+        rows = self._filter_rows_host(msg, rows)
         return self.route_rows(msg, rows, None, origin_local=False)
 
     def enqueue_remote(self, sid: SubscriberId, msgs: List[Msg]) -> bool:

@@ -98,6 +98,19 @@ FLAT_ALIASES.update({
     "mesh.native": "tpu_mesh_native",
 })
 
+#: extension family: payload filtering & windowed aggregation
+#: (vernemq_tpu/filters/) — the MQTT+ predicate/aggregate surface;
+#: schema DEFINITIONS are replicated state (`vmq-admin schema set` /
+#: the payload_schemas config list), these are the serving knobs
+FLAT_ALIASES.update({
+    "payload_schema.enabled": "payload_filters_enabled",
+    "payload_schema.host_threshold": "predicate_host_threshold",
+    "payload_schema.max_pairs": "predicate_max_pairs",
+    "payload_schema.initial_windows": "aggregate_initial_windows",
+    "payload_schema.max_windows": "aggregate_max_windows",
+    "payload_schema.window_tick_ms": "aggregate_tick_ms",
+})
+
 #: reference knobs typed in MILLISECONDS whose internal knob is seconds
 MS_TO_SECONDS = {
     "systree_interval",

@@ -30,6 +30,7 @@ from typing import TYPE_CHECKING, Any, Dict, List, Optional, Tuple
 
 log = logging.getLogger("vernemq_tpu.session")
 
+from ..filters.predicate import FilterError, parse_filter, split_filter_suffix
 from ..protocol import codec_v4, codec_v5
 from ..protocol import topic as T
 from ..protocol.types import (
@@ -930,7 +931,28 @@ class Session:
                 sub_id = ids[0]
         topics: List[Tuple[List[str], SubOpts]] = []
         codes: List[int] = []
+        filters_on = cfg.get("payload_filters_enabled", True)
         for topic_str, opts in f.topics:
+            # MQTT+ payload-filter suffix (vernemq_tpu/filters/):
+            # `sensors/+/temp?$gt(value,30)` splits into the plain topic
+            # filter plus a predicate/aggregation expression carried in
+            # SubOpts. Works identically for v4 and v5 (the suffix rides
+            # the topic string, no new packet fields). With the feature
+            # disabled the `?` stays part of the topic, byte-identical
+            # to the pre-filter broker.
+            if filters_on:
+                base_str, fexpr = split_filter_suffix(topic_str)
+                if fexpr is not None:
+                    try:
+                        parse_filter(fexpr)
+                    except FilterError:
+                        self.broker.metrics.incr("mqtt_subscribe_error")
+                        codes.append(0x8F if self.proto_ver == PROTO_5
+                                     else 0x80)
+                        topics.append(None)
+                        continue
+                    topic_str = base_str
+                    opts.filter_expr = fexpr
             try:
                 words = T.validate_topic("subscribe", topic_str)
             except T.TopicError:
@@ -1009,7 +1031,12 @@ class Session:
 
     async def _handle_unsubscribe(self, f: Unsubscribe) -> None:
         topics = []
+        filters_on = self.broker.config.get("payload_filters_enabled", True)
         for topic_str in f.topics:
+            if filters_on:
+                # a filter-suffixed UNSUBSCRIBE targets its base topic
+                # filter (the suffix rides SubOpts, not the sub key)
+                topic_str, _fexpr = split_filter_suffix(topic_str)
             try:
                 topics.append(T.validate_topic("subscribe", topic_str))
             except T.TopicError:

@@ -30,6 +30,14 @@ def opts_to_dict(opts: SubOpts) -> Dict[str, Any]:
     sub_id = getattr(opts, "subscription_id", None)
     if sub_id:
         d["sid"] = sub_id
+    # MQTT+ payload-filter suffix (vernemq_tpu/filters/): carried
+    # UNCONDITIONALLY — a node with payload filters disabled must
+    # round-trip a replicated filtered subscription verbatim (re-storing
+    # the record must never truncate it into a plain topic sub; the
+    # "flt" cluster capability advertises which peers evaluate it)
+    flt = getattr(opts, "filter_expr", None)
+    if flt:
+        d["flt"] = flt
     return d
 
 
@@ -38,6 +46,8 @@ def opts_from_dict(d: Dict[str, Any]) -> SubOpts:
                    rap=d.get("rap", False), retain_handling=d.get("rh", 0))
     if "sid" in d:
         opts.subscription_id = d["sid"]
+    if "flt" in d:
+        opts.filter_expr = d["flt"]
     return opts
 
 

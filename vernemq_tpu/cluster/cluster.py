@@ -357,9 +357,17 @@ class Cluster:
         peers ignore unknown fields, we treat a missing "caps" as none),
         and the writer drop totals, split frames/bytes."""
         writers = list(self._writers.values()) + self._bootstrap
+        caps = ["spool"] if self.spool is not None else []
+        if getattr(self.broker, "filter_engine", None) is not None:
+            # payload-predicate evaluation (vernemq_tpu/filters/): the
+            # subscription's filter suffix replicates verbatim either
+            # way (subscriber_db "flt" field); the cap only advertises
+            # which peers EVALUATE it, for `cluster show` diagnosis of
+            # mixed-version deployments
+            caps.append("flt")
         return {"node": self.node_name,
                 "addr": [self.listen_host, self.listen_port],
-                "caps": ["spool"] if self.spool is not None else [],
+                "caps": caps,
                 "frames_dropped": sum(w.dropped_frames for w in writers),
                 "bytes_dropped": sum(w.dropped_bytes for w in writers)}
 

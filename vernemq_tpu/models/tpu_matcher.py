@@ -1719,8 +1719,14 @@ class BatchCollector:
                  lock_busy_shed_ms: int = 500, super_batch_k: int = 8,
                  latency_budget_ms: float = 50.0,
                  watchdog=None, dispatch_deadline_ms: float = 0.0,
-                 item_expiry_ms: float = 0.0):
+                 item_expiry_ms: float = 0.0, filter_engine=None):
         self.view = view
+        # payload-filter engine (vernemq_tpu/filters/): when set, every
+        # flush's matched fanout runs the predicate phase — device
+        # dispatch chained behind topic match, host evaluator on every
+        # shed path — before the futures settle. None (the default, and
+        # filters-disabled) touches nothing on any path.
+        self.filter_engine = filter_engine
         # stall watchdog (robustness/watchdog.py): with a deadline set,
         # device flushes run as SACRIFICIAL dispatches — the await is
         # released at the deadline (StallAbandoned → host trie serves,
@@ -1839,27 +1845,38 @@ class BatchCollector:
             f._vmq_res = f._vmq_exc = None
 
     def _settle_via_trie(self, mp: str, topic, fut,
-                         fallback_exc: Optional[BaseException] = None) -> None:
+                         fallback_exc: Optional[BaseException] = None,
+                         feat=None) -> None:
         """Serve one publish from the host trie (the correctness oracle)
         and settle its future; without a registry the original cause —
-        not a misleading AttributeError — reaches the caller."""
+        not a misleading AttributeError — reaches the caller. The
+        payload-predicate phase applies here too (exact host evaluator):
+        a shed/degraded publish must deliver the same filtered fanout
+        as the device path."""
         reg = getattr(self.view, "registry", None)
         if reg is None:
             self._settle(fut, exc=fallback_exc
                          or RuntimeError("no registry for trie fallback"))
             return
         try:
-            self._settle(fut, res=reg.trie(mp).match(list(topic)))
+            rows = reg.trie(mp).match(list(topic))
+            eng = self.filter_engine
+            if eng is not None and eng.wants(mp):
+                rows = eng.filter_single(mp, topic, feat, list(rows))
+            self._settle(fut, res=rows)
         except Exception as e:
             self._settle(fut, exc=e)
 
     def submit(self, mountpoint: str, topic: Sequence[str],
-               trace=None) -> asyncio.Future:
+               trace=None, feat=None) -> asyncio.Future:
         """``trace`` — an optional flight-recorder PublishTrace
         (observability/recorder.py): the sampled-at-admission context
         rides the pending item into the flush, where the collector
         stamps dequeue/match and, in worker mode, attaches the
-        match-service fold meta (the cross-process ring stamps)."""
+        match-service fold meta (the cross-process ring stamps).
+        ``feat`` — the publish's payload feature row (filters/engine
+        encode) riding the same staging into the predicate phase; None
+        for unfiltered mountpoints (zero-cost)."""
         loop = asyncio.get_event_loop()
         fut = self._enqueue_fut(loop)
         if (self._inflight >= self.MAX_INFLIGHT
@@ -1880,7 +1897,7 @@ class BatchCollector:
             # device needs to catch back up.
             if getattr(self.view, "registry", None) is not None:
                 self.overload_host_pubs += 1
-                self._settle_via_trie(mountpoint, topic, fut)
+                self._settle_via_trie(mountpoint, topic, fut, feat=feat)
                 return fut
         now_sub = time.monotonic()
         exp = (now_sub + self.item_expiry
@@ -1888,7 +1905,7 @@ class BatchCollector:
         if trace is not None:
             trace.stamp("submit")
         self._pending.append((mountpoint, tuple(topic), fut, exp,
-                              now_sub, trace))
+                              now_sub, trace, feat))
         if exp is not None and self._expiry_handle is None:
             # expiry sweep: fires even when no flush can (both pipeline
             # slots wedged) — the queued-tail bound of the stall story
@@ -1943,7 +1960,7 @@ class BatchCollector:
             if (exp is not None and now >= exp
                     and settled < self._EXPIRE_CHUNK):
                 self.expired_host_pubs += 1
-                self._settle_via_trie(mp, topic, fut)
+                self._settle_via_trie(mp, topic, fut, feat=item[6])
                 settled += 1
             else:
                 keep.append(item)
@@ -1962,8 +1979,8 @@ class BatchCollector:
         if len(self._pending) <= self.host_threshold and reg is not None:
             pending, self._pending = self._pending, []
             self.host_hybrid_pubs += len(pending)
-            for mp, topic, fut, _exp, _t_sub, _trace in pending:
-                self._settle_via_trie(mp, topic, fut)
+            for mp, topic, fut, _exp, _t_sub, _trace, feat in pending:
+                self._settle_via_trie(mp, topic, fut, feat=feat)
             return
         if self._inflight >= self.MAX_INFLIGHT:
             # both slots busy: DON'T queue a third task — leave the
@@ -2020,15 +2037,17 @@ class BatchCollector:
         # the exact host trie instead of riding — and lengthening — a
         # device dispatch they already waited too long for
         now = time.monotonic()
-        by_mp: Dict[str, List[Tuple[Tuple[str, ...], asyncio.Future]]] = {}
+        by_mp: Dict[str, List[Tuple[Tuple[str, ...], asyncio.Future,
+                                    Any]]] = {}
         traces_mp: Dict[str, list] = {}
-        expired: List[Tuple[str, Tuple[str, ...], asyncio.Future]] = []
+        expired: List[Tuple[str, Tuple[str, ...], asyncio.Future,
+                            Any]] = []
         oldest_sub = None
-        for mp, topic, fut, exp, t_sub, trace in pending:
+        for mp, topic, fut, exp, t_sub, trace, feat in pending:
             if exp is not None and now >= exp:
-                expired.append((mp, topic, fut))
+                expired.append((mp, topic, fut, feat))
             else:
-                by_mp.setdefault(mp, []).append((topic, fut))
+                by_mp.setdefault(mp, []).append((topic, fut, feat))
                 if oldest_sub is None or t_sub < oldest_sub:
                     oldest_sub = t_sub
                 if trace is not None:
@@ -2040,13 +2059,13 @@ class BatchCollector:
             # per dispatch keeps the seam cost flat at any batch size)
             obs.observe("stage_collector_wait_ms",
                         (now - oldest_sub) * 1e3)
-        for i, (mp, t_, fut) in enumerate(expired):
+        for i, (mp, t_, fut, feat) in enumerate(expired):
             self.expired_host_pubs += 1
-            self._settle_via_trie(mp, t_, fut)
+            self._settle_via_trie(mp, t_, fut, feat=feat)
             if (i + 1) % 64 == 0:
                 await asyncio.sleep(0)
         for mp, items in by_mp.items():
-            topics = [t for t, _ in items]
+            topics = [t for t, _, _ in items]
             self.view.matcher(mp)  # warm-load on the loop thread (see matcher())
             lock_to = (self.lock_busy_shed_ms / 1e3
                        if self.lock_busy_shed_ms else None)
@@ -2120,8 +2139,9 @@ class BatchCollector:
                      if hasattr(self.view, "matcher") else None)
                 if m is not None and hasattr(m, "record_stall"):
                     m.record_stall(sa)
-                for i, (t_, fut) in enumerate(items):
-                    self._settle_via_trie(mp, t_, fut, fallback_exc=sa)
+                for i, (t_, fut, feat) in enumerate(items):
+                    self._settle_via_trie(mp, t_, fut, fallback_exc=sa,
+                                          feat=feat)
                     if (i + 1) % 64 == 0:
                         await asyncio.sleep(0)
                 continue
@@ -2159,21 +2179,49 @@ class BatchCollector:
                             m.ensure_warm(len(items))
                 else:
                     self.rebuild_host_pubs += len(items)
-                for i, (t_, fut) in enumerate(items):
-                    self._settle_via_trie(mp, t_, fut, fallback_exc=rb)
+                for i, (t_, fut, feat) in enumerate(items):
+                    self._settle_via_trie(mp, t_, fut, fallback_exc=rb,
+                                          feat=feat)
                     if (i + 1) % 64 == 0:
                         await asyncio.sleep(0)
                 continue
             except Exception as e:  # settle futures with the error
-                for _, fut in items:
+                for _, fut, _feat in items:
                     self._settle(fut, exc=e)
                 continue
+            # payload-predicate phase (vernemq_tpu/filters/): the second
+            # device dispatch chained behind topic match — skipped at
+            # one dict probe when the mountpoint carries no predicates.
+            # A wedged phase is abandoned at the same dispatch deadline
+            # (host evaluator serves, breaker fed, late fold discarded);
+            # any other engine failure fails open inside filter_batch.
+            eng = self.filter_engine
+            if eng is not None:
+                if not eng.wants(mp):
+                    eng.note_skip()
+                else:
+                    tf = [(t, feat) for t, _fut, feat in items]
+                    try:
+                        if sacrificial:
+                            results = await wd.dispatch_async(
+                                "device.predicate",
+                                lambda m=mp, x=tf, r=results:
+                                    eng.filter_batch(m, x, r),
+                                self.dispatch_deadline,
+                                label=f"predicate:{mp or '(default)'}")
+                        else:
+                            results = await loop.run_in_executor(
+                                None, eng.filter_batch, mp, tf, results)
+                    except StallAbandoned as sa:
+                        eng.record_stall(sa)
+                        results = await loop.run_in_executor(
+                            None, eng.filter_batch_host, mp, tf, results)
             if mtraces:
                 for tr in mtraces:
                     tr.stamp("match")
                     if meta_box:
                         tr.meta = meta_box
-            for (_, fut), rows in zip(items, results):
+            for (_, fut, _feat), rows in zip(items, results):
                 self._settle(fut, res=rows)
         # overload-signal EWMA: whole-flush service time (shed/degraded
         # paths included — a slow fallback is pressure too)

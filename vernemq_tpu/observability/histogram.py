@@ -83,6 +83,16 @@ STAGE_FAMILIES: List[Tuple[str, str]] = [
      "Slice-routed delta flush latency: per-slice sub-delta build + "
      "scatter over only the dirty slices' shards (informs "
      "sub_to_matchable_ms_max at mesh scale)."),
+    ("stage_predicate_dispatch_ms",
+     "Payload-predicate phase device dispatch latency: pair upload + "
+     "kernel + verdict/partial pull per fold batch "
+     "(vernemq_tpu/filters/; informs predicate_host_threshold and "
+     "watchdog_dispatch_deadline_ms)."),
+    ("stage_predicate_host_ms",
+     "Exact host-evaluator latency per predicate batch served "
+     "host-side (breaker-open/degraded, sub-threshold, or "
+     "unrepresentable-escape pairs; the device-vs-host comparison "
+     "base for bench config 13)."),
 ]
 
 _ENABLED = True

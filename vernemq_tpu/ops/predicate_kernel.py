@@ -1,0 +1,155 @@
+"""Payload-predicate evaluation + windowed aggregation as dense JAX ops —
+the second device phase behind topic match (MQTT+ content filters,
+PAPERS.md: brokers should evaluate data predicates, not just topic
+filters).
+
+Representation: compiled predicates live in HBM as parallel arrays over
+**predicate rows** —
+
+- ``p_op``    int32 [NP]: comparison opcode (``OP_*`` below, ``OP_PAD``
+  for free slots);
+- ``p_field`` int32 [NP]: feature column the predicate reads (schemas
+  append one guaranteed-NaN column, so an unknown field compiles to a
+  real index instead of a host escape);
+- ``p_a``/``p_b`` float32 [NP]: threshold / range bounds;
+- ``p_mlo``/``p_mhi`` int32 [NP]: 64-bit enum-membership bitmask for
+  ``OP_IN`` (codes 0..63; larger enum alphabets escape to the host).
+
+A publish batch ships as a feature matrix ``feats`` float32 [B, F]
+(NaN = field missing / payload undecodable) and the topic-match fanout
+arrives as **pairs**: ``(pair_pub, pair_pred)`` — one pair per matched
+(publish × predicated-subscription). ONE dispatch evaluates every
+pair's keep verdict; a missing value satisfies only ``OP_NULL``
+(MQTT+ null-check), every comparison on NaN is false.
+
+Aggregation subscriptions (``$AVG``/``$MIN``/``$MAX``/``$SUM``/
+``$COUNT`` over count- or time-windows) ride the SAME dispatch: a
+device-resident accumulator table ``acc`` float32 [W, 4]
+(count, sum, min, max) is updated in place (donated) from the batch's
+``(agg_slot, agg_pub, agg_field)`` pairs via segment reductions, and
+the per-slot partials come back so the host mirror folds identically
+(both sides do the same float32 adds on the same values — the window a
+degraded host path keeps accumulating stays bit-compatible).
+
+The host evaluator twin lives in ``filters/predicate.py``
+(``eval_compiled_row``): same opcodes, same float32 semantics, used by
+the exact fallback behind the CircuitBreaker — predicate-filtered
+fanout is bit-identical between the two paths by construction.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# predicate opcodes + host twin live in filters/predicate.py (jax-free
+# — worker processes import it without pulling the JAX runtime in);
+# re-exported here so kernel callers see ONE semantic, two executors
+from ..filters.predicate import (  # noqa: F401
+    MISSING,
+    OP_EQ,
+    OP_EXISTS,
+    OP_GE,
+    OP_GT,
+    OP_IN,
+    OP_LE,
+    OP_LT,
+    OP_NE,
+    OP_NULL,
+    OP_PAD,
+    OP_RANGE,
+    OP_TRUE,
+)
+
+
+def _pair_keep(p_op, p_field, p_a, p_b, p_mlo, p_mhi, feats,
+               pair_pub, pair_pred):
+    """[P] keep verdicts for the (publish, predicate) pairs."""
+    op = p_op[pair_pred]
+    fi = p_field[pair_pred]
+    x = feats[pair_pub, fi]
+    a = p_a[pair_pred]
+    b = p_b[pair_pred]
+    missing = jnp.isnan(x)
+    xs = jnp.where(missing, jnp.float32(0), x)
+    # enum membership: integral codes 0..63 against the 2x int32 mask
+    code = xs.astype(jnp.int32)
+    code_ok = (~missing) & (xs == jnp.floor(xs)) & (xs >= 0) & (xs < 64)
+    cc = jnp.clip(code, 0, 63)
+    lo_bit = (p_mlo[pair_pred] >> jnp.minimum(cc, 31)) & 1
+    hi_bit = (p_mhi[pair_pred] >> jnp.clip(cc - 32, 0, 31)) & 1
+    in_mask = jnp.where(cc < 32, lo_bit, hi_bit) == 1
+    res = jnp.select(
+        [op == OP_TRUE,
+         op == OP_GT, op == OP_GE, op == OP_LT, op == OP_LE,
+         op == OP_EQ, op == OP_NE, op == OP_RANGE, op == OP_IN,
+         op == OP_EXISTS, op == OP_NULL],
+        [jnp.ones_like(missing),
+         xs > a, xs >= a, xs < a, xs <= a,
+         xs == a, xs != a, (xs >= a) & (xs <= b), code_ok & in_mask,
+         ~missing, missing],
+        default=jnp.zeros_like(missing))
+    # NaN short-circuit: only $null (and the TRUE gate) survives missing
+    keep = jnp.where(missing, (op == OP_NULL) | (op == OP_TRUE), res)
+    return keep & (op != OP_PAD)
+
+
+@jax.jit
+def eval_pairs(p_op, p_field, p_a, p_b, p_mlo, p_mhi, feats,
+               pair_pub, pair_pred):
+    """Predicate-only dispatch (no aggregation windows in the batch)."""
+    return _pair_keep(p_op, p_field, p_a, p_b, p_mlo, p_mhi, feats,
+                      pair_pub, pair_pred)
+
+
+def _agg_partials(acc, feats, agg_slot, agg_pub, agg_field, agg_valid, W):
+    """Per-slot partial reductions of this batch + the updated table."""
+    fi = jnp.maximum(agg_field, 0)
+    raw = feats[agg_pub, fi]
+    countlike = agg_field < 0  # $COUNT: no field, every message counts
+    val = jnp.where(countlike, jnp.float32(0), raw)
+    valid = agg_valid & (countlike | ~jnp.isnan(raw))
+    # invalid pairs land in a spill segment past the table
+    seg = jnp.where(valid, agg_slot, W)
+    ones = jnp.where(valid, jnp.float32(1), jnp.float32(0))
+    v0 = jnp.where(valid, val, jnp.float32(0))
+    cnt = jax.ops.segment_sum(ones, seg, num_segments=W + 1)[:W]
+    sm = jax.ops.segment_sum(v0, seg, num_segments=W + 1)[:W]
+    inf = jnp.float32(jnp.inf)
+    mn = jax.ops.segment_min(jnp.where(valid, val, inf), seg,
+                             num_segments=W + 1)[:W]
+    mx = jax.ops.segment_max(jnp.where(valid, val, -inf), seg,
+                             num_segments=W + 1)[:W]
+    touched = cnt > 0
+    new_acc = acc.at[:, 0].add(cnt)
+    new_acc = new_acc.at[:, 1].add(sm)
+    new_acc = new_acc.at[:, 2].set(
+        jnp.where(touched, jnp.minimum(acc[:, 2], mn), acc[:, 2]))
+    new_acc = new_acc.at[:, 3].set(
+        jnp.where(touched, jnp.maximum(acc[:, 3], mx), acc[:, 3]))
+    return new_acc, cnt, sm, mn, mx
+
+
+@functools.partial(jax.jit, static_argnames=("W",), donate_argnums=(6,))
+def predicate_phase(p_op, p_field, p_a, p_b, p_mlo, p_mhi, acc, feats,
+                    pair_pub, pair_pred, agg_slot, agg_pub, agg_field,
+                    agg_gate, agg_valid, *, W: int):
+    """The full second phase in ONE dispatch: pair keep-masks plus the
+    in-place (donated) accumulator-table update and its per-slot
+    partials. ``agg_gate`` is a predicate-row id gating each fold
+    (``$gt(v,30)&$avg(v,100)`` folds only passing messages; the
+    reserved OP_TRUE row gates nothing). ``W`` is the accumulator
+    capacity (static: the table grows in doublings like the
+    subscription table)."""
+    keep = _pair_keep(p_op, p_field, p_a, p_b, p_mlo, p_mhi, feats,
+                      pair_pub, pair_pred)
+    gate_ok = _pair_keep(p_op, p_field, p_a, p_b, p_mlo, p_mhi, feats,
+                         agg_pub, agg_gate)
+    new_acc, cnt, sm, mn, mx = _agg_partials(
+        acc, feats, agg_slot, agg_pub, agg_field, agg_valid & gate_ok, W)
+    return keep, new_acc, cnt, sm, mn, mx
+
+

@@ -211,12 +211,18 @@ class Pubcomp:
 @dataclass
 class SubOpts:
     """Per-topic subscription options. v4 carries only ``qos``; v5 adds
-    no-local / retain-as-published / retain-handling (MQTT5 3.8.3.1)."""
+    no-local / retain-as-published / retain-handling (MQTT5 3.8.3.1).
+    ``filter_expr`` is the MQTT+ payload-filter suffix carried past the
+    ``?`` of the SUBSCRIBE topic string (``sensors/+/temp?$gt(value,30)``
+    — vernemq_tpu/filters/): it replicates with the subscription and is
+    preserved verbatim even on nodes with payload filters disabled, so a
+    mixed-version cluster never truncates it into a plain topic sub."""
 
     qos: int = 0
     no_local: bool = False
     rap: bool = False  # retain as published
     retain_handling: int = 0  # 0 send, 1 send-if-new, 2 don't send
+    filter_expr: Optional[str] = None  # MQTT+ payload-filter suffix
 
     def to_byte(self) -> int:
         return (

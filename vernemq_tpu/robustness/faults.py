@@ -21,6 +21,9 @@ Injection points in the tree (grep for ``faults.inject``):
                      dispatch, delta scatter and full (re)build — the
                      whole device half of retained replay degrades to
                      the host retain walk behind its breaker
+``device.predicate`` payload-predicate phase (filters/engine.py):
+                     pair-mask + window-fold dispatch degrades to the
+                     exact host evaluator behind the predicate breaker
 ``cluster.recv``     inbound cluster data-plane frames (cluster/com.py)
 ``cluster.spool``    delivery-spool journal writes (cluster/spool.py)
 ``store.write``      message-store writes (storage/msg_store.py)
