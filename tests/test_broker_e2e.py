@@ -394,3 +394,66 @@ async def test_metrics_prometheus(broker):
     assert "mqtt_publish_received" in text
     assert 'mqtt_connect_received{node="local"} 1' in text
     await c.disconnect()
+
+
+@pytest.mark.asyncio
+async def test_reg_views_knob_materializes_views_at_boot():
+    """The ``reg_views`` knob lists views started at BOOT
+    (vmq_server.schema reg_views) — regression for the dead knob the
+    vmqlint knob-registry pass flagged: the conf loader filled it but
+    nothing ever read it, so ``reg_views = vmq_reg_tpu`` with
+    ``default_reg_view = trie`` built no device view until a runtime
+    ``config set default_reg_view`` paid the cold build inline."""
+    from vernemq_tpu.broker.server import start_broker as _sb
+
+    b, server = await _sb(
+        Config(systree_enabled=False, allow_anonymous=True,
+               reg_views=["trie", "tpu"], default_reg_view="trie"),
+        port=0)
+    try:
+        # the tpu view exists (pre-built), while routing still uses trie
+        assert "tpu" in b.registry.reg_views
+        assert b.registry.reg_view() is b.registry.reg_views["trie"]
+        # an unknown view name must not abort boot (logged, skipped):
+        # covered by the KeyError guard — boot a second broker to prove
+        b2, s2 = await _sb(
+            Config(systree_enabled=False, allow_anonymous=True,
+                   reg_views=["trie", "bogus"]),
+            port=0)
+        try:
+            assert "bogus" not in b2.registry.reg_views
+        finally:
+            await b2.stop()
+            await s2.stop()
+    finally:
+        await b.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_reg_views_build_failure_does_not_abort_boot(monkeypatch):
+    """Pre-building a listed view is an optimization, never a boot
+    gate: a device-view build that raises at boot logs and stays lazy
+    while the broker comes up serving on the default view."""
+    from vernemq_tpu.broker import reg as reg_mod
+    from vernemq_tpu.broker.server import start_broker as _sb
+
+    orig = reg_mod.Registry.reg_view
+
+    def exploding(self, name=None):
+        if name == "tpu":
+            raise RuntimeError("injected device-view build failure")
+        return orig(self, name)
+
+    monkeypatch.setattr(reg_mod.Registry, "reg_view", exploding)
+    b, server = await _sb(
+        Config(systree_enabled=False, allow_anonymous=True,
+               reg_views=["trie", "tpu"], default_reg_view="trie"),
+        port=0)
+    try:
+        assert "tpu" not in b.registry.reg_views  # stayed lazy
+        c = await connected((b, server), "rvb1")  # and the broker serves
+        await c.disconnect()
+    finally:
+        await b.stop()
+        await server.stop()

@@ -595,3 +595,36 @@ async def test_broker_attaches_stats_and_exposes_worker_surface():
     finally:
         stats.close()
         stats.unlink()
+
+
+@pytest.mark.asyncio
+async def test_workers_total_mismatch_warns_on_stale_block(caplog):
+    """``workers_total`` is the parent's declared group size; a stats
+    block whose slot count disagrees is a STALE segment from a previous
+    group generation. Regression for the dead knob the vmqlint
+    knob-registry pass flagged: WorkerGroup always set it, nothing
+    read it, so a torn rolling restart attached silently."""
+    import logging
+
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+
+    stats = WorkerStatsBlock.create(_name("wt"), 2)
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger="vernemq_tpu.broker"):
+            broker, server = await start_broker(
+                Config(systree_enabled=False, allow_anonymous=True,
+                       worker_stats_block=stats.name, worker_index=0,
+                       workers_total=3),  # block says 2
+                port=0, node_name="wt0")
+            try:
+                assert broker.worker_stats is not None
+            finally:
+                await broker.stop()
+                await server.stop()
+        assert any("workers_total=3" in r.getMessage()
+                   for r in caplog.records), caplog.records
+    finally:
+        stats.close()
+        stats.unlink()

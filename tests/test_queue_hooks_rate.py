@@ -578,3 +578,45 @@ async def test_max_message_rate_throttles_not_kills():
     await sub.disconnect()
     await b.stop()
     await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_v5_receive_max_client_default_applied():
+    """A v5 client that announces NO receive_maximum gets the broker's
+    ``receive_max_client`` knob as its broker->client inflight cap (the
+    reference's vmq_server.schema default), not a hardcoded 65535 —
+    regression for the dead knob the vmqlint knob-registry pass
+    flagged: the DEFAULTS entry existed since seed but was never
+    read."""
+    b, server = await boot(receive_max_client=7,
+                           max_inflight_messages=50)
+    c = RawV5(server.host, server.port)
+    ack = await c.connect("rmc1")
+    assert ack.rc == 0
+    sess = b.sessions[("", "rmc1")]
+    assert sess.receive_max_out == 7
+    c.w.close()
+    await b.stop()
+    await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_v5_announced_receive_maximum_still_wins():
+    """A client that DOES announce receive_maximum keeps its own value
+    — the receive_max_client knob is only the silent-client default."""
+    from vernemq_tpu.protocol import codec_v5
+    from vernemq_tpu.protocol.types import Connect
+
+    b, server = await boot(receive_max_client=7)
+    c = RawV5(server.host, server.port)
+    c.r, c.w = await asyncio.open_connection(c.host, c.port)
+    c.w.write(codec_v5.serialise(Connect(
+        proto_ver=5, client_id="rmc2", clean_start=True, keepalive=60,
+        properties={"receive_maximum": 3})))
+    await c.w.drain()
+    ack = await c.recv()
+    assert ack.rc == 0
+    assert b.sessions[("", "rmc2")].receive_max_out == 3
+    c.w.close()
+    await b.stop()
+    await server.stop()

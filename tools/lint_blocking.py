@@ -1,220 +1,21 @@
 #!/usr/bin/env python3
-"""Static check: no loop-blocking calls inside ``async def`` bodies.
+"""Thin compatibility shim: the blocking lint moved into the unified
+static-analysis suite (``tools/vmqlint``, the ``blocking`` pass).
 
-The class of bug this catches is exactly what the old binary load
-shedder was: a synchronous stall (``time.sleep(1.0)``) sitting on the
-event loop inside an async path, freezing every session's IO for its
-duration. Flags, inside any ``async def`` in ``vernemq_tpu/``:
-
-- ``time.sleep(...)`` (use ``await asyncio.sleep`` — or run the sync
-  work in an executor);
-- synchronous file IO via a direct ``open(...)`` / ``os.fsync(...)``
-  call (push it behind ``run_in_executor`` or a sync helper that the
-  loop calls knowingly — a *named* helper documents the stall, a bare
-  ``open`` in an async body is almost always an accident);
-- ``input(...)`` (never legal on the loop);
-- unbounded waits that the stall watchdog cannot release: a bare
-  ``<lock>.acquire()`` with no ``timeout=``/``blocking=False``, a
-  ``<future>.result()`` with no timeout, and a no-argument
-  ``<queue>.get()`` — each parks the LOOP behind another thread's
-  progress forever if that thread wedges (``dict.get(key)`` and
-  bounded variants are not flagged);
-- the cross-process seam (parallel/shm_ring.py): the blocking ring
-  helpers ``.pop_wait(...)``/``.push_wait(...)`` (sleep-poll loops for
-  plain-thread ring ends — on the loop they freeze every session for
-  the full timeout while the peer process lags), and a direct
-  ``SharedMemory(...)`` construction (segment create/attach is
-  synchronous filesystem+mmap work; do it at boot or in an executor,
-  never per-request on the loop);
-- the mesh seam (parallel/mesh_match.py): ``jax.distributed.
-  initialize(...)`` (blocks until every process of the runtime has
-  dialed the coordinator — boot-time work, never on the loop),
-  ``.block_until_ready()`` (parks the loop behind device completion —
-  dispatch from an executor like every other device call), and the
-  blocking multihost collectives ``multihost_utils.
-  sync_global_devices`` / ``process_allgather`` (barriers over every
-  process of the mesh: one slow peer stalls every session this loop
-  serves).
-
-Nested synchronous ``def``s inside an async function are NOT flagged
-(they may run anywhere — an executor, a thread); nested async defs are
-visited in their own right. A line may opt out with a trailing
-``# lint: allow-blocking`` comment naming its reason — the opt-out is
-for deliberate, capped stalls (e.g. a fault-injection seam that models
-a slow disk ON the loop on purpose).
-
-Exits 1 with ``file:line`` findings; wired into ``tools/run_tier1.sh``
-as a pre-test step so a regression fails tier-1 before a single test
-runs.
+Kept so existing invocations (docs, muscle memory, CI snippets that
+predate the suite) keep working; new callers should run
+``python -m tools.vmqlint`` (every pass) or
+``python -m tools.vmqlint --pass blocking``.  Same exit-code contract:
+0 clean, 1 findings.
 """
 
-from __future__ import annotations
-
-import ast
 import os
 import sys
 
-ROOT = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..")
-TARGET = os.path.join(ROOT, "vernemq_tpu")
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
 
-ALLOW_MARK = "lint: allow-blocking"
-
-#: call spellings that block the event loop. Attribute calls match on
-#: the LAST TWO components, so ``jax.distributed.initialize`` and a
-#: bare ``distributed.initialize`` both hit ("distributed",
-#: "initialize").
-_BAD_ATTR = {("time", "sleep"), ("os", "fsync"),
-             ("shared_memory", "SharedMemory"),
-             # mesh seams: process-wide barriers / device waits
-             ("distributed", "initialize"),
-             ("multihost_utils", "sync_global_devices"),
-             ("multihost_utils", "process_allgather")}
-_BAD_NAME = {"open", "input", "SharedMemory"}
-
-#: method names that are ALWAYS blocking regardless of arguments: the
-#: shm-ring sleep-poll helpers for plain-thread producers/consumers
-#: (parallel/shm_ring.py) — the timeout bounds the wait but still parks
-#: the loop for up to its full length while the peer process lags —
-#: and jax's device-completion wait (a wedged mesh collective would
-#: park the loop forever; device waits belong on executor threads)
-_BLOCKING_METHODS = {"pop_wait", "push_wait", "block_until_ready"}
-
-
-def _call_name(node: ast.Call):
-    f = node.func
-    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
-        return (f.value.id, f.attr)
-    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Attribute):
-        # dotted chain (jax.distributed.initialize): match on the last
-        # two components — the prefix module alias is spelling-dependent
-        return (f.value.attr, f.attr)
-    if isinstance(f, ast.Name):
-        return f.id
-    return None
-
-
-def _unbounded_wait(node: ast.Call):
-    """Detect unbounded cross-thread waits by METHOD SHAPE (the receiver
-    may be any expression, so typing is out of reach for an AST pass):
-
-    - ``x.acquire()`` with neither a positional ``blocking`` arg nor a
-      ``timeout=``/``blocking=`` kwarg — ``threading.Lock.acquire``'s
-      forever-blocking form (``acquire(False)`` and
-      ``acquire(timeout=...)`` are bounded);
-    - ``x.result()`` with no arguments — ``Future.result`` waiting
-      forever on another thread;
-    - ``x.get()`` with NO positional arguments and no
-      ``timeout=``/``block=`` kwarg — ``queue.Queue.get``'s blocking
-      form. ``dict.get(key[, default])`` always has a positional arg,
-      so it never matches.
-
-    Returns the pretty spelling to report, or None."""
-    f = node.func
-    if not isinstance(f, ast.Attribute):
-        return None
-    kw = {k.arg for k in node.keywords}
-    if f.attr == "acquire":
-        if not node.args and not ({"timeout", "blocking"} & kw):
-            return ".acquire()"
-    elif f.attr == "result":
-        if not node.args and "timeout" not in kw:
-            return ".result()"
-    elif f.attr == "get":
-        if not node.args and not kw:
-            return ".get()"
-    return None
-
-
-class _AsyncBodyVisitor(ast.NodeVisitor):
-    """Walk ONE async function's body without descending into nested
-    function definitions (each async def gets its own visitor from the
-    module walk; nested sync defs are not loop-bound)."""
-
-    def __init__(self, findings, rel, allowed_lines):
-        self.findings = findings
-        self.rel = rel
-        self.allowed = allowed_lines
-        # directly-awaited calls are loop-FRIENDLY versions of the same
-        # spellings (asyncio.Queue.get, asyncio.Lock.acquire): exempt
-        self._awaited = set()
-
-    def visit_Await(self, node):  # noqa: N802
-        if isinstance(node.value, ast.Call):
-            self._awaited.add(id(node.value))
-        self.generic_visit(node)
-
-    def visit_FunctionDef(self, node):  # noqa: N802 — ast API
-        pass  # nested sync def: not necessarily on the loop
-
-    def visit_AsyncFunctionDef(self, node):  # noqa: N802
-        pass  # visited by the module-level walk
-
-    def visit_Call(self, node):  # noqa: N802
-        name = _call_name(node)
-        if name == ("asyncio", "wait_for") or name == "wait_for":
-            # the wrapped awaitable is bounded by wait_for's timeout
-            for a in node.args:
-                if isinstance(a, ast.Call):
-                    self._awaited.add(id(a))
-        bad = (name in _BAD_NAME if isinstance(name, str)
-               else name in _BAD_ATTR)
-        if (not bad and isinstance(node.func, ast.Attribute)
-                and node.func.attr in _BLOCKING_METHODS):
-            # shm-ring blocking helpers: any receiver spelling counts
-            # (the method shape is the contract, like _unbounded_wait)
-            bad, name = True, f".{node.func.attr}"
-        if bad and node.lineno not in self.allowed:
-            pretty = name if isinstance(name, str) else ".".join(name)
-            self.findings.append(
-                f"{self.rel}:{node.lineno}: blocking call "
-                f"`{pretty}(...)` inside async def")
-        unbounded = (None if id(node) in self._awaited
-                     else _unbounded_wait(node))
-        if unbounded and node.lineno not in self.allowed:
-            self.findings.append(
-                f"{self.rel}:{node.lineno}: unbounded `{unbounded}` "
-                f"inside async def (no timeout= — a wedged holder "
-                f"parks the loop forever; bound it or mark "
-                f"`# {ALLOW_MARK}: <reason>`)")
-        self.generic_visit(node)
-
-
-def scan_file(path: str, rel: str, findings) -> None:
-    with open(path, "r", encoding="utf-8") as fh:
-        src = fh.read()
-    allowed = {i for i, line in enumerate(src.splitlines(), 1)
-               if ALLOW_MARK in line}
-    try:
-        tree = ast.parse(src, filename=rel)
-    except SyntaxError as e:
-        findings.append(f"{rel}:{e.lineno}: syntax error: {e.msg}")
-        return
-    for node in ast.walk(tree):
-        if isinstance(node, ast.AsyncFunctionDef):
-            v = _AsyncBodyVisitor(findings, rel, allowed)
-            for child in node.body:
-                v.visit(child)
-
-
-def main() -> int:
-    findings = []
-    for dirpath, _dirs, files in os.walk(TARGET):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in sorted(files):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            scan_file(path, os.path.relpath(path, ROOT), findings)
-    if findings:
-        print("lint_blocking: loop-blocking calls in async bodies:",
-              file=sys.stderr)
-        for f in findings:
-            print(f"  {f}", file=sys.stderr)
-        return 1
-    print("lint_blocking: ok")
-    return 0
-
+from tools.vmqlint.core import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--pass", "blocking"]))

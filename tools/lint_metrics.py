@@ -1,168 +1,21 @@
 #!/usr/bin/env python3
-"""Static metrics-registry lint (tier-1 pre-test pass, like
-lint_blocking.py).
+"""Thin compatibility shim: the metrics-registry lint moved into the
+unified static-analysis suite (``tools/vmqlint``, the ``metrics``
+pass).
 
-Two invariants, both cheap to break silently and annoying to debug at
-scrape time:
-
-1. Every registered metric has non-empty HELP text: the
-   ``COUNTERS`` table (broker/metrics.py), the ``STAGE_FAMILIES``
-   histogram table (observability/histogram.py), and every literal
-   descriptions dict passed to ``Metrics.register_gauges`` — an empty
-   description ships a ``# HELP name`` line Prometheus tooling chokes
-   on, and the parity tests only cover families they explicitly name.
-
-2. Every ``*.observe("name", ...)`` / ``observe("name", ...)`` call
-   site in the tree names a REGISTERED histogram family: a typo'd
-   family name raises KeyError on the hot path — in production, under
-   load, at the first sampled publish — instead of here.
-
-Exit 0 = clean. Any finding prints file:line and exits 1.
+Kept so existing invocations keep working; new callers should run
+``python -m tools.vmqlint`` (every pass) or
+``python -m tools.vmqlint --pass metrics``.  Same exit-code contract:
+0 clean, 1 findings.
 """
 
-from __future__ import annotations
-
-import ast
+import os
 import sys
-from pathlib import Path
 
-ROOT = Path(__file__).resolve().parent.parent
-PKG = ROOT / "vernemq_tpu"
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".."))
 
-#: methods named `observe` that are NOT histogram observations
-_OBSERVE_EXEMPT_ATTRS = {"observe_lag"}
-
-#: same opt-out idiom as lint_blocking's allow marker: a delegation
-#: seam (Metrics.observe -> histogram.observe, the registry's own
-#: dispatch) forwards a dynamic name by design
-ALLOW_MARK = "lint: observe-passthrough"
-
-
-def _const_str(node) -> str | None:
-    return node.value if (isinstance(node, ast.Constant)
-                          and isinstance(node.value, str)) else None
-
-
-def _tuple_table(tree: ast.AST, name: str, path: Path, errors: list,
-                 what: str) -> set:
-    """Collect (name, help) 2-tuple tables like COUNTERS /
-    STAGE_FAMILIES; flag entries with empty or non-literal HELP."""
-    names = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
-            continue
-        targets = (node.targets if isinstance(node, ast.Assign)
-                   else [node.target])
-        if not any(isinstance(t, ast.Name) and t.id == name
-                   for t in targets):
-            continue
-        value = node.value
-        if not isinstance(value, (ast.List, ast.Tuple)):
-            continue
-        for elt in value.elts:
-            if not isinstance(elt, ast.Tuple) or len(elt.elts) < 2:
-                errors.append(f"{path}:{elt.lineno}: {what} entry is "
-                              "not a (name, help) tuple")
-                continue
-            metric = _const_str(elt.elts[0])
-            # help may be an implicit concat of string constants — the
-            # parser folds adjacent literals into one Constant, so a
-            # plain _const_str covers the multi-line style used here
-            help_text = _const_str(elt.elts[1])
-            if metric is None:
-                errors.append(f"{path}:{elt.lineno}: {what} name is "
-                              "not a string literal")
-                continue
-            names.add(metric)
-            if not help_text or not help_text.strip():
-                errors.append(f"{path}:{elt.lineno}: {what} "
-                              f"'{metric}' has empty HELP text")
-    return names
-
-
-def _check_gauge_dicts(tree: ast.AST, path: Path, errors: list) -> None:
-    """Every literal dict passed to register_gauges(...) must have
-    non-empty string values (the HELP text of each gauge)."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        if not (isinstance(fn, ast.Attribute)
-                and fn.attr == "register_gauges"):
-            continue
-        cands = list(node.args[1:2]) + [
-            kw.value for kw in node.keywords
-            if kw.arg == "descriptions"]
-        for d in cands:
-            if not isinstance(d, ast.Dict):
-                continue  # dynamic dict: parity tests cover those names
-            for k, v in zip(d.keys, d.values):
-                key = _const_str(k) if k is not None else None
-                val = _const_str(v)
-                if key is None:
-                    continue
-                if not val or not val.strip():
-                    errors.append(f"{path}:{v.lineno}: gauge '{key}' "
-                                  "registered with empty HELP text")
-
-
-def _check_observe_sites(tree: ast.AST, path: Path, families: set,
-                         errors: list, allowed_lines: set) -> None:
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call) or not node.args:
-            continue
-        fn = node.func
-        if isinstance(fn, ast.Attribute):
-            if fn.attr != "observe" or fn.attr in _OBSERVE_EXEMPT_ATTRS:
-                continue
-        elif isinstance(fn, ast.Name):
-            if fn.id != "observe":
-                continue
-        else:
-            continue
-        if node.lineno in allowed_lines:
-            continue
-        fam = _const_str(node.args[0])
-        if fam is None:
-            errors.append(f"{path}:{node.lineno}: observe() family is "
-                          "not a string literal (cannot verify "
-                          "registration statically)")
-        elif fam not in families:
-            errors.append(f"{path}:{node.lineno}: observe() names "
-                          f"unregistered histogram family '{fam}'")
-
-
-def main() -> int:
-    errors: list = []
-    metrics_tree = ast.parse(
-        (PKG / "broker" / "metrics.py").read_text())
-    _tuple_table(metrics_tree, "COUNTERS", PKG / "broker" / "metrics.py",
-                 errors, "counter")
-    hist_path = PKG / "observability" / "histogram.py"
-    families = _tuple_table(ast.parse(hist_path.read_text()),
-                            "STAGE_FAMILIES", hist_path, errors,
-                            "histogram")
-    if not families:
-        errors.append(f"{hist_path}: STAGE_FAMILIES table not found")
-    for path in sorted(PKG.rglob("*.py")):
-        text = path.read_text()
-        try:
-            tree = ast.parse(text)
-        except SyntaxError as e:
-            errors.append(f"{path}: unparseable: {e}")
-            continue
-        allowed = {i for i, line in enumerate(text.splitlines(), 1)
-                   if ALLOW_MARK in line}
-        _check_gauge_dicts(tree, path, errors)
-        _check_observe_sites(tree, path, families, errors, allowed)
-    if errors:
-        print(f"lint_metrics: {len(errors)} finding(s)")
-        for e in errors:
-            print(f"  {e}")
-        return 1
-    print("lint_metrics: clean")
-    return 0
-
+from tools.vmqlint.core import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(["--pass", "metrics"]))

@@ -25,13 +25,16 @@ else
   EXTRA=(-m 'not slow')
 fi
 
-# pre-test static pass: no loop-blocking calls (time.sleep, sync file
-# IO, input) inside async bodies — the bug class the old fixed-sleep
-# load shedding was (tools/lint_blocking.py)
-python tools/lint_blocking.py || exit 1
-# metrics-registry lint: every counter/gauge/histogram has HELP text,
-# every observe() call site names a registered family
-python tools/lint_metrics.py || exit 1
+# pre-test static gate: the unified vmqlint suite (tools/vmqlint) —
+# blocking calls in async bodies, metric-registry HELP/observe names,
+# lock discipline (no device/compile/IO under a threading lock),
+# thread lifecycle (every started thread joined/cancelled from close),
+# knob registry (config reads <-> DEFAULTS <-> schema aliases agree),
+# fault-point/breaker-path registry (inject sites and admin drills
+# can't drift). A regression in any defect class fails tier-1 before a
+# single test runs. Fast local iteration: `python -m tools.vmqlint
+# --changed` scopes the file-level passes to the git working-set.
+python -m tools.vmqlint || exit 1
 
 # hung-test forensics: faulthandler dumps every thread's stack just
 # below the outer timeout wall (tests/conftest.py arms it), so a wedged

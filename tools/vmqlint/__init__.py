@@ -1,0 +1,31 @@
+"""vmqlint — the broker's unified static-analysis suite.
+
+One shared AST walk (per-file parse cache), a plugin-pass registry, and
+one suppression idiom (``# vmqlint: allow(<pass>): <reason>``; the
+legacy ``lint: allow-blocking`` / ``lint: observe-passthrough`` markers
+keep working) over six passes:
+
+==================  ====================================================
+``blocking``         loop-blocking calls / unbounded waits in async
+                     bodies (the old ``tools/lint_blocking.py``)
+``metrics``          metric-registry HELP text + ``observe()`` family
+                     names (the old ``tools/lint_metrics.py``)
+``lock-discipline``  device transfers / compiles / sync IO lexically
+                     under a ``threading`` lock, and ``await`` under
+                     one — the PR 2/9/10 recurring defect class
+``thread-lifecycle`` ``threading.Thread``/``Timer`` started by a class
+                     with no join/cancel reachable from ``close()`` /
+                     ``stop()``
+``knob-registry``    every config read resolves to a ``DEFAULTS`` knob,
+                     every schema alias targets one, and no knob is
+                     declared but never read
+``fault-registry``   every ``faults.inject*`` site and ``breaker
+                     path=`` spelling matches the registered set
+==================  ====================================================
+
+Run ``python -m tools.vmqlint`` (the tier-1 pre-test gate), or
+``--changed`` for a git-diff-scoped fast pass, ``--json`` for machine
+output.  Exit codes: 0 clean, 1 findings, 2 usage/internal error.
+"""
+
+from .core import Finding, main, run  # noqa: F401

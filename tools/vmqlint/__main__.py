@@ -1,0 +1,8 @@
+"""``python -m tools.vmqlint`` — the tier-1 pre-test static gate."""
+
+import sys
+
+from .core import main
+
+if __name__ == "__main__":
+    sys.exit(main())

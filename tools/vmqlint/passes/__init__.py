@@ -1,0 +1,20 @@
+"""Pass registry: one entry per defect class the suite encodes.
+
+Each pass module exports a ``PASS`` instance; adding a pass = adding a
+module here.  Keep the list ordered cheapest-first so a syntax-level
+failure surfaces before the registry diffs."""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..core import Pass
+
+
+def all_passes() -> List[Pass]:
+    from . import (blocking, fault_registry, knob_registry,
+                   lock_discipline, metrics, thread_lifecycle)
+
+    return [blocking.PASS, metrics.PASS, lock_discipline.PASS,
+            thread_lifecycle.PASS, knob_registry.PASS,
+            fault_registry.PASS]

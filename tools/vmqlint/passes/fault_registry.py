@@ -1,0 +1,242 @@
+"""``fault-registry`` pass: injection points and breaker paths agree.
+
+The fault-injection surface has three places that must spell the same
+names or drills silently no-op:
+
+- the injection **sites** (``faults.inject("device.dispatch")`` hooks
+  threaded through the tree),
+- the **registry** (``robustness/faults.py`` ``KNOWN_POINTS`` — what
+  ``vmq-admin fault inject`` validates against and the docs list),
+- the admin/drill surface (``vmq-admin fault inject point=...``,
+  ``breaker trip|reset path=...``).
+
+A typo'd ``faults.inject("device.dipatch")`` site creates a point no
+plan ever targets — the seam is dead and chaos drills pass vacuously.
+A registry entry with no site means an operator can "inject" a fault
+that can never fire.  Same story for breaker paths: the
+``breaker show`` rows and the ``trip|reset`` path filter must both
+match ``robustness/breaker.py`` ``BREAKER_PATHS`` exactly, or a new
+device path ships un-drillable.
+
+Checks:
+
+1. every ``faults.inject(...)``/``inject_async(...)`` first argument is
+   a string literal naming a ``KNOWN_POINTS`` entry;
+2. every ``KNOWN_POINTS`` entry has at least one injection site;
+3. every breaker admin row — a dict literal carrying BOTH ``"path"``
+   and ``"mountpoint"`` keys, the ``breaker show`` row shape (plain
+   ``"path"`` dicts are file paths/HTTP routes, not this surface) —
+   names a ``BREAKER_PATHS`` entry (the ``"-"`` placeholder row is
+   exempt), and every ``path in (None, "<lit>", ...)`` selector branch
+   (the trip/reset per-path filter idiom — recognized by the ``None``
+   member meaning "all paths") uses only registered spellings;
+4. every ``BREAKER_PATHS`` entry appears in at least one ``"path"``
+   row (a registered path with no admin surface is un-drillable).
+
+(The trip/reset *validation* no longer carries its own literal tuple —
+``admin/commands.py`` imports ``BREAKER_PATHS`` — so the remaining
+drift surface is exactly the per-path selector branches checked here.)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Context, Finding, Pass, const_str
+
+_FAULTS_FILE = "vernemq_tpu/robustness/faults.py"
+_BREAKER_FILE = "vernemq_tpu/robustness/breaker.py"
+
+#: `breaker show` placeholder row when no matcher exists yet
+_PATH_PLACEHOLDERS = {"-"}
+
+
+_const_str = const_str  # shared literal probe (core.py)
+
+
+def _parse_const_table(tree: ast.AST, var: str, rel: str,
+                       errors: List[Finding],
+                       ) -> Dict[str, int]:
+    """``var`` as a dict literal (keys) or tuple/list/set literal
+    (elements) of string constants -> name -> line."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        if not any(isinstance(t, ast.Name) and t.id == var
+                   for t in targets):
+            continue
+        val = node.value
+        if isinstance(val, ast.Dict):
+            for k in val.keys:
+                s = _const_str(k) if k is not None else None
+                if s is None:
+                    errors.append(Finding(
+                        PASS.name, rel,
+                        getattr(k, "lineno", node.lineno),
+                        f"{var} key is not a string literal"))
+                else:
+                    out[s] = k.lineno
+        elif isinstance(val, (ast.Tuple, ast.List, ast.Set)):
+            for elt in val.elts:
+                s = _const_str(elt)
+                if s is None:
+                    errors.append(Finding(
+                        PASS.name, rel, elt.lineno,
+                        f"{var} entry is not a string literal"))
+                else:
+                    out[s] = elt.lineno
+        else:
+            errors.append(Finding(
+                PASS.name, rel, node.lineno,
+                f"{var} is not a literal table — cannot verify"))
+    return out
+
+
+def _inject_point(node: ast.Call) -> Optional[Tuple[Optional[str], int]]:
+    """Is this an injection site?  -> (point literal or None, line)."""
+    f = node.func
+    callee = None
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name) and f.value.id == "faults":
+            callee = f.attr
+    elif isinstance(f, ast.Name):
+        callee = f.id if f.id in ("inject", "inject_async") else None
+    if callee not in ("inject", "inject_async"):
+        return None
+    if not node.args:
+        return (None, node.lineno)
+    return (_const_str(node.args[0]), node.lineno)
+
+
+class FaultRegistryPass(Pass):
+    name = "fault-registry"
+    describe = ("faults.inject* sites match KNOWN_POINTS; breaker "
+                "path= spellings match BREAKER_PATHS")
+    defect = ("a typo'd injection point or breaker path makes drills "
+              "and admin trip/reset silently no-op")
+    tree_scoped = True
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        ff = ctx.get(_FAULTS_FILE)
+        if ff is None or ff.tree is None:
+            return [Finding(PASS.name, _FAULTS_FILE, 0,
+                            "faults module missing/unparseable")]
+        points = _parse_const_table(ff.tree, "KNOWN_POINTS",
+                                    _FAULTS_FILE, findings)
+        if not points:
+            findings.append(Finding(
+                PASS.name, _FAULTS_FILE, 0,
+                "KNOWN_POINTS registry not found — every injection "
+                "point must be registered"))
+        bf = ctx.get(_BREAKER_FILE)
+        if bf is None or bf.tree is None:
+            return findings + [Finding(PASS.name, _BREAKER_FILE, 0,
+                               "breaker module missing/unparseable")]
+        paths = _parse_const_table(bf.tree, "BREAKER_PATHS",
+                                   _BREAKER_FILE, findings)
+        if not paths:
+            findings.append(Finding(
+                PASS.name, _BREAKER_FILE, 0,
+                "BREAKER_PATHS registry not found"))
+
+        sites: Set[str] = set()
+        path_rows: Set[str] = set()
+        for f in ctx.iter_files(self.roots, respect_changed=False):
+            if f.tree is None:
+                continue
+            in_faults = f.rel == _FAULTS_FILE
+            for node in ast.walk(f.tree):
+                if isinstance(node, ast.Call) and not in_faults:
+                    hit = _inject_point(node)
+                    if hit is not None:
+                        point, line = hit
+                        if point is None:
+                            findings.append(Finding(
+                                PASS.name, f.rel, line,
+                                "faults.inject* point is not a string "
+                                "literal — the site cannot be checked "
+                                "against KNOWN_POINTS"))
+                        else:
+                            sites.add(point)
+                            if points and point not in points:
+                                findings.append(Finding(
+                                    PASS.name, f.rel, line,
+                                    f"injection point '{point}' is not "
+                                    f"in faults.KNOWN_POINTS — "
+                                    f"register it or fix the "
+                                    f"spelling"))
+                elif isinstance(node, ast.Dict):
+                    # a breaker admin ROW, not any dict with a "path"
+                    # key (file paths, HTTP routes): the show rows all
+                    # carry BOTH "path" and "mountpoint" — that pair is
+                    # the disambiguator
+                    keys = {_const_str(k) for k in node.keys
+                            if k is not None}
+                    if "path" not in keys or "mountpoint" not in keys:
+                        continue
+                    for k, v in zip(node.keys, node.values):
+                        if (k is not None and _const_str(k) == "path"):
+                            val = _const_str(v)
+                            if val is None \
+                                    or val in _PATH_PLACEHOLDERS:
+                                continue
+                            path_rows.add(val)
+                            if paths and val not in paths:
+                                findings.append(Finding(
+                                    PASS.name, f.rel, v.lineno,
+                                    f"breaker path '{val}' is not in "
+                                    f"breaker.BREAKER_PATHS"))
+                elif isinstance(node, ast.Compare):
+                    findings.extend(self._check_membership(
+                        node, f.rel, paths))
+        for point, line in sorted(points.items()):
+            if point not in sites:
+                findings.append(Finding(
+                    PASS.name, _FAULTS_FILE, line,
+                    f"KNOWN_POINTS entry '{point}' has no "
+                    f"faults.inject* site — an operator-injectable "
+                    f"fault that can never fire"))
+        for path, line in sorted(paths.items()):
+            if path not in path_rows:
+                findings.append(Finding(
+                    PASS.name, _BREAKER_FILE, line,
+                    f"BREAKER_PATHS entry '{path}' never appears as a "
+                    f"breaker-show/trip row — the path is "
+                    f"un-drillable from the admin surface"))
+        return findings
+
+    @staticmethod
+    def _check_membership(node: ast.Compare, rel: str,
+                          paths: Dict[str, int]) -> List[Finding]:
+        """``path in (None, "match")`` selector branches (the trip/
+        reset per-path filter idiom — the ``None`` member means "no
+        filter, take all paths" and distinguishes this shape from URL/
+        filesystem path tests) must use registered spellings only."""
+        if not (isinstance(node.left, ast.Name)
+                and node.left.id == "path" and len(node.ops) == 1
+                and isinstance(node.ops[0], (ast.In, ast.NotIn))
+                and isinstance(node.comparators[0],
+                               (ast.Tuple, ast.List, ast.Set))):
+            return []
+        elts = node.comparators[0].elts
+        if not any(isinstance(e, ast.Constant) and e.value is None
+                   for e in elts):
+            return []  # no None member: not the breaker selector idiom
+        out = []
+        for elt in elts:
+            s = _const_str(elt)
+            if s is not None and paths and s not in paths:
+                out.append(Finding(
+                    PASS.name, rel, elt.lineno,
+                    f"breaker path selector names '{s}' which is not "
+                    f"in BREAKER_PATHS — the branch can never match a "
+                    f"registered path"))
+        return out
+
+
+PASS = FaultRegistryPass()

@@ -1,0 +1,365 @@
+"""``knob-registry`` pass: config reads, aliases and DEFAULTS agree.
+
+Three drift modes this catches at lint time instead of at boot (or
+never):
+
+1. **Phantom reads** — ``cfg.get("tpu_breker_enabled", True)`` on the
+   broker :class:`Config` silently serves the default forever (the
+   two-arg form never raises), so a typo'd knob read is invisible until
+   someone wonders why the conf file has no effect.  Every string-
+   literal ``.get``/``.set`` on a *config-shaped* receiver must name a
+   ``DEFAULTS`` entry.
+2. **Dangling aliases** — every ``schema.py`` dotted-alias target
+   (``FLAT_ALIASES``, including the dict-comprehension families), and
+   every ``MS_TO_SECONDS``/``DURATION_KEYS`` entry, must resolve to a
+   ``DEFAULTS`` knob or an alias key; a rename that misses schema.py
+   breaks conf files at parse time.
+3. **Dead knobs** — a ``DEFAULTS`` entry nothing in the package ever
+   reads is documentation lying about a switch that does nothing.
+   ``COMPAT_NOOPS`` entries are exempt by design (accepted-for-
+   compatibility, explicitly no effect); anything else is a finding on
+   its declaration line — fix it or annotate it there with
+   ``# vmqlint: allow(knob-registry): <reason>``.
+
+Config-shaped receivers are resolved by a per-scope taint walk: the
+seeds are ``<anything>.config`` attributes, ``Config(...)`` /
+``Config.from_file(...)`` / ``load_conf_file(...)`` calls,
+``getattr(x, "config")``, and ``.snapshot()`` of a shaped value; plain
+names become shaped by assignment from a seed (``cfg = self.config``)
+or by a ``Config``-annotated parameter.  Unannotated dict parameters
+named ``cfg`` are NOT shaped — the bridge/connector per-entry dicts
+share the spelling.  Reads the taint walk cannot see (dynamic keys in
+the conf loader) are simply not checked.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Context, Finding, Pass, const_str
+
+_CONFIG_FILE = "vernemq_tpu/broker/config.py"
+_SCHEMA_FILE = "vernemq_tpu/broker/schema.py"
+
+#: Config's own attribute surface — not knob reads
+_CONFIG_API = {"get", "set", "on_change", "snapshot", "from_file",
+               "_values", "_listeners"}
+
+
+_const_str = const_str  # shared literal probe (core.py)
+
+
+# ------------------------------------------------------------- registries
+
+def _parse_defaults(tree: ast.AST, rel: str,
+                    errors: List[Finding]) -> Dict[str, int]:
+    """DEFAULTS knob -> declaration line."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        if not any(isinstance(t, ast.Name) and t.id == "DEFAULTS"
+                   for t in targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            errors.append(Finding(PASS.name, rel, node.lineno,
+                                  "DEFAULTS is not a dict literal — "
+                                  "cannot verify knob reads"))
+            return out
+        for k in node.value.keys:
+            key = _const_str(k) if k is not None else None
+            if key is None:
+                errors.append(Finding(
+                    PASS.name, rel, getattr(k, "lineno", node.lineno),
+                    "DEFAULTS key is not a string literal"))
+                continue
+            if key in out:
+                errors.append(Finding(PASS.name, rel, k.lineno,
+                                      f"duplicate DEFAULTS knob "
+                                      f"'{key}'"))
+            out[key] = k.lineno
+    return out
+
+
+def _dict_pairs(node: ast.Dict) -> List[Tuple[Optional[str],
+                                              Optional[str], int]]:
+    out = []
+    for k, v in zip(node.keys, node.values):
+        out.append((_const_str(k) if k is not None else None,
+                    _const_str(v), v.lineno))
+    return out
+
+
+def _comp_targets(node: ast.DictComp) -> List[Tuple[str, int]]:
+    """The alias-family dict comprehensions map a derived dotted
+    spelling to the knob name itself::
+
+        {f"overload.{k[len('overload_'):]}": k for k in ("overload_mode",
+         ...)}
+
+    — the *values* iterated are the targets; anything fancier is
+    reported as unverifiable by the caller."""
+    if not (isinstance(node.value, ast.Name) and len(node.generators) == 1):
+        return []
+    gen = node.generators[0]
+    if not (isinstance(gen.target, ast.Name)
+            and gen.target.id == node.value.id
+            and isinstance(gen.iter, (ast.Tuple, ast.List, ast.Set))):
+        return []
+    out = []
+    for elt in gen.iter.elts:
+        s = _const_str(elt)
+        if s is not None:
+            out.append((s, elt.lineno))
+    return out
+
+
+def _parse_schema(tree: ast.AST, rel: str, errors: List[Finding]
+                  ) -> Tuple[List[Tuple[str, int]], Set[str],
+                             List[Tuple[str, int]], Set[str]]:
+    """-> (alias targets, alias keys, MS/DURATION entries, compat-noop
+    schema names)."""
+    targets: List[Tuple[str, int]] = []
+    alias_keys: Set[str] = set()
+    unit_keys: List[Tuple[str, int]] = []
+    noops: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            tlist = (node.targets if isinstance(node, ast.Assign)
+                     else [node.target])
+            names = {t.id for t in tlist if isinstance(t, ast.Name)}
+            val = node.value
+            if "FLAT_ALIASES" in names and isinstance(val, ast.Dict):
+                for k, v, line in _dict_pairs(val):
+                    if k is not None:
+                        alias_keys.add(k)
+                    if v is not None:
+                        targets.append((v, line))
+            elif names & {"MS_TO_SECONDS", "DURATION_KEYS"} \
+                    and isinstance(val, (ast.Set, ast.Tuple, ast.List)):
+                for elt in val.elts:
+                    s = _const_str(elt)
+                    if s is not None:
+                        unit_keys.append((s, elt.lineno))
+            elif "COMPAT_NOOPS" in names and isinstance(val, ast.Dict):
+                for k, _v, _line in _dict_pairs(val):
+                    if k is not None:
+                        noops.add(k)
+            # FLAT_ALIASES["x"] = "y"
+            for t in tlist:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "FLAT_ALIASES"):
+                    k = _const_str(t.slice)
+                    v = _const_str(node.value)
+                    if k is not None:
+                        alias_keys.add(k)
+                    if v is not None:
+                        targets.append((v, node.lineno))
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)
+              and node.func.attr == "update"
+              and isinstance(node.func.value, ast.Name)
+              and node.func.value.id == "FLAT_ALIASES" and node.args):
+            arg = node.args[0]
+            if isinstance(arg, ast.Dict):
+                for k, v, line in _dict_pairs(arg):
+                    if k is not None:
+                        alias_keys.add(k)
+                    if v is not None:
+                        targets.append((v, line))
+            elif isinstance(arg, ast.DictComp):
+                found = _comp_targets(arg)
+                if not found:
+                    errors.append(Finding(
+                        PASS.name, rel, arg.lineno,
+                        "FLAT_ALIASES.update() with a comprehension "
+                        "vmqlint cannot evaluate — use the "
+                        "{f'tree.{k[...]}': k for k in (literals)} "
+                        "shape"))
+                targets.extend(found)
+            else:
+                errors.append(Finding(
+                    PASS.name, rel, arg.lineno,
+                    "FLAT_ALIASES.update() argument is not a literal "
+                    "dict — alias targets cannot be verified"))
+    return targets, alias_keys, unit_keys, noops
+
+
+# ------------------------------------------------------------- taint walk
+
+def _is_shaped(expr: ast.AST, shaped: Set[str]) -> bool:
+    """Is this expression the broker Config (or its snapshot dict)?"""
+    if isinstance(expr, ast.Attribute):
+        if expr.attr == "config":
+            return True
+        return False
+    if isinstance(expr, ast.Name):
+        return expr.id in shaped
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Name) and f.id in ("Config",
+                                                "load_conf_file"):
+            return True
+        if isinstance(f, ast.Attribute):
+            if f.attr == "from_file" and isinstance(f.value, ast.Name) \
+                    and f.value.id == "Config":
+                return True
+            if f.attr == "snapshot" and _is_shaped(f.value, shaped):
+                return True
+        if (isinstance(f, ast.Name) and f.id == "getattr"
+                and len(expr.args) >= 2
+                and _const_str(expr.args[1]) == "config"):
+            return True
+    return False
+
+
+class _ScopeWalker(ast.NodeVisitor):
+    """Per-function taint of config-shaped names + knob-read harvest."""
+
+    def __init__(self, rel: str, defaults: Dict[str, int],
+                 findings: List[Finding], reads: Set[str],
+                 shaped: Optional[Set[str]] = None):
+        self.rel = rel
+        self.defaults = defaults
+        self.findings = findings
+        self.reads = reads
+        self.shaped: Set[str] = set(shaped or ())
+
+    def _enter_function(self, node):
+        inner = _ScopeWalker(self.rel, self.defaults, self.findings,
+                             self.reads, self.shaped)
+        for a in list(node.args.args) + list(node.args.kwonlyargs):
+            ann = a.annotation
+            ann_name = None
+            if isinstance(ann, ast.Name):
+                ann_name = ann.id
+            elif isinstance(ann, ast.Constant):
+                ann_name = str(ann.value)
+            if ann_name == "Config":
+                inner.shaped.add(a.arg)
+        for child in node.body:
+            inner.visit(child)
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        self._enter_function(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # noqa: N815
+
+    def visit_Assign(self, node):  # noqa: N802
+        shaped_val = _is_shaped(node.value, self.shaped)
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Name):
+                if shaped_val:
+                    self.shaped.add(tgt.id)
+                else:
+                    self.shaped.discard(tgt.id)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):  # noqa: N802
+        # `cfg: Config = self.config` — trust the annotation like a
+        # Config-annotated parameter, or the value like a plain assign
+        if isinstance(node.target, ast.Name):
+            ann = node.annotation
+            ann_name = (ann.id if isinstance(ann, ast.Name)
+                        else str(ann.value)
+                        if isinstance(ann, ast.Constant) else None)
+            if ann_name == "Config" or (
+                    node.value is not None
+                    and _is_shaped(node.value, self.shaped)):
+                self.shaped.add(node.target.id)
+            else:
+                self.shaped.discard(node.target.id)
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node):  # noqa: N802
+        # knob read via attribute access (cfg.workers) counts as a read
+        if (_is_shaped(node.value, self.shaped)
+                and node.attr not in _CONFIG_API
+                and node.attr in self.defaults):
+            self.reads.add(node.attr)
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in ("get", "set")
+                and node.args):
+            key = _const_str(node.args[0])
+            if key is not None and _is_shaped(f.value, self.shaped):
+                if key not in self.defaults:
+                    self.findings.append(Finding(
+                        PASS.name, self.rel, node.lineno,
+                        f"config.{f.attr}(\"{key}\") does not resolve "
+                        f"to a DEFAULTS knob — a typo'd read silently "
+                        f"serves its fallback forever"))
+                elif f.attr == "get":
+                    # only a GET on a config-shaped receiver is a read:
+                    # .set is a write (a write-only knob is exactly the
+                    # plumbed-never-consumed defect), and an unshaped
+                    # receiver's .get("k") is some other dict that
+                    # happens to share the spelling
+                    self.reads.add(key)
+        if (isinstance(f, ast.Name) and f.id == "getattr"
+                and len(node.args) >= 2
+                and _is_shaped(node.args[0], self.shaped)):
+            key = _const_str(node.args[1])
+            if key is not None and key in self.defaults:
+                self.reads.add(key)
+        self.generic_visit(node)
+
+
+class KnobRegistryPass(Pass):
+    name = "knob-registry"
+    describe = ("config reads resolve to DEFAULTS; schema aliases "
+                "target real knobs; no declared-but-never-read knobs")
+    defect = ("a typo'd cfg.get silently serves its default; a dead "
+              "DEFAULTS entry documents a switch that does nothing")
+    tree_scoped = True
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        cfg = ctx.get(_CONFIG_FILE)
+        if cfg is None or cfg.tree is None:
+            return [Finding(PASS.name, _CONFIG_FILE, 0,
+                            "DEFAULTS file missing/unparseable")]
+        defaults = _parse_defaults(cfg.tree, _CONFIG_FILE, findings)
+        schema = ctx.get(_SCHEMA_FILE)
+        if schema is None or schema.tree is None:
+            return [Finding(PASS.name, _SCHEMA_FILE, 0,
+                            "schema file missing/unparseable")]
+        targets, alias_keys, unit_keys, noops = _parse_schema(
+            schema.tree, _SCHEMA_FILE, findings)
+        for target, line in targets:
+            if target not in defaults:
+                findings.append(Finding(
+                    PASS.name, _SCHEMA_FILE, line,
+                    f"schema alias targets unknown knob '{target}' "
+                    f"(not in DEFAULTS)"))
+        for key, line in unit_keys:
+            if key not in defaults and key not in alias_keys:
+                findings.append(Finding(
+                    PASS.name, _SCHEMA_FILE, line,
+                    f"unit-conversion entry '{key}' is neither a "
+                    f"DEFAULTS knob nor a schema alias"))
+        reads: Set[str] = set()
+        for f in ctx.iter_files(self.roots, respect_changed=False):
+            if f.tree is None or f.rel == _CONFIG_FILE:
+                continue
+            w = _ScopeWalker(f.rel, defaults, findings, reads)
+            w.visit(f.tree)
+        for knob, line in sorted(defaults.items(),
+                                 key=lambda kv: kv[1]):
+            if knob in reads or knob in noops:
+                continue
+            findings.append(Finding(
+                PASS.name, _CONFIG_FILE, line,
+                f"knob '{knob}' is declared in DEFAULTS but never "
+                f"read anywhere in the package — wire it up, delete "
+                f"it, or annotate the declaration"))
+        return findings
+
+
+PASS = KnobRegistryPass()

@@ -1,0 +1,193 @@
+"""``lock-discipline`` pass: no device/compile/IO work under a lock.
+
+The single most-recurring defect class in this repo's review-hardening
+tails, shipped (and re-fixed) at least three times:
+
+- PR 2: ``warm_delta_ladder`` compiled the delta-scatter ladder while
+  holding the matcher lock — every publish parked behind a jit compile;
+- PR 9: ``adopt_slices`` ran device work under the matcher lock from a
+  gossip callback — a long device flush parked every session;
+- PR 10: ``device_put`` uploads ran inside the filter-engine lock — a
+  wedged transfer parked the event loop's ``_tick``/replay/status
+  takers.
+
+The cure is always the same shape: **snapshot under the lock, transfer/
+compile outside it**.  This pass flags, lexically inside a ``with
+<lock>:`` block (any context expression whose final name component ends
+in ``lock``/``mutex``):
+
+- device transfers/waits: ``device_put``, ``block_until_ready``,
+  ``make_array_from_callback``, ``make_array_from_single_device_arrays``;
+- compiles: ``jax.jit`` / ``pjit`` / ``warm_delta_ladder`` /
+  ``ensure_warm*`` (each compiles on a cold shape);
+- synchronous IO: bare ``open``, ``os.fsync``, ``time.sleep``, and
+  journal writes (``append``/``write``/``delete``/``trim``/``flush``/
+  ``sync``/``put`` on a receiver spelled ``*journal*``);
+- ``await`` while holding a *threading* lock (a plain ``with`` in an
+  ``async def``): the loop suspends the coroutine mid-critical-section
+  and every thread blocking on that lock — and every session behind
+  those threads — waits for the loop to resume it.
+
+Nested function bodies are NOT flagged (they run later, elsewhere —
+the background-rebuild closure pattern).  Deliberate sites (a
+host-backed fake device in a test helper, a bounded metadata write)
+opt out with ``# vmqlint: allow(lock-discipline): <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+from ..core import Context, Finding, Pass, SourceFile
+
+#: a with-item guards a lock when its context expression's final name
+#: component looks lock-shaped (self._lock, plan._lock, self.lock,
+#: table_lock ...)
+_LOCK_COMPONENT = re.compile(r"(?:^|_)(?:lock|mutex|rlock)$",
+                             re.IGNORECASE)
+
+#: final call-name components that are device transfers / waits
+_DEVICE_CALLS = {"device_put", "block_until_ready",
+                 "make_array_from_callback",
+                 "make_array_from_single_device_arrays"}
+#: final call-name components that compile (directly or on cold shapes)
+_COMPILE_CALLS = {"jit", "pjit", "warm_delta_ladder"}
+#: bare-name calls that are synchronous IO
+_IO_NAMES = {"open", "input"}
+#: (receiver, method) IO pairs
+_IO_ATTRS = {("os", "fsync"), ("time", "sleep")}
+#: journal-write method names (receiver must be spelled *journal*)
+_JOURNAL_METHODS = {"append", "write", "delete", "trim", "flush",
+                    "sync", "put"}
+
+
+def _final_component(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _is_lock_item(item: ast.withitem) -> bool:
+    comp = _final_component(item.context_expr)
+    return comp is not None and bool(_LOCK_COMPONENT.search(comp))
+
+
+def _call_parts(node: ast.Call) -> Tuple[Optional[str], Optional[str]]:
+    """(receiver final component or None, callee name)."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        return _final_component(f.value), f.attr
+    if isinstance(f, ast.Name):
+        return None, f.id
+    return None, None
+
+
+def _classify(node: ast.Call) -> Optional[str]:
+    """Why this call must not run under a lock, or None."""
+    recv, callee = _call_parts(node)
+    if callee is None:
+        return None
+    if callee in _DEVICE_CALLS:
+        return (f"device transfer/wait `{callee}(...)` under a lock — "
+                f"snapshot under the lock, transfer outside it (the "
+                f"PR 9 adopt_slices / PR 10 device_put defect class)")
+    if callee in _COMPILE_CALLS or callee.startswith("ensure_warm"):
+        return (f"compile `{callee}(...)` under a lock — every waiter "
+                f"parks behind XLA (the PR 2 warm_delta_ladder defect "
+                f"class); compile against throwaway arrays outside it")
+    if recv is None and callee in _IO_NAMES:
+        return (f"synchronous IO `{callee}(...)` under a lock")
+    if (recv, callee) in _IO_ATTRS:
+        return (f"synchronous `{recv}.{callee}(...)` under a lock — "
+                f"every waiter stalls for its full duration")
+    if (callee in _JOURNAL_METHODS and recv is not None
+            and "journal" in recv.lower()):
+        return (f"journal write `{recv}.{callee}(...)` under a lock — "
+                f"journal IO belongs outside the critical section")
+    return None
+
+
+class _FunctionVisitor(ast.NodeVisitor):
+    """Walk one function body tracking how many lock-shaped ``with``
+    blocks enclose the current node.  Nested function definitions are
+    skipped — their bodies execute later, not under the lock."""
+
+    def __init__(self, findings: List[Finding], rel: str):
+        self.findings = findings
+        self.rel = rel
+        self.lock_depth = 0
+
+    def visit_FunctionDef(self, node):  # noqa: N802
+        pass
+
+    def visit_AsyncFunctionDef(self, node):  # noqa: N802
+        pass
+
+    def visit_Lambda(self, node):  # noqa: N802
+        pass
+
+    def visit_With(self, node):  # noqa: N802
+        # items evaluate left-to-right, each under whatever locks the
+        # earlier items acquired — so `with self._lock, open(p) as fh:`
+        # opens the file WITH the lock held, and a nested
+        # `with open(p):` body-statement is just as visible as the
+        # assignment spelling
+        entered = 0
+        for item in node.items:
+            self.visit(item.context_expr)
+            if _is_lock_item(item):
+                self.lock_depth += 1
+                entered += 1
+        for child in node.body:
+            self.visit(child)
+        self.lock_depth -= entered
+
+    def visit_Await(self, node):  # noqa: N802
+        if self.lock_depth:
+            self.findings.append(Finding(
+                PASS.name, self.rel, node.lineno,
+                "await while holding a threading lock — the coroutine "
+                "suspends mid-critical-section and every thread (and "
+                "session) behind the lock waits for the loop to resume "
+                "it; release first or use asyncio.Lock"))
+        self.generic_visit(node)
+
+    def visit_Call(self, node):  # noqa: N802
+        if self.lock_depth:
+            why = _classify(node)
+            if why:
+                self.findings.append(
+                    Finding(PASS.name, self.rel, node.lineno, why))
+        self.generic_visit(node)
+
+
+class LockDisciplinePass(Pass):
+    name = "lock-discipline"
+    describe = ("device transfers, compiles, sync IO and awaits inside "
+                "`with <lock>` blocks")
+    defect = ("work that can wedge or take seconds runs inside a "
+              "threading critical section — every waiter (often the "
+              "event loop) parks behind it")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for f in ctx.iter_files(self.roots):
+            self._scan(f, findings)
+        return findings
+
+    @staticmethod
+    def _scan(f: SourceFile, findings: List[Finding]) -> None:
+        if f.tree is None:
+            return
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                v = _FunctionVisitor(findings, f.rel)
+                for child in node.body:
+                    v.visit(child)
+
+
+PASS = LockDisciplinePass()

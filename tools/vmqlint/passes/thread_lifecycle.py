@@ -1,0 +1,291 @@
+"""``thread-lifecycle`` pass: every started thread has an owner.
+
+Defect class (PR 2/4 review-hardening tails, shipped twice): a class
+starts a ``threading.Thread``/``threading.Timer`` and its ``close()``/
+``stop()`` never joins or cancels it — background rebuild threads
+outlived ``close()``, installed stale tables after teardown, and kept
+test processes alive.  The repo's rule since PR 4: a thread handle is
+state; whoever stores it winds it down (join/cancel) or explicitly
+documents the cooperative-stop design.
+
+For every class that starts a Thread/Timer, this pass requires:
+
+- the constructed thread is **stored** (``self.x = threading.Thread``,
+  possibly via a local, or appended to a ``self.<collection>``) — an
+  inline ``threading.Thread(...).start()`` leaves ``close()`` nothing
+  to join;
+- a ``.join(...)`` or ``.cancel(...)`` of that attribute (directly,
+  through a local alias, or on the loop variable of a
+  ``for ... in self.<collection>``) is **reachable from a lifecycle
+  method**: the class-local ``self.<m>()`` call graph is walked to a
+  fixpoint from ``close``/``stop``/``shutdown``/``__exit__`` — a join
+  parked in a helper nothing on the teardown path calls does not count;
+- the class has a lifecycle method at all.
+
+Module-level fire-and-forget threads (one-shot dump writers) are out of
+scope — the defect class is *instances that claim a lifecycle and leak
+threads past it*.  Deliberate designs (sacrificial executors whose
+wedged workers are abandoned by contract; cooperative-stop rebuild
+threads that observe a closed flag and discard their install) opt out
+with ``# vmqlint: allow(thread-lifecycle): <reason>`` on the
+``Thread(...)`` construction line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import Context, Finding, Pass, SourceFile
+
+_LIFECYCLE_METHODS = {"close", "stop", "shutdown", "__exit__"}
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        return (f.value.id in ("threading", "_threading")
+                and f.attr in ("Thread", "Timer"))
+    if isinstance(f, ast.Name):
+        return f.id in ("Thread", "Timer")
+    return False
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.x`` -> ``"x"``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One pass over a method body collecting thread construction,
+    storage, start and join/cancel facts (with one level of local
+    aliasing: ``t = threading.Thread(...)`` / ``m = self._monitor``)."""
+
+    def __init__(self):
+        # local name -> "ctor" (holds a fresh thread) or ("attr", name)
+        self.alias: Dict[str, object] = {}
+        #: self attrs assigned a fresh thread: attr -> ctor line
+        self.stored: Dict[str, int] = {}
+        #: self attrs a fresh thread was append/add-ed to: attr -> line
+        self.collected: Dict[str, int] = {}
+        #: attrs .start()ed (directly or via alias)
+        self.started_attrs: Set[str] = set()
+        #: ctor lines started without any storage (inline/local-only);
+        #: line -> True once .start() observed
+        self.naked_ctors: Dict[int, bool] = {}
+        #: ctor line -> ("attr"|"coll", name) once stored/collected —
+        #: start order independent
+        self.ctor_home: Dict[int, tuple] = {}
+        #: attrs joined/cancelled in this method (incl. via alias or
+        #: for-loop over a self collection)
+        self.joined: Set[str] = set()
+
+    def _expr_thread(self, node: ast.AST) -> Optional[Tuple[str, int]]:
+        """Is this expression a fresh thread? -> ("ctor", line)."""
+        if isinstance(node, ast.Call) and _is_thread_ctor(node):
+            return ("ctor", node.lineno)
+        if isinstance(node, ast.Name):
+            a = self.alias.get(node.id)
+            if isinstance(a, tuple) and a[0] == "ctor":
+                return ("ctor", a[1])
+        return None
+
+    def visit_Assign(self, node):  # noqa: N802
+        val_thread = self._expr_thread(node.value)
+        for tgt in node.targets:
+            attr = _self_attr(tgt)
+            if attr is not None:
+                if val_thread:
+                    self.stored[attr] = val_thread[1]
+                    # a stored ctor is no longer naked, even if the
+                    # local alias is .start()ed after this assignment
+                    self.ctor_home[val_thread[1]] = ("attr", attr)
+                    self.naked_ctors.pop(val_thread[1], None)
+            elif isinstance(tgt, ast.Name):
+                if isinstance(node.value, ast.Call) \
+                        and _is_thread_ctor(node.value):
+                    self.alias[tgt.id] = ("ctor", node.value.lineno)
+                    self.naked_ctors.setdefault(node.value.lineno, False)
+                elif _self_attr(node.value) is not None:
+                    self.alias[tgt.id] = ("attr",
+                                          _self_attr(node.value))
+                else:
+                    self.alias.pop(tgt.id, None)
+        self.generic_visit(node)
+
+    def _receiver_attr(self, recv: ast.AST) -> Optional[str]:
+        """Resolve a call receiver to a self attr (direct or alias)."""
+        attr = _self_attr(recv)
+        if attr is not None:
+            return attr
+        if isinstance(recv, ast.Name):
+            a = self.alias.get(recv.id)
+            if isinstance(a, tuple) and a[0] == "attr":
+                return a[1]
+        return None
+
+    def visit_Call(self, node):  # noqa: N802
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if f.attr == "start" and not node.args:
+                th = self._expr_thread(recv)
+                if th:  # threading.Thread(...).start() / t.start()
+                    home = self.ctor_home.get(th[1])
+                    if home is not None:  # stored/collected earlier
+                        self.started_attrs.add(home[1])
+                    else:                 # truly unstored so far
+                        self.naked_ctors[th[1]] = True
+                else:
+                    attr = self._receiver_attr(recv)
+                    if attr is not None:
+                        self.started_attrs.add(attr)
+            elif f.attr in ("join", "cancel"):
+                attr = self._receiver_attr(recv)
+                if attr is not None:
+                    self.joined.add(attr)
+            elif f.attr in ("append", "add"):
+                attr = self._receiver_attr(recv)
+                if attr is not None and node.args:
+                    th = self._expr_thread(node.args[0])
+                    if th:
+                        self.collected[attr] = th[1]
+                        self.ctor_home[th[1]] = ("coll", attr)
+                        self.naked_ctors.pop(th[1], None)
+        if isinstance(node, ast.Call) and _is_thread_ctor(node):
+            # ctor seen in any other position: candidate naked start
+            self.naked_ctors.setdefault(node.lineno, False)
+        self.generic_visit(node)
+
+    def visit_For(self, node):  # noqa: N802
+        # `for t in self._threads: t.join()` — credit the collection
+        it = node.iter
+        if isinstance(it, ast.Call) and it.args:  # list(self._threads)
+            it = it.args[0]
+        attr = _self_attr(it)
+        if attr is not None and isinstance(node.target, ast.Name):
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr in ("join", "cancel")
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == node.target.id):
+                    self.joined.add(attr)
+        self.generic_visit(node)
+
+
+class ThreadLifecyclePass(Pass):
+    name = "thread-lifecycle"
+    describe = ("Thread/Timer started by a class with no join/cancel "
+                "reachable from close()/stop()")
+    defect = ("background threads outlive close(), install stale state "
+              "after teardown and keep processes alive")
+
+    def run(self, ctx: Context) -> List[Finding]:
+        findings: List[Finding] = []
+        for f in ctx.iter_files(self.roots):
+            self._scan(f, findings)
+        return findings
+
+    @staticmethod
+    def _scan(f: SourceFile, findings: List[Finding]) -> None:
+        if f.tree is None:
+            return
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ClassDef):
+                _audit_class(node, f, findings)
+
+
+def _self_calls(item: ast.AST) -> Set[str]:
+    """Method names this method invokes as ``self.<m>(...)``."""
+    out: Set[str] = set()
+    for node in ast.walk(item):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and _self_attr(node.func) is not None):
+            out.add(node.func.attr)
+    return out
+
+
+def _audit_class(cls: ast.ClassDef, f: SourceFile,
+                 findings: List[Finding]) -> None:
+    stored: Dict[str, int] = {}
+    collected: Dict[str, int] = {}
+    started: Set[str] = set()
+    #: attrs joined/cancelled, per method name (reachability matters)
+    joined_by_method: Dict[str, Set[str]] = {}
+    calls_by_method: Dict[str, Set[str]] = {}
+    naked: Dict[int, bool] = {}
+    method_names: Set[str] = set()
+    for item in cls.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        method_names.add(item.name)
+        scan = _MethodScan()
+        for child in item.body:
+            scan.visit(child)
+        for attr, line in scan.stored.items():
+            stored.setdefault(attr, line)
+        for attr, line in scan.collected.items():
+            collected.setdefault(attr, line)
+        started |= scan.started_attrs
+        joined_by_method.setdefault(item.name, set()).update(scan.joined)
+        calls_by_method[item.name] = _self_calls(item)
+        for line, was_started in scan.naked_ctors.items():
+            naked[line] = naked.get(line, False) or was_started
+    thread_lines = (list(stored.values()) + list(collected.values())
+                    + [ln for ln, st in naked.items() if st])
+    if not thread_lines:
+        return
+    # joins count only when REACHABLE from a lifecycle method: the
+    # class-local call graph (self.<m>() edges) walked to a fixpoint
+    # from close/stop/shutdown/__exit__ — a join parked in a helper
+    # nothing on the teardown path calls is the PR 4 defect with extra
+    # steps, not a fix for it
+    has_lifecycle = bool(method_names & _LIFECYCLE_METHODS)
+    reachable = set(method_names & _LIFECYCLE_METHODS)
+    frontier = set(reachable)
+    while frontier:
+        nxt = set()
+        for m in frontier:
+            for callee in calls_by_method.get(m, ()):
+                if callee in method_names and callee not in reachable:
+                    reachable.add(callee)
+                    nxt.add(callee)
+        frontier = nxt
+    joined: Set[str] = set()
+    for m in reachable:
+        joined |= joined_by_method.get(m, set())
+    for line, was_started in sorted(naked.items()):
+        if was_started:
+            findings.append(Finding(
+                PASS.name, f.rel, line,
+                f"class {cls.name} starts a Thread/Timer without "
+                f"storing its handle — close()/stop() has nothing to "
+                f"join; keep the handle or mark `# vmqlint: "
+                f"allow(thread-lifecycle): <reason>`"))
+    for attr, line in sorted({**stored, **collected}.items(),
+                             key=lambda kv: kv[1]):
+        if attr not in started:
+            # constructed but never .start()ed anywhere in the class:
+            # nothing to wind down (joining an unstarted Thread raises)
+            continue
+        if not has_lifecycle:
+            findings.append(Finding(
+                PASS.name, f.rel, line,
+                f"class {cls.name} starts threads but has no "
+                f"close()/stop() lifecycle method to wind them down"))
+        elif attr not in joined:
+            findings.append(Finding(
+                PASS.name, f.rel, line,
+                f"class {cls.name} stores a Thread/Timer in "
+                f"self.{attr} but no join/cancel of it is reachable "
+                f"from close()/stop() — a background thread outliving "
+                f"close() is the PR 4 stale-install defect class"))
+
+
+PASS = ThreadLifecyclePass()

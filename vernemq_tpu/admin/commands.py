@@ -1210,6 +1210,12 @@ def _fault_inject(broker, flags):
     point = flags.get("point")
     if not isinstance(point, str):
         raise CommandError("point=NAME required (e.g. device.dispatch)")
+    try:
+        # a drill against a misspelled seam must fail here, not pass
+        # vacuously (the registry the fault-registry lint pass enforces)
+        faults.validate_point(point)
+    except ValueError as e:
+        raise CommandError(str(e))
     rule = faults.FaultRule(
         point=point,
         kind=str(flags.get("kind", "error")),
@@ -1283,10 +1289,17 @@ def _each_breaker(broker, flags):
     """Breakers selected by the optional mountpoint=/path= flags — both
     the publish matchers' and the retained indexes' breakers, so
     trip/reset drills cover every device path."""
+    from ..robustness.breaker import BREAKER_PATHS
+
     want = flags.get("mountpoint")
     path = flags.get("path")
-    if path not in (None, "match", "retained", "predicate"):
-        raise CommandError("path must be match, retained or predicate")
+    # the registered set, not a hand-maintained tuple: a new breakered
+    # device path registers in BREAKER_PATHS and is drillable here
+    # immediately (the fault-registry lint pass proves the show rows
+    # below stay in sync)
+    if path is not None and path not in BREAKER_PATHS:
+        raise CommandError(
+            f"path must be one of {', '.join(BREAKER_PATHS)}")
     if path in (None, "match"):
         view = broker.registry.reg_views.get("tpu")
         for mp, m in getattr(view, "_matchers", {}).items():

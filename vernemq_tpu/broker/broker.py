@@ -1356,6 +1356,20 @@ class Broker:
 
             try:
                 self.worker_stats = WorkerStatsBlock.attach(stats_name)
+                # the parent's workers_total must agree with the slot
+                # count baked into the segment header: a mismatch means
+                # this worker attached a STALE block from a previous
+                # group generation (or a torn rolling restart) — peer
+                # pressure fusion and `workers show` would read slots
+                # that belong to nobody
+                expected = int(self.config.get("workers_total", 1) or 0)
+                if expected and expected != self.worker_stats.n_workers:
+                    log.warning(
+                        "worker stats block %r has %d slots but "
+                        "workers_total=%d — parent and worker config "
+                        "generations disagree (stale segment?)",
+                        stats_name, self.worker_stats.n_workers,
+                        expected)
                 # scrape-point histogram aggregation: merge the OTHER
                 # live workers' slot blocks into this worker's scrape
                 # (our own observations come from the live in-process
@@ -1387,6 +1401,32 @@ class Broker:
             except Exception:
                 log.exception("match-service rings unavailable; this "
                               "worker matches on its local trie")
+        # materialize the reg views listed in the reg_views knob
+        # (vmq_server.schema reg_views: views started at BOOT, not on
+        # first default_reg_view routing) — an operator listing tpu with
+        # default_reg_view=trie wants the device table building now so
+        # a later `config set default_reg_view tpu` flips onto a warm
+        # view; the worker-mode ShmMatchView mount above stays
+        # authoritative (already-present names are skipped)
+        from .schema import REG_VIEW_ALIASES
+
+        valid_views = sorted(set(REG_VIEW_ALIASES.values()))
+        for view_name in self.config.get("reg_views", ["trie"]):
+            if view_name in self.registry.reg_views:
+                continue
+            if view_name not in valid_views:
+                log.error("reg_views names unknown view %r (valid: %s)",
+                          view_name, ", ".join(valid_views))
+                continue
+            try:
+                self.registry.reg_view(view_name)
+            except Exception:
+                # pre-building is an optimization, never a boot gate: a
+                # failing device-view build logs and stays lazy (the
+                # accel probe/recovery machinery retries it), routing
+                # serves on the default view either way
+                log.exception("reg_views: building view %r failed at "
+                              "boot; it stays lazy", view_name)
         # adaptive overload governor BEFORE sysmon so the lag sampler can
         # feed it from its very first sample (robustness/overload.py)
         from ..robustness.overload import OverloadGovernor

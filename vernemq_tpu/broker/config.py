@@ -196,6 +196,10 @@ DEFAULTS: Dict[str, Any] = {
     # and what every test boots) runs byte-identical to the classic
     # single-process broker — none of the keys below change any code
     # path until the WorkerGroup parent sets them.
+    # vmqlint: allow(knob-registry): consumed by the worker CLI via the
+    # RAW parsed conf (workers.py probes parse_conf output, deliberately
+    # not a Config — DEFAULTS merging would make the cpu_count/2
+    # fallback unreachable), a read the config-shaped taint cannot see
     "workers": 1,
     # shared-memory stats table name (parallel/shm_ring.py
     # WorkerStatsBlock): per-worker health/pressure slots the governors

@@ -194,7 +194,12 @@ class Session:
             if cfg.topic_alias_max_broker:
                 self.topic_alias_max_out = min(self.topic_alias_max_out,
                                                cfg.topic_alias_max_broker)
-            self.receive_max_out = f.properties.get("receive_maximum", 65535)
+            # default when the client announces none: the reference's
+            # receive_max_client knob (vmq_server.schema), not a
+            # hardcoded 65535 — an operator capping broker->client
+            # inflight for quiet v5 clients gets the cap they set
+            self.receive_max_out = f.properties.get(
+                "receive_maximum", cfg.receive_max_client)
             # client's packet-size ceiling for broker->client frames
             # (vmq_mqtt5_fsm.erl:159-161 maybe_get_maximum_packet_size,
             # min'd with the broker's own configured cap)

@@ -468,6 +468,9 @@ class TpuMatcher:
             finally:
                 self._warming.discard(Bpad)
 
+        # vmqlint: allow(thread-lifecycle): bounded fire-and-forget —
+        # one warm-up compile per cold shape, deduped by _warming, that
+        # exits on its own; joining would make close() wait out XLA
         threading.Thread(target=_w, name=f"tpu-warm-{Bpad}",
                          daemon=True).start()
 
@@ -665,6 +668,10 @@ class TpuMatcher:
                 if op is not None:
                     wd.deregister(op)
 
+        # vmqlint: allow(thread-lifecycle): cooperative stop by design —
+        # _run observes close()'s _closed flag and the watchdog abandon
+        # token and DISCARDS its install; sync() reaps the handle. A
+        # join would park shutdown behind a possibly-wedged device call.
         th = threading.Thread(target=_run, name="tpu-table-rebuild",
                               daemon=True)
         self._rebuild_thread = th
@@ -1320,6 +1327,8 @@ class TpuMatcher:
             finally:
                 self._warming.discard(key)
 
+        # vmqlint: allow(thread-lifecycle): bounded fire-and-forget —
+        # same contract as the single-batch warm thread above
         threading.Thread(target=_w, name=f"tpu-warm-many-{n_batches}",
                          daemon=True).start()
 

@@ -332,6 +332,10 @@ class RetainedIndex:
                 if op is not None:
                     wd.deregister(op)
 
+        # vmqlint: allow(thread-lifecycle): cooperative stop by design —
+        # _run checks _closed/the abandon token before build AND install
+        # and discards stale work; a join would park close() behind a
+        # possibly-wedged device upload (the watchdog abandons instead)
         th = threading.Thread(target=_run, name="retained-rebuild",
                               daemon=True)
         self._rebuild_thread = th

@@ -32,6 +32,14 @@ from typing import Any, Callable, Dict, Optional
 CLOSED, HALF_OPEN, OPEN = 0, 1, 2
 _NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
 
+#: The registered breaker paths: every device path that carries a
+#: circuit breaker, as spelled by the admin surface (``vmq-admin
+#: breaker show|trip|reset path=...``).  A new breakered device phase
+#: registers here FIRST — the ``fault-registry`` vmqlint pass proves
+#: the admin rows and the trip/reset filter both match this set
+#: exactly, so a path can't ship un-drillable.
+BREAKER_PATHS = ("match", "retained", "predicate")
+
 
 class CircuitBreaker:
     def __init__(self, failure_threshold: int = 3,

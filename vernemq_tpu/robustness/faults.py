@@ -10,25 +10,11 @@ seeds reproduce identical injection sequences regardless of how hits on
 *different* points interleave — the property the determinism test in
 ``tests/test_fault_injection.py`` asserts.
 
-Injection points in the tree (grep for ``faults.inject``):
-
-==================  =====================================================
-``device.dispatch``  TPU match dispatch (ops.match_kernel ``call_packed``
-                     / ``call_match_many`` and the matcher fallbacks)
-``device.delta``     delta-scatter upload of dirty table slots
-``device.rebuild``   full device-table (re)build, inline or background
-``device.retained``  retained reverse-match path (retained/index.py):
-                     dispatch, delta scatter and full (re)build — the
-                     whole device half of retained replay degrades to
-                     the host retain walk behind its breaker
-``device.predicate`` payload-predicate phase (filters/engine.py):
-                     pair-mask + window-fold dispatch degrades to the
-                     exact host evaluator behind the predicate breaker
-``cluster.recv``     inbound cluster data-plane frames (cluster/com.py)
-``cluster.spool``    delivery-spool journal writes (cluster/spool.py)
-``store.write``      message-store writes (storage/msg_store.py)
-``listener.bind``    listener (re)bind (broker/listeners.py)
-==================  =====================================================
+The injection points in the tree are registered in
+:data:`KNOWN_POINTS` (one authoritative table: ``vmq-admin fault
+inject`` validates against it, and the ``fault-registry`` vmqlint pass
+proves every ``faults.inject*`` site and every registry entry agree —
+a typo'd point on either side fails tier-1, not a chaos drill).
 
 The no-plan fast path is one module-global ``is None`` check, so the
 hooks cost nothing in production.
@@ -52,6 +38,52 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 #: sites that HAVE surrounding deadlines. ``hang`` stays capped for
 #: sites that still lack them.
 HANG_CAP_S = 60.0
+
+#: The authoritative injection-point registry: point -> what lives
+#: there.  Every ``faults.inject``/``inject_async`` call site names one
+#: of these, every entry has at least one site, and ``vmq-admin fault
+#: inject`` refuses points (or globs) matching none of them — all three
+#: invariants are enforced statically by ``tools/vmqlint``'s
+#: ``fault-registry`` pass and at runtime by :func:`validate_point`.
+KNOWN_POINTS: Dict[str, str] = {
+    "device.dispatch":
+        "TPU match dispatch (ops.match_kernel call_packed/"
+        "call_match_many and the matcher fallbacks)",
+    "device.delta":
+        "delta-scatter upload of dirty table slots",
+    "device.rebuild":
+        "full device-table (re)build, inline or background",
+    "device.retained":
+        "retained reverse-match path (retained/index.py): dispatch, "
+        "delta scatter and full (re)build",
+    "device.predicate":
+        "payload-predicate phase (filters/engine.py): pair-mask + "
+        "window-fold dispatch",
+    "device.pressure":
+        "overload-governor device-pressure probe (robustness/"
+        "overload.py): an exact-match error rule forces pressure 1.0",
+    "cluster.recv":
+        "inbound cluster data-plane frames (cluster/com.py)",
+    "cluster.spool":
+        "delivery-spool journal writes (cluster/spool.py)",
+    "store.write":
+        "message-store writes (storage/msg_store.py)",
+    "listener.bind":
+        "listener (re)bind (broker/listeners.py)",
+}
+
+
+def validate_point(point: str) -> None:
+    """Reject an injection point (or fnmatch glob) that matches no
+    registered point — a drill against a misspelled seam must fail
+    loudly at the admin surface, not pass vacuously."""
+    if point in KNOWN_POINTS:
+        return
+    if any(fnmatch.fnmatch(known, point) for known in KNOWN_POINTS):
+        return
+    raise ValueError(
+        f"unknown injection point {point!r} (known: "
+        f"{', '.join(sorted(KNOWN_POINTS))})")
 
 
 class InjectedFault(RuntimeError):

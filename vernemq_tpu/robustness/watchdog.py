@@ -168,6 +168,10 @@ class SacrificialExecutor:
                 n = next(self._seq)
             self._q.put((fut, fn))
         if spawn:
+            # vmqlint: allow(thread-lifecycle): sacrificial by contract
+            # — a worker wedged in an abandoned call is spawned AROUND,
+            # never joined; close() flips _closed and live workers exit
+            # on their next queue pass (the whole point of this pool)
             threading.Thread(target=self._worker,
                              name=f"{self.name}-{n}",
                              daemon=True).start()
