@@ -1760,24 +1760,55 @@ def config11_admission_storm(smoke):
         finally:
             g.stop()
 
-    async def run_single_loop():
+    async def run_single_loop(tag="base", wire_fastpath=True):
         """Pre-PR baseline: ONE in-process broker on this loop, same
-        storm from the same external load processes."""
+        storm from the same external load processes.
+        ``wire_fastpath=False`` pins the classic per-frame session path
+        (the wire A/B's pure leg runs it with the native codec forced
+        off as well)."""
         from vernemq_tpu.broker.config import Config
         from vernemq_tpu.broker.server import start_broker
 
         cfg = Config(systree_enabled=False, allow_anonymous=True,
-                     sysmon_lag_threshold=30.0)
+                     sysmon_lag_threshold=30.0,
+                     wire_fastpath_enabled=wire_fastpath)
         broker, server = await start_broker(cfg, port=0,
-                                            node_name="adm-base")
+                                            node_name="adm-" + tag)
         out = await storm_measure(
-            server.port, "base",
+            server.port, tag,
             lambda: broker.metrics.value("mqtt_publish_received"))
         await broker.stop()
         await server.stop()
         return out
 
     base = asyncio.run(run_single_loop())
+    # wire-plane A/B (ISSUE 12 acceptance): the SAME storm at the same
+    # (single) worker count, native batched codec + QoS0 fast path vs
+    # the pure-Python pre-wire-plane session path. The native leg IS
+    # the baseline run above; the pure leg forces the whole plane off.
+    from vernemq_tpu.protocol import codec_v4 as _c4
+    from vernemq_tpu.protocol import codec_v5 as _c5
+    from vernemq_tpu.protocol import fastpath as _fp
+
+    native_built = _fp.load_native() is not None
+    note("[bench] config11 wire-plane pure-python leg...")
+    _saved_codec = (_c4._C, _c5._C, _fp._force_pure)
+    _c4._C = None
+    _c5._C = None
+    _fp._force_pure = True
+    try:
+        pure = asyncio.run(run_single_loop("pure", wire_fastpath=False))
+    finally:
+        _c4._C, _c5._C, _fp._force_pure = _saved_codec
+    wire_ab = {
+        "native": {"admitted_pubs_per_s": base["admitted_pubs_per_s"],
+                   "native_codec": native_built, "wire_fastpath": True},
+        "pure": {"admitted_pubs_per_s": pure["admitted_pubs_per_s"],
+                 "native_codec": False, "wire_fastpath": False},
+        "admitted_speedup": (round(
+            base["admitted_pubs_per_s"] / pure["admitted_pubs_per_s"],
+            2) if pure["admitted_pubs_per_s"] else None),
+    }
     per = {}
     for i, n in enumerate((1, 2, 4)):
         note(f"[bench] config11 workers={n} storm...")
@@ -1790,6 +1821,9 @@ def config11_admission_storm(smoke):
         "publishers": n_procs * clients_per,
         "single_loop_pubs_per_s": base["admitted_pubs_per_s"],
         "single_loop_connect_ms_p99": base["connect_ms_p99"],
+        # wire plane: native codec availability + the A/B at one worker
+        "native_codec": native_built,
+        "wire_ab": wire_ab,
         "per_workers": per,
         "speedup_w2_vs_w1": round(
             per["2"]["admitted_pubs_per_s"] / r1, 2) if r1 else None,

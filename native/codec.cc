@@ -24,6 +24,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <vector>
 
 namespace {
 
@@ -171,6 +172,260 @@ PyObject* parse_fast(PyObject*, PyObject* args) {
   return out;
 }
 
+// ---------------------------------------------------------------------
+// Batched wire plane (the "frame table").
+//
+// parse_batch(data, max_size=0, v5=False) -> (table: bytes, n, consumed)
+//
+// One call turns a recv buffer into a packed table of fixed-width
+// records — offsets and spans only, NO per-frame Python objects. The
+// record layout is struct '<BBHIIIII' (24 bytes, little-endian), shared
+// bit-for-bit with the pure-Python fallback in
+// vernemq_tpu/protocol/fastpath.py (the differential fuzz test asserts
+// table equality on arbitrary byte streams):
+//
+//   kind        u8   0=PY (python codec owns this span, including every
+//                    malformed-input error), 1=QoS0 PUBLISH hot shape,
+//                    2=QoS1/2 PUBLISH hot shape, 3=2-byte ack family,
+//                    4=PINGREQ/PINGRESP
+//   b0          u8   raw fixed-header byte (type nibble | flags)
+//   pid         u16  packet id (0 when none)
+//   frame_off   u32  first byte of the frame in the buffer
+//   frame_end   u32  one past the frame's last byte
+//   topic_off   u32  topic span (publish kinds only, else 0)
+//   topic_len   u32
+//   payload_off u32  payload runs to frame_end
+//
+// Classification never validates topic CONTENT (UTF-8 / NUL): the
+// consumer decodes lazily and hands any failure to the Python codec so
+// the canonical ParseError surfaces. A structurally unparseable head
+// (5-byte varint, max_size overrun) emits one PY record spanning the
+// rest of the buffer and stops — the Python parser raises the
+// canonical error for that span. A torn frame at the tail simply stops
+// the walk (consumed < len).
+
+constexpr unsigned char K_PY = 0;
+constexpr unsigned char K_PUB0 = 1;
+constexpr unsigned char K_PUB = 2;
+constexpr unsigned char K_ACKREC = 3;
+constexpr unsigned char K_PINGREC = 4;
+
+constexpr int REC_SIZE = 24;
+
+inline void put_u16(std::vector<unsigned char>& v, unsigned int x) {
+  v.push_back(x & 0xFF);
+  v.push_back((x >> 8) & 0xFF);
+}
+
+inline void put_u32(std::vector<unsigned char>& v, unsigned long x) {
+  v.push_back(x & 0xFF);
+  v.push_back((x >> 8) & 0xFF);
+  v.push_back((x >> 16) & 0xFF);
+  v.push_back((x >> 24) & 0xFF);
+}
+
+inline void push_rec(std::vector<unsigned char>& v, unsigned char kind,
+                     unsigned char b0, unsigned int pid,
+                     Py_ssize_t frame_off, Py_ssize_t frame_end,
+                     Py_ssize_t topic_off, Py_ssize_t topic_len,
+                     Py_ssize_t payload_off) {
+  v.push_back(kind);
+  v.push_back(b0);
+  put_u16(v, pid);
+  put_u32(v, (unsigned long)frame_off);
+  put_u32(v, (unsigned long)frame_end);
+  put_u32(v, (unsigned long)topic_off);
+  put_u32(v, (unsigned long)topic_len);
+  put_u32(v, (unsigned long)payload_off);
+}
+
+PyObject* parse_batch(PyObject*, PyObject* args) {
+  Py_buffer view;
+  Py_ssize_t max_size = 0;
+  int v5 = 0;
+  if (!PyArg_ParseTuple(args, "y*|np", &view, &max_size, &v5))
+    return nullptr;
+  struct Releaser {
+    Py_buffer* v;
+    ~Releaser() { PyBuffer_Release(v); }
+  } releaser{&view};
+  const unsigned char* d = static_cast<const unsigned char*>(view.buf);
+  const Py_ssize_t len = view.len;
+
+  std::vector<unsigned char> recs;
+  recs.reserve(64 * REC_SIZE);
+  Py_ssize_t pos = 0;
+  Py_ssize_t n = 0;
+  Py_ssize_t consumed = 0;
+
+  while (len - pos >= 2) {
+    const unsigned char b0 = d[pos];
+    // remaining-length varint at pos+1..
+    Py_ssize_t body_len = 0;
+    int shift = 0;
+    Py_ssize_t hlen = 0;  // 0 = incomplete, -1 = invalid
+    for (Py_ssize_t i = pos + 1; i < len && i <= pos + 4; ++i) {
+      unsigned char b = d[i];
+      body_len |= static_cast<Py_ssize_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) {
+        hlen = i - pos + 1;
+        break;
+      }
+      shift += 7;
+    }
+    if (hlen == 0) {
+      if (len - pos >= 5) hlen = -1;  // 5-byte varint: protocol error
+      else break;                     // torn varint at the tail
+    }
+    if (hlen < 0 || (max_size > 0 && body_len > max_size)) {
+      // unparseable head: the Python codec raises the canonical error
+      // for this span; nothing past it has a knowable boundary
+      push_rec(recs, K_PY, b0, 0, pos, len, 0, 0, pos);
+      ++n;
+      consumed = len;
+      pos = len;
+      break;
+    }
+    if (len - pos < hlen + body_len) break;  // torn frame at the tail
+    const Py_ssize_t frame_end = pos + hlen + body_len;
+    const unsigned char* body = d + pos + hlen;
+    const Py_ssize_t body_off = pos + hlen;
+    const int ptype = b0 >> 4;
+    const int flags = b0 & 0x0F;
+
+    unsigned char kind = K_PY;
+    unsigned int pid = 0;
+    Py_ssize_t topic_off = 0, topic_len = 0, payload_off = pos;
+
+    if (ptype == PUBLISH) {
+      const int qos = (flags >> 1) & 0x03;
+      do {
+        if (qos == 3 || body_len < 2) break;
+        const Py_ssize_t tlen = (body[0] << 8) | body[1];
+        Py_ssize_t tpos = 2 + tlen;
+        if (tpos > body_len) break;
+        if (qos > 0) {
+          if (tpos + 2 > body_len) break;
+          pid = (body[tpos] << 8) | body[tpos + 1];
+          if (pid == 0) { pid = 0; break; }
+          tpos += 2;
+        }
+        if (v5) {
+          // hot v5 shape: EMPTY property block (one 0x00 length byte)
+          if (tpos >= body_len || body[tpos] != 0) break;
+          tpos += 1;
+        }
+        kind = (qos == 0) ? K_PUB0 : K_PUB;
+        topic_off = body_off + 2;
+        topic_len = tlen;
+        payload_off = body_off + tpos;
+      } while (false);
+      if (kind == K_PY) pid = 0;
+    } else if (ptype == PUBACK || ptype == PUBREC || ptype == PUBREL ||
+               ptype == PUBCOMP) {
+      const int want_flags = (ptype == PUBREL) ? 2 : 0;
+      if (flags == want_flags && body_len == 2) {
+        pid = (body[0] << 8) | body[1];
+        if (!(v5 && pid == 0))  // v5 raises invalid_packet_id; v4 accepts
+          kind = K_ACKREC;
+        else
+          pid = 0;
+      }
+    } else if (ptype == PINGREQ || ptype == PINGRESP) {
+      if (flags == 0 && body_len == 0) kind = K_PINGREC;
+    }
+    push_rec(recs, kind, b0, pid, pos, frame_end, topic_off, topic_len,
+             payload_off);
+    ++n;
+    pos = frame_end;
+    consumed = pos;
+  }
+
+  PyObject* table = PyBytes_FromStringAndSize(
+      recs.empty() ? "" : reinterpret_cast<const char*>(recs.data()),
+      static_cast<Py_ssize_t>(recs.size()));
+  if (table == nullptr) return nullptr;
+  return Py_BuildValue("(Nnn)", table, n, consumed);
+}
+
+// encode_publish_header(topic: str, qos, retain, dup, packet_id or
+//   None, payload_len, v5=False) -> bytes
+//
+// The writev-ready half of a PUBLISH frame: fixed header +
+// remaining-length varint + topic + [pid] + [empty v5 property block].
+// The transport writes (header, payload) as an iovec — the payload
+// bytes are NEVER copied into a per-frame frame buffer, which is the
+// per-recipient assembly cost this exists to remove. Refusals raise
+// ValueError so the Python wrapper falls back to the full codec for
+// the canonical error type (same contract as serialise_publish).
+PyObject* encode_publish_header(PyObject*, PyObject* args) {
+  PyObject* topic_obj;
+  int qos, retain, dup;
+  PyObject* pid_obj;
+  Py_ssize_t payload_len;
+  int v5 = 0;
+  if (!PyArg_ParseTuple(args, "UiiiOn|p", &topic_obj, &qos, &retain,
+                        &dup, &pid_obj, &payload_len, &v5))
+    return nullptr;
+  Py_ssize_t tlen;
+  const char* topic = PyUnicode_AsUTF8AndSize(topic_obj, &tlen);
+  if (topic == nullptr) return nullptr;
+  if (tlen > 65535) {
+    PyErr_SetString(PyExc_ValueError, "topic too long");
+    return nullptr;
+  }
+  const int has_pid = (pid_obj != Py_None);
+  long pid = 0;
+  if (has_pid) {
+    pid = PyLong_AsLong(pid_obj);
+    if (pid == -1 && PyErr_Occurred()) return nullptr;
+    if (pid < 1 || pid > 65535) {
+      PyErr_SetString(PyExc_ValueError, "packet_id out of range");
+      return nullptr;
+    }
+  }
+  if (qos > 0 && !has_pid) {
+    PyErr_SetString(PyExc_ValueError, "missing_packet_id");
+    return nullptr;
+  }
+  const Py_ssize_t body_len =
+      2 + tlen + (qos > 0 ? 2 : 0) + (v5 ? 1 : 0) + payload_len;
+  unsigned char var[4];
+  int var_len = 0;
+  Py_ssize_t rem = body_len;
+  do {
+    unsigned char b = rem & 0x7F;
+    rem >>= 7;
+    if (rem) b |= 0x80;
+    var[var_len++] = b;
+  } while (rem && var_len < 4);
+  if (rem) {
+    PyErr_SetString(PyExc_ValueError, "frame too large");
+    return nullptr;
+  }
+  const Py_ssize_t hlen =
+      1 + var_len + 2 + tlen + (qos > 0 ? 2 : 0) + (v5 ? 1 : 0);
+  PyObject* out = PyBytes_FromStringAndSize(nullptr, hlen);
+  if (out == nullptr) return nullptr;
+  unsigned char* w =
+      reinterpret_cast<unsigned char*>(PyBytes_AS_STRING(out));
+  *w++ = static_cast<unsigned char>(
+      (PUBLISH << 4) | (dup ? 0x08 : 0) | ((qos & 3) << 1) |
+      (retain ? 1 : 0));
+  std::memcpy(w, var, var_len);
+  w += var_len;
+  *w++ = static_cast<unsigned char>(tlen >> 8);
+  *w++ = static_cast<unsigned char>(tlen & 0xFF);
+  std::memcpy(w, topic, tlen);
+  w += tlen;
+  if (qos > 0) {
+    *w++ = static_cast<unsigned char>((pid >> 8) & 0xFF);
+    *w++ = static_cast<unsigned char>(pid & 0xFF);
+  }
+  if (v5) *w++ = 0;
+  return out;
+}
+
 // serialise_publish(topic: str, payload: bytes, qos, retain, dup,
 //                   packet_id or None) -> bytes (one allocation)
 PyObject* serialise_publish(PyObject*, PyObject* args) {
@@ -250,6 +505,12 @@ PyObject* serialise_publish(PyObject*, PyObject* args) {
 PyMethodDef methods[] = {
     {"parse_fast", parse_fast, METH_VARARGS,
      "Parse one v4/v5 frame if it is a hot-path shape; (3,) = fallback."},
+    {"parse_batch", parse_batch, METH_VARARGS,
+     "Batch-parse a recv buffer into a packed frame table: "
+     "(table, n_frames, consumed)."},
+    {"encode_publish_header", encode_publish_header, METH_VARARGS,
+     "Writev-ready PUBLISH header (fixed header + topic [+pid]); the "
+     "payload rides the iovec uncopied."},
     {"serialise_publish", serialise_publish, METH_VARARGS,
      "Serialise a v4/v5 PUBLISH frame in one allocation."},
     {nullptr, nullptr, 0, nullptr}};
@@ -261,7 +522,7 @@ PyModuleDef module = {PyModuleDef_HEAD_INIT, "_vmq_codec",
 // Bumped whenever a function signature or result layout changes: the
 // loader refuses an older prebuilt .so (a stale-ABI artifact would
 // otherwise raise TypeError at call time deep inside the parse path).
-constexpr long FASTPATH_VERSION = 2;
+constexpr long FASTPATH_VERSION = 3;
 
 }  // namespace
 

@@ -434,19 +434,24 @@ async def test_admin_fault_and_breaker_commands():
         table = reg.run(b, ["fault", "show"])["table"]
         assert any(r.get("point") == "device.dispatch" for r in table)
         # breaker drill: trip forces degraded mode, reset restores.
-        # An unscoped trip covers EVERY device path — the match
-        # breaker plus the payload-predicate engine's (PR 10)
+        # An unscoped trip covers EVERY breakered path — the match
+        # breaker, the payload-predicate engine's (PR 10), and the
+        # process-global wire-codec breaker (PR 12)
         b.registry.reg_view("tpu").matcher("")
         out = reg.run(b, ["breaker", "trip"])
-        assert "tripped 2" in out
+        assert "tripped 3" in out
         rows = reg.run(b, ["breaker", "show"])["table"]
-        assert {r["path"] for r in rows} == {"match", "predicate"}
+        assert {r["path"] for r in rows} == {"match", "predicate",
+                                             "wire"}
         assert all(r["state"] == "forced_open" for r in rows)
         # pinned: no backoff expiry or stray success may close it
         m = b.registry.reg_view("tpu").matcher("")
         assert not m.breaker.allow()
         assert not m.breaker.record_success()
         assert not b.filter_engine.breaker.allow()
+        from vernemq_tpu.protocol import fastpath as _fp
+
+        assert not _fp.breaker.allow()
         reg.run(b, ["breaker", "reset"])
         rows = reg.run(b, ["breaker", "show"])["table"]
         assert all(r["state"] == "closed" for r in rows)

@@ -298,6 +298,201 @@ def test_differential_fuzz_random_bytes():
                 assert bytes(nrest) == bytes(prest)
 
 
+# ------------------------------------------------------- batched plane
+
+
+def _mixed_stream(rng, n=60):
+    """A frame stream mixing every hot shape with python-owned frames."""
+    from vernemq_tpu.protocol.types import (Connect, Pingresp, SubOpts,
+                                            Subscribe, Unsubscribe)
+
+    frames = []
+    for i in range(n):
+        roll = rng.random()
+        if roll < 0.6:
+            frames.append(rand_publish(rng))
+        elif roll < 0.75:
+            frames.append(rng.choice([Puback, Pubrec, Pubrel, Pubcomp])(
+                packet_id=rng.randint(1, 65535)))
+        elif roll < 0.85:
+            frames.append(rng.choice([Pingreq(), Pingresp()]))
+        elif roll < 0.95:
+            frames.append(Subscribe(packet_id=rng.randint(1, 65535),
+                                    topics=[("a/#", SubOpts(qos=1))]))
+        else:
+            frames.append(Unsubscribe(packet_id=rng.randint(1, 65535),
+                                      topics=["a/#"]))
+    return frames
+
+
+def _reference_walk(mod, buf):
+    """Sequential per-frame parse through the PURE codec: the oracle
+    the frame table must reproduce. Returns (frames, leftover, err)."""
+    frames = []
+    saved, mod._C = mod._C, None
+    try:
+        while True:
+            try:
+                f, buf = mod.parse(bytes(buf))
+            except ParseError as e:
+                return frames, None, str(e)
+            if f is None:
+                return frames, bytes(buf), None
+            frames.append(f)
+    finally:
+        mod._C = saved
+
+
+def _table_walk(mod, fp, buf, native):
+    """parse_batch + materialize over ``buf``: the wire plane's view of
+    the same bytes. Returns (frames, leftover, err)."""
+    saved = fp._force_pure
+    fp._force_pure = not native
+    try:
+        table, n, consumed = fp.parse_batch(
+            buf, 0, mod.__name__.endswith("v5"))
+    finally:
+        fp._force_pure = saved
+    frames = []
+    for off in range(0, n * fp.REC_SIZE, fp.REC_SIZE):
+        rec = fp.REC.unpack_from(table, off)
+        try:
+            frames.append(fp.materialize(mod, buf, rec))
+        except ParseError as e:
+            return frames, None, str(e)
+    return frames, buf[consumed:], None
+
+
+def test_batch_table_native_pure_bit_identical():
+    """The packed frame table is byte-identical between native/codec.cc
+    parse_batch and the pure-Python twin — on valid streams, truncated
+    tails, and arbitrary garbage."""
+    from vernemq_tpu.protocol import fastpath as fp
+
+    rng = random.Random(31)
+    blob = b"".join(C.serialise(f) for f in _mixed_stream(rng))
+    for v5 in (False, True):
+        for cut in range(0, len(blob), 11):
+            data = blob[:cut]
+            assert fp._native.parse_batch(data, 0, v5) == \
+                fp._parse_batch_py(data, 0, v5)
+    for _ in range(4000):
+        data = bytes(rng.randbytes(rng.randint(0, 40)))
+        for v5 in (False, True):
+            for ms in (0, 16):
+                assert fp._native.parse_batch(data, ms, v5) == \
+                    fp._parse_batch_py(data, ms, v5), (data.hex(), v5)
+
+
+def test_batch_walk_matches_reference_codec():
+    """Differential fuzz: frame table + materialize must yield the
+    exact frame sequence, leftover bytes, and error verdict of the
+    sequential pure-codec walk — valid, truncated, and malformed
+    streams, both codecs, native and pure table builders."""
+    from vernemq_tpu.protocol import codec_v5 as C5
+    from vernemq_tpu.protocol import fastpath as fp
+
+    rng = random.Random(77)
+    blobs = []
+    for seed in range(6):
+        r2 = random.Random(seed)
+        blobs.append(b"".join(C.serialise(f) for f in
+                              _mixed_stream(r2, 30)))
+    blobs += [bytes(rng.randbytes(rng.randint(0, 60)))
+              for _ in range(1500)]
+    # biased garbage: plausible type nibbles + short bodies
+    for _ in range(1500):
+        t = rng.choice([3, 4, 5, 6, 7, 12, 13]) << 4 | rng.randint(0, 15)
+        body = bytes(rng.randbytes(rng.randint(0, 20)))
+        blobs.append(bytes([t, len(body)]) + body)
+    for blob in blobs:
+        for cut in (len(blob), rng.randint(0, max(1, len(blob)))):
+            data = blob[:cut]
+            for mod in (C, C5):
+                want = _reference_walk(mod, data)
+                for native in (True, False):
+                    got = _table_walk(mod, fp, data, native)
+                    assert got == want, (mod.__name__, native,
+                                         data.hex())
+
+
+def test_batch_torn_buffer_resume_parity():
+    """Feeding the same stream through ARBITRARY recv-boundary splits
+    must produce the identical frame sequence: the table's consumed
+    cursor resumes exactly where the codec's incremental parse would."""
+    from vernemq_tpu.protocol import fastpath as fp
+
+    rng = random.Random(5)
+    frames = _mixed_stream(rng, 80)
+    blob = b"".join(C.serialise(f) for f in frames)
+    for trial in range(6):
+        r2 = random.Random(trial)
+        buf = b""
+        got = []
+        pos = 0
+        while pos < len(blob) or buf:
+            step = min(r2.randint(1, 37), len(blob) - pos)
+            buf += blob[pos:pos + step]
+            pos += step
+            table, n, consumed = fp.parse_batch(buf, 0, False)
+            for off in range(0, n * fp.REC_SIZE, fp.REC_SIZE):
+                got.append(fp.materialize(
+                    C, buf, fp.REC.unpack_from(table, off)))
+            buf = buf[consumed:]
+            if pos >= len(blob) and consumed == 0:
+                break
+        assert got == frames, trial
+        assert buf == b""
+
+
+def test_batch_max_size_error_parity():
+    """An oversize frame mid-stream raises frame_too_large through the
+    table walk exactly where the sequential parse would — frames before
+    it are delivered."""
+    from vernemq_tpu.protocol import fastpath as fp
+
+    small = Publish(topic="s", payload=b"x", qos=0)
+    big = Publish(topic="b", payload=b"y" * 500, qos=0)
+    blob = C.serialise(small) + C.serialise(big) + C.serialise(small)
+    for native in (True, False):
+        saved = fp._force_pure
+        fp._force_pure = not native
+        try:
+            table, n, consumed = fp.parse_batch(blob, 100, False)
+        finally:
+            fp._force_pure = saved
+        recs = [fp.REC.unpack_from(table, off)
+                for off in range(0, n * fp.REC_SIZE, fp.REC_SIZE)]
+        assert recs[0][0] == fp.K_PUB0
+        assert fp.materialize(C, blob, recs[0]) == small
+        with pytest.raises(ParseError, match="frame_too_large"):
+            fp.materialize(C, blob, recs[1], 100)
+        assert len(recs) == 2  # nothing past the unparseable head
+
+
+def test_publish_header_parity_with_serialise():
+    """The writev header + payload is byte-identical to the full codec
+    serialise for every hot shape, native and pure."""
+    from vernemq_tpu.protocol import codec_v5 as C5
+    from vernemq_tpu.protocol import fastpath as fp
+
+    rng = random.Random(13)
+    for _ in range(200):
+        fr = rand_publish(rng)
+        for v5, mod in ((False, C), (True, C5)):
+            want = mod.serialise(fr)
+            for native in (True, False):
+                saved = fp._force_pure
+                fp._force_pure = not native
+                try:
+                    hdr = fp.publish_header(
+                        fr.topic, fr.qos, fr.retain, fr.dup,
+                        fr.packet_id, len(fr.payload), v5)
+                finally:
+                    fp._force_pure = saved
+                assert hdr + fr.payload == want, (native, v5)
+
+
 def test_stale_extension_version_rejected():
     """A prebuilt .so older than REQUIRED_VERSION must not be used (its
     signatures would TypeError mid-parse); the loader rebuilds once and,

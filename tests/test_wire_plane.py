@@ -1,0 +1,417 @@
+"""Wire plane end-to-end: the QoS0 object-free fast path, iovec
+transport flush, byte identity with the native codec forcibly absent,
+and the wire.parse/wire.encode fault seam degrading to the pure codec.
+
+The frame-table/codec differential fuzz lives in test_native_codec.py;
+this file covers the broker-side behaviour of the plane.
+"""
+
+import asyncio
+import contextlib
+
+import pytest
+
+from vernemq_tpu.broker.config import Config
+from vernemq_tpu.broker.server import start_broker
+from vernemq_tpu.client import MQTTClient
+from vernemq_tpu.protocol import codec_v4, codec_v5, fastpath, wire
+from vernemq_tpu.protocol.types import (Connect, Publish, SubOpts,
+                                        Subscribe)
+
+
+@contextlib.contextmanager
+def pure_mode():
+    """Force the whole wire plane pure-Python — the native module
+    'forcibly absent' posture the build/CI satellite asserts against."""
+    saved = (codec_v4._C, codec_v5._C, fastpath._force_pure)
+    codec_v4._C = None
+    codec_v5._C = None
+    fastpath._force_pure = True
+    try:
+        yield
+    finally:
+        codec_v4._C, codec_v5._C, fastpath._force_pure = saved
+
+
+async def boot(**cfg):
+    cfg.setdefault("allow_anonymous", True)
+    cfg.setdefault("systree_enabled", False)
+    return await start_broker(Config(**cfg), port=0, node_name="wire")
+
+
+class Raw:
+    """Raw-socket MQTT endpoint: scripted bytes out, captured bytes in
+    (the byte-identity assertions need the exact stream, not frames)."""
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        self.buf = b""
+
+    @classmethod
+    async def connect(cls, port, client_id):
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        self = cls(r, w)
+        await self.send(codec_v4.serialise(Connect(
+            client_id=client_id, keepalive=0, clean_start=True)))
+        await self.read_frames(1)  # CONNACK
+        return self
+
+    async def send(self, data: bytes) -> None:
+        self.writer.write(data)
+        await self.writer.drain()
+
+    async def read_frames(self, n, timeout=5.0):
+        """Read until ``n`` complete frames are buffered; returns the
+        parsed frames (pure codec) WITHOUT consuming self.buf — the
+        captured stream stays intact for byte comparison."""
+        deadline = asyncio.get_event_loop().time() + timeout
+
+        def complete():
+            got, rest = 0, self.buf
+            while True:
+                split = wire.split_frame(rest)
+                if split is None:
+                    return got
+                got += 1
+                rest = split[3]
+
+        while complete() < n:
+            t = deadline - asyncio.get_event_loop().time()
+            if t <= 0:
+                raise asyncio.TimeoutError(
+                    f"wanted {n} frames, have {complete()}")
+            chunk = await asyncio.wait_for(self.reader.read(65536), t)
+            if not chunk:
+                break
+            self.buf += chunk
+        frames, rest = [], self.buf
+        saved, codec_v4._C = codec_v4._C, None
+        try:
+            while len(frames) < n:
+                f, rest = codec_v4.parse(rest)
+                assert f is not None
+                frames.append(f)
+        finally:
+            codec_v4._C = saved
+        return frames
+
+    def close(self):
+        self.writer.close()
+
+
+@pytest.mark.asyncio
+async def test_qos0_fast_path_delivers_with_zero_frame_objects():
+    """The acceptance spot test: a 1k-frame QoS0 batch admitted through
+    the fast path materialises ZERO Publish frames and ZERO Msg objects
+    broker-side, counts in wire_fastpath_pubs, and every payload is
+    delivered byte-correct."""
+    from vernemq_tpu.broker import message as message_mod
+
+    broker, server = await boot(observability_enabled=False)
+    try:
+        sub = await Raw.connect(server.port, "zsub")
+        await sub.send(codec_v4.serialise(Subscribe(
+            packet_id=1, topics=[("t/#", SubOpts(qos=0))])))
+        await sub.read_frames(2)  # CONNACK already buffered + SUBACK
+        sub_frames_before = 2
+
+        pub = await Raw.connect(server.port, "zpub")
+        n = 1000
+        blob = b"".join(
+            codec_v4.serialise(Publish(topic=f"t/{i % 8}",
+                                       payload=b"p%04d" % i, qos=0))
+            for i in range(n))
+        base_fast = fastpath.fastpath_pubs
+
+        counts = {"publish": 0, "msg": 0}
+        pub_init = Publish.__init__
+        msg_init = message_mod.Msg.__init__
+
+        def counting_pub(self, *a, **k):
+            counts["publish"] += 1
+            return pub_init(self, *a, **k)
+
+        def counting_msg(self, *a, **k):
+            counts["msg"] += 1
+            return msg_init(self, *a, **k)
+
+        Publish.__init__ = counting_pub
+        message_mod.Msg.__init__ = counting_msg
+        try:
+            await pub.send(blob)
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while (fastpath.fastpath_pubs - base_fast) < n:
+                assert asyncio.get_event_loop().time() < deadline, \
+                    fastpath.fastpath_pubs - base_fast
+                await asyncio.sleep(0.01)
+        finally:
+            Publish.__init__ = pub_init
+            message_mod.Msg.__init__ = msg_init
+        assert counts == {"publish": 0, "msg": 0}
+        assert fastpath.fastpath_pubs - base_fast == n
+        assert broker.metrics.value("mqtt_publish_received") >= n
+
+        frames = await sub.read_frames(sub_frames_before + n)
+        payloads = [f.payload for f in frames[sub_frames_before:]]
+        assert payloads == [b"p%04d" % i for i in range(n)]
+        # the gauge surface carries the counter
+        assert broker.registry.stats()["wire_fastpath_pubs"] >= n
+        sub.close()
+        pub.close()
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+async def _conversation(port):
+    """One scripted v4 conversation; returns (pub_stream, sub_stream)
+    byte captures."""
+    sub = await Raw.connect(port, "csub")
+    await sub.send(codec_v4.serialise(Subscribe(
+        packet_id=1, topics=[("t/#", SubOpts(qos=1))])))
+    await sub.read_frames(2)
+    pub = await Raw.connect(port, "cpub")
+    script = (
+        codec_v4.serialise(Publish(topic="t/a", payload=b"one", qos=0))
+        + codec_v4.serialise(Publish(topic="t/b", payload=b"two",
+                                     qos=0))
+        + codec_v4.serialise(Publish(topic="t/a", payload=b"three",
+                                     qos=1, packet_id=7))
+        + b"\xc0\x00"  # PINGREQ
+    )
+    await pub.send(script)
+    await pub.read_frames(1 + 1 + 1)  # CONNACK + PUBACK + PINGRESP
+    await sub.read_frames(2 + 3)      # + three PUBLISHes
+    pub_bytes, sub_bytes = pub.buf, sub.buf
+    pub.close()
+    sub.close()
+    return pub_bytes, sub_bytes
+
+
+@pytest.mark.asyncio
+async def test_wire_identical_with_native_forcibly_absent():
+    """The PR 7 byte-identity guarantee extended to the codec seam:
+    the same conversation yields the identical byte streams whether the
+    native codec serves or the pure-Python plane does (fast path ON in
+    both — the table walk itself is bit-identical)."""
+    broker, server = await boot()
+    try:
+        native_run = await _conversation(server.port)
+    finally:
+        await broker.stop()
+        await server.stop()
+    with pure_mode():
+        broker, server = await boot()
+        try:
+            pure_run = await _conversation(server.port)
+        finally:
+            await broker.stop()
+            await server.stop()
+    assert native_run == pure_run
+
+
+@pytest.mark.asyncio
+async def test_wire_identical_with_fastpath_disabled():
+    """wire_fastpath_enabled=off (every frame through the classic
+    handler) produces the same bytes as the fast path — and admits
+    nothing through it."""
+    broker, server = await boot()
+    try:
+        fast_run = await _conversation(server.port)
+    finally:
+        await broker.stop()
+        await server.stop()
+    base = fastpath.fastpath_pubs
+    broker, server = await boot(wire_fastpath_enabled=False)
+    try:
+        classic_run = await _conversation(server.port)
+        assert fastpath.fastpath_pubs == base  # nothing fast-admitted
+    finally:
+        await broker.stop()
+        await server.stop()
+    assert fast_run == classic_run
+
+
+@pytest.mark.asyncio
+async def test_wire_parse_fault_degrades_to_pure_never_drops():
+    """A wire.parse fault drill: native batch calls fail, the breaker
+    opens, every batch re-serves through the pure codec — zero lost
+    publishes, the connection survives, and the breaker recovers after
+    the drill."""
+    from vernemq_tpu.robustness import faults
+    from vernemq_tpu.robustness.breaker import CircuitBreaker
+    from vernemq_tpu.robustness.faults import FaultPlan, FaultRule
+
+    if fastpath.load_native() is None:
+        pytest.skip("native codec extension not built")
+    saved_breaker = fastpath.breaker
+    # test-scoped breaker: low threshold, backoff too long for a
+    # half-open probe to race the assertions
+    fastpath.breaker = CircuitBreaker(failure_threshold=2,
+                                      backoff_initial=60.0)
+    broker, server = await boot()
+    try:
+        sub = MQTTClient("127.0.0.1", server.port, client_id="fsub")
+        await sub.connect()
+        await sub.subscribe("f/#", qos=0)
+        pub = MQTTClient("127.0.0.1", server.port, client_id="fpub")
+        await pub.connect()
+        errs_before = fastpath.native_errors
+        faults.install(FaultPlan([FaultRule(point="wire.parse",
+                                            kind="error", count=100)]))
+        try:
+            for i in range(30):
+                await pub.publish("f/t", b"m%d" % i, qos=0)
+                # separate recv chunks → separate batches, so the
+                # failure run actually accumulates
+                await asyncio.sleep(0.005)
+            got = set()
+            for _ in range(30):
+                f = await sub.recv(5.0)
+                got.add(f.payload)
+            assert got == {b"m%d" % i for i in range(30)}
+        finally:
+            faults.clear()
+        assert fastpath.native_errors - errs_before >= 2
+        assert not fastpath.breaker.is_closed  # opened under the drill
+        assert fastpath.degraded_batches > 0  # open → pure served
+        st = broker.registry.stats()
+        assert st["wire_breaker_state"] > 0
+        # recovery: reset (the admin drill's exit) and the native path
+        # serves again
+        fastpath.breaker.reset()
+        nb = fastpath.native_batches
+        await pub.publish("f/t", b"back", qos=0)
+        assert (await sub.recv(5.0)).payload == b"back"
+        assert fastpath.native_batches > nb
+        await pub.close()
+        await sub.close()
+    finally:
+        fastpath.breaker = saved_breaker
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_complex_rows_fall_back_to_exact_msg_path():
+    """One complex recipient (a v5 subscriber) routes the whole fanout
+    through the classic Msg path: the v5 client gets a correct v5
+    frame, the v4 client its v4 frame — semantics over speed."""
+    broker, server = await boot()
+    try:
+        v4sub = MQTTClient("127.0.0.1", server.port, client_id="s4")
+        await v4sub.connect()
+        await v4sub.subscribe("c/#", qos=0)
+        v5sub = MQTTClient("127.0.0.1", server.port, client_id="s5",
+                           proto_ver=5)
+        await v5sub.connect()
+        await v5sub.subscribe("c/#", qos=0)
+        pub = MQTTClient("127.0.0.1", server.port, client_id="p4")
+        await pub.connect()
+        await pub.publish("c/x", b"mixed", qos=0)
+        assert (await v4sub.recv(5.0)).payload == b"mixed"
+        assert (await v5sub.recv(5.0)).payload == b"mixed"
+        # a v5 PUBLISHER with empty props is fast-admittable too
+        base = fastpath.fastpath_pubs
+        pub5 = MQTTClient("127.0.0.1", server.port, client_id="p5",
+                          proto_ver=5)
+        await pub5.connect()
+        await pub5.publish("c/y", b"from5", qos=0)
+        assert (await v4sub.recv(5.0)).payload == b"from5"
+        assert (await v5sub.recv(5.0)).payload == b"from5"
+        assert fastpath.fastpath_pubs > base
+        for c in (v4sub, v5sub, pub, pub5):
+            await c.close()
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_retained_publish_takes_classic_path():
+    """The retain bit excludes a frame from the fast path (flags != 0x30):
+    retained store semantics are exact."""
+    broker, server = await boot()
+    try:
+        pub = MQTTClient("127.0.0.1", server.port, client_id="rp")
+        await pub.connect()
+        await pub.publish("r/t", b"keep", qos=0, retain=True)
+        await asyncio.sleep(0.05)
+        sub = MQTTClient("127.0.0.1", server.port, client_id="rs")
+        await sub.connect()
+        await sub.subscribe("r/#", qos=0)
+        f = await sub.recv(5.0)
+        assert f.payload == b"keep" and f.retain
+        await pub.close()
+        await sub.close()
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_wire_metrics_and_stage_families_exposed():
+    """stage_wire_parse_ms / stage_wire_encode_ms exposition with HELP,
+    and the wire_* gauges, after real traffic."""
+    broker, server = await boot()
+    try:
+        sub = MQTTClient("127.0.0.1", server.port, client_id="ms")
+        await sub.connect()
+        await sub.subscribe("m/#", qos=0)
+        pub = MQTTClient("127.0.0.1", server.port, client_id="mp")
+        await pub.connect()
+        for i in range(5):
+            await pub.publish("m/t", b"x%d" % i, qos=0)
+        for _ in range(5):
+            await sub.recv(5.0)
+        text = broker.metrics.prometheus_text()
+        assert "# HELP stage_wire_parse_ms " in text
+        assert "# TYPE stage_wire_parse_ms histogram" in text
+        assert "# HELP stage_wire_encode_ms " in text
+        assert "# HELP wire_fastpath_pubs " in text
+        assert "# HELP wire_native_batches " in text
+        snap = broker.metrics.histogram_snapshot()
+        assert snap["stage_wire_parse_ms"][2] > 0  # observations landed
+        assert snap["stage_wire_encode_ms"][2] > 0
+        # $SYS scalar surface
+        allm = broker.metrics.all_metrics()
+        assert allm["stage_wire_parse_ms_count"] > 0
+        await pub.close()
+        await sub.close()
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_stream_transport_iovec_flush():  # async: write() schedules
+    # its flush on the running loop; the test then drives _flush by hand
+    """StreamTransport coalesces iovec chunks and flushes them as ONE
+    writelines tick, byte-identical to sequential writes."""
+    from vernemq_tpu.broker.server import StreamTransport
+
+    written = []
+
+    class W:
+        def write(self, data):
+            written.append(bytes(data))
+
+        def writelines(self, chunks):
+            written.append(b"".join(chunks))
+
+        def close(self):
+            pass
+
+    t = StreamTransport(W())
+    t.write(b"aa")
+    t.write_iov((b"bb", b"cc"))
+    t.write(b"dd")
+    assert written == []  # nothing until the scheduled flush
+    t._flush()
+    assert written == [b"aabbccdd"]
+    t._flush()  # empty flush is a no-op
+    assert written == [b"aabbccdd"]
+    t.write(b"ee")
+    t._flush()
+    assert written == [b"aabbccdd", b"ee"]

@@ -25,6 +25,15 @@ else
   EXTRA=(-m 'not slow')
 fi
 
+# best-effort native build (wire codec + kvstore/counters/fence): the
+# loaders build on demand anyway, but warming here keeps the first
+# test that touches the codec from paying the compile inside its own
+# timeout. Skips cleanly when no toolchain is present — every native
+# consumer has a bit-identical pure-Python fallback.
+if command -v g++ >/dev/null 2>&1 || command -v c++ >/dev/null 2>&1; then
+  make -C native >/dev/null 2>&1 || true
+fi
+
 # pre-test static gate: the unified vmqlint suite (tools/vmqlint) —
 # blocking calls in async bodies, metric-registry HELP/observe names,
 # lock discipline (no device/compile/IO under a threading lock),

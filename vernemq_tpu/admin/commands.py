@@ -1281,8 +1281,13 @@ def _breaker_show(broker, flags):
             else:
                 rows.append({"path": "predicate", "mountpoint": mp,
                              **st})
-    return {"table": rows or [{"path": "-", "mountpoint": "(none)",
-                               "state": "no matchers yet"}]}
+    # the wire-plane codec breaker is process-global (the native codec
+    # is process state, not per-mountpoint): one row, always present
+    from ..protocol import fastpath as _fastpath
+
+    rows.append({"path": "wire", "mountpoint": "(all)",
+                 **_fastpath.breaker.status()})
+    return {"table": rows}
 
 
 def _each_breaker(broker, flags):
@@ -1321,6 +1326,13 @@ def _each_breaker(broker, flags):
             # one engine-wide breaker (the predicate table is tiny):
             # no per-mountpoint granularity to select on
             yield "(all)", feng.breaker
+    if path in (None, "wire"):
+        if want is None:
+            # process-global codec breaker: trip pins every batch onto
+            # the pure-Python codec until reset (the keep-off drill)
+            from ..protocol import fastpath as _fastpath
+
+            yield "(all)", _fastpath.breaker
 
 
 def _schemas(broker):

@@ -287,6 +287,24 @@ class Broker:
             "faults_injected": "Faults raised by the active plan.",
             "faults_delayed": "Latency/hang faults applied by the "
                               "active plan.",
+            # wire plane (protocol/fastpath.py + native/codec.cc)
+            "wire_native_active": "1 while the native wire codec is "
+                                  "serving batch parse/encode (built, "
+                                  "enabled, breaker closed).",
+            "wire_native_batches": "Recv buffers batch-parsed by the "
+                                   "native frame-table builder.",
+            "wire_pure_batches": "Recv buffers batch-parsed by the "
+                                 "bit-identical pure-Python twin.",
+            "wire_native_errors": "Native codec calls that failed and "
+                                  "fed the wire breaker (the batch was "
+                                  "re-served by the pure codec).",
+            "wire_degraded_batches": "Batches served pure-Python while "
+                                     "the wire breaker was open.",
+            "wire_fastpath_pubs": "QoS0 publishes admitted through the "
+                                  "object-free wire fast path (no "
+                                  "frame/Msg objects materialised).",
+            "wire_breaker_state": "Wire-codec breaker state (0 closed, "
+                                  "1 half-open, 2 open).",
             # cluster delivery spool (cluster/spool.py): depth +
             # outstanding-ack gauges, published to $SYS/Prometheus
             "cluster_spool_depth_frames": "QoS>=1 cluster frames "

@@ -128,6 +128,13 @@ DEFAULTS: Dict[str, Any] = {
     # device flush waits at most this long for the matcher lock before
     # the whole flush serves from the host trie (0 = unbounded wait)
     "tpu_lock_busy_shed_ms": 500,
+    # wire plane (protocol/fastpath.py + native/codec.cc): the QoS0
+    # object-free fast path over the batched frame table. Off = every
+    # frame materialises and takes the classic session handler (the
+    # pre-wire-plane behaviour); the batch parser itself stays on
+    # either way (it is byte-identical). The NATIVE codec has its own
+    # escape hatch: the VMQ_NATIVE_CODEC=0 environment variable.
+    "wire_fastpath_enabled": True,
     # under load, up to this many full batch windows coalesce into ONE
     # device dispatch (match_many super-batches: K round trips -> 1,
     # the continuous-batching posture); 1 disables

@@ -94,6 +94,52 @@ def wire_v4_qos(msg: "Msg", pid: int) -> bytes:
     return bytes(buf)
 
 
+def wire_v4_iov_qos0(msg: "Msg") -> tuple:
+    """Writev-ready v4 QoS0 PUBLISH: ``(header, payload)`` with the
+    header cached on the Msg — the payload bytes object is shared
+    across every recipient's transport iovec and never copied into a
+    per-frame buffer (protocol/fastpath.py encode seam). Falls back to
+    the single cached frame when the header encoder refuses (so the
+    canonical codec error surfaces)."""
+    iov = getattr(msg, "_wire_v4_q0_iov", None)
+    if iov is None:
+        from ..protocol import fastpath
+        from ..protocol import topic as T
+
+        try:
+            hdr = fastpath.publish_header(
+                T.unword(list(msg.topic)), 0, bool(msg.retain), False,
+                None, len(msg.payload))
+        except ValueError:
+            return (wire_v4_qos0(msg),)
+        iov = msg._wire_v4_q0_iov = (hdr, msg.payload)
+    return iov
+
+
+def wire_v4_iov_qos(msg: "Msg", pid: int) -> tuple:
+    """Writev-ready v4 QoS>0 PUBLISH: per-recipient frames differ only
+    in the 2-byte packet id, which sits at the END of the header — so
+    the cached header template is patched per recipient and the shared
+    payload rides the iovec uncopied (the iov analog of
+    :func:`wire_v4_qos`)."""
+    tpl = getattr(msg, "_wire_v4_hdr_tpl", None)
+    if tpl is None:
+        from ..protocol import fastpath
+        from ..protocol import topic as T
+
+        try:
+            hdr = fastpath.publish_header(
+                T.unword(list(msg.topic)), msg.qos, bool(msg.retain),
+                False, pid, len(msg.payload))
+        except ValueError:
+            return (wire_v4_qos(msg, pid),)
+        msg._wire_v4_hdr_tpl = bytearray(hdr)
+        return (hdr, msg.payload)
+    tpl[-2] = (pid >> 8) & 0xFF
+    tpl[-1] = pid & 0xFF
+    return (bytes(tpl), msg.payload)
+
+
 def wire_v4_qos0(msg: "Msg") -> bytes:
     """The v4 QoS0 PUBLISH wire frame for ``msg``, cached on the Msg:
     identical for every v4 QoS0 recipient (no packet id, no props, no

@@ -32,9 +32,10 @@ import time
 from typing import TYPE_CHECKING, Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..models.trie import SubscriptionTrie
+from ..protocol import fastpath
 from ..protocol.topic import is_shared, unshare
 from ..protocol.types import PROTO_5, SubOpts
-from .message import Msg, SubscriberId, wire_v4_qos0
+from .message import Msg, SubscriberId, wire_v4_iov_qos0
 from .queue import OFFLINE, ONLINE, QueueOpts, SubscriberQueue
 from .subscriber_db import SubscriberDB, SubscriberRecord, opts_to_dict
 
@@ -816,6 +817,133 @@ class Registry:
         fut.add_done_callback(_done)
         return 0
 
+    def publish_wire_qos0(self, mountpoint: str,
+                          words: Tuple[str, ...], topic_str: str,
+                          payload: Optional[bytes],
+                          from_sid: Optional[SubscriberId],
+                          wire_frame: Optional[bytes] = None,
+                          payload_skip: int = 0,
+                          trace=None) -> int:
+        """The wire-plane QoS0 publish: route straight from frame-table
+        spans — no Msg, no Publish frame — for fanouts whose every
+        recipient is a plain local online lone-session v4 subscriber
+        with no delivery transform. Anything else (shared groups,
+        remote nodes, v5 receivers, offline queues, predicates,
+        QoS-upgrade) materialises ONE Msg and takes the classic
+        ``route_rows`` unchanged. With the batched view active the
+        match rides the collector's staging exactly like
+        ``publish_nowait`` (same submission-order guarantee, same
+        device/host fold seam); the trie view folds synchronously.
+        The session layer pre-gates retain/dup/auth/filters, so no
+        retain handling happens here. ``payload`` may be None when
+        ``wire_frame`` is given — it then lives at
+        ``wire_frame[payload_skip:]`` and is sliced out lazily only by
+        the branches that need it."""
+        if self.batched_view_active():
+            fut = self.broker.batch_collector().submit(
+                mountpoint, words, trace, feat=None)
+
+            def _done(f: "asyncio.Future") -> None:
+                exc = f.exception()
+                if exc is not None:
+                    self.broker.metrics.incr("mqtt_publish_error")
+                    return
+                self._wire_route(mountpoint, words, topic_str, payload,
+                                 f.result(), from_sid, wire_frame,
+                                 payload_skip)
+                if trace is not None:
+                    trace.stamp("route")
+                    self.broker.recorder.finish(trace)
+
+            fut.add_done_callback(_done)
+            return 0
+        n = self._wire_route(mountpoint, words, topic_str, payload,
+                             self.trie(mountpoint).match(list(words)),
+                             from_sid, wire_frame, payload_skip)
+        if trace is not None:
+            trace.stamp("route")
+            self.broker.recorder.finish(trace)
+        return n
+
+    def _wire_route(self, mountpoint: str, words: Tuple[str, ...],
+                    topic_str: str, payload: Optional[bytes], rows,
+                    from_sid: Optional[SubscriberId],
+                    wire_frame: Optional[bytes] = None,
+                    payload_skip: int = 0) -> int:
+        """Classify the fold result: if EVERY matched row is the plain
+        fast shape, write the shared wire bytes to each recipient's
+        transport (verbatim inbound span for v4 publishers, one
+        native-encoded header + shared payload iovec otherwise) —
+        the object-free half of the wire plane. One complex row routes
+        the whole fanout through the classic Msg path for exact
+        semantics."""
+        rows = list(rows)
+        cfg = self.broker.config
+        upgrade = cfg.upgrade_outgoing_qos
+        sessions: List[Any] = []
+        fast = True
+        for _f, key, opts in rows:
+            if not (isinstance(key, tuple) and len(key) == 2):
+                fast = False  # $g group row or remote node pointer
+                break
+            if opts.no_local and key == from_sid:
+                continue
+            if (getattr(opts, "filter_expr", None)
+                    or getattr(opts, "subscription_id", None)
+                    or (upgrade and opts.qos > 0)):
+                fast = False
+                break
+            q = self.queues.get(key)
+            if q is None:
+                continue
+            if q.state is not ONLINE or len(q.sessions) != 1:
+                fast = False  # offline backlog / multi-session queue
+                break
+            sess = next(iter(q.sessions))
+            # getattr defaults: non-Session consumers (bridge
+            # endpoints) classify complex, same as the classic fan0
+            # collection
+            if getattr(sess, "closed", True) \
+                    or getattr(sess, "proto_ver", PROTO_5) == PROTO_5:
+                fast = False
+                break
+            sessions.append(sess)
+        if fast:
+            n = len(sessions)
+            if n:
+                m = self.broker.metrics
+                t0 = time.monotonic()
+                if wire_frame is not None:
+                    nbytes = len(wire_frame)
+                    for sess in sessions:
+                        sess.transport.write(wire_frame)
+                else:
+                    hdr = fastpath.publish_header(
+                        topic_str, 0, False, False, None, len(payload))
+                    iov = (hdr, payload)
+                    nbytes = len(hdr) + len(payload)
+                    for sess in sessions:
+                        sess.transport.write_iov(iov)
+                m.observe("stage_wire_encode_ms",
+                          (time.monotonic() - t0) * 1e3)
+                self.fanout_fast_pubs += 1
+                m.incr("queue_message_in", n)
+                m.incr("queue_message_out", n)
+                m.incr("bytes_sent", nbytes * n)
+                m.incr("mqtt_publish_sent", n)
+                m.incr("router_matches_local", n)
+            return n
+        # complex fanout: ONE Msg, the exact classic path (host
+        # predicate phase included — a racing filter subscription must
+        # still filter). The payload materialises HERE, lazily, when
+        # the fast fanout didn't need it as separate bytes.
+        if payload is None:
+            payload = wire_frame[payload_skip:]
+        msg = Msg(topic=tuple(words), payload=payload, qos=0,
+                  mountpoint=mountpoint)
+        return self.route_rows(msg, self._filter_rows_host(msg, rows),
+                               from_sid)
+
     def _pre_publish(self, msg: Msg) -> Msg:
         cfg = self.broker.config
         if not self.broker.cluster_ready() and not cfg.allow_publish_during_netsplit:
@@ -963,7 +1091,9 @@ class Registry:
         delivery path at fanout — profiled at 36%). Semantics match the
         queue path exactly for the collected class of recipients
         (online, lone session, v4, no transform, no tracing)."""
-        data = wire_v4_qos0(msg)
+        t0 = time.monotonic()
+        iov = wire_v4_iov_qos0(msg)
+        nbytes = sum(len(c) for c in iov)
         handlers = self.broker.hooks.handlers("on_deliver")
         delivered = 0
         for sess in sessions:
@@ -981,14 +1111,16 @@ class Registry:
                         asyncio.ensure_future(res)
                 except Exception:
                     log.exception("on_deliver hook failed")
-            sess.transport.write(data)
+            sess.transport.write_iov(iov)
             delivered += 1
         if delivered:
+            self.broker.metrics.observe(
+                "stage_wire_encode_ms", (time.monotonic() - t0) * 1e3)
             self.fanout_fast_pubs += 1
             m = self.broker.metrics
             m.incr("queue_message_in", delivered)
             m.incr("queue_message_out", delivered)
-            m.incr("bytes_sent", delivered * len(data))
+            m.incr("bytes_sent", delivered * nbytes)
             m.incr("mqtt_publish_sent", delivered)
 
     def _publish_shared(
@@ -1110,6 +1242,9 @@ class Registry:
         from ..robustness import faults as _faults
 
         out.update(_faults.stats())
+        # wire plane (protocol/fastpath.py): native-vs-pure batch split,
+        # codec breaker state, object-free admissions
+        out.update(fastpath.stats())
         return out
 
     def fold_subscriptions(self, mountpoint: str = ""):

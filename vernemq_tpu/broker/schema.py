@@ -98,6 +98,12 @@ FLAT_ALIASES.update({
     "mesh.native": "tpu_mesh_native",
 })
 
+#: extension family: the native wire plane (protocol/fastpath.py) —
+#: same dotted-tree discipline
+FLAT_ALIASES.update({
+    "wire.fastpath_enabled": "wire_fastpath_enabled",
+})
+
 #: extension family: payload filtering & windowed aggregation
 #: (vernemq_tpu/filters/) — the MQTT+ predicate/aggregate surface;
 #: schema DEFINITIONS are replicated state (`vmq-admin schema set` /

@@ -12,9 +12,10 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Optional, Tuple
 
-from ..protocol import codec_v4, codec_v5, wire
+from ..protocol import codec_v4, codec_v5, fastpath, wire
 from ..protocol.types import (
     PROTO_5,
     RC_PACKET_TOO_LARGE,
@@ -33,37 +34,52 @@ MAX_FRAME_SIZE = 268435455
 
 class StreamTransport(Transport):
     """Write-coalescing wrapper over an asyncio StreamWriter: session
-    writes within one loop tick append to ONE buffer that the flush
-    cuts loose as a single transport write (writev-style — one
+    writes within one loop tick collect into ONE iovec (a chunk list)
+    that the flush hands to ``writelines`` — one C-level join + one
     syscall-bound send per loop iteration, however many small
-    PUBACK/PUBLISH frames landed in it)."""
+    PUBACK/PUBLISH frames landed in it. Compared to the previous
+    single-bytearray coalescer this removes the per-write append copy
+    entirely: a fanout's shared payload bytes object is referenced from
+    every recipient's iovec and only touched once, inside the
+    transport's join. The list swap at flush keeps the PR 7
+    swap-not-copy behaviour whether or not the native encoder is
+    present."""
 
     def __init__(self, writer: asyncio.StreamWriter):
         self._writer = writer
-        self._buf = bytearray()
+        self._chunks: list = []
         self._flush_scheduled = False
         self.closed = False
 
     def write(self, data: bytes) -> None:
         if self.closed:
             return
-        self._buf += data
+        self._chunks.append(data)
+        if not self._flush_scheduled:
+            self._flush_scheduled = True
+            asyncio.get_event_loop().call_soon(self._flush)
+
+    def write_iov(self, chunks) -> None:
+        """Queue a writev-ready iovec (e.g. the native encoder's
+        (header, payload) pair) without assembling a per-frame bytes
+        object."""
+        if self.closed:
+            return
+        self._chunks.extend(chunks)
         if not self._flush_scheduled:
             self._flush_scheduled = True
             asyncio.get_event_loop().call_soon(self._flush)
 
     def _flush(self) -> None:
         self._flush_scheduled = False
-        if self.closed or not self._buf:
+        if self.closed or not self._chunks:
             return
-        # single buffer cut: swap a fresh buffer in and hand the full
-        # coalesced bytearray to the transport as-is (bytes-like, never
-        # mutated again). The old path re-copied every flushed byte
-        # (bytes(buf) then clear) on top of the per-frame append — at
-        # small-frame fanout rates that copy, not the append, dominated
-        buf, self._buf = self._buf, bytearray()
+        chunks, self._chunks = self._chunks, []
         try:
-            self._writer.write(buf)
+            if len(chunks) == 1:
+                self._writer.write(chunks[0])
+            else:
+                self._writer.writelines(chunks)
         except Exception:
             self.closed = True
 
@@ -208,46 +224,91 @@ async def mqtt_connection(
             return
 
         # ---- steady-state frame loop ---------------------------------
+        # The wire plane (protocol/fastpath.py): each buffered chunk is
+        # batch-parsed into a packed frame table in ONE call (native
+        # codec when built, bit-identical pure-Python twin otherwise).
+        # Admitted QoS0 PUBLISHes flow from the table straight into the
+        # routing fanout without materialising frame/Msg objects
+        # (session.wire_publish_qos0); every other record — acks,
+        # QoS>=1, protocol edges, malformed input — materialises its
+        # frame object and takes the classic handler unchanged.
         buf = bytes(rest)
         frames_run = 0
+        v5 = codec is codec_v5
+        rec_size = fastpath.REC_SIZE
+        unpack_rec = fastpath.REC.unpack_from
         while not session.closed:
-            view = memoryview(buf)
-            while True:
+            if buf:
+                t0 = time.monotonic()
+                table, nrec, consumed = fastpath.parse_batch(
+                    buf, max_frame_size, v5)
+                metrics.observe("stage_wire_parse_ms",
+                                (time.monotonic() - t0) * 1e3)
+                fast_gate = nrec > 0 and session.wire_fast_ready()
+                fast_pubs = 0
                 try:
-                    frame, view = codec.parse(view, max_frame_size)
-                except ParseError as e:
-                    if e.reason == "frame_too_large":
-                        # the metric monitoring keys on, now that the
-                        # parser (not the session payload check) is the
-                        # enforcement point
-                        metrics.incr("mqtt_invalid_msg_size_error")
-                        if session.proto_ver == PROTO_5 \
-                                and not session.closed:
-                            # tell a v5 client WHY before dropping the
-                            # socket (MQTT5 3.2.2.3.6 / DISCONNECT 0x95)
-                            await session._disconnect_v5(
-                                RC_PACKET_TOO_LARGE)
-                    raise
-                if frame is None:
-                    break
-                await session.handle_frame(frame)
+                    for off in range(0, nrec * rec_size, rec_size):
+                        rec = unpack_rec(table, off)
+                        if (fast_gate and rec[0] == fastpath.K_PUB0
+                                and rec[1] == 0x30
+                                and session.wire_publish_qos0(buf, rec)):
+                            fast_pubs += 1
+                        else:
+                            try:
+                                frame = fastpath.materialize(
+                                    codec, buf, rec, max_frame_size)
+                            except ParseError as e:
+                                if e.reason == "frame_too_large":
+                                    # the metric monitoring keys on,
+                                    # now that the parser (not the
+                                    # session payload check) is the
+                                    # enforcement point
+                                    metrics.incr(
+                                        "mqtt_invalid_msg_size_error")
+                                    if session.proto_ver == PROTO_5 \
+                                            and not session.closed:
+                                        # tell a v5 client WHY before
+                                        # dropping the socket (MQTT5
+                                        # 3.2.2.3.6 / DISCONNECT 0x95)
+                                        await session._disconnect_v5(
+                                            RC_PACKET_TOO_LARGE)
+                                raise
+                            await session.handle_frame(frame)
+                            if session.closed:
+                                break
+                            # every classic frame is an await — policy
+                            # (governor level, hooks, tracer) may have
+                            # moved while we yielded, so the remaining
+                            # fast records must re-pass the gate
+                            fast_gate = (fast_gate
+                                         and session.wire_fast_ready())
+                        frames_run += 1
+                        if frames_run >= 64:
+                            # bound the synchronous run per read chunk:
+                            # a 64KB chunk can hold ~700 small
+                            # PUBLISHes, and a handler that never truly
+                            # awaits would process them all in ONE loop
+                            # callback — a flood connection must not
+                            # stall every other session's IO (and the
+                            # sysmon sampler) for the whole chunk
+                            frames_run = 0
+                            await asyncio.sleep(0)
+                            if session.closed:  # closed while yielded
+                                break
+                            # re-check the batch gate after yielding:
+                            # the governor/hooks may have moved while
+                            # we slept
+                            fast_gate = (fast_gate
+                                         and session.wire_fast_ready())
+                finally:
+                    # a mid-batch error (malformed frame after admitted
+                    # publishes) must not lose the bookkeeping for
+                    # fast-path messages already routed and delivered
+                    if fast_pubs:
+                        session.wire_fast_done(fast_pubs)
                 if session.closed:
                     break
-                frames_run += 1
-                if frames_run >= 64:
-                    # bound the synchronous run per read chunk: a 64KB
-                    # chunk can hold ~700 small PUBLISHes, and a handler
-                    # that never truly awaits would process them all in
-                    # ONE loop callback — a flood connection must not
-                    # stall every other session's IO (and the sysmon
-                    # sampler) for the whole chunk
-                    frames_run = 0
-                    await asyncio.sleep(0)
-                    if session.closed:  # closed while we yielded
-                        break
-            buf = bytes(view)
-            if session.closed:
-                break
+                buf = buf[consumed:] if consumed else buf
             if session.connected:
                 chunk = await read_chunk()
             else:

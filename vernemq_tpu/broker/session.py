@@ -130,6 +130,11 @@ class Session:
         self.auth_method: Optional[str] = None
         self._in_enhanced_auth = False
         self._pending_connect: Optional[Connect] = None
+        # wire fast path (protocol/fastpath.py): per-connection topic
+        # admission cache — raw topic bytes -> (words, topic_str), so a
+        # telemetry stream repeating a handful of topics validates each
+        # once and admits the rest with zero frame/Msg objects
+        self._wire_topic_cache: Dict[bytes, Tuple[Tuple[str, ...], str]] = {}
 
     # ------------------------------------------------------------------ IO
 
@@ -668,6 +673,124 @@ class Session:
             self.send(Pubrec(packet_id=f.packet_id))
             self.broker.metrics.incr("mqtt_pubrec_sent")
 
+    # ------------------------------------------------- wire fast path
+
+    def wire_fast_ready(self) -> bool:
+        """Batch-level gate for the QoS0 wire fast path: True only when
+        NO per-publish Python edge applies — no tracer, no per-publish
+        auth/deliver hooks, no rate limit, governor idle, cluster
+        ready, no payload predicates on this mountpoint. Checked once
+        per parsed batch (and re-checked after cooperative yields);
+        anything that needs per-frame policy falls back to the classic
+        handler frame by frame."""
+        if not self.connected or self.closed:
+            return False
+        b = self.broker
+        cfg = b.config
+        if not cfg.get("wire_fastpath_enabled", True):
+            return False
+        if b.tracer is not None or cfg.max_message_rate:
+            return False
+        gov = b.overload
+        if gov is not None:
+            if gov.level > 0:
+                return False
+        elif b.sysmon is not None and b.sysmon.overloaded:
+            return False
+        h = b.hooks
+        if (h.has("auth_on_publish") or h.has("auth_on_publish_m5")
+                or h.has("on_publish") or h.has("on_deliver")):
+            return False
+        if not b.cluster_ready() \
+                and not cfg.allow_publish_during_netsplit:
+            return False
+        eng = getattr(b, "filter_engine", None)
+        if eng is not None and eng.wants(self.mountpoint):
+            return False
+        return True
+
+    def wire_publish_qos0(self, buf, rec) -> bool:
+        """Admit one QoS0 PUBLISH straight from the frame table:
+        topic resolved through the per-connection cache, payload sliced
+        once, fanout written as shared wire bytes — no Publish frame,
+        no Msg, no property dict on this path. Returns False when the
+        frame needs classic handling (uncached-invalid topic, codec
+        edge); the caller materialises it then."""
+        _k, b0, _pid, f_off, f_end, t_off, t_len, p_off = rec
+        b = self.broker
+        cache = self._wire_topic_cache
+        key = bytes(buf[t_off:t_off + t_len])
+        ent = cache.get(key)
+        if ent is None:
+            try:
+                topic_str = key.decode("utf-8")
+            except UnicodeDecodeError:
+                return False  # codec raises the canonical invalid_utf8
+            if "\x00" in topic_str:
+                return False  # canonical no_null_allowed
+            try:
+                words = tuple(T.validate_topic("publish", topic_str))
+            except T.TopicError:
+                return False  # classic close("invalid_topic")
+            ent = (words, topic_str)
+            # bounded by entries AND entry size: topics run up to 64KB
+            # and each entry holds ~3 copies — a publisher minting
+            # large distinct topics must not pin O(100MB) per
+            # connection. Long topics still fast-path, just uncached
+            # (the cache pays off for short repeated telemetry names).
+            if len(key) <= 1024:
+                if len(cache) >= 512:
+                    cache.clear()
+                cache[key] = ent
+        words, topic_str = ent
+        trace = b.recorder.admit(self.client_id, topic_str, 0)
+        if trace is not None:
+            trace.stamp("admit")
+        # a v4 QoS0 frame with flags 0 forwards VERBATIM: the inbound
+        # span IS the outbound frame for every fast recipient — the
+        # payload is NOT copied separately (the dominant cost this
+        # path removes); the route slices it out of the span lazily
+        # only on the complex-row fallback. A v5 inbound frame carries
+        # the extra property-length byte, so those pass the payload
+        # and re-encode a header instead.
+        if self.proto_ver != PROTO_5:
+            span = bytes(buf[f_off:f_end])
+            payload = None
+            pskip = p_off - f_off
+        else:
+            span = None
+            payload = bytes(buf[p_off:f_end])
+            pskip = 0
+        try:
+            b.registry.publish_wire_qos0(
+                self.mountpoint, words, topic_str, payload, self.sid,
+                wire_frame=span, payload_skip=pskip, trace=trace)
+        except RuntimeError as e:
+            b.metrics.incr("mqtt_publish_error")
+            if e.args != ("not_ready",):
+                log.exception("wire publish routing failed for %s",
+                              self.sid)
+            return True  # handled: QoS0 owes no ack (classic parity)
+        except Exception:
+            b.metrics.incr("mqtt_publish_error")
+            log.exception("wire publish routing failed for %s", self.sid)
+            return True
+        return True
+
+    def wire_fast_done(self, n: int) -> None:
+        """Batch-level bookkeeping for ``n`` fast-admitted publishes
+        (classic path does these per frame)."""
+        self.last_activity = time.monotonic()
+        b = self.broker
+        b.metrics.incr("mqtt_publish_received", n)
+        if b.overload is not None:
+            # the heaviest-talker signal keeps integrating even though
+            # the fast path never parks (it only runs at level 0)
+            b.overload.record_publish_n(self.sid, n)
+        from ..protocol import fastpath
+
+        fastpath.fastpath_pubs += n
+
     async def _route(self, msg: Msg, nowait: bool = False,
                      trace=None) -> int:
         """Route via the registry; returns match count, or -1 on an internal
@@ -834,17 +957,17 @@ class Session:
             # v4 fanout fast path: across recipients the frame is
             # identical (QoS0: no packet id, no props, no per-session
             # alias state) or differs only in the 2-byte packet id
-            # (QoS1/2) — serialise once per Msg and reuse/patch the
-            # cached bytes instead of re-running the codec per recipient
-            # (the analog of the reference serialising in vmq_mqtt_fsm
-            # once per frame, but across recipients)
-            from .message import wire_v4_qos, wire_v4_qos0
+            # (QoS1/2) — one cached header per Msg, the shared payload
+            # rides the transport iovec uncopied (the analog of the
+            # reference serialising in vmq_mqtt_fsm once per frame, but
+            # across recipients, minus the per-recipient payload copy)
+            from .message import wire_v4_iov_qos, wire_v4_iov_qos0
 
-            data = (wire_v4_qos0(msg) if pid is None
-                    else wire_v4_qos(msg, pid))
-            self.transport.write(data)
+            iov = (wire_v4_iov_qos0(msg) if pid is None
+                   else wire_v4_iov_qos(msg, pid))
+            self.transport.write_iov(iov)
             m = self.broker.metrics
-            m.incr("bytes_sent", len(data))
+            m.incr("bytes_sent", sum(len(c) for c in iov))
             m.incr("mqtt_publish_sent")
             return
         if self.proto_ver == PROTO_5:
@@ -1243,6 +1366,13 @@ class Transport:
 
     def write(self, data: bytes) -> None:  # pragma: no cover - interface
         raise NotImplementedError
+
+    def write_iov(self, chunks) -> None:
+        """Write a writev-ready iovec. Transports that can scatter
+        (StreamTransport) override; the default join keeps framing
+        transports (websocket, test fixtures) seeing ONE contiguous
+        write per frame — byte-identical on the wire either way."""
+        self.write(b"".join(chunks))
 
     def close(self) -> None:  # pragma: no cover - interface
         raise NotImplementedError

@@ -93,6 +93,15 @@ STAGE_FAMILIES: List[Tuple[str, str]] = [
      "host-side (breaker-open/degraded, sub-threshold, or "
      "unrepresentable-escape pairs; the device-vs-host comparison "
      "base for bench config 13)."),
+    ("stage_wire_parse_ms",
+     "Wire-plane batch parse latency: one recv buffer -> packed frame "
+     "table call (native codec or pure-Python twin), observed PER "
+     "BATCH, not per frame (protocol/fastpath.py parse_batch)."),
+    ("stage_wire_encode_ms",
+     "Wire-plane fanout encode+write latency: one PUBLISH fanout's "
+     "iovec build and per-recipient transport writes, observed PER "
+     "FANOUT (the writev-ready encode seam; informs the wire "
+     "fast-path share vs the classic Msg path)."),
 ]
 
 _ENABLED = True

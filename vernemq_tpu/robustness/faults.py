@@ -70,6 +70,13 @@ KNOWN_POINTS: Dict[str, str] = {
         "message-store writes (storage/msg_store.py)",
     "listener.bind":
         "listener (re)bind (broker/listeners.py)",
+    "wire.parse":
+        "native wire-codec batch parse (protocol/fastpath.py "
+        "parse_batch): a fault degrades the batch to the bit-identical "
+        "pure-Python codec, never drops the connection",
+    "wire.encode":
+        "native wire-codec fanout header encode (protocol/fastpath.py "
+        "publish_header): a fault degrades to the pure-Python encoder",
 }
 
 

@@ -460,6 +460,15 @@ class OverloadGovernor:
         if sid is not None:
             self._talker_counts[sid] = self._talker_counts.get(sid, 0) + 1
 
+    def record_publish_n(self, sid: Any, n: int) -> None:
+        """Batched talker accounting for the wire fast path: admitted
+        QoS0 batches bypass publish_delay (the path only runs at level
+        0), but the heaviest-talker signal must keep integrating — L3's
+        top-N pick and the L1 proportional factor read these rates the
+        moment pressure arrives."""
+        if sid is not None and self.mode == "governor":
+            self._talker_counts[sid] = self._talker_counts.get(sid, 0) + n
+
     def _fold_talkers(self, dt: float) -> None:
         """Fold this tick's per-sid publish counts into rate estimates.
         Asymmetric: rates ratchet UP fast but decay slowly — tracked
