@@ -113,6 +113,19 @@ def stage_breakdown(before):
     return out
 
 
+def events_during_drill(t0_mono):
+    """Control-plane journal excerpt for a drill window: every event
+    emitted since ``t0_mono`` as compact (t_rel_s, code, detail) rows —
+    the fault/stall storm artifacts finally record WHAT the broker did
+    (breaker opened at +0.8s, watchdog abandoned at +1.1s, recovery
+    closed at +4.2s), not just the resulting percentiles."""
+    from vernemq_tpu.observability import events as _events
+
+    return [{"t_rel_s": round(e["t"] - t0_mono, 4), "code": e["code"],
+             "detail": e["detail"], "value": e["value"]}
+            for e in _events.journal().snapshot(since=t0_mono)]
+
+
 def observability_overhead_probe(wb, reps=40):
     """The acceptance overhead guard: publish p50 through the
     PRODUCTION match path (TpuMatcher.match_batch — the seam the stage
@@ -126,18 +139,20 @@ def observability_overhead_probe(wb, reps=40):
     wb.m.match_batch(topics)
     # INTERLEAVED on/off reps: two sequential blocks would attribute
     # clock drift / cache-state luck to the flag — alternating pairs
-    # measure only the flag's own cost
+    # measure only the flag's own cost. The WITHIN-pair order also
+    # alternates: a fixed off-then-on order turns any monotonic drift
+    # (thermal, a co-tenant waking up mid-run) into a systematic
+    # pro-"on" bias — observed as a ±10% swing on identical code on a
+    # busy smoke box — whereas alternating cancels it to first order
     lat_on, lat_off = [], []
     try:
-        for _ in range(reps):
-            hist.set_enabled(False)
-            t0 = time.perf_counter()
-            wb.m.match_batch(topics)
-            lat_off.append((time.perf_counter() - t0) * 1e3)
-            hist.set_enabled(True)
-            t0 = time.perf_counter()
-            wb.m.match_batch(topics)
-            lat_on.append((time.perf_counter() - t0) * 1e3)
+        for i in range(reps):
+            order = ((False, lat_off), (True, lat_on))
+            for flag, sink in (order if i % 2 == 0 else order[::-1]):
+                hist.set_enabled(flag)
+                t0 = time.perf_counter()
+                wb.m.match_batch(topics)
+                sink.append((time.perf_counter() - t0) * 1e3)
     finally:
         hist.set_enabled(True)
     off = float(np.percentile(lat_off, 50))
@@ -650,7 +665,7 @@ def config6_fault_storm(jax_mod, rng, n_subs, batch, smoke):
     m = TpuMatcher(max_levels=8,
                    initial_capacity=1 << (n - 1).bit_length())
     m.breaker = CircuitBreaker(failure_threshold=3, backoff_initial=0.05,
-                               backoff_max=0.4)
+                               backoff_max=0.4, name="match")
     trie = SubscriptionTrie()
     for i in range(n):
         f = [f"r{i % 64}", f"d{i % 257}",
@@ -689,6 +704,7 @@ def config6_fault_storm(jax_mod, rng, n_subs, batch, smoke):
         return lats, bad
 
     healthy, _ = run_phase()
+    t_drill = time.monotonic()
     faults.install(faults.FaultPlan(
         [faults.FaultRule("device.*", kind="error")], seed=1))
     degraded, bad = run_phase(check_parity=True)
@@ -725,6 +741,9 @@ def config6_fault_storm(jax_mod, rng, n_subs, batch, smoke):
         "parity_ok": bad == 0,
         "device_recovery_s": (round(recovery_s, 3)
                               if recovery_s is not None else None),
+        # what the broker DID during the drill (breaker transitions on
+        # this matcher's journal, time-relative to fault install)
+        "events_during_drill": events_during_drill(t_drill),
     }
 
 
@@ -1081,6 +1100,7 @@ def config10_stall_storm(smoke):
         # the storm: EVERY device dispatch wedges (probability 1); the
         # breaker gate bounds how many dispatches actually block —
         # after it opens the trie serves directly
+        t_drill = time.monotonic()
         faults.install(faults.FaultPlan(
             [faults.FaultRule("device.dispatch", kind="wedge")], seed=10))
         storm_lat = []
@@ -1139,6 +1159,10 @@ def config10_stall_storm(smoke):
             "healthy_lat": healthy_lat, "storm_lat": storm_lat,
             "device_recovery_s": (round(recovery_s, 3)
                                   if recovery_s is not None else None),
+            # the stall storm's control-plane timeline: stall →
+            # abandon → breaker open → late discard → probe → close,
+            # time-relative to wedge install
+            "events_during_drill": events_during_drill(t_drill),
         }
         await sub.close()
         await pub.close()
@@ -1187,6 +1211,7 @@ def config10_stall_storm(smoke):
         await pub.connect()
 
         # half-open: inbound (frames AND acks) dropped, channel "up"
+        t_drill = time.monotonic()
         faults.install(faults.FaultPlan(
             [faults.FaultRule("cluster.recv", kind="error")], seed=12))
         for i in range(n_msgs):
@@ -1230,6 +1255,9 @@ def config10_stall_storm(smoke):
             "replay_s": round(replay_s, 3),
             "missing": len(expect - set(got)),
             "duplicates": sum(c - 1 for c in got.values()),
+            # ack-stall detect → channel cycle → spool replay, on the
+            # journal's clock (both in-process nodes share it)
+            "events_during_drill": events_during_drill(t_drill),
         }
 
     dev = asyncio.run(device_segment())

@@ -256,6 +256,70 @@ async def test_predicate_and_aggregate_metrics_exposed():
 
 
 @pytest.mark.asyncio
+async def test_event_and_canary_families_exposed():
+    """The control-plane event journal and the canary probe are
+    first-class metric families: every registered event code exposes an
+    event_<code> counter gauge with non-empty HELP (derived from
+    events.KNOWN_EVENTS — a new code cannot ship without HELP), the
+    journal totals and canary gauges scrape, and the e2e_canary_ms
+    histogram carries proper HELP/TYPE with cumulative buckets (the
+    generic family test covers its bucket discipline; this one proves
+    the canary family is registered at all and counts real probes)."""
+    import asyncio
+
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.observability import events
+
+    cfg = Config(systree_enabled=False, allow_anonymous=True,
+                 canary_enabled=True, canary_interval_ms=40)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        events.journal().reset()
+        events.emit("breaker_open", detail="match")
+        text = broker.metrics.prometheus_text(node=broker.node_name)
+        am = broker.metrics.all_metrics()
+        names = ([f"event_{c}" for c in events.KNOWN_EVENTS]
+                 + ["events_emitted", "events_dropped",
+                    "canary_probes", "canary_received",
+                    "canary_slo_breaches", "canary_timeouts"])
+        for name in names:
+            help_line = next(
+                (line for line in text.splitlines()
+                 if line.startswith(f"# HELP {name} ")), None)
+            assert help_line is not None, f"{name} has no HELP"
+            assert len(help_line) > len(f"# HELP {name} "), \
+                f"{name} HELP text empty"
+            assert f"# TYPE {name} gauge" in text, name
+            assert name in am, f"{name} missing from $SYS metrics"
+        assert am["event_breaker_open"] == 1.0
+        assert am["events_emitted"] == 1.0
+        # the canary histogram family: HELP/TYPE + cumulative buckets
+        # fed by real loopback probes
+        deadline = asyncio.get_event_loop().time() + 15
+        while (broker.canary.received < 1
+               and asyncio.get_event_loop().time() < deadline):
+            await asyncio.sleep(0.05)
+        text = broker.metrics.prometheus_text(node=broker.node_name)
+        assert "# HELP e2e_canary_ms " in text
+        assert "# TYPE e2e_canary_ms histogram" in text
+        buckets = [int(m.group(2)) for m in re.finditer(
+            r'^e2e_canary_ms_bucket{[^}]*le="([^"]+)"} (\d+)$',
+            text, re.M)]
+        assert buckets and buckets == sorted(buckets)
+        count = int(re.search(r"^e2e_canary_ms_count{[^}]*} (\d+)$",
+                              text, re.M).group(1))
+        assert buckets[-1] == count >= 1
+        assert broker.metrics.all_metrics()["canary_probes"] >= 1
+    finally:
+        from vernemq_tpu.observability import histogram as hist
+        hist.reset_all()
+        events.journal().reset()
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_histogram_families_exposed_and_consistent():
     """Stage latency histograms are first-class Prometheus families:
     HELP/TYPE present for every STAGE_FAMILIES entry, bucket counts

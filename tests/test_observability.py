@@ -15,9 +15,11 @@ import time
 
 import pytest
 
-from vernemq_tpu.observability import chrome_trace, histogram as hist
+from vernemq_tpu.observability import chrome_trace, events, \
+    histogram as hist
 from vernemq_tpu.observability.profiler import profiler
-from vernemq_tpu.observability.recorder import FlightRecorder, PublishTrace
+from vernemq_tpu.observability.recorder import ClockSync, FlightRecorder, \
+    PublishTrace
 
 
 @pytest.fixture(autouse=True)
@@ -25,6 +27,7 @@ def _clean_registry():
     hist.set_enabled(True)
     hist.reset_all()
     profiler().reset()
+    events.journal().reset()
     yield
     hist.set_enabled(True)
 
@@ -492,3 +495,594 @@ async def test_graphite_lines_include_histogram_percentiles():
         await server.stop()
         gserver.close()
         await gserver.wait_closed()
+
+
+# ------------------------------------------------------------ event journal
+
+
+def test_event_journal_emit_snapshot_filters_and_bound():
+    j = events.journal()
+    j.emit("breaker_open", detail="match", value=3.0)
+    j.emit("breaker_close", detail="match")
+    j.emit("overload_level_enter", detail="throttle", value=1.0)
+    evs = j.snapshot()
+    assert [e["code"] for e in evs] == [
+        "breaker_open", "breaker_close", "overload_level_enter"]
+    assert evs[0]["detail"] == "match" and evs[0]["value"] == 3.0
+    assert evs[0]["pid"] == os.getpid()
+    # code filter + since cursor (the tail-follow contract)
+    assert len(j.snapshot(code="breaker_open")) == 1
+    cursor = evs[1]["t"]
+    tail = j.snapshot(since=cursor)
+    assert [e["code"] for e in tail] == ["overload_level_enter"]
+    # per-code counters + totals
+    st = j.stats()
+    assert st["event_breaker_open"] == 1.0
+    assert st["events_emitted"] == 3.0 and st["events_dropped"] == 0.0
+    # unregistered codes raise — the registry contract the vmqlint
+    # events-registry pass enforces statically
+    with pytest.raises(KeyError):
+        j.emit("not_a_registered_code")
+    # the ring is bounded: evictions are counted, oldest drop first
+    j.reset()
+    j.set_capacity(64)
+    for i in range(70):
+        j.emit("watchdog_stall", value=float(i))
+    assert len(j.snapshot()) == 64
+    assert j.dropped == 6
+    assert j.snapshot()[0]["value"] == 6.0
+    j.set_capacity(2048)
+
+
+def test_events_show_tail_follow_catches_up_oldest_first():
+    """A since= follow past a bursty window must return the OLDEST n
+    beyond the cursor (catch-up), not the newest n (which would jump
+    the cursor over the burst and silently lose it); a plain show
+    keeps newest-n semantics."""
+    from vernemq_tpu.admin.commands import _events_show
+
+    for i in range(8):
+        events.emit("watchdog_stall", value=float(i))
+    plain = _events_show(None, {"n": 3})
+    assert [r["value"] for r in plain["table"]] == [5.0, 6.0, 7.0]
+    cur = 0.0
+    seen = []
+    for _ in range(4):
+        out = _events_show(None, {"n": 3, "since": cur})
+        rows = [r for r in out["table"] if r["code"] != "(no events)"]
+        if not rows:
+            break
+        seen.extend(r["value"] for r in rows)
+        cur = out["cursor"]
+    assert seen == [float(i) for i in range(8)]  # nothing skipped
+
+
+def test_event_emit_disabled_is_noop_and_gated():
+    hist.set_enabled(False)
+    events.emit("breaker_open", detail="x")
+    hist.set_enabled(True)
+    assert events.journal().snapshot() == []
+    events.emit("breaker_open", detail="x")
+    assert len(events.journal().snapshot()) == 1
+
+
+def test_event_pack_unpack_roundtrip_and_torn_entry():
+    j = events.journal()
+    j.emit("spool_replay_start", detail="node1", value=13.0)
+    j.emit("spool_replay_end", detail="node1", value=13.0)
+    flat = j.pack()
+    assert len(flat) == events.PACK_WIDTH
+    out = events.unpack(flat, pid=777)
+    assert [e["code"] for e in out] == ["spool_replay_start",
+                                       "spool_replay_end"]
+    assert out[0]["value"] == 13.0 and out[0]["pid"] == 777
+    # detail strings do not cross the shm boundary (by design)
+    assert out[0]["detail"] == ""
+    # a torn entry (garbage code index) is skipped, not crashed on
+    flat[3] = 9999.0
+    out = events.unpack(flat)
+    assert [e["code"] for e in out] == ["spool_replay_end"]
+    assert events.unpack([]) == []
+
+
+def test_state_machines_emit_registered_events():
+    """The live emitters: a breaker open/half-open/close cycle and a
+    watchdog stall/abandon/late-discard cycle land in the journal with
+    their registered codes."""
+    from vernemq_tpu.robustness.breaker import CircuitBreaker
+    from vernemq_tpu.robustness.watchdog import StallAbandoned, \
+        StallWatchdog
+
+    b = CircuitBreaker(failure_threshold=2, backoff_initial=0.01,
+                       name="match")
+    b.record_failure()
+    b.record_failure()  # opens
+    time.sleep(0.05)
+    assert b.allow()    # grants the half-open probe
+    b.record_success()  # closes
+    codes = [e["code"] for e in events.journal().snapshot()]
+    assert codes == ["breaker_open", "breaker_half_open", "breaker_close"]
+    assert all(e["detail"] == "match"
+               for e in events.journal().snapshot())
+
+    events.journal().reset()
+    wd = StallWatchdog(tick_s=0.01)
+    release = threading.Event()
+    with pytest.raises(StallAbandoned):
+        wd.dispatch("device.dispatch", release.wait, deadline_s=0.05)
+    release.set()
+    assert _poll(lambda: events.journal().counts.get(
+        "watchdog_late_discard", 0) >= 1)
+    counts = events.journal().counts
+    assert counts.get("watchdog_abandon", 0) >= 1
+    assert counts.get("watchdog_stall", 0) >= 1
+
+
+def test_chrome_trace_interleaves_instant_events():
+    rec = FlightRecorder(sample_n=1)
+    tr = rec.admit("c", "t", 0)
+    tr.stamp("admit")
+    tr.stamp("route")
+    rec.finish(tr)
+    events.emit("breaker_open", detail="match")
+    trace = chrome_trace(rec.snapshot(), node="n1",
+                         journal_events=events.journal().snapshot())
+    json.dumps(trace)
+    inst = [e for e in trace["traceEvents"] if e["ph"] == "i"]
+    assert len(inst) == 1
+    assert inst[0]["name"] == "breaker_open"
+    assert inst[0]["cat"] == "events"
+    assert inst[0]["args"]["detail"] == "match"
+    # the instant lands on the emitting process's track
+    spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert inst[0]["pid"] == spans[0]["pid"]
+
+
+# ------------------------------------------------- cross-node trace resume
+
+
+def test_clock_sync_offset_estimation():
+    cs = ClockSync()
+    assert cs.offset("peer") == 0.0
+    # remote clock 10s behind local, 20ms RTT: delta samples land at
+    # +10.01 (offset + one-way), rtt halves out the transit
+    for _ in range(20):
+        cs.observe_delta("peer", 100.0, 110.01)
+        cs.observe_rtt("peer", 20.0)
+    assert cs.offset("peer") == pytest.approx(10.0, abs=0.005)
+    assert cs.peers()["peer"]["rtt_ms"] == pytest.approx(20.0, rel=0.01)
+    # REPLAY immunity (the windowed-min filter): a spool-replayed
+    # traced frame carries its original export-time send stamp, so its
+    # delta is inflated by the whole outage — it must not move the
+    # offset the way a mean/EWMA would
+    cs.observe_delta("peer", 100.0, 170.01)  # +60s replay delay
+    assert cs.offset("peer") == pytest.approx(10.0, abs=0.005)
+
+
+def test_resume_carries_origin_and_transit_stage():
+    a = FlightRecorder(sample_n=1, node="nodeA")
+    tr = a.admit("pub-1", "x/y", 1)
+    tr.stamp("admit")
+    ctx = tr.export_wire("nodeA")
+    assert ctx["n"] == "nodeA" and ctx["c"] == "pub-1"
+    b = FlightRecorder(sample_n=1, node="nodeB")
+    tr2 = b.resume(ctx, "nodeA")
+    assert b.resumed == 1
+    tr2.stamp("route")
+    rec = b.finish(tr2)
+    assert rec["node"] == "nodeB"
+    assert rec["origin"]["node"] == "nodeA"
+    assert rec["origin"]["marks"] == [("admit", pytest.approx(
+        tr.marks[0][1]))]
+    assert "cluster_transit_ms" in rec["stages"]
+    assert "cluster_ingress_ms" in rec["stages"]
+    # a malformed peer context resumes to None, never a crash — a
+    # resume failure on the spooled path would otherwise abort the
+    # dispatch AFTER the seq was accepted (QoS1 loss)
+    assert b.resume({"t0": "garbage", "q": "x"}, "nodeA") is None
+    assert b.resume(["not", "a", "dict"], "nodeA") is None
+    assert b.resume({"m": [("x",)]}, "nodeA") is None  # torn marks
+    # observability off: no resume at all
+    hist.set_enabled(False)
+    assert b.resume(ctx, "nodeA") is None
+    hist.set_enabled(True)
+
+
+def test_chrome_trace_renders_origin_node_track_and_flow():
+    a = FlightRecorder(sample_n=1, node="nodeA")
+    tr = a.admit("c", "t", 1)
+    tr.stamp("admit")
+    ctx = tr.export_wire("nodeA")
+    b = FlightRecorder(sample_n=1, node="nodeB")
+    tr2 = b.resume(ctx, "nodeA")
+    tr2.stamp("route")
+    b.finish(tr2)
+    trace = chrome_trace(b.snapshot(), node="nodeB")
+    json.dumps(trace)
+    names = {e["args"]["name"]: e["pid"]
+             for e in trace["traceEvents"] if e["ph"] == "M"}
+    node_tracks = [n for n in names if n.startswith(("nodeA-worker",
+                                                     "nodeB-worker"))]
+    assert len(node_tracks) == 2, names
+    # origin spans landed on the origin node's (synthesized-pid) track
+    a_pid = next(p for n, p in names.items()
+                 if n.startswith("nodeA-worker"))
+    b_pid = next(p for n, p in names.items()
+                 if n.startswith("nodeB-worker"))
+    assert a_pid != b_pid
+    origin_spans = [e for e in trace["traceEvents"]
+                    if e["ph"] == "X" and e["pid"] == a_pid]
+    assert any(e["name"] == "admission" for e in origin_spans)
+    # the cluster hop renders as a flow arrow between the two tracks
+    flows = {e["ph"]: e for e in trace["traceEvents"]
+             if e.get("name") == "cluster_hop"}
+    assert flows["s"]["pid"] == a_pid and flows["f"]["pid"] == b_pid
+
+
+# ---------------------------------------------------------- canary probe
+
+
+@pytest.mark.asyncio
+async def test_canary_probe_e2e_histogram_slo_and_isolation():
+    """The canary SLO probe: loopback probes ride the full publish path
+    into the e2e_canary_ms histogram, SLO breaches burn the counter and
+    journal an event, the admin/QL surfaces render, and the $-topic
+    keeps the probe invisible to wildcard subscribers."""
+    from vernemq_tpu.admin.commands import CommandRegistry, \
+        register_core_commands
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.client import MQTTClient
+
+    cfg = Config(systree_enabled=False, allow_anonymous=True,
+                 canary_enabled=True, canary_interval_ms=40,
+                 canary_slo_ms=10_000.0, flight_recorder_sample_n=0)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        assert broker.canary is not None
+        deadline = time.monotonic() + 15
+        while broker.canary.received < 3 and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        assert broker.canary.received >= 3
+        assert broker.canary.timeouts == 0
+        assert hist.get("e2e_canary_ms").snapshot()[2] >= 3
+        am = broker.metrics.all_metrics()
+        assert am["canary_probes"] >= 3
+        assert am["canary_received"] >= 3
+        assert am["canary_slo_breaches"] == 0
+        assert am["canary_last_e2e_ms"] >= 0
+        # HELP present for the canary gauges and event counters
+        text = broker.metrics.prometheus_text(node=broker.node_name)
+        assert "# HELP canary_slo_breaches " in text
+        assert "# HELP event_canary_slo_breach " in text
+        assert "# HELP events_emitted " in text
+        # an impossible SLO burns the counter and journals the breach
+        broker.canary.slo_ms = 0.0
+        deadline = time.monotonic() + 15
+        while (broker.canary.slo_breaches < 1
+               and time.monotonic() < deadline):
+            await asyncio.sleep(0.05)
+        assert broker.canary.slo_breaches >= 1
+        assert events.journal().counts.get("canary_slo_breach", 0) >= 1
+        # admin + QL surfaces
+        reg = register_core_commands(CommandRegistry())
+        out = reg.run(broker, ["events", "show", "code=canary_slo_breach"])
+        assert out["table"][0]["code"] == "canary_slo_breach"
+        assert out["journal"]["events_emitted"] >= 1
+        ql = reg.run(broker, ["ql", "query",
+                              "q=SELECT code, subsystem FROM events "
+                              "WHERE code = 'canary_slo_breach' LIMIT 1"])
+        assert ql["table"][0]["subsystem"] == "observability/canary"
+        # the tail-follow cursor: a since= past the last event is empty
+        cur = out["cursor"]
+        again = reg.run(broker, ["events", "show", f"since={cur + 1000}"])
+        assert again["table"][0]["code"] == "(no events)"
+        # $-topic isolation: a # wildcard subscriber never sees probes
+        c = MQTTClient("127.0.0.1", server.port, client_id="canary-spy")
+        assert (await c.connect()).rc == 0
+        await c.subscribe("#")
+        with pytest.raises(asyncio.TimeoutError):
+            await c.recv(0.5)
+        await c.disconnect()
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_canary_not_ready_rolls_back_and_never_counts_timeout():
+    """A netsplit CAP gate tick must not inject a probe NOR leave a
+    phantom inflight entry that the sweep later burns as a
+    path-dropped timeout."""
+    from vernemq_tpu.observability.canary import CanaryProbe
+
+    class _Reg:
+        def batched_view_active(self):
+            return False
+
+        def publish(self, msg):
+            raise RuntimeError("not_ready")
+
+    class _Broker:
+        node_name = "n0"
+        registry = _Reg()
+
+    probe = CanaryProbe(_Broker(), interval_ms=10)
+    await probe._probe_once()
+    assert probe.probes == 0 and probe._inflight == {}
+    probe._sweep_timeouts()
+    assert probe.timeouts == 0
+
+
+# ------------------------------------------- cross-node cluster trace e2e
+
+
+@pytest.mark.asyncio
+async def test_cross_node_trace_two_brokers_one_perfetto_trace(tmp_path):
+    """The tentpole acceptance: a sampled publish crossing two
+    in-process brokers over the cluster plane produces ONE
+    Perfetto-loadable trace with both nodes' tracks, stage spans, and
+    interleaved instant events — under an injected device.dispatch
+    fault whose breaker transitions land in the same timeline."""
+    from test_cluster import connected, start_node, stop_cluster, \
+        wait_until
+    from vernemq_tpu.robustness import faults
+
+    a = await start_node(
+        "node0", default_reg_view="tpu", tpu_host_batch_threshold=0,
+        flight_recorder_sample_n=1, tpu_breaker_failure_threshold=2,
+        tpu_breaker_backoff_initial_ms=50,
+        tpu_breaker_backoff_max_ms=200)
+    b = await start_node("node1", flight_recorder_sample_n=1)
+    nodes = [a, b]
+    try:
+        b.cluster.join(a.cluster.listen_host, a.cluster.listen_port)
+        for n in nodes:
+            await wait_until(lambda n=n: (len(n.cluster.members()) == 2
+                                          and n.cluster.is_ready()))
+        sub = await connected(b, "xn-sub")
+        await sub.subscribe("xn/#", qos=1)
+        await wait_until(lambda: len(
+            a.broker.registry.trie("").match(["xn", "x"])) == 1)
+        # both capabilities must have exchanged: spool (QoS1 envelope)
+        # and trace (the propagation opt-in)
+        await wait_until(lambda: {"spool", "trace"} <= set(
+            a.cluster._peer_caps.get("node1", ())))
+        pub = await connected(a, "xn-pub")
+
+        await pub.publish("xn/1", b"m1", qos=1)
+        m = await sub.recv(15)
+        assert m.payload == b"m1"
+        # the receiving node RESUMED the origin's trace
+        await wait_until(lambda: b.broker.recorder.resumed >= 1)
+        resumed = [r for r in b.broker.recorder.snapshot()
+                   if r.get("origin")]
+        assert resumed, "no resumed record on the receiving node"
+        rec = resumed[-1]
+        assert rec["origin"]["node"] == "node0"
+        assert rec["client"] == "xn-pub" and rec["topic"] == "xn/1"
+        assert any(l == "admit" for l, _ in rec["origin"]["marks"])
+        assert "cluster_transit_ms" in rec["stages"]
+        assert "cluster_ingress_ms" in rec["stages"]
+
+        # device.dispatch fault storm on the origin: the breaker opens
+        # (journaled) while delivery continues via the host trie, and
+        # the trace keeps propagating
+        faults.install(faults.FaultPlan(
+            [faults.FaultRule("device.dispatch", kind="error")], seed=3))
+        for i in range(6):
+            await pub.publish(f"xn/f{i}", b"f%d" % i, qos=1)
+            await sub.recv(15)
+        assert _poll(lambda: events.journal().counts.get(
+            "breaker_open", 0) >= 1)
+        faults.clear()
+
+        # ONE merged Perfetto trace from both recorders + the journal
+        recs = (a.broker.recorder.snapshot()
+                + b.broker.recorder.snapshot())
+        evs = events.journal().snapshot()
+        trace = chrome_trace(recs, node="node0", journal_events=evs)
+        blob = json.dumps(trace)  # Perfetto-loadable as-is
+        parsed = json.loads(blob)
+        tracks = {e["args"]["name"]: e["pid"]
+                  for e in parsed["traceEvents"] if e["ph"] == "M"}
+        node0 = [p for n, p in tracks.items()
+                 if n.startswith("node0-worker")]
+        node1 = [p for n, p in tracks.items()
+                 if n.startswith("node1-worker")]
+        assert node0 and node1, tracks
+        assert set(node0).isdisjoint(node1)
+        spans = [e for e in parsed["traceEvents"] if e["ph"] == "X"]
+        span_pids = {e["pid"] for e in spans}
+        assert span_pids & set(node0) and span_pids & set(node1), \
+            "stage spans missing on one node's track"
+        # instant events interleave on the same axis, in stamp order,
+        # inside the trace's span window
+        inst = [e for e in parsed["traceEvents"] if e["ph"] == "i"]
+        assert any(e["name"] == "breaker_open" for e in inst)
+        ts = [e["ts"] for e in inst]
+        assert ts == sorted(ts)
+        lo = min(e["ts"] for e in spans)
+        hi = max(e["ts"] + e["dur"] for e in spans)
+        open_ts = next(e["ts"] for e in inst
+                       if e["name"] == "breaker_open")
+        assert lo <= open_ts <= hi
+        # the cluster hop rendered as flow arrows between the tracks
+        assert any(e.get("name") == "cluster_hop" and e["ph"] == "s"
+                   for e in parsed["traceEvents"])
+        await sub.disconnect()
+        await pub.disconnect()
+    finally:
+        faults.clear()
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_trace_cap_negotiation_keeps_envelope_byte_identical():
+    """The acceptance guard: without the negotiated "trace" cap (old
+    peer) or with observability off, the cluster envelope is
+    byte-identical to pre-trace framing on BOTH the legacy msg path
+    and the spooled msq path — and cluster-ingress publishes still hit
+    the receiver's own 1-in-N admission (the remote-path sampling
+    fix)."""
+    from test_cluster import start_node, stop_cluster, wait_until
+    from vernemq_tpu.broker.message import Msg
+    from vernemq_tpu.cluster.node import frame, msg_to_term
+
+    a = await start_node("node0", flight_recorder_sample_n=1)
+    b = await start_node("node1", flight_recorder_sample_n=1)
+    nodes = [a, b]
+    try:
+        b.cluster.join(a.cluster.listen_host, a.cluster.listen_port)
+        for n in nodes:
+            await wait_until(lambda n=n: (len(n.cluster.members()) == 2
+                                          and n.cluster.is_ready()))
+        await wait_until(lambda: {"spool", "trace"} <= set(
+            a.cluster._peer_caps.get("node1", ())))
+        w = a.cluster._writers["node1"]
+        sent = []
+        real_send = w.send_frame
+
+        def capture(data, sheddable=False):
+            sent.append(bytes(data))
+            return real_send(data, sheddable)
+
+        w.send_frame = capture
+
+        def mk(ref, qos=0):
+            return Msg(topic=("nt", "1"), payload=b"x", qos=qos,
+                       mountpoint="", msg_ref=ref)
+
+        # capability present + observability on: the context rides
+        tr = a.broker.recorder.admit("ntc", "nt/1", 0)
+        assert a.cluster.publish("node1", mk(b"r1"), trace=tr)
+        assert any(b"trc" in d for d in sent)
+
+        # old peer (no cap): byte-identical legacy framing
+        a.cluster._peer_caps["node1"].discard("trace")
+        sent.clear()
+        msg2 = mk(b"r2")
+        tr = a.broker.recorder.admit("ntc", "nt/1", 0)
+        assert a.cluster.publish("node1", msg2, trace=tr)
+        assert sent == [frame(b"msg", msg_to_term(msg2))]
+
+        # old peer, spooled QoS1: byte-identical msq framing
+        seq = a.cluster.spool.state("node1").next_seq
+        sent.clear()
+        msgq = mk(b"r3", qos=1)
+        tr = a.broker.recorder.admit("ntc", "nt/1", 1)
+        assert a.cluster.publish("node1", msgq, trace=tr)
+        expected = frame(b"msq", (seq, "msg", msg_to_term(msgq)))
+        assert expected in sent
+
+        # capability present but observability OFF: same guarantee
+        a.cluster._peer_caps["node1"].add("trace")
+        hist.set_enabled(False)
+        sent.clear()
+        msg4 = mk(b"r4")
+        forced = PublishTrace(("c", "nt/1", 0))
+        assert a.cluster.publish("node1", msg4, trace=forced)
+        assert sent == [frame(b"msg", msg_to_term(msg4))]
+        hist.set_enabled(True)
+
+        # the remote-path admission fix: an un-traced cluster-ingress
+        # publish is sampled by the RECEIVER's own 1-in-N decision
+        a.cluster._peer_caps["node1"].discard("trace")
+        before = len(b.broker.recorder.records)
+        assert a.cluster.publish("node1", mk(b"r5"))
+        await wait_until(lambda: any(
+            r["client"] == "(cluster)" and r["topic"] == "nt/1"
+            for r in list(b.broker.recorder.records)[before:]))
+        remote_rec = next(r for r in b.broker.recorder.snapshot()
+                          if r["client"] == "(cluster)")
+        assert "origin" not in remote_rec  # locally admitted, not resumed
+        assert "cluster_ingress_ms" in remote_rec["stages"]
+    finally:
+        await stop_cluster(nodes)
+
+
+# ----------------------------------------- worker-slot event aggregation
+
+
+@pytest.mark.asyncio
+async def test_merged_events_fold_worker_slots_and_dump_merge(tmp_path):
+    """--merge aggregation: a broker attached as worker 0 of 3 folds
+    the OTHER live slots' packed event rings (and the foreign-pid match
+    service's) into one interleaved timeline; `events dump --merge` and
+    `timeline dump --merge` write it as one artifact."""
+    from vernemq_tpu.admin.commands import CommandRegistry, \
+        register_core_commands
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.parallel.shm_ring import WorkerStatsBlock
+
+    def fake_block(code, value, dt=0.0):
+        return [1.0, time.monotonic() + dt, time.time() + dt,
+                float(events.EVENT_CODES.index(code)), value]
+
+    stats = WorkerStatsBlock.create(f"evm{os.getpid() % 100000}", 3)
+    try:
+        broker, server = await start_broker(
+            Config(systree_enabled=False, allow_anonymous=True,
+                   worker_stats_block=stats.name, worker_index=0,
+                   workers_total=3),
+            port=0, node_name="w0")
+        try:
+            events.journal().reset()
+            events.emit("breaker_open", detail="match")
+            # slot 1: live peer with one packed event
+            stats.write_health(1, pid=111, sessions=0, admitted=0)
+            stats.write_events(1, fake_block("supervisor_restart", 2.0))
+            # slot 2: data but NO heartbeat — excluded
+            stats.write_events(2, fake_block("supervisor_escalation", 1.0))
+            merged = broker.merged_journal_events(merge=True)
+            codes = [e["code"] for e in merged]
+            assert "breaker_open" in codes
+            assert "supervisor_restart" in codes
+            assert "supervisor_escalation" not in codes
+            assert [e["t"] for e in merged] == sorted(
+                e["t"] for e in merged)
+            assert next(e for e in merged
+                        if e["code"] == "supervisor_restart")["pid"] == 111
+            # merge=False: the local journal only
+            assert [e["code"] for e in
+                    broker.merged_journal_events(merge=False)] == \
+                ["breaker_open"]
+            # a foreign-pid match service's events merge too
+            stats.set_service(1, os.getpid() + 1)
+            stats.write_service_events(
+                fake_block("mesh_slice_claim", 4.0))
+            merged = broker.merged_journal_events(merge=True)
+            assert "mesh_slice_claim" in [e["code"] for e in merged]
+            # merging twice does not duplicate (the (t, code, pid) key)
+            assert len(broker.merged_journal_events(merge=True)) \
+                == len(merged)
+
+            reg = register_core_commands(CommandRegistry())
+            path = str(tmp_path / "ev.json")
+            out = reg.run(broker, ["events", "dump", f"path={path}",
+                                   "--merge"])
+            assert out["events"] == len(merged)
+            assert _poll(lambda: os.path.exists(path))
+            with open(path) as fh:
+                dump = json.load(fh)
+            assert dump["merged"] is True
+            assert len(dump["events"]) == len(merged)
+            assert dump["codes"]["breaker_open"] == "robustness/breaker"
+            # timeline dump --merge interleaves the same stream as
+            # instant events
+            tpath = str(tmp_path / "tl.json")
+            reg.run(broker, ["timeline", "dump", f"path={tpath}",
+                             "--merge"])
+            assert _poll(lambda: os.path.exists(tpath))
+            with open(tpath) as fh:
+                tl = json.load(fh)
+            inst = [e for e in tl["traceEvents"] if e["ph"] == "i"]
+            assert {e["name"] for e in inst} >= {
+                "breaker_open", "supervisor_restart", "mesh_slice_claim"}
+        finally:
+            await broker.stop()
+            await server.stop()
+    finally:
+        stats.close()
+        stats.unlink()

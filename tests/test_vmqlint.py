@@ -48,7 +48,7 @@ def test_tree_is_clean(base_files):
     assert findings == [], [f.render() for f in findings]
     assert stats["passes"] == ["blocking", "metrics", "lock-discipline",
                               "thread-lifecycle", "knob-registry",
-                              "fault-registry"]
+                              "fault-registry", "events-registry"]
 
 
 # -------------------------------------------------- lock-discipline corpus
@@ -593,6 +593,69 @@ def test_fault_registry_runtime_validation():
     with pytest.raises(CommandError):
         _fault_inject(None, {"point": "device.dipatch"})
     assert faults.active() is None  # the failed inject installed no plan
+
+
+# --------------------------------------------------- events-registry corpus
+
+def test_events_registry_unknown_code(base_files):
+    src = ('from vernemq_tpu.observability import events\n'
+           'def f():\n'
+           '    events.emit("braeker_open", detail="x")\n')
+    found = snippet_findings("events-registry", base_files, src,
+                             paths_only=False)
+    assert any("braeker_open" in f.message
+               and "KNOWN_EVENTS" in f.message for f in found)
+
+
+def test_events_registry_non_literal_code_flagged(base_files):
+    src = ('from vernemq_tpu.observability import events\n'
+           'def f(code):\n'
+           '    events.emit(code)\n')
+    found = snippet_findings("events-registry", base_files, src,
+                             paths_only=False)
+    assert any("not a string literal" in f.message for f in found)
+
+
+def test_events_registry_bare_emit_not_matched(base_files):
+    """`emit` is a common name (the filter engine's aggregate hook is
+    literally `self.filter_engine.emit`) — only `events.emit` /
+    `_events.emit` receivers are journal sites."""
+    src = ('class Engine:\n'
+           '    def emit(self, what):\n'
+           '        pass\n'
+           'def f(eng):\n'
+           '    eng.emit("not_an_event_code")\n'
+           '    eng.inner.emit("also_not")\n')
+    assert snippet_findings("events-registry", base_files, src,
+                            paths_only=False) == []
+
+
+def test_events_registry_dead_registry_entry(base_files):
+    """A KNOWN_EVENTS entry with no events.emit site is a documented
+    black-box signal that can never appear — flagged at the registry
+    line."""
+    rel = "vernemq_tpu/observability/events.py"
+    text = base_files[rel].text
+    needle = '    "breaker_open": ('
+    assert needle in text
+    mutated = text.replace(
+        needle,
+        '    "phantom_event": (\n'
+        '        "nowhere",\n'
+        '        "An event no site ever emits."),\n' + needle, 1)
+    found = run_pass("events-registry", base_files,
+                     overrides={rel: mutated})
+    assert any("phantom_event" in f.message
+               and "no events.emit" in f.message for f in found)
+
+
+def test_events_registry_runtime_validation():
+    """The same registry gates emit() at runtime: an unregistered
+    code raises instead of journaling garbage."""
+    from vernemq_tpu.observability import events
+
+    with pytest.raises(KeyError):
+        events.journal().emit("not_a_registered_code")
 
 
 # ------------------------------------------------- framework / CLI surface

@@ -12,9 +12,10 @@ from ..core import Pass
 
 
 def all_passes() -> List[Pass]:
-    from . import (blocking, fault_registry, knob_registry,
-                   lock_discipline, metrics, thread_lifecycle)
+    from . import (blocking, events_registry, fault_registry,
+                   knob_registry, lock_discipline, metrics,
+                   thread_lifecycle)
 
     return [blocking.PASS, metrics.PASS, lock_discipline.PASS,
             thread_lifecycle.PASS, knob_registry.PASS,
-            fault_registry.PASS]
+            fault_registry.PASS, events_registry.PASS]

@@ -163,7 +163,7 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "[order_by=f1,f2] [--<field>...]")
     reg.register(["ql", "query"], _ql_query,
                  "vmq-admin ql query q='SELECT f FROM sessions|queues|"
-                 "subscriptions|messages|retain|retained_index "
+                 "subscriptions|messages|retain|retained_index|events "
                  "[WHERE ...] [ORDER BY f [DESC]] [LIMIT n]'")
     reg.register(["queue", "show"], _queue_show,
                  "vmq-admin queue show [--limit=N]")
@@ -281,9 +281,22 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "Recent flight-recorder publish samples with "
                  "per-stage latency deltas")
     reg.register(["timeline", "dump"], _timeline_dump,
-                 "vmq-admin timeline dump [path=timeline.json]",
+                 "vmq-admin timeline dump [path=timeline.json] "
+                 "[--merge]",
                  "Export flight-recorder samples + device dispatch "
-                 "records as Chrome trace-event JSON (Perfetto)")
+                 "records + control-plane events as Chrome trace-event "
+                 "JSON (Perfetto); --merge folds every worker slot's "
+                 "event stream into the one artifact")
+    reg.register(["events", "show"], _events_show,
+                 "vmq-admin events show [n=50] [code=C] [since=T]",
+                 "Recent control-plane journal events (breaker/"
+                 "governor/watchdog/supervisor/mesh/spool/wire/canary "
+                 "transitions); since=<monotonic> tail-follows")
+    reg.register(["events", "dump"], _events_dump,
+                 "vmq-admin events dump [path=events.json] [--merge]",
+                 "Export the event journal as one JSON artifact; "
+                 "--merge folds every worker slot (and the match "
+                 "service) into it")
     reg.register(["profile", "device"], _profile_device,
                  "vmq-admin profile device [kind=match] [n=20]",
                  "Per-dispatch device profile: K, batch fill, "
@@ -1113,6 +1126,36 @@ def _workers_show(broker, flags):
     return out
 
 
+def _dump_async(path, blob, what):
+    """Write one dump artifact atomically OFF the event loop (the admin
+    handlers run on it — a multi-MB write to a slow disk must not stall
+    session IO). Per-dump-unique tmp name so overlapping dumps to one
+    path can't replace each other's half-written blob; a failure is
+    logged (the command already returned — the broker log is the only
+    place the operator can see it). Shared by `timeline dump` and
+    `events dump` so the write protocol can't drift between them."""
+    import threading as _threading
+
+    def _write(p=path, b=blob):
+        tmp = f"{p}.{os.getpid()}.{_threading.get_ident()}.tmp"
+        try:
+            with open(tmp, "w") as fh:
+                fh.write(b)
+            os.replace(tmp, p)
+        except OSError:
+            import logging
+
+            logging.getLogger("vernemq_tpu.admin").exception(
+                "%s dump to %r failed", what, p)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    _threading.Thread(target=_write, name=f"{what}-dump",
+                      daemon=True).start()
+
+
 def _timeline_show(broker, flags):
     """Recent flight-recorder samples (observability/recorder.py): one
     row per sampled publish, stage deltas in ms."""
@@ -1137,49 +1180,95 @@ def _timeline_show(broker, flags):
 
 def _timeline_dump(broker, flags):
     """Chrome trace-event export: flight-recorder publish stages plus
-    device dispatch records on one CLOCK_MONOTONIC axis, pid-tagged so
-    worker and match-service spans land in separate Perfetto tracks."""
+    device dispatch records plus control-plane journal events on one
+    CLOCK_MONOTONIC axis, pid-tagged so worker, match-service and
+    remote-node spans land in separate Perfetto tracks. ``--merge``
+    folds every live worker slot's (and the match service's) event
+    stream into this one artifact."""
     import json as _json
-    import threading as _threading
 
     from ..observability import chrome_trace
     from ..observability.profiler import profiler as _profiler
 
     trace = chrome_trace(broker.recorder.snapshot(),
                          _profiler().snapshot(),
-                         node=broker.node_name)
+                         node=broker.node_name,
+                         journal_events=broker.merged_journal_events(
+                             merge=bool(flags.get("merge"))))
     path = flags.get("path")
     if not isinstance(path, str) or not path:
         path = f"timeline_{broker.node_name}.json"
-    blob = _json.dumps(trace)
-
-    # the admin handlers run ON the event loop (sync fns called from
-    # the async mgmt path): a multi-MB dump to a slow disk must not
-    # stall every session's IO mid-diagnosis — serialize here (cheap,
-    # bounded by the ring caps), write in a throwaway thread. The tmp
-    # name is per-dump unique so two overlapping dumps to one path
-    # can't replace each other's half-written blob, and a write
-    # failure is logged (the command already returned — the broker log
-    # is the only place the operator can see it)
-    def _write(p=path, b=blob):
-        tmp = f"{p}.{os.getpid()}.{_threading.get_ident()}.tmp"
-        try:
-            with open(tmp, "w") as fh:
-                fh.write(b)
-            os.replace(tmp, p)
-        except OSError:
-            import logging
-
-            logging.getLogger("vernemq_tpu.admin").exception(
-                "timeline dump to %r failed", p)
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-
-    _threading.Thread(target=_write, name="timeline-dump",
-                      daemon=True).start()
+    _dump_async(path, _json.dumps(trace), "timeline")
     return {"writing": path, "events": len(trace["traceEvents"])}
+
+
+def _events_show(broker, flags):
+    """Recent control-plane journal events (observability/events.py):
+    one row per state-machine transition, newest last. ``since=<t>``
+    (a monotonic stamp from a previous call's last row) returns only
+    newer events — the tail-follow loop for live debugging."""
+    from ..observability import events as _events
+
+    n = int(flags.get("n", 50) or 50)
+    code = flags.get("code")
+    since = flags.get("since")
+    following = isinstance(since, (int, float))
+    evs = _events.journal().snapshot(
+        code=code if isinstance(code, str) else None,
+        since=float(since) if following else None)
+    # a plain show wants the NEWEST n; a since= follow must take the
+    # OLDEST n past the cursor — keeping the newest would jump the
+    # returned cursor over everything a bursty window emitted beyond
+    # n, and the follower would silently lose exactly the storm the
+    # journal exists to explain (the next poll catches up instead)
+    evs = evs[:n] if following else evs[-n:]
+    rows = [{
+        "t": round(e["t"], 6),
+        "time": time.strftime("%H:%M:%S", time.localtime(e["ts"]))
+                + f".{int((e['ts'] % 1) * 1e3):03d}",
+        "code": e["code"],
+        "detail": e["detail"],
+        "value": e["value"],
+        "pid": e["pid"],
+    } for e in evs]
+    if not rows:
+        rows = [{"t": 0.0, "time": "", "code": "(no events)",
+                 "detail": "", "value": 0.0, "pid": 0}]
+    out = {"table": rows,
+           "journal": {k: int(v)
+                       for k, v in _events.journal().stats().items()
+                       if k.startswith("events_")}}
+    if evs:
+        # FULL precision: the snapshot filter is a strict `t > since`
+        # at full float precision, so a rounded-DOWN cursor would
+        # re-return its own event on every tail-follow poll
+        out["cursor"] = evs[-1]["t"]
+    return out
+
+
+def _events_dump(broker, flags):
+    """One JSON artifact of the event journal (``--merge``: every live
+    worker slot's packed stream and the match service's folded in,
+    interleaved by monotonic stamp). Same off-loop atomic write
+    discipline as `timeline dump`."""
+    import json as _json
+
+    from ..observability import events as _events
+
+    evs = broker.merged_journal_events(merge=bool(flags.get("merge")))
+    blob = _json.dumps({
+        "node": broker.node_name,
+        "clock": "CLOCK_MONOTONIC",
+        "merged": bool(flags.get("merge")),
+        "codes": {c: sub for c, (sub, _h) in
+                  _events.KNOWN_EVENTS.items()},
+        "events": evs,
+    })
+    path = flags.get("path")
+    if not isinstance(path, str) or not path:
+        path = f"events_{broker.node_name}.json"
+    _dump_async(path, blob, "events")
+    return {"writing": path, "events": len(evs)}
 
 
 def _profile_device(broker, flags):

@@ -171,6 +171,20 @@ def filter_window_rows(broker) -> Iterator[Dict[str, Any]]:
                "max": round(float(acc[slot][3]), 6) if c else None}
 
 
+def event_rows(broker) -> Iterator[Dict[str, Any]]:
+    """Control-plane journal events (observability/events.py): one row
+    per state-machine transition — queryable by code/subsystem/time,
+    e.g. ``SELECT * FROM events WHERE code = 'breaker_open'``."""
+    from ..observability import events as _events
+
+    for e in _events.journal().snapshot():
+        sub, _help = _events.KNOWN_EVENTS.get(e["code"], ("?", ""))
+        yield {"t": round(e["t"], 6), "ts": round(e["ts"], 3),
+               "code": e["code"], "subsystem": sub,
+               "detail": e["detail"], "value": e["value"],
+               "pid": e["pid"]}
+
+
 TABLES: Dict[str, Callable[[Any], Iterator[Dict[str, Any]]]] = {
     "sessions": session_rows,
     "subscriptions": subscription_rows,
@@ -180,6 +194,7 @@ TABLES: Dict[str, Callable[[Any], Iterator[Dict[str, Any]]]] = {
     "messages": message_rows,
     "payload_schemas": payload_schema_rows,
     "filter_windows": filter_window_rows,
+    "events": event_rows,
 }
 
 

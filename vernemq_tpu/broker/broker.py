@@ -191,7 +191,12 @@ class Broker:
         self.recorder = FlightRecorder(
             sample_n=int(self.config.get("flight_recorder_sample_n", 32)),
             capacity=int(self.config.get("flight_recorder_capacity",
-                                         4096)))
+                                         4096)),
+            node=node_name)
+        # canary SLO probe (observability/canary.py): built at start()
+        # when canary_enabled — the loopback subscription must not
+        # exist unless the operator asked for the probe
+        self.canary: Optional[Any] = None
         # multi-process session front end (broker/workers.py): when this
         # broker is one of N SO_REUSEPORT workers, the parent hands it a
         # shared stats slot (fused overload pressure, `vmq-admin workers
@@ -463,6 +468,9 @@ class Broker:
                               "in the flight-recorder ring.",
             "flight_sample_n": "Flight-recorder sampling divisor "
                                "(every Nth admitted publish records).",
+            "flight_resumed": "Flight-recorder traces resumed from a "
+                              "cluster peer's propagated context "
+                              "(cross-node publishes).",
             # mesh-native matcher (parallel/mesh_match.py) + slice map
             # (cluster/mesh_map.py): slice residency and delta-routing
             # effectiveness — all zero outside mesh mode
@@ -545,6 +553,12 @@ class Broker:
                                          "PUBLISHes emitted by closed "
                                          "windows.",
         })
+        from ..observability import events as _events
+        from ..observability.canary import GAUGE_HELP as _canary_help
+
+        self.metrics.register_gauges(self._observability_gauges,
+                                     {**_events.gauge_help(),
+                                      **_canary_help})
 
     # ------------------------------------------------------------ plumbing
 
@@ -673,6 +687,18 @@ class Broker:
         out["shm_ring_fence"] = 1.0 if fence_active() else 0.0
         return out
 
+    def _observability_gauges(self) -> Dict[str, float]:
+        """Event-journal counters (process-global ring) plus the canary
+        probe's counters — split from _gauges so the HELP text comes
+        from the registries themselves (events.KNOWN_EVENTS / canary
+        GAUGE_HELP), never a drifting literal."""
+        from ..observability import events as _events
+
+        out = _events.journal().stats()
+        if self.canary is not None:
+            out.update(self.canary.stats())
+        return out
+
     def _peer_histograms(self):
         """Merged stage-histogram blocks of every OTHER live worker
         (heartbeat-fresh slots only — a dead worker's frozen block must
@@ -711,6 +737,50 @@ class Broker:
         except Exception:
             pass
         return out
+
+    def merged_journal_events(self, merge: bool = False):
+        """The control-plane event stream for this node: the local
+        journal (full detail), plus — with ``merge`` in worker mode —
+        every OTHER live worker's packed slot events and the match
+        service's, interleaved by monotonic stamp into ONE list
+        (`vmq-admin events dump --merge` / `timeline dump --merge`; the
+        on-hardware capture item scrapes one worker instead of N)."""
+        from ..observability import events as _events
+
+        out = _events.journal().snapshot()
+        ws = self.worker_stats
+        if not merge or ws is None:
+            return out
+        my_pid = os.getpid()
+        for i in range(ws.n_workers):
+            if i == self.worker_index:
+                continue
+            slot = ws.read_slot(i)
+            hb = slot.get("heartbeat_age_s")
+            if hb is None or hb > 5.0:
+                continue
+            out.extend(_events.unpack(ws.read_events(i),
+                                      pid=slot.get("pid", 0)))
+        try:
+            svc = ws.service_info()
+            if (svc.get("pid") and svc["pid"] != my_pid
+                    and svc.get("heartbeat_age_s") is not None
+                    and svc["heartbeat_age_s"] < 5.0):
+                out.extend(_events.unpack(ws.read_service_events(),
+                                          pid=svc["pid"]))
+        except Exception:
+            pass  # an old-layout block (no event region) stays healthy
+        # a peer's packed ring may overlap what we read last time;
+        # dedup on the (stamp, code, pid) identity, then one timeline
+        seen = set()
+        uniq = []
+        for e in sorted(out, key=lambda e: e["t"]):
+            key = (round(e["t"], 6), e["code"], e.get("pid", 0))
+            if key in seen:
+                continue
+            seen.add(key)
+            uniq.append(e)
+        return uniq
 
     def cluster_ready(self) -> bool:
         """is_ready consistency gate (vmq_cluster.erl:67-92)."""
@@ -1147,6 +1217,7 @@ class Broker:
         level/pressure pair is written by the governor's own tick and
         the loop-lag samples by sysmon — every field has exactly one
         writer, so the block needs no locking."""
+        from ..observability import events as _events
         from ..observability import histogram as _hist
 
         ws = self.worker_stats
@@ -1161,6 +1232,7 @@ class Broker:
                 # ANY worker's /metrics (and the parent's bench read)
                 # shows the node-level merged families
                 ws.write_hist(idx, _hist.pack_all())
+                ws.write_events(idx, _events.journal().pack())
             except Exception:
                 log.exception("worker stats heartbeat failed")
             await asyncio.sleep(interval)
@@ -1263,6 +1335,10 @@ class Broker:
             bool(self.config.get("observability_enabled", True)))
         _profiler().set_capacity(
             int(self.config.get("profiler_capacity", 2048)))
+        from ..observability import events as _events
+
+        _events.journal().set_capacity(
+            int(self.config.get("events_capacity", 2048)))
         # warm-load from persisted metadata: routing state, offline queues,
         # retain cache (boot order of vmq_server_sup + vmq_reg_trie /
         # vmq_retain_srv warm-loads)
@@ -1488,6 +1564,20 @@ class Broker:
         self.crl_refresher = CrlRefresher(
             self, interval=self.config.get("crl_refresh_interval", 60.0))
         self.crl_refresher.start()
+        # canary SLO probe: a loopback subscriber + a periodic synthetic
+        # publish through the FULL path feeding e2e_canary_ms — the
+        # continuous black-box end-to-end signal. Supervised like the
+        # systree reporter; zero footprint unless enabled.
+        if (bool(self.config.get("canary_enabled", False))
+                and bool(self.config.get("observability_enabled", True))):
+            from ..observability.canary import CanaryProbe
+
+            self.canary = CanaryProbe(
+                self,
+                interval_ms=float(self.config.get("canary_interval_ms",
+                                                  1000)),
+                slo_ms=float(self.config.get("canary_slo_ms", 250.0)))
+            self.supervisor.spawn("canary", self.canary.run)
         # hot-upgrade baseline LAST, after every boot-time lazy import,
         # so `vmq-admin updo diff` is relative to what this boot loaded
         # (vmq_updo.erl:60-71 diffs loaded vsn vs on-disk beam); modules

@@ -349,6 +349,18 @@ DEFAULTS: Dict[str, Any] = {
     # device dispatch profiler ring (records kept for `vmq-admin
     # profile device` / `timeline dump`)
     "profiler_capacity": 2048,
+    # control-plane event journal ring (observability/events.py):
+    # breaker/governor/watchdog/supervisor/mesh/spool/wire transitions
+    # kept for `vmq-admin events show|dump` and trace interleaving
+    "events_capacity": 2048,
+    # canary SLO probe (observability/canary.py): a loopback subscriber
+    # + a periodic synthetic publish through the FULL path feeding the
+    # e2e_canary_ms histogram and the canary_slo_breaches burn counter.
+    # Off by default: the probe adds one routing-table row and a
+    # publish per interval — opt in per deployment.
+    "canary_enabled": False,
+    "canary_interval_ms": 1000,
+    "canary_slo_ms": 250.0,
     "crl_refresh_interval": 60.0,  # seconds (vmq_crl_srv schema knob)
     "swc_replication_groups": 8,  # reference runs 10 (vmq_swc_plugin.erl:36-44)
     "swc_sync_interval": 2.0,  # seconds between AE rounds (sync_interval)

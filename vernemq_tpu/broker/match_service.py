@@ -58,6 +58,7 @@ from typing import Any, Deque, Dict, Iterable, List, Optional, Sequence, \
 
 from ..models.trie import SubscriptionTrie
 from ..models.tpu_matcher import DeviceDegraded
+from ..observability import events as _events
 from ..observability import histogram as obs
 from ..parallel.shm_ring import RingClosed, RingFull, ShmRing, \
     WorkerStatsBlock
@@ -367,6 +368,7 @@ class MatchService:
         # block is the only way they reach a worker's scrape endpoint
         try:
             self.stats.write_service_hist(obs.pack_all())
+            self.stats.write_service_events(_events.journal().pack())
         except Exception:
             pass  # an old-layout block (no hist region) stays healthy
 
@@ -549,7 +551,8 @@ class MatchServiceClient:
         self.node_name = node_name
         self.timeout_s = timeout_ms / 1e3
         self.breaker = breaker or CircuitBreaker(
-            failure_threshold=3, backoff_initial=0.5, backoff_max=5.0)
+            failure_threshold=3, backoff_initial=0.5, backoff_max=5.0,
+            name="match_client")
         self._mux = _ResponseMux(self.resp)
         self._req_lock = threading.Lock()  # single-producer discipline
         # drain stale replies a dead predecessor (same worker identity,

@@ -739,6 +739,7 @@ class Registry:
         msg: Msg,
         from_sid: Optional[SubscriberId] = None,
         reg_view: Optional[str] = None,
+        trace=None,
     ) -> int:
         """Retain handling + fold + enqueue; returns number of local matches
         (used for the v5 no-matching-subscribers reason code).
@@ -754,7 +755,7 @@ class Registry:
             name = "trie"
         rows = self.reg_view(name).fold(msg.mountpoint, msg.topic)
         rows = self._filter_rows_host(msg, rows)
-        return self.route_rows(msg, rows, from_sid)
+        return self.route_rows(msg, rows, from_sid, trace=trace)
 
     def _filter_rows_host(self, msg: Msg, rows):
         """Payload-predicate phase for the synchronous fold paths (the
@@ -787,7 +788,7 @@ class Registry:
         msg = self._pre_publish(msg)
         rows = await self.broker.batch_collector().submit(
             msg.mountpoint, msg.topic, trace, feat=self._filters_feat(msg))
-        return self.route_rows(msg, rows, from_sid)
+        return self.route_rows(msg, rows, from_sid, trace=trace)
 
     def publish_nowait(self, msg: Msg,
                        from_sid: Optional[SubscriberId] = None,
@@ -809,7 +810,7 @@ class Registry:
             if exc is not None:
                 self.broker.metrics.incr("mqtt_publish_error")
                 return
-            self.route_rows(msg, f.result(), from_sid)
+            self.route_rows(msg, f.result(), from_sid, trace=trace)
             if trace is not None:
                 trace.stamp("route")
                 self.broker.recorder.finish(trace)
@@ -972,13 +973,17 @@ class Registry:
         rows: Iterable[Tuple[Tuple[str, ...], Any, SubOpts]],
         from_sid: Optional[SubscriberId],
         origin_local: bool = True,
+        trace=None,
     ) -> int:
         """The fold body (vmq_reg:publish/3 fold fun, vmq_reg.erl:326-353):
         local rows enqueue, shared rows collect into groups, node rows
         forward. Shared groups then go through policy selection.
         ``origin_local=False`` (publish arriving over the cluster channel)
         serves local plain rows only — node and group rows were already
-        covered by the origin node (vmq_cluster_com.erl:198-203)."""
+        covered by the origin node (vmq_cluster_com.erl:198-203).
+        ``trace`` (a sampled publish's flight-recorder context) rides
+        node-row forwards onto the cluster envelope so the receiving
+        node resumes it (one cross-node Perfetto trace)."""
         matches = 0
         groups: Dict[str, List[Tuple[SubscriberId, SubOpts]]] = {}
         forwarded_nodes = set()  # one msg frame per remote node per publish
@@ -1011,7 +1016,13 @@ class Registry:
                     # supports it — False back means dropped, visibly.
                     forwarded_nodes.add(key)
                     if self.remote_publish is not None:
-                        if self.remote_publish(key, msg):
+                        # keyword only when a trace rides along: test
+                        # stubs and older embeddings keep their 2-arg
+                        # remote_publish signature
+                        ok = (self.remote_publish(key, msg, trace=trace)
+                              if trace is not None
+                              else self.remote_publish(key, msg))
+                        if ok:
                             self.broker.metrics.incr("router_matches_remote")
                         else:
                             self.broker.metrics.incr("cluster_publish_drop")
@@ -1034,12 +1045,30 @@ class Registry:
             self.broker.metrics.incr("router_matches_local", matches)
         return matches
 
-    def publish_from_remote(self, msg: Msg) -> int:
+    def publish_from_remote(self, msg: Msg, trace=None) -> int:
         """Entry for ``msg`` frames from the cluster channel: fold the local
-        view, local subscribers only (vmq_cluster_com.erl:153-157)."""
+        view, local subscribers only (vmq_cluster_com.erl:153-157).
+
+        This is a flight-recorder ADMISSION point: a cluster-ingress
+        publish without a propagated context competes in the same
+        1-in-N sample count as local publishes (the recorder used to be
+        blind to remote traffic — the one admission decision lived only
+        in ``session._handle_publish``). A ``trace`` resumed from the
+        origin node's envelope context takes precedence: its sample
+        decision was already made at the origin, and the finished
+        record carries both nodes' stamps."""
+        if trace is None:
+            trace = self.broker.recorder.admit(
+                "(cluster)", "/".join(msg.topic), msg.qos)
+            if trace is not None:
+                trace.stamp("remote_recv")
         rows = self.reg_view("trie").fold(msg.mountpoint, msg.topic)
         rows = self._filter_rows_host(msg, rows)
-        return self.route_rows(msg, rows, None, origin_local=False)
+        n = self.route_rows(msg, rows, None, origin_local=False)
+        if trace is not None:
+            trace.stamp("route")
+            self.broker.recorder.finish(trace)
+        return n
 
     def enqueue_remote(self, sid: SubscriberId, msgs: List[Msg]) -> bool:
         """Entry for ``enq`` frames (remote shared-sub delivery and queue

@@ -89,6 +89,10 @@ FLAT_ALIASES.update({
     "observability.sample_n": "flight_recorder_sample_n",
     "observability.recorder_capacity": "flight_recorder_capacity",
     "observability.profiler_capacity": "profiler_capacity",
+    "observability.events_capacity": "events_capacity",
+    "observability.canary_enabled": "canary_enabled",
+    "observability.canary_interval_ms": "canary_interval_ms",
+    "observability.canary_slo_ms": "canary_slo_ms",
 })
 
 #: extension family: the mesh-native matcher (parallel/mesh_match.py)

@@ -811,7 +811,8 @@ class Session:
                     n = await self.broker.registry.publish_async(
                         msg, from_sid=self.sid, trace=trace)
             else:
-                n = self.broker.registry.publish(msg, from_sid=self.sid)
+                n = self.broker.registry.publish(msg, from_sid=self.sid,
+                                                 trace=trace)
             if trace is not None:
                 trace.stamp("route")
                 self.broker.recorder.finish(trace)

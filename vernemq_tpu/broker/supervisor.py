@@ -15,6 +15,8 @@ import asyncio
 import logging
 from typing import Any, Awaitable, Callable, Dict, Optional
 
+from ..observability import events
+
 log = logging.getLogger("vernemq_tpu.supervisor")
 
 
@@ -82,6 +84,8 @@ class Supervisor:
                     return
                 self.restarts[name] = self.restarts.get(name, 0) + 1
                 self.broker.metrics.incr("supervisor_restarts")
+                events.emit("supervisor_restart", detail=name,
+                            value=float(self.restarts[name]))
                 # a healthy stint (longer than the current backoff, or
                 # past the restart window outright) resets the ramp AND
                 # the budget: only consecutive rapid crashes climb
@@ -112,6 +116,7 @@ class Supervisor:
         health checks loudly, not limp with a dead subsystem."""
         self.escalated[name] = self.escalated.get(name, 0) + 1
         self.broker.metrics.incr("supervisor_escalations")
+        events.emit("supervisor_escalation", detail=name)
         log.error("supervised task %r exceeded the restart budget "
                   "(%d consecutive crashy restarts); escalating: tearing "
                   "down listeners", name, self.max_restarts)

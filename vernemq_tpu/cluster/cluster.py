@@ -24,6 +24,8 @@ import logging
 import time
 from typing import Any, Dict, List, Optional, Set, Tuple
 
+from ..observability import events
+from ..observability import histogram as _hist
 from .com import ClusterCom
 from .metadata import MetadataStore
 from .node import NodeWriter, frame, msg_to_term
@@ -365,6 +367,13 @@ class Cluster:
             # which peers EVALUATE it, for `cluster show` diagnosis of
             # mixed-version deployments
             caps.append("flt")
+        if _hist.enabled():
+            # cross-node trace propagation (observability/recorder.py):
+            # this node RESUMES a sampled publish's trace context from
+            # the envelope's optional trace field. Peers without the
+            # cap (old versions, observability off) get byte-identical
+            # pre-trace framing — the field is never attached to them.
+            caps.append("trace")
         return {"node": self.node_name,
                 "addr": [self.listen_host, self.listen_port],
                 "caps": caps,
@@ -508,19 +517,36 @@ class Cluster:
     def writer(self, node: str) -> Optional[NodeWriter]:
         return self._writers.get(node)
 
-    def publish(self, node: str, msg) -> bool:
+    def publish(self, node: str, msg, trace=None) -> bool:
         """Data-plane publish forward (vmq_cluster:publish/2). The QoS
         split: QoS 0 keeps the reference's fire-and-forget ``msg`` frame
         (sheddable under buffer pressure); QoS ≥ 1 to a spool-capable
         peer is journaled first and shipped as a seq-tagged ``msq`` frame
-        — True then means durably accepted, not necessarily sent."""
+        — True then means durably accepted, not necessarily sent.
+
+        ``trace`` (a sampled publish's flight-recorder context) rides
+        the msg term's optional ``trc`` field to a trace-capable peer —
+        negotiated via the hlo caps, so a peer without the cap (old
+        version, observability off) receives byte-identical pre-trace
+        framing on BOTH the legacy and the spooled path. A spooled
+        traced frame journals its context too: a replay re-delivers it
+        and the receiver's dedup gate decides exactly once."""
         w = self._writers.get(node)
         if w is None:
             self.metrics.incr("cluster_publish_no_channel")
             return False
+        term = msg_to_term(msg)
+        if trace is not None and self._peer_traces(node):
+            term["trc"] = trace.export_wire(self.node_name)
+            if not trace.marks or trace.marks[-1][0] != "forward":
+                # one forward mark per PUBLISH, not per remote node: a
+                # multi-node fanout calls this per node, and duplicate
+                # labels would overwrite each other in the finished
+                # record's stage dict (last hop wins, first hop lost)
+                trace.stamp("forward")
         if msg.qos > 0 and self._peer_spools(node):
-            return self._spool_send(node, w, "msg", msg_to_term(msg))
-        return w.publish(msg)
+            return self._spool_send(node, w, "msg", term)
+        return w.send_frame(frame(b"msg", term), sheddable=msg.qos == 0)
 
     def enqueue_nowait(self, node: str, sid, msgs: List[Any]) -> bool:
         """Fire-and-forget remote enqueue (shared-subscription delivery to a
@@ -538,6 +564,14 @@ class Cluster:
     def _peer_spools(self, node: str) -> bool:
         return (self.spool is not None
                 and "spool" in self._peer_caps.get(node, ()))
+
+    def _peer_traces(self, node: str) -> bool:
+        """May a trace context ride the envelope to ``node``? Both ends
+        must opt in: the peer advertised the "trace" cap AND this
+        node's observability is on (off must keep the wire byte-
+        identical, per the config-3 zero-cost guarantee)."""
+        return (_hist.enabled()
+                and "trace" in self._peer_caps.get(node, ()))
 
     def _spool_send(self, node: str, w: NodeWriter, kind: str, term) -> bool:
         """Journal-then-send for one QoS ≥ 1 frame. A refused journal
@@ -636,6 +670,9 @@ class Cluster:
                             and w.status == "up"
                             and now - st.last_progress_at >= stall_s):
                         self.metrics.incr("cluster_stall_reconnects")
+                        events.emit("cluster_ack_stall", detail=node,
+                                    value=round(
+                                        now - st.last_progress_at, 3))
                         if wd is not None:
                             wd.note_cluster_stall()
                             op = ack_ops.pop(node, None)

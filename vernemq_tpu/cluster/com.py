@@ -115,9 +115,17 @@ class ClusterCom:
     def _dispatch(self, origin: str, cmd: bytes, term) -> None:
         cluster = self.cluster
         if cmd == b"msg":
-            # remote publish: local subscribers only (origin covered the rest)
+            # remote publish: local subscribers only (origin covered the
+            # rest). The optional "trc" field is the origin's sampled
+            # flight-recorder context (negotiated via the "trace" hlo
+            # cap): RESUME it so the record carries both nodes' stamps
+            # — publish_from_remote is an admission point either way.
+            trc = term.pop("trc", None) if isinstance(term, dict) else None
             msg = term_to_msg(term)
-            cluster.broker.registry.publish_from_remote(msg)
+            trace = None
+            if trc is not None:
+                trace = cluster.broker.recorder.resume(trc, origin)
+            cluster.broker.registry.publish_from_remote(msg, trace=trace)
         elif cmd == b"msq":
             # spooled seq-tagged envelope (cluster/spool.py): dedup on
             # (seq, msg_ref) per origin — a replay after a lost ack must

@@ -27,6 +27,8 @@ import logging
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
+from ..observability import events
+
 log = logging.getLogger("vernemq_tpu.mesh")
 
 PREFIX = "mesh_slices"
@@ -94,6 +96,9 @@ class MeshSliceMap:
             self.adoptions += 1
             log.info("claimed mesh slices %s (of %d) for %s", newly,
                      self.n_slices, self.node_name)
+            events.emit("mesh_slice_claim",
+                        detail=",".join(map(str, newly)),
+                        value=float(len(newly)))
             if self.on_adopt is not None:
                 self.on_adopt(newly, (self.node_name, self._epoch))
         return newly
@@ -113,6 +118,9 @@ class MeshSliceMap:
         if released:
             log.warning("released mesh slices %s: this node cannot "
                         "serve them", released)
+            events.emit("mesh_slice_release",
+                        detail=",".join(map(str, released)),
+                        value=float(len(released)))
         return released
 
     def _on_change(self, key: Any, old: Any, new: Any, origin: str) -> None:
@@ -125,6 +133,7 @@ class MeshSliceMap:
                 and (old is None or old.get("node") != self.node_name)
                 and self.on_adopt is not None):
             self.adoptions += 1
+            events.emit("mesh_slice_adopt", detail=f"{key}<-{origin}")
             # token = (writer, its epoch): epochs are per-node
             # counters, so the claimer must ride in the exactly-once
             # key or two nodes' colliding counters suppress a replay

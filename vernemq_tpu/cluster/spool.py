@@ -47,6 +47,8 @@ import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..observability import events
+from ..observability.recorder import clock_sync
 from ..robustness import faults
 from .node import frame
 
@@ -365,9 +367,12 @@ class ClusterSpool:
             t_j = st.journaled_at.pop(s, None)
             if t_j is not None:
                 # journal->cumulative-ack round trip per frame: the
-                # measured base for cluster_stall_timeout_s tuning
-                self.metrics.observe("stage_cluster_ack_rtt_ms",
-                                     (now - t_j) * 1e3)
+                # measured base for cluster_stall_timeout_s tuning AND
+                # the per-peer clock-offset estimate merged cross-node
+                # traces ride on (observability/recorder.ClockSync)
+                rtt_ms = (now - t_j) * 1e3
+                self.metrics.observe("stage_cluster_ack_rtt_ms", rtt_ms)
+                clock_sync().observe_rtt(peer, rtt_ms)
             n += 1
         if n:
             st.last_ack_at = time.monotonic()
@@ -412,6 +417,8 @@ class ClusterSpool:
         if not send(frame(b"msb", low)):
             st.blocked = True
             return 0
+        events.emit("spool_replay_start", detail=peer,
+                    value=float(len(st.pending)))
         # pending is a CONTIGUOUS seq run [low..high] (acks are
         # cumulative), so the sweep walks seqs directly and point-reads
         # the journal — O(frames shipped) per call, never a full
@@ -443,6 +450,7 @@ class ClusterSpool:
         if sent:
             st.last_ack_at = time.monotonic()
             self.metrics.incr("cluster_spool_replayed", sent)
+        events.emit("spool_replay_end", detail=peer, value=float(sent))
         return sent
 
     def flush(self, peer: Optional[str] = None) -> Tuple[int, int]:

@@ -230,7 +230,8 @@ class FilterEngine:
         self.breaker: Optional[CircuitBreaker] = (CircuitBreaker(
             failure_threshold=breaker_failure_threshold,
             backoff_initial=breaker_backoff_initial,
-            backoff_max=breaker_backoff_max) if breaker_enabled else None)
+            backoff_max=breaker_backoff_max,
+            name="predicate") if breaker_enabled else None)
         #: pairs below this are host-evaluated (no device round trip —
         #: the predicate analog of the collector's hybrid threshold)
         self.host_threshold = host_threshold

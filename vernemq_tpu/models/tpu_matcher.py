@@ -355,7 +355,7 @@ class TpuMatcher:
         # device exception escaping the matcher would fail publishes —
         # but reconfigurable (TpuRegView applies the tpu_breaker_*
         # knobs; None disables and re-raises device errors verbatim).
-        self.breaker: Optional[CircuitBreaker] = CircuitBreaker()
+        self.breaker: Optional[CircuitBreaker] = CircuitBreaker(name="match")
         self.device_failures = 0   # dispatch/upload errors fed to it
         self.degraded_sheds = 0    # calls refused while open (host-served)
         self.delta_shapes_warmed = 0  # pre-compiled scatter ladder rungs
@@ -1556,7 +1556,8 @@ class TpuRegView:
             m.breaker = (CircuitBreaker(
                 failure_threshold=breaker_failure_threshold,
                 backoff_initial=breaker_backoff_initial,
-                backoff_max=breaker_backoff_max)
+                backoff_max=breaker_backoff_max,
+                name="match")
                 if breaker_enabled else None)
             # stall watchdog: background rebuilds register a monitored
             # op and are abandoned (breaker fed, late install discarded)

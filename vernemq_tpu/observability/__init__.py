@@ -23,17 +23,37 @@ Three pieces, all always-on and cheap enough for the publish hot path:
   plus Chrome trace-event JSON export (``vmq-admin timeline dump``,
   loadable in Perfetto).
 
+- :mod:`.events` — the control-plane event journal: a bounded ring of
+  registry-checked state-machine transitions (breaker opens, governor
+  level changes, watchdog abandons, slice adoptions, spool replays,
+  wire fallbacks) with monotonic stamps — ``vmq-admin events
+  show|dump``, the QL ``events`` table, instant events in
+  ``chrome_trace()``, per-worker shm slots merged at scrape.
+
+- :mod:`.canary` — the canary SLO probe: a loopback subscriber plus a
+  periodic synthetic publish through the FULL path, feeding the
+  ``e2e_canary_ms`` histogram and an SLO burn counter — the broker's
+  continuous black-box end-to-end signal.
+
+A trace resumed from a cluster peer (``FlightRecorder.resume``)
+carries the origin node's stamps across the negotiated cluster
+envelope, so ONE ``chrome_trace()`` dump renders per-node process
+tracks for a publish that crossed the wire (per-peer clock offsets
+estimated by :class:`~.recorder.ClockSync` from the spool ack RTT).
+
 The whole subsystem is gated by one flag (``observability_enabled``):
 off, every seam pays a single module-global boolean test.
 """
 
-from . import histogram
+from . import events, histogram
 from .histogram import observe, set_enabled, enabled
 from .profiler import DispatchProfiler, profiler
-from .recorder import FlightRecorder, PublishTrace, chrome_trace
+from .recorder import (ClockSync, FlightRecorder, PublishTrace,
+                       chrome_trace, clock_sync)
 
 __all__ = [
-    "histogram", "observe", "set_enabled", "enabled",
+    "events", "histogram", "observe", "set_enabled", "enabled",
     "DispatchProfiler", "profiler",
-    "FlightRecorder", "PublishTrace", "chrome_trace",
+    "ClockSync", "FlightRecorder", "PublishTrace", "chrome_trace",
+    "clock_sync",
 ]

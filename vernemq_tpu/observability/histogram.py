@@ -102,6 +102,12 @@ STAGE_FAMILIES: List[Tuple[str, str]] = [
      "iovec build and per-recipient transport writes, observed PER "
      "FANOUT (the writev-ready encode seam; informs the wire "
      "fast-path share vs the classic Msg path)."),
+    ("e2e_canary_ms",
+     "Canary SLO probe end-to-end latency: a synthetic loopback "
+     "publish through the FULL path (admission -> collector -> device "
+     "-> route -> queue delivery), the broker's continuous black-box "
+     "signal (observability/canary.py; canary_slo_ms breaches burn "
+     "the canary_slo_breaches counter)."),
 ]
 
 _ENABLED = True

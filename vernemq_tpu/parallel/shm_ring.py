@@ -24,10 +24,12 @@ Two primitives, both over ``multiprocessing.shared_memory``:
 
 - :class:`WorkerStatsBlock` — a fixed-layout per-worker stats table
   (pid, heartbeat, overload level/pressure, session + admitted-publish
-  counters, a small loop-lag sample ring) plus a service header
+  counters, a small loop-lag sample ring, a packed stage-histogram
+  block, and a packed control-plane EVENT ring) plus a service header
   (epoch/generation/heartbeat). Every worker writes its own slot and
   reads everyone else's: this is how per-worker ``OverloadGovernor``
-  instances fuse into one cluster-style aggregate pressure level, and
+  instances fuse into one cluster-style aggregate pressure level, how
+  histograms and the event journal merge at the scrape point, and
   what ``vmq-admin workers show`` / bench config 11 read.
 
 Blocking helpers (``pop_wait``/``push_wait``) exist for plain-thread
@@ -328,32 +330,45 @@ class WorkerStatsBlock:
         if magic != _STATS_MAGIC:
             raise ValueError(f"not a WorkerStatsBlock: {shm.name}")
         self.n_workers = n
-        # per-worker stage-histogram block layout (observability
-        # scrape-point aggregation): written by create(), read here so
-        # both sides agree without recompiling constants
+        # per-worker stage-histogram + event-ring block layout
+        # (observability scrape-point aggregation): written by
+        # create(), read here so both sides agree without recompiling
+        # constants (a stale pre-events segment reads ev_f64 = 0 and
+        # simply has no event region)
         self._hist_f64 = struct.unpack_from("<I", self._buf, 120)[0]
-        self._slot_bytes = _SLOT_FIXED + self._hist_f64 * 8
+        self._ev_f64 = struct.unpack_from("<I", self._buf, 124)[0]
+        self._slot_bytes = _SLOT_FIXED + (self._hist_f64
+                                          + self._ev_f64) * 8
 
     @classmethod
     def create(cls, name: str, n_workers: int,
-               hist_f64: Optional[int] = None) -> "WorkerStatsBlock":
+               hist_f64: Optional[int] = None,
+               ev_f64: Optional[int] = None) -> "WorkerStatsBlock":
         """``hist_f64`` — flat f64 width of one histogram block
         (defaults to the full STAGE_FAMILIES pack width; 0 disables the
-        region). One block per worker slot plus ONE for the match
-        service process: the device-side seams (dispatch, delta,
-        rebuild) run in the service, which has no scrape endpoint of
-        its own — its block is how those observations reach a worker's
-        /metrics."""
+        region); ``ev_f64`` — flat f64 width of one packed event ring
+        (defaults to events.PACK_WIDTH; 0 disables). One of each per
+        worker slot plus ONE per region for the match service process:
+        the device-side seams (dispatch, delta, rebuild) and the
+        service's own control-plane transitions happen in the service,
+        which has no scrape endpoint of its own — its blocks are how
+        those observations reach a worker's /metrics and a merged
+        event dump."""
         if hist_f64 is None:
             from ..observability import histogram as _hist
 
             hist_f64 = len(_hist.STAGE_FAMILIES) * _hist.FLAT_WIDTH
-        slot = _SLOT_FIXED + hist_f64 * 8
-        size = _STATS_HDR + n_workers * slot + hist_f64 * 8
+        if ev_f64 is None:
+            from ..observability import events as _events
+
+            ev_f64 = _events.PACK_WIDTH
+        slot = _SLOT_FIXED + (hist_f64 + ev_f64) * 8
+        size = _STATS_HDR + n_workers * slot + (hist_f64 + ev_f64) * 8
         shm = shared_memory.SharedMemory(name=name, create=True, size=size)
         shm.buf[:size] = b"\x00" * size
         struct.pack_into("<II", shm.buf, 0, _STATS_MAGIC, n_workers)
         struct.pack_into("<I", shm.buf, 120, hist_f64)
+        struct.pack_into("<I", shm.buf, 124, ev_f64)
         return cls(shm, owner=True)
 
     @classmethod
@@ -473,6 +488,40 @@ class WorkerStatsBlock:
         b = self._base(idx) + _SLOT_FIXED
         return list(struct.unpack_from(f"<{self._hist_f64}d",
                                        self._buf, b))
+
+    # ---------------------------------------------------- event slots
+
+    def write_events(self, idx: int, flat: List[float]) -> None:
+        """Publish this worker's packed event ring
+        (observability.events.EventJournal.pack) into its slot. Single
+        writer per slot; a torn read at worst drops/garbles one entry,
+        which unpack() skips and the next heartbeat repairs."""
+        if not self._ev_f64:
+            return
+        b = self._base(idx) + _SLOT_FIXED + self._hist_f64 * 8
+        k = min(len(flat), self._ev_f64)
+        struct.pack_into(f"<{k}d", self._buf, b, *flat[:k])
+
+    def read_events(self, idx: int) -> List[float]:
+        if not self._ev_f64:
+            return []
+        b = self._base(idx) + _SLOT_FIXED + self._hist_f64 * 8
+        return list(struct.unpack_from(f"<{self._ev_f64}d", self._buf,
+                                       b))
+
+    def write_service_events(self, flat: List[float]) -> None:
+        if not self._ev_f64:
+            return
+        b = self._service_hist_base() + self._hist_f64 * 8
+        k = min(len(flat), self._ev_f64)
+        struct.pack_into(f"<{k}d", self._buf, b, *flat[:k])
+
+    def read_service_events(self) -> List[float]:
+        if not self._ev_f64:
+            return []
+        b = self._service_hist_base() + self._hist_f64 * 8
+        return list(struct.unpack_from(f"<{self._ev_f64}d", self._buf,
+                                       b))
 
     def _service_hist_base(self) -> int:
         return _STATS_HDR + self.n_workers * self._slot_bytes

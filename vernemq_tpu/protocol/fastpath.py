@@ -37,6 +37,7 @@ import os
 import struct
 from typing import Optional, Tuple
 
+from ..observability import events
 from ..robustness import faults
 from ..robustness.breaker import CircuitBreaker
 from .types import (PINGREQ, PINGRESP, PUBACK, PUBCOMP, PUBLISH, PUBREC,
@@ -88,7 +89,7 @@ _force_pure = False
 #: half-open probe succeeds. One process-global breaker — the codec is
 #: process-global state, not per-mountpoint.
 breaker = CircuitBreaker(failure_threshold=3, backoff_initial=1.0,
-                         backoff_max=30.0)
+                         backoff_max=30.0, name="wire")
 
 # wire-plane counters (process-global like robustness/faults; surfaced
 # as gauges through Registry.stats -> broker._gauges)
@@ -175,6 +176,7 @@ def parse_batch(data, max_size: int = 0,
             except Exception:
                 native_errors += 1
                 if breaker.record_failure():
+                    events.emit("wire_fallback", detail="parse")
                     log.error("native wire parse failed; breaker open — "
                               "serving the pure-Python codec",
                               exc_info=True)
@@ -343,6 +345,7 @@ def publish_header(topic: str, qos: int, retain: bool, dup: bool,
             global native_errors
             native_errors += 1
             if breaker.record_failure():
+                events.emit("wire_fallback", detail="encode")
                 log.error("native wire encode failed; breaker open — "
                           "serving the pure-Python codec", exc_info=True)
     return _publish_header_py(topic, qos, retain, dup, packet_id,

@@ -119,7 +119,8 @@ class RetainedIndex:
         self._enc_cache: Dict[Tuple[str, ...], tuple] = {}
         self._enc_gen: tuple = (-1, -1, -1)
         self.breaker = (breaker if breaker is not None
-                        else (CircuitBreaker() if breaker_enabled else None))
+                        else (CircuitBreaker(name="retained")
+                              if breaker_enabled else None))
         self._closed = False
         # mid-warm-load delta buffer (warm_load_async): non-None while a
         # chunked load is in flight; on_retain writes land here instead
@@ -701,7 +702,8 @@ class RetainedEngine:
             breaker=(CircuitBreaker(
                 failure_threshold=breaker_failure_threshold,
                 backoff_initial=breaker_backoff_initial,
-                backoff_max=breaker_backoff_max)
+                backoff_max=breaker_backoff_max,
+                name="retained")
                 if breaker_enabled else None),
             breaker_enabled=breaker_enabled,
             watchdog=watchdog, rebuild_deadline_s=rebuild_deadline_s)

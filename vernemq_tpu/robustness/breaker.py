@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
+from ..observability import events
+
 CLOSED, HALF_OPEN, OPEN = 0, 1, 2
 _NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
 
@@ -46,7 +48,12 @@ class CircuitBreaker:
                  backoff_initial: float = 0.2, backoff_max: float = 10.0,
                  jitter: float = 0.1,
                  clock: Callable[[], float] = time.monotonic,
-                 rng: Optional[random.Random] = None):
+                 rng: Optional[random.Random] = None,
+                 name: str = ""):
+        #: breaker path name for the control-plane event journal
+        #: (BREAKER_PATHS spelling, or "<path>:<mountpoint>"); unnamed
+        #: breakers (tests, embedded) journal with an empty detail
+        self.name = name
         self.failure_threshold = max(1, failure_threshold)
         self.backoff_initial = backoff_initial
         self.backoff_max = backoff_max
@@ -123,6 +130,7 @@ class CircuitBreaker:
             if self._state == OPEN and self._clock() >= self._retry_at:
                 self._state = HALF_OPEN
                 self.probes += 1
+                events.emit("breaker_half_open", detail=self.name)
                 return True
             return False
 
@@ -145,6 +153,7 @@ class CircuitBreaker:
             if self._degraded_since is not None:
                 self._time_degraded += self._clock() - self._degraded_since
                 self._degraded_since = None
+            events.emit("breaker_close", detail=self.name)
             return True
 
     def record_failure(self) -> bool:
@@ -201,6 +210,7 @@ class CircuitBreaker:
             self._forced = False
             if self._state != CLOSED:
                 self.closes += 1
+                events.emit("breaker_close", detail=self.name)
             self._state = CLOSED
             self._consecutive = 0
             self._backoff = self.backoff_initial
@@ -211,6 +221,8 @@ class CircuitBreaker:
     def _open_locked(self) -> None:
         self._state = OPEN
         self.opens += 1
+        events.emit("breaker_open", detail=self.name,
+                    value=float(self._consecutive))
         # full jitter on the retry deadline: concurrent matchers must
         # not probe in lockstep after a shared outage
         self._retry_at = self._clock() + self._backoff * (

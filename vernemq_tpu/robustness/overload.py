@@ -87,6 +87,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import faults
+from ..observability import events
 
 log = logging.getLogger("vernemq_tpu.overload")
 
@@ -448,11 +449,16 @@ class OverloadGovernor:
             log.warning("overload level %d -> %d (%s): pressure=%.2f %s",
                         prev, level, LEVEL_NAMES[level],
                         self._last_pressure, self._last_signals)
+            events.emit("overload_level_enter",
+                        detail=f"{LEVEL_NAMES[level]} {self._last_signals}",
+                        value=float(level))
             if level >= 3:
                 self._shed_top_talkers()
         elif level < prev:
             log.info("overload level %d -> %d (recovered to %s)",
                      prev, level, LEVEL_NAMES[level])
+            events.emit("overload_level_exit",
+                        detail=LEVEL_NAMES[level], value=float(level))
 
     # ------------------------------------------------------------ responses
 

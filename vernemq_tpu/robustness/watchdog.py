@@ -58,6 +58,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from . import faults
+from ..observability import events
 
 log = logging.getLogger("vernemq_tpu.watchdog")
 
@@ -324,6 +325,8 @@ class StallWatchdog:
                     # (an unretrieved exception would only spam logs)
                     with self._lock:
                         self.late_discarded += 1
+                    events.emit("watchdog_late_discard",
+                                detail=f"{op.point} error")
                     log.info("abandoned %s [%s] completed late with an "
                              "error (discarded)", op.point, op.label)
                     return None
@@ -331,6 +334,9 @@ class StallWatchdog:
             if op.abandoned:
                 with self._lock:
                     self.late_discarded += 1
+                events.emit("watchdog_late_discard",
+                            detail=f"{op.point} {op.label}".strip(),
+                            value=round(op.age(), 4))
                 log.warning(
                     "abandoned %s [%s] completed at age %.3fs (deadline "
                     "%.3fs); result discarded (never delivered)",
@@ -357,9 +363,18 @@ class StallWatchdog:
                 # the worker running this op is lost to it until the
                 # wedge ends; the pool spawns around it
                 self.sacrificed += 1
-            if not op.stalled:
+            newly_stalled = not op.stalled
+            if newly_stalled:
                 op.stalled = True
                 self.stalls += 1
+        detail = f"{op.point} {op.label}".strip()
+        if newly_stalled:
+            # a deadline-released dispatch abandons without passing
+            # through the monitor scan: its stall event is owed here
+            events.emit("watchdog_stall", detail=detail,
+                        value=round(op.age(), 4))
+        events.emit("watchdog_abandon", detail=detail,
+                    value=round(op.age(), 4))
         # an injected wedge at this point ends at abandonment: the
         # sacrificial thread unblocks, completes late, and exercises
         # the discard path — the deterministic drill for real hangs
@@ -436,6 +451,9 @@ class StallWatchdog:
             log.warning("stall: %s [%s] in flight %.3fs past its %.3fs "
                         "deadline", op.point, op.label, op.age(now),
                         op.deadline_s)
+            events.emit("watchdog_stall",
+                        detail=f"{op.point} {op.label}".strip(),
+                        value=round(op.age(now), 4))
             if op.on_stall is not None:
                 # on_stall ops carry abandon semantics (rebuild threads):
                 # the callback marks the registrant's token/breaker, and
@@ -455,6 +473,8 @@ class StallWatchdog:
         with the dispatch-level late discards."""
         with self._lock:
             self.late_discarded += 1
+        events.emit("watchdog_late_discard",
+                    detail=f"{point} {why}".strip())
         log.warning("late completion of abandoned %s discarded%s",
                     point, f" ({why})" if why else "")
 
