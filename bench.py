@@ -844,6 +844,11 @@ def config7_partition_storm(smoke):
             got[m.payload] = got.get(m.payload, 0) + 1
         replayed = a[0].metrics.value("cluster_spool_replayed")
         deduped = b[0].metrics.value("cluster_spool_deduped")
+        # which engine served the journal (native kvstore / segment-log
+        # fallback / memory): replay-throughput numbers are only
+        # comparable across boxes with this recorded
+        journal_engine = getattr(getattr(a[2], "spool", None),
+                                 "engine_kind", "memory")
 
         await sub.disconnect()
         await pub.disconnect()
@@ -863,6 +868,7 @@ def config7_partition_storm(smoke):
 
         return {
             "storm_publishes": n_storm, "storm_s": storm_s,
+            "journal_engine": journal_engine,
             "healthy_publish_ms_p50": pct(healthy_lat, 0.50),
             "healthy_publish_ms_p99": pct(healthy_lat, 0.99),
             "degraded_publish_ms_p50": pct(storm_lat, 0.50),
@@ -2175,6 +2181,187 @@ def config13_downsampling_storm(smoke, seed):
     }
 
 
+def config14_reconnect_storm(smoke, sessions=None, backlog=10,
+                             broadcast=5):
+    """Storage-tier config: a reconnect storm of persistent sessions
+    with stored offline backlogs against a freshly-booted broker — the
+    million-offline-session workload (ROADMAP direction 3 / ISSUE 14).
+
+    The corpus is the IoT-benchmark paper's fan-out-notification shape:
+    each session's backlog is ``broadcast`` messages shared by EVERY
+    session (one refcounted payload m-record each — the broadcast that
+    landed while everyone was asleep) plus ``backlog - broadcast``
+    per-session messages (unique refs — per-device commands).
+
+    Two legs on identical corpora drive the queue/store resume seam
+    directly (queue create → recover → attach; registration machinery
+    is identical in both and would only add constant cost):
+
+    - ``batched``: the ResumeCollector coalesces concurrent replays
+      into off-loop ``read_many`` batches (lazy boot, staged delivery,
+      cross-session decode cache: a broadcast decodes once per batch)
+    - ``read_all`` baseline: the pre-PR path — one synchronous
+      loop-side ``read_all`` + enqueue loop per session, which pays
+      every broadcast decode per session (same session count, so
+      loop-lag/GC pressure is apples-to-apples)
+
+    Reports per-session replay latency p50/p99, event-loop lag p99
+    sampled through the storm, zero-QoS1-loss parity (every stored
+    message delivered exactly once, in order), the batched-vs-baseline
+    replay throughput speedup, and which journal engine served
+    (native kvstore / segment fallback) so numbers are comparable
+    across boxes."""
+    import asyncio
+    import shutil
+    import tempfile
+
+    n_sessions = sessions or (20_000 if smoke else 100_000)
+    # equal scale in both legs: loop-lag/GC pressure must be
+    # apples-to-apples, not a 10x-smaller baseline flattered by a
+    # smaller heap
+    n_baseline = n_sessions
+    n_unique = backlog - broadcast
+
+    async def leg(batched, n):
+        from vernemq_tpu.broker.config import Config
+        from vernemq_tpu.broker.message import Msg
+        from vernemq_tpu.broker.queue import QueueOpts
+        from vernemq_tpu.broker.server import start_broker
+
+        tmp = tempfile.mkdtemp(prefix="vmq-resume-bench-")
+        cfg = Config(systree_enabled=False, allow_anonymous=True,
+                     message_store="file", message_store_dir=tmp,
+                     resume_batched=batched)
+        broker, server = await start_broker(cfg, port=0)
+        try:
+            sids = [("", f"c{i}") for i in range(n)]
+            bcast = [Msg(topic=("bcast", str(j)),
+                         payload=b"B%d" % j * 8, qos=1,
+                         msg_ref=b"bcast-%d" % j)
+                     for j in range(broadcast)]
+            t0 = time.perf_counter()
+            for i, sid in enumerate(sids):
+                for m in bcast:  # shared ref: stored payload is ONE
+                    broker.msg_store.write(sid, m)
+                for j in range(n_unique):
+                    broker.msg_store.write(sid, Msg(
+                        topic=("r", sid[1]), payload=b"p%d" % j, qos=1,
+                        msg_ref=(f"{sid[1]}-{j}").encode()))
+                if (i + 1) % 1000 == 0:
+                    await asyncio.sleep(0)
+            populate_s = time.perf_counter() - t0
+            broker.msg_store.commit()
+
+            # loop-lag sampler through the storm (config 11 discipline)
+            lags = []
+            stop_probe = False
+
+            async def lag_probe(period=0.005):
+                t = time.perf_counter()
+                while not stop_probe:
+                    await asyncio.sleep(period)
+                    now = time.perf_counter()
+                    lags.append(max(0.0, now - t - period))
+                    t = now
+
+            probe = asyncio.get_event_loop().create_task(lag_probe())
+            delivered = {sid: [] for sid in sids}
+            done_at = {}
+            opts = dict(clean_session=False)
+            t_storm = time.perf_counter()
+
+            def make_deliver(sid):
+                def deliver(msg):
+                    got = delivered[sid]
+                    got.append(msg.payload)
+                    if len(got) >= backlog and sid not in done_at:
+                        done_at[sid] = time.perf_counter() - t_storm
+                    return True
+                return deliver
+
+            for i, sid in enumerate(sids):
+                q = broker.registry._start_queue(sid, QueueOpts(**opts))
+                # lazy in the batched leg (collector loads on attach);
+                # the baseline gate fails lazy and reads synchronously
+                # right here — the pre-PR read_all-per-session path
+                broker.recover_offline(sid, q, lazy=True)
+                q.add_session(object(), make_deliver(sid))
+                if (i + 1) % 200 == 0:
+                    await asyncio.sleep(0)
+            deadline = time.perf_counter() + 120
+            while (len(done_at) < len(sids)
+                   and time.perf_counter() < deadline):
+                await asyncio.sleep(0.01)
+            drain_s = time.perf_counter() - t_storm
+            stop_probe = True
+            await probe
+            expect = ([b"B%d" % j * 8 for j in range(broadcast)]
+                      + [b"p%d" % j for j in range(n_unique)])
+            bad_order = sum(1 for sid in sids
+                            if delivered[sid] != expect)
+            lat = sorted(done_at.values())
+
+            def pct(xs, q):
+                return (round(xs[min(len(xs) - 1, int(q * len(xs)))]
+                              * 1e3, 2) if xs else None)
+
+            rc = broker._resume_collector
+            out = {
+                "sessions": n, "backlog_per_session": backlog,
+                "journal_engine": getattr(broker.msg_store,
+                                          "engine_kind", "?"),
+                "populate_s": round(populate_s, 2),
+                "drain_s": round(drain_s, 3),
+                "replay_msgs_per_sec": round(
+                    len(done_at) * backlog / max(drain_s, 1e-9)),
+                "replay_ms_p50": pct(lat, 0.50),
+                "replay_ms_p99": pct(lat, 0.99),
+                "loop_lag_ms_p99": pct(sorted(lags), 0.99),
+                "loop_lag_ms_max": (round(max(lags) * 1e3, 2)
+                                    if lags else None),
+                "sessions_resumed": len(done_at),
+                "parity_ok": (len(done_at) == len(sids)
+                              and bad_order == 0
+                              and broker.metrics.value(
+                                  "queue_message_drop") == 0),
+                "resume": ({k: int(v) for k, v in rc.stats().items()}
+                           if rc is not None else None),
+            }
+            return out
+        finally:
+            await broker.stop()
+            await server.stop()
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    async def run():
+        batched = await leg(True, n_sessions)
+        baseline = await leg(False, n_baseline)
+        speedup = (batched["replay_msgs_per_sec"]
+                   / max(1, baseline["replay_msgs_per_sec"]))
+        import jax as _jax
+
+        return {
+            "cpu_smoke": _jax.devices()[0].platform != "tpu",
+            "batched": batched,
+            "read_all_baseline": baseline,
+            "speedup_vs_read_all": round(speedup, 2),
+            # bounded RELATIVE to the per-session baseline at the same
+            # scale (an absolute self-referential bound would be
+            # vacuous): the batched tail must not regress past it
+            "replay_p99_bounded": (
+                batched["replay_ms_p99"] is not None
+                and baseline["replay_ms_p99"] is not None
+                and batched["replay_ms_p99"]
+                <= baseline["replay_ms_p99"] * 1.25),
+            "loop_lag_bounded": (
+                batched["loop_lag_ms_p99"] is not None
+                and batched["loop_lag_ms_p99"] < 500.0),
+            "parity_ok": batched["parity_ok"] and baseline["parity_ok"],
+        }
+
+    return asyncio.run(run())
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--subs", type=int, default=1_000_000)
@@ -2197,7 +2384,10 @@ def main() -> int:
                     help="internal: run ONE mesh-ladder rung at this "
                     "slice count in-process (config 12 spawns these "
                     "with forced host device counts)")
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13",
+    ap.add_argument("--reconnect-sessions", type=int, default=0,
+                    help="config 14 session count override (default: "
+                         "100k, 20k on CPU smoke)")
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13,14",
                     help="which BASELINE configs to run (3 = headline; "
                     "6 = fault-storm robustness: publish p99 while the "
                     "device path is down + breaker recovery time; "
@@ -2507,6 +2697,11 @@ def main() -> int:
     if "13" in want:
         guarded("13_downsampling_storm",
                 lambda: config13_downsampling_storm(smoke, args.seed))
+
+    if "14" in want:
+        guarded("14_reconnect_storm",
+                lambda: config14_reconnect_storm(
+                    smoke, sessions=args.reconnect_sessions or None))
 
     if headline is not None:
         value = headline["matches_per_sec"]

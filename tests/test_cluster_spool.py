@@ -18,7 +18,8 @@ from test_cluster import (  # shared multi-node harness (tests dir on path)
     wait_until,
 )
 from vernemq_tpu.broker.metrics import Metrics
-from vernemq_tpu.cluster.spool import ClusterSpool, _FileJournal
+from vernemq_tpu.cluster.spool import ClusterSpool
+from vernemq_tpu.storage.segment import SegmentLogEngine
 from vernemq_tpu.robustness import faults
 
 
@@ -172,22 +173,24 @@ def test_spool_cap_and_fault_point(tmp_path):
 
 
 def test_file_journal_recovers_and_truncates_torn_tail(tmp_path):
-    """The pure-Python journal fallback: state rebuilds from the log and
-    a torn tail (crash mid-append) truncates to the last whole record —
-    the NativeMsgStore._recover discipline."""
-    path = str(tmp_path / "spool.log")
-    j = _FileJournal(path)
+    """The pure-Python journal fallback (now the shared segment-log
+    engine, storage/segment.py): state rebuilds from the log and a torn
+    tail (crash mid-append) truncates to the last whole record — the
+    NativeMsgStore._recover discipline."""
+    d = str(tmp_path / "spool.seg")
+    j = SegmentLogEngine(d)
     j.put_many([(b"k1", b"v1"), (b"k2", b"v2"), (b"k3", b"v3")])
     j.delete(b"k2")
     j.close()
-    with open(path, "ab") as fh:
+    seg = sorted(f for f in os.listdir(d) if f.startswith("seg-"))[-1]
+    with open(os.path.join(d, seg), "ab") as fh:
         fh.write(b"P\x00\x00\x00\x05garb")  # truncated mid-record
-    j2 = _FileJournal(path)
+    j2 = SegmentLogEngine(d)
     assert j2.scan() == [(b"k1", b"v1"), (b"k3", b"v3")]
     # the torn bytes are gone: appends after recovery stay parseable
     j2.put_many([(b"k4", b"v4")])
     j2.close()
-    j3 = _FileJournal(path)
+    j3 = SegmentLogEngine(d)
     assert [k for k, _ in j3.scan()] == [b"k1", b"k3", b"k4"]
     j3.close()
 

@@ -435,20 +435,22 @@ async def test_admin_fault_and_breaker_commands():
         assert any(r.get("point") == "device.dispatch" for r in table)
         # breaker drill: trip forces degraded mode, reset restores.
         # An unscoped trip covers EVERY breakered path — the match
-        # breaker, the payload-predicate engine's (PR 10), and the
-        # process-global wire-codec breaker (PR 12)
+        # breaker, the payload-predicate engine's (PR 10), the
+        # process-global wire-codec breaker (PR 12), and the store
+        # maintenance breaker (PR 14)
         b.registry.reg_view("tpu").matcher("")
         out = reg.run(b, ["breaker", "trip"])
-        assert "tripped 3" in out
+        assert "tripped 4" in out
         rows = reg.run(b, ["breaker", "show"])["table"]
         assert {r["path"] for r in rows} == {"match", "predicate",
-                                             "wire"}
+                                             "wire", "store"}
         assert all(r["state"] == "forced_open" for r in rows)
         # pinned: no backoff expiry or stray success may close it
         m = b.registry.reg_view("tpu").matcher("")
         assert not m.breaker.allow()
         assert not m.breaker.record_success()
         assert not b.filter_engine.breaker.allow()
+        assert not b.store_breaker.allow()
         from vernemq_tpu.protocol import fastpath as _fp
 
         assert not _fp.breaker.allow()

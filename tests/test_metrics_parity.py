@@ -256,6 +256,60 @@ async def test_predicate_and_aggregate_metrics_exposed():
 
 
 @pytest.mark.asyncio
+async def test_storage_tier_families_exposed(tmp_path):
+    """The storage-tier seams (ISSUE 14) are first-class metric
+    families: stage_store_append_ms / stage_resume_replay_ms carry
+    real observations through the broker path with HELP/TYPE, and the
+    store/resume gauges + fsync-coalesce counter expose with HELP."""
+    import asyncio
+
+    from vernemq_tpu.broker.config import Config
+    from vernemq_tpu.broker.message import Msg
+    from vernemq_tpu.broker.server import start_broker
+    from vernemq_tpu.observability import histogram as hist
+
+    cfg = Config(systree_enabled=False, allow_anonymous=True,
+                 message_store="file",
+                 message_store_dir=str(tmp_path / "ms"),
+                 msg_store_fsync=True)
+    broker, server = await start_broker(cfg, port=0)
+    try:
+        hist.reset_all()
+        sid = ("", "mp-c")
+        for i in range(5):
+            broker.store_offline(sid, Msg(
+                topic=("t", "x"), payload=b"p", qos=1,
+                msg_ref=b"mref-%d" % i))
+        await asyncio.sleep(0)
+        await asyncio.sleep(0)
+        # a batched resume feeds stage_resume_replay_ms
+        coll = broker.resume_collector()
+        assert coll is not None
+        coll.host_threshold = 0
+        futs = [coll.submit(sid) for _ in range(3)]
+        await asyncio.gather(*futs)
+        text = broker.metrics.prometheus_text(node=broker.node_name)
+        for fam in ("stage_store_append_ms", "stage_resume_replay_ms"):
+            assert f"# HELP {fam} " in text and \
+                f"# TYPE {fam} histogram" in text
+            count = int(re.search(rf"^{fam}_count{{[^}}]*}} (\d+)$",
+                                  text, re.M).group(1))
+            assert count >= 1, f"{fam} carried no observations"
+        am = broker.metrics.all_metrics()
+        assert am["msg_store_fsync_coalesced"] == 4  # 5 writes, 1 sync
+        for gauge in ("store_breaker_state", "store_live_bytes",
+                      "store_garbage_bytes", "store_segments",
+                      "resume_batched_sessions",
+                      "resume_pending_sessions"):
+            assert gauge in am, f"{gauge} missing from the scrape"
+            assert f"# HELP {gauge} " in text, f"{gauge} has no HELP"
+    finally:
+        hist.reset_all()
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
 async def test_event_and_canary_families_exposed():
     """The control-plane event journal and the canary probe are
     first-class metric families: every registered event code exposes an

@@ -258,10 +258,17 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin breaker show")
     reg.register(["breaker", "trip"], _breaker_trip,
                  "vmq-admin breaker trip [mountpoint=] "
-                 "[path=match|retained|predicate]")
+                 "[path=match|retained|predicate|wire|store]")
     reg.register(["breaker", "reset"], _breaker_reset,
                  "vmq-admin breaker reset [mountpoint=] "
-                 "[path=match|retained|predicate]")
+                 "[path=match|retained|predicate|wire|store]")
+    reg.register(["store", "show"], _store_show,
+                 "vmq-admin store show  (storage tier: engine kinds, "
+                 "segments, live/garbage bytes, compaction + resume "
+                 "collector counters, breaker)")
+    reg.register(["store", "compact"], _store_compact,
+                 "vmq-admin store compact [budget=BYTES]  (schedule "
+                 "one budgeted off-loop maintenance pass now)")
     reg.register(["schema", "show"], _schema_show,
                  "vmq-admin schema show [mountpoint=MP]",
                  "Registered payload schemas (replicated cluster-wide "
@@ -1376,6 +1383,10 @@ def _breaker_show(broker, flags):
 
     rows.append({"path": "wire", "mountpoint": "(all)",
                  **_fastpath.breaker.status()})
+    # the store maintenance breaker: one per broker — open = budgeted
+    # compaction paused, the engines run append-only
+    rows.append({"path": "store", "mountpoint": "(all)",
+                 **broker.store_breaker.status()})
     return {"table": rows}
 
 
@@ -1422,6 +1433,57 @@ def _each_breaker(broker, flags):
             from ..protocol import fastpath as _fastpath
 
             yield "(all)", _fastpath.breaker
+    if path in (None, "store"):
+        if want is None:
+            # one per broker: trip pins compaction paused (append-only
+            # degraded mode) until reset — delivery is untouched
+            yield "(all)", broker.store_breaker
+
+
+def _store_show(broker, flags):
+    """Storage-tier status: which engine serves each durable family
+    (msg store buckets + cluster spool journal), segment/garbage
+    accounting, the compaction driver's counters + breaker, and the
+    batched resume collector."""
+    st = broker.store_status()
+    rows = []
+    for eng in st["engines"]:
+        rows.append({
+            "kind": eng.get("kind", "?"),
+            "keys": eng.get("keys", ""),
+            "segments": eng.get("segments", ""),
+            "live_bytes": eng.get("live_bytes", ""),
+            "garbage_bytes": eng.get("garbage_bytes", ""),
+            "compactions": eng.get("compactions", ""),
+            "checkpoints": eng.get("checkpoints", ""),
+        })
+    if not rows:
+        rows.append({"kind": st["engine_kind"], "keys": "-",
+                     "segments": "-", "live_bytes": "-",
+                     "garbage_bytes": "-", "compactions": "-",
+                     "checkpoints": "-"})
+    out = {"table": rows,
+           "breaker": st["breaker"]["state"],
+           "compactions": st["compactions"],
+           "compacted_bytes": st["compacted_bytes"],
+           "compact_paused": st["compact_paused"],
+           "compact_errors": st["compact_errors"]}
+    if "resume" in st:
+        out["resume"] = {k: int(v) for k, v in st["resume"].items()}
+    return out
+
+
+def _store_compact(broker, flags):
+    """vmq-admin store compact [budget=BYTES] — schedule one budgeted
+    maintenance pass off the loop (the periodic driver's tick body)."""
+    import asyncio as _asyncio
+
+    budget = flags.get("budget")
+    budget = int(budget) if budget else None
+    _asyncio.get_event_loop().create_task(
+        broker.store_maintain_once(budget))
+    return ("maintenance pass scheduled "
+            f"(budget={budget if budget else 'store_compact_budget_bytes'})")
 
 
 def _schemas(broker):

@@ -138,9 +138,34 @@ class Broker:
                 self.metadata, node_name, n_slices,
                 on_adopt=self._on_mesh_adopt)
         fsync = bool(self.config.get("msg_store_fsync", False))
+        # fsync group-commit: one fsync per write burst at the flush-tick
+        # boundary instead of per record (msg_store_fsync_coalesced)
+        gc_on = bool(self.config.get("msg_store_group_commit", True))
+        seg_max = int(self.config.get("store_segment_max_bytes",
+                                      8 * 1024 * 1024))
+        ckpt_every = int(self.config.get("store_checkpoint_every_bytes",
+                                         32 * 1024 * 1024))
         if self.config.message_store == "file":
-            self.msg_store: MsgStore = FileMsgStore(
-                self.config.message_store_dir, fsync=fsync)
+            from ..storage.msg_store import SegmentMsgStore
+
+            store_dir = self.config.message_store_dir
+            if os.path.exists(os.path.join(store_dir, "msgstore.log")):
+                # a legacy flat-log store already lives here — honour
+                # its data rather than silently orphaning it
+                log.warning("legacy flat-log msg store found in %s; "
+                            "serving it (new dirs open the segment "
+                            "engine)", store_dir)
+                self.msg_store: MsgStore = FileMsgStore(
+                    store_dir, fsync=fsync, group_commit=gc_on)
+            else:
+                # the pure-Python half of the unified segment engine
+                # (storage/segment.py): checkpointed recovery, budgeted
+                # broker-driven compaction — the same engine layer the
+                # cluster spool journals through
+                self.msg_store = SegmentMsgStore(
+                    store_dir, fsync=fsync, group_commit=gc_on,
+                    segment_max_bytes=seg_max,
+                    checkpoint_every_bytes=ckpt_every)
         elif self.config.message_store == "native":
             from ..storage.msg_store import BucketedMsgStore, NativeMsgStore
 
@@ -156,21 +181,54 @@ class Broker:
                                 store_dir, n)
                     n = 1
                 # N engines hashed by msg-ref (vmq_lvldb_store_sup.erl:47-54)
-                self.msg_store = (BucketedMsgStore(store_dir, n, fsync=fsync)
+                self.msg_store = (BucketedMsgStore(store_dir, n, fsync=fsync,
+                                                   group_commit=gc_on)
                                   if n > 1
-                                  else NativeMsgStore(store_dir, fsync=fsync))
-            except Exception as e:  # no toolchain → durable Python fallback
+                                  else NativeMsgStore(store_dir, fsync=fsync,
+                                                      group_commit=gc_on))
+            except Exception as e:  # no toolchain → segment-log twin
+                from ..storage.msg_store import SegmentMsgStore
+
                 log.warning("native msg store unavailable (%s); "
-                            "falling back to file store", e)
-                self.msg_store = FileMsgStore(self.config.message_store_dir,
-                                              fsync=fsync)
+                            "falling back to the segment-log engine", e)
+                self.msg_store = SegmentMsgStore(
+                    self.config.message_store_dir, fsync=fsync,
+                    group_commit=gc_on, segment_max_bytes=seg_max,
+                    checkpoint_every_bytes=ckpt_every)
         else:
             self.msg_store = MemoryMsgStore()
-        # corrupt records skipped by the file store's recovery scan are
-        # surfaced, not silent (the old behavior discarded the tail)
+        # batched reconnect-storm resumption (storage/resume.py): built
+        # lazily on the first deferrable recover when the store supports
+        # off-loop batched reads; the store breaker + compaction driver
+        # state lives here so the gauges always exist
+        self._resume_collector: Optional[Any] = None
+        self._store_commit_scheduled = False
+        from ..robustness.breaker import CircuitBreaker
+
+        self.store_breaker = CircuitBreaker(
+            failure_threshold=self.config.get(
+                "tpu_breaker_failure_threshold", 3),
+            backoff_initial=self.config.get(
+                "tpu_breaker_backoff_initial_ms", 200) / 1e3,
+            backoff_max=self.config.get(
+                "tpu_breaker_backoff_max_ms", 10_000) / 1e3,
+            name="store")
+        self.store_compactions = 0
+        self.store_compacted_bytes = 0
+        self.store_compact_paused = 0
+        self.store_compact_errors = 0
+        # corrupt records skipped by the store's recovery scan are
+        # surfaced, not silent (the old behavior discarded the tail) —
+        # and so is a checkpoint-discarding full-scan fallback
         skipped = getattr(self.msg_store, "recover_skipped", 0)
         if skipped:
             self.metrics.incr("msg_store_recover_skipped", skipped)
+        fallbacks = sum(
+            getattr(getattr(st, "engine", None), "recover_fallbacks", 0)
+            for st in (getattr(self.msg_store, "instances", None)
+                       or [self.msg_store]))
+        if fallbacks:
+            self.metrics.incr("store_recover_fallbacks", fallbacks)
         # live sessions: sid -> Session (the reference reaches sessions via
         # queue pids; a direct map is equivalent single-node)
         self.sessions: Dict[SubscriberId, Any] = {}
@@ -368,6 +426,37 @@ class Broker:
             "retained_replay_expired_filters": "Queued replay filters "
                                                "host-served past their "
                                                "collector expiry.",
+            # storage tier (storage/segment.py + storage/resume.py):
+            # the unified segment engine's health + the batched
+            # reconnect-storm resumption counters
+            "store_breaker_state": "Store compaction breaker state "
+                                   "(0 closed, 1 half-open, 2 open; "
+                                   "open = append-only degraded mode).",
+            "store_live_bytes": "Live record bytes across every "
+                                "segment/kv engine (msg store + "
+                                "cluster spool).",
+            "store_garbage_bytes": "Dead record bytes awaiting "
+                                   "budgeted compaction across every "
+                                   "engine.",
+            "store_segments": "On-disk segment files across every "
+                              "segment-log engine.",
+            "resume_batched_sessions": "Reconnecting sessions whose "
+                                       "offline replay rode a batched "
+                                       "off-loop store read.",
+            "resume_batched_reads": "Batched off-loop read_many calls "
+                                    "issued by the resume collector.",
+            "resume_host_sessions": "Small resume flushes served by "
+                                    "the per-session read on the loop "
+                                    "(hybrid dispatch).",
+            "resume_expired_sessions": "Queued resumes served by the "
+                                       "exact per-session fallback "
+                                       "past their expiry.",
+            "resume_fallback_sessions": "Sessions served per-session "
+                                        "after a batched read failed.",
+            "resume_deferred_flushes": "Resume flushes deferred by the "
+                                       "overload governor (level 2+).",
+            "resume_pending_sessions": "Reconnect resumes queued in "
+                                       "the collector window.",
             "retained_dispatch_stalls": "Retained dispatches abandoned "
                                         "at the watchdog deadline (fed "
                                         "to the breaker).",
@@ -679,6 +768,22 @@ class Broker:
             out.update(self._retained_collector.stats())
         if self.filter_engine is not None:
             out.update(self.filter_engine.stats())
+        # storage tier (unified segment engine + batched resumption)
+        out["store_breaker_state"] = float(self.store_breaker.state)
+        live = garbage = segs = 0.0
+        for eng in self._store_engines():
+            try:
+                est = eng.stats()
+            except Exception:
+                continue
+            live += float(est.get("live_bytes", 0))
+            garbage += float(est.get("garbage_bytes", 0))
+            segs += float(est.get("segments", 0))
+        out["store_live_bytes"] = live
+        out["store_garbage_bytes"] = garbage
+        out["store_segments"] = segs
+        if self._resume_collector is not None:
+            out.update(self._resume_collector.stats())
         out.update(self.watchdog.stats())
         out.update(self.recorder.stats())
         out.update(self._mesh_gauges())
@@ -1047,7 +1152,10 @@ class Broker:
             with self.watchdog.monitored("store.write", 2.0,
                                          label=f"{sid[0]}/{sid[1]}"):
                 faults.inject("store.write", max_delay_s=1.0)
+                t0 = time.monotonic()
                 self.msg_store.write(sid, msg)
+                self.metrics.observe("stage_store_append_ms",
+                                     (time.monotonic() - t0) * 1e3)
         except Exception:
             # degraded, not fatal: the in-memory queue still holds the
             # message, so live delivery is unaffected — only the
@@ -1059,14 +1167,116 @@ class Broker:
                           "(message kept in memory only)", sid)
             return
         self.metrics.incr("msg_store_ops_write")
+        if self.msg_store.needs_commit() and not self._store_commit_scheduled:
+            # fsync group-commit: the burst's records are flushed; ONE
+            # fsync lands at the flush-tick boundary for all of them
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                self._commit_msg_store()  # no loop (tests): sync now
+            else:
+                self._store_commit_scheduled = True
+                loop.call_soon(self._commit_msg_store)
 
-    def recover_offline(self, sid: SubscriberId, queue: SubscriberQueue) -> None:
+    def _commit_msg_store(self) -> None:
+        self._store_commit_scheduled = False
+        try:
+            coalesced = self.msg_store.commit()
+        except Exception:
+            self.metrics.incr("msg_store_write_errors")
+            log.exception("msg store group commit failed")
+            return
+        if coalesced:
+            self.metrics.incr("msg_store_fsync_coalesced", coalesced)
+
+    def resume_collector(self):
+        """Lazy batched-resume collector (storage/resume.py), or None
+        when disabled or the store cannot serve off-loop batched reads
+        (memory / legacy flat-log stores) — reconnects then recover on
+        the synchronous per-session path, unchanged."""
+        if (not self.config.get("resume_batched", True)
+                or not getattr(self.msg_store, "supports_batched_read",
+                               False)):
+            return None
+        if self._resume_collector is None:
+            from ..storage.resume import ResumeCollector
+
+            cfg = self.config
+            self._resume_collector = ResumeCollector(
+                self.msg_store,
+                window_us=cfg.get("resume_window_us", 500),
+                max_batch=cfg.get("resume_max_batch", 512),
+                host_threshold=cfg.get("resume_host_threshold", 4),
+                item_expiry_ms=float(cfg.get("resume_expiry_ms",
+                                             30_000)),
+                metrics=self.metrics)
+            if self.overload is not None:
+                # L2 response: resume storms defer behind live publishes
+                # exactly like retained replays
+                self._resume_collector.defer_gate = \
+                    self.overload.defer_replay
+        return self._resume_collector
+
+    def recover_offline(self, sid: SubscriberId, queue: SubscriberQueue,
+                        may_defer: bool = False,
+                        lazy: bool = False) -> None:
         """Rebuild the offline backlog from storage on queue re-creation
-        (vmq_queue offline(init_offline_queue), vmq_lvldb_store.erl:396-416)."""
-        msgs = self.msg_store.read_all(sid)
-        if msgs:
-            queue.offline.extend(msgs)
-            self.metrics.incr("queue_initialized_from_storage")
+        (vmq_queue offline(init_offline_queue), vmq_lvldb_store.erl:396-416).
+
+        ``lazy`` marks boot/remap recovery of a DETACHED persistent
+        queue: with a batched-read store the backlog stays parked in
+        storage (``queue.offline_in_store``) and loads on first attach
+        (through the collector) or at drain — a million parked sessions
+        boot without a million read_alls. ``may_defer`` marks the
+        reconnect path (a session is attaching right now): the replay
+        rides the ResumeCollector — one batched off-loop read per storm
+        window instead of one loop-side ``read_all`` per session — with
+        the queue parking live publishes until the stored backlog
+        lands."""
+        if (lazy and self.config.get("resume_batched", True)
+                and getattr(self.msg_store, "supports_batched_read",
+                            False)):
+            queue.offline_in_store = True
+            return
+        coll = self.resume_collector() if may_defer else None
+        if coll is not None:
+            try:
+                asyncio.get_running_loop()
+            except RuntimeError:
+                coll = None  # no loop (tests/boot): sync path below
+        if coll is None:
+            msgs = self.msg_store.read_all(sid)
+            if msgs:
+                # merge, not extend: on the lazy path the deque may
+                # already hold a suffix of the store content (a publish
+                # that arrived while parked lands in both)
+                queue.merge_recovered(msgs)
+                self.metrics.incr("queue_initialized_from_storage")
+            return
+        queue.begin_resume()
+        fut = coll.submit(sid)
+
+        def _done(f: "asyncio.Future") -> None:
+            exc = None if f.cancelled() else f.exception()
+            if f.cancelled() or exc is not None:
+                # batched AND fallback read failed (or the future was
+                # cancelled): serve the exact per-session read inline —
+                # never leave the queue wedged in the resuming state
+                if exc is not None:
+                    log.warning("offline resume for %s failed: %s",
+                                sid, exc)
+                try:
+                    msgs = self.msg_store.read_all(sid)
+                except Exception:
+                    self.metrics.incr("msg_store_read_errors")
+                    log.exception("per-session resume fallback read "
+                                  "failed for %s", sid)
+                    msgs = []
+            else:
+                msgs = f.result()
+            queue.finish_resume(msgs)
+
+        fut.add_done_callback(_done)
 
     def delete_offline(self, sid: SubscriberId) -> None:
         self.msg_store.delete_all(sid)
@@ -1074,6 +1284,122 @@ class Broker:
 
     def offline_delivered(self, sid: SubscriberId, msg: Msg) -> None:
         self.msg_store.delete(sid, msg.msg_ref)
+
+    # ------------------------------------------------- store maintenance
+
+    def _store_engines(self) -> List[Any]:
+        """Every compactable engine this broker owns: the msg store's
+        (one per bucket instance) plus the cluster spool's journal —
+        they share the engine layer, so ONE budgeted driver maintains
+        both."""
+        engines: List[Any] = []
+        ms = self.msg_store
+        for st in (getattr(ms, "instances", None) or [ms]):
+            eng = getattr(st, "engine", None)
+            if eng is not None and hasattr(eng, "compact_step"):
+                engines.append(eng)
+        spool = getattr(self.cluster, "spool", None) \
+            if self.cluster is not None else None
+        eng = getattr(spool, "engine", None) if spool is not None else None
+        if eng is not None and hasattr(eng, "compact_step"):
+            engines.append(eng)
+        return engines
+
+    async def store_maintain_once(self, budget: Optional[int] = None) -> int:
+        """One budgeted compaction/checkpoint pass over every engine,
+        off the event loop on the watchdog's sacrificial executor.
+        ``store.compact`` is the drill seam: injected (or real) failures
+        feed the store breaker — open, compaction PAUSES and the store
+        degrades to append-only (counted) while writes/reads/delivery
+        continue untouched; the half-open probe resumes it."""
+        from ..robustness.watchdog import StallAbandoned
+
+        if budget is None:
+            budget = int(self.config.get("store_compact_budget_bytes",
+                                         4 * 1024 * 1024))
+        reclaimed = 0
+        for eng in self._store_engines():
+            if not self.store_breaker.allow():
+                self.store_compact_paused += 1
+                self.metrics.incr("store_compact_paused")
+                break
+
+            def _step(e=eng):
+                faults.inject("store.compact", max_delay_s=5.0)
+                return e.compact_step(budget)
+
+            label = getattr(eng, "directory", None) \
+                or getattr(eng, "path", "") or type(eng).__name__
+            try:
+                deadline = self._dispatch_deadline_ms() / 1e3
+                if deadline > 0:
+                    n = await self.watchdog.dispatch_async(
+                        "store.compact", _step, deadline, label=label)
+                else:
+                    n = await asyncio.get_event_loop().run_in_executor(
+                        None, _step)
+            except StallAbandoned:
+                self.store_breaker.record_failure()
+                self.store_compact_errors += 1
+                self.metrics.incr("store_compact_errors")
+                continue
+            except Exception:
+                opened = self.store_breaker.record_failure()
+                self.store_compact_errors += 1
+                self.metrics.incr("store_compact_errors")
+                if opened:
+                    log.warning("store compaction breaker OPEN: the "
+                                "store runs append-only until the "
+                                "half-open probe succeeds")
+                continue
+            self.store_breaker.record_success()
+            if n:
+                self.store_compactions += 1
+                self.store_compacted_bytes += int(n)
+                self.metrics.incr("store_compactions")
+                self.metrics.incr("store_compacted_bytes", int(n))
+                reclaimed += int(n)
+        return reclaimed
+
+    async def _store_maintenance_loop(self) -> None:
+        interval = max(0.05, float(self.config.get(
+            "store_compact_interval_ms", 1000)) / 1e3)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.store_maintain_once()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # the maintenance tick must never die: the next tick
+                # retries (a persistent failure shows in the breaker)
+                log.exception("store maintenance tick failed")
+
+    def store_status(self) -> Dict[str, Any]:
+        """`vmq-admin store show` / bench introspection."""
+        engines = []
+        for eng in self._store_engines():
+            st = {"kind": getattr(eng, "kind", "?")}
+            try:
+                st.update(eng.stats())
+            except Exception:
+                pass
+            engines.append(st)
+        out: Dict[str, Any] = {
+            "engine_kind": getattr(self.msg_store, "engine_kind",
+                                   "memory"),
+            "engines": engines,
+            "breaker": self.store_breaker.status(),
+            "compactions": self.store_compactions,
+            "compacted_bytes": self.store_compacted_bytes,
+            "compact_paused": self.store_compact_paused,
+            "compact_errors": self.store_compact_errors,
+        }
+        if self._resume_collector is not None:
+            out["resume"] = self._resume_collector.stats()
+        if hasattr(self.msg_store, "stats"):
+            out["msg_store"] = self.msg_store.stats()
+        return out
 
     # ------------------------------------------------------------ lifecycle
 
@@ -1439,6 +1765,15 @@ class Broker:
             self.watchdog.tick_s = self.config.get(
                 "watchdog_tick_ms", 100) / 1e3
             self.watchdog.start()
+        # budgeted store maintenance: segment compaction + checkpoints
+        # for every engine (msg store buckets + cluster spool journal)
+        # run OFF the loop on the sacrificial executor, at most
+        # store_compact_budget_bytes copied per engine per tick; the
+        # store breaker pauses it (append-only degraded mode) on
+        # injected or real failures without touching delivery
+        if float(self.config.get("store_compact_interval_ms", 1000)) > 0:
+            self._bg_tasks.append(asyncio.get_event_loop().create_task(
+                self._store_maintenance_loop()))
         # multi-process front end: attach the shared worker stats slot
         # and, when the parent configured a match service, mount the
         # ring-backed reg view so folds route to the service process
@@ -1650,5 +1985,9 @@ class Broker:
         # after the collectors/views that dispatch through it are down;
         # wedged sacrificial threads are daemons and die with the process
         self.watchdog.stop()
+        if self._resume_collector is not None:
+            # settle pending resume futures (per-session reads) BEFORE
+            # closing the store they read from
+            self._resume_collector.close()
         self.msg_store.close()
         self.metadata.close()

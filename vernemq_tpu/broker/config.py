@@ -263,8 +263,31 @@ DEFAULTS: Dict[str, Any] = {
     # on every write either way; fsync makes each write power-loss
     # durable at a large throughput cost (the reference's sync knob)
     "msg_store_fsync": False,
+    # with fsync on, coalesce to ONE fsync per write burst at the
+    # flush-tick boundary (msg_store_fsync_coalesced counts the saved
+    # syncs); off = the legacy per-record fsync
+    "msg_store_group_commit": True,
     # engines hashed by msg-ref; reference runs 12 (vmq_lvldb_store_sup.erl)
     "msg_store_instances": 12,
+    # unified segment engine (storage/segment.py): seal size of the
+    # append segment, checkpoint cadence (bytes appended between index
+    # checkpoints — recovery replays only what landed after one), and
+    # the budgeted off-loop compaction driver (bytes copied per engine
+    # per tick; 0 interval disables the driver)
+    "store_segment_max_bytes": 8 * 1024 * 1024,
+    "store_checkpoint_every_bytes": 32 * 1024 * 1024,
+    "store_compact_interval_ms": 1000,
+    "store_compact_budget_bytes": 4 * 1024 * 1024,
+    # batched reconnect-storm resumption (storage/resume.py): coalesce
+    # concurrent offline replays into one off-loop read per window
+    "resume_batched": True,
+    "resume_window_us": 500,
+    "resume_max_batch": 512,
+    "resume_host_threshold": 4,
+    # queued-resume deadline before the exact per-session fallback
+    # serves on the loop (a 100k-session storm legitimately queues for
+    # seconds — this is a wedge bound, not a latency target)
+    "resume_expiry_ms": 30_000,
     "metadata_dir": "./data/meta",
     "metadata_persistence": False,  # durable subscriber-db/retain via kvstore
     # metadata backend: "lww" (plumtree-flavored) | "swc" (server-wide

@@ -125,8 +125,29 @@ COUNTERS: List[Tuple[str, str]] = [
     ("msg_store_ops_delete", "Message store deletes."),
     ("msg_store_write_errors",
      "Message store writes that failed (message kept in memory only)."),
+    ("msg_store_read_errors",
+     "Message store recovery reads that failed (batched resume AND "
+     "per-session fallback; the session resumes with what storage "
+     "could serve)."),
     ("msg_store_recover_skipped",
      "Corrupt message-store records skipped during recovery."),
+    ("msg_store_fsync_coalesced",
+     "Per-record fsyncs coalesced into one group commit at the "
+     "flush-tick boundary (msg_store_fsync on)."),
+    ("store_compactions",
+     "Budgeted store maintenance passes that reclaimed garbage "
+     "(segment evacuations / native compactions)."),
+    ("store_compacted_bytes",
+     "Garbage bytes reclaimed by budgeted store compaction."),
+    ("store_compact_paused",
+     "Maintenance ticks skipped while the store breaker was open "
+     "(append-only degraded mode)."),
+    ("store_compact_errors",
+     "Store compaction steps that failed or were abandoned at the "
+     "watchdog deadline (fed to the store breaker)."),
+    ("store_recover_fallbacks",
+     "Engine opens that discarded an unusable checkpoint and fell "
+     "back to the full segment scan."),
     ("retain_messages_stored", "Retained messages persisted."),
     # robustness (supervision tree analog + fault harness)
     ("supervisor_restarts", "Supervised tasks restarted after a crash."),

@@ -89,6 +89,18 @@ class SubscriberQueue:
         # (windows full) parked until notify_ready — the passive-state
         # per-session queue of the reference (vmq_queue.erl:752-774)
         self.backlog: Deque[Msg] = deque()
+        # batched-resume window (storage/resume.py): while the stored
+        # offline backlog is in flight through the ResumeCollector, live
+        # publishes park here — delivering them first would reorder
+        # same-topic delivery against the older stored messages
+        # (MQTT-4.6.0)
+        self._resuming = False
+        self._resume_buf: Deque[Msg] = deque()
+        # lazy boot recovery: True when this queue's stored backlog was
+        # NOT loaded at queue (re)creation — a million parked sessions
+        # boot without a million read_alls; the backlog loads on first
+        # attach (through the ResumeCollector) or at drain time
+        self.offline_in_store = False
         self._expiry_task: Optional[asyncio.Task] = None
         self.created = time.time()
 
@@ -104,6 +116,23 @@ class SubscriberQueue:
         self._cancel_expiry()
         if was_offline:
             self.broker.hooks_fire_all("on_client_wakeup", self.subscriber_id)
+            if self.offline_in_store and not self._resuming:
+                # lazily-booted queue: the stored backlog loads NOW —
+                # batched through the ResumeCollector when available
+                # (begin_resume parks live publishes), synchronously
+                # into the offline deque otherwise (flushed below)
+                self.offline_in_store = False
+                self.broker.recover_offline(self.subscriber_id, self,
+                                            may_defer=True)
+            if self._resuming:
+                # a batched resume is still in flight for this queue: the
+                # offline deque holds only messages NEWER than the stored
+                # backlog being read — finish_resume delivers stored +
+                # deque + parked in order and clears storage ONCE.
+                # Flushing (and delete_offline-ing) here would race the
+                # executor read and could delete stored messages that
+                # were never delivered.
+                return
             backlog, self.offline = self.offline, deque()
             if backlog:
                 # handed to the session's inflight tracking; clear storage
@@ -130,6 +159,12 @@ class SubscriberQueue:
             backlog, self.backlog = self.backlog, deque()
             for msg in backlog:
                 self._enqueue_offline(msg)
+            # publishes parked behind an in-flight resume go offline
+            # too; finish_resume later puts the (older) stored backlog
+            # at the FRONT, preserving arrival order
+            buf, self._resume_buf = self._resume_buf, deque()
+            for msg in buf:
+                self._enqueue_offline(msg)
             self.broker.hooks_fire_all("on_client_offline", self.subscriber_id)
             self._arm_expiry()
 
@@ -141,8 +176,27 @@ class SubscriberQueue:
         :meth:`drain_pending` — never dropped."""
         self.state = DRAIN
         self._cancel_expiry()
+        if self._resuming:
+            # supersede an in-flight batched resume: the drain needs
+            # the stored backlog NOW — read it synchronously; the
+            # late-landing collector read becomes a no-op (finish_resume
+            # guards on _resuming) so nothing is dropped or doubled.
+            # Stored messages merge to the FRONT of the offline deque,
+            # the parked live publishes (newest) go AFTER them — the
+            # drained list keeps per-subscriber order (MQTT-4.6.0)
+            self._resuming = False
+            buf, self._resume_buf = self._resume_buf, deque()
+            self.broker.recover_offline(self.subscriber_id, self)
+            self.offline.extend(buf)
+        if self.offline_in_store:
+            # a lazily-booted queue drains its STORED backlog too: load
+            # it synchronously (migration correctness beats boot speed)
+            self.offline_in_store = False
+            self.broker.recover_offline(self.subscriber_id, self)
         backlog = list(self.backlog)
         self.backlog.clear()
+        backlog += list(self._resume_buf)
+        self._resume_buf.clear()
         backlog += list(self.offline)
         self.offline.clear()
         return [m for m in backlog
@@ -168,6 +222,10 @@ class SubscriberQueue:
         for msg in self.backlog:
             self._drop(msg)
         self.backlog.clear()
+        for msg in self._resume_buf:
+            self._drop(msg)
+        self._resume_buf.clear()
+        self._resuming = False
         self.broker.registry.queue_terminated(self.subscriber_id)
         self.broker.hooks_fire_all("on_client_gone", self.subscriber_id)
         self.broker.metrics.incr("queue_teardown")
@@ -208,6 +266,13 @@ class SubscriberQueue:
         """Hot-path entry from the registry fanout (vmq_queue:enqueue/2)."""
         self.broker.metrics.incr("queue_message_in")
         if self.state == ONLINE:
+            if self._resuming:
+                # the stored offline backlog is still in flight through
+                # the batched resume: park live publishes until it has
+                # been delivered (finish_resume drains this buffer) —
+                # delivering now would reorder against older messages
+                self._resume_buf.append(msg)
+                return
             self._deliver_online(msg)
         elif self.state == OFFLINE:
             self._enqueue_offline(msg)
@@ -264,7 +329,7 @@ class SubscriberQueue:
         order until it refuses again. Peek-then-pop: a refused head must
         stay at the FRONT or same-subscriber delivery reorders
         (MQTT-4.6.0)."""
-        if not self.backlog:
+        if not self.backlog or self._resuming:
             return
         t0 = time.monotonic()
         while self.backlog and self.state == ONLINE and self.sessions:
@@ -273,6 +338,82 @@ class SubscriberQueue:
             self.backlog.popleft()
         self.broker.metrics.observe(
             "stage_queue_flush_ms", (time.monotonic() - t0) * 1e3)
+
+    # -- batched resume (storage/resume.py) --------------------------------
+
+    def begin_resume(self) -> None:
+        """The stored offline backlog is being read through the
+        ResumeCollector: hold live delivery order until it lands."""
+        self._resuming = True
+
+    def merge_recovered(self, msgs: List[Msg]) -> None:
+        """Merge a store-read backlog with whatever already sits in the
+        offline deque: stored messages FIRST (they are the oldest),
+        then deque entries that are NOT copies of a stored one. On the
+        lazy-boot path the deque is a suffix of the store content (a
+        publish arriving while parked lands in both), so a plain extend
+        would deliver those twice; the multiset dedup keeps only the
+        deque's store-write-failed stragglers (kept in memory only)."""
+        if not msgs:
+            return
+        have: Dict[bytes, int] = {}
+        for m in msgs:
+            have[m.msg_ref] = have.get(m.msg_ref, 0) + 1
+        keep = []
+        for m in self.offline:
+            if have.get(m.msg_ref, 0) > 0:
+                have[m.msg_ref] -= 1
+            else:
+                keep.append(m)
+        self.offline = deque(list(msgs) + keep)
+
+    def finish_resume(self, msgs: List[Msg]) -> None:
+        """The collector resolved this queue's stored backlog. Deliver
+        it FIRST (it is older than anything parked), then drain the
+        parked live publishes — same per-queue order a synchronous
+        ``recover_offline`` + ``add_session`` flush would have
+        produced."""
+        if not self._resuming:
+            return
+        self._resuming = False
+        buf, self._resume_buf = self._resume_buf, deque()
+        if self.state == ONLINE and self.sessions:
+            # delivery order: stored backlog (oldest) → offline-deque
+            # stragglers (a detach window mid-resume, deduped against
+            # the store read) → parked live publishes (newest) — the
+            # same per-queue order the synchronous recover + flush
+            # produced
+            self.merge_recovered(msgs)
+            parked, self.offline = self.offline, deque()
+            if msgs:
+                self.broker.metrics.incr("queue_initialized_from_storage")
+            if parked:
+                # handed to the session's inflight tracking; clear
+                # storage exactly like the add_session offline flush
+                self.broker.delete_offline(self.subscriber_id)
+            for msg in parked:
+                if (msg.expires_at is not None
+                        and msg.expires_at < time.monotonic()):
+                    self.broker.metrics.incr("queue_message_expired")
+                    continue
+                self._deliver_online(msg)
+            for msg in buf:
+                self._deliver_online(msg)
+        elif self.state in (OFFLINE, DRAIN):
+            # the session left (or a drain started) before the read
+            # landed: stored messages merge to the FRONT of the offline
+            # deque (deduped — anything the deque already holds from a
+            # mid-resume detach is the same stored message); they stay
+            # in the store, matching the sync recover path's
+            # post-recover state. Parked live publishes were already
+            # moved by del_session/start_drain; stragglers take the
+            # offline path.
+            self.merge_recovered(msgs)
+            for msg in buf:
+                self._enqueue_offline(msg)
+        else:  # terminated while resuming: drop with accounting
+            for msg in list(msgs) + list(buf):
+                self._drop(msg)
 
     def _enqueue_offline(self, msg: Msg) -> None:
         if self.opts.clean_session:
@@ -306,6 +447,7 @@ class SubscriberQueue:
             "sessions": len(self.sessions),
             "offline_messages": len(self.offline),
             "backlog_messages": len(self.backlog),
+            "resuming": self._resuming,
             "clean_session": self.opts.clean_session,
             "deliver_mode": self.opts.deliver_mode,
             "started": self.created,

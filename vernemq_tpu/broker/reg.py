@@ -166,7 +166,10 @@ class Registry:
                     and sid not in self.queues):
                 queue = self._start_queue(
                     sid, _qopts_from_dict(rec.queue_opts, self.broker.config))
-                self.broker.recover_offline(sid, queue)
+                # lazy: the stored backlog loads on first attach (via
+                # the ResumeCollector) or at drain — boot stays O(1)
+                # per parked session instead of one read_all each
+                self.broker.recover_offline(sid, queue, lazy=True)
                 queue._arm_expiry()  # session/persistent expiry clock
 
     @property
@@ -404,7 +407,11 @@ class Registry:
             return existing, session_present
         queue = self._start_queue(sid, queue_opts)
         if session_present:
-            self.broker.recover_offline(sid, queue)
+            # the reconnect path: a session is attaching right now, so
+            # the replay may ride the batched ResumeCollector (one
+            # off-loop read per storm window) — boot/remap recovery
+            # stays synchronous
+            self.broker.recover_offline(sid, queue, may_defer=True)
         return queue, session_present
 
     async def register_subscriber_synced(
@@ -531,7 +538,7 @@ class Registry:
             return
         queue = self._start_queue(
             sid, _qopts_from_dict(rec.queue_opts, self.broker.config))
-        self.broker.recover_offline(sid, queue)
+        self.broker.recover_offline(sid, queue, lazy=True)
         queue._arm_expiry()
 
     def _trie_add(self, mountpoint: str, fw: Tuple[str, ...],

@@ -108,6 +108,23 @@ FLAT_ALIASES.update({
     "wire.fastpath_enabled": "wire_fastpath_enabled",
 })
 
+#: extension family: the unified storage tier (storage/segment.py +
+#: storage/resume.py) — segment engine geometry, the budgeted
+#: compaction driver, and batched reconnect-storm resumption
+FLAT_ALIASES.update({
+    "store.segment_max_bytes": "store_segment_max_bytes",
+    "store.checkpoint_every_bytes": "store_checkpoint_every_bytes",
+    "store.compact_interval_ms": "store_compact_interval_ms",
+    "store.compact_budget_bytes": "store_compact_budget_bytes",
+    "store.fsync": "msg_store_fsync",
+    "store.group_commit": "msg_store_group_commit",
+    "resume.batched": "resume_batched",
+    "resume.window_us": "resume_window_us",
+    "resume.max_batch": "resume_max_batch",
+    "resume.host_threshold": "resume_host_threshold",
+    "resume.expiry_ms": "resume_expiry_ms",
+})
+
 #: extension family: payload filtering & windowed aggregation
 #: (vernemq_tpu/filters/) — the MQTT+ predicate/aggregate surface;
 #: schema DEFINITIONS are replicated state (`vmq-admin schema set` /

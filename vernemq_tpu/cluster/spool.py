@@ -18,11 +18,13 @@ cursor, and keeps a bounded ``(seq, msg_ref)`` dedup window for
 above-gap frames, so a sender whose sequence space restarted is never
 mistaken for a replay and redelivery is safe for QoS 2.
 
-Storage reuses the ``native/kvstore.py`` engine like ``storage/
-msg_store.py`` does (torn-tail-tolerant log recovery is the engine's),
-with a pure-Python append-log fallback when the toolchain is missing and
-a memory journal when ``cluster_spool_dir`` is unset (replay across
-partitions, no crash durability). Key families:
+Storage is the SAME engine layer as ``storage/msg_store.py`` — one
+``storage/segment.py`` :func:`~vernemq_tpu.storage.segment.open_engine`
+call serves both facades: the native C++ kvstore when the toolchain
+built it, the pure-Python segment-log twin otherwise (sealed segments,
+checkpointed recovery, broker-driven budgeted compaction), and a memory
+engine when ``cluster_spool_dir`` is unset (replay across partitions,
+no crash durability). Key families:
 
 - ``s<len16><peer><seq:8>`` → the ready-to-send ``msq`` frame bytes
 - ``h<len16><peer>``        → high-water seq (survives full acks, so a
@@ -42,7 +44,6 @@ from __future__ import annotations
 
 import logging
 import os
-import struct
 import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
@@ -72,145 +73,6 @@ class _NullMetrics:
 
     def observe(self, name: str, ms: float) -> None:
         pass
-
-
-class _MemJournal:
-    """In-process journal (``cluster_spool_dir`` unset): replay across
-    partitions and writer-buffer overflow, no crash durability."""
-
-    durable = False
-
-    def __init__(self) -> None:
-        self._d: Dict[bytes, bytes] = {}
-
-    def put_many(self, pairs) -> None:
-        self._d.update(dict(pairs))
-
-    def get(self, key: bytes) -> Optional[bytes]:
-        return self._d.get(key)
-
-    def delete(self, key: bytes) -> None:
-        self._d.pop(key, None)
-
-    def scan(self, prefix: bytes = b"") -> List[Tuple[bytes, bytes]]:
-        return sorted((k, v) for k, v in self._d.items()
-                      if k.startswith(prefix))
-
-    def sync(self) -> None:
-        pass
-
-    def close(self) -> None:
-        pass
-
-
-class _FileJournal:
-    """Append-log journal for hosts without the native engine: every
-    put/delete is one framed record, state is rebuilt on open, a torn
-    tail (crash mid-append) truncates cleanly at the last whole record —
-    the same recovery discipline as ``NativeMsgStore._recover``."""
-
-    durable = True
-    _COMPACT_MIN = 8 * 1024 * 1024
-
-    def __init__(self, path: str) -> None:
-        self.path = path
-        self._d: Dict[bytes, bytes] = {}
-        self._dead = 0  # bytes of overwritten/deleted records on disk
-        self._live = 0  # bytes of live values (O(1) compaction check)
-        self._recover()
-        self._live = sum(len(v) for v in self._d.values())
-        self._fh = open(path, "ab")
-
-    def _recover(self) -> None:
-        if not os.path.exists(self.path):
-            return
-        with open(self.path, "rb") as fh:
-            blob = fh.read()
-        pos = 0
-        while pos < len(blob):
-            start = pos
-            op = blob[pos:pos + 1]
-            if op not in (b"P", b"D") or pos + 5 > len(blob):
-                break  # torn/garbage tail: keep everything before it
-            (klen,) = struct.unpack(">I", blob[pos + 1:pos + 5])
-            pos += 5
-            key = blob[pos:pos + klen]
-            pos += klen
-            if len(key) != klen:
-                pos = start
-                break
-            if op == b"P":
-                if pos + 4 > len(blob):
-                    pos = start
-                    break
-                (vlen,) = struct.unpack(">I", blob[pos:pos + 4])
-                pos += 4
-                val = blob[pos:pos + vlen]
-                pos += vlen
-                if len(val) != vlen:
-                    pos = start
-                    break
-                if key in self._d:
-                    self._dead += len(self._d[key])
-                self._d[key] = val
-            else:
-                self._dead += len(self._d.pop(key, b""))
-        if pos < len(blob):
-            log.warning("spool journal %s: torn tail at +%d of %d bytes "
-                        "(truncating)", self.path, pos, len(blob))
-            with open(self.path, "r+b") as fh:
-                fh.truncate(pos)
-
-    def put_many(self, pairs) -> None:
-        out = bytearray()
-        for k, v in pairs:
-            if k in self._d:
-                dead = len(self._d[k])
-                self._dead += dead
-                self._live -= dead
-            self._d[k] = v
-            self._live += len(v)
-            out += b"P" + struct.pack(">I", len(k)) + k
-            out += struct.pack(">I", len(v)) + v
-        self._fh.write(out)
-        self._fh.flush()
-
-    def delete(self, key: bytes) -> None:
-        if key not in self._d:
-            return
-        dead = len(self._d.pop(key))
-        self._dead += dead
-        self._live -= dead
-        self._fh.write(b"D" + struct.pack(">I", len(key)) + key)
-        self._fh.flush()
-        self._maybe_compact()
-
-    def get(self, key: bytes) -> Optional[bytes]:
-        return self._d.get(key)
-
-    def scan(self, prefix: bytes = b"") -> List[Tuple[bytes, bytes]]:
-        return sorted((k, v) for k, v in self._d.items()
-                      if k.startswith(prefix))
-
-    def _maybe_compact(self) -> None:
-        if self._dead < self._COMPACT_MIN or self._dead < self._live:
-            return
-        tmp = self.path + ".compact"
-        with open(tmp, "wb") as fh:
-            for k, v in sorted(self._d.items()):
-                fh.write(b"P" + struct.pack(">I", len(k)) + k
-                         + struct.pack(">I", len(v)) + v)
-        self._fh.close()
-        os.replace(tmp, self.path)
-        self._fh = open(self.path, "ab")
-        self._dead = 0
-
-    def sync(self) -> None:
-        self._fh.flush()
-        os.fsync(self._fh.fileno())
-
-    def close(self) -> None:
-        self._fh.close()
 
 
 class _PeerState:
@@ -260,17 +122,45 @@ class ClusterSpool:
 
     @staticmethod
     def _open_journal(directory: str):
-        if not directory:
-            return _MemJournal()
-        os.makedirs(directory, exist_ok=True)
-        try:
-            from ..native.kvstore import KVStore
+        # the unified storage engine (storage/segment.py): native C++
+        # kvstore when built, the segment-log twin otherwise, memory
+        # when no directory — the SAME engine classes the offline
+        # message store mounts, so spool and msg store share recovery
+        # and compaction discipline (ISSUE 14 tentpole)
+        from ..storage.segment import SegmentLogEngine, open_engine
 
-            return KVStore(os.path.join(directory, "spool.kv"))
-        except Exception as e:
-            log.warning("native kvstore unavailable for the cluster spool "
-                        "(%s); using the append-log journal", e)
-            return _FileJournal(os.path.join(directory, "spool.log"))
+        if directory:
+            # a pre-unification _FileJournal spool.log may still hold
+            # unacked QoS>=1 frames — its record framing IS the segment
+            # record framing, so it becomes segment #1 of a segment
+            # engine verbatim (orphaning it would silently lose the
+            # frames owed to a partitioned peer)
+            legacy = os.path.join(directory, "spool.log")
+            seg_dir = os.path.join(directory, "spool.seg")
+            if os.path.exists(legacy) and not os.path.isdir(seg_dir):
+                os.makedirs(seg_dir, exist_ok=True)
+                os.replace(legacy,
+                           os.path.join(seg_dir, "seg-00000001.log"))
+                log.warning("cluster spool: migrated legacy spool.log "
+                            "into the segment engine at %s", seg_dir)
+            if os.path.isdir(seg_dir):
+                # data continuity beats engine preference: once the
+                # journal lives in the segment layout, keep serving it
+                # there even where the native kvstore is built
+                return SegmentLogEngine(seg_dir)
+        return open_engine(directory, filename="spool")
+
+    @property
+    def engine(self):
+        """The journal engine (broker maintenance/introspection)."""
+        return self._kv
+
+    @property
+    def engine_kind(self) -> str:
+        """Which engine serves the journal — ``native`` / ``segment`` /
+        ``memory`` (recorded in the bench partition-storm artifact so
+        replay numbers are comparable across boxes)."""
+        return getattr(self._kv, "kind", "unknown")
 
     def _load(self) -> None:
         for key, val in self._kv.scan(b"s"):

@@ -71,6 +71,14 @@ STAGE_FAMILIES: List[Tuple[str, str]] = [
     ("stage_spool_journal_ms",
      "Cluster spool journal write latency per QoS>=1 frame (informs "
      "cluster_spool_dir placement and msg_store_fsync)."),
+    ("stage_store_append_ms",
+     "Offline message-store append latency per stored message (the "
+     "index-entry write burst on the loop; informs msg_store_fsync / "
+     "msg_store_group_commit and store_segment_max_bytes)."),
+    ("stage_resume_replay_ms",
+     "Batched reconnect resume flush latency: one off-loop read_many "
+     "for a storm batch plus staged future resolution (storage/"
+     "resume.py; informs resume_window_us and resume_max_batch)."),
     ("stage_cluster_ack_rtt_ms",
      "Cluster frame journal->cumulative-ack round trip per spooled "
      "frame (informs cluster_stall_timeout_s and "

@@ -68,6 +68,15 @@ KNOWN_POINTS: Dict[str, str] = {
         "delivery-spool journal writes (cluster/spool.py)",
     "store.write":
         "message-store writes (storage/msg_store.py)",
+    "store.compact":
+        "budgeted segment/kv compaction step (broker store maintenance "
+        "tick -> storage/segment.py compact_step): a fault feeds the "
+        "store breaker — open pauses compaction (append-only degraded "
+        "mode) without touching delivery",
+    "store.recover":
+        "segment-engine checkpoint load at open (storage/segment.py): "
+        "a fault discards the checkpoint and recovery degrades to the "
+        "full segment scan (slower, never lossy)",
     "listener.bind":
         "listener (re)bind (broker/listeners.py)",
     "wire.parse":

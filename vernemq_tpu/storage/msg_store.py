@@ -8,9 +8,20 @@ ref, per-subscriber ref entries, and a per-subscriber index for recovery
 scans (``vmq_lvldb_store.erl:339-416``) — plus payload refcounting across
 subscribers.
 
-Round 1 ships the in-memory store and a durable append-log file store with
-the same refcounted layout; the C++/RocksDB engine lands behind this same
-interface in a later round.
+The durable stores now share ONE engine layer (``storage/segment.py``,
+also backing the cluster spool): :class:`EngineMsgStore` implements the
+3-key-family layout over any engine, :class:`NativeMsgStore` mounts it
+on the C++ kvstore, :class:`SegmentMsgStore` on the pure-Python
+segment-log twin (sealed segments, checkpointed recovery, budgeted
+compaction driven by the broker off the event loop). The legacy
+:class:`FileMsgStore` flat JSON log is kept for on-disk compatibility
+(an existing ``msgstore.log`` is honoured at boot) but new ``file``
+stores open the segment engine.
+
+When ``msg_store_fsync`` is on, stores **group-commit**: a write burst
+marks the store dirty and the broker issues ONE fsync at the flush-tick
+boundary (``commit()``), counting the coalesced syncs — per-record
+fsync made every offline enqueue a disk round trip on the event loop.
 """
 
 from __future__ import annotations
@@ -30,17 +41,40 @@ log = logging.getLogger("vernemq_tpu.storage")
 class MsgStore:
     """Interface (msg_store_* plugin hooks)."""
 
+    #: True when read_many may run on an executor thread concurrently
+    #: with loop-side writes (the store locks internally) — the gate
+    #: the batched ResumeCollector checks before going off-loop
+    supports_batched_read = False
+
     def write(self, sid: SubscriberId, msg: Msg) -> None:
         raise NotImplementedError
 
     def read_all(self, sid: SubscriberId) -> List[Msg]:
         raise NotImplementedError
 
+    def read_many(self, sids: List[SubscriberId]
+                  ) -> Dict[SubscriberId, List[Msg]]:
+        """Batched recovery read for a reconnect storm: one call
+        resolves every subscriber's offline backlog (the reference's
+        msg_store_find per queue, amortized)."""
+        return {sid: self.read_all(sid) for sid in sids}
+
     def delete(self, sid: SubscriberId, msg_ref: bytes) -> None:
         raise NotImplementedError
 
     def delete_all(self, sid: SubscriberId) -> None:
         raise NotImplementedError
+
+    def needs_commit(self) -> bool:
+        """True when fsync work is parked for the group commit."""
+        return False
+
+    def commit(self) -> int:
+        """Flush the parked fsync (one sync per write burst); returns
+        the number of COALESCED syncs (writes beyond the first since
+        the last commit — what per-record fsync would have cost
+        extra)."""
+        return 0
 
     def close(self) -> None:
         pass
@@ -112,30 +146,33 @@ class SeqCounter:
                 self._next = seen + 1
 
 
-class NativeMsgStore(MsgStore):
-    """C++ storage-engine-backed store with the reference's 3-key-family
-    layout (``vmq_lvldb_store.erl:339-416``):
+class EngineMsgStore(MsgStore):
+    """The reference's 3-key-family layout (``vmq_lvldb_store.erl:
+    339-416``) over any ``storage/segment.py`` engine:
 
     - ``m\\x00<ref>``                       → encoded message (payload family)
     - ``r\\x00<sid><ref>``                  → b"" (per-subscriber ref entry)
     - ``i\\x00<sid><seq:8>``                → ref (ordered recovery index)
 
     Payloads are deduplicated across subscribers via an in-memory refcount
-    rebuilt from the ``r`` family on open; unreferenced payloads are
+    rebuilt from the ``i`` family on open; unreferenced payloads are
     garbage-collected by a startup scan (``vmq_lvldb_store.erl:418-453``).
 
     Thread-safety: one lock per store instance around the host-side maps
-    (the C++ engine has its own per-instance mutex) — the analog of the
+    (the engines serialize their own file/C-side ops) — the analog of the
     reference's one gen_server per bucket serializing that bucket's ops.
+    Reads (``read_all_seq``/``read_many``) may therefore run on executor
+    threads concurrently with loop-side writes.
     """
 
-    def __init__(self, directory: str, seq: Optional[SeqCounter] = None,
-                 fsync: bool = False):
+    supports_batched_read = True
+
+    def __init__(self, engine, seq: Optional[SeqCounter] = None,
+                 fsync: bool = False, group_commit: bool = True):
         import time as _time
 
         from ..cluster.codec import decode, encode
         from ..cluster.node import msg_to_term, term_to_msg
-        from ..native.kvstore import KVStore
 
         # wrap the wire term with the wall-clock store time: the codec's
         # "remaining seconds" expiry is rebased at decode, so time spent in
@@ -152,15 +189,21 @@ class NativeMsgStore(MsgStore):
 
         self._enc = _enc
         self._dec = _dec
-        os.makedirs(directory, exist_ok=True)
-        self._kv = KVStore(os.path.join(directory, "msgstore.kv"))
+        self.engine = engine
+        self._kv = engine
         # refcount + sid→ref→[seq] maps, rebuilt from the r/i families
         self._refcount: Dict[bytes, int] = {}
         self._seqs: Dict[SubscriberId, Dict[bytes, List[int]]] = {}
         self._seq = seq or SeqCounter()
         self._fsync = fsync
+        self._group_commit = group_commit
+        self._sync_pending = 0
         self._lock = threading.Lock()
         self._recover()
+
+    @property
+    def engine_kind(self) -> str:
+        return getattr(self.engine, "kind", "native")
 
     @staticmethod
     def _sid_key(sid: SubscriberId) -> bytes:
@@ -196,7 +239,7 @@ class NativeMsgStore(MsgStore):
             if ref not in live_refs:
                 self._kv.delete(key)  # stale ref marker with no idx entries
         # startup GC: drop payloads nobody references (keys-only scan — no
-        # payload bytes cross the C boundary)
+        # payload bytes cross the engine boundary)
         for key in self._kv.scan_keys(b"m\x00"):
             if key[2:] not in live_refs:
                 self._kv.delete(key)
@@ -205,7 +248,7 @@ class NativeMsgStore(MsgStore):
         with self._lock:
             ref = msg.msg_ref
             # the 2-3 records of one message write go down in a single
-            # batched append (one native lock acquisition) — the analog
+            # batched append (one engine lock acquisition) — the analog
             # of the reference's one gen_server call covering the whole
             # 3-key write (vmq_lvldb_store.erl:339-358)
             batch = []
@@ -222,28 +265,78 @@ class NativeMsgStore(MsgStore):
             # _refcount first would make a retried first-delivery skip
             # the m-record forever (silent loss after restart)
             self._kv.put_many(batch)
-            if self._fsync:  # opt-in power-loss durability per write
-                self._kv.sync()
+            if self._fsync:
+                if self._group_commit:
+                    # park the sync for the broker's flush-tick commit:
+                    # one fsync per write burst, not per record
+                    self._sync_pending += 1
+                else:
+                    self._kv.sync()  # per-write power-loss durability
             if first:
                 self._refcount[ref] = 0
             self._refcount[ref] += 1
             self._seqs.setdefault(sid, {}).setdefault(ref, []).append(seq)
 
+    def needs_commit(self) -> bool:
+        return self._sync_pending > 0
+
+    def commit(self) -> int:
+        with self._lock:
+            pending, self._sync_pending = self._sync_pending, 0
+        if pending == 0:
+            return 0
+        self._kv.sync()
+        return pending - 1
+
     def read_all(self, sid: SubscriberId) -> List[Msg]:
         return [m for _, m in self.read_all_seq(sid)]
 
-    def read_all_seq(self, sid: SubscriberId) -> List[Tuple[int, Msg]]:
+    def read_all_seq(self, sid: SubscriberId,
+                     decoded: Optional[Dict[bytes, Msg]] = None
+                     ) -> List[Tuple[int, Msg]]:
         """(enqueue-seq, msg) pairs in seq order — the merge key for a
         bucketed store's cross-instance recovery (the reference's ordset
-        union in msg_store_collect, vmq_lvldb_store.erl:104-107)."""
+        union in msg_store_collect, vmq_lvldb_store.erl:104-107).
+
+        Served from the in-memory sid→ref→[seq] map (rebuilt from the
+        ``i`` family at recovery, mirrored on every write/delete) with
+        one engine point-get per distinct ref: a reconnect-storm read
+        is O(backlog) per session, never an O(total-keys) prefix scan
+        per session (the quadratic-storm cost the old per-sid engine
+        scans paid). ``decoded`` is an optional shared ref→Msg cache —
+        the payload family is refcounted ACROSS subscribers, so a
+        broadcast's single m-record decodes once per batch, not once
+        per session (sharing the Msg object mirrors the live fanout
+        path, which enqueues one Msg to every queue)."""
         out: List[Tuple[int, Msg]] = []
+        if decoded is None:
+            decoded = {}
         with self._lock:
-            for key, ref in self._kv.scan(b"i\x00" + self._sid_key(sid)):
-                data = self._kv.get(b"m\x00" + ref)
-                if data is not None:
-                    out.append((int.from_bytes(key[-8:], "big"),
-                                self._dec(data)))
+            pairs = [(seq, ref)
+                     for ref, seqs in self._seqs.get(sid, {}).items()
+                     for seq in seqs]
+            pairs.sort()
+            for seq, ref in pairs:
+                msg = decoded.get(ref)
+                if msg is None:
+                    data = self._kv.get(b"m\x00" + ref)
+                    if data is None:
+                        continue
+                    msg = decoded[ref] = self._dec(data)
+                out.append((seq, msg))
         return out
+
+    def read_many(self, sids: List[SubscriberId]
+                  ) -> Dict[SubscriberId, List[Msg]]:
+        """One batched recovery read (executor-friendly): a whole
+        reconnect-storm batch resolves in ONE off-loop call, and the
+        shared decode cache collapses cross-subscriber payload refs —
+        a fan-out notification parked in 100k offline queues is ONE
+        stored payload and decodes ONCE per batch here, where the
+        per-session read_all baseline pays the decode per session."""
+        decoded: Dict[bytes, Msg] = {}
+        return {sid: [m for _, m in self.read_all_seq(sid, decoded)]
+                for sid in sids}
 
     def delete(self, sid: SubscriberId, msg_ref: bytes) -> None:
         with self._lock:
@@ -254,52 +347,115 @@ class NativeMsgStore(MsgStore):
             if not seqs:
                 self._seqs[sid].pop(msg_ref, None)
             sk = self._sid_key(sid)
-            self._kv.delete(b"i\x00" + sk + seq.to_bytes(8, "big"))
+            keys = [b"i\x00" + sk + seq.to_bytes(8, "big")]
             if not self._seqs.get(sid, {}).get(msg_ref):
-                self._kv.delete(b"r\x00" + sk + msg_ref)
-            self._deref(msg_ref)
+                keys.append(b"r\x00" + sk + msg_ref)
+            keys.extend(self._deref_keys(msg_ref, 1))
+            self._kv.delete_many(keys)
 
     def delete_all(self, sid: SubscriberId) -> None:
         with self._lock:
             sk = self._sid_key(sid)
-            for key, ref in self._kv.scan(b"i\x00" + sk):
-                self._kv.delete(key)
-                self._deref(ref)
-            for key, _ in self._kv.scan(b"r\x00" + sk):
-                self._kv.delete(key)
-            self._seqs.pop(sid, None)
+            # the in-memory map names every live i/r key for this sid:
+            # point deletes batched into ONE engine append+flush, not an
+            # O(total-keys) prefix scan + a flush per record
+            keys: List[bytes] = []
+            for ref, seqs in self._seqs.pop(sid, {}).items():
+                for seq in seqs:
+                    keys.append(b"i\x00" + sk + seq.to_bytes(8, "big"))
+                keys.append(b"r\x00" + sk + ref)
+                keys.extend(self._deref_keys(ref, len(seqs)))
+            if keys:
+                self._kv.delete_many(keys)
 
-    def _deref(self, ref: bytes) -> None:
-        n = self._refcount.get(ref, 0) - 1
-        if n <= 0:
+    def _deref_keys(self, ref: bytes, n: int) -> List[bytes]:
+        """Drop ``n`` refcounts; returns the payload key to delete when
+        nobody references it anymore (caller batches the engine op)."""
+        left = self._refcount.get(ref, 0) - n
+        if left <= 0:
             self._refcount.pop(ref, None)
-            self._kv.delete(b"m\x00" + ref)
-        else:
-            self._refcount[ref] = n
+            return [b"m\x00" + ref]
+        self._refcount[ref] = left
+        return []
 
     def stats(self) -> Dict[str, int]:
-        return {"stored_messages": len(self._refcount),
-                "stored_refs": sum(len(m) for m in self._seqs.values()),
-                "kv_keys": self._kv.count(),
-                "kv_garbage_bytes": self._kv.garbage_bytes()}
+        out = {"stored_messages": len(self._refcount),
+               "stored_refs": sum(len(m) for m in self._seqs.values()),
+               "kv_keys": self._kv.count(),
+               "kv_garbage_bytes": self._kv.garbage_bytes()}
+        return out
 
     def sync(self) -> None:
         self._kv.sync()
 
     def close(self) -> None:
+        if self._sync_pending:
+            self.commit()
         self._kv.close()
 
 
-class FileMsgStore(MemoryMsgStore):
-    """Append-log-backed store: every op is journaled, state rebuilt on open
-    (the recovery scan role of vmq_lvldb_store.erl:396-453). Simple but
-    durable; swapped for the C++ engine later."""
+class NativeMsgStore(EngineMsgStore):
+    """C++ storage-engine-backed store (the kvstore engine mounted under
+    :class:`EngineMsgStore`'s 3-key-family layout)."""
 
-    def __init__(self, directory: str, fsync: bool = False):
+    def __init__(self, directory: str, seq: Optional[SeqCounter] = None,
+                 fsync: bool = False, group_commit: bool = True):
+        from .segment import NativeEngine
+
+        os.makedirs(directory, exist_ok=True)
+        super().__init__(
+            NativeEngine(os.path.join(directory, "msgstore.kv")),
+            seq=seq, fsync=fsync, group_commit=group_commit)
+
+
+class SegmentMsgStore(EngineMsgStore):
+    """Segment-log-backed store: the pure-Python twin of the native
+    engine (``storage/segment.py``) under the same key families —
+    sealed segments, checkpointed recovery (a million parked sessions
+    boot by loading the checkpoint index, not replaying history), and
+    broker-driven budgeted compaction off the event loop."""
+
+    def __init__(self, directory: str, seq: Optional[SeqCounter] = None,
+                 fsync: bool = False, group_commit: bool = True,
+                 segment_max_bytes: int = 8 * 1024 * 1024,
+                 checkpoint_every_bytes: int = 32 * 1024 * 1024):
+        from .segment import SegmentLogEngine
+
+        os.makedirs(directory, exist_ok=True)
+        super().__init__(
+            SegmentLogEngine(os.path.join(directory, "msgstore.seg"),
+                             segment_max_bytes=segment_max_bytes,
+                             checkpoint_every_bytes=checkpoint_every_bytes),
+            seq=seq, fsync=fsync, group_commit=group_commit)
+
+    @property
+    def recover_skipped(self) -> int:
+        return self.engine.recover_skipped
+
+    def stats(self) -> Dict[str, int]:
+        out = super().stats()
+        for k, v in self.engine.stats().items():
+            out[f"segment_{k}"] = v
+        return out
+
+
+class FileMsgStore(MemoryMsgStore):
+    """Legacy flat append-log store: every op is one JSON line, state
+    rebuilt by whole-file replay on open. Superseded by
+    :class:`SegmentMsgStore` for new ``message_store = file`` data dirs
+    (the broker keeps opening this class when a ``msgstore.log``
+    already exists, so old data dirs stay readable)."""
+
+    engine_kind = "file"
+
+    def __init__(self, directory: str, fsync: bool = False,
+                 group_commit: bool = True):
         super().__init__()
         os.makedirs(directory, exist_ok=True)
         self._path = os.path.join(directory, "msgstore.log")
         self._fsync = fsync
+        self._group_commit = group_commit
+        self._sync_pending = 0
         #: corrupt mid-file records skipped at recovery (surfaced as the
         #: msg_store_recover_skipped metric by the broker)
         self.recover_skipped = 0
@@ -357,8 +513,21 @@ class FileMsgStore(MemoryMsgStore):
     def _log(self, rec: dict) -> None:
         self._fh.write(json.dumps(rec).encode() + b"\n")
         self._fh.flush()
-        if self._fsync:  # opt-in power-loss durability per write
-            os.fsync(self._fh.fileno())
+        if self._fsync:  # opt-in power-loss durability
+            if self._group_commit:
+                self._sync_pending += 1  # one fsync per burst (commit)
+            else:
+                os.fsync(self._fh.fileno())
+
+    def needs_commit(self) -> bool:
+        return self._sync_pending > 0
+
+    def commit(self) -> int:
+        pending, self._sync_pending = self._sync_pending, 0
+        if pending == 0:
+            return 0
+        os.fsync(self._fh.fileno())
+        return pending - 1
 
     def write(self, sid: SubscriberId, msg: Msg) -> None:
         super().write(sid, msg)
@@ -379,6 +548,8 @@ class FileMsgStore(MemoryMsgStore):
         self._log({"op": "da", "mp": sid[0], "cid": sid[1]})
 
     def close(self) -> None:
+        if self._sync_pending:
+            self.commit()
         self._fh.close()
 
 
@@ -393,8 +564,10 @@ class BucketedMsgStore(MsgStore):
     ``msg_store_find``, ``vmq_lvldb_store.erl:84-107``).
     """
 
+    supports_batched_read = True
+
     def __init__(self, directory: str, instances: int = 12,
-                 fsync: bool = False):
+                 fsync: bool = False, group_commit: bool = True):
         os.makedirs(directory, exist_ok=True)
         # the bucket count is part of the on-disk layout: ref→bucket hashing
         # must match what wrote the data, or deletes silently miss. Persist
@@ -419,11 +592,15 @@ class BucketedMsgStore(MsgStore):
             for i in range(max(1, instances)):
                 self.instances.append(NativeMsgStore(
                     os.path.join(directory, f"bucket{i}"), seq=self._seqc,
-                    fsync=fsync))
+                    fsync=fsync, group_commit=group_commit))
         except Exception:
             for inst in self.instances:  # no half-open engines left locked
                 inst.close()
             raise
+
+    @property
+    def engine_kind(self) -> str:
+        return self.instances[0].engine_kind
 
     def _bucket(self, ref: bytes) -> NativeMsgStore:
         return self.instances[zlib.crc32(ref) % len(self.instances)]
@@ -438,12 +615,30 @@ class BucketedMsgStore(MsgStore):
         merged.sort(key=lambda p: p[0])
         return [m for _, m in merged]
 
+    def read_many(self, sids: List[SubscriberId]
+                  ) -> Dict[SubscriberId, List[Msg]]:
+        decoded: Dict[bytes, Msg] = {}
+        out: Dict[SubscriberId, List[Msg]] = {}
+        for sid in sids:
+            merged: List[Tuple[int, Msg]] = []
+            for inst in self.instances:
+                merged.extend(inst.read_all_seq(sid, decoded))
+            merged.sort(key=lambda p: p[0])
+            out[sid] = [m for _, m in merged]
+        return out
+
     def delete(self, sid: SubscriberId, msg_ref: bytes) -> None:
         self._bucket(msg_ref).delete(sid, msg_ref)
 
     def delete_all(self, sid: SubscriberId) -> None:
         for inst in self.instances:
             inst.delete_all(sid)
+
+    def needs_commit(self) -> bool:
+        return any(inst.needs_commit() for inst in self.instances)
+
+    def commit(self) -> int:
+        return sum(inst.commit() for inst in self.instances)
 
     def stats(self) -> Dict[str, int]:
         agg: Dict[str, int] = {}
