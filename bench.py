@@ -1523,13 +1523,18 @@ def config9_overload_storm(smoke):
 
 
 def _admission_client_proc(port, n_clients, storm_s, tag,
-                           connect_churn, out_q):
+                           connect_churn, out_q, mode="qos0"):
     """Spawn-safe load-generator entry for bench config 11. Each
-    process runs its own asyncio loop with ``n_clients`` QoS0 flood
+    process runs its own asyncio loop with ``n_clients`` flood
     publishers — each writes a pre-serialised blob of 2048 PUBLISH
     frames per drain cycle, so the load side costs ~a memcpy per
     message and the broker's admission path (parse, auth chain, route,
-    governor) is what saturates. ``connect_churn`` adds a
+    governor) is what saturates. ``mode`` picks the wire shape:
+    ``qos0`` (v4 QoS0, the original storm), ``qos1`` (v4 QoS1 with
+    distinct packet ids; a reader task drains the PUBACK stream so the
+    broker's write buffer never wedges the A/B), or ``alias1`` (v5
+    QoS1 through an established topic alias — every flooded frame is
+    the alias-only hot shape). ``connect_churn`` adds a
     connect/disconnect loop recording CONNECT->CONNACK latencies (the
     connect-storm component). Admitted throughput is counted on the
     WORKER side (mqtt_publish_received via the shared stats block) —
@@ -1546,23 +1551,57 @@ def _admission_client_proc(port, n_clients, storm_s, tag,
             sock.setsockopt(_sck.IPPROTO_TCP, _sck.TCP_NODELAY, 1)
 
     async def publisher(i):
-        from vernemq_tpu.protocol import codec_v4
+        from vernemq_tpu.protocol import codec_v4, codec_v5
         from vernemq_tpu.protocol.types import Connect, Publish
 
+        codec = codec_v5 if mode == "alias1" else codec_v4
         t0 = _t.perf_counter()
         reader, writer = await aio.open_connection("127.0.0.1", port)
         _nodelay(writer)
-        writer.write(codec_v4.serialise(
-            Connect(client_id=f"adm{tag}-{i}", keepalive=0)))
-        ack = await aio.wait_for(reader.readexactly(4), 15.0)
+        writer.write(codec.serialise(Connect(
+            client_id=f"adm{tag}-{i}", keepalive=0,
+            proto_ver=5 if mode == "alias1" else 4)))
+        buf = b""
+        while True:
+            buf += await aio.wait_for(reader.read(1024), 15.0)
+            connack, _rest = codec.parse(buf)
+            if connack is not None:
+                break
         results["connect_s"].append(_t.perf_counter() - t0)
-        if ack[3] != 0:
+        if getattr(connack, "rc", 0):
             results["refused"] += 1
             writer.close()
             return
-        frame = codec_v4.serialise(Publish(
-            topic=f"adm/{tag}/{i}", payload=b"x" * 32, qos=0))
-        blob = frame * 2048
+        topic = f"adm/{tag}/{i}"
+        if mode == "qos0":
+            frame = codec_v4.serialise(Publish(
+                topic=topic, payload=b"x" * 32, qos=0))
+            blob = frame * 2048
+        elif mode == "qos1":
+            blob = b"".join(codec_v4.serialise(Publish(
+                topic=topic, payload=b"x" * 32, qos=1, packet_id=p))
+                for p in range(1, 2049))
+        else:  # alias1: establish the alias, then flood alias-only
+            writer.write(codec_v5.serialise(Publish(
+                topic=topic, payload=b"x" * 32, qos=1, packet_id=1,
+                properties={"topic_alias": 1})))
+            await writer.drain()
+            blob = b"".join(codec_v5.serialise(Publish(
+                topic="", payload=b"x" * 32, qos=1, packet_id=p,
+                properties={"topic_alias": 1}))
+                for p in range(2, 2050))
+        drainer = None
+        if mode != "qos0":
+            async def _drain_acks():
+                # the broker PUBACKs every QoS1 frame: sink the stream
+                # (its bytes aren't the measurement — admitted count is
+                # read broker-side) so neither side's buffer wedges
+                try:
+                    while await reader.read(65536):
+                        pass
+                except (ConnectionError, OSError):
+                    pass
+            drainer = aio.ensure_future(_drain_acks())
         deadline = _t.monotonic() + storm_s
         sent = 0
         try:
@@ -1577,6 +1616,8 @@ def _admission_client_proc(port, n_clients, storm_s, tag,
             # which is exactly the admission-control contract
             results["errors"] += 1
         results["sent"] += sent
+        if drainer is not None:
+            drainer.cancel()
         writer.close()
 
     async def churner():
@@ -1657,7 +1698,7 @@ def config11_admission_storm(smoke):
                 time.sleep(0.25)
         return False
 
-    async def storm_measure(port, tag, sampler):
+    async def storm_measure(port, tag, sampler, mode="qos0"):
         """Fan out the load processes and measure admitted throughput
         over a mid-storm window via ``sampler()`` (a monotonic admitted
         count read on the broker side). Async so the single-loop
@@ -1666,7 +1707,7 @@ def config11_admission_storm(smoke):
         q = ctx.Queue()
         procs = [ctx.Process(target=_admission_client_proc,
                              args=(port, clients_per, storm_s,
-                                   f"{tag}{j}", j == 0, q),
+                                   f"{tag}{j}", j == 0, q, mode),
                              daemon=True)
                  for j in range(n_procs)]
         for p in procs:
@@ -1794,55 +1835,104 @@ def config11_admission_storm(smoke):
         finally:
             g.stop()
 
-    async def run_single_loop(tag="base", wire_fastpath=True):
+    async def run_single_loop(tag="base", wire_fastpath=True,
+                              mode="qos0"):
         """Pre-PR baseline: ONE in-process broker on this loop, same
         storm from the same external load processes.
         ``wire_fastpath=False`` pins the classic per-frame session path
-        (the wire A/B's pure leg runs it with the native codec forced
-        off as well)."""
+        (the wire A/B's pure legs run it with the native codec forced
+        off as well). ``mode`` selects the storm's wire shape (see
+        ``_admission_client_proc``); every leg also records its
+        wire-stage histograms and runs the QoS1 exactly-once parity
+        phase against the same broker — the A/B is only meaningful if
+        both legs are provably zero-loss."""
         from vernemq_tpu.broker.config import Config
         from vernemq_tpu.broker.server import start_broker
+        from vernemq_tpu.observability import histogram as hist
 
         cfg = Config(systree_enabled=False, allow_anonymous=True,
                      sysmon_lag_threshold=30.0,
-                     wire_fastpath_enabled=wire_fastpath)
+                     wire_fastpath_enabled=wire_fastpath,
+                     topic_alias_max_client=16)
         broker, server = await start_broker(cfg, port=0,
                                             node_name="adm-" + tag)
+        # the histogram registry is process-global and every leg runs
+        # in THIS process: per-leg stage latencies are the delta
+        # against a pre-storm baseline, taken after the parity phase
+        # so the leg's own QoS1 fanout encodes are in its numbers
+        fams = ("stage_wire_parse_ms", "stage_wire_encode_ms")
+        base_snap = {f: broker.metrics.histogram_snapshot().get(f)
+                     for f in fams}
         out = await storm_measure(
             server.port, tag,
-            lambda: broker.metrics.value("mqtt_publish_received"))
+            lambda: broker.metrics.value("mqtt_publish_received"),
+            mode)
+        out["parity_ok"] = await parity_phase(server.port, tag)
+        for fam in fams:
+            s1 = broker.metrics.histogram_snapshot().get(fam)
+            s0 = base_snap[fam]
+            if s1 and s0:
+                s1 = ([a - b for a, b in zip(s1[0], s0[0])],
+                      s1[1] - s0[1], s1[2] - s0[2])
+            out[fam] = ({k: (round(v, 4) if isinstance(v, float)
+                             else v)
+                         for k, v in hist.summary(s1).items()}
+                        if s1 and s1[2] > 0 else None)
         await broker.stop()
         await server.stop()
         return out
 
     base = asyncio.run(run_single_loop())
-    # wire-plane A/B (ISSUE 12 acceptance): the SAME storm at the same
-    # (single) worker count, native batched codec + QoS0 fast path vs
-    # the pure-Python pre-wire-plane session path. The native leg IS
-    # the baseline run above; the pure leg forces the whole plane off.
+    # wire-plane A/B (ISSUE 12 + ISSUE 16 acceptance): the SAME storm
+    # at the same (single) worker count, native batched codec + wire
+    # fast path vs the pure-Python pre-wire-plane session path — one
+    # leg pair per wire shape: qos0 (the original flood; its native
+    # leg IS the baseline run above), qos1 (ack-bearing ingress +
+    # batched fanout encode), alias1 (v5 alias-only hot frames). The
+    # pure legs force the whole plane off. Every leg carries its own
+    # stage_wire_* histograms and a QoS1 exactly-once parity verdict.
     from vernemq_tpu.protocol import codec_v4 as _c4
     from vernemq_tpu.protocol import codec_v5 as _c5
     from vernemq_tpu.protocol import fastpath as _fp
 
     native_built = _fp.load_native() is not None
-    note("[bench] config11 wire-plane pure-python leg...")
-    _saved_codec = (_c4._C, _c5._C, _fp._force_pure)
-    _c4._C = None
-    _c5._C = None
-    _fp._force_pure = True
-    try:
-        pure = asyncio.run(run_single_loop("pure", wire_fastpath=False))
-    finally:
-        _c4._C, _c5._C, _fp._force_pure = _saved_codec
-    wire_ab = {
-        "native": {"admitted_pubs_per_s": base["admitted_pubs_per_s"],
-                   "native_codec": native_built, "wire_fastpath": True},
-        "pure": {"admitted_pubs_per_s": pure["admitted_pubs_per_s"],
-                 "native_codec": False, "wire_fastpath": False},
-        "admitted_speedup": (round(
-            base["admitted_pubs_per_s"] / pure["admitted_pubs_per_s"],
-            2) if pure["admitted_pubs_per_s"] else None),
-    }
+
+    def _leg(r, native):
+        return {
+            "admitted_pubs_per_s": r["admitted_pubs_per_s"],
+            "native_codec": native_built if native else False,
+            "wire_fastpath": native,
+            "stage_wire_parse_ms": r["stage_wire_parse_ms"],
+            "stage_wire_encode_ms": r["stage_wire_encode_ms"],
+            "parity_ok": r["parity_ok"],
+        }
+
+    def _pure_leg(tag, mode):
+        saved = (_c4._C, _c5._C, _fp._force_pure)
+        _c4._C = None
+        _c5._C = None
+        _fp._force_pure = True
+        try:
+            return asyncio.run(run_single_loop(
+                tag, wire_fastpath=False, mode=mode))
+        finally:
+            _c4._C, _c5._C, _fp._force_pure = saved
+
+    wire_ab = {}
+    for mode in ("qos0", "qos1", "alias1"):
+        if mode == "qos0":
+            nat = base
+        else:
+            note(f"[bench] config11 wire-plane {mode} native leg...")
+            nat = asyncio.run(run_single_loop(f"n{mode}", mode=mode))
+        note(f"[bench] config11 wire-plane {mode} pure leg...")
+        pure = _pure_leg(f"p{mode}", mode)
+        pfx = "" if mode == "qos0" else mode + "_"
+        wire_ab[pfx + "native"] = _leg(nat, True)
+        wire_ab[pfx + "pure"] = _leg(pure, False)
+        wire_ab[pfx + "admitted_speedup"] = (round(
+            nat["admitted_pubs_per_s"] / pure["admitted_pubs_per_s"],
+            2) if pure["admitted_pubs_per_s"] else None)
     per = {}
     for i, n in enumerate((1, 2, 4)):
         note(f"[bench] config11 workers={n} storm...")
@@ -1882,7 +1972,10 @@ def config11_admission_storm(smoke):
             "Re-run on a many-core host (ROADMAP million-session item) "
             "for the real ladder."
             if (os.cpu_count() or 1) < 5 else None),
-        "parity_ok": all(p["parity_ok"] for p in per.values()),
+        "parity_ok": (all(p["parity_ok"] for p in per.values())
+                      and all(leg["parity_ok"]
+                              for leg in wire_ab.values()
+                              if isinstance(leg, dict))),
     }
     return out
 

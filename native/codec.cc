@@ -311,9 +311,20 @@ PyObject* parse_batch(PyObject*, PyObject* args) {
           tpos += 2;
         }
         if (v5) {
-          // hot v5 shape: EMPTY property block (one 0x00 length byte)
-          if (tpos >= body_len || body[tpos] != 0) break;
-          tpos += 1;
+          // hot v5 shapes: EMPTY property block (one 0x00 length byte)
+          // or a block carrying ONLY a topic-alias property
+          // (0x03 0x23 hi lo) — the record layout is unchanged; the
+          // consumer re-reads the alias from the span between pid and
+          // payload_off (props_len 4 means alias, 1 means none)
+          if (tpos >= body_len) break;
+          if (body[tpos] == 0) {
+            tpos += 1;
+          } else if (body[tpos] == 3 && tpos + 4 <= body_len &&
+                     body[tpos + 1] == 0x23) {
+            tpos += 4;
+          } else {
+            break;
+          }
         }
         kind = (qos == 0) ? K_PUB0 : K_PUB;
         topic_off = body_off + 2;
@@ -426,6 +437,186 @@ PyObject* encode_publish_header(PyObject*, PyObject* args) {
   return out;
 }
 
+// encode_publish_headers_batch(topic: str, qos, retain, dup,
+//   pids: sequence, payload_len, v5=False, aliases=None)
+//     -> (arena: bytes, offsets: tuple[int, ...])
+//
+// The fanout half of the wire plane: ONE call emits N per-recipient
+// pid-patched PUBLISH headers into a single arena; offsets carries
+// N+1 entries so header i is arena[offsets[i]:offsets[i+1]]. The
+// caller slices with a memoryview and pairs each header with the
+// SHARED payload bytes object in an iovec — the payload is never
+// copied per recipient, and the per-recipient Python encode loop
+// collapses into one native call.
+//
+// Per-recipient variation: pids[i] is the recipient's packet id (None
+// = no pid; refused when qos > 0). aliases[i] (v5 only) selects the
+// topic-alias form: 0 = full topic + empty property block, +a =
+// alias-only header (EMPTY topic + topic-alias property a), -a =
+// alias-establishing header (full topic AND topic-alias property a).
+// Refusals raise ValueError with the same spellings as
+// encode_publish_header so the Python wrapper's fallback contract is
+// shared.
+PyObject* encode_publish_headers_batch(PyObject*, PyObject* args) {
+  PyObject* topic_obj;
+  int qos, retain, dup;
+  PyObject* pids_obj;
+  Py_ssize_t payload_len;
+  int v5 = 0;
+  PyObject* aliases_obj = Py_None;
+  if (!PyArg_ParseTuple(args, "UiiiOn|pO", &topic_obj, &qos, &retain,
+                        &dup, &pids_obj, &payload_len, &v5,
+                        &aliases_obj))
+    return nullptr;
+  Py_ssize_t tlen;
+  const char* topic = PyUnicode_AsUTF8AndSize(topic_obj, &tlen);
+  if (topic == nullptr) return nullptr;
+  if (tlen > 65535) {
+    PyErr_SetString(PyExc_ValueError, "topic too long");
+    return nullptr;
+  }
+  PyObject* pids = PySequence_Fast(pids_obj, "pids must be a sequence");
+  if (pids == nullptr) return nullptr;
+  const Py_ssize_t n = PySequence_Fast_GET_SIZE(pids);
+  PyObject* aliases = nullptr;
+  if (aliases_obj != Py_None) {
+    if (!v5) {
+      Py_DECREF(pids);
+      PyErr_SetString(PyExc_ValueError, "aliases require v5");
+      return nullptr;
+    }
+    aliases = PySequence_Fast(aliases_obj,
+                              "aliases must be a sequence");
+    if (aliases == nullptr) {
+      Py_DECREF(pids);
+      return nullptr;
+    }
+    if (PySequence_Fast_GET_SIZE(aliases) != n) {
+      Py_DECREF(pids);
+      Py_DECREF(aliases);
+      PyErr_SetString(PyExc_ValueError, "aliases length mismatch");
+      return nullptr;
+    }
+  }
+  const unsigned char b0 = static_cast<unsigned char>(
+      (PUBLISH << 4) | (dup ? 0x08 : 0) | ((qos & 3) << 1) |
+      (retain ? 1 : 0));
+  std::vector<unsigned char> arena;
+  arena.reserve(static_cast<size_t>(n) *
+                (static_cast<size_t>(tlen) + 16));
+  std::vector<Py_ssize_t> offs;
+  offs.reserve(static_cast<size_t>(n) + 1);
+  offs.push_back(0);
+  const char* err = nullptr;
+  bool fail = false;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* pid_obj = PySequence_Fast_GET_ITEM(pids, i);
+    long pid = 0;
+    int has_pid = 0;
+    if (pid_obj != Py_None) {
+      pid = PyLong_AsLong(pid_obj);
+      if (pid == -1 && PyErr_Occurred()) {
+        fail = true;
+        break;
+      }
+      if (pid < 1 || pid > 65535) {
+        err = "packet_id out of range";
+        fail = true;
+        break;
+      }
+      has_pid = 1;
+    }
+    if (qos > 0 && !has_pid) {
+      err = "missing_packet_id";
+      fail = true;
+      break;
+    }
+    long alias = 0;
+    if (aliases != nullptr) {
+      PyObject* a_obj = PySequence_Fast_GET_ITEM(aliases, i);
+      alias = PyLong_AsLong(a_obj);
+      if (alias == -1 && PyErr_Occurred()) {
+        fail = true;
+        break;
+      }
+      const long mag = alias < 0 ? -alias : alias;
+      if (mag > 65535) {
+        err = "topic_alias out of range";
+        fail = true;
+        break;
+      }
+    }
+    const long mag = alias < 0 ? -alias : alias;
+    const Py_ssize_t ti = (v5 && alias > 0) ? 0 : tlen;
+    const Py_ssize_t props_len = v5 ? (alias != 0 ? 4 : 1) : 0;
+    const Py_ssize_t body_len =
+        2 + ti + (qos > 0 ? 2 : 0) + props_len + payload_len;
+    unsigned char var[4];
+    int var_len = 0;
+    Py_ssize_t rem = body_len;
+    do {
+      unsigned char b = rem & 0x7F;
+      rem >>= 7;
+      if (rem) b |= 0x80;
+      var[var_len++] = b;
+    } while (rem && var_len < 4);
+    if (rem) {
+      err = "frame too large";
+      fail = true;
+      break;
+    }
+    arena.push_back(b0);
+    arena.insert(arena.end(), var, var + var_len);
+    arena.push_back(static_cast<unsigned char>(ti >> 8));
+    arena.push_back(static_cast<unsigned char>(ti & 0xFF));
+    if (ti) {
+      const unsigned char* t =
+          reinterpret_cast<const unsigned char*>(topic);
+      arena.insert(arena.end(), t, t + ti);
+    }
+    if (qos > 0) {
+      arena.push_back(static_cast<unsigned char>((pid >> 8) & 0xFF));
+      arena.push_back(static_cast<unsigned char>(pid & 0xFF));
+    }
+    if (v5) {
+      if (alias != 0) {
+        arena.push_back(3);
+        arena.push_back(0x23);
+        arena.push_back(static_cast<unsigned char>((mag >> 8) & 0xFF));
+        arena.push_back(static_cast<unsigned char>(mag & 0xFF));
+      } else {
+        arena.push_back(0);
+      }
+    }
+    offs.push_back(static_cast<Py_ssize_t>(arena.size()));
+  }
+  Py_DECREF(pids);
+  Py_XDECREF(aliases);
+  if (fail) {
+    if (err != nullptr) PyErr_SetString(PyExc_ValueError, err);
+    return nullptr;
+  }
+  PyObject* arena_obj = PyBytes_FromStringAndSize(
+      arena.empty() ? "" : reinterpret_cast<const char*>(arena.data()),
+      static_cast<Py_ssize_t>(arena.size()));
+  if (arena_obj == nullptr) return nullptr;
+  PyObject* offs_obj = PyTuple_New(n + 1);
+  if (offs_obj == nullptr) {
+    Py_DECREF(arena_obj);
+    return nullptr;
+  }
+  for (Py_ssize_t i = 0; i <= n; ++i) {
+    PyObject* v = PyLong_FromSsize_t(offs[static_cast<size_t>(i)]);
+    if (v == nullptr) {
+      Py_DECREF(arena_obj);
+      Py_DECREF(offs_obj);
+      return nullptr;
+    }
+    PyTuple_SET_ITEM(offs_obj, i, v);
+  }
+  return Py_BuildValue("(NN)", arena_obj, offs_obj);
+}
+
 // serialise_publish(topic: str, payload: bytes, qos, retain, dup,
 //                   packet_id or None) -> bytes (one allocation)
 PyObject* serialise_publish(PyObject*, PyObject* args) {
@@ -511,6 +702,10 @@ PyMethodDef methods[] = {
     {"encode_publish_header", encode_publish_header, METH_VARARGS,
      "Writev-ready PUBLISH header (fixed header + topic [+pid]); the "
      "payload rides the iovec uncopied."},
+    {"encode_publish_headers_batch", encode_publish_headers_batch,
+     METH_VARARGS,
+     "One call emits N per-recipient pid-patched (and v5 alias-aware) "
+     "PUBLISH headers into a single arena: (arena, offsets)."},
     {"serialise_publish", serialise_publish, METH_VARARGS,
      "Serialise a v4/v5 PUBLISH frame in one allocation."},
     {nullptr, nullptr, 0, nullptr}};
@@ -522,7 +717,7 @@ PyModuleDef module = {PyModuleDef_HEAD_INIT, "_vmq_codec",
 // Bumped whenever a function signature or result layout changes: the
 // loader refuses an older prebuilt .so (a stale-ABI artifact would
 // otherwise raise TypeError at call time deep inside the parse path).
-constexpr long FASTPATH_VERSION = 3;
+constexpr long FASTPATH_VERSION = 4;
 
 }  // namespace
 

@@ -508,3 +508,101 @@ def test_stale_extension_version_rejected():
     mod = load_extension("_vmq_codec", min_version=REQUIRED_VERSION)
     assert mod is not None
     assert mod.FASTPATH_VERSION >= REQUIRED_VERSION
+
+
+def _batch_both(fp, *args, **kw):
+    out = []
+    for native in (True, False):
+        saved = fp._force_pure
+        fp._force_pure = not native
+        try:
+            out.append(fp.publish_headers_batch(*args, **kw))
+        finally:
+            fp._force_pure = saved
+    return out
+
+
+def test_publish_headers_batch_native_pure_bit_identical():
+    """One-call batched fanout encode: native and pure twins emit a
+    byte-identical (arena, offsets) pair over random fanout shapes —
+    pid patching, v4/v5, alias-only and alias-establishing headers —
+    and every arena segment + the shared payload is byte-identical to
+    the full codec's serialise of the equivalent per-recipient frame."""
+    from vernemq_tpu.protocol import codec_v5 as C5
+    from vernemq_tpu.protocol import fastpath as fp
+
+    rng = random.Random(77)
+    topics = ["a", "s/b/c", "x" * 200, "t/élé/+x", ""]
+    for trial in range(300):
+        topic = rng.choice(topics)
+        qos = rng.randint(0, 2)
+        retain = rng.random() < 0.3
+        dup = rng.random() < 0.2
+        v5 = rng.random() < 0.5
+        n = rng.randint(1, 24)
+        payload = bytes(rng.getrandbits(8)
+                        for _ in range(rng.choice((0, 1, 32, 700))))
+        pids = [rng.randint(1, 65535) if qos else None
+                for _ in range(n)]
+        aliases = None
+        if v5 and rng.random() < 0.7:
+            aliases = [rng.choice((0, 0, rng.randint(1, 40),
+                                   -rng.randint(1, 40)))
+                       for _ in range(n)]
+        native, pure = _batch_both(fp, topic, qos, retain, dup, pids,
+                                   len(payload), v5, aliases)
+        assert native == pure, trial
+        arena, offs = native
+        assert len(offs) == n + 1 and offs[0] == 0
+        assert offs[-1] == len(arena)
+        mod = C5 if v5 else C
+        for i in range(n):
+            alias = aliases[i] if aliases else 0
+            props = {}
+            t = topic
+            if alias > 0:
+                props = {"topic_alias": alias}
+                t = ""
+            elif alias < 0:
+                props = {"topic_alias": -alias}
+            want = mod.serialise(Publish(
+                topic=t, payload=payload, qos=qos, retain=retain,
+                dup=dup, packet_id=pids[i], properties=props))
+            assert arena[offs[i]:offs[i + 1]] + payload == want, \
+                (trial, i)
+
+
+def test_publish_headers_batch_refusals_identical():
+    """Torn/oversize/contract-violating batch inputs raise the SAME
+    ValueError spelling from both twins — a refusal is a healthy
+    verdict, never a breaker event."""
+    from vernemq_tpu.protocol import fastpath as fp
+    from vernemq_tpu.protocol import wire
+
+    cases = [
+        (("x" * 70000, 0, False, False, [None], 4, False, None),
+         "topic too long"),
+        (("t", 0, False, False, [None], 4, False, [0]),
+         "aliases require v5"),
+        (("t", 0, False, False, [None, None], 4, True, [0]),
+         "aliases length mismatch"),
+        (("t", 1, False, False, [0], 4, False, None),
+         "packet_id out of range"),
+        (("t", 1, False, False, [70000], 4, False, None),
+         "packet_id out of range"),
+        (("t", 1, False, False, [None], 4, False, None),
+         "missing_packet_id"),
+        (("t", 1, False, False, [7], 4, True, [70000]),
+         "topic_alias out of range"),
+        (("t", 1, False, False, [7], wire.MAX_VARINT, False, None),
+         "frame too large"),
+    ]
+    for args, msg in cases:
+        for native in (True, False):
+            saved = fp._force_pure
+            fp._force_pure = not native
+            try:
+                with pytest.raises(ValueError, match=msg):
+                    fp.publish_headers_batch(*args)
+            finally:
+                fp._force_pure = saved

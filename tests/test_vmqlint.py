@@ -556,6 +556,29 @@ def test_fault_registry_dead_registry_entry(base_files):
                and "no faults.inject" in f.message for f in found)
 
 
+def test_fault_registry_covers_batch_encode_site(base_files):
+    """The batched fanout encoder's ``wire.encode`` seam is visible to
+    the pass, not just grandfathered by the older per-frame site: strip
+    every ``wire.encode`` inject from fastpath.py and the registry
+    entry goes dead; strip only the per-frame site and the batch
+    entry point alone keeps the registry satisfied."""
+    rel = "vernemq_tpu/protocol/fastpath.py"
+    text = base_files[rel].text
+    site = 'faults.inject("wire.encode", max_delay_s=1.0)'
+    # publish_header + publish_headers_batch each carry the seam
+    assert text.count(site) == 2
+    found = run_pass("fault-registry", base_files,
+                     overrides={rel: text.replace(site, "pass")})
+    assert any("'wire.encode'" in f.message
+               and "no faults.inject" in f.message for f in found)
+    # first occurrence is the per-frame publish_header site; with it
+    # gone, the batch-encode site must satisfy the registry by itself
+    found = run_pass("fault-registry", base_files,
+                     overrides={rel: text.replace(site, "pass", 1)})
+    assert not any("wire.encode" in f.message for f in found), \
+        [f.render() for f in found]
+
+
 def test_fault_registry_breaker_path_drift(base_files):
     src = ('def rows(mp):\n'
            '    return [{"path": "acl", "mountpoint": mp,\n'

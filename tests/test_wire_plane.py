@@ -295,9 +295,12 @@ async def test_wire_parse_fault_degrades_to_pure_never_drops():
 
 @pytest.mark.asyncio
 async def test_complex_rows_fall_back_to_exact_msg_path():
-    """One complex recipient (a v5 subscriber) routes the whole fanout
-    through the classic Msg path: the v5 client gets a correct v5
-    frame, the v4 client its v4 frame — semantics over speed."""
+    """One complex recipient routes the whole fanout through the
+    classic Msg path — since the alias-aware batch encoder a plain v5
+    subscriber is a FAST recipient; what stays complex is a v5 session
+    with a maximum_packet_size (every frame must be measured by
+    _plan_v5_delivery). The capped client gets a correct v5 frame, the
+    v4 client its v4 frame — semantics over speed."""
     broker, server = await boot()
     try:
         v4sub = MQTTClient("127.0.0.1", server.port, client_id="s4")
@@ -307,11 +310,22 @@ async def test_complex_rows_fall_back_to_exact_msg_path():
                            proto_ver=5)
         await v5sub.connect()
         await v5sub.subscribe("c/#", qos=0)
+        # packet-size-capped v5 session: the one v5 shape the wire
+        # fanout refuses (wire_v5_fast_ok) — forces the classic path
+        capped = await Raw5.connect(server.port, "s5cap",
+                                    {"maximum_packet_size": 256})
+        await capped.send(codec_v5.serialise(Subscribe(
+            packet_id=1, topics=[("c/#", SubOpts(qos=0))])))
+        await capped.recv5(1)  # SUBACK
         pub = MQTTClient("127.0.0.1", server.port, client_id="p4")
         await pub.connect()
+        base_batches = fastpath.fanout_batches
         await pub.publish("c/x", b"mixed", qos=0)
         assert (await v4sub.recv(5.0)).payload == b"mixed"
         assert (await v5sub.recv(5.0)).payload == b"mixed"
+        assert (await capped.recv5(1))[0].payload == b"mixed"
+        assert fastpath.fanout_batches == base_batches  # classic served
+        capped.close()
         # a v5 PUBLISHER with empty props is fast-admittable too
         base = fastpath.fastpath_pubs
         pub5 = MQTTClient("127.0.0.1", server.port, client_id="p5",
@@ -415,3 +429,205 @@ async def test_stream_transport_iovec_flush():  # async: write() schedules
     t.write(b"ee")
     t._flush()
     assert written == [b"aabbccdd", b"ee"]
+
+
+class Raw5(Raw):
+    """Raw v5 endpoint: CONNECT with properties, consuming v5 reads."""
+
+    @classmethod
+    async def connect(cls, port, client_id, properties=None):
+        r, w = await asyncio.open_connection("127.0.0.1", port)
+        self = cls(r, w)
+        await self.send(codec_v5.serialise(Connect(
+            client_id=client_id, keepalive=0, clean_start=True,
+            proto_ver=5, properties=properties or {})))
+        await self.recv5(1)  # CONNACK
+        return self
+
+    async def recv5(self, n, timeout=5.0):
+        frames = []
+        while len(frames) < n:
+            if self.buf:
+                saved, codec_v5._C = codec_v5._C, None
+                try:
+                    f, rest = codec_v5.parse(self.buf)
+                finally:
+                    codec_v5._C = saved
+                if f is not None:
+                    self.buf = rest
+                    frames.append(f)
+                    continue
+            chunk = await asyncio.wait_for(self.reader.read(65536),
+                                           timeout)
+            assert chunk, "peer closed"
+            self.buf += chunk
+        return frames
+
+
+@pytest.mark.asyncio
+async def test_qos1_fast_path_delivers_with_zero_frame_objects():
+    """The QoS≥1 ingress acceptance spot test: a QoS1 batch admitted
+    through the widened gate resolves pid + PUBACK straight from the
+    frame table and — with only QoS0 recipients in the fanout —
+    materialises ZERO Publish frames and ZERO Msg objects broker-side,
+    counting in wire_fastpath_pubs_qos."""
+    from vernemq_tpu.broker import message as message_mod
+
+    broker, server = await boot(observability_enabled=False)
+    try:
+        sub = await Raw.connect(server.port, "q1sub")
+        await sub.send(codec_v4.serialise(Subscribe(
+            packet_id=1, topics=[("q/#", SubOpts(qos=0))])))
+        await sub.read_frames(2)  # CONNACK + SUBACK
+
+        pub = await Raw.connect(server.port, "q1pub")
+        n = 500
+        blob = b"".join(
+            codec_v4.serialise(Publish(topic=f"q/{i % 8}",
+                                       payload=b"q%04d" % i, qos=1,
+                                       packet_id=(i % 1000) + 1))
+            for i in range(n))
+        base_fast = fastpath.fastpath_pubs_qos
+
+        counts = {"publish": 0, "msg": 0}
+        pub_init = Publish.__init__
+        msg_init = message_mod.Msg.__init__
+
+        def counting_pub(self, *a, **k):
+            counts["publish"] += 1
+            return pub_init(self, *a, **k)
+
+        def counting_msg(self, *a, **k):
+            counts["msg"] += 1
+            return msg_init(self, *a, **k)
+
+        Publish.__init__ = counting_pub
+        message_mod.Msg.__init__ = counting_msg
+        try:
+            await pub.send(blob)
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while (fastpath.fastpath_pubs_qos - base_fast) < n:
+                assert asyncio.get_event_loop().time() < deadline, \
+                    fastpath.fastpath_pubs_qos - base_fast
+                await asyncio.sleep(0.01)
+            # every publish PUBACKed from the span (read_frames keeps
+            # the CONNACK in the capture buffer: skip frame 0)
+            acks = (await pub.read_frames(1 + n))[1:]
+        finally:
+            Publish.__init__ = pub_init
+            message_mod.Msg.__init__ = msg_init
+        assert counts == {"publish": 0, "msg": 0}
+        assert all(type(a).__name__ == "Puback" for a in acks)
+        frames = await sub.read_frames(2 + n)
+        payloads = [f.payload for f in frames[2:]]
+        assert payloads == [b"q%04d" % i for i in range(n)]
+        assert broker.registry.stats()["wire_fastpath_pubs_qos"] >= n
+        sub.close()
+        pub.close()
+    finally:
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_wire_encode_fault_drill_batch_path():
+    """A wire.encode fault drill against the batched fanout encoder:
+    native batch-encode calls fail, the breaker opens, every fanout
+    re-serves through the bit-identical pure twin — zero lost QoS1
+    deliveries — and the drill's exit recovers the native path."""
+    from vernemq_tpu.robustness import faults
+    from vernemq_tpu.robustness.breaker import CircuitBreaker
+    from vernemq_tpu.robustness.faults import FaultPlan, FaultRule
+
+    if fastpath.load_native() is None:
+        pytest.skip("native codec extension not built")
+    saved_breaker = fastpath.breaker
+    fastpath.breaker = CircuitBreaker(failure_threshold=2,
+                                      backoff_initial=60.0)
+    broker, server = await boot()
+    try:
+        # two protocol groups → TWO batch-encode calls per publish, so
+        # the failure run is consecutive (the wire breaker is shared
+        # with the parse seam, whose native successes between publishes
+        # reset a single-failure run)
+        sub = MQTTClient("127.0.0.1", server.port, client_id="esub")
+        await sub.connect()
+        await sub.subscribe("e/#", qos=1)
+        sub5 = MQTTClient("127.0.0.1", server.port, client_id="esub5",
+                          proto_ver=5)
+        await sub5.connect()
+        await sub5.subscribe("e/#", qos=1)
+        pub = MQTTClient("127.0.0.1", server.port, client_id="epub")
+        await pub.connect()
+        errs_before = fastpath.native_errors
+        faults.install(FaultPlan([FaultRule(point="wire.encode",
+                                            kind="error", count=100)]))
+        try:
+            for i in range(10):
+                await pub.publish("e/t", b"e%d" % i, qos=1,
+                                  timeout=10.0)
+            want = {b"e%d" % i for i in range(10)}
+            got = set()
+            got5 = set()
+            for _ in range(10):
+                got.add((await sub.recv(5.0)).payload)
+                got5.add((await sub5.recv(5.0)).payload)
+            assert got == want and got5 == want
+        finally:
+            faults.clear()
+        assert fastpath.native_errors - errs_before >= 2
+        assert not fastpath.breaker.is_closed
+        assert broker.registry.stats()["wire_breaker_state"] > 0
+        # recovery: the admin drill's exit resets; native serves again
+        fastpath.breaker.reset()
+        await pub.publish("e/t", b"back", qos=1, timeout=10.0)
+        assert (await sub.recv(5.0)).payload == b"back"
+        assert (await sub5.recv(5.0)).payload == b"back"
+        assert fastpath.breaker.is_closed
+        for c in (pub, sub, sub5):
+            await c.close()
+    finally:
+        fastpath.breaker = saved_breaker
+        await broker.stop()
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_v5_alias_lru_eviction_on_wire_path():
+    """Outbound topic aliases on the wire fast path: hot topics send
+    alias-only headers, a full per-connection table evicts the
+    least-recently-sent topic and re-establishes its alias number
+    (MQTT5 3.3.2.3.4 remapping) — all through the batched encoder."""
+    broker, server = await boot()
+    try:
+        sub = await Raw5.connect(server.port, "asub",
+                                 {"topic_alias_maximum": 2})
+        await sub.send(codec_v5.serialise(Subscribe(
+            packet_id=1, topics=[("a/#", SubOpts(qos=0))])))
+        await sub.recv5(1)  # SUBACK
+        pub = await Raw.connect(server.port, "apub")
+        base_batches = fastpath.fanout_batches
+        script = ["a/t1", "a/t2", "a/t3", "a/t2", "a/t1"]
+        blob = b"".join(
+            codec_v4.serialise(Publish(topic=t, payload=b"p%d" % i,
+                                       qos=0))
+            for i, t in enumerate(script))
+        await pub.send(blob)
+        frames = await sub.recv5(5)
+        got = [(f.topic, f.properties.get("topic_alias"), f.payload)
+               for f in frames]
+        # t1, t2 establish aliases 1, 2; t3 evicts LRU t1 and reuses
+        # alias 1; t2 is alias-only (hot); t1 evicts t3, reusing 1
+        assert got == [
+            ("a/t1", 1, b"p0"),
+            ("a/t2", 2, b"p1"),
+            ("a/t3", 1, b"p2"),
+            ("", 2, b"p3"),
+            ("a/t1", 1, b"p4"),
+        ]
+        assert fastpath.fanout_batches > base_batches  # wire path served
+        sub.close()
+        pub.close()
+    finally:
+        await broker.stop()
+        await server.stop()

@@ -34,6 +34,29 @@ if command -v g++ >/dev/null 2>&1 || command -v c++ >/dev/null 2>&1; then
   make -C native >/dev/null 2>&1 || true
 fi
 
+# loaded-codec version assertion: when the warmup produced a wire-codec
+# extension, its baked-in FASTPATH_VERSION must match the source header
+# — a stale .so served from the build cache would otherwise shadow a
+# contract bump and every "native" test result would be a lie. The
+# runtime loader enforces min_version too; this catches it BEFORE 700
+# tests run against the wrong module. (Skips cleanly when the codec
+# didn't build: the pure twin is the contract then.)
+python - <<'PYEOF' || exit 1
+import re, sys
+from vernemq_tpu.protocol import fastpath
+
+mod = fastpath.load_native()
+if mod is not None:
+    src = open("native/codec.cc", encoding="utf-8").read()
+    m = re.search(r"FASTPATH_VERSION\s*=\s*(\d+)", src)
+    want = int(m.group(1))
+    got = getattr(mod, "FASTPATH_VERSION", None)
+    if got != want or want != fastpath.REQUIRED_VERSION:
+        sys.exit(f"stale wire codec: loaded FASTPATH_VERSION={got}, "
+                 f"source header says {want}, loader requires "
+                 f"{fastpath.REQUIRED_VERSION} — rebuild native/")
+PYEOF
+
 # pre-test static gate: the unified vmqlint suite (tools/vmqlint) —
 # blocking calls in async bodies, metric-registry HELP/observe names,
 # lock discipline (no device/compile/IO under a threading lock),

@@ -217,6 +217,9 @@ class Broker:
         self.store_compacted_bytes = 0
         self.store_compact_paused = 0
         self.store_compact_errors = 0
+        # last-drained (hits, misses) snapshot of the bucketed store's
+        # probe counters (the maintenance tick moves deltas into $SYS)
+        self._probe_drained = (0, 0)
         # corrupt records skipped by the store's recovery scan are
         # surfaced, not silent (the old behavior discarded the tail) —
         # and so is a checkpoint-discarding full-scan fallback
@@ -366,6 +369,19 @@ class Broker:
             "wire_fastpath_pubs": "QoS0 publishes admitted through the "
                                   "object-free wire fast path (no "
                                   "frame/Msg objects materialised).",
+            "wire_fastpath_pubs_qos": "QoS1/2 publishes admitted "
+                                      "through the wire fast path (pid "
+                                      "stamped from the frame-table "
+                                      "span, no inbound frame object).",
+            "wire_fastpath_acks": "Ack-family frames (PUBACK/PUBREC/"
+                                  "PUBREL/PUBCOMP) resolved straight "
+                                  "from the frame table with no frame "
+                                  "object.",
+            "wire_fanout_batches": "One-call batched fanout header "
+                                   "encodes (publish_headers_batch): "
+                                   "each emitted N per-recipient "
+                                   "pid/alias-patched headers into one "
+                                   "arena.",
             "wire_breaker_state": "Wire-codec breaker state (0 closed, "
                                   "1 half-open, 2 open).",
             # cluster delivery spool (cluster/spool.py): depth +
@@ -1359,6 +1375,41 @@ class Broker:
                 self.metrics.incr("store_compactions")
                 self.metrics.incr("store_compacted_bytes", int(n))
                 reclaimed += int(n)
+        # the TTL sweep of expired parked messages rides the same tick,
+        # budgeted like compaction and gated by the same breaker (it is
+        # store maintenance: a failing engine must not be hammered)
+        ms = self.msg_store
+        sweep = getattr(ms, "sweep_expired", None)
+        if sweep is not None and self.store_breaker.allow():
+            sweep_budget = int(self.config.get(
+                "store_expire_sweep_budget", 256))
+            try:
+                n = await asyncio.get_event_loop().run_in_executor(
+                    None, sweep, sweep_budget)
+            except Exception:
+                if self.store_breaker.record_failure():
+                    log.warning("store TTL sweep failed; store "
+                                "maintenance breaker OPEN")
+                self.store_compact_errors += 1
+                self.metrics.incr("store_compact_errors")
+            else:
+                # no record_success here: the compaction steps own the
+                # breaker's success/probe accounting — a healthy sweep
+                # must not mask an accumulating compaction failure run
+                if n:
+                    self.metrics.incr("msg_store_expired_swept", n)
+        # bucket-probe telemetry: move the bucketed store's counter
+        # deltas into $SYS (the store layer holds no metrics handle)
+        hits = getattr(ms, "probe_hits", 0)
+        misses = getattr(ms, "probe_misses", 0)
+        dh, dm = self._probe_drained
+        if hits - dh or misses - dm:
+            if hits - dh:
+                self.metrics.incr("store_bucket_probe_hits", hits - dh)
+            if misses - dm:
+                self.metrics.incr("store_bucket_probe_misses",
+                                  misses - dm)
+            self._probe_drained = (hits, misses)
         return reclaimed
 
     async def _store_maintenance_loop(self) -> None:

@@ -278,6 +278,9 @@ DEFAULTS: Dict[str, Any] = {
     "store_checkpoint_every_bytes": 32 * 1024 * 1024,
     "store_compact_interval_ms": 1000,
     "store_compact_budget_bytes": 4 * 1024 * 1024,
+    # expired parked offline messages classified per maintenance tick
+    # (refs examined, not bytes; the sweep rides the compaction tick)
+    "store_expire_sweep_budget": 256,
     # batched reconnect-storm resumption (storage/resume.py): coalesce
     # concurrent offline replays into one off-loop read per window
     "resume_batched": True,

@@ -148,6 +148,15 @@ COUNTERS: List[Tuple[str, str]] = [
     ("store_recover_fallbacks",
      "Engine opens that discarded an unusable checkpoint and fell "
      "back to the full segment scan."),
+    ("store_bucket_probe_hits",
+     "Bucketed-store reads probing a bucket the sid→bucket membership "
+     "index named that held messages."),
+    ("store_bucket_probe_misses",
+     "Bucketed-store reads probing a bucket whose membership turned "
+     "out stale (cleaned from the index)."),
+    ("msg_store_expired_swept",
+     "Expired parked offline message copies deleted by the budgeted "
+     "TTL sweep riding the store maintenance tick."),
     ("retain_messages_stored", "Retained messages persisted."),
     # robustness (supervision tree analog + fault harness)
     ("supervisor_restarts", "Supervised tasks restarted after a crash."),

@@ -35,9 +35,10 @@ from ..models.trie import SubscriptionTrie
 from ..protocol import fastpath
 from ..protocol.topic import is_shared, unshare
 from ..protocol.types import PROTO_5, SubOpts
-from .message import Msg, SubscriberId, wire_v4_iov_qos0
+from .message import Msg, SubscriberId, wire_batch_iovs, wire_v4_iov_qos0
 from .queue import OFFLINE, ONLINE, QueueOpts, SubscriberQueue
-from .subscriber_db import SubscriberDB, SubscriberRecord, opts_to_dict
+from .subscriber_db import (SubscriberDB, SubscriberRecord, opts_from_dict,
+                            opts_to_dict)
 
 if TYPE_CHECKING:
     from .broker import Broker
@@ -156,19 +157,43 @@ class Registry:
         self.remote_enqueue_nowait = None  # fn(node, sid, [msg]) shared subs
 
     def bootstrap(self) -> None:
-        """Warm-load routing state from a persisted subscriber DB: replay
-        every stored record as a change event (the async trie warm-load of
-        ``vmq_reg_trie.erl:144-149``) and re-create offline queues for
-        persistent sessions homed here (``vmq_reg_mgr.erl:64-72``)."""
-        for sid, rec in self.db.fold():
-            self._on_subs_event(sid, None, rec, self.node_name)
-            if (rec.node == self.node_name and not rec.clean_session
+        """Warm-load routing state from a persisted subscriber DB —
+        STREAMING: the raw stored terms go straight to trie rows (the
+        fresh-record case of the change-event diff, with no
+        SubscriberRecord allocation per record and the common plain
+        opts shapes interned to a handful of shared objects), and
+        offline queues for persistent sessions homed here re-create
+        with the lazy-recovery pattern — the stored backlog loads on
+        first attach (via the ResumeCollector) or at drain. Boot cost
+        is one trie add per filter plus one queue object per parked
+        session, never a whole-DB object graph (the async trie
+        warm-load of ``vmq_reg_trie.erl:144-149``;
+        ``vmq_reg_mgr.erl:64-72``)."""
+        interned: Dict[Tuple, SubOpts] = {}
+        for sid, term in self.db.fold_raw():
+            if term is None:
+                continue
+            mountpoint = sid[0]
+            node = term["node"]
+            for f, od in (term.get("subs") or {}).items():
+                fw = tuple(f)
+                if "sid" in od or "flt" in od:
+                    # subscription-id / payload-filter rows keep their
+                    # own opts object (the filter engine refcounts and
+                    # windows per row — these must not be shared)
+                    opts = opts_from_dict(od)
+                else:
+                    k = (od.get("qos", 0), od.get("nl", False),
+                         od.get("rap", False), od.get("rh", 0))
+                    opts = interned.get(k)
+                    if opts is None:
+                        opts = interned[k] = opts_from_dict(od)
+                self._trie_add(mountpoint, fw, sid, node, opts)
+            if (node == self.node_name and not term.get("clean", True)
                     and sid not in self.queues):
                 queue = self._start_queue(
-                    sid, _qopts_from_dict(rec.queue_opts, self.broker.config))
-                # lazy: the stored backlog loads on first attach (via
-                # the ResumeCollector) or at drain — boot stays O(1)
-                # per parked session instead of one read_all each
+                    sid, _qopts_from_dict(dict(term.get("qopts") or {}),
+                                          self.broker.config))
                 self.broker.recover_offline(sid, queue, lazy=True)
                 queue._arm_expiry()  # session/persistent expiry clock
 
@@ -873,22 +898,49 @@ class Registry:
             self.broker.recorder.finish(trace)
         return n
 
+    def publish_wire(self, mountpoint: str, words: Tuple[str, ...],
+                     topic_str: str, payload: bytes,
+                     from_sid: Optional[SubscriberId], qos: int,
+                     trace=None) -> int:
+        """The wire-plane QoS1/2 publish: like
+        :meth:`publish_wire_qos0` but the fanout stamps each QoS≥1
+        recipient's packet id into its in-flight window and
+        batch-encodes all recipients' headers in ONE native call
+        (``fastpath.publish_headers_batch``). Synchronous only — the
+        session needs the match count for the PUBACK/PUBREC reason
+        code, so callers pre-gate ``batched_view_active()`` and keep
+        the classic async path there."""
+        n = self._wire_route(mountpoint, words, topic_str, payload,
+                             self.trie(mountpoint).match(list(words)),
+                             from_sid, qos=qos)
+        if trace is not None:
+            trace.stamp("route")
+            self.broker.recorder.finish(trace)
+        return n
+
     def _wire_route(self, mountpoint: str, words: Tuple[str, ...],
                     topic_str: str, payload: Optional[bytes], rows,
                     from_sid: Optional[SubscriberId],
                     wire_frame: Optional[bytes] = None,
-                    payload_skip: int = 0) -> int:
+                    payload_skip: int = 0, qos: int = 0) -> int:
         """Classify the fold result: if EVERY matched row is the plain
         fast shape, write the shared wire bytes to each recipient's
-        transport (verbatim inbound span for v4 publishers, one
-        native-encoded header + shared payload iovec otherwise) —
-        the object-free half of the wire plane. One complex row routes
-        the whole fanout through the classic Msg path for exact
-        semantics."""
+        transport (verbatim inbound span for v4 QoS0 publishers, one
+        shared native-encoded header, or one batched per-recipient
+        header arena for pid/alias-bearing groups — always with the
+        shared payload riding the iovec uncopied) — the object-free
+        half of the wire plane. One complex row routes the whole
+        fanout through the classic Msg path for exact semantics.
+
+        Fast rows now include v5 recipients (alias-aware headers from
+        the per-connection LRU via ``wire_alias_for``) and QoS≥1
+        deliveries (in-flight bookkeeping via ``wire_take_qos``); a
+        qos-downgrade row (subscription qos below the publish qos but
+        above 0) builds its own shared Msg per effective qos."""
         rows = list(rows)
         cfg = self.broker.config
         upgrade = cfg.upgrade_outgoing_qos
-        sessions: List[Any] = []
+        recips: List[Tuple[Any, int]] = []
         fast = True
         for _f, key, opts in rows:
             if not (isinstance(key, tuple) and len(key) == 2):
@@ -911,46 +963,128 @@ class Registry:
             # getattr defaults: non-Session consumers (bridge
             # endpoints) classify complex, same as the classic fan0
             # collection
-            if getattr(sess, "closed", True) \
-                    or getattr(sess, "proto_ver", PROTO_5) == PROTO_5:
+            if getattr(sess, "closed", True):
                 fast = False
                 break
-            sessions.append(sess)
+            if getattr(sess, "proto_ver", 0) == PROTO_5:
+                ok5 = getattr(sess, "wire_v5_fast_ok", None)
+                if ok5 is None or not ok5():
+                    fast = False  # packet-size cap needs per-frame plan
+                    break
+            recips.append((sess, min(opts.qos, qos)))
         if fast:
-            n = len(sessions)
-            if n:
-                m = self.broker.metrics
-                t0 = time.monotonic()
-                if wire_frame is not None:
-                    nbytes = len(wire_frame)
-                    for sess in sessions:
-                        sess.transport.write(wire_frame)
-                else:
-                    hdr = fastpath.publish_header(
-                        topic_str, 0, False, False, None, len(payload))
-                    iov = (hdr, payload)
-                    nbytes = len(hdr) + len(payload)
-                    for sess in sessions:
-                        sess.transport.write_iov(iov)
-                m.observe("stage_wire_encode_ms",
-                          (time.monotonic() - t0) * 1e3)
-                self.fanout_fast_pubs += 1
-                m.incr("queue_message_in", n)
-                m.incr("queue_message_out", n)
-                m.incr("bytes_sent", nbytes * n)
-                m.incr("mqtt_publish_sent", n)
-                m.incr("router_matches_local", n)
-            return n
+            if recips:
+                self._wire_fanout(mountpoint, words, topic_str, payload,
+                                  wire_frame, payload_skip, recips)
+            return len(recips)
         # complex fanout: ONE Msg, the exact classic path (host
         # predicate phase included — a racing filter subscription must
         # still filter). The payload materialises HERE, lazily, when
         # the fast fanout didn't need it as separate bytes.
         if payload is None:
             payload = wire_frame[payload_skip:]
-        msg = Msg(topic=tuple(words), payload=payload, qos=0,
+        msg = Msg(topic=tuple(words), payload=payload, qos=qos,
                   mountpoint=mountpoint)
         return self.route_rows(msg, self._filter_rows_host(msg, rows),
                                from_sid)
+
+    def _wire_fanout(self, mountpoint: str, words: Tuple[str, ...],
+                     topic_str: str, payload: Optional[bytes],
+                     wire_frame: Optional[bytes], payload_skip: int,
+                     recips: List[Tuple[Any, int]]) -> None:
+        """The object-free fast fanout write. Recipients group by
+        (effective qos, protocol):
+
+        - v4 effective-QoS0 recipients share ONE frame — the verbatim
+          inbound span when the publisher gave us one, else one
+          encoded header + payload iovec;
+        - every pid- or alias-bearing group (QoS≥1 and/or v5) encodes
+          ALL its per-recipient headers in ONE
+          ``fastpath.publish_headers_batch`` call and writes
+          memoryview slices of the arena, the shared payload riding
+          each iovec uncopied;
+        - QoS≥1 recipients register the (lazily built, shared) Msg in
+          their in-flight window first (``wire_take_qos``); a full
+          window parks the Msg in pending exactly like the classic
+          deliver path — no wire write now, the ack-driven pump owns
+          it."""
+        m = self.broker.metrics
+        t0 = time.monotonic()
+        nbytes = 0
+        sent = 0
+        parked = 0
+        v4_plain: List[Any] = []
+        groups: Dict[Tuple[int, bool], List[Tuple[Any, Optional[int],
+                                                  Optional[int]]]] = {}
+        msg_by_eff: Dict[int, Msg] = {}
+        for sess, eff in recips:
+            is5 = getattr(sess, "proto_ver", 0) == PROTO_5
+            if eff == 0:
+                if not is5:
+                    v4_plain.append(sess)
+                else:
+                    alias = sess.wire_alias_for(words)
+                    groups.setdefault((0, True), []).append(
+                        (sess, None, alias))
+                continue
+            msg = msg_by_eff.get(eff)
+            if msg is None:
+                if payload is None:
+                    payload = wire_frame[payload_skip:]
+                msg = Msg(topic=tuple(words), payload=payload, qos=eff,
+                          mountpoint=mountpoint)
+                msg_by_eff[eff] = msg
+            pid = sess.wire_take_qos(msg)
+            if not pid:
+                if pid == 0:
+                    parked += 1  # window full: pending pump owns it
+                continue  # None: dropped (counted by wire_take_qos)
+            if is5:
+                alias = sess.wire_alias_for(words)
+                groups.setdefault((eff, True), []).append(
+                    (sess, pid, alias))
+            else:
+                groups.setdefault((eff, False), []).append(
+                    (sess, pid, None))
+        if v4_plain:
+            if wire_frame is not None:
+                fb = len(wire_frame)
+                for sess in v4_plain:
+                    sess.transport.write(wire_frame)
+            else:
+                hdr = fastpath.publish_header(
+                    topic_str, 0, False, False, None, len(payload))
+                iov = (hdr, payload)
+                fb = len(hdr) + len(payload)
+                for sess in v4_plain:
+                    sess.transport.write_iov(iov)
+            nbytes += fb * len(v4_plain)
+            sent += len(v4_plain)
+        if groups and payload is None:
+            payload = wire_frame[payload_skip:]
+        for (eff, is5), members in groups.items():
+            pids = [p for _s, p, _a in members]
+            aliases = [a for _s, _p, a in members] if is5 else None
+            arena, offs = fastpath.publish_headers_batch(
+                topic_str, eff, False, False, pids, len(payload),
+                is5, aliases)
+            fastpath.fanout_batches += 1
+            plen = len(payload)
+            for i, iov in enumerate(wire_batch_iovs(arena, offs,
+                                                    payload)):
+                members[i][0].transport.write_iov(iov)
+                nbytes += (offs[i + 1] - offs[i]) + plen
+            sent += len(members)
+        if sent or parked:
+            m.observe("stage_wire_encode_ms",
+                      (time.monotonic() - t0) * 1e3)
+            self.fanout_fast_pubs += 1
+            m.incr("queue_message_in", sent + parked)
+            m.incr("queue_message_out", sent)
+            if nbytes:
+                m.incr("bytes_sent", nbytes)
+            m.incr("mqtt_publish_sent", sent)
+            m.incr("router_matches_local", len(recips))
 
     def _pre_publish(self, msg: Msg) -> Msg:
         cfg = self.broker.config

@@ -116,6 +116,7 @@ FLAT_ALIASES.update({
     "store.checkpoint_every_bytes": "store_checkpoint_every_bytes",
     "store.compact_interval_ms": "store_compact_interval_ms",
     "store.compact_budget_bytes": "store_compact_budget_bytes",
+    "store.expire_sweep_budget": "store_expire_sweep_budget",
     "store.fsync": "msg_store_fsync",
     "store.group_commit": "msg_store_group_commit",
     "resume.batched": "resume_batched",

@@ -227,11 +227,14 @@ async def mqtt_connection(
         # The wire plane (protocol/fastpath.py): each buffered chunk is
         # batch-parsed into a packed frame table in ONE call (native
         # codec when built, bit-identical pure-Python twin otherwise).
-        # Admitted QoS0 PUBLISHes flow from the table straight into the
-        # routing fanout without materialising frame/Msg objects
-        # (session.wire_publish_qos0); every other record — acks,
-        # QoS>=1, protocol edges, malformed input — materialises its
-        # frame object and takes the classic handler unchanged.
+        # Admitted PUBLISHes — QoS0 AND QoS1/2 — flow from the table
+        # straight into the routing fanout without materialising
+        # frame/Msg objects (session.wire_publish_qos0/_qos), and the
+        # 2-byte ack family resolves its pid against the in-flight
+        # bookkeeping the same way (session.wire_ack); every other
+        # record — reason-code acks, retained/dup publishes, protocol
+        # edges, malformed input — materialises its frame object and
+        # takes the classic handler unchanged.
         buf = bytes(rest)
         frames_run = 0
         v5 = codec is codec_v5
@@ -246,14 +249,32 @@ async def mqtt_connection(
                                 (time.monotonic() - t0) * 1e3)
                 fast_gate = nrec > 0 and session.wire_fast_ready()
                 fast_pubs = 0
+                fast_qpubs = 0
                 try:
                     for off in range(0, nrec * rec_size, rec_size):
                         rec = unpack_rec(table, off)
-                        if (fast_gate and rec[0] == fastpath.K_PUB0
-                                and rec[1] == 0x30
-                                and session.wire_publish_qos0(buf, rec)):
-                            fast_pubs += 1
-                        else:
+                        handled = False
+                        if fast_gate:
+                            kind = rec[0]
+                            if kind == fastpath.K_PUB0 \
+                                    and rec[1] == 0x30:
+                                if session.wire_publish_qos0(buf, rec):
+                                    fast_pubs += 1
+                                    handled = True
+                            elif kind == fastpath.K_PUB \
+                                    and rec[1] in (0x32, 0x34):
+                                # QoS1/2, no retain, no dup: the dup
+                                # retransmit and retained forms keep
+                                # the classic path (dedup/store edges)
+                                if session.wire_publish_qos(buf, rec):
+                                    fast_qpubs += 1
+                                    handled = True
+                            elif kind == fastpath.K_ACK:
+                                # always resolves (invalid pids count
+                                # *_invalid_error exactly like classic)
+                                session.wire_ack(rec)
+                                handled = True
+                        if not handled:
                             try:
                                 frame = fastpath.materialize(
                                     codec, buf, rec, max_frame_size)
@@ -304,8 +325,8 @@ async def mqtt_connection(
                     # a mid-batch error (malformed frame after admitted
                     # publishes) must not lose the bookkeeping for
                     # fast-path messages already routed and delivered
-                    if fast_pubs:
-                        session.wire_fast_done(fast_pubs)
+                    if fast_pubs or fast_qpubs:
+                        session.wire_fast_done(fast_pubs, fast_qpubs)
                 if session.closed:
                     break
                 buf = buf[consumed:] if consumed else buf

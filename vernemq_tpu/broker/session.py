@@ -26,15 +26,20 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+from collections import OrderedDict
 from typing import TYPE_CHECKING, Any, Dict, List, Optional, Tuple
 
 log = logging.getLogger("vernemq_tpu.session")
 
 from ..filters.predicate import FilterError, parse_filter, split_filter_suffix
-from ..protocol import codec_v4, codec_v5
+from ..protocol import codec_v4, codec_v5, fastpath
 from ..protocol import topic as T
 from ..protocol.types import (
     PROTO_5,
+    PUBACK as PUBACK_T,
+    PUBCOMP as PUBCOMP_T,
+    PUBREC as PUBREC_T,
+    PUBREL as PUBREL_T,
     RC_GRANTED_QOS0,
     RC_NOT_AUTHORIZED,
     RC_SERVER_UNAVAILABLE,
@@ -119,8 +124,15 @@ class Session:
         self.close_reason = "normal"
         # v5 state
         self.session_expiry = 0
-        self.topic_alias_in: Dict[int, Tuple[str, ...]] = {}
-        self.topic_alias_out: Dict[Tuple[str, ...], int] = {}
+        # inbound alias -> (words, topic_str): the wire fast path needs
+        # the validated string without re-unwording per publish
+        self.topic_alias_in: Dict[int, Tuple[Tuple[str, ...], str]] = {}
+        # outbound words -> alias, LRU-ordered (oldest first): a full
+        # table evicts the least-recently-SENT topic and re-establishes
+        # its alias number for the new hot topic (MQTT5 3.3.2.3.4 lets
+        # the sender remap an alias mid-connection)
+        self.topic_alias_out: "OrderedDict[Tuple[str, ...], int]" = \
+            OrderedDict()
         self.topic_alias_max_out = 0  # client's limit for broker→client aliases
         self.receive_max_out = 65535  # client's receive maximum (broker→client inflight cap)
         self.max_packet_out = 0  # client's maximum_packet_size; 0 = unlimited
@@ -583,12 +595,13 @@ class Session:
                     except T.TopicError:
                         await self._pub_nack(f, RC_TOPIC_NAME_INVALID)
                         return
-                    self.topic_alias_in[alias] = words
+                    self.topic_alias_in[alias] = (words, topic_str)
                 else:
-                    words = self.topic_alias_in.get(alias)
-                    if words is None:
+                    ent = self.topic_alias_in.get(alias)
+                    if ent is None:
                         await self._disconnect_v5(RC_TOPIC_ALIAS_INVALID)
                         return
+                    words = ent[0]
         if words is None:
             try:
                 words = tuple(T.validate_topic("publish", topic_str))
@@ -676,8 +689,9 @@ class Session:
     # ------------------------------------------------- wire fast path
 
     def wire_fast_ready(self) -> bool:
-        """Batch-level gate for the QoS0 wire fast path: True only when
-        NO per-publish Python edge applies — no tracer, no per-publish
+        """Batch-level gate for the wire fast path (QoS0 AND QoS1/2
+        publishes, plus the 2-byte ack family): True only when NO
+        per-publish Python edge applies — no tracer, no per-publish
         auth/deliver hooks, no rate limit, governor idle, cluster
         ready, no payload predicates on this mountpoint. Checked once
         per parsed batch (and re-checked after cooperative yields);
@@ -709,15 +723,10 @@ class Session:
             return False
         return True
 
-    def wire_publish_qos0(self, buf, rec) -> bool:
-        """Admit one QoS0 PUBLISH straight from the frame table:
-        topic resolved through the per-connection cache, payload sliced
-        once, fanout written as shared wire bytes — no Publish frame,
-        no Msg, no property dict on this path. Returns False when the
-        frame needs classic handling (uncached-invalid topic, codec
-        edge); the caller materialises it then."""
-        _k, b0, _pid, f_off, f_end, t_off, t_len, p_off = rec
-        b = self.broker
+    def _wire_cache_topic(self, buf, t_off: int, t_len: int):
+        """Resolve ``(words, topic_str)`` through the per-connection
+        topic cache, or None when the topic is invalid (the classic
+        path raises the canonical error)."""
         cache = self._wire_topic_cache
         key = bytes(buf[t_off:t_off + t_len])
         ent = cache.get(key)
@@ -725,13 +734,13 @@ class Session:
             try:
                 topic_str = key.decode("utf-8")
             except UnicodeDecodeError:
-                return False  # codec raises the canonical invalid_utf8
+                return None  # codec raises the canonical invalid_utf8
             if "\x00" in topic_str:
-                return False  # canonical no_null_allowed
+                return None  # canonical no_null_allowed
             try:
                 words = tuple(T.validate_topic("publish", topic_str))
             except T.TopicError:
-                return False  # classic close("invalid_topic")
+                return None  # classic close("invalid_topic")
             ent = (words, topic_str)
             # bounded by entries AND entry size: topics run up to 64KB
             # and each entry holds ~3 copies — a publisher minting
@@ -742,6 +751,47 @@ class Session:
                 if len(cache) >= 512:
                     cache.clear()
                 cache[key] = ent
+        return ent
+
+    def _wire_topic(self, buf, rec):
+        """``(words, topic_str)`` for a frame-table publish record —
+        the topic cache plus, for v5, the inbound topic-alias table
+        (the frame table classifies an alias-ONLY property block as
+        hot and leaves the 4-byte span for us to read). None = the
+        classic path must serve: invalid topic, alias 0 / over the
+        announced cap / unknown — each raises or disconnects with the
+        canonical reason there."""
+        _k, b0, _pid, f_off, f_end, t_off, t_len, p_off = rec
+        if self.proto_ver == PROTO_5:
+            qos = (b0 >> 1) & 0x03
+            pstart = t_off + t_len + (2 if qos else 0)
+            if p_off - pstart == 4:  # topic-alias-only property block
+                alias = (buf[p_off - 2] << 8) | buf[p_off - 1]
+                cfg = self.broker.config
+                if alias == 0 or (cfg.topic_alias_max_client
+                                  and alias > cfg.topic_alias_max_client):
+                    return None  # classic: TOPIC_ALIAS_INVALID
+                if t_len == 0:
+                    return self.topic_alias_in.get(alias)
+                ent = self._wire_cache_topic(buf, t_off, t_len)
+                if ent is not None:
+                    self.topic_alias_in[alias] = ent
+                return ent
+        return self._wire_cache_topic(buf, t_off, t_len)
+
+    def wire_publish_qos0(self, buf, rec) -> bool:
+        """Admit one QoS0 PUBLISH straight from the frame table:
+        topic resolved through the per-connection cache (and, v5, the
+        inbound alias table), payload sliced once, fanout written as
+        shared wire bytes — no Publish frame, no Msg, no property dict
+        on this path. Returns False when the frame needs classic
+        handling (uncached-invalid topic, alias error, codec edge);
+        the caller materialises it then."""
+        _k, b0, _pid, f_off, f_end, t_off, t_len, p_off = rec
+        b = self.broker
+        ent = self._wire_topic(buf, rec)
+        if ent is None:
+            return False
         words, topic_str = ent
         trace = b.recorder.admit(self.client_id, topic_str, 0)
         if trace is not None:
@@ -777,19 +827,195 @@ class Session:
             return True
         return True
 
-    def wire_fast_done(self, n: int) -> None:
-        """Batch-level bookkeeping for ``n`` fast-admitted publishes
-        (classic path does these per frame)."""
+    def wire_publish_qos(self, buf, rec) -> bool:
+        """Admit one QoS1/2 PUBLISH straight from the frame table: the
+        pid is stamped into the store/ack state machine from the span
+        and the PUBACK/PUBREC reply is sent without materialising a
+        Publish or Msg on the inbound side (the fanout builds ONE Msg
+        lazily only for QoS≥1 recipients that must track it in
+        waiting_acks). Returns False when the frame needs the exact
+        classic path: receive-max exceeded, invalid topic/alias — each
+        raises or disconnects with the canonical reason there."""
+        _k, b0, pid, f_off, f_end, t_off, t_len, p_off = rec
+        qos = (b0 >> 1) & 0x03
+        b = self.broker
+        # QoS≥1 acks need the synchronous match count for the reason
+        # code; the batched (collector) view routes asynchronously, so
+        # the classic await path serves it
+        if b.registry.batched_view_active():
+            return False
+        # v5 incoming flow control: at the announced receive maximum
+        # the next QoS>0 publish is a protocol error — the classic
+        # path serves the RECEIVE_MAX_EXCEEDED disconnect canonically
+        if (self.proto_ver == PROTO_5 and self._recv_max_announced
+                and len(self.awaiting_rel) >= self._recv_max_announced
+                and not (qos == 2 and pid in self.awaiting_rel)):
+            return False
+        ent = self._wire_topic(buf, rec)
+        if ent is None:
+            return False
+        words, topic_str = ent
+        trace = b.recorder.admit(self.client_id, topic_str, qos)
+        if trace is not None:
+            trace.stamp("admit")
+        if qos == 2 and pid in self.awaiting_rel:
+            # duplicate arrival of an unreleased pid: dedup (no
+            # re-route), refresh the PUBREC (classic parity)
+            self.send(Pubrec(packet_id=pid))
+            b.metrics.incr("mqtt_pubrec_sent")
+            return True
+        payload = bytes(buf[p_off:f_end])
+        if qos == 2:
+            self.awaiting_rel[pid] = time.monotonic()
+        try:
+            matches = b.registry.publish_wire(
+                self.mountpoint, words, topic_str, payload, self.sid,
+                qos, trace=trace)
+        except RuntimeError as e:
+            b.metrics.incr("mqtt_publish_error")
+            if e.args != ("not_ready",):
+                log.exception("wire publish routing failed for %s",
+                              self.sid)
+            # withhold the ack so the client's DUP retry re-routes;
+            # the QoS2 receive credit must not leak meanwhile
+            if qos == 2:
+                self.awaiting_rel.pop(pid, None)
+            return True
+        except Exception:
+            b.metrics.incr("mqtt_publish_error")
+            log.exception("wire publish routing failed for %s", self.sid)
+            if qos == 2:
+                self.awaiting_rel.pop(pid, None)
+            return True
+        if qos == 1:
+            ack = Puback(packet_id=pid)
+            if self.proto_ver == PROTO_5 and not matches:
+                ack.reason_code = RC_NO_MATCHING_SUBSCRIBERS
+            self.send(ack)
+            b.metrics.incr("mqtt_puback_sent")
+        else:
+            self.send(Pubrec(packet_id=pid))
+            b.metrics.incr("mqtt_pubrec_sent")
+        return True
+
+    def wire_ack(self, rec) -> None:
+        """Resolve one 2-byte ack-family frame straight from the frame
+        table: the pid checks against the waiting_acks / awaiting_rel
+        bookkeeping with no frame object. The table only classifies
+        the no-property rc=0 shape as K_ACK, so the v5 reason-code
+        forms stay on the classic codec path."""
+        ptype = rec[1] >> 4
+        pid = rec[2]
+        m = self.broker.metrics
+        self.last_activity = time.monotonic()
+        if ptype == PUBACK_T:
+            m.incr("mqtt_puback_received")
+            entry = self.waiting_acks.get(pid)
+            if entry and entry[0] == "puback":
+                del self.waiting_acks[pid]
+                self._pump_pending()
+            else:  # ack for nothing we sent (vmq_metrics *_invalid_error)
+                m.incr("mqtt_puback_invalid_error")
+        elif ptype == PUBREC_T:
+            m.incr("mqtt_pubrec_received")
+            entry = self.waiting_acks.get(pid)
+            if entry and entry[0] == "pubrec":
+                entry[0] = "pubcomp"
+                entry[2] = time.monotonic()
+                self.send(Pubrel(packet_id=pid))
+                m.incr("mqtt_pubrel_sent")
+            elif not (entry and entry[0] == "pubcomp"):
+                # a DUP PUBREC while we await PUBCOMP is legal
+                # retransmission; anything else is unexpected
+                m.incr("mqtt_pubrec_invalid_error")
+        elif ptype == PUBREL_T:
+            m.incr("mqtt_pubrel_received")
+            existed = self.awaiting_rel.pop(pid, None)
+            comp = Pubcomp(packet_id=pid)
+            if existed is None and self.proto_ver == PROTO_5:
+                comp.reason_code = RC_PACKET_ID_NOT_FOUND
+            self.send(comp)
+            m.incr("mqtt_pubcomp_sent")
+        else:  # PUBCOMP
+            m.incr("mqtt_pubcomp_received")
+            entry = self.waiting_acks.get(pid)
+            if entry and entry[0] == "pubcomp":
+                del self.waiting_acks[pid]
+                self._pump_pending()
+            else:
+                m.incr("mqtt_pubcomp_invalid_error")
+        fastpath.fastpath_acks += 1
+
+    def wire_take_qos(self, msg: Msg) -> Optional[int]:
+        """Register a wire-plane QoS≥1 delivery in the in-flight
+        window: allocate the packet id and the waiting_acks entry (the
+        bookkeeping half of the classic deliver path) WITHOUT encoding
+        the frame — the registry batch-encodes all recipients' headers
+        in one native call. 0 = window full, message parked — session
+        pending first, then the queue-level backlog via the same
+        ``_backpressure`` tier the classic refusal takes (the
+        ack-driven pump and ``notify_ready`` replay deliver it
+        classically later); None = no park tier available, dropped.
+        Neither takes a wire write now."""
+        window = min(self.broker.config.max_inflight_messages,
+                     self.receive_max_out)
+        if len(self.waiting_acks) >= window:
+            if len(self.pending) >= \
+                    self.broker.config.max_online_messages:
+                if self.queue is not None:
+                    self.queue._backpressure(msg)
+                    return 0
+                self.broker.metrics.incr("queue_message_drop")
+                return None
+            self.pending.append(msg)
+            return 0
+        pid = self._next_packet_id()
+        self.waiting_acks[pid] = ["puback" if msg.qos == 1 else "pubrec",
+                                  msg, time.monotonic(), False]
+        return pid
+
+    def wire_v5_fast_ok(self) -> bool:
+        """May this v5 session take wire-plane fast delivery? A client
+        maximum_packet_size forces per-frame measurement
+        (_plan_v5_delivery) and keeps the exact classic path."""
+        return not self.max_packet_out
+
+    def wire_alias_for(self, words: Tuple[str, ...]) -> int:
+        """Outbound topic-alias decision for one wire-plane delivery,
+        against the same per-connection LRU table the classic
+        _build_v5_publish drives. Returns the signed alias convention
+        of ``fastpath.publish_headers_batch``: 0 = no aliasing (full
+        topic), +a = established (alias-only header), -a = newly
+        established here (header carries BOTH topic and alias). A full
+        table evicts the least-recently-sent topic and re-establishes
+        its alias number (MQTT5 3.3.2.3.4 permits remapping)."""
+        amax = self.topic_alias_max_out
+        if not amax:
+            return 0
+        tbl = self.topic_alias_out
+        alias = tbl.get(words)
+        if alias is not None:
+            tbl.move_to_end(words)
+            return alias
+        if len(tbl) < amax:
+            alias = len(tbl) + 1
+        else:
+            _lru, alias = tbl.popitem(last=False)
+        tbl[words] = alias
+        return -alias
+
+    def wire_fast_done(self, n: int, nq: int = 0) -> None:
+        """Batch-level bookkeeping for ``n`` fast-admitted QoS0 and
+        ``nq`` QoS1/2 publishes (classic path does these per frame)."""
         self.last_activity = time.monotonic()
         b = self.broker
-        b.metrics.incr("mqtt_publish_received", n)
+        b.metrics.incr("mqtt_publish_received", n + nq)
         if b.overload is not None:
             # the heaviest-talker signal keeps integrating even though
             # the fast path never parks (it only runs at level 0)
-            b.overload.record_publish_n(self.sid, n)
-        from ..protocol import fastpath
-
+            b.overload.record_publish_n(self.sid, n + nq)
         fastpath.fastpath_pubs += n
+        fastpath.fastpath_pubs_qos += nq
 
     async def _route(self, msg: Msg, nowait: bool = False,
                      trace=None) -> int:
@@ -906,13 +1132,27 @@ class Session:
         if self.topic_alias_max_out:
             alias = self.topic_alias_out.get(msg.topic)
             if alias is not None:
+                if commit:
+                    self.topic_alias_out.move_to_end(msg.topic)
                 topic_str = ""
                 props["topic_alias"] = alias
-            elif allow_alias \
-                    and len(self.topic_alias_out) < self.topic_alias_max_out:
-                alias = len(self.topic_alias_out) + 1
-                if commit:
-                    self.topic_alias_out[msg.topic] = alias
+            elif allow_alias:
+                # LRU allocation: a free slot takes the next number; a
+                # full table evicts the least-recently-SENT topic and
+                # re-establishes its alias number for this one (MQTT5
+                # 3.3.2.3.4 permits remapping mid-connection), so hot
+                # topics keep alias-only frames under churn
+                if len(self.topic_alias_out) < self.topic_alias_max_out:
+                    alias = len(self.topic_alias_out) + 1
+                    if commit:
+                        self.topic_alias_out[msg.topic] = alias
+                else:
+                    if commit:
+                        _lru, alias = self.topic_alias_out.popitem(
+                            last=False)
+                        self.topic_alias_out[msg.topic] = alias
+                    else:  # simulate without mutating (peek the LRU)
+                        alias = next(iter(self.topic_alias_out.values()))
                 # the alias-establishing frame carries BOTH the full
                 # topic and the alias property
                 props["topic_alias"] = alias

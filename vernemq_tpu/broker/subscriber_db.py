@@ -105,6 +105,16 @@ class SubscriberDB:
         for key, term in self.metadata.fold(PREFIX):
             yield (key[0], key[1]), SubscriberRecord.from_term(term)
 
+    def fold_raw(self) -> Iterable[Tuple[SubscriberId, Dict[str, Any]]]:
+        """Stream the raw stored terms WITHOUT materialising
+        SubscriberRecord/SubOpts objects: the boot warm-load walks
+        every stored subscriber and builds its routing rows straight
+        from the terms (interning shared opts shapes), so a huge
+        restart doesn't allocate a record object graph per parked
+        session just to throw it away."""
+        for key, term in self.metadata.fold(PREFIX):
+            yield (key[0], key[1]), term
+
     def subscribe_db_events(
         self, fn: Callable[[SubscriberId, Optional[SubscriberRecord],
                             Optional[SubscriberRecord], str], None]) -> None:

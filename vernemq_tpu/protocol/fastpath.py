@@ -47,7 +47,7 @@ from .types import (PINGREQ, PINGRESP, PUBACK, PUBCOMP, PUBLISH, PUBREC,
 log = logging.getLogger("vernemq_tpu.wire")
 
 #: bump together with FASTPATH_VERSION in native/codec.cc
-REQUIRED_VERSION = 3
+REQUIRED_VERSION = 4
 
 ACK_CTORS = {PUBACK: Puback, PUBREC: Pubrec, PUBREL: Pubrel,
              PUBCOMP: Pubcomp}
@@ -98,6 +98,9 @@ pure_batches = 0        #: batches parsed by the pure-Python twin
 native_errors = 0       #: native calls that failed (fed the breaker)
 degraded_batches = 0    #: batches served pure while the breaker was open
 fastpath_pubs = 0       #: QoS0 publishes admitted object-free
+fastpath_pubs_qos = 0   #: QoS1/2 publishes admitted object-free
+fastpath_acks = 0       #: ack frames resolved object-free
+fanout_batches = 0      #: batched fanout header encodes (one per fanout)
 
 
 def load_native():
@@ -147,6 +150,9 @@ def stats():
         "wire_native_errors": float(native_errors),
         "wire_degraded_batches": float(degraded_batches),
         "wire_fastpath_pubs": float(fastpath_pubs),
+        "wire_fastpath_pubs_qos": float(fastpath_pubs_qos),
+        "wire_fastpath_acks": float(fastpath_acks),
+        "wire_fanout_batches": float(fanout_batches),
         "wire_breaker_state": float(breaker.state),
     }
 
@@ -253,9 +259,20 @@ def _parse_batch_py(data, max_size: int = 0,
                         break
                     tpos += 2
                 if v5:
-                    if tpos >= body_len or d[body_off + tpos] != 0:
+                    # hot v5 shapes: empty property block, or ONLY a
+                    # topic-alias property (0x03 0x23 hi lo) — the
+                    # consumer re-reads the alias from the 4-byte span
+                    # between pid and payload_off
+                    if tpos >= body_len:
                         break
-                    tpos += 1
+                    pb = d[body_off + tpos]
+                    if pb == 0:
+                        tpos += 1
+                    elif (pb == 3 and tpos + 4 <= body_len
+                          and d[body_off + tpos + 1] == 0x23):
+                        tpos += 4
+                    else:
+                        break
                 kind = K_PUB0 if qos == 0 else K_PUB
                 topic_off = body_off + 2
                 topic_len = tlen
@@ -292,6 +309,12 @@ def materialize(codec, buf, rec, max_size: int = 0) -> Frame:
     unparseable-head record raises frame_too_large, not need-more)."""
     kind, b0, pid, f_off, f_end, t_off, t_len, p_off = rec
     if kind in (K_PUB0, K_PUB):
+        # a 4-byte v5 property span is the topic-alias-only hot shape:
+        # the codec owns it so the alias lands in frame.properties
+        # canonically (the empty block is 1 byte; v4 is 0)
+        if p_off - (t_off + t_len + (2 if kind == K_PUB else 0)) == 4:
+            frame, _rest = codec.parse(bytes(buf[f_off:f_end]), max_size)
+            return frame
         try:
             topic = bytes(buf[t_off:t_off + t_len]).decode("utf-8")
         except UnicodeDecodeError:
@@ -380,6 +403,102 @@ def _publish_header_py(topic: str, qos: int, retain: bool, dup: bool,
     if v5:
         out += b"\x00"
     return out
+
+
+def publish_headers_batch(topic: str, qos: int, retain: bool, dup: bool,
+                          pids, payload_len: int, v5: bool = False,
+                          aliases=None) -> Tuple[bytes, tuple]:
+    """One call emits N per-recipient PUBLISH headers into a single
+    arena: ``(arena, offsets)`` with N+1 offsets so header *i* is
+    ``arena[offsets[i]:offsets[i+1]]``. The caller slices with a
+    memoryview and pairs each header with the SHARED payload bytes in
+    an iovec — one native call replaces the per-recipient Python
+    encode loop of a QoS≥1 fanout.
+
+    ``pids[i]`` is recipient *i*'s packet id (None = no pid; refused
+    for qos>0). ``aliases[i]`` (v5 only): 0 = full topic + empty
+    property block; +a = alias-only header (empty topic + topic-alias
+    property); -a = alias-establishing header (topic AND alias).
+
+    Same dispatch contract as :func:`publish_header`: native behind
+    the wire breaker with the ``wire.encode`` fault point; ValueError
+    refusals are healthy native verdicts (re-raised after feeding the
+    breaker a success); real failures degrade to the bit-identical
+    pure twin."""
+    C = None if _force_pure else load_native()
+    if C is not None and breaker.allow():
+        try:
+            faults.inject("wire.encode", max_delay_s=1.0)
+            out = C.encode_publish_headers_batch(
+                topic, qos, 1 if retain else 0, 1 if dup else 0,
+                pids, payload_len, v5, aliases)
+            breaker.record_success()
+            return out
+        except ValueError:
+            breaker.record_success()
+            raise
+        except Exception:
+            global native_errors
+            native_errors += 1
+            if breaker.record_failure():
+                events.emit("wire_fallback", detail="encode")
+                log.error("native wire batch encode failed; breaker "
+                          "open — serving the pure-Python codec",
+                          exc_info=True)
+    return _publish_headers_batch_py(topic, qos, retain, dup, pids,
+                                     payload_len, v5, aliases)
+
+
+def _publish_headers_batch_py(topic: str, qos: int, retain: bool,
+                              dup: bool, pids, payload_len: int,
+                              v5: bool = False,
+                              aliases=None) -> Tuple[bytes, tuple]:
+    """Pure twin of the native batch encoder — byte-identical arena
+    and offsets, same ValueError spellings in the same order."""
+    tb = topic.encode("utf-8")
+    if len(tb) > 65535:
+        raise ValueError("topic too long")
+    if aliases is not None:
+        if not v5:
+            raise ValueError("aliases require v5")
+        if len(aliases) != len(pids):
+            raise ValueError("aliases length mismatch")
+    from . import wire
+
+    head = bytes([(PUBLISH << 4) | (0x08 if dup else 0)
+                  | ((qos & 3) << 1) | (0x01 if retain else 0)])
+    tb_len2 = len(tb).to_bytes(2, "big")
+    arena = bytearray()
+    offsets = [0]
+    for i, pid in enumerate(pids):
+        if pid is not None and not 1 <= pid <= 65535:
+            raise ValueError("packet_id out of range")
+        if qos > 0 and pid is None:
+            raise ValueError("missing_packet_id")
+        alias = aliases[i] if aliases is not None else 0
+        mag = -alias if alias < 0 else alias
+        if mag > 65535:
+            raise ValueError("topic_alias out of range")
+        t = b"" if (v5 and alias > 0) else tb
+        props_len = (4 if alias != 0 else 1) if v5 else 0
+        body_len = (2 + len(t) + (2 if qos > 0 else 0) + props_len
+                    + payload_len)
+        if body_len > wire.MAX_VARINT:
+            raise ValueError("frame too large")
+        arena += head
+        arena += wire.encode_varint(body_len)
+        arena += tb_len2 if t else b"\x00\x00"
+        arena += t
+        if qos > 0:
+            arena += pid.to_bytes(2, "big")
+        if v5:
+            if alias != 0:
+                arena += b"\x03\x23"
+                arena += mag.to_bytes(2, "big")
+            else:
+                arena += b"\x00"
+        offsets.append(len(arena))
+    return bytes(arena), tuple(offsets)
 
 
 # ------------------------------------------------------ per-frame parse

@@ -85,7 +85,9 @@ KNOWN_POINTS: Dict[str, str] = {
         "pure-Python codec, never drops the connection",
     "wire.encode":
         "native wire-codec fanout header encode (protocol/fastpath.py "
-        "publish_header): a fault degrades to the pure-Python encoder",
+        "publish_header and the one-call batched "
+        "publish_headers_batch): a fault degrades to the pure-Python "
+        "encoder",
 }
 
 

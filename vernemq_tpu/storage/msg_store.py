@@ -30,6 +30,7 @@ import json
 import logging
 import os
 import threading
+import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
@@ -187,8 +188,16 @@ class EngineMsgStore(MsgStore):
                 term["exp"] = max(0.0, term["exp"] - elapsed)
             return term_to_msg(term)
 
+        def _peek_deadline(b):
+            # wall-clock expiry deadline WITHOUT building a Msg: the
+            # TTL sweep classifies recovered records with this
+            term, stored_at = decode(b)
+            exp = term.get("exp")
+            return None if exp is None else stored_at + exp
+
         self._enc = _enc
         self._dec = _dec
+        self._peek_deadline = _peek_deadline
         self.engine = engine
         self._kv = engine
         # refcount + sid→ref→[seq] maps, rebuilt from the r/i families
@@ -199,7 +208,14 @@ class EngineMsgStore(MsgStore):
         self._group_commit = group_commit
         self._sync_pending = 0
         self._lock = threading.Lock()
+        # TTL sweep state: ref -> wall-clock expiry deadline (only
+        # expiring messages carry an entry); refs recovered from disk
+        # have no in-memory deadline yet and queue for budgeted
+        # classification on the maintenance tick
+        self._exp: Dict[bytes, float] = {}
+        self._exp_scan: List[bytes] = []
         self._recover()
+        self._exp_scan = list(self._refcount)
 
     @property
     def engine_kind(self) -> str:
@@ -276,6 +292,11 @@ class EngineMsgStore(MsgStore):
                 self._refcount[ref] = 0
             self._refcount[ref] += 1
             self._seqs.setdefault(sid, {}).setdefault(ref, []).append(seq)
+            if msg.expires_at is not None and ref not in self._exp:
+                # monotonic deadline → wall clock, so the sweep can
+                # compare against time.time() without a Msg decode
+                self._exp[ref] = time.time() + max(
+                    0.0, msg.expires_at - time.monotonic())
 
     def needs_commit(self) -> bool:
         return self._sync_pending > 0
@@ -374,9 +395,62 @@ class EngineMsgStore(MsgStore):
         left = self._refcount.get(ref, 0) - n
         if left <= 0:
             self._refcount.pop(ref, None)
+            self._exp.pop(ref, None)
             return [b"m\x00" + ref]
         self._refcount[ref] = left
         return []
+
+    def sweep_expired(self, budget: int = 256) -> int:
+        """Budgeted TTL sweep riding the store maintenance tick: delete
+        parked copies whose v5 message-expiry deadline has passed, so a
+        million-session store doesn't hold dead payloads until each
+        owner reconnects. Refs recovered from disk carry no in-memory
+        deadline — up to ``budget`` of them are classified per call
+        (one point-get each, no Msg built), so a huge restarted store
+        never stalls the tick. Returns the number of parked
+        per-subscriber copies removed."""
+        swept = 0
+        with self._lock:
+            now = time.time()
+            examined = 0
+            while self._exp_scan and examined < budget:
+                ref = self._exp_scan.pop()
+                examined += 1
+                if ref not in self._refcount or ref in self._exp:
+                    continue
+                data = self._kv.get(b"m\x00" + ref)
+                if data is None:
+                    continue
+                deadline = self._peek_deadline(data)
+                if deadline is not None:
+                    self._exp[ref] = deadline
+            expired = {r for r, dl in self._exp.items() if dl <= now}
+            if not expired:
+                return 0
+            # ONE pass over the sid map resolves every expired ref's
+            # owners (there is no ref→sid reverse index to maintain)
+            keys: List[bytes] = []
+            for sid in list(self._seqs):
+                table = self._seqs[sid]
+                hit = expired.intersection(table)
+                if not hit:
+                    continue
+                sk = self._sid_key(sid)
+                for ref in hit:
+                    seqs = table.pop(ref)
+                    for seq in seqs:
+                        keys.append(b"i\x00" + sk
+                                    + seq.to_bytes(8, "big"))
+                    keys.append(b"r\x00" + sk + ref)
+                    keys.extend(self._deref_keys(ref, len(seqs)))
+                    swept += len(seqs)
+                if not table:
+                    del self._seqs[sid]
+            for ref in expired:
+                self._exp.pop(ref, None)
+            if keys:
+                self._kv.delete_many(keys)
+        return swept
 
     def stats(self) -> Dict[str, int]:
         out = {"stored_messages": len(self._refcount),
@@ -559,9 +633,17 @@ class BucketedMsgStore(MsgStore):
     rem NR_OF_BUCKETS``, default 12 instances) so concurrent writers hit
     different engines/locks instead of serializing on one WAL mutex.
 
-    Per-subscriber reads fan out to every instance and merge on the shared
-    enqueue-seq (the reference's cross-bucket ordset union in
-    ``msg_store_find``, ``vmq_lvldb_store.erl:84-107``).
+    Per-subscriber reads merge on the shared enqueue-seq (the
+    reference's cross-bucket ordset union in ``msg_store_find``,
+    ``vmq_lvldb_store.erl:84-107``) — but probe ONLY the buckets a
+    sid→bucket membership index (exact set, rebuilt from each
+    instance's recovery map at open) names: a reconnect-storm read for
+    a session whose backlog landed in one bucket touches one engine,
+    not all twelve. ``probe_hits``/``probe_misses`` count probed
+    buckets that held messages vs stale memberships (cleaned on
+    miss); the broker drains them into the
+    ``store_bucket_probe_hits/misses`` counters on the maintenance
+    tick.
     """
 
     supports_batched_read = True
@@ -597,42 +679,97 @@ class BucketedMsgStore(MsgStore):
             for inst in self.instances:  # no half-open engines left locked
                 inst.close()
             raise
+        # sid → {bucket index}: membership rebuilt from each engine's
+        # recovery map, maintained on write/delete. Reads probe only
+        # member buckets; a stale member (emptied behind our back by
+        # the TTL sweep) is a counted probe miss and is cleaned.
+        self._index_lock = threading.Lock()
+        self._sid_buckets: Dict[SubscriberId, set] = {}
+        for i, inst in enumerate(self.instances):
+            for sid in inst._seqs:
+                self._sid_buckets.setdefault(sid, set()).add(i)
+        self.probe_hits = 0
+        self.probe_misses = 0
 
     @property
     def engine_kind(self) -> str:
         return self.instances[0].engine_kind
 
+    def _bucket_idx(self, ref: bytes) -> int:
+        return zlib.crc32(ref) % len(self.instances)
+
     def _bucket(self, ref: bytes) -> NativeMsgStore:
-        return self.instances[zlib.crc32(ref) % len(self.instances)]
+        return self.instances[self._bucket_idx(ref)]
+
+    def _probe(self, sid: SubscriberId, decoded=None
+               ) -> List[Tuple[int, Msg]]:
+        """Merged (seq, msg) rows for ``sid`` from its member buckets
+        only; counts hits/misses and drops memberships proven stale
+        (the instance's recovery map no longer knows the sid)."""
+        with self._index_lock:
+            members = sorted(self._sid_buckets.get(sid, ()))
+        merged: List[Tuple[int, Msg]] = []
+        hits = misses = 0
+        for i in members:
+            inst = self.instances[i]
+            rows = inst.read_all_seq(sid, decoded)
+            if rows:
+                merged.extend(rows)
+                hits += 1
+                continue
+            misses += 1
+            with self._index_lock:
+                # re-check under the lock: a concurrent write adds the
+                # membership only AFTER its instance write landed, so
+                # an absent sid here is genuinely stale
+                if sid not in inst._seqs:
+                    s = self._sid_buckets.get(sid)
+                    if s is not None:
+                        s.discard(i)
+                        if not s:
+                            self._sid_buckets.pop(sid, None)
+        if hits or misses:
+            with self._index_lock:
+                self.probe_hits += hits
+                self.probe_misses += misses
+        merged.sort(key=lambda p: p[0])
+        return merged
 
     def write(self, sid: SubscriberId, msg: Msg) -> None:
-        self._bucket(msg.msg_ref).write(sid, msg)
+        i = self._bucket_idx(msg.msg_ref)
+        self.instances[i].write(sid, msg)
+        with self._index_lock:
+            self._sid_buckets.setdefault(sid, set()).add(i)
 
     def read_all(self, sid: SubscriberId) -> List[Msg]:
-        merged: List[Tuple[int, Msg]] = []
-        for inst in self.instances:
-            merged.extend(inst.read_all_seq(sid))
-        merged.sort(key=lambda p: p[0])
-        return [m for _, m in merged]
+        return [m for _, m in self._probe(sid)]
 
     def read_many(self, sids: List[SubscriberId]
                   ) -> Dict[SubscriberId, List[Msg]]:
         decoded: Dict[bytes, Msg] = {}
-        out: Dict[SubscriberId, List[Msg]] = {}
-        for sid in sids:
-            merged: List[Tuple[int, Msg]] = []
-            for inst in self.instances:
-                merged.extend(inst.read_all_seq(sid, decoded))
-            merged.sort(key=lambda p: p[0])
-            out[sid] = [m for _, m in merged]
-        return out
+        return {sid: [m for _, m in self._probe(sid, decoded)]
+                for sid in sids}
 
     def delete(self, sid: SubscriberId, msg_ref: bytes) -> None:
-        self._bucket(msg_ref).delete(sid, msg_ref)
+        i = self._bucket_idx(msg_ref)
+        inst = self.instances[i]
+        inst.delete(sid, msg_ref)
+        if sid not in inst._seqs:
+            with self._index_lock:
+                s = self._sid_buckets.get(sid)
+                if s is not None:
+                    s.discard(i)
+                    if not s:
+                        self._sid_buckets.pop(sid, None)
 
     def delete_all(self, sid: SubscriberId) -> None:
-        for inst in self.instances:
-            inst.delete_all(sid)
+        with self._index_lock:
+            members = sorted(self._sid_buckets.pop(sid, ()))
+        for i in members:
+            self.instances[i].delete_all(sid)
+
+    def sweep_expired(self, budget: int = 256) -> int:
+        return sum(inst.sweep_expired(budget) for inst in self.instances)
 
     def needs_commit(self) -> bool:
         return any(inst.needs_commit() for inst in self.instances)
@@ -646,6 +783,9 @@ class BucketedMsgStore(MsgStore):
             for k, v in inst.stats().items():
                 agg[k] = agg.get(k, 0) + v
         agg["instances"] = len(self.instances)
+        agg["bucket_index_sids"] = len(self._sid_buckets)
+        agg["bucket_probe_hits"] = self.probe_hits
+        agg["bucket_probe_misses"] = self.probe_misses
         return agg
 
     def sync(self) -> None:
