@@ -2455,6 +2455,176 @@ def config14_reconnect_storm(smoke, sessions=None, backlog=10,
     return asyncio.run(run())
 
 
+def config15_elastic_storm(smoke, seed=31):
+    """Robustness config: drain a node mid-QoS1-storm (ISSUE 18).
+
+    Two clustered brokers; a fleet of persistent QoS1 subscriber
+    sessions homed on node A goes offline with publish load still
+    arriving. Mid-storm, `vmq-admin cluster drain-node` (library form:
+    ``handoff.drain_node``) evacuates every queue to node B through
+    the freeze->drain->fence->adopt FSM while publishing CONTINUES.
+    Every session then reconnects at node B and replays its backlog.
+
+    Reports zero-QoS>=1-loss parity across the move (every payload
+    published before, during, and after the drain is delivered;
+    duplicates counted separately — at-least-once), the per-handoff
+    pause p99 (the stage_handoff_pause_ms histogram), and a wedged-
+    drain drill: a wedge fault at the ``cluster.handoff`` seam hangs
+    one drain, the phase deadline rolls it back, and the old owner
+    still serves — rollback latency must stay within the deadline
+    budget, not the 60s hang cap."""
+    import asyncio
+    import time as _time
+
+    async def run():
+        from vernemq_tpu.broker.config import Config
+        from vernemq_tpu.broker.server import start_broker
+        from vernemq_tpu.client import MQTTClient
+        from vernemq_tpu.cluster import Cluster
+        from vernemq_tpu.robustness import faults
+
+        n_sessions = 8 if smoke else 40
+        n_rounds = 4 if smoke else 12      # publish rounds per phase
+        wedge_deadline_s = 0.5 if smoke else 1.0
+
+        nodes = []
+        for i in range(2):
+            cfg = Config(systree_enabled=False, allow_anonymous=True,
+                         handoff_drain_deadline_s=10.0)
+            broker, server = await start_broker(cfg, port=0,
+                                                node_name=f"node{i}")
+            broker.node_name = broker.metadata.node_name = f"node{i}"
+            broker.registry.node_name = f"node{i}"
+            broker.registry.db.node_name = f"node{i}"
+            cluster = Cluster(broker, "127.0.0.1", 0)
+            await cluster.start()
+            nodes.append((broker, server, cluster))
+        a, b = nodes
+        b[2].join(a[2].listen_host, a[2].listen_port)
+        while not (len(a[2].members()) == 2 and a[2].is_ready()
+                   and b[2].is_ready()):
+            await asyncio.sleep(0.02)
+
+        # persistent QoS1 fleet homed on node A, then offline
+        for s in range(n_sessions):
+            cl = MQTTClient("127.0.0.1", a[1].port, client_id=f"es{s}",
+                            clean_start=False)
+            await cl.connect()
+            await cl.subscribe(f"es/{s}/#", qos=1)
+            await cl.disconnect()
+
+        pub = MQTTClient("127.0.0.1", a[1].port, client_id="es-pub")
+        await pub.connect()
+        sent = [set() for _ in range(n_sessions)]
+        seq = 0
+
+        async def publish_round():
+            nonlocal seq
+            for s in range(n_sessions):
+                payload = b"e%d" % seq
+                await pub.publish(f"es/{s}/t", payload, qos=1)
+                sent[s].add(payload)
+                seq += 1
+
+        for _ in range(n_rounds):           # pre-drain storm
+            await publish_round()
+
+        # drain node A while the storm continues: publisher keeps
+        # hammering the DRAINING node concurrently with the handoffs
+        storm = asyncio.get_event_loop().create_task(
+            _keep_publishing(publish_round, n_rounds))
+        t0 = _time.perf_counter()
+        summary = await a[0].handoff.drain_node()
+        drain_s = _time.perf_counter() - t0
+        await storm
+        for _ in range(n_rounds):           # post-drain storm
+            await publish_round()
+
+        pauses = sorted(r.get("pause_ms", 0.0)
+                        for r in a[0].handoff.history
+                        if r.get("result") == "completed")
+        pause_p99 = (pauses[min(len(pauses) - 1,
+                                int(0.99 * len(pauses)))]
+                     if pauses else None)
+
+        # every session reconnects at node B and replays its backlog
+        missing = dupes = received = 0
+        for s in range(n_sessions):
+            cl = MQTTClient("127.0.0.1", b[1].port, client_id=f"es{s}",
+                            clean_start=False)
+            await cl.connect()
+            got = {}
+            want = set(sent[s])
+            deadline = _time.perf_counter() + 20
+            while (set(got) < want
+                   and _time.perf_counter() < deadline):
+                try:
+                    m = await cl.recv(2)
+                except asyncio.TimeoutError:
+                    break
+                got[m.payload] = got.get(m.payload, 0) + 1
+            await cl.disconnect()
+            received += len(got)
+            missing += len(want - set(got))
+            dupes += sum(c - 1 for c in got.values())
+
+        # wedged-drain drill: one fresh queue, a wedge at the handoff
+        # seam; the drain deadline must roll it back with the OLD
+        # owner still serving (bounded pause, not an outage)
+        wcl = MQTTClient("127.0.0.1", b[1].port, client_id="es-wedge",
+                         clean_start=False)
+        await wcl.connect()
+        await wcl.subscribe("es-wedge/#", qos=1)
+        await wcl.disconnect()
+        await pub.publish("es-wedge/t", b"wedged", qos=1)
+        wsid = ("", "es-wedge")
+        while len(b[0].registry.queues[wsid].offline) != 1:
+            await asyncio.sleep(0.02)
+        b[0].config.set("handoff_drain_deadline_s", wedge_deadline_s)
+        faults.install(faults.FaultPlan([faults.FaultRule(
+            "cluster.handoff", kind="wedge", after=1, count=1)],
+            seed=seed))
+        try:
+            w0 = _time.perf_counter()
+            ok = await b[0].handoff.handoff_session(wsid, "node0")
+            wedge_rollback_s = _time.perf_counter() - w0
+        finally:
+            faults.clear()
+        wedge_ok = (ok is False
+                    and wedge_rollback_s < wedge_deadline_s + 1.0
+                    and len(b[0].registry.queues[wsid].offline) == 1)
+
+        await pub.disconnect()
+        for broker, server, cluster in nodes:
+            await cluster.stop()
+            await broker.stop()
+            await server.stop()
+
+        published = sum(len(x) for x in sent)
+        return {
+            "sessions": n_sessions,
+            "published": published,
+            "received": received,
+            "missing": missing,
+            "duplicates": dupes,
+            "drain_moved": summary["sessions"]["moved"],
+            "drain_failed": summary["sessions"]["failed"],
+            "drain_s": round(drain_s, 3),
+            "handoff_pause_ms_p99": pause_p99,
+            "wedge_rollback_s": round(wedge_rollback_s, 3),
+            "wedge_rolled_back_in_deadline": wedge_ok,
+            "parity_ok": missing == 0 and wedge_ok,
+        }
+
+    async def _keep_publishing(publish_round, rounds):
+        import asyncio as _a
+        for _ in range(rounds):
+            await publish_round()
+            await _a.sleep(0)
+
+    return asyncio.run(run())
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--subs", type=int, default=1_000_000)
@@ -2480,7 +2650,7 @@ def main() -> int:
     ap.add_argument("--reconnect-sessions", type=int, default=0,
                     help="config 14 session count override (default: "
                          "100k, 20k on CPU smoke)")
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13,14",
+    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15",
                     help="which BASELINE configs to run (3 = headline; "
                     "6 = fault-storm robustness: publish p99 while the "
                     "device path is down + breaker recovery time; "
@@ -2795,6 +2965,10 @@ def main() -> int:
         guarded("14_reconnect_storm",
                 lambda: config14_reconnect_storm(
                     smoke, sessions=args.reconnect_sessions or None))
+
+    if "15" in want:
+        guarded("15_elastic_storm",
+                lambda: config15_elastic_storm(smoke, args.seed))
 
     if headline is not None:
         value = headline["matches_per_sec"]

@@ -897,3 +897,120 @@ async def test_plumtree_eight_node_convergence(event_loop):
         await pub.disconnect()
     finally:
         await stop_cluster(nodes)
+
+
+# ------------------------------------------- migration under injected faults
+
+
+@pytest.mark.asyncio
+async def test_migration_survives_store_read_failure_mid_drain():
+    """A store-backed offline queue whose backlog read fails mid-drain:
+    the drain aborts with the LOCAL queue state restored (nothing
+    shipped, nothing deleted), the migration reads `failed`, and once
+    the store heals the retarget machinery completes the move with
+    zero loss and the target recorded in `tried` (vmq_reg.erl's
+    block_until_migrated error path)."""
+    nodes = await make_cluster(3)
+    try:
+        a, b, c = nodes
+        sid = ("", "srf")
+        cl = await connected(a, "srf", clean_start=False)
+        await cl.subscribe("srf/#", qos=1)
+        await cl.disconnect()
+        pub = await connected(b, "srf-pub")
+        for i in range(3):
+            await pub.publish(f"srf/{i}", b"s%d" % i, qos=1)
+        await pub.disconnect()
+        await wait_until(lambda: len(
+            a.broker.registry.queues[sid].offline) == 3)
+        q = a.broker.registry.queues[sid]
+        # push the backlog fully into the store tier (cold-queue shape)
+        assert len(a.broker.msg_store.read_all(sid)) == 3
+        q.offline.clear()
+        q.offline_in_store = True
+
+        real_read = a.broker.msg_store.read_all
+        state = {"broken": True}
+
+        def flaky_read(s):
+            if state["broken"] and s == sid:
+                raise IOError("injected store read failure")
+            return real_read(s)
+
+        a.broker.msg_store.read_all = flaky_read
+        # fence the record at node1: the change event fires the drain
+        rec = a.broker.registry.db.read(sid)
+        rec.node = "node1"
+        a.broker.registry.db.store(sid, rec)
+        await wait_until(lambda: a.broker.migrations.get(
+            sid, {}).get("state") == "failed")
+        # local state intact: queue offline, backlog safe in the store
+        from vernemq_tpu.broker.queue import OFFLINE
+        assert q.state == OFFLINE and q.offline_in_store is True
+        assert a.broker.metrics.value("msg_store_read_errors") >= 1
+        assert a.broker.metrics.value("queue_drain_failed") >= 1
+        assert len(real_read(sid)) == 3  # nothing deleted
+        mig = a.broker.migrations[sid]
+
+        # store heals; the leave-loop retarget picks a fresh peer
+        state["broken"] = False
+        assert a.cluster._retarget_failed_migrations(
+            ["node1", "node2"]) is True
+        assert mig["tried"] == ["node1", "node2"]
+        await wait_until(lambda: sid not in a.broker.migrations
+                         and sid not in a.broker.registry.queues)
+        rec = a.broker.registry.db.read(sid)
+        assert rec.node == "node2"
+        await wait_until(lambda: (
+            (q2 := c.broker.registry.queues.get(sid)) is not None
+            and len(q2.offline) == 3))
+        assert sorted(m.payload for m in
+                      c.broker.registry.queues[sid].offline) == \
+            [b"s0", b"s1", b"s2"]
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_migration_survives_cluster_recv_faults():
+    """migrate_offline_queues under a lossy channel: cluster.recv
+    faults drop inbound `enq`/ack batches; the bounded retry loop
+    re-ships the unacked tail until every message lands — QoS1
+    at-least-once, zero loss."""
+    from vernemq_tpu.robustness import faults
+    from vernemq_tpu.robustness.faults import FaultPlan, FaultRule
+
+    nodes = await make_cluster(2, remote_enqueue_timeout=300,
+                               max_drain_time=50,
+                               max_msgs_per_drain_step=3)
+    try:
+        a, b = nodes
+        sid = ("", "lossy")
+        cl = await connected(a, "lossy", clean_start=False)
+        await cl.subscribe("lossy/#", qos=1)
+        await cl.disconnect()
+        pub = await connected(a, "lossy-pub")
+        sent = {b"l%d" % i for i in range(12)}
+        for i in range(12):
+            await pub.publish(f"lossy/{i}", b"l%d" % i, qos=1)
+        await pub.disconnect()
+        await wait_until(lambda: len(
+            a.broker.registry.queues[sid].offline) == 12)
+
+        faults.install(FaultPlan([FaultRule(
+            "cluster.recv", kind="error", probability=0.4, count=8)],
+            seed=11))
+        try:
+            moved = await a.cluster.migrate_offline_queues(
+                ["node1"], timeout=30.0)
+        finally:
+            faults.clear()
+        assert moved == 1
+        await wait_until(lambda: sid not in a.broker.registry.queues
+                         and sid not in a.broker.migrations)
+        q2 = b.broker.registry.queues[sid]
+        # at-least-once across retries: every payload present, dupes OK
+        assert {m.payload for m in q2.offline} == sent
+        assert a.broker.metrics.value("queue_migrated") == 1
+    finally:
+        await stop_cluster(nodes)

@@ -436,14 +436,15 @@ async def test_admin_fault_and_breaker_commands():
         # breaker drill: trip forces degraded mode, reset restores.
         # An unscoped trip covers EVERY breakered path — the match
         # breaker, the payload-predicate engine's (PR 10), the
-        # process-global wire-codec breaker (PR 12), and the store
-        # maintenance breaker (PR 14)
+        # process-global wire-codec breaker (PR 12), the store
+        # maintenance breaker (PR 14), and the handoff admission
+        # breaker (ISSUE 18)
         b.registry.reg_view("tpu").matcher("")
         out = reg.run(b, ["breaker", "trip"])
-        assert "tripped 4" in out
+        assert "tripped 5" in out
         rows = reg.run(b, ["breaker", "show"])["table"]
         assert {r["path"] for r in rows} == {"match", "predicate",
-                                             "wire", "store"}
+                                             "wire", "store", "handoff"}
         assert all(r["state"] == "forced_open" for r in rows)
         # pinned: no backoff expiry or stray success may close it
         m = b.registry.reg_view("tpu").matcher("")

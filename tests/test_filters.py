@@ -853,3 +853,33 @@ def test_chaos_predicate_storm_soak():
     # the host-parity re-run folds each batch a second time: 2x total
     assert folded + open_cnt == 2 * total
     assert eng.values_folded == 2 * total
+
+
+def test_flush_windows_emits_open_windows_immediately():
+    """Node-drain support: flush_windows(force=True) closes every
+    OPEN aggregation window at once — a subscriber whose session is
+    about to hand off gets the partial fold now instead of losing it
+    with the old owner (ROADMAP item 2: windows flush on handoff)."""
+    md = MetadataStore("n1")
+    reg = SchemaRegistry(md, "n1")
+    reg.set_schema("", "s/#", "v:number")
+    eng = _engine(reg)
+    emitted = []
+    eng.emit = lambda mp, key, o, t, p: emitted.append(json.loads(p))
+    o = SubOpts()
+    o.filter_expr = "$sum(v,10s)"  # deadline far away: tick won't close
+    eng.on_sub_delta("add", "", o)
+    rows = [(("s", "#"), ("", "fw"), o)]
+    topic = ("s", "x")
+    items = [(topic, eng.encode("", topic, b'{"v": 5}')),
+             (topic, eng.encode("", topic, b'{"v": 4}'))]
+    eng.filter_batch_host("", items, [list(rows), list(rows)])
+    eng._tick()
+    assert emitted == []  # 10s window: a tick leaves it open
+    n = eng.flush_windows()
+    assert n == 1
+    assert len(emitted) == 1 and emitted[0]["value"] == 9.0
+    assert emitted[0]["count"] == 2
+    # the flushed slot tumbled: nothing further to flush or emit
+    assert eng.flush_windows() == 0
+    assert len(emitted) == 1

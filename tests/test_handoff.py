@@ -1,0 +1,477 @@
+"""Live-handoff FSM tests: the freeze->drain->fence->adopt engine
+(cluster/handoff.py), its per-phase watchdog rollback, breaker-gated
+admission, the mesh-slice fence, and the end-to-end live session
+handoff with zero QoS>=1 loss (ROADMAP: elastic rebalancing)."""
+
+import asyncio
+import time
+
+import pytest
+
+from test_cluster import connected, make_cluster, stop_cluster, wait_until
+from vernemq_tpu.broker.broker import Broker
+from vernemq_tpu.broker.config import Config
+from vernemq_tpu.broker.queue import DRAIN, OFFLINE, ONLINE
+from vernemq_tpu.cluster.handoff import (HandoffDeadline, HandoffManager,
+                                         HandoffRefused)
+from vernemq_tpu.cluster.mesh_map import MeshSliceMap
+from vernemq_tpu.robustness import faults
+from vernemq_tpu.robustness.faults import FaultPlan, FaultRule
+
+
+def mk_broker(**cfg):
+    return Broker(Config(systree_enabled=False, **cfg), node_name="n1")
+
+
+# ------------------------------------------------------------- FSM engine
+
+
+@pytest.mark.asyncio
+async def test_fsm_runs_phases_in_order_and_records():
+    b = mk_broker()
+    seen = []
+    ok = await b.handoff.run(
+        "unit", "u1", "n2",
+        freeze=lambda: seen.append("freeze"),
+        drain=lambda: seen.append("drain"),
+        fence=lambda: seen.append("fence"),
+        adopt=lambda: seen.append("adopt"),
+        rollback=lambda: seen.append("rollback"))
+    assert ok is True
+    assert seen == ["freeze", "drain", "fence", "adopt"]
+    assert b.metrics.value("handoff_started") == 1
+    assert b.metrics.value("handoff_completed") == 1
+    assert b.metrics.value("handoff_rollbacks") == 0
+    assert not b.handoff.active
+    row = b.handoff.status_rows()[0]
+    assert row["result"] == "completed" and row["unit"] == "u1"
+
+
+@pytest.mark.asyncio
+async def test_fsm_phase_error_rolls_back():
+    b = mk_broker()
+    seen = []
+
+    def boom():
+        raise ValueError("drain exploded")
+
+    ok = await b.handoff.run(
+        "unit", "u2", "n2",
+        freeze=lambda: seen.append("freeze"),
+        drain=boom,
+        fence=lambda: seen.append("fence"),
+        adopt=lambda: seen.append("adopt"),
+        rollback=lambda: seen.append("rollback"))
+    assert ok is False
+    assert seen == ["freeze", "rollback"]  # fence/adopt never ran
+    assert b.metrics.value("handoff_rollbacks") == 1
+    assert b.metrics.value("handoff_completed") == 0
+    row = b.handoff.status_rows()[0]
+    assert row["result"] == "rolled_back" and row["phase"] == "drain"
+
+
+@pytest.mark.asyncio
+async def test_fsm_async_phases_and_duplicate_unit_refused():
+    b = mk_broker()
+    gate = asyncio.Event()
+
+    async def slow_freeze():
+        await gate.wait()
+
+    task = asyncio.get_event_loop().create_task(b.handoff.run(
+        "unit", "dup", "n2", freeze=slow_freeze,
+        drain=lambda: None, fence=lambda: None, adopt=lambda: None,
+        rollback=lambda: None))
+    await wait_until(lambda: "unit:dup" in b.handoff.active)
+    with pytest.raises(HandoffRefused):
+        await b.handoff.run(
+            "unit", "dup", "n3", freeze=lambda: None,
+            drain=lambda: None, fence=lambda: None, adopt=lambda: None,
+            rollback=lambda: None)
+    gate.set()
+    assert await task is True
+
+
+@pytest.mark.asyncio
+async def test_wedged_drain_rolls_back_within_deadline():
+    """The tentpole drill: a wedge fault at the cluster.handoff seam
+    hangs the drain phase; the phase deadline abandons it (releasing
+    the wedge) and the handoff rolls back — bounded, not stuck."""
+    b = mk_broker(handoff_drain_deadline_s=0.4,
+                  handoff_freeze_deadline_ms=400)
+    rolled = []
+    faults.install(FaultPlan([
+        # after=1: the freeze-phase injection passes, the drain wedges
+        FaultRule("cluster.handoff", kind="wedge", after=1, count=1)]))
+    try:
+        t0 = time.monotonic()
+        ok = await b.handoff.run(
+            "unit", "wedge", "n2",
+            freeze=lambda: None, drain=lambda: None,
+            fence=lambda: None, adopt=lambda: None,
+            rollback=lambda: rolled.append(True))
+        elapsed = time.monotonic() - t0
+    finally:
+        faults.clear()
+    assert ok is False
+    assert rolled == [True]
+    assert elapsed < 2.0  # deadline + slack, not the 60s hang cap
+    assert b.handoff.breaker.status()["failures"] == 1
+    row = b.handoff.status_rows()[0]
+    assert row["phase"] == "drain" and row["result"] == "rolled_back"
+
+
+@pytest.mark.asyncio
+async def test_breaker_gates_admission():
+    b = mk_broker()
+    b.handoff.breaker.trip()
+    with pytest.raises(HandoffRefused):
+        await b.handoff.run(
+            "unit", "gated", "n2", freeze=lambda: None,
+            drain=lambda: None, fence=lambda: None, adopt=lambda: None,
+            rollback=lambda: None)
+    assert b.metrics.value("handoff_started") == 0
+
+
+# -------------------------------------------------------- mesh slice fence
+
+
+def test_slice_freeze_fence_and_stale_claim_rejection():
+    b = mk_broker()
+    adopted = []
+    mm = MeshSliceMap(b.metadata, "n1", 4,
+                      on_adopt=lambda s, tok: adopted.append((s, tok)),
+                      metrics=b.metrics)
+    mm.claim_local()
+    assert mm.local_slices() == [0, 1, 2, 3]
+
+    # freeze pins the slice out of claim passes
+    mm.metadata.delete("mesh_slices", 0)
+    mm.freeze(0)
+    assert 0 not in mm.claim_local()
+    mm.unfreeze(0)
+    assert 0 in mm.claim_local()
+
+    # transfer_local bumps the epoch, pins the record, arms the fence
+    fence_epoch = mm.transfer_local(2, "n2")
+    assert mm.owner(2) == "n2"
+    assert mm.metadata.get("mesh_slices", 2)["pinned"] is True
+
+    # a stale lower-epoch claim flipping the slice back is rejected
+    adopted.clear()
+    mm._on_change(2, {"node": "n2", "epoch": fence_epoch},
+                  {"node": "n1", "epoch": fence_epoch - 1}, origin="n2")
+    assert adopted == []
+    assert mm.fenced_rejects == 1
+    assert b.metrics.value("handoff_fenced_writes") == 1
+
+    # an explicit transfer BACK at a newer epoch lifts the fence
+    mm._on_change(2, {"node": "n2", "epoch": fence_epoch},
+                  {"node": "n1", "epoch": fence_epoch + 3,
+                   "pinned": True}, origin="n2")
+    assert adopted == [([2], ("n2", fence_epoch + 3))]
+    assert 2 not in mm._fenced
+
+
+def test_claim_pass_honours_pinned_transfer_while_owner_lives():
+    b = mk_broker()
+    mm = MeshSliceMap(b.metadata, "n1", 4, metrics=b.metrics)
+    mm.claim_local(["n1", "n2"])  # round-robin: n1 owns 0, 2
+    mm.transfer_local(2, "n2")
+    # slice 2 maps to n1 by round-robin but the pinned record points at
+    # a live member: the claim pass must not steal it back
+    assert 2 not in mm.claim_local(["n1", "n2"])
+    assert mm.owner(2) == "n2"
+    # ... until n2 leaves the membership: then the pin is void
+    assert 2 in mm.claim_local(["n1"])
+    assert mm.owner(2) == "n1"
+
+
+def test_transfer_local_requires_ownership():
+    b = mk_broker()
+    mm = MeshSliceMap(b.metadata, "n1", 2, metrics=b.metrics)
+    with pytest.raises(RuntimeError):
+        mm.transfer_local(0, "n2")  # unclaimed
+
+
+@pytest.mark.asyncio
+async def test_transfer_slice_refusals():
+    b = mk_broker()
+    if b.mesh_map is None:
+        b.mesh_map = MeshSliceMap(b.metadata, "n1", 2, metrics=b.metrics)
+    with pytest.raises(HandoffRefused):
+        await b.handoff.transfer_slice(0, "n2")  # not owned here
+    b.mesh_map.claim_local()
+    with pytest.raises(HandoffRefused):
+        await b.handoff.transfer_slice(0, "n1")  # target is self
+    with pytest.raises(HandoffRefused):
+        await b.handoff.transfer_slice(99, "n2")  # out of range
+
+
+# ---------------------------------------------------- live session handoff
+
+
+@pytest.mark.asyncio
+async def test_live_session_handoff_zero_qos1_loss():
+    """A LIVE persistent session moves nodes mid-traffic: unacked
+    in-flight deliveries requeue and ship, the record repoints, and the
+    client reconnects at the successor with every message intact."""
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        sid = ("", "mv")
+        cl = await connected(a, "mv", clean_start=False)
+        cl._auto_ack = False  # hold PUBACKs: deliveries stay in-flight
+        await cl.subscribe("mv/#", qos=1)
+        pub = await connected(a, "mv-pub")
+        for i in range(3):
+            await pub.publish(f"mv/{i}", b"m%d" % i, qos=1)
+        # the session holds 3 unacked QoS1 deliveries
+        await wait_until(lambda: (
+            (s := a.broker.sessions.get(sid)) is not None
+            and len(s.waiting_acks) == 3))
+
+        ok = await a.broker.handoff.handoff_session(sid, "node1")
+        assert ok is True
+        # old owner: queue gone, migration table clean, record repointed
+        assert sid not in a.broker.registry.queues
+        assert sid not in a.broker.migrations
+        assert a.broker.registry.db.read(sid).node == "node1"
+        row = a.broker.handoff.status_rows()[0]
+        assert row["result"] == "completed" and row["kind"] == "session"
+        assert a.broker.metrics.value("queue_migrated") == 1
+
+        # a post-fence publish routes to the NEW owner
+        await pub.publish("mv/after", b"late", qos=1)
+        await wait_until(lambda: (
+            (q := b.broker.registry.queues.get(sid)) is not None
+            and len(q.offline) == 4))
+
+        # the client reconnects at the successor: zero loss
+        cl2 = await connected(b, "mv", clean_start=False)
+        assert cl2.connack.session_present is True
+        got = {(await cl2.recv()).payload for _ in range(4)}
+        assert got == {b"m0", b"m1", b"m2", b"late"}
+        await cl2.disconnect()
+        await pub.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_session_handoff_rollback_restores_local_queue():
+    """The drain deadline fires against a dead target: the handoff
+    rolls back, the backlog is restored to the LOCAL offline queue
+    (old owner keeps serving) and the migration row reads failed."""
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        a.broker.config.set("handoff_drain_deadline_s", 0.6)
+        a.broker.config.set("remote_enqueue_timeout", 200)
+        sid = ("", "rb")
+        cl = await connected(a, "rb", clean_start=False)
+        await cl.subscribe("rb/#", qos=1)
+        await cl.disconnect()
+        pub = await connected(a, "rb-pub")
+        for i in range(3):
+            await pub.publish(f"rb/{i}", b"r%d" % i, qos=1)
+        await pub.disconnect()
+        await wait_until(lambda: len(
+            a.broker.registry.queues[sid].offline) == 3)
+        # sever a->b so enq acks never arrive
+        w = a.cluster._writers["node1"]
+        w.addr = ("127.0.0.1", 9)
+        if w._writer is not None:
+            w._writer.close()
+
+        ok = await a.broker.handoff.handoff_session(sid, "node1")
+        assert ok is False
+        q = a.broker.registry.queues[sid]
+        assert q.state == OFFLINE
+        assert len(q.offline) == 3  # every message restored locally
+        assert a.broker.migrations[sid]["state"] == "failed"
+        assert a.broker.registry.db.read(sid).node == "node0"
+        assert a.broker.metrics.value("handoff_rollbacks") == 1
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_handoff_session_refusals():
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        with pytest.raises(HandoffRefused):
+            await a.broker.handoff.handoff_session(("", "ghost"), "node1")
+        cl = await connected(a, "cs", clean_start=True)
+        await cl.subscribe("cs/#", qos=1)
+        with pytest.raises(HandoffRefused):  # clean-session: no state
+            await a.broker.handoff.handoff_session(("", "cs"), "node1")
+        await cl.disconnect()
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_drain_node_evacuates_queues():
+    """`vmq-admin cluster drain-node` in library form: every
+    persistent queue leaves for a live peer through its own handoff."""
+    nodes = await make_cluster(3)
+    try:
+        a, b, c = nodes
+        sids = []
+        for name in ("ev1", "ev2"):
+            cl = await connected(a, name, clean_start=False)
+            await cl.subscribe(f"ev/{name}/#", qos=1)
+            await cl.disconnect()
+            sids.append(("", name))
+        pub = await connected(b, "ev-pub")
+        for name in ("ev1", "ev2"):
+            for i in range(2):
+                await pub.publish(f"ev/{name}/{i}", b"e%d" % i, qos=1)
+        await pub.disconnect()
+        await wait_until(lambda: all(
+            (q := a.broker.registry.queues.get(sid)) is not None
+            and len(q.offline) == 2 for sid in sids))
+
+        out = await a.broker.handoff.drain_node()
+        assert out["sessions"] == {"moved": 2, "failed": 0, "skipped": 0}
+        assert not a.broker.registry.queues
+        # both queues live whole on the peers, round-robin
+        owners = set()
+        for sid in sids:
+            rec = a.broker.registry.db.read(sid)
+            assert rec.node in ("node1", "node2")
+            owners.add(rec.node)
+            owner = b if rec.node == "node1" else c
+            await wait_until(lambda: (
+                (q := owner.broker.registry.queues.get(sid)) is not None
+                and len(q.offline) == 2))
+        assert owners == {"node1", "node2"}
+    finally:
+        await stop_cluster(nodes)
+
+
+@pytest.mark.asyncio
+async def test_drain_node_refused_without_live_peers():
+    b = mk_broker()
+    with pytest.raises(HandoffRefused):
+        await b.handoff.drain_node()
+
+
+# ------------------------------------------------------------------- admin
+
+
+def test_admin_handoff_surfaces():
+    from vernemq_tpu.admin.commands import (CommandError, CommandRegistry,
+                                            register_core_commands)
+
+    b = mk_broker()
+    reg = register_core_commands(CommandRegistry())
+    out = reg.run(b, ["handoff", "show"])
+    assert out["breaker"] == "closed" and out["started"] == 0
+    rows = reg.run(b, ["breaker", "show"])["table"]
+    assert any(r["path"] == "handoff" for r in rows)
+    # trip/reset through the shared breaker selector
+    reg.run(b, ["breaker", "trip", "path=handoff"])
+    assert b.handoff.breaker.status()["state"] == "forced_open"
+    reg.run(b, ["breaker", "reset", "path=handoff"])
+    assert b.handoff.breaker.status()["state"] == "closed"
+    with pytest.raises(CommandError):
+        reg.run(b, ["handoff", "drain", "client-id=nope", "target=n2"])
+
+
+# ------------------------------------------------------------- chaos soak
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+@pytest.mark.asyncio
+async def test_handoff_bounce_soak_under_faults():
+    """Elastic-storm soak: a persistent QoS1 session bounces between
+    two nodes round after round while the cluster.handoff seam injects
+    latency and errors. Failed rounds must roll back to a serving
+    owner; successful rounds must move the whole backlog. Invariant:
+    after every round the backlog is intact somewhere — the final
+    reconnect receives EVERY payload ever published (dupes allowed,
+    loss never)."""
+    nodes = await make_cluster(2)
+    try:
+        a, b = nodes
+        by_name = {"node0": a, "node1": b}
+        sid = ("", "soak")
+        cl = await connected(a, "soak", clean_start=False)
+        await cl.subscribe("soak/#", qos=1)
+        await cl.disconnect()
+
+        # one probability draw per hit, rules matched in order: the
+        # error band is [0, 0.2), the latency band [0.2, 0.5)
+        faults.install(FaultPlan([
+            FaultRule("cluster.handoff", kind="error", probability=0.2,
+                      count=-1, message="injected handoff chaos"),
+            FaultRule("cluster.handoff", kind="latency", latency_ms=20.0,
+                      probability=0.5, count=-1)],
+            seed=29))
+        sent = set()
+        owner = "node0"
+        rollbacks = completions = 0
+        try:
+            for rnd in range(8):
+                src = by_name[owner]
+                burst = {b"r%d-%d" % (rnd, i) for i in range(5)}
+                pub = await connected(src, f"soak-pub-{rnd}")
+                for p in sorted(burst):
+                    await pub.publish(f"soak/{rnd}", p, qos=1)
+                await pub.disconnect()
+                sent |= burst
+                # burst settled into the owner's queue before moving it
+                await wait_until(lambda: burst <= {
+                    m.payload for m in src.broker.registry.queues[sid]
+                    .offline})
+                target = "node1" if owner == "node0" else "node0"
+                ok = await src.broker.handoff.handoff_session(sid, target)
+                if ok:
+                    completions += 1
+                    owner = target
+                    # both nodes converge on the new record owner so the
+                    # next round's publisher routes correctly
+                    for n in nodes:
+                        await wait_until(lambda n=n: (
+                            (r := n.broker.registry.db.read(sid))
+                            is not None and r.node == owner))
+                else:
+                    rollbacks += 1
+                    rec = src.broker.registry.db.read(sid)
+                    if rec.node == target:
+                        # post-fence failure: ownership committed, the
+                        # FSM rolled FORWARD via the legacy retry drain
+                        owner = target
+                        await wait_until(
+                            lambda: sid not in src.broker.registry.queues
+                            and sid not in src.broker.migrations)
+                        for n in nodes:
+                            await wait_until(lambda n=n: (
+                                (r := n.broker.registry.db.read(sid))
+                                is not None and r.node == owner))
+                        await wait_until(lambda: burst <= {
+                            m.payload for m in by_name[owner].broker
+                            .registry.queues[sid].offline})
+                    else:
+                        # pre-fence failure: the OLD owner still serves
+                        q = src.broker.registry.queues[sid]
+                        assert {m.payload for m in q.offline} >= burst
+        finally:
+            faults.clear()
+
+        dst = by_name[owner]
+        assert dst.broker.registry.queues[sid] is not None
+        # the seeded plan makes both outcomes happen in 8 rounds
+        assert completions > 0 and rollbacks > 0
+        cl2 = await connected(dst, "soak", clean_start=False)
+        assert cl2.connack.session_present is True
+        got = set()
+        while not sent <= got:
+            got.add((await cl2.recv(10)).payload)
+        await cl2.disconnect()
+    finally:
+        await stop_cluster(nodes)

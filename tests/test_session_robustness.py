@@ -248,3 +248,54 @@ async def test_unsolicited_acks_are_harmless():
     w.close()
     await b.stop()
     await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_qos2_dedup_window_is_bounded():
+    """A client that opens QoS2 exchanges and never sends PUBREL must
+    not grow ``awaiting_rel`` without bound: past ``qos2_dedup_max``
+    the oldest pids are evicted (counted in ``qos2_dedup_evictions``)
+    — trading dedup for THAT pid, never availability. The session and
+    the broker stay fully functional."""
+    b, server = await boot(qos2_dedup_max=8)
+    r, w = await asyncio.open_connection(server.host, server.port)
+
+    buf = b""
+
+    async def recv():
+        nonlocal buf
+        while True:
+            f, rest = codec_v5.parse(buf)
+            if f is not None:
+                buf = rest
+                return f
+            data = await asyncio.wait_for(r.read(65536), 5)
+            assert data, "connection closed unexpectedly"
+            buf += data
+
+    w.write(codec_v5.serialise(Connect(proto_ver=5, client_id="q2ev",
+                                       clean_start=True, keepalive=60)))
+    await w.drain()
+    await recv()  # CONNACK
+    for pid in range(1, 21):  # 20 opens, PUBREL never sent
+        w.write(codec_v5.serialise(Publish(
+            topic="q2/t", payload=b"x", qos=2, packet_id=pid,
+            properties={})))
+    await w.drain()
+    for _ in range(20):
+        assert isinstance(await recv(), Pubrec)
+
+    sess = b.sessions[("", "q2ev")]
+    assert len(sess.awaiting_rel) == 8  # bounded at the knob
+    assert b.metrics.value("qos2_dedup_evictions") == 12
+    # survivors are the newest pids; the exchange still completes
+    assert min(sess.awaiting_rel) == 13
+    w.write(codec_v5.serialise(Pubrel(packet_id=20)))
+    await w.drain()
+    comp = await recv()
+    assert isinstance(comp, Pubcomp) and comp.packet_id == 20
+    assert len(sess.awaiting_rel) == 7
+    await control_roundtrip(server, b"after-qos2-flood")
+    w.close()
+    await b.stop()
+    await server.stop()

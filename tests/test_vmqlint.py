@@ -681,6 +681,25 @@ def test_events_registry_runtime_validation():
         events.journal().emit("not_a_registered_code")
 
 
+def test_events_registry_guards_handoff_codes(base_files):
+    """The handoff FSM's journal codes are held to the same discipline:
+    deleting the lone `handoff_fence` emit site leaves a dead registry
+    entry the pass must flag (and the clean tree proves every handoff
+    code currently has a live site)."""
+    rel = "vernemq_tpu/cluster/handoff.py"
+    text = base_files[rel].text
+    assert 'events.emit("handoff_fence"' in text
+    mutated = text.replace('events.emit("handoff_fence"',
+                           'log.debug("handoff_fence"', 1)
+    found = run_pass("events-registry", base_files,
+                     overrides={rel: mutated})
+    assert any("handoff_fence" in f.message
+               and "no events.emit" in f.message for f in found)
+    # unmutated tree: no handoff finding (all four codes live)
+    clean = run_pass("events-registry", base_files)
+    assert not any("handoff" in f.message for f in clean)
+
+
 # ------------------------------------------------- framework / CLI surface
 
 def test_marker_hygiene(base_files):

@@ -295,12 +295,13 @@ async def test_wire_parse_fault_degrades_to_pure_never_drops():
 
 @pytest.mark.asyncio
 async def test_complex_rows_fall_back_to_exact_msg_path():
-    """One complex recipient routes the whole fanout through the
-    classic Msg path — since the alias-aware batch encoder a plain v5
-    subscriber is a FAST recipient; what stays complex is a v5 session
-    with a maximum_packet_size (every frame must be measured by
-    _plan_v5_delivery). The capped client gets a correct v5 frame, the
-    v4 client its v4 frame — semantics over speed."""
+    """A v5 session with a maximum_packet_size is fast-admissible when
+    the conservative frame bound FITS the cap (wire_v5_fast_ok with
+    frame_bound) — small publishes ride the batched encoder and arrive
+    byte-correct. An oversize publish flips the whole fanout to the
+    classic Msg path, where _plan_v5_delivery measures exactly and
+    DROPS the frame for the capped client (MQTT-3.1.2-24) while the v4
+    client still gets its frame — semantics over speed."""
     broker, server = await boot()
     try:
         v4sub = MQTTClient("127.0.0.1", server.port, client_id="s4")
@@ -310,8 +311,6 @@ async def test_complex_rows_fall_back_to_exact_msg_path():
                            proto_ver=5)
         await v5sub.connect()
         await v5sub.subscribe("c/#", qos=0)
-        # packet-size-capped v5 session: the one v5 shape the wire
-        # fanout refuses (wire_v5_fast_ok) — forces the classic path
         capped = await Raw5.connect(server.port, "s5cap",
                                     {"maximum_packet_size": 256})
         await capped.send(codec_v5.serialise(Subscribe(
@@ -319,12 +318,23 @@ async def test_complex_rows_fall_back_to_exact_msg_path():
         await capped.recv5(1)  # SUBACK
         pub = MQTTClient("127.0.0.1", server.port, client_id="p4")
         await pub.connect()
+        # small frame: bound <= cap, the capped session joins the batch
         base_batches = fastpath.fanout_batches
         await pub.publish("c/x", b"mixed", qos=0)
         assert (await v4sub.recv(5.0)).payload == b"mixed"
         assert (await v5sub.recv(5.0)).payload == b"mixed"
-        assert (await capped.recv5(1))[0].payload == b"mixed"
-        assert fastpath.fanout_batches == base_batches  # classic served
+        f = (await capped.recv5(1))[0]
+        assert f.payload == b"mixed" and f.topic == "c/x"  # byte parity
+        assert fastpath.fanout_batches > base_batches  # batch served it
+        # oversize frame: bound > cap — classic path, capped client is
+        # skipped (a frame over its cap may not be sent), others served
+        base_batches = fastpath.fanout_batches
+        await pub.publish("c/x", b"x" * 300, qos=0)
+        assert (await v4sub.recv(5.0)).payload == b"x" * 300
+        assert (await v5sub.recv(5.0)).payload == b"x" * 300
+        assert fastpath.fanout_batches == base_batches  # classic fanout
+        with pytest.raises(asyncio.TimeoutError):
+            await capped.recv5(1, timeout=0.3)
         capped.close()
         # a v5 PUBLISHER with empty props is fast-admittable too
         base = fastpath.fastpath_pubs

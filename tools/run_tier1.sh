@@ -10,7 +10,8 @@
 # Exits with pytest's status; prints DOTS_PASSED=<n> for the driver.
 # Chaos/soak tests are opt-in: they carry BOTH the `chaos` and `slow`
 # markers, so tier-1's `-m 'not slow'` excludes them (run them with
-# `tools/run_tier1.sh -m chaos`).
+# `tools/run_tier1.sh -m chaos`, or set TIER1_CHAOS=1 to append the
+# chaos leg after a green tier-1 run).
 set -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -82,5 +83,23 @@ timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
   -p no:randomly 2>&1 | tee "$LOG"
 rc=${PIPESTATUS[0]}
+
+# opt-in chaos leg (TIER1_CHAOS=1): after a green tier-1 run, also run
+# the fault-injection soaks (`-m chaos` — partition storms, handoff
+# bounce, filter/watchdog chaos). Kept out of the default gate because
+# the soaks are long; CI jobs that want the full robustness sweep set
+# the env var instead of remembering a second command.
+if [ "${TIER1_CHAOS:-0}" = "1" ] && [ "$rc" -eq 0 ]; then
+  CLOG=${TIER1_CHAOS_LOG:-/tmp/_t1_chaos.log}
+  rm -f "$CLOG"
+  timeout -k 10 "$TIMEOUT" env JAX_PLATFORMS=cpu \
+    TIER1_FAULTHANDLER_S="$DUMP_S" \
+    python -m pytest tests/ -q -m chaos \
+    --continue-on-collection-errors -p no:cacheprovider -p no:xdist \
+    -p no:randomly 2>&1 | tee "$CLOG"
+  rc=${PIPESTATUS[0]}
+  cat "$CLOG" >> "$LOG"
+fi
+
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' "$LOG" | tr -cd . | wc -c)"
 exit "$rc"

@@ -144,6 +144,23 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin cluster fix-dead-queues [targets=n1,n2]")
     reg.register(["cluster", "migrations"], _cluster_migrations,
                  "vmq-admin cluster migrations")
+    reg.register(["cluster", "drain-node"], _cluster_drain_node,
+                 "vmq-admin cluster drain-node [targets=n1,n2]  "
+                 "(evacuate this node: flush filter windows, hand "
+                 "every persistent queue + owned mesh slice to live "
+                 "peers through bounded live handoffs)")
+    reg.register(["handoff", "show"], _handoff_show,
+                 "vmq-admin handoff show  (in-flight freeze->drain->"
+                 "fence->adopt moves, recent history, admission "
+                 "breaker)")
+    reg.register(["handoff", "drain"], _handoff_drain,
+                 "vmq-admin handoff drain client-id=CID target=Node "
+                 "[mountpoint=]  (live session handoff — bounded "
+                 "pause, zero QoS>=1 loss, rollback on deadline)")
+    reg.register(["handoff", "rebalance"], _handoff_rebalance,
+                 "vmq-admin handoff rebalance  (move local mesh "
+                 "slices the round-robin assigns elsewhere, one "
+                 "bounded handoff per slice)")
     reg.register(["cluster", "spool", "show"], _cluster_spool_show,
                  "vmq-admin cluster spool show")
     reg.register(["cluster", "spool", "flush"], _cluster_spool_flush,
@@ -258,10 +275,10 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin breaker show")
     reg.register(["breaker", "trip"], _breaker_trip,
                  "vmq-admin breaker trip [mountpoint=] "
-                 "[path=match|retained|predicate|wire|store]")
+                 "[path=match|retained|predicate|wire|store|handoff]")
     reg.register(["breaker", "reset"], _breaker_reset,
                  "vmq-admin breaker reset [mountpoint=] "
-                 "[path=match|retained|predicate|wire|store]")
+                 "[path=match|retained|predicate|wire|store|handoff]")
     reg.register(["store", "show"], _store_show,
                  "vmq-admin store show  (storage tier: engine kinds, "
                  "segments, live/garbage bytes, compaction + resume "
@@ -453,6 +470,121 @@ def _cluster_migrations(broker, flags):
              "state": m["state"]}
             for sid, m in sorted(broker.migrations.items())]
     return {"table": rows}
+
+
+def _cluster_drain_node(broker, flags):
+    """Whole-node evacuation behind `vmq-admin cluster drain-node`:
+    every unit moves through its own bounded freeze->drain->fence->
+    adopt handoff, so one wedged move rolls back alone while the sweep
+    continues. Background task (same pattern as graceful leave) — the
+    command returns immediately; progress via `handoff show`."""
+    import asyncio
+
+    targets = flags.get("targets")
+    if isinstance(targets, str):
+        targets = [t for t in targets.split(",") if t]
+
+    task = asyncio.get_event_loop().create_task(
+        broker.handoff.drain_node(targets))
+    broker._bg_tasks.append(task)
+
+    def _done(t):
+        if not t.cancelled() and t.exception() is not None:
+            import logging
+
+            logging.getLogger("vernemq_tpu.handoff").error(
+                "drain-node failed: %s", t.exception())
+
+    task.add_done_callback(_done)
+    return ("node draining: queues and mesh slices handing off to "
+            "live peers — progress via `vmq-admin handoff show`")
+
+
+def _handoff_show(broker, flags):
+    rows = broker.handoff.status_rows()
+    st = broker.handoff.breaker.status()
+    out = {"breaker": st["state"],
+           "started": broker.handoff.started,
+           "completed": broker.handoff.completed,
+           "rollbacks": broker.handoff.rollbacks}
+    if rows:
+        out["table"] = rows
+        return out
+    out["note"] = "no handoffs in flight or in recent history"
+    return out
+
+
+def _handoff_drain(broker, flags):
+    """One live-session handoff, synchronously awaited: the bounded
+    pause IS the command latency, so the operator sees the verdict."""
+    from ..cluster.handoff import HandoffRefused
+
+    cid = flags.get("client-id") or flags.get("client_id")
+    if not cid:
+        raise CommandError("client-id is required")
+    target = flags.get("target")
+    if not isinstance(target, str) or not target:
+        raise CommandError("target=NodeName required")
+    sid = (flags.get("mountpoint", ""), cid)
+    # cheap admission checks surface synchronously; the FSM re-checks
+    # (the background task can only log)
+    if broker.cluster is None:
+        raise CommandError("clustering is not enabled on this node")
+    if broker.registry.queues.get(sid) is None:
+        raise CommandError(f"no queue for {sid!r}")
+
+    async def _go():
+        try:
+            return await broker.handoff.handoff_session(sid, target)
+        except HandoffRefused as e:
+            raise CommandError(str(e)) from None
+
+    return _await_admin(broker, _go())
+
+
+def _handoff_rebalance(broker, flags):
+    from ..cluster.handoff import HandoffRefused
+
+    async def _go():
+        try:
+            return await broker.handoff.rebalance_slices()
+        except HandoffRefused as e:
+            raise CommandError(str(e)) from None
+
+    res = _await_admin(broker, _go())
+    if isinstance(res, dict):
+        return (f"moved slices {res['moved']} (failed {res['failed']}) "
+                f"across {res['members']}")
+    return res
+
+
+def _await_admin(broker, coro):
+    """Run a coroutine to completion from an admin command handler.
+    Admin handlers are called ON the broker loop (sync), so awaiting
+    inline would deadlock — schedule and report instead when a loop is
+    already running; block only from a loop-less caller (tests)."""
+    import asyncio
+
+    try:
+        loop = asyncio.get_event_loop()
+    except RuntimeError:
+        loop = None
+    if loop is not None and loop.is_running():
+        task = loop.create_task(coro)
+        broker._bg_tasks.append(task)
+
+        def _done(t):
+            if not t.cancelled() and t.exception() is not None:
+                import logging
+
+                logging.getLogger("vernemq_tpu.handoff").error(
+                    "handoff command failed: %s", t.exception())
+
+        task.add_done_callback(_done)
+        return ("handoff started in the background — progress via "
+                "`vmq-admin handoff show`")
+    return asyncio.get_event_loop().run_until_complete(coro) \
+        if loop is not None else asyncio.run(coro)
 
 
 def _cluster_spool(broker):
@@ -1387,6 +1519,10 @@ def _breaker_show(broker, flags):
     # compaction paused, the engines run append-only
     rows.append({"path": "store", "mountpoint": "(all)",
                  **broker.store_breaker.status()})
+    # the live-handoff admission breaker: open = new freeze/drain/
+    # fence/adopt moves refused (units stay with their current owner)
+    rows.append({"path": "handoff", "mountpoint": "(all)",
+                 **broker.handoff.breaker.status()})
     return {"table": rows}
 
 
@@ -1438,6 +1574,11 @@ def _each_breaker(broker, flags):
             # one per broker: trip pins compaction paused (append-only
             # degraded mode) until reset — delivery is untouched
             yield "(all)", broker.store_breaker
+    if path in (None, "handoff"):
+        if want is None:
+            # one per broker: trip refuses new live handoffs (every
+            # unit stays with its current owner) until reset
+            yield "(all)", broker.handoff.breaker
 
 
 def _store_show(broker, flags):

@@ -136,7 +136,7 @@ class Broker:
 
             self.mesh_map = MeshSliceMap(
                 self.metadata, node_name, n_slices,
-                on_adopt=self._on_mesh_adopt)
+                on_adopt=self._on_mesh_adopt, metrics=self.metrics)
         fsync = bool(self.config.get("msg_store_fsync", False))
         # fsync group-commit: one fsync per write burst at the flush-tick
         # boundary instead of per record (msg_store_fsync_coalesced)
@@ -239,6 +239,14 @@ class Broker:
         # migrations` (the reference surfaces drain progress via queue
         # status / cluster show): sid -> {target, pending, retries, state}
         self.migrations: Dict[SubscriberId, Dict[str, Any]] = {}
+        # live-handoff engine (cluster/handoff.py): the reusable
+        # freeze->drain->fence->adopt FSM behind `vmq-admin handoff
+        # drain|rebalance` and `cluster drain-node`; its breaker gates
+        # admission so repeated rollbacks stop new moves piling onto a
+        # broken successor
+        from ..cluster.handoff import HandoffManager
+
+        self.handoff = HandoffManager(self)
         self._delayed_wills: Dict[SubscriberId, asyncio.Task] = {}
         self.tracer: Optional[Any] = None  # single active session tracer
         # hot-path flight recorder (observability/recorder.py): the
@@ -954,6 +962,12 @@ class Broker:
         queue = self.registry.queues.get(sid)
         if queue is None:
             return
+        cur = self.migrations.get(sid)
+        if cur is not None and cur.get("state") == "handoff":
+            # the live-handoff FSM is already moving this queue — its
+            # own fence phase wrote the record that fired this hook;
+            # a second concurrent drain task would double-ship
+            return
         # register the migration BEFORE the task first runs: callers (the
         # graceful-leave wait loop) poll this map right after the record
         # rewrite, and a not-yet-scheduled task must already count.
@@ -973,7 +987,21 @@ class Broker:
         session = self.sessions.get(sid)
         if session is not None:
             await session.takeover_close()
-        backlog = queue.start_drain()
+        try:
+            backlog = queue.start_drain()
+        except Exception:
+            # the stored backlog could not be read (start_drain restored
+            # the queue untouched — state, parked publishes, in-store
+            # marker): fail the migration so the retarget/retry machinery
+            # owns recovery; nothing was shipped, nothing may be deleted
+            st = self.migrations.get(sid)
+            if st is not None:
+                st["state"] = "failed"
+            self.metrics.incr("queue_drain_failed")
+            log.exception("queue drain %s -> %s could not load the "
+                          "stored backlog; migration failed, local "
+                          "state intact", sid, new_node)
+            return
         step = self.config.max_msgs_per_drain_step
         # retry/settle delay between drain steps (vmq_server.schema
         # max_drain_time, ms): the reference re-arms drain_start after

@@ -76,6 +76,18 @@ DEFAULTS: Dict[str, Any] = {
     # bounded migration-drain retry (max_drain_time apart) before the
     # backlog is restored locally and the migration is marked failed
     "migrate_drain_retries": 60,
+    # live handoff (cluster/handoff.py): per-phase deadlines of the
+    # freeze→drain→fence→adopt state machine. The freeze deadline
+    # bounds the pause a moving unit's clients can observe (freeze,
+    # fence and adopt each run under it); the drain deadline bounds
+    # the backlog flush — past either the handoff rolls back and the
+    # OLD owner keeps serving (degraded, never stuck).
+    "handoff_freeze_deadline_ms": 500,
+    "handoff_drain_deadline_s": 10.0,
+    # QoS2 exactly-once dedup bound: max awaiting-release pids held
+    # per session before oldest-first eviction (qos2_dedup_evictions);
+    # 0 = unbounded (the pre-cap behaviour)
+    "qos2_dedup_max": 4096,
     # v5
     "topic_alias_max_client": 0,
     "topic_alias_max_broker": 0,

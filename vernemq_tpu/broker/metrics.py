@@ -237,6 +237,29 @@ COUNTERS: List[Tuple[str, str]] = [
     ("aggregate_window_overflow",
      "Aggregation subscriptions served raw per-message delivery "
      "because the window table hit aggregate_max_windows."),
+    # QoS2 exactly-once dedup bound (broker/session.py awaiting_rel):
+    # the per-session pid-window is capped at qos2_dedup_max — a
+    # slow-release storm evicts oldest-first instead of growing the
+    # dict unboundedly (groundwork for the native bitmap in ROADMAP)
+    ("qos2_dedup_evictions",
+     "QoS2 awaiting-release pids evicted oldest-first because a "
+     "session's dedup window hit qos2_dedup_max; an evicted pid's DUP "
+     "retransmission re-routes (at-least-once degradation, counted)."),
+    # live handoff (cluster/handoff.py): the freeze→drain→fence→adopt
+    # state machine moving mesh slices and sessions between nodes
+    ("handoff_started",
+     "Live handoffs admitted (freeze phase entered) for mesh slices "
+     "and session migrations."),
+    ("handoff_completed",
+     "Live handoffs that reached adopt: the successor owns the unit "
+     "and replayed exactly-once; zero QoS>=1 loss."),
+    ("handoff_rollbacks",
+     "Live handoffs rolled back at a phase failure or watchdog "
+     "deadline — the unit un-froze and the old owner kept serving."),
+    ("handoff_fenced_writes",
+     "Late writes caught by a handoff fence: stale lower-epoch mesh "
+     "slice claims rejected, plus post-fence queue arrivals swept to "
+     "the new owner instead of landing locally."),
 ]
 
 

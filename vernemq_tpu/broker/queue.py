@@ -174,6 +174,7 @@ class SubscriberQueue:
         Enqueues arriving mid-drain are queued (drain({enqueue,..})
         inserts, vmq_queue.erl:383-390) and picked up by
         :meth:`drain_pending` — never dropped."""
+        prev_state = self.state
         self.state = DRAIN
         self._cancel_expiry()
         if self._resuming:
@@ -186,13 +187,21 @@ class SubscriberQueue:
             # drained list keeps per-subscriber order (MQTT-4.6.0)
             self._resuming = False
             buf, self._resume_buf = self._resume_buf, deque()
-            self.broker.recover_offline(self.subscriber_id, self)
+            try:
+                self.broker.recover_offline(self.subscriber_id, self)
+            except Exception:
+                self._drain_read_failed(prev_state, buf)
+                raise
             self.offline.extend(buf)
         if self.offline_in_store:
             # a lazily-booted queue drains its STORED backlog too: load
             # it synchronously (migration correctness beats boot speed)
             self.offline_in_store = False
-            self.broker.recover_offline(self.subscriber_id, self)
+            try:
+                self.broker.recover_offline(self.subscriber_id, self)
+            except Exception:
+                self._drain_read_failed(prev_state)
+                raise
         backlog = list(self.backlog)
         self.backlog.clear()
         backlog += list(self._resume_buf)
@@ -201,6 +210,22 @@ class SubscriberQueue:
         self.offline.clear()
         return [m for m in backlog
                 if m.expires_at is None or m.expires_at >= time.monotonic()]
+
+    def _drain_read_failed(self, prev_state: str,
+                           parked: Optional[Deque[Msg]] = None) -> None:
+        """A drain could not load the stored backlog: leave the queue
+        exactly as it was — state restored, parked live publishes back
+        in the offline deque, the stored backlog STILL marked in-store
+        (nothing read, so nothing may be deleted) — and let the raised
+        error fail the migration, which retries or retargets. Zero
+        loss: the store keeps every message the read could not serve."""
+        if parked:
+            self.offline.extend(parked)
+        self.offline_in_store = True
+        self.broker.metrics.incr("msg_store_read_errors")
+        self.state = prev_state
+        if prev_state == OFFLINE:
+            self._arm_expiry()
 
     def drain_pending(self) -> List[Msg]:
         """Messages that raced into the queue after start_drain — the

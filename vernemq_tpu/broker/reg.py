@@ -46,6 +46,17 @@ if TYPE_CHECKING:
 log = logging.getLogger("vernemq_tpu.reg")
 
 
+def _varint_len(n: int) -> int:
+    """Bytes of an MQTT variable-length integer encoding ``n``."""
+    if n < 128:
+        return 1
+    if n < 16_384:
+        return 2
+    if n < 2_097_152:
+        return 3
+    return 4
+
+
 class RetainedMsg:
     """Stored retained message (#retain_msg{}, vmq_reg.erl:281-287)."""
 
@@ -942,6 +953,7 @@ class Registry:
         upgrade = cfg.upgrade_outgoing_qos
         recips: List[Tuple[Any, int]] = []
         fast = True
+        frame_bound = 0
         for _f, key, opts in rows:
             if not (isinstance(key, tuple) and len(key) == 2):
                 fast = False  # $g group row or remote node pointer
@@ -968,8 +980,24 @@ class Registry:
                 break
             if getattr(sess, "proto_ver", 0) == PROTO_5:
                 ok5 = getattr(sess, "wire_v5_fast_ok", None)
-                if ok5 is None or not ok5():
-                    fast = False  # packet-size cap needs per-frame plan
+                if ok5 is None:
+                    fast = False
+                    break
+                if frame_bound == 0:
+                    # conservative worst-case v5 frame size, computed
+                    # once per fanout: full topic (no alias), pid,
+                    # prop-len byte, and a 3-byte topic-alias property
+                    # — every batch-encoded variant is <= this, so a
+                    # cap check against it can never pass an oversize
+                    # frame (MQTT-3.1.2-24: exceeding the client's
+                    # maximum_packet_size is a protocol error)
+                    plen = (len(payload) if payload is not None
+                            else len(wire_frame) - payload_skip)
+                    body = 2 + len(topic_str.encode("utf-8")) \
+                        + 2 + 1 + 3 + plen
+                    frame_bound = 1 + _varint_len(body) + body
+                if not ok5(frame_bound):
+                    fast = False  # frame may exceed the session's cap
                     break
             recips.append((sess, min(opts.qos, qos)))
         if fast:
@@ -1211,14 +1239,21 @@ class Registry:
             self.broker.recorder.finish(trace)
         return n
 
-    def enqueue_remote(self, sid: SubscriberId, msgs: List[Msg]) -> bool:
+    def enqueue_remote(self, sid: SubscriberId, msgs: List[Msg],
+                       migrate: bool = False) -> bool:
         """Entry for ``enq`` frames (remote shared-sub delivery and queue
         migration drain): enqueue into the local queue
-        (vmq_cluster_com.erl:160-196)."""
+        (vmq_cluster_com.erl:160-196). With ``migrate`` the sender is
+        the record owner running a coordinated handoff: the drain lands
+        BEFORE the fence repoints the record, so accept the queue even
+        though the record still names the old owner."""
         queue = self.queues.get(sid)
         if queue is None:
             rec = self.db.read(sid)
-            if rec is None or rec.node != self.node_name:
+            if rec is None:
+                return False
+            if rec.node != self.node_name and not (
+                    migrate and not rec.clean_session):
                 return False
             queue = self._start_queue(sid, QueueOpts(
                 clean_session=rec.clean_session))

@@ -72,6 +72,17 @@ FLAT_ALIASES.update({
 })
 FLAT_ALIASES["watchdog.cluster_stall_timeout_s"] = "cluster_stall_timeout_s"
 
+#: extension family: the live-handoff state machine
+#: (cluster/handoff.py) — freeze→drain→fence→adopt elastic
+#: rebalancing; same dotted-tree spelling discipline as overload.*
+FLAT_ALIASES.update({
+    f"handoff.{k[len('handoff_'):]}": k
+    for k in (
+        "handoff_freeze_deadline_ms", "handoff_drain_deadline_s",
+    )
+})
+FLAT_ALIASES["mqtt5.qos2_dedup_max"] = "qos2_dedup_max"
+
 #: extension family: the multi-process session front end
 #: (broker/workers.py / broker/match_service.py). The plumbing knobs
 #: (ring/stats segment names, worker index) are set by the WorkerGroup

@@ -632,7 +632,7 @@ class Session:
                     self.send(Puback(packet_id=f.packet_id))
                 elif f.qos == 2 and f.packet_id:
                     self.send(Pubrec(packet_id=f.packet_id))
-                    self.awaiting_rel[f.packet_id] = time.monotonic()
+                    self._qos2_hold(f.packet_id)
             return
         payload = f.payload
         if mods:
@@ -676,7 +676,7 @@ class Session:
             self.broker.metrics.incr("mqtt_puback_sent")
         else:  # qos 2: route on first arrival, dedup until PUBREL
             if f.packet_id not in self.awaiting_rel:
-                self.awaiting_rel[f.packet_id] = time.monotonic()
+                self._qos2_hold(f.packet_id)
                 n = await self._route(msg, trace=trace)
                 if n < 0:
                     # internal routing failure: forget the packet id so the
@@ -685,6 +685,25 @@ class Session:
                     return
             self.send(Pubrec(packet_id=f.packet_id))
             self.broker.metrics.incr("mqtt_pubrec_sent")
+
+    def _qos2_hold(self, pid: int) -> None:
+        """Park ``pid`` in the QoS2 dedup window (awaiting PUBREL),
+        bounded at qos2_dedup_max: a client that never releases must
+        not grow the dict without limit, so the OLDEST held pid is
+        evicted (insertion order = arrival order) and counted. An
+        evicted pid's DUP retransmission re-routes — the documented
+        at-least-once degradation at window overflow."""
+        rel = self.awaiting_rel
+        if pid in rel:
+            rel[pid] = time.monotonic()
+            return
+        cap = int(self.broker.config.get("qos2_dedup_max", 4096))
+        if cap > 0:
+            m = self.broker.metrics
+            while len(rel) >= cap:
+                rel.pop(next(iter(rel)))
+                m.incr("qos2_dedup_evictions")
+        rel[pid] = time.monotonic()
 
     # ------------------------------------------------- wire fast path
 
@@ -866,7 +885,7 @@ class Session:
             return True
         payload = bytes(buf[p_off:f_end])
         if qos == 2:
-            self.awaiting_rel[pid] = time.monotonic()
+            self._qos2_hold(pid)
         try:
             matches = b.registry.publish_wire(
                 self.mountpoint, words, topic_str, payload, self.sid,
@@ -974,11 +993,19 @@ class Session:
                                   msg, time.monotonic(), False]
         return pid
 
-    def wire_v5_fast_ok(self) -> bool:
-        """May this v5 session take wire-plane fast delivery? A client
-        maximum_packet_size forces per-frame measurement
-        (_plan_v5_delivery) and keeps the exact classic path."""
-        return not self.max_packet_out
+    def wire_v5_fast_ok(self, frame_bound: int = 0) -> bool:
+        """May this v5 session take wire-plane fast delivery? Capless
+        sessions always can. A client maximum_packet_size admits the
+        fast path only when the fanout's conservative worst-case frame
+        bound (full topic, pid, alias property — computed once in
+        ``_wire_route``) fits under the cap: every batch-encoded
+        variant is smaller, so an admitted frame can never violate
+        MQTT-3.1.2-24. An unknown bound (0) keeps the exact classic
+        per-frame measurement (_plan_v5_delivery)."""
+        cap = self.max_packet_out
+        if not cap:
+            return True
+        return 0 < frame_bound <= cap
 
     def wire_alias_for(self, words: Tuple[str, ...]) -> int:
         """Outbound topic-alias decision for one wire-plane delivery,

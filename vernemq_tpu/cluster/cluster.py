@@ -787,7 +787,8 @@ class Cluster:
             self.spool.ack(origin, seq)
 
     async def remote_enqueue(self, node: str, sid, msgs: List[Any],
-                             timeout: Optional[float] = None) -> bool:
+                             timeout: Optional[float] = None,
+                             migrate: bool = False) -> bool:
         """Acked remote enqueue with backpressure — the migration/drain path
         (vmq_cluster:remote_enqueue/3, blocking with timeout
         vmq_cluster_node.erl:67-83). Default timeout comes from the
@@ -809,7 +810,7 @@ class Cluster:
         try:
             if not w.send_frame(frame(b"enq", (ref_id, list(sid),
                                                [msg_to_term(m) for m in msgs],
-                                               True))):
+                                               True, migrate))):
                 raise ConnectionError(f"channel buffer to {node} full")
             return await asyncio.wait_for(fut, timeout)
         finally:

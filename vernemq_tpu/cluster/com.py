@@ -148,13 +148,16 @@ class ClusterCom:
             # up to seq (contiguously) — delete them from our journal
             cluster.resolve_spool_ack(origin, int(term))
         elif cmd == b"enq":
-            ref_id, sid, msgs, want_ack = term
+            ref_id, sid, msgs, want_ack = term[:4]
+            # 5th element (optional): coordinated-handoff drain — the
+            # sender is the record owner shipping ahead of the fence
+            migrate = bool(term[4]) if len(term) > 4 else False
             sid = (sid[0], sid[1])
             # enqueue off the channel path (the reference spawns,
             # vmq_cluster_com.erl:160-166)
             async def _enq():
                 ok = cluster.broker.registry.enqueue_remote(
-                    sid, [term_to_msg(m) for m in msgs])
+                    sid, [term_to_msg(m) for m in msgs], migrate=migrate)
                 if want_ack:
                     cluster.send_ack(origin, ref_id, ok)
 

@@ -55,7 +55,8 @@ def parse_mesh_spec(spec: str) -> Optional[Tuple[int, int]]:
 
 class MeshSliceMap:
     def __init__(self, metadata, node_name: str, n_slices: int,
-                 on_adopt: Optional[Callable[[List[int], int], None]] = None):
+                 on_adopt: Optional[Callable[[List[int], int], None]] = None,
+                 metrics: Optional[Any] = None):
         self.metadata = metadata
         self.node_name = node_name
         self.n_slices = int(n_slices)
@@ -63,13 +64,54 @@ class MeshSliceMap:
         #: or a gossiped change hands this node new slices; the token
         #: is the adopt-replay exactly-once key (claimer node + epoch)
         self.on_adopt = on_adopt
+        self.metrics = metrics
         # wall-clock-seeded so a node's epochs stay monotonic ACROSS
         # boots: the adopt-replay guard keys on (claimer, epoch), and a
         # boot-reset counter could repeat an old epoch and silently
         # suppress a replay the re-adopted slice needs
         self._epoch = int(time.time())
         self.adoptions = 0
+        # live-handoff state (cluster/handoff.py): frozen slices are
+        # mid-move — the handoff FSM owns their records, so claim
+        # passes must not race it. A fence entry (slice -> epoch)
+        # makes this OLD owner reject any write for the slice at or
+        # below the fenced epoch: a stale claim gossiped after the
+        # transfer cannot re-adopt the slice here.
+        self._frozen: set = set()
+        self._fenced: Dict[int, int] = {}
+        self.fenced_rejects = 0
         metadata.subscribe(PREFIX, self._on_change)
+
+    # -------------------------------------------------------------- handoff
+
+    def freeze(self, slice_id: int) -> None:
+        """Pin one slice for a live handoff: claim passes skip it until
+        :meth:`unfreeze` (the FSM owns its record mid-move)."""
+        self._frozen.add(int(slice_id))
+
+    def unfreeze(self, slice_id: int) -> None:
+        self._frozen.discard(int(slice_id))
+
+    def transfer_local(self, slice_id: int, to_node: str) -> int:
+        """The handoff FENCE: write the epoch-bumped ownership record
+        handing ``slice_id`` to ``to_node`` and arm the local fence at
+        that epoch. The gossiped change IS the successor's adopt
+        trigger (:meth:`_on_change` fires its ``on_adopt`` with the
+        ``(origin, epoch)`` exactly-once token). ``pinned`` marks an
+        explicit transfer: claim passes honour it while the new owner
+        lives instead of round-robin-reclaiming the slice. Returns the
+        fencing epoch."""
+        s = int(slice_id)
+        cur = self.metadata.get(PREFIX, s)
+        if cur is None or cur.get("node") != self.node_name:
+            raise RuntimeError(
+                f"cannot transfer slice {s}: owned by "
+                f"{cur.get('node') if cur else None!r}, not this node")
+        self._epoch += 1
+        self._fenced[s] = self._epoch
+        self.metadata.put(PREFIX, s, {
+            "node": to_node, "epoch": self._epoch, "pinned": True})
+        return self._epoch
 
     # ---------------------------------------------------------------- claims
 
@@ -85,8 +127,19 @@ class MeshSliceMap:
             target = members[s % len(members)]
             if target != self.node_name:
                 continue
+            if s in self._frozen:
+                # mid-handoff: the FSM owns this record until adopt
+                # or rollback — a concurrent claim would race the fence
+                continue
             cur = self.metadata.get(PREFIX, s)
             if cur is not None and cur.get("node") == self.node_name:
+                continue
+            if (cur is not None and cur.get("pinned")
+                    and cur.get("node") in members):
+                # an explicit handoff/rebalance placed this slice and
+                # its owner still lives: honour the operator's move —
+                # the slice is reclaimed round-robin only once the
+                # pinned owner leaves the membership
                 continue
             self._epoch += 1
             self.metadata.put(PREFIX, s, {
@@ -129,6 +182,25 @@ class MeshSliceMap:
         the same adopt hook; everything else is bookkeeping only."""
         if origin == self.node_name or new is None:
             return
+        if new.get("node") == self.node_name:
+            fe = self._fenced.get(int(key))
+            if fe is not None:
+                if new.get("pinned") and int(new.get("epoch", 0)) > fe:
+                    # an explicit transfer BACK to this node at a newer
+                    # epoch lifts the fence — the adopt below proceeds
+                    self._fenced.pop(int(key), None)
+                else:
+                    # late write at or below the fenced epoch: a stale
+                    # claim gossiped after this node handed the slice
+                    # away. Reject — we no longer serve it.
+                    self.fenced_rejects += 1
+                    if self.metrics is not None:
+                        self.metrics.incr("handoff_fenced_writes")
+                    log.warning(
+                        "fenced stale claim for slice %s from %s "
+                        "(epoch %s <= fence %s): rejected", key,
+                        origin, new.get("epoch", 0), fe)
+                    return
         if (new.get("node") == self.node_name
                 and (old is None or old.get("node") != self.node_name)
                 and self.on_adopt is not None):

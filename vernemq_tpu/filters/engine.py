@@ -976,6 +976,34 @@ class FilterEngine:
             self._tick_handle = self._loop.call_later(self.tick_s,
                                                       self._tick)
 
+    def flush_windows(self, force: bool = True) -> int:
+        """Close accumulating windows NOW and emit their partial
+        aggregates — the drain-node seam (cluster/handoff.py): a node
+        about to evacuate must not let minutes of half-filled window
+        state die with the process. ``force=True`` (the default) emits
+        every non-empty window; ``force=False`` only the ones already
+        past deadline (a tick the caller did not want to wait for).
+        Returns the number of windows emitted."""
+        emissions: List[Tuple[_WinMeta, np.ndarray]] = []
+        now = time.monotonic()
+        with self._lock:
+            win = self._win
+            for key, slot in list(win.slot_of.items()):
+                meta = win.meta[slot]
+                if meta is None:
+                    continue
+                due = (meta.deadline is not None and now >= meta.deadline)
+                if not (force or due):
+                    continue
+                if win.acc[slot][0] > 0:
+                    emissions.append((meta, win.acc[slot].copy()))
+                    win.reset_slot(slot, now)
+        if emissions:
+            self.windows_closed += len(emissions)
+            self._m("aggregate_windows_closed", len(emissions))
+            self._emit_all(emissions)
+        return len(emissions)
+
     def close(self) -> None:
         self._closed = True
         if self._tick_handle is not None:

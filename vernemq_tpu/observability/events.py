@@ -125,6 +125,26 @@ KNOWN_EVENTS: Dict[str, Tuple[str, str]] = {
         "observability/canary",
         "A canary probe's end-to-end latency exceeded canary_slo_ms "
         "(value is the measured e2e in ms)."),
+    "handoff_start": (
+        "cluster/handoff",
+        "A live handoff entered its freeze phase (detail is "
+        "kind:unit->target); the moving unit parks new arrivals until "
+        "adopt or rollback."),
+    "handoff_fence": (
+        "cluster/handoff",
+        "A handoff fenced the old owner: the epoch-bumped ownership "
+        "record landed in the metadata plane and late writes at the "
+        "old epoch are rejected/forwarded (detail is kind:unit)."),
+    "handoff_complete": (
+        "cluster/handoff",
+        "A handoff finished its adopt phase — the successor owns the "
+        "unit and replayed exactly-once (value is the freeze-to-adopt "
+        "pause in ms)."),
+    "handoff_rollback": (
+        "cluster/handoff",
+        "A handoff phase failed or overran its deadline and was rolled "
+        "back — the unit un-froze and the OLD owner keeps serving "
+        "(detail names the phase and cause)."),
 }
 
 #: stable code order for the fixed-width shm packing (index = wire id)

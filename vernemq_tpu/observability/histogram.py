@@ -116,6 +116,16 @@ STAGE_FAMILIES: List[Tuple[str, str]] = [
      "-> route -> queue delivery), the broker's continuous black-box "
      "signal (observability/canary.py; canary_slo_ms breaches burn "
      "the canary_slo_breaches counter)."),
+    ("stage_handoff_drain_ms",
+     "Live-handoff drain-phase latency: flushing the moving unit's "
+     "in-flight state (QoS>=1 backlog chunks over acked enq batches, "
+     "or pending mesh slice deltas) to the successor, observed per "
+     "handoff (cluster/handoff.py; informs handoff_drain_deadline_s)."),
+    ("stage_handoff_pause_ms",
+     "Live-handoff freeze-to-adopt pause: the window during which the "
+     "moving unit parks new arrivals, observed per completed handoff "
+     "(the bounded-pause guarantee; informs "
+     "handoff_freeze_deadline_ms and bench config 15's pause p99)."),
 ]
 
 _ENABLED = True

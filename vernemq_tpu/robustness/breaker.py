@@ -40,7 +40,8 @@ _NAMES = {CLOSED: "closed", HALF_OPEN: "half_open", OPEN: "open"}
 #: registers here FIRST — the ``fault-registry`` vmqlint pass proves
 #: the admin rows and the trip/reset filter both match this set
 #: exactly, so a path can't ship un-drillable.
-BREAKER_PATHS = ("match", "retained", "predicate", "wire", "store")
+BREAKER_PATHS = ("match", "retained", "predicate", "wire", "store",
+                 "handoff")
 
 
 class CircuitBreaker:

@@ -66,6 +66,11 @@ KNOWN_POINTS: Dict[str, str] = {
         "inbound cluster data-plane frames (cluster/com.py)",
     "cluster.spool":
         "delivery-spool journal writes (cluster/spool.py)",
+    "cluster.handoff":
+        "live-handoff phase entries (cluster/handoff.py): every "
+        "freeze/drain/fence/adopt phase of a mesh-slice or session "
+        "handoff passes this seam — a wedge here drills the "
+        "per-phase watchdog rollback (old owner keeps serving)",
     "store.write":
         "message-store writes (storage/msg_store.py)",
     "store.compact":
