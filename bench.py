@@ -2625,6 +2625,276 @@ def config15_elastic_storm(smoke, seed=31):
     return asyncio.run(run())
 
 
+def config16_membership_churn_storm(smoke, seed=31):
+    """Robustness config: membership churn storm (ISSUE 20).
+
+    Three clustered brokers with the health plane tuned hot. A fleet
+    of persistent QoS1 sessions is homed on a victim node; another
+    fleet homed on a survivor takes continuous publish load. Three
+    phases:
+
+    1. **Kill** — the victim's links are severed (crash semantics, no
+       leave). The accrual detector must declare it down and the
+       quorum-gated planner auto-evacuates its sessions to the
+       least-loaded survivors. Measures detection latency
+       (kill -> member_down) and evacuation pause (down -> every
+       record rewritten). Post-evacuation publishes to the victim
+       fleet must be deliverable (memory-store loss physics: only
+       payloads published after adoption count toward the audit).
+    2. **Flap** — the victim is revived, then isolated/healed in
+       cycles. The hysteresis + per-peer cooldown rails must hold the
+       planner to the single phase-1 cycle: evacuated records never
+       bounce back (ping-pong count 0).
+    3. **Quorum drill** — one survivor is fully isolated: its planner
+       sees every peer down but must refuse to act (no majority
+       visibility), counted by handoff_auto_skipped_no_quorum.
+
+    Ends with the zero-loss audit: every fleet session reconnects at
+    its record owner and must replay every counted payload (dupes
+    allowed — at-least-once; loss never)."""
+    import asyncio
+    import time as _time
+
+    async def run():
+        from vernemq_tpu.broker.config import Config
+        from vernemq_tpu.broker.server import start_broker
+        from vernemq_tpu.client import MQTTClient
+        from vernemq_tpu.cluster import Cluster
+        from vernemq_tpu.cluster.health import ALIVE, DOWN
+
+        n_victim = 4 if smoke else 16
+        n_keep = 4 if smoke else 16
+        n_flaps = 2 if smoke else 4
+        per_round = 3 if smoke else 6
+
+        cfg_kw = dict(
+            systree_enabled=False, allow_anonymous=True,
+            # debounce stays at the production default (1.5s): it is the
+            # correlated-failure confirmation window the phase-3 quorum
+            # drill depends on — an isolated node's two DOWN verdicts
+            # skew by up to the 1s ping phase and must land in ONE
+            # batch so the quorum gate sees them together
+            health_tick_ms=50, health_phi_down=1.0, health_hold_s=0.5,
+            rebalance_cooldown_s=60.0,
+            # survivors must keep serving mid-outage, and the reg-sync
+            # lock coordinator may hash onto the dead member
+            allow_register_during_netsplit=True,
+            allow_publish_during_netsplit=True,
+            allow_subscribe_during_netsplit=True,
+            coordinate_registrations=False)
+        nodes = []
+        for i in range(3):
+            broker, server = await start_broker(Config(**cfg_kw),
+                                                port=0,
+                                                node_name=f"node{i}")
+            broker.node_name = broker.metadata.node_name = f"node{i}"
+            broker.registry.node_name = f"node{i}"
+            broker.registry.db.node_name = f"node{i}"
+            cluster = Cluster(broker, "127.0.0.1", 0)
+            await cluster.start()
+            nodes.append((broker, server, cluster))
+        a, b, c = nodes
+        for n in (b, c):
+            n[2].join(a[2].listen_host, a[2].listen_port)
+        while not all(len(x[2].members()) == 3 and x[2].is_ready()
+                      for x in nodes):
+            await asyncio.sleep(0.02)
+
+        async def wait_for(pred, timeout=30.0):
+            deadline = _time.perf_counter() + timeout
+            while _time.perf_counter() < deadline:
+                if pred():
+                    return True
+                await asyncio.sleep(0.02)
+            raise RuntimeError(f"churn-storm wait timed out: {pred}")
+
+        def sever(x, y):
+            for s, d in ((x, y), (y, x)):
+                w = s[2]._writers.get(d[0].node_name)
+                if w is None:
+                    continue
+                if not hasattr(w, "_real_addr"):
+                    w._real_addr = w.addr
+                w.addr = ("127.0.0.1", 9)  # discard: connect refused
+                if w._writer is not None:
+                    w._writer.close()
+
+        def mend(x, y):
+            for s, d in ((x, y), (y, x)):
+                w = s[2]._writers.get(d[0].node_name)
+                if w is not None:
+                    w.addr = getattr(w, "_real_addr", w.addr)
+
+        # let the formation-time join cycles settle, then clear the
+        # per-peer cooldown windows so phase 1 starts from quiet
+        await wait_for(lambda: all(
+            len(x[2].planner._cooldown_until) >= 2 for x in nodes))
+        for x in nodes:
+            x[2].planner._cooldown_until.clear()
+        cycles0 = a[2].planner.cycles
+
+        # victim fleet homed on node2, survivor fleet on node0
+        for s in range(n_victim):
+            cl = MQTTClient("127.0.0.1", c[1].port, client_id=f"vs{s}",
+                            clean_start=False)
+            await cl.connect()
+            await cl.subscribe(f"vs/{s}/#", qos=1)
+            await cl.disconnect()
+        for s in range(n_keep):
+            cl = MQTTClient("127.0.0.1", a[1].port, client_id=f"ks{s}",
+                            clean_start=False)
+            await cl.connect()
+            await cl.subscribe(f"ks/{s}/#", qos=1)
+            await cl.disconnect()
+
+        pub = MQTTClient("127.0.0.1", b[1].port, client_id="cs-pub")
+        await pub.connect()
+        sent_keep = [set() for _ in range(n_keep)]
+        sent_victim = [set() for _ in range(n_victim)]
+        seq = 0
+
+        async def keep_round():
+            nonlocal seq
+            for s in range(n_keep):
+                payload = b"k%d" % seq
+                await pub.publish(f"ks/{s}/t", payload, qos=1)
+                sent_keep[s].add(payload)
+                seq += 1
+
+        async def victim_round():
+            nonlocal seq
+            for s in range(n_victim):
+                payload = b"v%d" % seq
+                await pub.publish(f"vs/{s}/t", payload, qos=1)
+                sent_victim[s].add(payload)
+                seq += 1
+
+        for _ in range(per_round):
+            await keep_round()
+
+        # ---- phase 1: kill the victim (no leave), auto-evacuate
+        vsids = [("", f"vs{s}") for s in range(n_victim)]
+        t_kill = _time.perf_counter()
+        sever(a, c)
+        sever(b, c)
+        await wait_for(
+            lambda: a[2].health.state_of("node2") == DOWN)
+        detect_s = _time.perf_counter() - t_kill
+        t_down = _time.perf_counter()
+        for x in (a, b):  # survivors converge on the rewritten records
+            await wait_for(lambda x=x: all(
+                (r := x[0].registry.db.read(sid)) is not None
+                and r.node in ("node0", "node1") for sid in vsids))
+        evacuate_s = _time.perf_counter() - t_down
+        evacuated = a[0].metrics.value("handoff_auto_evacuations")
+        for _ in range(per_round):  # post-adoption: these must survive
+            await victim_round()
+            await keep_round()
+
+        # ---- phase 2: revive, then flap — evacuated records must not
+        # ping-pong back to the flapper
+        owners = {sid: a[0].registry.db.read(sid).node for sid in vsids}
+        ping_pong = 0
+        mend(a, c)
+        mend(b, c)
+        await wait_for(
+            lambda: a[2].health.state_of("node2") == ALIVE)
+        for _ in range(n_flaps):
+            sever(a, c)
+            sever(b, c)
+            await wait_for(
+                lambda: a[2].health.state_of("node2") == DOWN)
+            await keep_round()
+            mend(a, c)
+            mend(b, c)
+            await wait_for(
+                lambda: a[2].health.state_of("node2") == ALIVE)
+            for sid in vsids:
+                now_node = a[0].registry.db.read(sid).node
+                if now_node != owners[sid]:
+                    ping_pong += 1
+                    owners[sid] = now_node
+        await victim_round()
+        cycles = a[2].planner.cycles - cycles0
+        suppressed = a[0].metrics.value("handoff_auto_suppressed")
+
+        # ---- zero-loss audit at the record owners (before the quorum
+        # drill: the majority side legitimately evacuates the isolated
+        # node's sessions there, which rewrites the keep-fleet records
+        # away from where their backlogs physically live)
+        by_name = {"node0": a, "node1": b, "node2": c}
+        missing = dupes = received = 0
+
+        async def replay(client_id, sid, want):
+            nonlocal missing, dupes, received
+            owner = by_name[a[0].registry.db.read(sid).node]
+            cl = MQTTClient("127.0.0.1", owner[1].port,
+                            client_id=client_id, clean_start=False)
+            await cl.connect()
+            got = {}
+            deadline = _time.perf_counter() + 20
+            while (set(got) < want
+                   and _time.perf_counter() < deadline):
+                try:
+                    m = await cl.recv(2)
+                except asyncio.TimeoutError:
+                    break
+                got[m.payload] = got.get(m.payload, 0) + 1
+            await cl.disconnect()
+            received += len(got)
+            missing += len(want - set(got))
+            dupes += sum(n - 1 for n in got.values())
+
+        for s in range(n_keep):
+            await replay(f"ks{s}", ("", f"ks{s}"), set(sent_keep[s]))
+        for s in range(n_victim):
+            await replay(f"vs{s}", ("", f"vs{s}"), set(sent_victim[s]))
+
+        # ---- phase 3: quorum drill — an isolated minority must refuse
+        sever(a, b)
+        sever(a, c)
+        await wait_for(lambda: a[0].metrics.value(
+            "handoff_auto_skipped_no_quorum") >= 1)
+        minority_acted = (a[2].planner.cycles - cycles0) > cycles
+        mend(a, b)
+        mend(a, c)
+        await wait_for(lambda: all(
+            a[2].health.state_of(n) == ALIVE
+            for n in ("node1", "node2")))
+
+        await pub.disconnect()
+        for broker, server, cluster in nodes:
+            await cluster.stop()
+            await broker.stop()
+            await server.stop()
+
+        published = (sum(len(x) for x in sent_keep)
+                     + sum(len(x) for x in sent_victim))
+        return {
+            "victim_sessions": n_victim,
+            "keep_sessions": n_keep,
+            "flaps": n_flaps,
+            "detect_s": round(detect_s, 3),
+            "evacuate_pause_s": round(evacuate_s, 3),
+            "evacuated": evacuated,
+            "planner_cycles": cycles,
+            "suppressed_cycles": suppressed,
+            "ping_pong": ping_pong,
+            "quorum_refusals": a[0].metrics.value(
+                "handoff_auto_skipped_no_quorum"),
+            "minority_acted": minority_acted,
+            "published": published,
+            "received": received,
+            "missing": missing,
+            "duplicates": dupes,
+            "parity_ok": (missing == 0 and ping_pong == 0
+                          and evacuated >= n_victim
+                          and not minority_acted),
+        }
+
+    return asyncio.run(run())
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--subs", type=int, default=1_000_000)
@@ -2650,7 +2920,8 @@ def main() -> int:
     ap.add_argument("--reconnect-sessions", type=int, default=0,
                     help="config 14 session count override (default: "
                          "100k, 20k on CPU smoke)")
-    ap.add_argument("--configs", default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15",
+    ap.add_argument("--configs",
+                    default="1,2,3,4,5,6,7,8,9,10,11,12,13,14,15,16",
                     help="which BASELINE configs to run (3 = headline; "
                     "6 = fault-storm robustness: publish p99 while the "
                     "device path is down + breaker recovery time; "
@@ -2668,7 +2939,11 @@ def main() -> int:
                     "12 = mesh ladder: mesh-native matcher at 1/2/4 "
                     "forced-host-device slices — per-slice rows, "
                     "delta-routing hit rate, parity vs the "
-                    "single-process sharded oracle)")
+                    "single-process sharded oracle; "
+                    "16 = membership churn storm: kill/flap/quorum "
+                    "drills against the accrual detector + auto-"
+                    "rebalance — detection latency, evacuation pause, "
+                    "ping-pong count, zero-loss audit)")
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
     ap.add_argument("--kernel-only", action="store_true",
@@ -2969,6 +3244,10 @@ def main() -> int:
     if "15" in want:
         guarded("15_elastic_storm",
                 lambda: config15_elastic_storm(smoke, args.seed))
+
+    if "16" in want:
+        guarded("16_membership_churn_storm",
+                lambda: config16_membership_churn_storm(smoke, args.seed))
 
     if headline is not None:
         value = headline["matches_per_sec"]

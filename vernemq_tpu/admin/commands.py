@@ -144,6 +144,10 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "vmq-admin cluster fix-dead-queues [targets=n1,n2]")
     reg.register(["cluster", "migrations"], _cluster_migrations,
                  "vmq-admin cluster migrations")
+    reg.register(["cluster", "health"], _cluster_health,
+                 "vmq-admin cluster health  (per-peer failure-detector "
+                 "verdict, suspicion phi, gossiped load score, "
+                 "last-heartbeat age, quorum)")
     reg.register(["cluster", "drain-node"], _cluster_drain_node,
                  "vmq-admin cluster drain-node [targets=n1,n2]  "
                  "(evacuate this node: flush filter windows, hand "
@@ -180,7 +184,8 @@ def register_core_commands(reg: CommandRegistry) -> CommandRegistry:
                  "[order_by=f1,f2] [--<field>...]")
     reg.register(["ql", "query"], _ql_query,
                  "vmq-admin ql query q='SELECT f FROM sessions|queues|"
-                 "subscriptions|messages|retain|retained_index|events "
+                 "subscriptions|messages|retain|retained_index|events|"
+                 "cluster_health "
                  "[WHERE ...] [ORDER BY f [DESC]] [LIMIT n]'")
     reg.register(["queue", "show"], _queue_show,
                  "vmq-admin queue show [--limit=N]")
@@ -360,7 +365,28 @@ def _cluster_show(broker, flags):
         counts = mm.counts_by_node()
         for r in rows:
             r["mesh_slices"] = counts.get(r["node"], 0)
+    health = getattr(broker.cluster, "health", None) \
+        if broker.cluster is not None else None
+    if health is not None:
+        # the failure detector's verdict, alongside the TCP-level
+        # "running" flag (`cluster health` has phi/load/age detail)
+        for r in rows:
+            r["health"] = health.state_of(r["node"])
     return {"table": rows}
+
+
+def _cluster_health(broker, flags):
+    """Per-peer accrual failure-detector state (cluster/health.py):
+    alive/suspect/down verdict, current suspicion phi, gossiped load
+    score and last-heartbeat age, plus the quorum verdict gating the
+    automatic rebalance planner."""
+    health = getattr(broker.cluster, "health", None) \
+        if broker.cluster is not None else None
+    if health is None:
+        raise CommandError("health plane not running (not clustered, "
+                           "or health_enabled=false)")
+    return {"table": health.status_rows(),
+            "quorum": health.quorum_ok()}
 
 
 def _mesh_show(broker, flags):

@@ -185,6 +185,20 @@ def event_rows(broker) -> Iterator[Dict[str, Any]]:
                "pid": e["pid"]}
 
 
+def cluster_health_rows(broker) -> Iterator[Dict[str, Any]]:
+    """Membership health plane (cluster/health.py): one row per member
+    with the failure detector's verdict, suspicion phi, gossiped load
+    score and last-heartbeat age — e.g. ``SELECT node, phi FROM
+    cluster_health WHERE state != 'alive'``."""
+    health = getattr(broker.cluster, "health", None) \
+        if getattr(broker, "cluster", None) is not None else None
+    if health is None:
+        return
+    quorum = health.quorum_ok()
+    for r in health.status_rows():
+        yield {**r, "quorum": quorum}
+
+
 TABLES: Dict[str, Callable[[Any], Iterator[Dict[str, Any]]]] = {
     "sessions": session_rows,
     "subscriptions": subscription_rows,
@@ -195,6 +209,7 @@ TABLES: Dict[str, Callable[[Any], Iterator[Dict[str, Any]]]] = {
     "payload_schemas": payload_schema_rows,
     "filter_windows": filter_window_rows,
     "events": event_rows,
+    "cluster_health": cluster_health_rows,
 }
 
 

@@ -665,6 +665,25 @@ class Broker:
             "aggregate_emissions_total": "Synthesized aggregate "
                                          "PUBLISHes emitted by closed "
                                          "windows.",
+            # membership health plane (cluster/health.py): detector
+            # verdicts + this node's gossiped load, published like the
+            # breaker/governor families
+            "cluster_health_suspect_peers": "Peers the accrual failure "
+                                            "detector currently marks "
+                                            "suspect.",
+            "cluster_health_down_peers": "Peers the accrual failure "
+                                         "detector currently declares "
+                                         "down.",
+            "cluster_health_quorum": "1 while this node sees a "
+                                     "majority of the joined "
+                                     "membership (automatic rebalance "
+                                     "admissible).",
+            "cluster_load_score": "This node's gossiped load score "
+                                  "(queue depth + loop-lag p99 + "
+                                  "governor pressure; order matters, "
+                                  "not units).",
+            "rebalance_cycles": "Automatic planner cycles that passed "
+                                "every safety rail and acted.",
         })
         from ..observability import events as _events
         from ..observability.canary import GAUGE_HELP as _canary_help
@@ -811,6 +830,20 @@ class Broker:
         out.update(self.watchdog.stats())
         out.update(self.recorder.stats())
         out.update(self._mesh_gauges())
+        health = getattr(self.cluster, "health", None)
+        if health is not None:
+            from ..cluster.health import DOWN, SUSPECT, local_load_score
+
+            states = [p.state for p in health.peers.values()]
+            out["cluster_health_suspect_peers"] = float(
+                states.count(SUSPECT))
+            out["cluster_health_down_peers"] = float(states.count(DOWN))
+            out["cluster_health_quorum"] = 1.0 if health.quorum_ok() \
+                else 0.0
+            out["cluster_load_score"] = local_load_score(self)
+            planner = getattr(self.cluster, "planner", None)
+            if planner is not None:
+                out["rebalance_cycles"] = float(planner.cycles)
         from ..parallel.shm_ring import fence_active
 
         out["shm_ring_fence"] = 1.0 if fence_active() else 0.0

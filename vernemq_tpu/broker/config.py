@@ -84,6 +84,51 @@ DEFAULTS: Dict[str, Any] = {
     # OLD owner keeps serving (degraded, never stuck).
     "handoff_freeze_deadline_ms": 500,
     "handoff_drain_deadline_s": 10.0,
+    # live v5 handoff: moved sessions get DISCONNECT 0x9D (Server
+    # moved, with the Server Reference property) after fence+adopt
+    # instead of a takeover kick — the client reconnects straight to
+    # the new owner. v3/4 sessions always keep the takeover path.
+    "handoff_v5_redirect": True,
+    # sessions per batched drain handoff: each batch bound for one
+    # target shares ONE fence write (store_many) instead of a
+    # per-session record rewrite
+    "handoff_batch_max_sessions": 64,
+    # membership health plane (cluster/health.py): phi-accrual failure
+    # detection over the existing cluster traffic. Every delivered
+    # inbound batch is a heartbeat (the 1s idle ping guarantees a
+    # floor); phi scores the current silence in units of the observed
+    # cadence — suspect at ~3.5 missed intervals, down at ~18. The
+    # exit_ratio/hold pair is the governor's flap-suppression
+    # hysteresis: re-entering alive needs phi below
+    # phi_suspect*exit_ratio for hold_s straight.
+    "health_enabled": True,
+    "health_tick_ms": 500,
+    "health_window": 64,
+    "health_phi_suspect": 1.5,
+    "health_phi_down": 8.0,
+    "health_exit_ratio": 0.5,
+    "health_hold_s": 3.0,
+    # automatic rebalance planner: fires on join/leave/down/alive,
+    # debounced. The debounce doubles as the correlated-failure
+    # confirmation window: when this node is being isolated, its links
+    # die together but the DOWN verdicts skew by up to the 1s idle-ping
+    # phase, so the window must exceed that cadence for both verdicts
+    # to land in one batch and the quorum gate to see them together.
+    # Per-peer cooldown is the anti-ping-pong rail (at most
+    # one cycle per peer per window); the quorum gate refuses automatic
+    # action while this node cannot see a membership majority (a
+    # netsplit minority sits still — CAP machinery owns partitions);
+    # max_concurrent caps in-flight handoffs node-wide (automation must
+    # not freeze half the node at once).
+    "rebalance_enabled": True,
+    "rebalance_require_quorum": True,
+    "rebalance_debounce_s": 1.5,
+    "rebalance_cooldown_s": 10.0,
+    "rebalance_max_concurrent": 4,
+    # client-facing address gossiped to peers (hlo/ping "caddr"): what
+    # a v5 server-redirect DISCONNECT hands out as the Server Reference
+    # for sessions moved HERE. Empty = peers fall back to the node name.
+    "cluster_advertised_address": "",
     # QoS2 exactly-once dedup bound: max awaiting-release pids held
     # per session before oldest-first eviction (qos2_dedup_evictions);
     # 0 = unbounded (the pre-cap behaviour)

@@ -260,6 +260,41 @@ COUNTERS: List[Tuple[str, str]] = [
      "Late writes caught by a handoff fence: stale lower-epoch mesh "
      "slice claims rejected, plus post-fence queue arrivals swept to "
      "the new owner instead of landing locally."),
+    ("handoff_batch_fence_writes",
+     "Shared fence writes issued by batched session handoffs — one "
+     "per (batch, target), amortizing the per-session record rewrite "
+     "a bulk drain used to pay."),
+    # membership health plane (cluster/health.py): accrual failure
+    # detector verdicts + the automatic rebalance planner's actions
+    # and refusals
+    ("member_suspect_transitions",
+     "Peers the accrual failure detector marked suspect (phi crossed "
+     "health_phi_suspect, or the outbound channel tore)."),
+    ("member_down_transitions",
+     "Peers the accrual failure detector declared down (phi crossed "
+     "health_phi_down); each verdict notes the rebalance planner."),
+    ("member_alive_transitions",
+     "Peers re-admitted to alive after sustaining low suspicion for "
+     "the full hysteresis hold (health_exit_ratio/health_hold_s)."),
+    ("handoff_auto_rebalances",
+     "Automatic slice-rebalance cycles the planner drove to the "
+     "handoff engine (join/alive membership changes)."),
+    ("handoff_auto_evacuations",
+     "Subscriber records auto-evacuated off a down member onto the "
+     "least-loaded survivors by the rebalance planner."),
+    ("handoff_auto_skipped_no_quorum",
+     "Planner cycles refused because this node could not see a "
+     "majority of the joined membership (netsplit minority sits "
+     "still)."),
+    ("handoff_auto_skipped_breaker",
+     "Planner cycles refused because the handoff circuit breaker was "
+     "open (repeated rollbacks; a probe must recover it first)."),
+    ("handoff_auto_suppressed",
+     "Planner cycles suppressed by the per-peer cooldown — the "
+     "anti-ping-pong rail for flapping members."),
+    ("handoff_auto_limited",
+     "Handoffs refused by the global concurrent-handoff limiter "
+     "(rebalance_max_concurrent already in flight)."),
 ]
 
 

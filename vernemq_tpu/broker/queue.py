@@ -227,6 +227,22 @@ class SubscriberQueue:
         if prev_state == OFFLINE:
             self._arm_expiry()
 
+    def restore_online(self, msgs: List[Msg]) -> None:
+        """Cancel a drain whose session is STILL ATTACHED (the MQTT5
+        redirect path keeps the connection up through the drain): the
+        handoff rolled back before the client was told anything, so
+        re-enter ONLINE and redeliver ``msgs`` — the restored backlog,
+        including chunks the target may have acked — locally. Chunks
+        the target kept surface as QoS1 dupes if a later handoff
+        succeeds; dupes beat loss."""
+        self.state = ONLINE
+        self._resuming = False
+        buf, self._resume_buf = self._resume_buf, deque()
+        for msg in msgs:
+            self._deliver_online(msg)
+        for msg in buf:
+            self._deliver_online(msg)
+
     def drain_pending(self) -> List[Msg]:
         """Messages that raced into the queue after start_drain — the
         migration driver keeps draining until this runs dry (the reference

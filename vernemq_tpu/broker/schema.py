@@ -79,9 +79,27 @@ FLAT_ALIASES.update({
     f"handoff.{k[len('handoff_'):]}": k
     for k in (
         "handoff_freeze_deadline_ms", "handoff_drain_deadline_s",
+        "handoff_v5_redirect", "handoff_batch_max_sessions",
     )
 })
 FLAT_ALIASES["mqtt5.qos2_dedup_max"] = "qos2_dedup_max"
+
+#: extension family: the membership health plane (cluster/health.py) —
+#: accrual failure detection + the automatic rebalance planner. The
+#: flat spellings keep their subsystem prefixes (health_*,
+#: rebalance_*); the dotted tree groups them under cluster.* with the
+#: other cluster knobs.
+FLAT_ALIASES.update({
+    f"cluster.{k}": k
+    for k in (
+        "health_enabled", "health_tick_ms", "health_window",
+        "health_phi_suspect", "health_phi_down", "health_exit_ratio",
+        "health_hold_s", "rebalance_enabled",
+        "rebalance_require_quorum", "rebalance_debounce_s",
+        "rebalance_cooldown_s", "rebalance_max_concurrent",
+    )
+})
+FLAT_ALIASES["cluster.advertised_address"] = "cluster_advertised_address"
 
 #: extension family: the multi-process session front end
 #: (broker/workers.py / broker/match_service.py). The plumbing knobs

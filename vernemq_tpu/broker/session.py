@@ -47,8 +47,10 @@ from ..protocol.types import (
     RC_NO_SUBSCRIPTION_EXISTED,
     RC_PACKET_ID_NOT_FOUND,
     RC_SERVER_BUSY,
+    RC_SERVER_MOVED,
     RC_SESSION_TAKEN_OVER,
     RC_SUCCESS,
+    RC_USE_ANOTHER_SERVER,
     RC_RECEIVE_MAX_EXCEEDED,
     RC_TOPIC_ALIAS_INVALID,
     RC_UNSPECIFIED_ERROR,
@@ -1573,6 +1575,46 @@ class Session:
             self.send(Disconnect(reason_code=RC_SERVER_BUSY))
             self._count_disconnect_sent(RC_SERVER_BUSY)
         await self.close("overload_shed")
+
+    async def redirect_close(self, server_reference: str = "") -> None:
+        """MQTT5 server redirect (live handoff): the session's state is
+        already fenced+adopted at another node, so tell the client
+        WHERE it went — DISCONNECT 0x9D (Server moved, permanent) with
+        the Server Reference property, or 0x9C (Use another server)
+        when no address is known — instead of a bare takeover kick that
+        makes it knock here again. v3/4 clients have no redirect frame
+        and never reach this path (the handoff keeps takeover_close
+        for them)."""
+        if self.proto_ver == PROTO_5:
+            if server_reference:
+                self.send(Disconnect(
+                    reason_code=RC_SERVER_MOVED,
+                    properties={"server_reference": server_reference}))
+                rc = RC_SERVER_MOVED
+            else:
+                self.send(Disconnect(reason_code=RC_USE_ANOTHER_SERVER))
+                rc = RC_USE_ANOTHER_SERVER
+            self._count_disconnect_sent(rc)
+        suppress = self.broker.config.suppress_lwt_on_session_takeover
+        await self.close("server_redirect", send_will=not suppress)
+
+    def detach_inflight(self) -> List[Any]:
+        """Strip this session's undelivered QoS>=1 state (unacked
+        in-flight + pending) WITHOUT closing it, oldest first — the
+        live-handoff drain ships these to the new owner while the
+        connection stays up, instead of close() parking them in the
+        local offline backlog the handoff is about to tear down.
+        Redelivery at the target beats loss, as with any QoS1 retry."""
+        out: List[Any] = []
+        for pid, (kind, msg, _, _) in sorted(self.waiting_acks.items()):
+            if kind in ("puback", "pubrec"):
+                out.append(msg)
+        for msg in self.pending:
+            if msg.qos > 0:
+                out.append(msg)
+        self.waiting_acks.clear()
+        self.pending.clear()
+        return out
 
     async def takeover_close(self) -> None:
         """Kicked by a newer session with the same client id."""

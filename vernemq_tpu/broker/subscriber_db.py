@@ -94,6 +94,22 @@ class SubscriberDB:
     def store(self, sid: SubscriberId, record: SubscriberRecord) -> None:
         self.metadata.put(PREFIX, tuple(sid), record.to_term())
 
+    def store_many(
+            self, pairs: Iterable[Tuple[SubscriberId,
+                                        SubscriberRecord]]) -> int:
+        """Store a batch of records as ONE logical write — the batched
+        handoff's shared fence. The metadata facade has no multi-key
+        primitive across its backends (LWW put vs SWC dotted puts), so
+        physically this loops ``put``; the batching contract lives one
+        level up: the caller bumps the fence counter and journals the
+        fence event ONCE per batch, not per record. Returns the number
+        of records stored."""
+        n = 0
+        for sid, record in pairs:
+            self.metadata.put(PREFIX, tuple(sid), record.to_term())
+            n += 1
+        return n
+
     def read(self, sid: SubscriberId) -> Optional[SubscriberRecord]:
         return SubscriberRecord.from_term(
             self.metadata.get(PREFIX, tuple(sid)))

@@ -109,6 +109,21 @@ class Cluster:
         from .reg_sync import RegSync
 
         self.reg_sync = RegSync(self)
+        # membership health plane: accrual failure detector + automatic
+        # rebalance planner (cluster/health.py). Gossip side-tables fed
+        # by hlo/png terms: the peer's advertised client address (what
+        # a v5 server-redirect DISCONNECT carries) and load score.
+        self._peer_caddr: Dict[str, str] = {}
+        self._advertised = str(
+            broker.config.get("cluster_advertised_address", "") or "")
+        self.health: Optional[Any] = None
+        self.planner: Optional[Any] = None
+        if broker.config.get("health_enabled", True):
+            from .health import HealthMonitor, RebalancePlanner
+
+            self.health = HealthMonitor(self)
+            self.planner = RebalancePlanner(self, self.health)
+            self.health.planner = self.planner
         self._com = ClusterCom(self)
         self.metadata.subscribe(MEMBERS, self._on_member_change)
         if hasattr(self.metadata, "attach_cluster"):  # SWC backend
@@ -153,8 +168,16 @@ class Cluster:
         if self.spool is not None:
             self._spool_task = asyncio.get_event_loop().create_task(
                 self._spool_retransmit_loop())
+        if self.health is not None:
+            self.health.start()
+        if self.planner is not None:
+            self.planner.start()
 
     async def stop(self) -> None:
+        if self.planner is not None:
+            self.planner.stop()
+        if self.health is not None:
+            self.health.stop()
         if hasattr(self.metadata, "stop_ae"):
             self.metadata.stop_ae()
         if self._spool_task is not None:
@@ -297,7 +320,14 @@ class Cluster:
             if rec is None:
                 self.broker.migrations.pop(sid, None)
                 continue
-            new_target = alive[0]
+            if self.health is not None:
+                # least-loaded surviving peer, not "next untried": the
+                # first-listed target would otherwise absorb every
+                # retargeted queue of a mid-drain node death
+                new_target = min(
+                    alive, key=lambda t: (self.health.load_of(t), t))
+            else:
+                new_target = alive[0]
             tried.append(new_target)
             rec.node = new_target
             reg.db.store(sid, rec)
@@ -374,11 +404,21 @@ class Cluster:
             # cap (old versions, observability off) get byte-identical
             # pre-trace framing — the field is never attached to them.
             caps.append("trace")
-        return {"node": self.node_name,
+        info = {"node": self.node_name,
                 "addr": [self.listen_host, self.listen_port],
                 "caps": caps,
                 "frames_dropped": sum(w.dropped_frames for w in writers),
                 "bytes_dropped": sum(w.dropped_bytes for w in writers)}
+        if self.health is not None:
+            # seed the peer's load table before the first idle ping, and
+            # advertise the CLIENT-facing address a v5 server-redirect
+            # DISCONNECT should hand out for sessions moved to us
+            from .health import local_load_score
+
+            info["load"] = local_load_score(self.broker)
+            if self._advertised:
+                info["caddr"] = self._advertised
+        return info
 
     def on_hello(self, origin: str, info: Dict[str, Any]) -> None:
         """First contact from a node we may not know yet (bootstrap join):
@@ -395,12 +435,54 @@ class Cluster:
             newly_spools = ("spool" in caps
                             and "spool" not in self._peer_caps.get(node, ()))
             self._peer_caps[node] = caps
+            if info.get("caddr"):
+                self._peer_caddr[node] = str(info["caddr"])
+            if self.health is not None:
+                self.health.heartbeat(node, load=info.get("load"))
             if newly_spools:
                 # bootstrap case: our channel came up before we knew the
                 # peer spools, so the channel-up replay was skipped. On a
                 # routine reconnect the capability is already known and
                 # the channel-up hook replays — don't send it all twice.
                 self._maybe_replay_spool(node)
+
+    def ping_term(self) -> Optional[Dict[str, Any]]:
+        """Term for the idle ``png`` frame: this node's gossiped load
+        score (+ advertised client address for v5 redirects). ``None``
+        when the health plane is off — byte-compatible with the
+        pre-health ping, and old receivers ignore the term anyway."""
+        if self.health is None:
+            return None
+        from .health import local_load_score
+
+        term: Dict[str, Any] = {"load": local_load_score(self.broker)}
+        if self._advertised:
+            term["caddr"] = self._advertised
+        return term
+
+    def on_ping(self, origin: str, term: Any) -> None:
+        """Inbound idle ping (com.py ``png``): refresh the peer's
+        gossiped load/address. Liveness itself was already credited by
+        on_peer_traffic for the enclosing batch."""
+        if not isinstance(term, dict):
+            return  # pre-health peer: bare ping
+        if term.get("caddr"):
+            self._peer_caddr[origin] = str(term["caddr"])
+        if self.health is not None and "load" in term:
+            self.health.heartbeat(origin, load=term.get("load"))
+
+    def on_peer_traffic(self, origin: str) -> None:
+        """Every delivered inbound batch from ``origin`` is a heartbeat
+        for the accrual failure detector."""
+        if self.health is not None:
+            self.health.heartbeat(origin)
+
+    def server_reference(self, node: str) -> str:
+        """What a v5 DISCONNECT 0x9C/0x9D Server Reference should carry
+        for a session moved to ``node``: the peer's advertised client
+        address when gossiped, else the node name (the operator's
+        naming scheme is often resolvable as-is)."""
+        return self._peer_caddr.get(node) or node
 
     def _sync_metadata_peers(self) -> None:
         """Keep the SWC replica groups' peer set in lock-step with cluster
@@ -432,6 +514,10 @@ class Cluster:
                 if b.addr == addr:
                     b.stop()
                     self._bootstrap.remove(b)
+            if old is None and self.planner is not None:
+                # a NEW member (not an addr refresh) reshapes the
+                # cluster: let the planner spread load onto it
+                self.planner.note(node, "join")
         else:  # left or tombstoned
             w = self._writers.pop(node, None)
             if w is not None:
@@ -447,7 +533,10 @@ class Cluster:
             st = self._spool_in.pop(node, None)
             if st is not None and st.timer is not None:
                 st.timer.cancel()
+            self._peer_caddr.pop(node, None)
             self.broker.registry.node_left(node)
+            if old is not None and self.planner is not None:
+                self.planner.note(node, "leave")
 
     # -------------------------------------------------------- channel status
 
@@ -458,6 +547,10 @@ class Cluster:
             return
         old = self._status.get(node)
         self._status[node] = status
+        if self.health is not None:
+            # a torn outbound channel sharpens the detector (immediate
+            # suspect); the phi clock owns the down verdict
+            self.health.on_channel(node, status)
         if self.plumtree is not None:
             if status == "up":
                 self.plumtree.peer_up(node)

@@ -91,6 +91,12 @@ class ClusterCom:
             writer.close()
 
     def _process(self, origin: str, blob: bytes) -> None:
+        # every delivered batch is a liveness proof for the failure
+        # detector — data-plane traffic keeps a busy peer alive without
+        # waiting for its idle ping (dropped batches, e.g. the
+        # cluster.recv fault seam, deliberately do NOT count: an
+        # isolated peer must look silent)
+        self.cluster.on_peer_traffic(origin)
         pos = 0
         while pos < len(blob):
             try:
@@ -245,7 +251,11 @@ class ClusterCom:
         elif cmd == b"hlo":
             cluster.on_hello(origin, term)
         elif cmd == b"png":
-            pass  # liveness ping
+            # liveness ping; a health-plane peer gossips its load score
+            # and advertised client address in the term (None from
+            # pre-health peers — the batch itself already counted as
+            # the heartbeat in _process)
+            cluster.on_ping(origin, term)
         else:
             log.warning("unknown cluster frame %r from %s", cmd, origin)
 

@@ -53,6 +53,7 @@ from ..observability import events
 from ..observability import histogram as hist
 from ..robustness import faults
 from ..robustness.breaker import CircuitBreaker
+from .health import assign_targets
 
 log = logging.getLogger("vernemq_tpu.handoff")
 
@@ -101,6 +102,7 @@ class HandoffManager:
         self.started = 0
         self.completed = 0
         self.rollbacks = 0
+        self._batch_seq = itertools.count(1)
 
     # ------------------------------------------------------------ engine
 
@@ -129,6 +131,14 @@ class HandoffManager:
                 f"handoff breaker open (retry in "
                 f"{self.breaker.status()['retry_in_s']:.1f}s)")
         cfg = self.broker.config
+        max_conc = max(1, int(cfg.get("rebalance_max_concurrent", 4)))
+        if len(self.active) >= max_conc:
+            # the global limiter: automation (planner cycles racing an
+            # operator drain) must not freeze half the node at once
+            self.broker.metrics.incr("handoff_auto_limited")
+            raise HandoffRefused(
+                f"concurrent handoff limit reached "
+                f"({len(self.active)}/{max_conc} in flight)")
         freeze_s = max(0.001, float(
             cfg.get("handoff_freeze_deadline_ms", 500)) / 1000.0)
         drain_s = max(0.001, float(
@@ -270,10 +280,15 @@ class HandoffManager:
             rollback=lambda: mm.unfreeze(s))
 
     async def rebalance_slices(
-            self, members: Optional[Sequence[str]] = None) -> Dict[str, Any]:
+            self, members: Optional[Sequence[str]] = None,
+            load_of: Optional[Callable[[str], float]] = None
+    ) -> Dict[str, Any]:
         """Move every local slice the deterministic round-robin assigns
         elsewhere (the claim rule, mesh_map.py) to its target, one
-        bounded handoff at a time. Returns {moved, failed, members}."""
+        bounded handoff at a time. With ``load_of`` (the health plane's
+        gossiped scorer) the claim rule still decides WHICH slices
+        leave, but each goes to the least-loaded peer instead of its
+        round-robin home. Returns {moved, failed, members}."""
         mm = self.broker.mesh_map
         if mm is None:
             raise HandoffRefused("no mesh slice map on this node")
@@ -282,17 +297,26 @@ class HandoffManager:
                        if self.broker.cluster is not None
                        else [self.broker.node_name])
         members = sorted(set(members) | {self.broker.node_name})
+        provisional: Dict[str, float] = {}
+        if load_of is not None:
+            provisional = {m: float(load_of(m)) for m in members
+                           if m != self.broker.node_name}
         moved: List[int] = []
         failed: List[int] = []
         for s in list(mm.local_slices()):
             target = members[s % len(members)]
             if target == self.broker.node_name:
                 continue
+            if provisional:
+                target = min(provisional,
+                             key=lambda m: (provisional[m], m))
             try:
                 ok = await self.transfer_slice(s, target)
             except HandoffRefused:
                 ok = False
             (moved if ok else failed).append(s)
+            if ok and provisional:
+                provisional[target] += 0.01  # health._ASSIGN_STEP
         return {"moved": moved, "failed": failed, "members": members}
 
     # ---------------------------------------------------------- sessions
@@ -329,7 +353,8 @@ class HandoffManager:
         broker.migrations[sid] = mig
         state: Dict[str, Any] = {"frozen_online": False,
                                  "draining": False,
-                                 "leftover": [], "shipped": []}
+                                 "leftover": [], "shipped": [],
+                                 "redirect": None}
 
         def _freeze():
             if queue.state == ONLINE and not queue._resuming:
@@ -340,9 +365,24 @@ class HandoffManager:
 
         async def _drain():
             session = broker.sessions.get(sid)
-            if session is not None:
-                await session.takeover_close()
-            backlog = queue.start_drain()  # supersedes the freeze parking
+            if (session is not None
+                    and getattr(session, "proto_ver", 4) >= 5
+                    and broker.config.get("handoff_v5_redirect", True)):
+                # MQTT5 server redirect: keep the connection up through
+                # the drain — the client learns where its state went
+                # only in _adopt (DISCONNECT 0x9C/0x9D with Server
+                # Reference, after fence+adopt committed) and then
+                # reconnects straight to the new owner instead of
+                # bouncing a takeover through this node. Unacked
+                # in-flight QoS>=1 detaches into the head of the
+                # backlog: redelivery at the target beats loss.
+                state["redirect"] = session
+                backlog = session.detach_inflight()
+                backlog.extend(queue.start_drain())
+            else:
+                if session is not None:
+                    await session.takeover_close()
+                backlog = queue.start_drain()  # supersedes the parking
             state["draining"] = True
             state["leftover"] = backlog
             mig["pending"] = len(backlog)
@@ -372,6 +412,18 @@ class HandoffManager:
                     break
                 broker.metrics.incr("handoff_fenced_writes", len(late))
                 await self._ship(sid, target, late, state, mig)
+            sess = state["redirect"]
+            if sess is not None:
+                # state is fenced and shipped: NOW tell the v5 client
+                # where it lives. Its close may park one last
+                # straggler — sweep once more behind it.
+                await sess.redirect_close(
+                    broker.cluster.server_reference(target))
+                late = queue.drain_pending()
+                if late:
+                    broker.metrics.incr("handoff_fenced_writes",
+                                        len(late))
+                    await self._ship(sid, target, late, state, mig)
             broker.delete_offline(sid)
             broker.metrics.incr("queue_migrated")
             # clean_session stays False: queue_terminated must NOT
@@ -406,6 +458,15 @@ class HandoffManager:
                 leftover = list(state["shipped"])
                 leftover.extend(state["leftover"])
                 leftover.extend(queue.drain_pending())
+                sess = state["redirect"]
+                if sess is not None and broker.sessions.get(sid) is sess:
+                    # redirect drain: the client never saw a DISCONNECT
+                    # and is still connected — re-enter ONLINE and
+                    # redeliver locally instead of parking offline
+                    queue.restore_online(leftover)
+                    broker.metrics.incr("queue_drain_failed")
+                    broker.migrations.pop(sid, None)
+                    return
                 queue.offline.extend(leftover)
                 queue.state = OFFLINE
                 queue._arm_expiry()  # start_drain cancelled the clock
@@ -447,16 +508,183 @@ class HandoffManager:
             state["leftover"] = backlog[i + len(chunk):]
             mig["pending"] = len(state["leftover"])
 
+    async def handoff_sessions_batch(self, sids: Sequence[Any],
+                                     target: str) -> Any:
+        """Migrate MANY persistent sessions to one ``target`` through a
+        single four-phase handoff: freeze all, drain all, then ONE
+        fence write for the whole batch (``store_many`` — the
+        per-session record rewrite is what made big drains O(sessions)
+        metadata epoch bumps), adopt all. A wedge anywhere fails the
+        whole batch and rollback is per-session (pre-fence undo /
+        post-fence roll-forward), so the caller can retry stragglers
+        individually. Returns ``(ok, eligible_sids)``; raises
+        :class:`HandoffRefused` when nothing in the batch is movable."""
+        from ..broker.queue import OFFLINE, ONLINE
+
+        broker = self.broker
+        if broker.cluster is None:
+            raise HandoffRefused("not clustered")
+        if target == broker.node_name:
+            raise HandoffRefused("target is this node")
+        units: List[Any] = []
+        for sid in sids:
+            queue = broker.registry.queues.get(sid)
+            if queue is None or queue.opts.clean_session:
+                continue
+            rec = broker.registry.db.read(sid)
+            if rec is None or rec.node != broker.node_name:
+                continue
+            if f"session:{_sid_label(sid)}" in self.active:
+                continue  # an individual move already owns it
+            units.append((sid, queue))
+        if not units:
+            raise HandoffRefused("no eligible sessions in batch")
+        states: Dict[Any, Dict[str, Any]] = {}
+        for sid, queue in units:
+            prev = broker.migrations.get(sid) or {}
+            mig = {"target": target, "pending": len(queue.offline),
+                   "retries": 0, "state": "handoff",
+                   **{k: prev[k] for k in ("tried",) if k in prev}}
+            broker.migrations[sid] = mig
+            states[sid] = {"mig": mig, "frozen_online": False,
+                           "draining": False, "adopted": False,
+                           "leftover": [], "shipped": [],
+                           "redirect": None}
+
+        def _freeze():
+            for sid, queue in units:
+                if queue.state == ONLINE and not queue._resuming:
+                    queue.begin_resume()
+                    states[sid]["frozen_online"] = True
+
+        async def _drain():
+            redirect_on = broker.config.get("handoff_v5_redirect", True)
+            for sid, queue in units:
+                st = states[sid]
+                session = broker.sessions.get(sid)
+                if (session is not None and redirect_on
+                        and getattr(session, "proto_ver", 4) >= 5):
+                    st["redirect"] = session
+                    backlog = session.detach_inflight()
+                    backlog.extend(queue.start_drain())
+                else:
+                    if session is not None:
+                        await session.takeover_close()
+                    backlog = queue.start_drain()
+                st["draining"] = True
+                st["leftover"] = backlog
+                st["mig"]["pending"] = len(backlog)
+                await self._ship(sid, target, backlog, st, st["mig"])
+                while True:
+                    more = queue.drain_pending()
+                    if not more:
+                        break
+                    st["leftover"] = more
+                    st["mig"]["pending"] = len(more)
+                    await self._ship(sid, target, more, st, st["mig"])
+
+        def _fence():
+            pairs = []
+            for sid, _q in units:
+                rec = broker.registry.db.read(sid)
+                if rec is None:
+                    raise RuntimeError(
+                        f"subscriber record for {_sid_label(sid)} "
+                        "vanished mid-handoff")
+                rec.node = target
+                pairs.append((sid, rec))
+            # the single logical fence for the whole batch: one sweep,
+            # one counter tick, one journal event — not len(units)
+            # separate epoch bumps
+            broker.registry.db.store_many(pairs)
+            broker.metrics.incr("handoff_batch_fence_writes")
+
+        async def _adopt():
+            for sid, queue in units:
+                st = states[sid]
+                while True:
+                    late = queue.drain_pending()
+                    if not late:
+                        break
+                    broker.metrics.incr("handoff_fenced_writes",
+                                        len(late))
+                    await self._ship(sid, target, late, st, st["mig"])
+                sess = st["redirect"]
+                if sess is not None:
+                    await sess.redirect_close(
+                        broker.cluster.server_reference(target))
+                    late = queue.drain_pending()
+                    if late:
+                        broker.metrics.incr("handoff_fenced_writes",
+                                            len(late))
+                        await self._ship(sid, target, late, st,
+                                         st["mig"])
+                broker.delete_offline(sid)
+                broker.metrics.incr("queue_migrated")
+                queue.terminate("migrated")
+                broker.migrations.pop(sid, None)
+                st["adopted"] = True
+
+        def _rollback(phase: str):
+            for sid, queue in units:
+                st = states[sid]
+                mig = st["mig"]
+                if st["adopted"]:
+                    continue  # fully handed over before the failure
+                if phase == "adopt":
+                    # the batch fence committed: roll FORWARD — the
+                    # legacy bounded-retry drain finishes the tail
+                    leftover = list(st["leftover"])
+                    leftover.extend(queue.drain_pending())
+                    queue.offline.extend(leftover)
+                    queue.state = OFFLINE
+                    queue._arm_expiry()
+                    mig["state"] = "failed"
+                    mig["pending"] = len(leftover)
+                    broker.on_subscriber_moved(sid, target)
+                elif st["draining"]:
+                    leftover = list(st["shipped"])
+                    leftover.extend(st["leftover"])
+                    leftover.extend(queue.drain_pending())
+                    sess = st["redirect"]
+                    if (sess is not None
+                            and broker.sessions.get(sid) is sess):
+                        queue.restore_online(leftover)
+                        broker.metrics.incr("queue_drain_failed")
+                        broker.migrations.pop(sid, None)
+                        continue
+                    queue.offline.extend(leftover)
+                    queue.state = OFFLINE
+                    queue._arm_expiry()
+                    mig["state"] = "failed"
+                    mig["pending"] = len(leftover)
+                    broker.metrics.incr("queue_drain_failed")
+                elif st["frozen_online"]:
+                    queue.finish_resume([])
+                    broker.migrations.pop(sid, None)
+                else:
+                    broker.migrations.pop(sid, None)
+
+        label = f"{len(units)}@{target}#{next(self._batch_seq)}"
+        ok = await self.run(
+            "batch", label, target,
+            freeze=_freeze, drain=_drain, fence=_fence, adopt=_adopt,
+            rollback=_rollback)
+        return ok, [sid for sid, _q in units]
+
     # ------------------------------------------------------- node drain
 
     async def drain_node(
             self, targets: Optional[Sequence[str]] = None) -> Dict[str, Any]:
         """Evacuate this node for a restart/scale-in: flush closed
         filter windows (their partial aggregates would otherwise die
-        with the process), hand every persistent queue to the live
-        peers round-robin, then move every owned mesh slice. Each unit
-        is its own bounded handoff — one wedged move rolls back alone
-        and the sweep continues."""
+        with the process), spread every persistent queue over the live
+        peers — greedy least-loaded by the gossiped health score when
+        available, name-ordered ties otherwise — then move every owned
+        mesh slice the same way. Sessions bound for the same peer move
+        in BATCHED handoffs sharing one fence write per (batch,
+        target); a failed batch retries its members individually, so
+        one wedged session never strands its batch-mates."""
         broker = self.broker
         if targets is None:
             if broker.cluster is None:
@@ -472,8 +700,12 @@ class HandoffManager:
                 flushed = broker.filter_engine.flush_windows()
             except Exception:
                 log.exception("drain-node: filter window flush failed")
-        rr = itertools.cycle(sorted(targets))
+        health = (getattr(broker.cluster, "health", None)
+                  if broker.cluster is not None else None)
+        load_of = (health.load_of if health is not None
+                   else (lambda n: 0.0))
         sessions = {"moved": 0, "failed": 0, "skipped": 0}
+        eligible: List[Any] = []
         for sid, queue in list(broker.registry.queues.items()):
             if queue.opts.clean_session:
                 sessions["skipped"] += 1
@@ -482,19 +714,51 @@ class HandoffManager:
             if rec is None or rec.node != broker.node_name:
                 sessions["skipped"] += 1
                 continue
-            try:
-                ok = await self.handoff_session(sid, next(rr))
-            except HandoffRefused:
-                ok = False
-            sessions["moved" if ok else "failed"] += 1
+            eligible.append(sid)
+        assign = assign_targets(eligible, sorted(targets), load_of)
+        by_target: Dict[str, List[Any]] = {}
+        for sid in eligible:
+            by_target.setdefault(assign[sid], []).append(sid)
+        batch_max = max(1, int(broker.config.get(
+            "handoff_batch_max_sessions", 64)))
+        for tgt in sorted(by_target):
+            group = by_target[tgt]
+            for i in range(0, len(group), batch_max):
+                chunk = group[i:i + batch_max]
+                if len(chunk) > 1:
+                    try:
+                        ok, moved_sids = await self.handoff_sessions_batch(
+                            chunk, tgt)
+                    except HandoffRefused:
+                        ok = False
+                    if ok:
+                        sessions["moved"] += len(moved_sids)
+                        continue
+                # singleton chunk, or a failed batch retried one by one
+                for sid in chunk:
+                    rec = broker.registry.db.read(sid)
+                    if rec is not None and rec.node != broker.node_name:
+                        # the batch adopted (or rolled forward) this
+                        # one before failing — it left this node
+                        sessions["moved"] += 1
+                        continue
+                    try:
+                        ok = await self.handoff_session(sid, tgt)
+                    except HandoffRefused:
+                        ok = False
+                    sessions["moved" if ok else "failed"] += 1
         slices = {"moved": [], "failed": []}
         if broker.mesh_map is not None:
+            provisional = {t: float(load_of(t)) for t in targets}
             for s in list(broker.mesh_map.local_slices()):
+                tgt = min(provisional, key=lambda m: (provisional[m], m))
                 try:
-                    ok = await self.transfer_slice(s, next(rr))
+                    ok = await self.transfer_slice(s, tgt)
                 except HandoffRefused:
                     ok = False
                 slices["moved" if ok else "failed"].append(s)
+                if ok:
+                    provisional[tgt] += 0.01  # health._ASSIGN_STEP
         return {"windows_flushed": flushed, "sessions": sessions,
                 "slices": slices, "targets": sorted(targets)}
 

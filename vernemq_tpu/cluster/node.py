@@ -239,8 +239,16 @@ class NodeWriter:
                     await asyncio.wait_for(self._wakeup.wait(),
                                            self.PING_INTERVAL)
                 except asyncio.TimeoutError:
-                    self._buf.append((frame(b"png", None), False))
-                    self._buf_bytes += 12
+                    # the ping doubles as the load-gossip carrier: the
+                    # term (None for pre-health peers, who ignore it)
+                    # carries this node's load score + advertised
+                    # client address for the failure detector/planner
+                    term = None
+                    if hasattr(self.cluster, "ping_term"):
+                        term = self.cluster.ping_term()
+                    data = frame(b"png", term)
+                    self._buf.append((data, False))
+                    self._buf_bytes += len(data)
             if self._conn_lost or writer.is_closing():
                 raise ConnectionError("channel closed by peer")
             batch, self._buf = self._buf, []

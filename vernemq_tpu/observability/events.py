@@ -145,6 +145,31 @@ KNOWN_EVENTS: Dict[str, Tuple[str, str]] = {
         "A handoff phase failed or overran its deadline and was rolled "
         "back — the unit un-froze and the OLD owner keeps serving "
         "(detail names the phase and cause)."),
+    "member_suspect": (
+        "cluster/health",
+        "The accrual failure detector marked a peer suspect — phi "
+        "crossed health_phi_suspect or its channel tore (detail names "
+        "the peer, value is phi)."),
+    "member_down": (
+        "cluster/health",
+        "The accrual failure detector declared a peer down (phi "
+        "crossed health_phi_down); the rebalance planner is notified "
+        "(detail names the peer, value is phi)."),
+    "member_alive": (
+        "cluster/health",
+        "A suspect/down peer re-entered alive after sustaining low "
+        "suspicion for the full hysteresis hold (detail names the "
+        "peer)."),
+    "rebalance_plan": (
+        "cluster/health",
+        "The rebalance planner started a cycle — evacuation for a "
+        "down member, load-aware slice spread for a join/recovery "
+        "(detail is peer: reason)."),
+    "rebalance_skipped": (
+        "cluster/health",
+        "A planner cycle was refused by a safety rail — per-peer "
+        "cooldown, missing quorum, or the open handoff breaker "
+        "(detail is peer: cause)."),
 }
 
 #: stable code order for the fixed-width shm packing (index = wire id)
